@@ -1,0 +1,40 @@
+"""k8s_spot_rescheduler_tpu — a TPU-native spot-rescheduling framework.
+
+A from-scratch reimplementation of the capabilities of
+``coveord/k8s-spot-rescheduler`` (reference: /root/reference, a pure-Go
+Kubernetes controller) with the per-tick drain *plan* reformulated as a
+batched, vectorized bin-packing problem solved on TPU via JAX/XLA/Pallas.
+
+Architecture (see SURVEY.md for the reference layer map this mirrors):
+
+- ``utils/``      — config dataclass, k8s quantity parsing, label matching,
+                    leveled logging, injectable clocks.
+- ``models/``     — the host-side cluster model (PodSpec/NodeSpec/NodeInfo,
+                    node-map builder, evictability filter) and the dense
+                    tensor packing (``PackedCluster``).
+- ``predicates/`` — vectorized scheduler-predicate masks (resource fit,
+                    taints/tolerations, readiness) replacing the reference's
+                    per-(pod,node) ``PredicateChecker.CheckPredicates`` probe
+                    (reference rescheduler.go:344).
+- ``solver/``     — the drain-plan solvers: a NumPy oracle faithful to the
+                    reference's serial first-fit (rescheduler.go:334-370) and
+                    a batched JAX FFD solver (scan over pod slots, vmap over
+                    candidate on-demand nodes).
+- ``ops/``        — Pallas TPU kernels for the solver hot loop.
+- ``parallel/``   — device-mesh sharding of the solver (shard_map over
+                    candidate and spot-node axes, XLA collectives).
+- ``planner/``    — the ``Planner`` interface: ``plan(state) -> DrainPlan``.
+- ``actuator/``   — host-side eviction/drain state machine with retries,
+                    timeouts and taint bookkeeping (reference scaler/).
+- ``loop/``       — the housekeeping control loop with its gates
+                    (reference rescheduler.go:144-293).
+- ``io/``         — the ClusterClient boundary: in-memory fake cluster,
+                    synthetic cluster generators, interruption replay.
+- ``metrics/``    — Prometheus series matching the reference's
+                    (metrics/metrics.go) plus solver timing.
+- ``cli/``        — process entry point with the reference's flag surface.
+"""
+
+__version__ = "0.1.0"
+
+VERSION = __version__

@@ -1,0 +1,4 @@
+from k8s_spot_rescheduler_tpu.cli.main import main
+import sys
+
+sys.exit(main())

@@ -1,0 +1,5 @@
+"""Actuation layer: node drain state machine."""
+
+from k8s_spot_rescheduler_tpu.actuator.drain import DrainError, drain_node
+
+__all__ = ["DrainError", "drain_node"]

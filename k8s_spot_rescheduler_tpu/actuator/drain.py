@@ -1,0 +1,144 @@
+"""Node-drain actuation state machine.
+
+Host-side reimplementation of the reference's ``scaler`` package
+(reference scaler/scaler.go:41-146):
+
+1. taint the node ToBeDeleted so the scheduler won't re-place evicted pods
+   onto it mid-drain (scaler.go:77 ``MarkToBeDeleted``);
+2. evict every pod, retrying each failed eviction every
+   ``eviction_retry_time`` until ``pod_eviction_timeout`` expires
+   (scaler.go:47-62; the reference fans out one goroutine per pod and
+   fans in over a channel, scaler.go:93-113 — here the same retry
+   schedule runs as round-robin passes over the not-yet-evicted set,
+   which preserves the per-pod retry cadence without threads);
+3. poll every 5 s until every pod is confirmed off the node or the
+   timeout passes (scaler.go:119-144);
+4. on success un-taint — the drained node stays schedulable as spare
+   capacity for the next drain (scaler.go:138-141, README.md:117);
+   on any failure un-taint and emit a warning event (the reference's
+   deferred cleanup, scaler.go:83-88).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from k8s_spot_rescheduler_tpu.io.cluster import ClusterClient, EventSink
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    NodeSpec,
+    PodSpec,
+    Taint,
+    TO_BE_DELETED_TAINT,
+)
+from k8s_spot_rescheduler_tpu.utils.clock import Clock
+from k8s_spot_rescheduler_tpu.utils import logging as log
+
+VERIFY_POLL_INTERVAL = 5.0  # scaler.go:143 time.Sleep(5 * time.Second)
+
+
+class DrainError(Exception):
+    pass
+
+
+def drain_node(
+    client: ClusterClient,
+    recorder: EventSink,
+    node: NodeSpec,
+    pods: Sequence[PodSpec],
+    *,
+    clock: Clock,
+    max_graceful_termination: int,
+    pod_eviction_timeout: float,
+    eviction_retry_time: float,
+) -> None:
+    """Drain ``node`` of ``pods``; raises DrainError on failure
+    (reference scaler.go:68-146 ``DrainNode``)."""
+    taint = Taint(TO_BE_DELETED_TAINT, "", "NoSchedule")
+    try:
+        client.add_taint(node.name, taint)
+    except Exception as err:  # noqa: BLE001 — any apiserver failure aborts
+        recorder.event(
+            "Node", node.name, "Warning", "ReschedulerFailed",
+            f"failed to mark the node as draining/unschedulable: {err}",
+        )
+        raise DrainError(str(err)) from err
+    recorder.event(
+        "Node", node.name, "Normal", "Rescheduler",
+        "marked the node as draining/unschedulable",
+    )
+
+    drain_successful = False
+    try:
+        retry_until = clock.now() + pod_eviction_timeout
+
+        # Eviction fan-out with the reference's retry cadence: every pod is
+        # attempted, then the failed set is retried each retry period until
+        # the deadline (scaler.go:47-62 per-pod loop, flattened into rounds).
+        remaining: List[PodSpec] = list(pods)
+        while remaining:
+            failed: List[PodSpec] = []
+            for pod in remaining:
+                try:
+                    client.evict_pod(pod, max_graceful_termination)
+                    metrics.update_evictions_count()
+                except Exception as err:  # noqa: BLE001 — retry any apiserver
+                    failed.append(pod)  # error until deadline (scaler.go:47-62)
+                    last_error = err
+            remaining = failed
+            if remaining:
+                if clock.now() + eviction_retry_time >= retry_until:
+                    for pod in remaining:
+                        recorder.event(
+                            "Pod", pod.uid, "Warning", "ReschedulerFailed",
+                            "failed to delete pod from on-demand node",
+                        )
+                    raise DrainError(
+                        f"failed to drain node {node.name}, due to following "
+                        f"errors: {last_error}"
+                    )
+                clock.sleep(eviction_retry_time)
+
+        # Verification poll (scaler.go:119-144): all pods must be off the
+        # node before the deadline.
+        while clock.now() < retry_until + VERIFY_POLL_INTERVAL:
+            all_gone = True
+            for pod in pods:
+                try:
+                    returned = client.get_pod(pod.namespace, pod.name)
+                except Exception as err:  # noqa: BLE001 — scaler.go:129-133
+                    log.error("Failed to check pod %s: %s", pod.uid, err)
+                    all_gone = False
+                    break
+                if returned is not None and returned.node_name == node.name:
+                    log.error("Not deleted yet %s", pod.name)
+                    all_gone = False
+                    break
+            if all_gone:
+                log.vlog(4, "All pods removed from %s", node.name)
+                drain_successful = True
+                recorder.event(
+                    "Node", node.name, "Normal", "Rescheduler",
+                    "marked the node as drained/schedulable",
+                )
+                try:
+                    client.remove_taint(node.name, TO_BE_DELETED_TAINT)
+                except Exception as err:  # noqa: BLE001
+                    log.error("Failed to clean taint on %s: %s", node.name, err)
+                return
+            clock.sleep(VERIFY_POLL_INTERVAL)
+        raise DrainError(
+            f"failed to drain node {node.name}: pods remaining after timeout"
+        )
+    finally:
+        if not drain_successful:
+            # deferred cleanup (scaler.go:83-88); cleanup failures must not
+            # mask the original DrainError or crash the loop
+            try:
+                client.remove_taint(node.name, TO_BE_DELETED_TAINT)
+            except Exception as err:  # noqa: BLE001
+                log.error("Failed to clean taint on %s: %s", node.name, err)
+            recorder.event(
+                "Node", node.name, "Warning", "ReschedulerFailed",
+                "failed to drain the node, aborting drain.",
+            )

@@ -1,0 +1,5 @@
+"""Process entry point."""
+
+from k8s_spot_rescheduler_tpu.cli.main import build_parser, main
+
+__all__ = ["build_parser", "main"]

@@ -1,0 +1,173 @@
+"""Process entry point.
+
+Flag-for-flag parity with the reference's CLI (reference
+rescheduler.go:48-142: 13 pflag flags + glog's -v + --version), plus the
+TPU-native knobs (solver backend, resources, mesh) and a cluster source
+selector: the reference always talks to a live apiserver; this framework
+additionally runs against synthetic clusters (demo/benchmark mode) behind
+the same ClusterClient interface.
+
+Run e.g.::
+
+    python -m k8s_spot_rescheduler_tpu --cluster synthetic:1 --ticks 3 -v 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from k8s_spot_rescheduler_tpu import VERSION
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from k8s_spot_rescheduler_tpu.utils.durations import parse_duration
+from k8s_spot_rescheduler_tpu.utils.labels import LabelFormatError
+from k8s_spot_rescheduler_tpu.utils import logging as log
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="k8s-spot-rescheduler-tpu",
+        description="TPU-native spot rescheduler",
+    )
+    d = ReschedulerConfig()
+    # --- reference flag surface (rescheduler.go:48-108) ---
+    p.add_argument("--running-in-cluster", type=_bool, default=d.running_in_cluster,
+                   help="use in-cluster credentials (reference rescheduler.go:53)")
+    p.add_argument("--namespace", default=d.namespace)
+    p.add_argument("--kube-api-content-type", default=d.kube_api_content_type)
+    p.add_argument("--housekeeping-interval", default="10s",
+                   help="how often rescheduler takes actions (Go duration)")
+    p.add_argument("--node-drain-delay", default="10m",
+                   help="wait between draining nodes")
+    p.add_argument("--pod-eviction-timeout", default="2m")
+    p.add_argument("--max-graceful-termination", default="2m")
+    p.add_argument("--listen-address", default=d.listen_address,
+                   help="prometheus metrics address")
+    p.add_argument("--kubeconfig", default=d.kubeconfig)
+    p.add_argument("--delete-non-replicated-pods", type=_bool,
+                   default=d.delete_non_replicated_pods)
+    p.add_argument("--on-demand-node-label", default=d.on_demand_node_label)
+    p.add_argument("--spot-node-label", default=d.spot_node_label)
+    p.add_argument("--priority-threshold", type=int, default=d.priority_threshold)
+    p.add_argument("--version", action="store_true", help="show version and exit")
+    p.add_argument("-v", "--verbosity", type=int, default=0, help="glog-style -v")
+    # --- TPU-native knobs ---
+    p.add_argument("--solver", default=d.solver,
+                   choices=["jax", "numpy", "pallas", "sharded"])
+    p.add_argument("--resources", default=",".join(d.resources),
+                   help="comma-separated resource axes to pack")
+    p.add_argument("--cluster", default="synthetic:1",
+                   help="cluster source: synthetic:<config#>[:seed] (demo/bench)"
+                        " or kube (real apiserver; not available in this build)")
+    p.add_argument("--ticks", type=int, default=0,
+                   help="run N housekeeping ticks then exit (0 = forever)")
+    p.add_argument("--no-metrics-server", action="store_true")
+    return p
+
+
+def _bool(s: str) -> bool:
+    return str(s).lower() in ("1", "true", "yes")
+
+
+def config_from_args(args) -> ReschedulerConfig:
+    return ReschedulerConfig(
+        running_in_cluster=args.running_in_cluster,
+        namespace=args.namespace,
+        kube_api_content_type=args.kube_api_content_type,
+        housekeeping_interval=parse_duration(args.housekeeping_interval),
+        node_drain_delay=parse_duration(args.node_drain_delay),
+        pod_eviction_timeout=parse_duration(args.pod_eviction_timeout),
+        max_graceful_termination=parse_duration(args.max_graceful_termination),
+        listen_address=args.listen_address,
+        kubeconfig=args.kubeconfig,
+        delete_non_replicated_pods=args.delete_non_replicated_pods,
+        on_demand_node_label=args.on_demand_node_label,
+        spot_node_label=args.spot_node_label,
+        priority_threshold=args.priority_threshold,
+        solver=args.solver,
+        resources=tuple(r for r in args.resources.split(",") if r),
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(f"k8s-spot-rescheduler-tpu {VERSION}")
+        return 0
+
+    log.setup(args.verbosity)
+    try:
+        config = config_from_args(args)
+    except (LabelFormatError, ValueError) as err:
+        print(f"Error: {err}", file=sys.stderr)
+        return 1
+
+    log.info("Running Rescheduler")
+    if not args.no_metrics_server:
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+        metrics.serve(config.listen_address)
+
+    from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+
+    if args.cluster.startswith("synthetic:"):
+        from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
+
+        parts = args.cluster.split(":")
+        try:
+            spec = CONFIGS[int(parts[1])]
+            seed = int(parts[2]) if len(parts) > 2 else 0
+        except (KeyError, ValueError, IndexError):
+            print(
+                f"Error: unknown synthetic config {args.cluster!r} "
+                f"(available: {sorted(CONFIGS)})",
+                file=sys.stderr,
+            )
+            return 1
+        log.info("Generating synthetic cluster %s (seed %d)", spec.name, seed)
+        client = generate_cluster(spec, seed, reschedule_evicted=True)
+        # the demo always runs on the fake cluster's virtual clock — pod
+        # termination timers live on it
+        clock = client.clock
+        recorder = client
+    elif args.cluster == "kube":
+        print(
+            "Error: the real-apiserver client is not wired in this build; "
+            "use --cluster synthetic:<n>",
+            file=sys.stderr,
+        )
+        return 1
+    else:
+        print(f"Error: unknown --cluster {args.cluster!r}", file=sys.stderr)
+        return 1
+
+    try:
+        planner = SolverPlanner(config)
+    except ValueError as err:
+        print(f"Error: {err}", file=sys.stderr)
+        return 1
+    r = Rescheduler(client, planner, config, clock=clock, recorder=recorder)
+    ticks = 0
+    while args.ticks == 0 or ticks < args.ticks:
+        clock.sleep(config.housekeeping_interval)
+        result = r.tick()
+        ticks += 1
+        if result.drained or result.drain_failed:
+            log.info(
+                "tick %d: drained=%s failed=%s", ticks,
+                result.drained, result.drain_failed,
+            )
+        elif result.report is not None:
+            log.info(
+                "tick %d: %d candidates, %d feasible, solve %.1f ms",
+                ticks, result.report.n_candidates, result.report.n_feasible,
+                result.report.solve_seconds * 1e3,
+            )
+        else:
+            log.info("tick %d: skipped (%s)", ticks, result.skipped)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Cluster I/O boundary: client protocol, fake cluster, generators."""
+
+from k8s_spot_rescheduler_tpu.io.cluster import ClusterClient, EventSink, EvictionError
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+
+__all__ = ["ClusterClient", "EventSink", "EvictionError", "FakeCluster"]

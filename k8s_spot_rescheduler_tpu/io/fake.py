@@ -1,0 +1,184 @@
+"""In-memory simulated cluster.
+
+The unit-test / benchmark / replay "apiserver": holds node and pod state,
+serves the read path, and models the write path with injectable failure
+counts and termination latency on a virtual clock. It optionally runs a
+tiny first-fit scheduler so evicted pods *re-appear* on spot nodes — the
+closed-loop behavior the reference relies on the real kube-scheduler for
+(README.md:116-123: evicted pods get rescheduled onto the spot pool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from k8s_spot_rescheduler_tpu.io.cluster import EvictionError
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    CPU,
+    MEMORY,
+    PODS,
+    NodeSpec,
+    PDBSpec,
+    PodSpec,
+    Taint,
+)
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.labels import matches_label
+
+
+@dataclasses.dataclass
+class Event:
+    kind: str
+    name: str
+    event_type: str
+    reason: str
+    message: str
+
+
+class FakeCluster:
+    """ClusterClient + EventSink implementation over plain dicts."""
+
+    def __init__(
+        self,
+        clock: Optional[FakeClock] = None,
+        *,
+        termination_latency: float = 1.0,
+        reschedule_evicted: bool = False,
+        spot_label: str = "kubernetes.io/role=spot-worker",
+    ):
+        self.clock = clock or FakeClock()
+        self.termination_latency = termination_latency
+        self.reschedule_evicted = reschedule_evicted
+        self.spot_label = spot_label
+        self.nodes: Dict[str, NodeSpec] = {}
+        self.pods: Dict[str, PodSpec] = {}  # keyed by namespace/name
+        self._by_node: Dict[str, Dict[str, PodSpec]] = {}  # node -> uid -> pod
+        self.pdbs: List[PDBSpec] = []
+        self.events: List[Event] = []
+        self.pending: List[PodSpec] = []  # unschedulable (evicted, unplaced)
+        # pod uid -> number of eviction calls that must fail first
+        self.eviction_failures: Dict[str, int] = {}
+        self.evictions: List[str] = []  # audit log of successful evictions
+
+    # --- state construction helpers ---
+
+    def add_node(self, node: NodeSpec) -> None:
+        self.nodes[node.name] = node
+        self.retry_pending()
+
+    def add_pod(self, pod: PodSpec) -> None:
+        assert pod.node_name in self.nodes, f"unknown node {pod.node_name}"
+        self.pods[pod.uid] = pod
+        self._by_node.setdefault(pod.node_name, {})[pod.uid] = pod
+
+    def _remove_pod(self, uid: str) -> Optional[PodSpec]:
+        pod = self.pods.pop(uid, None)
+        if pod is not None:
+            self._by_node.get(pod.node_name, {}).pop(uid, None)
+        return pod
+
+    def remove_node(self, name: str) -> List[PodSpec]:
+        """Spot interruption: the node and its pods vanish; returns the
+        displaced pods (the replay harness re-queues them as pending)."""
+        self.nodes.pop(name, None)
+        displaced = list(self._by_node.pop(name, {}).values())
+        for p in displaced:
+            self.pods.pop(p.uid, None)
+        return displaced
+
+    # --- read path ---
+
+    def list_ready_nodes(self) -> List[NodeSpec]:
+        # reference uses NewReadyNodeLister (rescheduler.go:154): not-ready
+        # nodes are invisible to the controller.
+        return [n for n in self.nodes.values() if n.ready]
+
+    def list_pods_on_node(self, node_name: str) -> List[PodSpec]:
+        return list(self._by_node.get(node_name, {}).values())
+
+    def list_unschedulable_pods(self) -> List[PodSpec]:
+        return list(self.pending)
+
+    def list_pdbs(self) -> List[PDBSpec]:
+        return list(self.pdbs)
+
+    def get_pod(self, namespace: str, name: str) -> Optional[PodSpec]:
+        return self.pods.get(f"{namespace}/{name}")
+
+    # --- write path ---
+
+    def evict_pod(self, pod: PodSpec, grace_seconds: int) -> None:
+        live = self.pods.get(pod.uid)
+        if live is None:
+            return  # already gone — eviction succeeds trivially
+        remaining = self.eviction_failures.get(pod.uid, 0)
+        if remaining > 0:
+            self.eviction_failures[pod.uid] = remaining - 1
+            raise EvictionError(f"simulated eviction failure for {pod.uid}")
+        self.evictions.append(pod.uid)
+        # pod terminates after its graceful period (bounded by latency knob)
+        delay = min(float(grace_seconds), self.termination_latency)
+        self.clock.call_at(self.clock.now() + delay, lambda: self._terminate(pod.uid))
+
+    def _terminate(self, uid: str) -> None:
+        pod = self._remove_pod(uid)
+        if pod is None:
+            return
+        if self.reschedule_evicted:
+            self._schedule(pod)
+            self.retry_pending()
+
+    def retry_pending(self) -> None:
+        """Re-attempt placement of unschedulable pods (capacity may have
+        appeared since)."""
+        if not self.reschedule_evicted or not self.pending:
+            return
+        waiting, self.pending = self.pending, []
+        for pod in waiting:
+            self._schedule(pod)
+
+    def _schedule(self, pod: PodSpec) -> None:
+        """Minimal kube-scheduler stand-in: first spot node with room."""
+        for node in self.nodes.values():
+            if not matches_label(node.labels, self.spot_label):
+                continue
+            if not node.ready or node.unschedulable:
+                continue
+            hard = [t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")]
+            if any(
+                not any(tol.tolerates(t) for tol in pod.tolerations) for t in hard
+            ):
+                continue
+            here = self.list_pods_on_node(node.name)
+            if len(here) >= node.allocatable.get(PODS, 110):
+                continue
+            free_cpu = node.allocatable.get(CPU, 0) - sum(
+                p.requests.get(CPU, 0) for p in here
+            )
+            free_mem = node.allocatable.get(MEMORY, 0) - sum(
+                p.requests.get(MEMORY, 0) for p in here
+            )
+            if pod.requests.get(CPU, 0) <= free_cpu and (
+                pod.requests.get(MEMORY, 0) <= free_mem
+            ):
+                self.add_pod(dataclasses.replace(pod, node_name=node.name))
+                return
+        self.pending.append(pod)
+
+    def add_taint(self, node_name: str, taint: Taint) -> None:
+        node = self.nodes[node_name]
+        if taint not in node.taints:
+            node.taints.append(taint)
+
+    def remove_taint(self, node_name: str, taint_key: str) -> None:
+        node = self.nodes.get(node_name)
+        if node:
+            node.taints = [t for t in node.taints if t.key != taint_key]
+
+    # --- event sink ---
+
+    def event(
+        self, kind: str, name: str, event_type: str, reason: str, message: str
+    ) -> None:
+        self.events.append(Event(kind, name, event_type, reason, message))

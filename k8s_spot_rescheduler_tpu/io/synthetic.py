@@ -1,0 +1,223 @@
+"""Synthetic cluster generation — the benchmark configs of BASELINE.md.
+
+Descendant of the reference tests' fixture builders
+(``createTestPod``/``createTestNode``/``createFakeClient``, reference
+nodes/nodes_test.go:324-449), scaled from the 3+3-node fixture up to the
+north-star 5k-node/50k-pod clusters with Zipf pod sizes, taints,
+anti-affinity groups, PDBs and spot-interruption replay
+(BASELINE.json ``configs`` 1-5).
+
+Pods are packed onto nodes up to a target utilization so that some
+on-demand nodes are genuinely drainable and spot capacity is contended but
+not exhausted — the regime the rescheduler operates in (README.md:136-149).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    CPU,
+    MEMORY,
+    PODS,
+    NodeSpec,
+    OwnerRef,
+    PDBSpec,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+
+ON_DEMAND_LABELS = {"kubernetes.io/role": "worker"}
+SPOT_LABELS = {"kubernetes.io/role": "spot-worker"}
+
+# machine shapes: (cpu millicores, memory bytes, max pods)
+SHAPES = [
+    (4000, 16 * 1024**3, 110),
+    (8000, 32 * 1024**3, 110),
+    (16000, 64 * 1024**3, 250),
+]
+
+SPOT_TAINT = Taint("cloud.provider/spot", "true", "NoSchedule")
+SPOT_TOLERATION = Toleration("cloud.provider/spot", "true", "Equal", "NoSchedule")
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticSpec:
+    """Knobs for one benchmark config."""
+
+    name: str
+    n_on_demand: int
+    n_spot: int
+    n_pods: int
+    zipf_sizes: bool = False
+    taints: bool = False  # spot taint + partial toleration coverage
+    anti_affinity: bool = False
+    pdbs: bool = False
+    # mean utilization targets (fraction of allocatable CPU)
+    on_demand_util: float = 0.45
+    spot_util: float = 0.50
+
+
+CONFIGS = {
+    # 1: the reference's own test-fixture scale (rescheduler_test.go:40-151)
+    1: SyntheticSpec("fixture-3x3", 3, 3, 20),
+    # 2: first scale step — uniform sizes, cpu+mem
+    2: SyntheticSpec("500n-5kp", 250, 250, 5_000),
+    # 3: north star — Zipf sizes, taints/tolerations
+    3: SyntheticSpec("5kn-50kp-taints", 2_500, 2_500, 50_000,
+                     zipf_sizes=True, taints=True),
+    # 4: combinatorial predicates at scale
+    4: SyntheticSpec("5kn-50kp-affinity-pdb", 2_500, 2_500, 50_000,
+                     zipf_sizes=True, taints=True, anti_affinity=True,
+                     pdbs=True),
+    # 5: streaming replay base cluster (events generated separately)
+    5: SyntheticSpec("replay-1k-events", 500, 500, 8_000, zipf_sizes=True),
+}
+
+
+def _pod_sizes(rng: np.random.Generator, n: int, zipf: bool) -> np.ndarray:
+    """CPU requests in millicores. Zipf-ish skew: many small pods, a few
+    huge ones, clipped to [50m, 4000m]."""
+    if zipf:
+        raw = (rng.zipf(2.2, n) * 50).clip(50, 4000)
+    else:
+        raw = rng.integers(50, 500, n)
+    return raw.astype(np.int64)
+
+
+def generate_cluster(
+    spec: SyntheticSpec,
+    seed: int = 0,
+    clock: Optional[FakeClock] = None,
+    **fake_kwargs,
+) -> FakeCluster:
+    rng = np.random.default_rng(seed)
+    fc = FakeCluster(clock or FakeClock(), **fake_kwargs)
+
+    def mk_nodes(count: int, labels: dict, prefix: str, tainted: bool) -> List[NodeSpec]:
+        nodes = []
+        for i in range(count):
+            cpu, mem, cap = SHAPES[rng.integers(0, len(SHAPES))]
+            node = NodeSpec(
+                name=f"{prefix}-{i}",
+                labels=dict(labels),
+                allocatable={CPU: cpu, MEMORY: mem, PODS: cap},
+                taints=[SPOT_TAINT] if tainted else [],
+            )
+            nodes.append(node)
+            fc.add_node(node)
+        return nodes
+
+    on_demand = mk_nodes(spec.n_on_demand, ON_DEMAND_LABELS, "od", False)
+    # with taints enabled, 40% of spot nodes carry the spot taint
+    spot = []
+    for i, node in enumerate(mk_nodes(spec.n_spot, SPOT_LABELS, "spot", False)):
+        if spec.taints and rng.random() < 0.4:
+            node.taints.append(SPOT_TAINT)
+        spot.append(node)
+
+    sizes = _pod_sizes(rng, spec.n_pods, spec.zipf_sizes)
+    # memory request correlated with cpu: ~2-6 MiB per millicore
+    mem_per_cpu = rng.integers(2, 6, spec.n_pods).astype(np.int64)
+    mems = sizes * mem_per_cpu * 1024**2
+
+    # Fill the emptiest-fitting node first (biggest pods placed first) via a
+    # max-heap on remaining budget — O(P log N), scales to 50k pods.
+    import heapq
+
+    all_nodes = [(n, spec.on_demand_util) for n in on_demand] + [
+        (n, spec.spot_util) for n in spot
+    ]
+    heap = [
+        (-(n.allocatable[CPU] * u), 0, idx)
+        for idx, (n, u) in enumerate(all_nodes)
+    ]
+    heapq.heapify(heap)
+
+    n_apps = max(4, spec.n_pods // 100)
+    for p in np.argsort(-sizes):
+        cpu = int(sizes[p])
+        app = int(rng.integers(0, n_apps))
+        if not heap:
+            break
+        neg_room, cnt, best = heap[0]
+        if -neg_room < cpu:
+            continue  # even the roomiest node is full at target utilization
+        heapq.heappop(heap)
+        node = all_nodes[best][0]
+        if cnt + 1 < node.allocatable[PODS] - 5:
+            heapq.heappush(heap, (neg_room + cpu, cnt + 1, best))
+        is_spot = node.labels == SPOT_LABELS
+        tolerations = []
+        if spec.taints and (is_spot or rng.random() < 0.7):
+            # pods already on tainted spot nodes must tolerate; 70% of
+            # on-demand pods are spot-tolerant (the movable majority)
+            tolerations = [SPOT_TOLERATION]
+        pod = PodSpec(
+            name=f"pod-{p}",
+            namespace=f"ns-{app % 16}",
+            node_name=node.name,
+            requests={CPU: cpu, MEMORY: int(mems[p])},
+            labels={"app": f"app-{app}"},
+            owner_refs=[OwnerRef("ReplicaSet", f"app-{app}-rs")],
+            tolerations=tolerations,
+            anti_affinity_group=(
+                f"aff-{app}" if spec.anti_affinity and rng.random() < 0.1 else ""
+            ),
+        )
+        fc.add_pod(pod)
+
+    if spec.pdbs:
+        for a in range(0, n_apps, 3):  # every third app gets a PDB
+            fc.pdbs.append(
+                PDBSpec(
+                    name=f"pdb-app-{a}",
+                    namespace=f"ns-{a % 16}",
+                    match_labels={"app": f"app-{a}"},
+                    disruptions_allowed=int(rng.integers(1, 10)),
+                )
+            )
+    return fc
+
+
+@dataclasses.dataclass
+class ReplayEvent:
+    at: float  # seconds from start
+    kind: str  # "add_spot" | "remove_spot"
+    node: Optional[NodeSpec] = None
+    node_name: str = ""
+
+
+def generate_replay(
+    spec: SyntheticSpec, n_events: int = 1000, seed: int = 0
+) -> Tuple[FakeCluster, List[ReplayEvent]]:
+    """Config 5: a base cluster plus a timed stream of spot add/remove
+    events (interruption replay, BASELINE.json config 5)."""
+    rng = np.random.default_rng(seed + 1)
+    fc = generate_cluster(spec, seed, reschedule_evicted=True)
+    events: List[ReplayEvent] = []
+    t = 0.0
+    extra = 0
+    live_spot = [n for n in fc.nodes if n.startswith("spot-")]
+    for _ in range(n_events):
+        t += float(rng.exponential(7.0))
+        if rng.random() < 0.5 and live_spot:
+            name = live_spot.pop(int(rng.integers(0, len(live_spot))))
+            events.append(ReplayEvent(at=t, kind="remove_spot", node_name=name))
+        else:
+            cpu, mem, cap = SHAPES[rng.integers(0, len(SHAPES))]
+            node = NodeSpec(
+                name=f"spot-new-{extra}",
+                labels=dict(SPOT_LABELS),
+                allocatable={CPU: cpu, MEMORY: mem, PODS: cap},
+            )
+            extra += 1
+            live_spot.append(node.name)
+            events.append(ReplayEvent(at=t, kind="add_spot", node=node))
+    return fc, events

@@ -1,0 +1,5 @@
+"""Housekeeping control loop."""
+
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler, TickResult
+
+__all__ = ["Rescheduler", "TickResult"]

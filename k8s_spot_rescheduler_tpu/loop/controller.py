@@ -1,0 +1,211 @@
+"""The housekeeping control loop.
+
+Reimplements the reference's ``run`` (reference rescheduler.go:144-293) —
+the level-triggered observe → plan → actuate tick — against the
+ClusterClient/Planner interfaces:
+
+per tick:
+1. gate: drain-delay cooldown still running → skip (rescheduler.go:167-170);
+2. gate: any unschedulable pods → skip, don't make things worse
+   (rescheduler.go:172-181);
+3. observe: list ready nodes, build the classified node map
+   (rescheduler.go:186-199), update metrics (202), list PDBs (205);
+4. plan: prove per-candidate drain feasibility (the Planner replaces the
+   canDrainNode/findSpotNodeForPod nest, rescheduler.go:228-275);
+5. actuate: drain the first feasible node, arm the cooldown, stop — at
+   most ``max_drains_per_tick`` (=1, faithful) drains per tick
+   (rescheduler.go:280-286);
+6. any observation error skips the tick (`continue`), never crashes the
+   loop — the recovery story is "recompute everything next tick"
+   (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from k8s_spot_rescheduler_tpu.actuator.drain import DrainError, drain_node
+from k8s_spot_rescheduler_tpu.io.cluster import ClusterClient, EventSink
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.models.cluster import NodeMap, build_node_map
+from k8s_spot_rescheduler_tpu.models.evictability import get_pods_for_deletion
+from k8s_spot_rescheduler_tpu.planner.base import Planner, PlanReport
+from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from k8s_spot_rescheduler_tpu.utils import logging as log
+
+
+@dataclasses.dataclass
+class TickResult:
+    """What one housekeeping pass did (the loop's unit-test surface)."""
+
+    skipped: str = ""  # "", "cooldown", "unschedulable", "error"
+    drained: List[str] = dataclasses.field(default_factory=list)
+    drain_failed: List[str] = dataclasses.field(default_factory=list)
+    report: Optional[PlanReport] = None
+
+
+class _NullRecorder:
+    def event(self, kind, name, event_type, reason, message):
+        pass
+
+
+class Rescheduler:
+    def __init__(
+        self,
+        client: ClusterClient,
+        planner: Planner,
+        config: ReschedulerConfig,
+        *,
+        clock: Optional[Clock] = None,
+        recorder: Optional[EventSink] = None,
+    ):
+        self.client = client
+        self.planner = planner
+        self.config = config
+        self.clock = clock or RealClock()
+        self.recorder = recorder or _NullRecorder()
+        # start processing straight away (rescheduler.go:158-159)
+        self.next_drain_time = self.clock.now()
+
+    # --- observation ---
+
+    def observe(self) -> Optional[NodeMap]:
+        try:
+            nodes = self.client.list_ready_nodes()
+            pods_by_node = {
+                n.name: self.client.list_pods_on_node(n.name) for n in nodes
+            }
+        except Exception as err:  # noqa: BLE001 — skip tick on any API error
+            log.error("Failed to list cluster state: %s", err)
+            return None
+        return build_node_map(
+            nodes,
+            pods_by_node,
+            on_demand_label=self.config.on_demand_node_label,
+            spot_label=self.config.spot_node_label,
+            priority_threshold=self.config.priority_threshold,
+        )
+
+    def _update_metrics(self, node_map: NodeMap, pdbs) -> None:
+        cfg = self.config
+        metrics.update_nodes_map(
+            cfg.on_demand_node_label,
+            cfg.spot_node_label,
+            len(node_map.on_demand),
+            len(node_map.spot),
+        )
+        # pods-the-rescheduler-understands per node, both classes
+        # (rescheduler.go:259 for on-demand, 385-399 for spot)
+        for info in node_map.on_demand:
+            pods, _ = get_pods_for_deletion(
+                info.pods, pdbs,
+                delete_non_replicated=cfg.delete_non_replicated_pods,
+            )
+            metrics.update_node_pods_count(
+                cfg.on_demand_node_label, info.node.name, len(pods)
+            )
+        for info in node_map.spot:
+            pods, _ = get_pods_for_deletion(
+                info.pods, pdbs,
+                delete_non_replicated=cfg.delete_non_replicated_pods,
+            )
+            metrics.update_node_pods_count(
+                cfg.spot_node_label, info.node.name, len(pods)
+            )
+
+    # --- the tick ---
+
+    def tick(self) -> TickResult:
+        now = self.clock.now()
+        if now < self.next_drain_time:
+            log.vlog(2, "Waiting %.0fs for drain delay timer.",
+                     self.next_drain_time - now)
+            return TickResult(skipped="cooldown")
+
+        try:
+            unschedulable = self.client.list_unschedulable_pods()
+        except Exception as err:  # noqa: BLE001
+            log.error("Failed to get unschedulable pods: %s", err)
+            unschedulable = []
+        if unschedulable:
+            log.vlog(2, "Waiting for unschedulable pods to be scheduled.")
+            return TickResult(skipped="unschedulable")
+
+        log.vlog(3, "Starting node processing.")
+        node_map = self.observe()
+        if node_map is None:
+            return TickResult(skipped="error")
+
+        try:
+            pdbs = self.client.list_pdbs()
+        except Exception as err:  # noqa: BLE001
+            log.error("Failed to list PDBs: %s", err)
+            return TickResult(skipped="error")
+
+        self._update_metrics(node_map, pdbs)
+
+        if not node_map.on_demand:
+            log.vlog(2, "No nodes to process.")
+
+        report = self.planner.plan(node_map, pdbs)
+        metrics.observe_plan_duration(
+            report.solver, report.solve_seconds, report.n_candidates
+        )
+
+        result = TickResult(report=report)
+        drains = 0
+        while drains < self.config.max_drains_per_tick:
+            if drains > 0:
+                # Multi-drain mode (beyond the reference's one-per-tick):
+                # earlier drains changed the spot pool, and every
+                # feasibility proof assumed the undisturbed snapshot
+                # (independent fork lanes) — so re-observe and re-plan
+                # before each additional drain to avoid spot overcommit.
+                node_map = self.observe()
+                if node_map is None:
+                    break
+                try:
+                    pdbs = self.client.list_pdbs()
+                except Exception as err:  # noqa: BLE001
+                    log.error("Failed to list PDBs: %s", err)
+                    break
+                report = self.planner.plan(node_map, pdbs)
+            plan = report.plan
+            if plan is None:
+                break
+            log.vlog(2, "All pods on %s can be moved. Will drain node.",
+                     plan.node.node.name)
+            try:
+                drain_node(
+                    self.client,
+                    self.recorder,
+                    plan.node.node,
+                    plan.pods,
+                    clock=self.clock,
+                    max_graceful_termination=int(
+                        self.config.max_graceful_termination
+                    ),
+                    pod_eviction_timeout=self.config.pod_eviction_timeout,
+                    eviction_retry_time=self.config.eviction_retry_time,
+                )
+                metrics.update_node_drain_count("Success", plan.node.node.name)
+                result.drained.append(plan.node.node.name)
+            except DrainError as err:
+                log.error("Failed to drain node: %s", err)
+                metrics.update_node_drain_count("Failure", plan.node.node.name)
+                result.drain_failed.append(plan.node.node.name)
+            # cooldown arms after a drain attempt, success or not
+            # (rescheduler.go:280-286)
+            self.next_drain_time = self.clock.now() + self.config.node_drain_delay
+            drains += 1
+
+        log.vlog(3, "Finished processing nodes.")
+        return result
+
+    def run_forever(self) -> None:
+        """reference rescheduler.go:161-164: act every housekeeping_interval."""
+        while True:
+            self.clock.sleep(self.config.housekeeping_interval)
+            self.tick()

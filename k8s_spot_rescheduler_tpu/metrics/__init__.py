@@ -1,0 +1,19 @@
+"""Prometheus observability."""
+
+from k8s_spot_rescheduler_tpu.metrics.registry import (
+    observe_plan_duration,
+    serve,
+    update_evictions_count,
+    update_node_drain_count,
+    update_node_pods_count,
+    update_nodes_map,
+)
+
+__all__ = [
+    "observe_plan_duration",
+    "serve",
+    "update_evictions_count",
+    "update_node_drain_count",
+    "update_node_pods_count",
+    "update_nodes_map",
+]

@@ -1,0 +1,94 @@
+"""Prometheus metrics.
+
+The reference's four series under namespace ``spot_rescheduler``
+(reference metrics/metrics.go:28-64), reproduced name-for-name and
+label-for-label, plus TPU-native solver telemetry. Served over HTTP at the
+configured listen address like the reference's promhttp handler
+(rescheduler.go:126-130).
+
+Reference update points this module mirrors:
+- nodes count per tick            rescheduler.go:202 → UpdateNodesMap
+- pods per on-demand node         rescheduler.go:259
+- pods per spot node              rescheduler.go:396
+- drain success/failure counter   rescheduler.go:377-382
+- evictions counter               scaler/scaler.go:108
+"""
+
+from __future__ import annotations
+
+from prometheus_client import Counter, Gauge, Histogram, start_http_server
+
+NAMESPACE = "spot_rescheduler"
+
+node_pods_count = Gauge(
+    "node_pods_count",
+    "Number of pods on each node.",
+    ["node_type", "node"],
+    namespace=NAMESPACE,
+)
+
+nodes_count = Gauge(
+    "nodes_count",
+    "Number of nodes in cluster.",
+    ["node_type"],
+    namespace=NAMESPACE,
+)
+
+node_drain_count = Counter(
+    "node_drain_total",
+    "Number of nodes drained by rescheduler.",
+    ["drain_state", "node"],
+    namespace=NAMESPACE,
+)
+
+evictions_count = Counter(
+    "evicted_pods_total",
+    "Number of pods evicted by the rescheduler.",
+    namespace=NAMESPACE,
+)
+
+# --- TPU-native additions (no reference equivalent) ---
+
+plan_duration = Histogram(
+    "plan_duration_seconds",
+    "Wall time of one drain-plan solve on the accelerator.",
+    ["solver"],
+    namespace=NAMESPACE,
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1.0, 5.0),
+)
+
+plan_candidates = Gauge(
+    "plan_candidates",
+    "Candidate on-demand nodes evaluated in the last solve.",
+    namespace=NAMESPACE,
+)
+
+
+def update_nodes_map(on_demand_label: str, spot_label: str, n_on_demand: int, n_spot: int) -> None:
+    """reference metrics/metrics.go:73-80 (labels carry the configured
+    node-class label strings, as in the reference)."""
+    nodes_count.labels(on_demand_label).set(n_on_demand)
+    nodes_count.labels(spot_label).set(n_spot)
+
+
+def update_node_pods_count(node_type: str, node_name: str, num_pods: int) -> None:
+    node_pods_count.labels(node_type, node_name).set(num_pods)
+
+
+def update_evictions_count() -> None:
+    evictions_count.inc()
+
+
+def update_node_drain_count(state: str, node_name: str) -> None:
+    node_drain_count.labels(state, node_name).inc()
+
+
+def observe_plan_duration(solver: str, seconds: float, candidates: int) -> None:
+    plan_duration.labels(solver).observe(seconds)
+    plan_candidates.set(candidates)
+
+
+def serve(listen_address: str) -> None:
+    """Start the metrics HTTP endpoint (reference rescheduler.go:126-130)."""
+    host, _, port = listen_address.rpartition(":")
+    start_http_server(int(port), addr=host or "localhost")

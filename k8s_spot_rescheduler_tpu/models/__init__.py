@@ -1,0 +1,33 @@
+"""Host-side cluster model and dense-tensor packing."""
+
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    NodeInfo,
+    NodeMap,
+    NodeSpec,
+    OwnerRef,
+    PDBSpec,
+    PodSpec,
+    Taint,
+    Toleration,
+    build_node_map,
+    pod_cpu_requests,
+)
+from k8s_spot_rescheduler_tpu.models.evictability import (
+    BlockingPod,
+    get_pods_for_deletion,
+)
+
+__all__ = [
+    "NodeInfo",
+    "NodeMap",
+    "NodeSpec",
+    "OwnerRef",
+    "PDBSpec",
+    "PodSpec",
+    "Taint",
+    "Toleration",
+    "build_node_map",
+    "pod_cpu_requests",
+    "BlockingPod",
+    "get_pods_for_deletion",
+]

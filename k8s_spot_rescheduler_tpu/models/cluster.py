@@ -1,0 +1,240 @@
+"""Host-side cluster model: pods, nodes, and the classified node map.
+
+This is the framework's equivalent of the reference's ``nodes`` package
+(reference nodes/nodes.go): plain-data pod/node specs (instead of client-go
+API objects), a ``NodeInfo`` carrying per-node accounting, and
+``build_node_map`` reproducing the reference's classification and sort
+policy — spot nodes most-requested-CPU-first, on-demand nodes
+least-requested-first, pods biggest-CPU-request-first
+(nodes/nodes.go:63-101; policy rationale README.md:136-149).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence
+
+from k8s_spot_rescheduler_tpu.utils.labels import matches_label
+
+# Resource names use k8s conventions. Base units: "cpu" is in millicores
+# (the reference's MilliValue, nodes/nodes.go:149-165), "memory" and
+# "ephemeral-storage" in bytes, "pods" in count.
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"
+
+MIRROR_POD_ANNOTATION = "kubernetes.io/config.mirror"
+
+# Taint key the actuator sets while draining; equivalent of the cluster-
+# autoscaler ToBeDeleted taint applied via deletetaint.MarkToBeDeleted
+# (reference scaler/scaler.go:77).
+TO_BE_DELETED_TAINT = "ToBeDeletedByClusterAutoscaler"
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclasses.dataclass(frozen=True)
+class Toleration:
+    key: str = ""  # empty key + Exists tolerates everything
+    value: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    effect: str = ""  # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        """k8s toleration matching semantics."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclasses.dataclass(frozen=True)
+class OwnerRef:
+    kind: str
+    name: str
+    controller: bool = True
+
+
+@dataclasses.dataclass
+class PodSpec:
+    """A pod, reduced to what scheduling/eviction decisions need."""
+
+    name: str
+    namespace: str = "default"
+    node_name: str = ""
+    requests: Dict[str, int] = dataclasses.field(default_factory=dict)
+    priority: int = 0
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    owner_refs: List[OwnerRef] = dataclasses.field(default_factory=list)
+    tolerations: List[Toleration] = dataclasses.field(default_factory=list)
+    # Simplified pod-anti-affinity: pods sharing a non-empty group refuse to
+    # co-locate on one node (topologyKey=hostname requiredDuringScheduling).
+    anti_affinity_group: str = ""
+    phase: str = "Running"
+
+    @property
+    def uid(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def is_mirror(self) -> bool:
+        return MIRROR_POD_ANNOTATION in self.annotations
+
+    def controller_ref(self) -> Optional[OwnerRef]:
+        for ref in self.owner_refs:
+            if ref.controller:
+                return ref
+        return None
+
+    def is_daemonset(self) -> bool:
+        """DaemonSet-controlled, per the reference's ownerRef check
+        (rescheduler.go:243-249)."""
+        ref = self.controller_ref()
+        return ref is not None and ref.kind == "DaemonSet"
+
+
+@dataclasses.dataclass
+class NodeSpec:
+    name: str
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    allocatable: Dict[str, int] = dataclasses.field(default_factory=dict)
+    taints: List[Taint] = dataclasses.field(default_factory=list)
+    ready: bool = True
+    unschedulable: bool = False
+
+    def allocatable_cpu(self) -> int:
+        return int(self.allocatable.get(CPU, 0))
+
+
+@dataclasses.dataclass
+class PDBSpec:
+    """PodDisruptionBudget, reduced to the evictability decision: which pods
+    it selects and how many more disruptions it currently allows."""
+
+    name: str
+    namespace: str = "default"
+    match_labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    disruptions_allowed: int = 0
+
+    def selects(self, pod: PodSpec) -> bool:
+        if pod.namespace != self.namespace:
+            return False
+        return all(pod.labels.get(k) == v for k, v in self.match_labels.items())
+
+
+def pod_cpu_requests(pod: PodSpec) -> int:
+    """Total requested CPU millicores (reference nodes/nodes.go:158-165
+    ``getPodCPURequests``; containers are pre-summed into ``requests``)."""
+    return int(pod.requests.get(CPU, 0))
+
+
+def pods_requested(pods: Iterable[PodSpec], resource: str = CPU) -> int:
+    """Reference nodes/nodes.go:149-155 ``calculateRequestedCPU``,
+    generalized over the resource axis."""
+    return sum(int(p.requests.get(resource, 0)) for p in pods)
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    """Reference nodes/nodes.go:46-51 ``NodeInfo``."""
+
+    node: NodeSpec
+    pods: List[PodSpec]
+    requested_cpu: int
+    free_cpu: int
+
+    @classmethod
+    def build(cls, node: NodeSpec, pods: Sequence[PodSpec]) -> "NodeInfo":
+        requested = pods_requested(pods)
+        return cls(
+            node=node,
+            pods=list(pods),
+            requested_cpu=requested,
+            free_cpu=node.allocatable_cpu() - requested,
+        )
+
+    def add_pod(self, pod: PodSpec) -> None:
+        """Reference nodes/nodes.go:121-126 ``AddPod``: append and
+        recompute requested/free."""
+        self.pods.append(pod)
+        self.requested_cpu = pods_requested(self.pods)
+        self.free_cpu = self.node.allocatable_cpu() - self.requested_cpu
+
+    def copy(self) -> "NodeInfo":
+        """Shallow copy with its own pods list, like the reference's
+        ``CopyNodeInfos`` element copy (nodes/nodes.go:211-224)."""
+        return NodeInfo(
+            node=self.node,
+            pods=list(self.pods),
+            requested_cpu=self.requested_cpu,
+            free_cpu=self.free_cpu,
+        )
+
+
+@dataclasses.dataclass
+class NodeMap:
+    """Reference nodes/nodes.go:37-39, 54-60 ``Map``: node infos keyed by
+    class, in planning order."""
+
+    on_demand: List[NodeInfo]
+    spot: List[NodeInfo]
+
+
+def is_spot_node(node: NodeSpec, spot_label: str) -> bool:
+    return matches_label(node.labels, spot_label)
+
+
+def is_on_demand_node(node: NodeSpec, on_demand_label: str) -> bool:
+    return matches_label(node.labels, on_demand_label)
+
+
+def build_node_map(
+    nodes: Sequence[NodeSpec],
+    pods_by_node: Mapping[str, Sequence[PodSpec]],
+    *,
+    on_demand_label: str,
+    spot_label: str,
+    priority_threshold: int = 0,
+) -> NodeMap:
+    """Classify and sort nodes; reference nodes/nodes.go:63-119 ``NewNodeMap``
+    + ``newNodeInfo`` + ``getPodsOnNode``.
+
+    Policy reproduced exactly:
+    - pods with priority below ``priority_threshold`` are ignored **on spot
+      nodes only** (they are presumed preemptible; nodes/nodes.go:137-141),
+    - each node's pods sort biggest-CPU-request-first (nodes/nodes.go:76-80),
+    - spot-before-on-demand classification precedence (the ``switch`` at
+      nodes/nodes.go:82-92: a node carrying both labels lands in spot),
+    - spot nodes sort most-requested-CPU-first, on-demand nodes
+      least-requested-first (nodes/nodes.go:95-101) — empty the emptiest
+      on-demand node onto the fullest spot nodes (README.md:136-149).
+    """
+    on_demand: List[NodeInfo] = []
+    spot: List[NodeInfo] = []
+
+    for node in nodes:
+        spot_node = is_spot_node(node, spot_label)
+        pods = [
+            p
+            for p in pods_by_node.get(node.name, [])
+            if not (spot_node and p.priority < priority_threshold)
+        ]
+        pods.sort(key=pod_cpu_requests, reverse=True)
+        info = NodeInfo.build(node, pods)
+        if spot_node:
+            spot.append(info)
+        elif is_on_demand_node(node, on_demand_label):
+            on_demand.append(info)
+        # nodes matching neither label are ignored (nodes/nodes.go:90-91)
+
+    # Python's sort is stable, like Go's sort.Slice is not — but ties keep
+    # input order here, which is deterministic for our packers.
+    spot.sort(key=lambda n: n.requested_cpu, reverse=True)
+    on_demand.sort(key=lambda n: n.requested_cpu)
+    return NodeMap(on_demand=on_demand, spot=spot)

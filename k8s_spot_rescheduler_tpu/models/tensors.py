@@ -1,0 +1,219 @@
+"""Dense-tensor packing of cluster state.
+
+This is where the host object model (``NodeMap`` of ``NodeInfo``/``PodSpec``)
+becomes the static-shape tensor problem the TPU solver consumes — the
+framework's replacement for the reference's ``ClusterSnapshot`` build
+(reference nodes/nodes.go:226-232) and its per-candidate ``Fork``/``Revert``
+(rescheduler.go:269-275): every candidate on-demand node becomes an
+independent *batch lane* over the same initial spot-pool tensors, so lanes
+cannot see each other's hypothetical placements — exactly the fork-per-
+candidate semantics, but data-parallel.
+
+Layout:
+
+- candidate axis ``C`` — on-demand nodes in drain-priority order
+  (least-requested-CPU first, nodes/nodes.go:99-101);
+- slot axis ``K`` — each candidate's evictable pods in placement order
+  (biggest-CPU-request first, nodes/nodes.go:76-80), padded with invalid
+  slots;
+- spot axis ``S`` — spot nodes in first-fit probe order (most-requested-CPU
+  first, nodes/nodes.go:95-97), padded with never-fitting nodes;
+- resource axis ``R`` — from ``ReschedulerConfig.resources``.
+
+Numerics: requests are ceil-scaled and allocatable floor-scaled into units
+that stay below 2**24 (exact in float32) — memory in MiB, CPU in millicores.
+Rounding is asymmetric on purpose: a plan must never be approved because of
+a rounding error (safe-direction conservatism, SURVEY.md §7 (e)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    NodeInfo,
+    NodeMap,
+    PDBSpec,
+    PodSpec,
+)
+from k8s_spot_rescheduler_tpu.models.evictability import (
+    BlockingPod,
+    get_pods_for_deletion,
+)
+from k8s_spot_rescheduler_tpu.predicates.masks import (
+    AFFINITY_WORDS,
+    TaintTable,
+    intern_taints,
+    node_affinity_mask,
+    node_taint_mask,
+    pod_affinity_mask,
+    pod_toleration_mask,
+)
+
+# Scale divisor per resource so packed values stay < 2**24 (float32-exact).
+RESOURCE_SCALE: Dict[str, int] = {
+    "cpu": 1,  # millicores
+    "memory": 1 << 20,  # bytes -> MiB
+    "ephemeral-storage": 1 << 20,
+    "pods": 1,
+}
+
+DEFAULT_MAX_PODS = 110  # k8s kubelet default when a node publishes no cap
+
+
+def _ceil_div(v: int, d: int) -> int:
+    return -(-int(v) // d)
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _pad_dim(n: int) -> int:
+    """Pad to a TPU-friendly size: multiples of 8 below 128, multiples of
+    128 above (the lane width; pallas_guide tiling constraints)."""
+    if n <= 0:
+        return 8
+    if n < 128:
+        return _round_up(n, 8)
+    return _round_up(n, 128)
+
+
+class PackedCluster(NamedTuple):
+    """The static-shape device problem. All arrays are host numpy; the
+    solver moves them to the device. Shapes: C candidates, K pod slots,
+    S spot nodes, R resources, W taint words, A affinity words."""
+
+    # candidate pod slots
+    slot_req: np.ndarray  # f32 [C, K, R]
+    slot_valid: np.ndarray  # bool [C, K]
+    slot_tol: np.ndarray  # uint32 [C, K, W]
+    slot_aff: np.ndarray  # uint32 [C, K, A]
+    cand_valid: np.ndarray  # bool [C]
+    # spot pool
+    spot_free: np.ndarray  # f32 [S, R]
+    spot_count: np.ndarray  # i32 [S]
+    spot_max_pods: np.ndarray  # i32 [S]
+    spot_taints: np.ndarray  # uint32 [S, W]
+    spot_ok: np.ndarray  # bool [S]
+    spot_aff: np.ndarray  # uint32 [S, A]
+
+
+@dataclasses.dataclass
+class PackMeta:
+    """Host-side mapping from tensor indices back to cluster objects."""
+
+    candidates: List[NodeInfo]  # index = candidate lane (unpadded prefix)
+    cand_pods: List[List[PodSpec]]  # per lane, slot order
+    blocking: List[Optional[BlockingPod]]
+    spot: List[NodeInfo]  # index = spot lane (unpadded prefix)
+    taint_table: TaintTable
+    resources: Sequence[str]
+
+
+def scale_request(requests: Dict[str, int], resources: Sequence[str]) -> np.ndarray:
+    return np.array(
+        [
+            _ceil_div(requests.get(r, 0), RESOURCE_SCALE.get(r, 1))
+            for r in resources
+        ],
+        dtype=np.float32,
+    )
+
+
+def scale_allocatable(alloc: Dict[str, int], resources: Sequence[str]) -> np.ndarray:
+    return np.array(
+        [int(alloc.get(r, 0)) // RESOURCE_SCALE.get(r, 1) for r in resources],
+        dtype=np.float32,
+    )
+
+
+def pack_cluster(
+    node_map: NodeMap,
+    pdbs: Sequence[PDBSpec] = (),
+    *,
+    resources: Sequence[str] = ("cpu", "memory"),
+    delete_non_replicated: bool = False,
+    pad_candidates: int = 0,
+    pad_spot: int = 0,
+    pad_slots: int = 0,
+) -> tuple[PackedCluster, PackMeta]:
+    """Pack a classified node map into the solver problem.
+
+    The evictability filter runs here, per candidate, exactly as the control
+    loop does per node (reference rescheduler.go:231-256): a blocking pod or
+    an empty evictable set invalidates the candidate lane (it is skipped,
+    not drained). Explicit ``pad_*`` floors let callers keep shapes constant
+    across ticks to avoid recompilation (streaming replay).
+    """
+    candidates = node_map.on_demand
+    spot = node_map.spot
+    table = intern_taints([n.node for n in spot])
+    W, A, R = table.words, AFFINITY_WORDS, len(resources)
+
+    cand_pods: List[List[PodSpec]] = []
+    blocking: List[Optional[BlockingPod]] = []
+    for info in candidates:
+        pods, blocked = get_pods_for_deletion(
+            info.pods, pdbs, delete_non_replicated=delete_non_replicated
+        )
+        cand_pods.append(pods if not blocked else [])
+        blocking.append(blocked)
+
+    C = max(_pad_dim(len(candidates)), _pad_dim(pad_candidates))
+    S = max(_pad_dim(len(spot)), _pad_dim(pad_spot))
+    K = max(
+        _pad_dim(max((len(p) for p in cand_pods), default=1)),
+        _pad_dim(pad_slots),
+    )
+
+    packed = PackedCluster(
+        slot_req=np.zeros((C, K, R), np.float32),
+        slot_valid=np.zeros((C, K), bool),
+        slot_tol=np.zeros((C, K, W), np.uint32),
+        slot_aff=np.zeros((C, K, A), np.uint32),
+        cand_valid=np.zeros((C,), bool),
+        spot_free=np.zeros((S, R), np.float32),
+        spot_count=np.zeros((S,), np.int32),
+        spot_max_pods=np.zeros((S,), np.int32),
+        spot_taints=np.zeros((S, W), np.uint32),
+        spot_ok=np.zeros((S,), bool),
+        spot_aff=np.zeros((S, A), np.uint32),
+    )
+
+    for c, (info, pods, blocked) in enumerate(zip(candidates, cand_pods, blocking)):
+        # a candidate with no evictable pods is skipped, not drained
+        # (reference rescheduler.go:260-265); likewise a blocked one.
+        packed.cand_valid[c] = blocked is None and len(pods) > 0
+        for k, pod in enumerate(pods):
+            packed.slot_req[c, k] = scale_request(pod.requests, resources)
+            packed.slot_valid[c, k] = True
+            packed.slot_tol[c, k] = pod_toleration_mask(pod, table)
+            packed.slot_aff[c, k] = pod_affinity_mask(pod)
+
+    for s, info in enumerate(spot):
+        alloc = scale_allocatable(info.node.allocatable, resources)
+        used = np.zeros(R, np.float32)
+        for pod in info.pods:
+            used += scale_request(pod.requests, resources)
+        packed.spot_free[s] = alloc - used
+        packed.spot_count[s] = len(info.pods)
+        packed.spot_max_pods[s] = int(
+            info.node.allocatable.get("pods", DEFAULT_MAX_PODS)
+        )
+        packed.spot_taints[s] = node_taint_mask(info.node, table)
+        packed.spot_ok[s] = info.node.ready and not info.node.unschedulable
+        packed.spot_aff[s] = node_affinity_mask(info.pods)
+
+    meta = PackMeta(
+        candidates=list(candidates),
+        cand_pods=cand_pods,
+        blocking=blocking,
+        spot=list(spot),
+        taint_table=table,
+        resources=tuple(resources),
+    )
+    return packed, meta

@@ -1,0 +1,6 @@
+"""Planner layer: plan(state) -> DrainPlan."""
+
+from k8s_spot_rescheduler_tpu.planner.base import DrainPlan, Planner
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+
+__all__ = ["DrainPlan", "Planner", "SolverPlanner"]

@@ -1,0 +1,48 @@
+"""The Planner interface.
+
+BASELINE.json's north star puts the solver "behind a Planner interface so
+the eviction/drain path stays unchanged": the control loop hands the
+classified node map + PDBs to ``plan`` and gets back either a drain
+decision or None — it never sees tensors, meshes or devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Protocol, Sequence
+
+from k8s_spot_rescheduler_tpu.models.cluster import NodeInfo, NodeMap, PDBSpec, PodSpec
+
+
+@dataclasses.dataclass
+class DrainPlan:
+    """A proven-feasible drain of one on-demand node.
+
+    ``assignments`` maps pod uid -> spot node name: the placement the
+    feasibility proof found. The reference discards this (the live
+    kube-scheduler re-places evicted pods, README.md:116-123); we surface it
+    for observability and the quality benchmarks.
+    """
+
+    node: NodeInfo
+    pods: List[PodSpec]
+    assignments: Dict[str, str]
+    candidate_index: int
+
+
+@dataclasses.dataclass
+class PlanReport:
+    """Telemetry of one solve, for metrics and the loop's logging."""
+
+    plan: Optional[DrainPlan]
+    n_candidates: int
+    n_feasible: int
+    solve_seconds: float
+    solver: str = ""
+    # all feasible candidates in drain-priority order (multi-drain planning
+    # and the quality benchmarks read this; the faithful loop uses plan only)
+    feasible_candidates: List[DrainPlan] = dataclasses.field(default_factory=list)
+
+
+class Planner(Protocol):
+    def plan(self, node_map: NodeMap, pdbs: Sequence[PDBSpec]) -> PlanReport: ...

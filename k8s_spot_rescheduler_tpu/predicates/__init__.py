@@ -1,0 +1,21 @@
+"""Vectorized scheduler-predicate oracle."""
+
+from k8s_spot_rescheduler_tpu.predicates.masks import (
+    AFFINITY_WORDS,
+    TaintTable,
+    affinity_bits,
+    fit_mask,
+    intern_taints,
+    pod_affinity_mask,
+    pod_toleration_mask,
+)
+
+__all__ = [
+    "AFFINITY_WORDS",
+    "TaintTable",
+    "affinity_bits",
+    "fit_mask",
+    "intern_taints",
+    "pod_affinity_mask",
+    "pod_toleration_mask",
+]

@@ -1,0 +1,145 @@
+"""Vectorized scheduler predicates.
+
+The reference asks the real kube-scheduler "would pod p fit on node n?" one
+(pod, node) pair at a time through ``PredicateChecker.CheckPredicates``
+(reference rescheduler.go:344; predicate list README.md:103-114: resource
+fit, taints/tolerations, node readiness, affinity, ...). Here the same
+questions are answered for *all* pairs at once from dense arrays:
+
+- **resource fit** — elementwise ``free >= request`` over the resource axis
+  plus a pod-count-vs-max-pods check;
+- **taints/tolerations** — taints on spot nodes are interned into a global
+  bit table; a node's taint bitmask AND NOT the pod's toleration bitmask
+  must be zero. Only hard effects (NoSchedule/NoExecute) block placement;
+  PreferNoSchedule is advisory and excluded from the table;
+- **readiness/schedulability** — folded into a per-node validity bit
+  (the reference only ever sees ready nodes via ``NewReadyNodeLister``,
+  rescheduler.go:154, and the scheduler rejects cordoned nodes);
+- **anti-affinity** — simplified hostname-topology groups, hashed onto a
+  fixed 64-bit mask. Hash collisions can only *forbid* extra placements,
+  never allow an invalid one — conservative in the safe direction (a plan
+  we approve must never strand a pod; SURVEY.md §7 "hard parts" (e)).
+
+All mask math is uint32 words so it runs identically under NumPy (oracle
+solver) and jnp (TPU solver).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    NodeSpec,
+    PodSpec,
+    Taint,
+    TO_BE_DELETED_TAINT,
+)
+
+HARD_EFFECTS = ("NoSchedule", "NoExecute")
+
+# Anti-affinity groups hash onto 64 bits = 2 uint32 words.
+AFFINITY_WORDS = 2
+AFFINITY_BITS = 32 * AFFINITY_WORDS
+
+
+@dataclasses.dataclass
+class TaintTable:
+    """Global interning of hard taints found on spot nodes."""
+
+    taints: List[Taint]
+    words: int  # number of uint32 words per mask
+
+    def index(self, taint: Taint) -> int:
+        return self.taints.index(taint)
+
+
+def intern_taints(nodes: Sequence[NodeSpec]) -> TaintTable:
+    """Collect distinct hard taints across ``nodes`` into a bit table.
+
+    The actuator's drain taint (TO_BE_DELETED_TAINT, reference
+    scaler/scaler.go:77) is always interned so a draining node never
+    receives planned pods.
+    """
+    seen: dict = {}
+    for node in nodes:
+        for taint in node.taints:
+            if taint.effect in HARD_EFFECTS and taint not in seen:
+                seen[taint] = len(seen)
+    drain = Taint(TO_BE_DELETED_TAINT, "", "NoSchedule")
+    if drain not in seen:
+        seen[drain] = len(seen)
+    taints = list(seen)
+    words = max(1, -(-len(taints) // 32))
+    return TaintTable(taints=taints, words=words)
+
+
+def node_taint_mask(node: NodeSpec, table: TaintTable) -> np.ndarray:
+    mask = np.zeros(table.words, dtype=np.uint32)
+    for taint in node.taints:
+        if taint.effect in HARD_EFFECTS:
+            i = table.index(taint)
+            mask[i // 32] |= np.uint32(1 << (i % 32))
+    return mask
+
+
+def pod_toleration_mask(pod: PodSpec, table: TaintTable) -> np.ndarray:
+    """Bit t set iff the pod tolerates interned taint t."""
+    mask = np.zeros(table.words, dtype=np.uint32)
+    for i, taint in enumerate(table.taints):
+        if any(tol.tolerates(taint) for tol in pod.tolerations):
+            mask[i // 32] |= np.uint32(1 << (i % 32))
+    return mask
+
+
+def affinity_bits(group: str) -> Tuple[int, int]:
+    """(word, bit) for an anti-affinity group name (stable hash)."""
+    h = int.from_bytes(hashlib.blake2b(group.encode(), digest_size=8).digest(), "little")
+    b = h % AFFINITY_BITS
+    return b // 32, b % 32
+
+
+def pod_affinity_mask(pod: PodSpec) -> np.ndarray:
+    mask = np.zeros(AFFINITY_WORDS, dtype=np.uint32)
+    if pod.anti_affinity_group:
+        w, b = affinity_bits(pod.anti_affinity_group)
+        mask[w] |= np.uint32(1 << b)
+    return mask
+
+
+def node_affinity_mask(pods: Sequence[PodSpec]) -> np.ndarray:
+    """Groups already present on a node (union of its pods' masks)."""
+    mask = np.zeros(AFFINITY_WORDS, dtype=np.uint32)
+    for pod in pods:
+        mask |= pod_affinity_mask(pod)
+    return mask
+
+
+def fit_mask(
+    xp,
+    *,
+    free,  # [..., S, R] remaining capacity
+    count,  # [..., S] current pod count
+    max_pods,  # [S]
+    node_taints,  # [S, W] uint32
+    node_ok,  # [S] bool (ready, schedulable, non-padding)
+    node_aff,  # [..., S, A] uint32 groups present
+    req,  # [..., R] pod request
+    tol,  # [..., W] uint32 pod tolerations
+    aff,  # [..., A] uint32 pod group mask
+):
+    """The full per-(pod, spot-node) admissibility mask.
+
+    ``xp`` is ``numpy`` or ``jax.numpy`` — the oracle and the TPU solver
+    share this exact predicate definition, which is what the parity tests
+    lean on. Leading batch dims of ``free``/``count``/``node_aff`` and of
+    the pod operands must broadcast against each other.
+    """
+    res_ok = xp.all(free >= req[..., None, :], axis=-1)  # [..., S]
+    cnt_ok = count < max_pods
+    taint_ok = xp.all((node_taints & ~tol[..., None, :]) == 0, axis=-1)
+    aff_ok = xp.all((node_aff & aff[..., None, :]) == 0, axis=-1)
+    return res_ok & cnt_ok & taint_ok & aff_ok & node_ok

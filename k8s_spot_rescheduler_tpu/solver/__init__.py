@@ -1,0 +1,6 @@
+"""Drain-plan solvers."""
+
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.solver.ffd import SolveResult, plan_ffd, plan_ffd_jit
+
+__all__ = ["plan_oracle", "SolveResult", "plan_ffd", "plan_ffd_jit"]

@@ -1,0 +1,110 @@
+"""Batched first-fit drain solver on TPU (JAX).
+
+Replaces the reference's O(candidates × pods × spotNodes) *serial* probe
+nest (reference rescheduler.go:334-370, the "HOT LOOP" of SURVEY.md §3.2)
+with one compiled program:
+
+- the **candidate axis** is data-parallel: every on-demand node's
+  Fork/simulate/Revert (rescheduler.go:269-275) becomes an independent batch
+  lane with its own copy of the spot-pool state — lanes never interact,
+  matching the reference's one-drain-per-tick semantics where each
+  candidate is judged against the same starting snapshot;
+- the **pod-slot axis** is the only true sequential dependency (each
+  placement depletes capacity for the candidate's later pods,
+  rescheduler.go:366), so it is a ``lax.scan`` of length K = max pods per
+  candidate — NOT of length total-pods: 50k pods over 5k nodes is a ~K=64
+  scan of wide vectorized steps, not a 50k-step loop;
+- the **spot axis** is vectorized inside each step: all predicates for all
+  (lane, spot) pairs at once, then "first fit in probe order" is an argmax
+  over the boolean fit row (argmax returns the first maximum — exactly the
+  reference's linear probe order, rescheduler.go:339-350).
+
+Dtypes: capacities/requests are float32 integers < 2**24 (exact); masks are
+uint32; everything is static-shape so XLA tiles it onto the VPU/MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask
+from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+
+
+class _Carry(NamedTuple):
+    free: jax.Array  # f32 [C, S, R]
+    count: jax.Array  # i32 [C, S]
+    aff: jax.Array  # u32 [C, S, A]
+    feasible: jax.Array  # bool [C]
+
+
+def _scan_step(static, carry: _Carry, slot):
+    """Place pod-slot k for every candidate lane at once."""
+    spot_max_pods, spot_taints, spot_ok = static
+    req, valid, tol, aff = slot  # [C,R], [C], [C,W], [C,A]
+
+    fits = fit_mask(
+        jnp,
+        free=carry.free,
+        count=carry.count,
+        max_pods=spot_max_pods,
+        node_taints=spot_taints,
+        node_ok=spot_ok,
+        node_aff=carry.aff,
+        req=req,
+        tol=tol,
+        aff=aff,
+    )  # bool [C, S]
+
+    any_fit = jnp.any(fits, axis=-1)
+    first = jnp.argmax(fits, axis=-1)  # first fitting spot per lane
+    place = valid & any_fit
+
+    S = fits.shape[-1]
+    onehot = (jnp.arange(S)[None, :] == first[:, None]) & place[:, None]
+
+    free = carry.free - onehot[..., None] * req[:, None, :]
+    count = carry.count + onehot.astype(carry.count.dtype)
+    aff_acc = carry.aff | jnp.where(onehot[..., None], aff[:, None, :], 0)
+    feasible = carry.feasible & (any_fit | ~valid)
+
+    chosen = jnp.where(place, first.astype(jnp.int32), jnp.int32(-1))
+    return _Carry(free, count, aff_acc, feasible), chosen
+
+
+def plan_ffd(packed: PackedCluster) -> SolveResult:
+    """Jittable batched first-fit over a PackedCluster (device arrays)."""
+    C = packed.slot_req.shape[0]
+    S = packed.spot_free.shape[0]
+
+    carry = _Carry(
+        free=jnp.broadcast_to(packed.spot_free, (C, *packed.spot_free.shape)),
+        count=jnp.broadcast_to(packed.spot_count, (C, S)).astype(jnp.int32),
+        aff=jnp.broadcast_to(packed.spot_aff, (C, *packed.spot_aff.shape)),
+        feasible=jnp.asarray(packed.cand_valid),
+    )
+    static = (packed.spot_max_pods, packed.spot_taints, packed.spot_ok)
+
+    slots = (
+        jnp.moveaxis(packed.slot_req, 1, 0),  # [K, C, R]
+        jnp.moveaxis(packed.slot_valid, 1, 0),  # [K, C]
+        jnp.moveaxis(packed.slot_tol, 1, 0),  # [K, C, W]
+        jnp.moveaxis(packed.slot_aff, 1, 0),  # [K, C, A]
+    )
+
+    carry, chosen = jax.lax.scan(
+        functools.partial(_scan_step, static), carry, slots
+    )  # chosen: [K, C]
+
+    feasible = carry.feasible & jnp.asarray(packed.cand_valid)
+    # revert semantics (rescheduler.go:273): infeasible lanes report no plan
+    assignment = jnp.where(feasible[None, :], chosen, -1).T  # [C, K]
+    return SolveResult(feasible=feasible, assignment=assignment)
+
+
+plan_ffd_jit = jax.jit(plan_ffd)

@@ -1,0 +1,69 @@
+"""Serial first-fit oracle — the correctness anchor.
+
+A direct, readable NumPy rendition of the reference's planning nest
+(reference rescheduler.go:334-370):
+
+- ``canDrainNode`` (355-370): walk the candidate's pods in order; every pod
+  must land on some spot node or the whole candidate fails;
+- ``findSpotNodeForPod`` (334-353): walk spot nodes in their static sorted
+  order and return the first that passes the predicates;
+- snapshot commit (366): a successful placement depletes that spot node's
+  remaining capacity/count for subsequent pods of the *same* candidate;
+- fork/revert (rescheduler.go:269-275): every candidate starts from the
+  same initial spot pool — implemented here by copying the pool per lane.
+
+The TPU solver (solver/ffd.py) must produce bit-identical feasibility and
+assignments; the property tests enforce it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask
+from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+
+
+def plan_oracle(packed: PackedCluster) -> SolveResult:
+    C, K, _ = packed.slot_req.shape
+    feasible = np.zeros(C, bool)
+    assign = np.full((C, K), -1, np.int32)
+
+    for c in range(C):
+        if not packed.cand_valid[c]:
+            continue
+        # fork: private copy of the spot pool (rescheduler.go:269)
+        free = packed.spot_free.copy()
+        count = packed.spot_count.copy()
+        aff = packed.spot_aff.copy()
+        ok = True
+        for k in range(K):
+            if not packed.slot_valid[c, k]:
+                continue
+            fits = fit_mask(
+                np,
+                free=free,
+                count=count,
+                max_pods=packed.spot_max_pods,
+                node_taints=packed.spot_taints,
+                node_ok=packed.spot_ok,
+                node_aff=aff,
+                req=packed.slot_req[c, k],
+                tol=packed.slot_tol[c, k],
+                aff=packed.slot_aff[c, k],
+            )
+            if not fits.any():
+                ok = False  # pod can't be rescheduled on any spot node
+                break
+            s = int(np.argmax(fits))  # first fit in probe order
+            assign[c, k] = s
+            # commit into the fork (rescheduler.go:366)
+            free[s] -= packed.slot_req[c, k]
+            count[s] += 1
+            aff[s] |= packed.slot_aff[c, k]
+        feasible[c] = ok
+        if not ok:
+            assign[c] = -1  # revert (rescheduler.go:273)
+
+    return SolveResult(feasible=feasible, assignment=assign)

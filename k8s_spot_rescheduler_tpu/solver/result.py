@@ -1,0 +1,20 @@
+"""Solver result container."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class SolveResult(NamedTuple):
+    """Per-candidate drain feasibility.
+
+    ``feasible[c]`` — every evictable pod of candidate c fits onto the spot
+    pool (the reference's ``canDrainNode(...) == nil``).
+    ``assignment[c, k]`` — spot index receiving slot k, -1 for unplaced or
+    invalid slots. The reference discards placements after the feasibility
+    proof (the real scheduler re-places evicted pods); we keep them for
+    reporting and for the quality benchmarks.
+    """
+
+    feasible: "object"  # bool [C]
+    assignment: "object"  # int32 [C, K]

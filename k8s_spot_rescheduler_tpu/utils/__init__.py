@@ -1,0 +1,25 @@
+"""Utility layer: config, quantities, labels, logging, clocks."""
+
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from k8s_spot_rescheduler_tpu.utils.labels import (
+    matches_label,
+    validate_label,
+)
+from k8s_spot_rescheduler_tpu.utils.quantity import (
+    parse_cpu_millis,
+    parse_memory_bytes,
+    parse_quantity,
+)
+from k8s_spot_rescheduler_tpu.utils.clock import Clock, FakeClock, RealClock
+
+__all__ = [
+    "ReschedulerConfig",
+    "matches_label",
+    "validate_label",
+    "parse_cpu_millis",
+    "parse_memory_bytes",
+    "parse_quantity",
+    "Clock",
+    "FakeClock",
+    "RealClock",
+]

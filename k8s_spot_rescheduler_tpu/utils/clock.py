@@ -1,0 +1,57 @@
+"""Injectable time source.
+
+The reference calls ``time.Now()``/``time.After``/``time.Sleep`` directly
+(rescheduler.go:159-167, scaler/scaler.go:47-62, 119-144), which is why its
+control loop and actuator are untested (SURVEY.md §4). The framework routes
+all time through a ``Clock`` so the loop/actuator state machines are unit
+testable with a virtual clock.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time as _time
+from typing import Protocol
+
+
+class Clock(Protocol):
+    def now(self) -> float: ...
+    def sleep(self, seconds: float) -> None: ...
+
+
+class RealClock:
+    def now(self) -> float:
+        return _time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            _time.sleep(seconds)
+
+
+class FakeClock:
+    """Deterministic virtual clock. ``sleep`` advances time instantly and
+    fires any timers scheduled via ``call_at`` (used by the fake cluster to
+    model pod-termination latency)."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self._timers: list = []  # heap of (when, seq, fn)
+        self._seq = 0
+
+    def now(self) -> float:
+        return self._now
+
+    def call_at(self, when: float, fn) -> None:
+        heapq.heappush(self._timers, (float(when), self._seq, fn))
+        self._seq += 1
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(max(0.0, seconds))
+
+    def advance(self, seconds: float) -> None:
+        deadline = self._now + float(seconds)
+        while self._timers and self._timers[0][0] <= deadline:
+            when, _, fn = heapq.heappop(self._timers)
+            self._now = max(self._now, when)
+            fn()
+        self._now = deadline

@@ -1,0 +1,85 @@
+"""Framework configuration.
+
+Replaces the reference's flag surface (reference rescheduler.go:48-108) and
+the cross-package mutable globals it writes into (reference
+nodes/nodes.go:31-42: ``OnDemandNodeLabel``/``SpotNodeLabel``/
+``PriorityThreshold``) with one explicit, immutable dataclass that is passed
+down the stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ReschedulerConfig:
+    """All knobs of the rescheduler, with the reference's defaults.
+
+    Field-by-field parity with the reference flags (citations are into
+    /root/reference):
+
+    - ``running_in_cluster``      — rescheduler.go:53-55
+    - ``namespace``               — rescheduler.go:57-58
+    - ``kube_api_content_type``   — rescheduler.go:60-61
+    - ``housekeeping_interval``   — rescheduler.go:63-64 (10 s)
+    - ``node_drain_delay``        — rescheduler.go:66-67 (10 min)
+    - ``pod_eviction_timeout``    — rescheduler.go:69-71 (2 min)
+    - ``max_graceful_termination``— rescheduler.go:73-75 (2 min)
+    - ``listen_address``          — rescheduler.go:77-78
+    - ``kubeconfig``              — rescheduler.go:82
+    - ``delete_non_replicated_pods`` — rescheduler.go:84
+    - ``on_demand_node_label``    — rescheduler.go:98-101
+    - ``spot_node_label``         — rescheduler.go:102-105
+    - ``priority_threshold``      — rescheduler.go:107-108
+    - ``eviction_retry_time``     — scaler/scaler.go:37-38 (10 s; a const
+      in the reference, a knob here)
+
+    TPU-native additions (no reference equivalent):
+
+    - ``resources``     — which resource dimensions the solver packs into the
+      request/allocatable tensors. The reference plans on CPU millicores only
+      (nodes/nodes.go:149-165); the full predicate checker it delegates to
+      checks cpu/mem/pods (README.md:103-114).
+    - ``max_pods_per_node_hint`` — static padding bound for the solver's pod
+      axis; the packer grows it if a node exceeds the hint.
+    - ``solver``        — which solver backend plans the drain
+      ("jax", "numpy", "pallas", "sharded").
+    - ``mesh_shape``    — (candidate-axis, spot-axis) device mesh for the
+      sharded solver.
+    - ``max_drains_per_tick`` — the reference hard-codes one drain per tick
+      (rescheduler.go:286 ``break``); keep 1 for faithful behavior.
+    """
+
+    running_in_cluster: bool = True
+    namespace: str = "kube-system"
+    kube_api_content_type: str = "application/vnd.kubernetes.protobuf"
+    housekeeping_interval: float = 10.0
+    node_drain_delay: float = 600.0
+    pod_eviction_timeout: float = 120.0
+    max_graceful_termination: float = 120.0
+    listen_address: str = "localhost:9235"
+    kubeconfig: str = ""
+    delete_non_replicated_pods: bool = False
+    on_demand_node_label: str = "kubernetes.io/role=worker"
+    spot_node_label: str = "kubernetes.io/role=spot-worker"
+    priority_threshold: int = 0
+    eviction_retry_time: float = 10.0
+
+    # TPU-native knobs
+    resources: Sequence[str] = ("cpu", "memory")
+    max_pods_per_node_hint: int = 64
+    solver: str = "jax"
+    mesh_shape: tuple = (1, 1)
+    max_drains_per_tick: int = 1
+
+    def __post_init__(self):
+        from k8s_spot_rescheduler_tpu.utils.labels import validate_label
+
+        validate_label(self.on_demand_node_label, "on demand node label")
+        validate_label(self.spot_node_label, "spot node label")
+        if self.max_drains_per_tick < 1:
+            raise ValueError("max_drains_per_tick must be >= 1")
+        if not self.resources:
+            raise ValueError("resources must be non-empty")

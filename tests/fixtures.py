@@ -1,0 +1,66 @@
+"""Shared test fixtures, descended from the reference's builders
+(nodes/nodes_test.go:324-369 ``createTestPod``/``createLowPriorityTestPod``/
+``createTestNode``; rescheduler_test.go:40-123 node fixtures)."""
+
+from __future__ import annotations
+
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    CPU,
+    MEMORY,
+    PODS,
+    NodeSpec,
+    OwnerRef,
+    PodSpec,
+)
+
+SPOT_LABELS = {"kubernetes.io/role": "spot-worker"}
+ON_DEMAND_LABELS = {"kubernetes.io/role": "worker"}
+ON_DEMAND_LABEL = "kubernetes.io/role=worker"
+SPOT_LABEL = "kubernetes.io/role=spot-worker"
+
+
+def make_pod(
+    name: str,
+    cpu_millis: int,
+    node: str = "",
+    *,
+    namespace: str = "default",
+    priority: int = 0,
+    memory: int = 0,
+    replicated: bool = True,
+    **kwargs,
+) -> PodSpec:
+    """A replicated (ReplicaSet-owned) running pod, like the reference's
+    createTestPod (nodes/nodes_test.go:324-346)."""
+    requests = {CPU: cpu_millis}
+    if memory:
+        requests[MEMORY] = memory
+    owner_refs = [OwnerRef("ReplicaSet", f"{name}-rs")] if replicated else []
+    return PodSpec(
+        name=name,
+        namespace=namespace,
+        node_name=node,
+        requests=requests,
+        priority=priority,
+        owner_refs=owner_refs,
+        **kwargs,
+    )
+
+
+def make_node(
+    name: str,
+    labels: dict,
+    *,
+    cpu_millis: int = 2000,
+    memory: int = 2 * 1024**3,
+    max_pods: int = 100,
+    **kwargs,
+) -> NodeSpec:
+    """2000m CPU / 2Gi / 100-pod node, like the reference's createTestNode
+    (nodes/nodes_test.go:348-369)."""
+    return NodeSpec(
+        name=name,
+        labels=dict(labels),
+        allocatable={CPU: cpu_millis, MEMORY: memory, PODS: max_pods},
+        **kwargs,
+    )

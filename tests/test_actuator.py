@@ -1,0 +1,82 @@
+"""Actuator state-machine tests (the reference's scaler/ is untested —
+SURVEY.md §4 — so these are new coverage, driven on a virtual clock)."""
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.actuator.drain import DrainError, drain_node
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.models.cluster import TO_BE_DELETED_TAINT
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from tests.fixtures import ON_DEMAND_LABELS, make_node, make_pod
+
+
+def _cluster_with_node(n_pods=3, **kwargs):
+    clock = FakeClock()
+    fc = FakeCluster(clock, **kwargs)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    pods = [make_pod(f"p{i}", 100, "od-1") for i in range(n_pods)]
+    for p in pods:
+        fc.add_pod(p)
+    return fc, clock, pods
+
+
+def _drain(fc, clock, pods, **overrides):
+    kw = dict(
+        clock=clock,
+        max_graceful_termination=120,
+        pod_eviction_timeout=120.0,
+        eviction_retry_time=10.0,
+    )
+    kw.update(overrides)
+    drain_node(fc, fc, fc.nodes["od-1"], pods, **kw)
+
+
+def test_successful_drain_evicts_all_and_untaints():
+    fc, clock, pods = _cluster_with_node()
+    _drain(fc, clock, pods)
+    assert sorted(fc.evictions) == sorted(p.uid for p in pods)
+    assert fc.list_pods_on_node("od-1") == []
+    # node left schedulable as spare capacity (scaler.go:138-141)
+    assert fc.nodes["od-1"].taints == []
+    reasons = [e.reason for e in fc.events]
+    assert "ReschedulerFailed" not in reasons
+
+
+def test_taint_present_during_drain():
+    fc, clock, pods = _cluster_with_node(n_pods=1)
+    seen = []
+    original = fc.evict_pod
+
+    def spy(pod, grace):
+        seen.append([t.key for t in fc.nodes["od-1"].taints])
+        return original(pod, grace)
+
+    fc.evict_pod = spy
+    _drain(fc, clock, pods)
+    assert seen == [[TO_BE_DELETED_TAINT]]
+
+
+def test_eviction_retries_until_success():
+    fc, clock, pods = _cluster_with_node(n_pods=2)
+    fc.eviction_failures[pods[0].uid] = 3  # fails 3 times, then succeeds
+    _drain(fc, clock, pods)
+    assert pods[0].uid in fc.evictions
+    assert fc.list_pods_on_node("od-1") == []
+
+
+def test_eviction_timeout_fails_drain_and_cleans_taint():
+    fc, clock, pods = _cluster_with_node(n_pods=1)
+    fc.eviction_failures[pods[0].uid] = 10**6  # never succeeds
+    with pytest.raises(DrainError):
+        _drain(fc, clock, pods, pod_eviction_timeout=30.0)
+    # deferred cleanup ran (scaler.go:83-88)
+    assert fc.nodes["od-1"].taints == []
+    assert any(e.reason == "ReschedulerFailed" for e in fc.events)
+
+
+def test_pod_stuck_on_node_fails_verification():
+    fc, clock, pods = _cluster_with_node(n_pods=1, termination_latency=10_000.0)
+    # eviction succeeds but the pod never actually terminates in time
+    with pytest.raises(DrainError, match="pods remaining"):
+        _drain(fc, clock, pods, pod_eviction_timeout=30.0)
+    assert fc.nodes["od-1"].taints == []

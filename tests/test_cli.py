@@ -1,0 +1,52 @@
+"""CLI flag-surface tests (reference rescheduler.go:89-142, 407-417)."""
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.cli.main import build_parser, config_from_args, main
+from k8s_spot_rescheduler_tpu.utils.durations import parse_duration
+
+
+def test_defaults_match_reference():
+    args = build_parser().parse_args([])
+    cfg = config_from_args(args)
+    assert cfg.housekeeping_interval == 10.0  # rescheduler.go:63
+    assert cfg.node_drain_delay == 600.0  # rescheduler.go:66
+    assert cfg.pod_eviction_timeout == 120.0  # rescheduler.go:69
+    assert cfg.max_graceful_termination == 120.0  # rescheduler.go:73
+    assert cfg.listen_address == "localhost:9235"  # rescheduler.go:77
+    assert cfg.namespace == "kube-system"
+    assert cfg.on_demand_node_label == "kubernetes.io/role=worker"
+    assert cfg.spot_node_label == "kubernetes.io/role=spot-worker"
+    assert cfg.priority_threshold == 0
+    assert cfg.delete_non_replicated_pods is False
+
+
+def test_version_flag(capsys):
+    assert main(["--version"]) == 0
+    assert "k8s-spot-rescheduler-tpu" in capsys.readouterr().out
+
+
+def test_bad_label_rejected(capsys):
+    rc = main(["--on-demand-node-label", "a=b=c", "--no-metrics-server"])
+    assert rc == 1
+    assert "not correctly formatted" in capsys.readouterr().err
+
+
+def test_duration_parsing():
+    assert parse_duration("10s") == 10.0
+    assert parse_duration("10m") == 600.0
+    assert parse_duration("2m30s") == 150.0
+    assert parse_duration("1.5h") == 5400.0
+    assert parse_duration("100ms") == pytest.approx(0.1)
+    assert parse_duration(42) == 42.0
+    with pytest.raises(ValueError):
+        parse_duration("10 parsecs")
+
+
+def test_synthetic_demo_run():
+    """Full binary path: synthetic cluster, 2 ticks, jax solver."""
+    rc = main(
+        ["--cluster", "synthetic:1", "--ticks", "2", "--no-metrics-server",
+         "--node-drain-delay", "1s"]
+    )
+    assert rc == 0

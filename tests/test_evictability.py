@@ -1,0 +1,75 @@
+"""Evictability filter tests (reference rescheduler.go:231-256 semantics)."""
+
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    MIRROR_POD_ANNOTATION,
+    OwnerRef,
+    PDBSpec,
+)
+from k8s_spot_rescheduler_tpu.models.evictability import get_pods_for_deletion
+from tests.fixtures import make_pod
+
+
+def test_replicated_pods_pass():
+    pods = [make_pod("a", 100), make_pod("b", 100)]
+    out, blocking = get_pods_for_deletion(pods, [])
+    assert [p.name for p in out] == ["a", "b"]
+    assert blocking is None
+
+
+def test_daemonset_pods_skipped():
+    ds = make_pod("ds", 100)
+    ds.owner_refs = [OwnerRef("DaemonSet", "ds-owner")]
+    out, blocking = get_pods_for_deletion([ds, make_pod("a", 100)], [])
+    assert [p.name for p in out] == ["a"]
+    assert blocking is None
+
+
+def test_non_controller_daemonset_ref_not_skipped():
+    # reference rescheduler.go:245 checks *owner.Controller
+    p = make_pod("p", 100, replicated=False)
+    p.owner_refs = [OwnerRef("DaemonSet", "x", controller=False)]
+    out, blocking = get_pods_for_deletion([p], [])
+    assert blocking is not None  # falls through to non-replicated check
+
+
+def test_mirror_pods_skipped():
+    m = make_pod("m", 100, replicated=False)
+    m.annotations = {MIRROR_POD_ANNOTATION: "true"}
+    out, blocking = get_pods_for_deletion([m], [])
+    assert out == [] and blocking is None
+
+
+def test_finished_pods_skipped():
+    p = make_pod("done", 100)
+    p.phase = "Succeeded"
+    out, blocking = get_pods_for_deletion([p], [])
+    assert out == [] and blocking is None
+
+
+def test_non_replicated_blocks_unless_flag():
+    bare = make_pod("bare", 100, replicated=False)
+    out, blocking = get_pods_for_deletion([bare], [])
+    assert blocking is not None and blocking.pod.name == "bare"
+
+    out, blocking = get_pods_for_deletion([bare], [], delete_non_replicated=True)
+    assert [p.name for p in out] == ["bare"] and blocking is None
+
+
+def test_pdb_blocks_when_budget_exhausted():
+    pod = make_pod("web", 100)
+    pod.labels = {"app": "web"}
+    pdb = PDBSpec("web-pdb", match_labels={"app": "web"}, disruptions_allowed=0)
+    out, blocking = get_pods_for_deletion([pod], [pdb])
+    assert blocking is not None and "budget" in blocking.reason
+
+    pdb_ok = PDBSpec("web-pdb", match_labels={"app": "web"}, disruptions_allowed=1)
+    out, blocking = get_pods_for_deletion([pod], [pdb_ok])
+    assert [p.name for p in out] == ["web"] and blocking is None
+
+
+def test_pdb_in_other_namespace_ignored():
+    pod = make_pod("web", 100, namespace="prod")
+    pod.labels = {"app": "web"}
+    pdb = PDBSpec("web-pdb", namespace="dev", match_labels={"app": "web"})
+    out, blocking = get_pods_for_deletion([pod], [pdb])
+    assert blocking is None

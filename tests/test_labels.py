@@ -1,0 +1,49 @@
+"""Label matching and validation.
+
+Mirrors reference nodes/nodes_test.go:32-56 (old/new schema matching) and
+rescheduler_test.go:84-100 (validateArgs).
+"""
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.utils.labels import (
+    LabelFormatError,
+    matches_label,
+    validate_label,
+)
+
+
+class TestMatchesLabel:
+    def test_new_schema_value_match(self):
+        labels = {"kubernetes.io/role": "spot-worker"}
+        assert matches_label(labels, "kubernetes.io/role=spot-worker")
+        assert not matches_label(labels, "kubernetes.io/role=worker")
+
+    def test_old_schema_presence_match(self):
+        labels = {"node-role.kubernetes.io/spot-worker": ""}
+        assert matches_label(labels, "node-role.kubernetes.io/spot-worker")
+        assert not matches_label(labels, "node-role.kubernetes.io/worker")
+
+    def test_key_present_wrong_value(self):
+        assert not matches_label({"role": "worker"}, "role=spot")
+
+    def test_empty_value_selector(self):
+        assert matches_label({"role": ""}, "role=")
+        assert not matches_label({"role": "x"}, "role=")
+
+    def test_missing_key(self):
+        assert not matches_label({}, "role=worker")
+        assert not matches_label({}, "role")
+
+
+class TestValidateLabel:
+    def test_accepts_bare_key(self):
+        validate_label("node-role.kubernetes.io/worker")
+
+    def test_accepts_key_value(self):
+        validate_label("kubernetes.io/role=worker")
+
+    def test_rejects_double_equals(self):
+        # reference rescheduler_test.go:84-100 / rescheduler.go:407-417
+        with pytest.raises(LabelFormatError):
+            validate_label("kubernetes.io/role=worker=extra")

@@ -1,0 +1,178 @@
+"""End-to-end control-loop tests on the fake cluster: the minimum
+observe→plan→actuate slice of SURVEY.md §7 step 4, driven tick by tick on a
+virtual clock. Gates, one-drain-per-tick, cooldown, and the closed loop
+(evicted pods land on spot nodes) are all exercised."""
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import ON_DEMAND_LABELS, SPOT_LABELS, make_node, make_pod
+
+
+def _setup(solver="jax", reschedule=True, **cfg_overrides):
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=reschedule)
+    config = ReschedulerConfig(solver=solver, **cfg_overrides)
+    planner = SolverPlanner(config)
+    r = Rescheduler(fc, planner, config, clock=clock, recorder=fc)
+    return fc, clock, r
+
+
+def _drainable_cluster(fc):
+    """One on-demand node whose 3 pods (600m total) fit onto two spot
+    nodes; a second on-demand node too big to drain."""
+    fc.add_node(make_node("od-small", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-big", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    for i, cpu in enumerate([300, 200, 100]):
+        fc.add_pod(make_pod(f"small-{i}", cpu, "od-small"))
+    for i in range(4):
+        fc.add_pod(make_pod(f"big-{i}", 1900, "od-big"))
+    fc.add_pod(make_pod("s1", 500, "spot-1"))
+
+
+@pytest.mark.parametrize("solver", ["numpy", "jax"])
+def test_end_to_end_drain(solver):
+    fc, clock, r = _setup(solver=solver)
+    _drainable_cluster(fc)
+    result = r.tick()
+    assert result.drained == ["od-small"]
+    # evicted pods terminated and were re-placed onto spot capacity
+    assert fc.list_pods_on_node("od-small") == []
+    moved = {p.uid for n in ("spot-1", "spot-2") for p in fc.list_pods_on_node(n)}
+    assert {"default/small-0", "default/small-1", "default/small-2"} <= moved
+    assert fc.pending == []
+    # the infeasible node was judged but not drained
+    assert result.report.n_candidates == 2
+    assert result.report.n_feasible == 1
+
+
+def test_cooldown_gate_after_drain():
+    fc, clock, r = _setup()
+    _drainable_cluster(fc)
+    assert r.tick().drained == ["od-small"]
+    # next tick is inside node_drain_delay (10 min default) -> skipped
+    clock.advance(10.0)
+    assert r.tick().skipped == "cooldown"
+    # after the delay, processing resumes
+    clock.advance(700.0)
+    assert r.tick().skipped == ""
+
+
+def test_unschedulable_gate():
+    fc, clock, r = _setup()
+    _drainable_cluster(fc)
+    fc.pending.append(make_pod("homeless", 100))
+    assert r.tick().skipped == "unschedulable"
+    assert fc.evictions == []
+
+
+def test_one_drain_per_tick():
+    fc, clock, r = _setup()
+    # two small drainable on-demand nodes, ample spot capacity
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-2", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS, cpu_millis=8000))
+    fc.add_pod(make_pod("a", 100, "od-1"))
+    fc.add_pod(make_pod("b", 100, "od-2"))
+    result = r.tick()
+    assert len(result.drained) == 1  # rescheduler.go:286 break
+    assert result.report.n_feasible == 2
+
+
+def test_empty_on_demand_node_not_drained():
+    # reference rescheduler.go:260-265: no pods -> wait for autoscaler.
+    fc, clock, r = _setup()
+    fc.add_node(make_node("od-empty", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    result = r.tick()
+    assert result.drained == []
+
+
+def test_infeasible_cluster_drains_nothing():
+    fc, clock, r = _setup()
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS, cpu_millis=500))
+    fc.add_pod(make_pod("a", 1800, "od-1"))
+    result = r.tick()
+    assert result.drained == []
+    assert result.report.n_feasible == 0
+
+
+def test_blocked_node_skipped_non_replicated():
+    # a bare pod (no controller) blocks its node (rescheduler.go:232-239)
+    fc, clock, r = _setup()
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_pod(make_pod("bare", 100, "od-1", replicated=False))
+    assert r.tick().drained == []
+
+    # with the flag, it drains (reference --delete-non-replicated-pods)
+    fc2, clock2, r2 = _setup(delete_non_replicated_pods=True)
+    fc2.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc2.add_node(make_node("spot-1", SPOT_LABELS))
+    fc2.add_pod(make_pod("bare", 100, "od-1", replicated=False))
+    assert r2.tick().drained == ["od-1"]
+
+
+def test_drained_order_prefers_emptiest():
+    # od nodes judged least-requested-first (nodes/nodes.go:99-101)
+    fc, clock, r = _setup()
+    fc.add_node(make_node("od-full", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-light", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS, cpu_millis=8000))
+    fc.add_pod(make_pod("h1", 900, "od-full"))
+    fc.add_pod(make_pod("h2", 900, "od-full"))
+    fc.add_pod(make_pod("l1", 100, "od-light"))
+    assert r.tick().drained == ["od-light"]
+
+
+def test_run_forever_cadence():
+    fc, clock, r = _setup()
+    _drainable_cluster(fc)
+    # simulate 3 intervals by hand (run_forever loops sleep+tick)
+    for _ in range(3):
+        clock.sleep(r.config.housekeeping_interval)
+        r.tick()
+    assert fc.list_pods_on_node("od-small") == []
+
+
+def test_tainted_spot_pool_closed_loop():
+    """Regression: evicted pods carrying tolerations must land on tainted
+    spot nodes in the fake scheduler, not pile up as unschedulable."""
+    from k8s_spot_rescheduler_tpu.models.cluster import Taint, Toleration
+
+    taint = Taint("cloud.provider/spot", "true", "NoSchedule")
+    tol = Toleration("cloud.provider/spot", "true", "Equal", "NoSchedule")
+    fc, clock, r = _setup()
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    spot = make_node("spot-1", SPOT_LABELS)
+    spot.taints.append(taint)
+    fc.add_node(spot)
+    p = make_pod("a", 100, "od-1")
+    p.tolerations = [tol]
+    fc.add_pod(p)
+    assert r.tick().drained == ["od-1"]
+    assert fc.pending == []
+    assert [q.name for q in fc.list_pods_on_node("spot-1")] == ["a"]
+
+
+def test_multi_drain_replans_between_drains():
+    """max_drains_per_tick > 1 must not overcommit the spot pool: spot-1
+    fits either od node's pod but not both."""
+    fc, clock, r = _setup(max_drains_per_tick=2, node_drain_delay=0.0)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-2", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS, cpu_millis=2000))
+    fc.add_pod(make_pod("a", 1200, "od-1"))
+    fc.add_pod(make_pod("b", 1200, "od-2"))
+    result = r.tick()
+    # first drain moves 1200m onto spot-1; the re-plan sees only 800m
+    # left and refuses the second drain
+    assert len(result.drained) == 1
+    assert fc.pending == []

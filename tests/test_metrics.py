@@ -1,0 +1,46 @@
+"""Metrics parity tests (reference metrics/metrics.go:24-96)."""
+
+from prometheus_client import REGISTRY
+
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+
+def _value(name, labels=None):
+    return REGISTRY.get_sample_value(name, labels or {})
+
+
+def test_series_names_match_reference():
+    metrics.update_nodes_map("od-label", "spot-label", 3, 5)
+    assert _value("spot_rescheduler_nodes_count", {"node_type": "od-label"}) == 3
+    assert _value("spot_rescheduler_nodes_count", {"node_type": "spot-label"}) == 5
+
+    metrics.update_node_pods_count("od-label", "node-1", 7)
+    assert (
+        _value(
+            "spot_rescheduler_node_pods_count",
+            {"node_type": "od-label", "node": "node-1"},
+        )
+        == 7
+    )
+
+    before = _value("spot_rescheduler_evicted_pods_total") or 0
+    metrics.update_evictions_count()
+    assert _value("spot_rescheduler_evicted_pods_total") == before + 1
+
+    metrics.update_node_drain_count("Success", "node-1")
+    assert (
+        _value(
+            "spot_rescheduler_node_drain_total",
+            {"drain_state": "Success", "node": "node-1"},
+        )
+        >= 1
+    )
+
+
+def test_plan_duration_histogram():
+    metrics.observe_plan_duration("jax", 0.042, 17)
+    assert _value("spot_rescheduler_plan_candidates") == 17
+    assert (
+        _value("spot_rescheduler_plan_duration_seconds_count", {"solver": "jax"})
+        >= 1
+    )

@@ -1,0 +1,109 @@
+"""Node-map builder tests.
+
+Mirrors reference nodes/nodes_test.go:58-298: classification, both node sort
+orders, per-node pod sort, the spot-only priority filter, CPU accounting,
+AddPod arithmetic, and copy isolation.
+"""
+
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    NodeInfo,
+    build_node_map,
+    pods_requested,
+)
+from tests.fixtures import (
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+
+def _build(nodes, pods_by_node, priority_threshold=0):
+    return build_node_map(
+        nodes,
+        pods_by_node,
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+        priority_threshold=priority_threshold,
+    )
+
+
+def test_classification_and_sort_orders():
+    # nodes/nodes_test.go:58-124: spot sorted most-requested first,
+    # on-demand least-requested first, unlabeled nodes dropped.
+    nodes = [
+        make_node("od-busy", ON_DEMAND_LABELS),
+        make_node("od-idle", ON_DEMAND_LABELS),
+        make_node("spot-empty", SPOT_LABELS),
+        make_node("spot-full", SPOT_LABELS),
+        make_node("other", {"kubernetes.io/role": "master"}),
+    ]
+    pods = {
+        "od-busy": [make_pod("a", 800, "od-busy"), make_pod("b", 400, "od-busy")],
+        "od-idle": [make_pod("c", 100, "od-idle")],
+        "spot-full": [make_pod("d", 1500, "spot-full")],
+        "spot-empty": [make_pod("e", 200, "spot-empty")],
+        "other": [make_pod("f", 999, "other")],
+    }
+    nm = _build(nodes, pods)
+    assert [n.node.name for n in nm.on_demand] == ["od-idle", "od-busy"]
+    assert [n.node.name for n in nm.spot] == ["spot-full", "spot-empty"]
+    assert nm.on_demand[1].requested_cpu == 1200
+    assert nm.on_demand[1].free_cpu == 800
+
+
+def test_pods_sorted_biggest_cpu_first():
+    # nodes/nodes.go:76-80
+    nodes = [make_node("od", ON_DEMAND_LABELS)]
+    pods = {"od": [make_pod("small", 100), make_pod("big", 900), make_pod("mid", 400)]}
+    nm = _build(nodes, pods)
+    assert [p.name for p in nm.on_demand[0].pods] == ["big", "mid", "small"]
+
+
+def test_priority_filter_spot_only():
+    # nodes/nodes_test.go:144-218: low-priority pods dropped on spot nodes,
+    # kept on on-demand nodes.
+    nodes = [make_node("spot", SPOT_LABELS), make_node("od", ON_DEMAND_LABELS)]
+    mixed = lambda node: [
+        make_pod("p1", 100, node),
+        make_pod("p2", 100, node, priority=-1),
+        make_pod("p3", 100, node, priority=5),
+    ]
+    nm = _build(nodes, {"spot": mixed("spot"), "od": mixed("od")}, priority_threshold=0)
+    assert len(nm.spot[0].pods) == 2  # p2 dropped
+    assert len(nm.on_demand[0].pods) == 3
+    assert nm.spot[0].requested_cpu == 200
+    assert nm.on_demand[0].requested_cpu == 300
+
+
+def test_node_with_both_labels_is_spot():
+    # switch precedence nodes/nodes.go:82-92
+    both = dict(SPOT_LABELS)
+    nm = _build([make_node("n", both)], {})
+    assert len(nm.spot) == 1 and not nm.on_demand
+
+
+def test_add_pod_updates_accounting():
+    # nodes/nodes_test.go:126-142
+    info = NodeInfo.build(make_node("n", SPOT_LABELS), [make_pod("a", 300)])
+    info.add_pod(make_pod("b", 500))
+    assert info.requested_cpu == 800
+    assert info.free_cpu == 2000 - 800
+    assert len(info.pods) == 2
+
+
+def test_copy_isolation():
+    # nodes/nodes_test.go:256-298 CopyNodeInfos
+    info = NodeInfo.build(make_node("n", SPOT_LABELS), [make_pod("a", 300)])
+    clone = info.copy()
+    clone.add_pod(make_pod("b", 500))
+    assert info.requested_cpu == 300
+    assert len(info.pods) == 1
+
+
+def test_cpu_aggregation():
+    # nodes/nodes_test.go:220-254
+    pods = [make_pod("a", 150), make_pod("b", 250), make_pod("c", 0)]
+    assert pods_requested(pods) == 400

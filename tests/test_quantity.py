@@ -1,0 +1,41 @@
+"""k8s quantity parsing."""
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.utils.quantity import (
+    parse_cpu_millis,
+    parse_memory_bytes,
+    parse_quantity,
+)
+
+
+def test_cpu_millis():
+    assert parse_cpu_millis("500m") == 500
+    assert parse_cpu_millis("2") == 2000
+    assert parse_cpu_millis("0.1") == 100
+    assert parse_cpu_millis("1500m") == 1500
+    assert parse_cpu_millis(2) == 2000
+
+
+def test_cpu_sub_milli_rounds_up():
+    assert parse_cpu_millis("1n") == 1  # like k8s MilliValue ceil
+
+
+def test_memory_bytes():
+    assert parse_memory_bytes("2Gi") == 2 * 1024**3
+    assert parse_memory_bytes("512Mi") == 512 * 1024**2
+    assert parse_memory_bytes("1000") == 1000
+    assert parse_memory_bytes("1k") == 1000
+    assert parse_memory_bytes("1M") == 10**6
+
+
+def test_exponent_form():
+    assert parse_quantity("1e3") == 1000
+    assert parse_quantity("1E3") == 1000
+
+
+def test_bad_quantity():
+    with pytest.raises(ValueError):
+        parse_quantity("")
+    with pytest.raises(ValueError):
+        parse_quantity("abc")

@@ -1,0 +1,139 @@
+"""Solver tests: the reference's planner fixtures, ported 1:1, plus
+oracle↔TPU-solver parity on randomized clusters.
+
+Fixture provenance: reference rescheduler_test.go:40-81
+(TestFindSpotNodeForPod) and :102-151 (TestCanDrainNode).
+"""
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.models.cluster import NodeInfo, NodeMap
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster, pack_cluster
+from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_jit
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from tests.fixtures import ON_DEMAND_LABELS, SPOT_LABELS, make_node, make_pod
+
+
+def _spot_info(name: str, capacity: int, used_pods):
+    """createTestNodeInfo equivalent: a spot node of given capacity with
+    pods already consuming some of it."""
+    pods = [make_pod(f"p{i}-{name}", cpu, name) for i, cpu in enumerate(used_pods)]
+    return NodeInfo.build(make_node(name, SPOT_LABELS, cpu_millis=capacity), pods)
+
+
+def _pack_drain_case(spot_infos, pods_for_deletion):
+    """One candidate on-demand node holding ``pods_for_deletion``."""
+    od = NodeInfo.build(
+        make_node("od-1", ON_DEMAND_LABELS, cpu_millis=4000),
+        [make_pod(f"d{i}", cpu, "od-1") for i, cpu in enumerate(pods_for_deletion)],
+    )
+    # NodeMap is normally sorted by build_node_map; here the fixture order
+    # is the probe order, matching rescheduler_test.go:119-123.
+    nm = NodeMap(on_demand=[od], spot=list(spot_infos))
+    return pack_cluster(nm)
+
+
+# The TestCanDrainNode spot pool: free CPU 700 / 300 / 100, presorted
+# most-requested-first (rescheduler_test.go:119-123).
+def _test_spot_pool():
+    return [
+        _spot_info("node3", 2000, [500, 500, 300]),  # free 700
+        _spot_info("node2", 1100, [500, 300]),  # free 300
+        _spot_info("node1", 500, [100, 300]),  # free 100
+    ]
+
+
+class TestCanDrainNodeFixture:
+    def test_feasible_set(self):
+        # rescheduler_test.go:126-132 + 142-145: 500,300,100,100,100 fits.
+        packed, meta = _pack_drain_case(_test_spot_pool(), [500, 300, 100, 100, 100])
+        res = plan_oracle(packed)
+        assert bool(res.feasible[0])
+        # Placement trace of the reference's first-fit:
+        # 500->node3, 300->node3(wait: free 200 after? no -- see below)
+        # Actual: 500->node3 (700->200), 300->node2 (300->0),
+        #         100->node3 (200->100), 100->node3 (100->0), 100->node1.
+        names = [meta.spot[s].node.name for s in res.assignment[0][:5]]
+        assert names == ["node3", "node2", "node3", "node3", "node1"]
+
+    def test_infeasible_set_over_capacity(self):
+        # rescheduler_test.go:134-150: swap one 300m pod for 400m -> fails.
+        packed, _ = _pack_drain_case(_test_spot_pool(), [500, 400, 100, 100, 100])
+        res = plan_oracle(packed)
+        assert not bool(res.feasible[0])
+        assert (res.assignment[0] == -1).all()
+
+    def test_jax_matches_fixture(self):
+        for pods in ([500, 300, 100, 100, 100], [500, 400, 100, 100, 100]):
+            packed, _ = _pack_drain_case(_test_spot_pool(), pods)
+            want = plan_oracle(packed)
+            got = plan_ffd_jit(packed)
+            np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+            np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+class TestFindSpotNodeForPodFixture:
+    """rescheduler_test.go:40-81, expressed as single-pod candidates."""
+
+    def _pool(self):
+        # free CPU: node1=100, node2=200, node3=700, probe order as listed.
+        return [
+            _spot_info("node1", 500, [100, 300]),
+            _spot_info("node2", 1000, [500, 300]),
+            _spot_info("node3", 2000, [500, 500, 300]),
+        ]
+
+    @pytest.mark.parametrize(
+        "cpu,want",
+        [(100, "node1"), (200, "node2"), (700, "node3"), (2200, None)],
+    )
+    def test_first_fit(self, cpu, want):
+        packed, meta = _pack_drain_case(self._pool(), [cpu])
+        res = plan_oracle(packed)
+        if want is None:
+            assert not bool(res.feasible[0])
+        else:
+            assert bool(res.feasible[0])
+            assert meta.spot[res.assignment[0][0]].node.name == want
+
+
+def _random_packed(rng: np.random.Generator) -> PackedCluster:
+    """A randomized PackedCluster exercising every predicate dimension."""
+    C = int(rng.integers(1, 6))
+    K = int(rng.integers(1, 7))
+    S = int(rng.integers(1, 8))
+    R = int(rng.integers(1, 4))
+    W, A = 1, 2
+    return PackedCluster(
+        slot_req=rng.integers(0, 900, (C, K, R)).astype(np.float32),
+        slot_valid=rng.random((C, K)) < 0.8,
+        slot_tol=rng.integers(0, 4, (C, K, W)).astype(np.uint32),
+        slot_aff=(
+            np.uint32(1)
+            << rng.integers(0, 32, (C, K, A)).astype(np.uint32)
+        )
+        * (rng.random((C, K, A)) < 0.3),
+        cand_valid=rng.random((C,)) < 0.9,
+        spot_free=rng.integers(-100, 2000, (S, R)).astype(np.float32),
+        spot_count=rng.integers(0, 5, (S,)).astype(np.int32),
+        spot_max_pods=rng.integers(1, 8, (S,)).astype(np.int32),
+        spot_taints=rng.integers(0, 4, (S, W)).astype(np.uint32),
+        spot_ok=rng.random((S,)) < 0.9,
+        spot_aff=(
+            np.uint32(1) << rng.integers(0, 32, (S, A)).astype(np.uint32)
+        )
+        * (rng.random((S, A)) < 0.3),
+    )
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_oracle_jax_parity_randomized(seed):
+    """The batched TPU solver is bit-identical to the serial reference
+    semantics on randomized clusters (taints, affinity, pod-count caps,
+    invalid lanes/slots, negative free capacity)."""
+    packed = _random_packed(np.random.default_rng(seed))
+    want = plan_oracle(packed)
+    got = plan_ffd_jit(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
