@@ -1,0 +1,14 @@
+"""Device-mesh parallelism for the drain solver."""
+
+from k8s_spot_rescheduler_tpu.parallel.mesh import make_mesh, pick_mesh_shape
+from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
+    make_sharded_planner,
+    plan_ffd_sharded,
+)
+
+__all__ = [
+    "make_mesh",
+    "pick_mesh_shape",
+    "make_sharded_planner",
+    "plan_ffd_sharded",
+]

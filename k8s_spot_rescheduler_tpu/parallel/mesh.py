@@ -1,0 +1,55 @@
+"""Device-mesh construction.
+
+The solver's two parallel axes (SURVEY.md §2.3) map onto a 2-D
+``jax.sharding.Mesh``:
+
+- ``"cand"`` — candidate on-demand nodes (pure data parallelism: the
+  fork-per-candidate lanes never communicate);
+- ``"spot"`` — the spot-node pool (model-parallel-like: the first-fit
+  probe requires a global argmin over spot shards each scan step, an
+  ICI collective).
+
+The reference has no analog — its planning loop is strictly sequential on
+one CPU (reference rescheduler.go:228-287); this is the scale axis that
+replaces it (SURVEY.md §5.7: cluster size is this framework's
+"long context").
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+CAND_AXIS = "cand"
+SPOT_AXIS = "spot"
+
+
+def pick_mesh_shape(n_devices: int) -> Tuple[int, int]:
+    """(cand, spot) mesh shape for n devices.
+
+    Candidate lanes are embarrassingly parallel (no collectives), so the
+    cand axis gets the larger factor; the spot axis (one pmin per scan
+    step) stays small to keep collective latency off the critical path.
+    """
+    spot = 1
+    for s in (2,):
+        if n_devices % s == 0 and n_devices > s:
+            spot = s
+    return n_devices // spot, spot
+
+
+def make_mesh(shape: Tuple[int, int] | None = None, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = pick_mesh_shape(len(devices))
+    n = shape[0] * shape[1]
+    if n > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, have {len(devices)}"
+        )
+    grid = mesh_utils.create_device_mesh(shape, devices=np.asarray(devices[:n]))
+    return Mesh(grid, (CAND_AXIS, SPOT_AXIS))

@@ -1,0 +1,185 @@
+"""Mesh-sharded batched first-fit solver (shard_map + ICI collectives).
+
+Semantics are identical to solver/ffd.py (itself bit-identical to the
+serial reference nest, rescheduler.go:334-370); the difference is layout:
+
+- candidate lanes are sharded over the ``cand`` mesh axis — no
+  communication at all (the Fork/Revert lanes are independent);
+- the spot pool is sharded over the ``spot`` mesh axis. First-fit needs
+  the *globally first* fitting spot node each scan step, so each device
+  computes its local first-fit index, converts it to a global index, and a
+  ``lax.pmin`` over the spot axis elects the winner — one small [C_local]
+  collective per scan step riding ICI. The winning device (and only it)
+  applies the capacity/count/affinity update to its local shard.
+
+This is the "blockwise/ring processing of the (pods × nodes) fit matrix"
+the survey calls for (SURVEY.md §5.7): the 50k-pod × 5k-node problem never
+materializes on one chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.parallel.mesh import CAND_AXIS, SPOT_AXIS, make_mesh
+from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask
+from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+
+_BIG = jnp.int32(2**30)
+
+
+def _local_step(static, carry, slot):
+    """One pod-slot placement on this device's (cand, spot) block."""
+    spot_max_pods, spot_taints, spot_ok, s_local, s_offset = static
+    free, count, aff_acc, feasible = carry
+    req, valid, tol, aff = slot  # local [Cl,R], [Cl], [Cl,W], [Cl,A]
+
+    fits = fit_mask(
+        jnp,
+        free=free,
+        count=count,
+        max_pods=spot_max_pods,
+        node_taints=spot_taints,
+        node_ok=spot_ok,
+        node_aff=aff_acc,
+        req=req,
+        tol=tol,
+        aff=aff,
+    )  # [Cl, Sl]
+
+    local_any = jnp.any(fits, axis=-1)
+    local_first = jnp.argmax(fits, axis=-1).astype(jnp.int32)
+    my_global = jnp.where(local_any, s_offset + local_first, _BIG)
+    # elect the globally-first fitting spot node across spot shards
+    winner = jax.lax.pmin(my_global, SPOT_AXIS)  # [Cl]
+    any_fit = winner < _BIG
+    place = valid & any_fit
+
+    local_winner = winner - s_offset
+    in_shard = place & (local_winner >= 0) & (local_winner < s_local)
+    onehot = (jnp.arange(fits.shape[-1])[None, :] == local_winner[:, None]) & (
+        in_shard[:, None]
+    )
+
+    free = free - onehot[..., None] * req[:, None, :]
+    count = count + onehot.astype(count.dtype)
+    aff_acc = aff_acc | jnp.where(onehot[..., None], aff[:, None, :], 0)
+    feasible = feasible & (any_fit | ~valid)
+
+    chosen = jnp.where(place, winner, jnp.int32(-1))
+    return (free, count, aff_acc, feasible), chosen
+
+
+def _sharded_plan_local(packed: PackedCluster):
+    """Runs on every device over its local block (inside shard_map)."""
+    Cl = packed.slot_req.shape[0]
+    Sl = packed.spot_free.shape[0]
+    s_offset = jax.lax.axis_index(SPOT_AXIS).astype(jnp.int32) * Sl
+
+    carry = (
+        jnp.broadcast_to(packed.spot_free, (Cl, *packed.spot_free.shape)),
+        jnp.broadcast_to(packed.spot_count, (Cl, Sl)).astype(jnp.int32),
+        jnp.broadcast_to(packed.spot_aff, (Cl, *packed.spot_aff.shape)),
+        jnp.asarray(packed.cand_valid),
+    )
+    static = (
+        packed.spot_max_pods,
+        packed.spot_taints,
+        packed.spot_ok,
+        jnp.int32(Sl),
+        s_offset,
+    )
+    slots = (
+        jnp.moveaxis(packed.slot_req, 1, 0),
+        jnp.moveaxis(packed.slot_valid, 1, 0),
+        jnp.moveaxis(packed.slot_tol, 1, 0),
+        jnp.moveaxis(packed.slot_aff, 1, 0),
+    )
+    (f, c, a, feasible), chosen = jax.lax.scan(
+        functools.partial(_local_step, static), carry, slots
+    )
+    feasible = feasible & jnp.asarray(packed.cand_valid)
+    assignment = jnp.where(feasible[None, :], chosen, -1).T  # [Cl, K]
+    return feasible, assignment
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _pad_to_mesh(packed: PackedCluster, mesh: Mesh) -> PackedCluster:
+    """Pad the candidate/spot axes to mesh-divisible sizes with inert
+    entries (invalid lanes, never-fitting nodes). Padding spot nodes sit
+    at the *end* of the probe order so first-fit semantics are unchanged."""
+    n_cand = mesh.shape[CAND_AXIS]
+    n_spot = mesh.shape[SPOT_AXIS]
+    C = packed.slot_req.shape[0]
+    S = packed.spot_free.shape[0]
+    Cp = _round_up(C, n_cand)
+    Sp = _round_up(S, n_spot)
+    if Cp == C and Sp == S:
+        return packed
+
+    def pad(arr, n, axis=0):
+        if n == arr.shape[axis]:
+            return arr
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, n - arr.shape[axis])
+        return jnp.pad(arr, widths)
+
+    return PackedCluster(
+        slot_req=pad(packed.slot_req, Cp),
+        slot_valid=pad(packed.slot_valid, Cp),
+        slot_tol=pad(packed.slot_tol, Cp),
+        slot_aff=pad(packed.slot_aff, Cp),
+        cand_valid=pad(packed.cand_valid, Cp),
+        spot_free=pad(packed.spot_free, Sp),
+        spot_count=pad(packed.spot_count, Sp),
+        spot_max_pods=pad(packed.spot_max_pods, Sp),
+        spot_taints=pad(packed.spot_taints, Sp),
+        spot_ok=pad(packed.spot_ok, Sp),  # padded nodes: spot_ok=False
+        spot_aff=pad(packed.spot_aff, Sp),
+    )
+
+
+def plan_ffd_sharded(mesh: Mesh, packed: PackedCluster) -> SolveResult:
+    """Shard the PackedCluster over the mesh and solve. Axes that don't
+    divide the mesh are padded with inert entries and sliced back out."""
+    C = packed.slot_req.shape[0]
+    packed = _pad_to_mesh(packed, mesh)
+    cand_sharded = PackedCluster(
+        slot_req=P(CAND_AXIS),
+        slot_valid=P(CAND_AXIS),
+        slot_tol=P(CAND_AXIS),
+        slot_aff=P(CAND_AXIS),
+        cand_valid=P(CAND_AXIS),
+        spot_free=P(SPOT_AXIS),
+        spot_count=P(SPOT_AXIS),
+        spot_max_pods=P(SPOT_AXIS),
+        spot_taints=P(SPOT_AXIS),
+        spot_ok=P(SPOT_AXIS),
+        spot_aff=P(SPOT_AXIS),
+    )
+    fn = shard_map(
+        _sharded_plan_local,
+        mesh=mesh,
+        in_specs=(cand_sharded,),
+        out_specs=(P(CAND_AXIS), P(CAND_AXIS, None)),
+        check_rep=False,
+    )
+    feasible, assignment = fn(packed)
+    return SolveResult(feasible=feasible[:C], assignment=assignment[:C])
+
+
+def make_sharded_planner(mesh_shape: Tuple[int, int] | None = None):
+    """A jitted solver callable bound to a mesh built from the visible
+    devices (the SolverPlanner 'sharded' backend)."""
+    mesh = make_mesh(mesh_shape)
+    return jax.jit(functools.partial(plan_ffd_sharded, mesh))

@@ -2,13 +2,16 @@
 
 Pack → solve → select. Selection reproduces the reference's loop policy
 (reference rescheduler.go:228-287): candidates are in least-requested-CPU
-order, the first feasible one is drained. Because the batched solver judges
-*every* candidate in one pass, all feasible candidates come back in the
-report — the faithful loop drains only the first; benchmarks and the
-multi-drain mode read the rest.
+order, the first feasible one is drained.
 
-Shape discipline: pad floors persist across calls (high-water marks) so the
-jitted solver does not recompile every tick as the cluster breathes.
+Device discipline (the lesson of the bandwidth-constrained host↔device
+boundary): the accelerator solvers run *solve + selection* fused on device
+(solver/select.py) and the host fetches only (index, found, count,
+assignment-row) — a few hundred bytes — never the full [C, K] assignment
+matrix. The numpy oracle backend returns everything on the host anyway.
+
+Shape discipline: pad floors persist across calls (high-water marks) so
+the jitted solver does not recompile every tick as the cluster breathes.
 """
 
 from __future__ import annotations
@@ -22,7 +25,6 @@ from k8s_spot_rescheduler_tpu.models.cluster import NodeMap, PDBSpec
 from k8s_spot_rescheduler_tpu.models.tensors import PackMeta, pack_cluster
 from k8s_spot_rescheduler_tpu.planner.base import DrainPlan, PlanReport
 from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
-from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
 
@@ -36,34 +38,45 @@ class SolverPlanner:
         self._pad_c = 0
         self._pad_s = 0
         self._pad_k = config.max_pods_per_node_hint
-        self._solve = self._make_solver(config.solver)
+        self._fused = None  # device path
+        if config.solver == "numpy":
+            self._solve_host = plan_oracle
+        else:
+            self._fused = self._make_fused(config.solver)
 
-    def _make_solver(self, name: str):
-        if name == "numpy":
-            return plan_oracle
-        if name in ("pallas", "sharded"):
-            try:
-                return self._make_accel_solver(name)
-            except ImportError as err:
-                raise ValueError(
-                    f"solver {name!r} is not available in this build: {err}"
-                ) from err
+    def _make_fused(self, name: str):
+        from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
+
         if name == "jax":
-            from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_jit
+            from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
 
-            return plan_ffd_jit
+            return make_fused_planner(plan_ffd)
+        try:
+            if name == "pallas":
+                from k8s_spot_rescheduler_tpu.ops.pallas_ffd import plan_ffd_pallas
+
+                return make_fused_planner(plan_ffd_pallas)
+            if name == "sharded":
+                import functools
+
+                from k8s_spot_rescheduler_tpu.parallel.mesh import make_mesh
+                from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
+                    plan_ffd_sharded,
+                )
+
+                mesh = make_mesh(
+                    self.config.mesh_shape
+                    if self.config.mesh_shape != (1, 1)
+                    else None
+                )
+                return make_fused_planner(
+                    functools.partial(plan_ffd_sharded, mesh)
+                )
+        except ImportError as err:
+            raise ValueError(
+                f"solver {name!r} is not available in this build: {err}"
+            ) from err
         raise ValueError(f"unknown solver {name!r}")
-
-    def _make_accel_solver(self, name: str):
-        if name == "pallas":
-            from k8s_spot_rescheduler_tpu.ops.pallas_ffd import plan_ffd_pallas_jit
-
-            return plan_ffd_pallas_jit
-        from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
-            make_sharded_planner,
-        )
-
-        return make_sharded_planner(self.config.mesh_shape)
 
     def plan(self, node_map: NodeMap, pdbs: Sequence[PDBSpec]) -> PlanReport:
         t0 = time.perf_counter()
@@ -85,38 +98,44 @@ class SolverPlanner:
             if blocked is not None:
                 log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)
 
-        result = self._solve(packed)
-        feasible = np.asarray(result.feasible)
-        assignment = np.asarray(result.assignment)
-        report = self._select(meta, feasible, assignment)
-        report.solve_seconds = time.perf_counter() - t0
-        report.solver = self.config.solver
+        if self._fused is not None:
+            from k8s_spot_rescheduler_tpu.solver.select import decode_selection
+
+            sel = decode_selection(self._fused(packed))
+            plan = (
+                self._build_plan(meta, sel.index, sel.row) if sel.found else None
+            )
+            n_feasible = sel.n_feasible
+        else:
+            result = self._solve_host(packed)
+            feasible = np.asarray(result.feasible)
+            n_feasible = int(feasible.sum())
+            plan = None
+            if n_feasible:
+                c = int(np.argmax(feasible))
+                plan = self._build_plan(meta, c, np.asarray(result.assignment[c]))
+
+        report = PlanReport(
+            plan=plan,
+            n_candidates=len(meta.candidates),
+            n_feasible=n_feasible,
+            solve_seconds=time.perf_counter() - t0,
+            solver=self.config.solver,
+            feasible_candidates=[plan] if plan else [],
+        )
         return report
 
-    def _select(
-        self, meta: PackMeta, feasible: np.ndarray, assignment: np.ndarray
-    ) -> PlanReport:
-        plans = []
-        for c in range(len(meta.candidates)):
-            if not feasible[c]:
-                continue
-            pods = meta.cand_pods[c]
-            assignments = {
-                pod.uid: meta.spot[int(assignment[c, k])].node.name
-                for k, pod in enumerate(pods)
-            }
-            plans.append(
-                DrainPlan(
-                    node=meta.candidates[c],
-                    pods=list(pods),
-                    assignments=assignments,
-                    candidate_index=c,
-                )
-            )
-        return PlanReport(
-            plan=plans[0] if plans else None,
-            n_candidates=len(meta.candidates),
-            n_feasible=len(plans),
-            solve_seconds=0.0,
-            feasible_candidates=plans,
+    def _build_plan(
+        self, meta: PackMeta, c: int, row: np.ndarray
+    ) -> Optional[DrainPlan]:
+        pods = meta.cand_pods[c]
+        assignments = {
+            pod.uid: meta.spot[int(row[k])].node.name
+            for k, pod in enumerate(pods)
+        }
+        return DrainPlan(
+            node=meta.candidates[c],
+            pods=list(pods),
+            assignments=assignments,
+            candidate_index=c,
         )

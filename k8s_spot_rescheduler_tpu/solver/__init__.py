@@ -2,5 +2,12 @@
 
 from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.solver.ffd import SolveResult, plan_ffd, plan_ffd_jit
+from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
 
-__all__ = ["plan_oracle", "SolveResult", "plan_ffd", "plan_ffd_jit"]
+__all__ = [
+    "plan_oracle",
+    "SolveResult",
+    "plan_ffd",
+    "plan_ffd_jit",
+    "make_fused_planner",
+]

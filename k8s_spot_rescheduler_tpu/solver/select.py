@@ -1,0 +1,61 @@
+"""Device-side plan selection.
+
+The control loop needs one thing from a solve: the *first feasible*
+candidate in drain-priority order and its placement row (the reference
+drains the first node whose ``canDrainNode`` succeeds, rescheduler.go:
+228-287). Selecting on device and fetching a single small vector instead
+of the full [C, K] assignment matrix keeps the host↔device boundary — the
+framework's "device boundary" (SURVEY.md §3.3) — off the critical path:
+on a latency-bound interconnect *every separate fetched array pays a full
+round trip*, so the result is packed into ONE int32 vector.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Selection(NamedTuple):
+    index: int  # first feasible candidate lane (drain-priority order)
+    found: bool
+    n_feasible: int
+    row: np.ndarray  # int32 [K] spot assignment of that lane
+
+
+def make_fused_planner(solve_fn):
+    """Wrap a PackedCluster->SolveResult solver into a jitted function
+    returning one int32 vector [idx, found, n_feasible, row...]; decode
+    with ``decode_selection``."""
+
+    @jax.jit
+    def fused(packed):
+        res = solve_fn(packed)
+        feasible = res.feasible
+        # candidates are pre-sorted least-requested-first, so argmax of the
+        # boolean mask IS the reference's drain choice
+        idx = jnp.argmax(feasible).astype(jnp.int32)
+        return jnp.concatenate(
+            [
+                idx[None],
+                jnp.any(feasible).astype(jnp.int32)[None],
+                feasible.sum().astype(jnp.int32)[None],
+                res.assignment[idx].astype(jnp.int32),
+            ]
+        )
+
+    return fused
+
+
+def decode_selection(vec) -> Selection:
+    """One host fetch, then unpack."""
+    vec = np.asarray(vec)
+    return Selection(
+        index=int(vec[0]),
+        found=bool(vec[1]),
+        n_feasible=int(vec[2]),
+        row=vec[3:],
+    )

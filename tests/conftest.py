@@ -1,18 +1,24 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU platform (multi-chip sharding tests run
-on this mesh, per the build environment: no multi-chip TPU hardware). Must
-run before jax initializes a backend, hence the env mutation at import time.
+Force JAX onto a virtual 8-device CPU platform — multi-chip sharding tests
+run on this mesh, per the build environment (no multi-chip TPU hardware).
+The env vars alone are not enough here: the machine's site customization
+registers the TPU plugin and snapshots JAX_PLATFORMS at interpreter start,
+so the config override after import is what actually takes effect.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

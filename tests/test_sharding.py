@@ -1,0 +1,48 @@
+"""Mesh-sharded solver tests on the virtual 8-device CPU platform
+(conftest forces --xla_force_host_platform_device_count=8).
+
+The sharded solver must be bit-identical to the serial oracle — the
+collective election of the globally-first fitting spot node must reproduce
+exact first-fit probe order across arbitrary shard boundaries.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.parallel.mesh import make_mesh, pick_mesh_shape
+from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import plan_ffd_sharded
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from tests.test_solver import _pack_drain_case, _random_packed, _test_spot_pool
+
+
+def test_eight_virtual_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def test_pick_mesh_shape():
+    assert pick_mesh_shape(8) == (4, 2)
+    assert pick_mesh_shape(4) == (2, 2)
+    assert pick_mesh_shape(2) == (2, 1)
+    assert pick_mesh_shape(1) == (1, 1)
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 1), (1, 2), (4, 2), (2, 4), (8, 1)])
+def test_sharded_matches_oracle_fixture(shape):
+    mesh = make_mesh(shape)
+    for pods in ([500, 300, 100, 100, 100], [500, 400, 100, 100, 100]):
+        packed, _ = _pack_drain_case(_test_spot_pool(), pods)
+        want = plan_oracle(packed)
+        got = jax.jit(lambda p: plan_ffd_sharded(mesh, p))(packed)
+        np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+        np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_sharded_matches_oracle_randomized(seed):
+    mesh = make_mesh((2, 2))
+    packed = _random_packed(np.random.default_rng(seed))
+    want = plan_oracle(packed)
+    got = plan_ffd_sharded(mesh, packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
