@@ -29,7 +29,7 @@ import numpy as np
 TARGET_MS = 200.0
 
 
-def build_problem(config_id: int, seed: int = 0):
+def build_problem(config_id: int, seed: int = 0, spec=None):
     from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
     from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
     from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
@@ -37,7 +37,7 @@ def build_problem(config_id: int, seed: int = 0):
 
     cfg = ReschedulerConfig()
     t0 = time.perf_counter()
-    client = generate_cluster(CONFIGS[config_id], seed)
+    client = generate_cluster(spec or CONFIGS[config_id], seed)
     t1 = time.perf_counter()
     nodes = client.list_ready_nodes()
     node_map = build_node_map(
@@ -60,13 +60,74 @@ def build_problem(config_id: int, seed: int = 0):
     return packed, meta
 
 
+def run_quality(seed: int) -> int:
+    """Greedy-vs-ILP quality ratio on a down-scaled affinity-free cluster
+    (the ILP oracle is only tractable at small scale)."""
+    from k8s_spot_rescheduler_tpu.bench.quality import (
+        drain_to_exhaustion,
+        ilp_max_drains,
+    )
+    from k8s_spot_rescheduler_tpu.io.synthetic import SyntheticSpec, generate_cluster
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    spec = SyntheticSpec("quality-40n-300p", 20, 20, 300)
+    packed, _ = build_problem(0, seed, spec=spec)
+    ilp = ilp_max_drains(packed)
+    client = generate_cluster(spec, seed, reschedule_evicted=True)
+    greedy = drain_to_exhaustion(client, ReschedulerConfig())
+    ratio = greedy / ilp if ilp else 1.0
+    print(
+        f"quality: greedy drained {greedy}, ILP oracle {ilp}, ratio {ratio:.3f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "nodes_freed_vs_ilp_oracle_ratio",
+                "value": round(ratio, 4),
+                "unit": "ratio",
+                "vs_baseline": round(ratio / 0.95, 4),
+            }
+        )
+    )
+    return 0
+
+
+def run_replay_bench(seed: int, n_events: int) -> int:
+    from k8s_spot_rescheduler_tpu.bench.replay import run_replay
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    stats = run_replay(ReschedulerConfig(), n_events=n_events, seed=seed)
+    print(f"replay: {stats}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "replay_replan_ms_p50_1k_events",
+                "value": round(stats["replan_ms_p50"], 3),
+                "unit": "ms",
+                "vs_baseline": round(TARGET_MS / max(stats["replan_ms_p50"], 1e-9), 3),
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=3)
     ap.add_argument("--repeats", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--solver", default="jax", choices=["jax", "sharded", "pallas"])
+    ap.add_argument("--quality", action="store_true",
+                    help="measure nodes-freed vs ILP oracle (small scale)")
+    ap.add_argument("--events", type=int, default=1000,
+                    help="event count for --config 5 replay")
     args = ap.parse_args()
+
+    if args.quality:
+        return run_quality(args.seed)
+    if args.config == 5:
+        return run_replay_bench(args.seed, args.events)
 
     import jax
 

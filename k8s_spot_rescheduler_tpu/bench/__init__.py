@@ -1,0 +1,9 @@
+"""Benchmark harnesses: solution-quality oracle and streaming replay."""
+
+from k8s_spot_rescheduler_tpu.bench.quality import (
+    drain_to_exhaustion,
+    ilp_max_drains,
+)
+from k8s_spot_rescheduler_tpu.bench.replay import run_replay
+
+__all__ = ["drain_to_exhaustion", "ilp_max_drains", "run_replay"]

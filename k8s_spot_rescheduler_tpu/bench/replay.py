@@ -1,0 +1,82 @@
+"""Streaming spot-interruption replay (BASELINE.md config 5).
+
+Replays a timed stream of spot add/remove events against the fake cluster
+while the housekeeping loop keeps re-planning on its 10 s cadence — the
+reference's level-triggered design under churn (its recovery story is
+"every tick recomputes from observed cluster state", SURVEY.md §5.3).
+Measures rolling re-plan latency and drain activity; displaced pods from
+interrupted nodes re-enter as unschedulable and gate the loop exactly as
+the reference's unschedulable-pods gate does (rescheduler.go:172-181).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.io.synthetic import (
+    CONFIGS,
+    generate_replay,
+)
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+
+def run_replay(
+    config: ReschedulerConfig,
+    *,
+    config_id: int = 5,
+    n_events: int = 1000,
+    seed: int = 0,
+) -> Dict[str, float]:
+    """Returns summary stats of a full replay run."""
+    client, events = generate_replay(CONFIGS[config_id], n_events, seed)
+    # drains every cooldown-free tick so churn keeps being consolidated
+    config = dataclasses.replace(config, node_drain_delay=0.0)
+    r = Rescheduler(
+        client, SolverPlanner(config), config, clock=client.clock, recorder=client
+    )
+
+    plan_ms: List[float] = []
+    drained = 0
+    displaced = 0
+    interruptions = 0
+    i = 0
+    t_end = events[-1].at if events else 0.0
+    now = 0.0
+    while now < t_end:
+        now += config.housekeeping_interval
+        while i < len(events) and events[i].at <= now:
+            ev = events[i]
+            if ev.kind == "remove_spot":
+                gone = client.remove_node(ev.node_name)
+                displaced += len(gone)
+                interruptions += 1
+                for pod in gone:
+                    # interrupted pods come back as pending reschedules
+                    client.pending.append(dataclasses.replace(pod, node_name=""))
+                client.retry_pending()
+            else:
+                client.add_node(ev.node)
+            i += 1
+        client.clock.advance(config.housekeeping_interval)
+        result = r.tick()
+        if result.report is not None:
+            plan_ms.append(result.report.solve_seconds * 1e3)
+        drained += len(result.drained)
+
+    return {
+        "ticks": len(plan_ms),
+        "events": float(len(events)),
+        "interruptions": float(interruptions),
+        "displaced_pods": float(displaced),
+        "drained_nodes": float(drained),
+        "replan_ms_p50": float(np.median(plan_ms)) if plan_ms else 0.0,
+        "replan_ms_p99": (
+            float(np.percentile(plan_ms, 99)) if plan_ms else 0.0
+        ),
+        "pending_at_end": float(len(client.pending)),
+    }

@@ -1,0 +1,84 @@
+"""Quality-oracle and replay-harness tests (BASELINE.md configs 4/5
+machinery at test scale)."""
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.bench.quality import (
+    drain_to_exhaustion,
+    ilp_max_drains,
+)
+from k8s_spot_rescheduler_tpu.bench.replay import run_replay
+from k8s_spot_rescheduler_tpu.io.synthetic import (
+    CONFIGS,
+    SyntheticSpec,
+    generate_cluster,
+    generate_replay,
+)
+from k8s_spot_rescheduler_tpu.models.cluster import NodeMap, build_node_map
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+
+def _pack(client, cfg):
+    nodes = client.list_ready_nodes()
+    nm = build_node_map(
+        nodes,
+        {n.name: client.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=cfg.on_demand_node_label,
+        spot_label=cfg.spot_node_label,
+        priority_threshold=cfg.priority_threshold,
+    )
+    return pack_cluster(nm, client.list_pdbs(), resources=cfg.resources)
+
+
+SMALL = SyntheticSpec("quality-test", 8, 8, 80)
+
+
+def test_ilp_upper_bounds_greedy():
+    cfg = ReschedulerConfig()
+    for seed in range(3):
+        client = generate_cluster(SMALL, seed)
+        packed, _ = _pack(client, cfg)
+        ilp = ilp_max_drains(packed)
+        assert ilp is not None
+
+        live = generate_cluster(SMALL, seed, reschedule_evicted=True)
+        greedy = drain_to_exhaustion(live, cfg)
+        # greedy's achieved set is ILP-feasible, so ILP is an upper bound
+        assert greedy <= ilp
+        # quality target: >= 95% of oracle (BASELINE.md)
+        if ilp > 0:
+            assert greedy / ilp >= 0.95
+
+
+def test_ilp_respects_capacity():
+    # a candidate whose pods cannot fit must not count
+    from tests.fixtures import ON_DEMAND_LABELS, SPOT_LABELS, make_node, make_pod
+    from k8s_spot_rescheduler_tpu.models.cluster import NodeInfo
+
+    od = NodeInfo.build(
+        make_node("od", ON_DEMAND_LABELS),
+        [make_pod("big", 1900, "od")],
+    )
+    spot = NodeInfo.build(
+        make_node("spot", SPOT_LABELS, cpu_millis=1000), []
+    )
+    packed, _ = pack_cluster(NodeMap(on_demand=[od], spot=[spot]))
+    assert ilp_max_drains(packed) == 0
+
+
+def test_replay_small():
+    stats = run_replay(
+        ReschedulerConfig(), config_id=5, n_events=20, seed=1
+    )
+    assert stats["ticks"] > 0
+    assert stats["interruptions"] + stats["events"] > 0
+    assert stats["replan_ms_p50"] >= 0.0
+
+
+def test_generate_replay_events_ordered():
+    _, events = generate_replay(CONFIGS[5], n_events=50, seed=0)
+    times = [e.at for e in events]
+    assert times == sorted(times)
+    kinds = {e.kind for e in events}
+    assert kinds <= {"add_spot", "remove_spot"}
