@@ -117,7 +117,8 @@ def main() -> int:
     ap.add_argument("--config", type=int, default=3)
     ap.add_argument("--repeats", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--solver", default="jax", choices=["jax", "sharded", "pallas"])
+    ap.add_argument("--solver", default="pallas",
+                    choices=["jax", "sharded", "pallas"])
     ap.add_argument("--quality", action="store_true",
                     help="measure nodes-freed vs ILP oracle (small scale)")
     ap.add_argument("--events", type=int, default=1000,

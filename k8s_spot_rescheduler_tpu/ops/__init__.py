@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the solver hot path."""
+
+from k8s_spot_rescheduler_tpu.ops.pallas_ffd import (
+    plan_ffd_pallas,
+    plan_ffd_pallas_jit,
+)
+
+__all__ = ["plan_ffd_pallas", "plan_ffd_pallas_jit"]

@@ -1,0 +1,213 @@
+"""Fused Pallas TPU kernel for the batched first-fit solver.
+
+Why a kernel: the XLA ``lax.scan`` version (solver/ffd.py) re-reads and
+re-writes the whole [C, S, ·] capacity carry from HBM on every one of the
+K scan steps (~200 MB × K of traffic at north-star scale). This kernel
+grids over **blocks of candidate lanes** and keeps each block's mutable
+state — free capacity, pod counts, affinity occupancy — in VMEM scratch
+across *all* K pod placements: HBM sees the spot pool once on the way in
+and the results once on the way out.
+
+Layout notes (pallas_guide: last dim = 128 lanes):
+- the wide axis S (spot nodes) is the lane dimension of every big
+  operand: state is [R, S] / [Cb, S] / [A, S] per lane-block, padded to a
+  multiple of 128 by the caller (models/tensors._pad_dim pads to 128
+  above 128; below that the kernel pads internally);
+- "first fit in probe order" = min over S of (iota where fit) — identical
+  to the scan solver's argmax-of-bool, which is what makes this kernel
+  bit-compatible with the serial reference semantics
+  (rescheduler.go:334-370); parity is enforced by tests.
+
+Semantics contract: identical results to solver/numpy_oracle.plan_oracle
+for any PackedCluster.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+
+_BIG = 2**30  # python int: jnp constants would be captured by the kernel
+LANE_BLOCK = 128  # candidate lanes per grid step (TPU lane width)
+
+
+def _kernel(
+    # inputs (VMEM refs). Slot tensors carry the pod-slot axis K as the
+    # LEADING (untiled) dim: Mosaic only allows dynamic indexing there.
+    slot_req_ref,  # f32 [K, R, Cb]
+    slot_valid_ref,  # i32 [K, 1, Cb]
+    slot_tol_ref,  # u32 [K, W, Cb]
+    slot_aff_ref,  # u32 [K, A, Cb]
+    cand_valid_ref,  # i32 [Cb, 1]
+    spot_free_ref,  # f32 [R, S]
+    spot_count_ref,  # i32 [1, S]
+    spot_maxp_ref,  # i32 [1, S]
+    spot_taints_ref,  # u32 [W, S]
+    spot_ok_ref,  # i32 [1, S]
+    spot_aff_ref,  # u32 [A, S]
+    # outputs
+    feasible_ref,  # i32 [Cb, 1]
+    chosen_ref,  # i32 [K, 1, Cb]
+    # scratch
+    free,  # f32 [R, Cb, S]
+    count,  # i32 [Cb, S]
+    aff,  # u32 [A, Cb, S]
+    feas,  # i32 [Cb, 1]
+    *,
+    K: int,
+    R: int,
+    W: int,
+    A: int,
+):
+    Cb, S = count.shape
+
+    # init per-lane state from the shared spot pool
+    for r in range(R):
+        free[r] = jnp.broadcast_to(spot_free_ref[r][None, :], (Cb, S))
+    count[...] = jnp.broadcast_to(spot_count_ref[0][None, :], (Cb, S))
+    for a in range(A):
+        aff[a] = jnp.broadcast_to(spot_aff_ref[a][None, :], (Cb, S))
+    feas[...] = cand_valid_ref[...]
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (Cb, S), 1)
+    cnt_cap = jnp.broadcast_to(spot_maxp_ref[0][None, :], (Cb, S))
+    node_ok = jnp.broadcast_to(spot_ok_ref[0][None, :], (Cb, S)) != 0
+
+    def body(k, _):
+        # pod slot k of every lane in the block
+        fit = node_ok
+        for r in range(R):
+            req_r = slot_req_ref[k, r][:, None]  # [Cb, 1]
+            fit &= free[r] >= req_r
+        fit &= count[...] < cnt_cap
+        for w in range(W):
+            tol_w = slot_tol_ref[k, w][:, None].astype(jnp.uint32)
+            taints_w = jnp.broadcast_to(
+                spot_taints_ref[w][None, :], (Cb, S)
+            ).astype(jnp.uint32)
+            fit &= (taints_w & ~tol_w) == 0
+        for a in range(A):
+            aff_a = slot_aff_ref[k, a][:, None].astype(jnp.uint32)
+            fit &= (aff[a] & aff_a) == 0
+
+        masked = jnp.where(fit, iota, _BIG)
+        first = jnp.min(masked, axis=1, keepdims=True)  # i32 [Cb, 1]
+        # Mosaic note: all size-1-minor-dim values stay 32-bit — inserting
+        # or broadcasting a minor dim of an i1 is unsupported on TPU.
+        anyfit_i = jnp.where(first < _BIG, 1, 0)  # i32 [Cb, 1]
+        valid_i = slot_valid_ref[k, 0][:, None]  # i32 [Cb, 1]
+        place_i = valid_i * anyfit_i  # i32 [Cb, 1]
+        place_s = jnp.broadcast_to(place_i, (Cb, S)) != 0  # [Cb, S]
+
+        onehot = (iota == first) & place_s  # [Cb, S]
+        for r in range(R):
+            req_r = slot_req_ref[k, r][:, None]
+            free[r] = jnp.where(onehot, free[r] - req_r, free[r])
+        count[...] = count[...] + onehot.astype(jnp.int32)
+        for a in range(A):
+            aff_a = slot_aff_ref[k, a][:, None].astype(jnp.uint32)
+            aff[a] = jnp.where(onehot, aff[a] | aff_a, aff[a])
+
+        # feasible &= any_fit | ~valid  (in i32 arithmetic)
+        feas[...] = feas[...] * jnp.maximum(anyfit_i, 1 - valid_i)
+        chosen_ref[k] = jnp.where(place_i != 0, first, -1).reshape(1, Cb)
+        return 0
+
+    jax.lax.fori_loop(0, K, body, 0)
+    feasible_ref[...] = feas[...]
+
+
+def plan_ffd_pallas(packed: PackedCluster, interpret: bool | None = None) -> SolveResult:
+    """Jittable Pallas solve over a PackedCluster (same contract as
+    solver/ffd.plan_ffd). Falls back to interpret mode off-TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    slot_req = jnp.asarray(packed.slot_req, jnp.float32)
+    C0, K, R = slot_req.shape
+    S = packed.spot_free.shape[0]
+    W = packed.spot_taints.shape[1]
+    A = packed.spot_aff.shape[1]
+
+    # Mosaic requires lane-dim blocks of 128 (or the full axis): small
+    # problems run as one block; large ones pad C to a 128 multiple and
+    # grid over 128-lane blocks (padding lanes are invalid -> inert).
+    if C0 <= LANE_BLOCK:
+        C, Cb = C0, C0
+    else:
+        C = ((C0 + LANE_BLOCK - 1) // LANE_BLOCK) * LANE_BLOCK
+        Cb = LANE_BLOCK
+
+    def pad_c(arr, axis=0):
+        if C == C0:
+            return arr
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, C - C0)
+        return jnp.pad(arr, widths)
+
+    grid = (C // Cb,)
+    kernel = functools.partial(_kernel, K=K, R=R, W=W, A=A)
+
+    out_shape = (
+        jax.ShapeDtypeStruct((C, 1), jnp.int32),  # feasible
+        jax.ShapeDtypeStruct((K, 1, C), jnp.int32),  # chosen
+    )
+    in_specs = [
+        pl.BlockSpec((K, R, Cb), lambda i: (0, 0, i)),
+        pl.BlockSpec((K, 1, Cb), lambda i: (0, 0, i)),
+        pl.BlockSpec((K, W, Cb), lambda i: (0, 0, i)),
+        pl.BlockSpec((K, A, Cb), lambda i: (0, 0, i)),
+        pl.BlockSpec((Cb, 1), lambda i: (i, 0)),
+        pl.BlockSpec((R, S), lambda i: (0, 0)),
+        pl.BlockSpec((1, S), lambda i: (0, 0)),
+        pl.BlockSpec((1, S), lambda i: (0, 0)),
+        pl.BlockSpec((W, S), lambda i: (0, 0)),
+        pl.BlockSpec((1, S), lambda i: (0, 0)),
+        pl.BlockSpec((A, S), lambda i: (0, 0)),
+    ]
+    out_specs = (
+        pl.BlockSpec((Cb, 1), lambda i: (i, 0)),
+        pl.BlockSpec((K, 1, Cb), lambda i: (0, 0, i)),
+    )
+    scratch_shapes = [
+        pltpu.VMEM((R, Cb, S), jnp.float32),
+        pltpu.VMEM((Cb, S), jnp.int32),
+        pltpu.VMEM((A, Cb, S), jnp.uint32),
+        pltpu.VMEM((Cb, 1), jnp.int32),
+    ]
+
+    feasible_i, chosen = pl.pallas_call(
+        kernel,
+        grid=grid,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+    )(
+        pad_c(slot_req, 0).transpose(1, 2, 0),  # [K, R, C]
+        pad_c(jnp.asarray(packed.slot_valid, jnp.int32), 0).T[:, None, :],
+        pad_c(jnp.asarray(packed.slot_tol, jnp.uint32), 0).transpose(1, 2, 0),
+        pad_c(jnp.asarray(packed.slot_aff, jnp.uint32), 0).transpose(1, 2, 0),
+        pad_c(jnp.asarray(packed.cand_valid, jnp.int32), 0)[:, None],
+        jnp.asarray(packed.spot_free, jnp.float32).T,
+        jnp.asarray(packed.spot_count, jnp.int32)[None, :],
+        jnp.asarray(packed.spot_max_pods, jnp.int32)[None, :],
+        jnp.asarray(packed.spot_taints, jnp.uint32).T,
+        jnp.asarray(packed.spot_ok, jnp.int32)[None, :],
+        jnp.asarray(packed.spot_aff, jnp.uint32).T,
+    )
+
+    feasible = feasible_i[:C0, 0] != 0
+    assignment = jnp.where(feasible[:, None], chosen[:, 0, :C0].T, -1)
+    return SolveResult(feasible=feasible, assignment=assignment)
+
+
+plan_ffd_pallas_jit = jax.jit(plan_ffd_pallas, static_argnames=("interpret",))

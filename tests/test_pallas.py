@@ -1,0 +1,27 @@
+"""Pallas kernel parity tests (interpret mode on CPU; the same kernel
+compiles for TPU — bench runs it there)."""
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.ops.pallas_ffd import plan_ffd_pallas
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from tests.test_solver import _pack_drain_case, _random_packed, _test_spot_pool
+
+
+def test_pallas_matches_fixture():
+    for pods in ([500, 300, 100, 100, 100], [500, 400, 100, 100, 100]):
+        packed, _ = _pack_drain_case(_test_spot_pool(), pods)
+        want = plan_oracle(packed)
+        got = plan_ffd_pallas(packed)
+        np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+        np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_pallas_matches_oracle_randomized(seed):
+    packed = _random_packed(np.random.default_rng(seed))
+    want = plan_oracle(packed)
+    got = plan_ffd_pallas(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
