@@ -1,0 +1,17 @@
+# Two-stage image in the spirit of the reference's Dockerfile
+# (golang:1.16 builder -> alpine runtime; here: wheel build -> slim
+# runtime with the TPU-enabled jax stack).
+FROM python:3.12-slim AS build
+WORKDIR /src
+COPY k8s_spot_rescheduler_tpu ./k8s_spot_rescheduler_tpu
+COPY bench.py README.md ./
+
+FROM python:3.12-slim
+# jax[tpu] pulls libtpu for Cloud TPU VMs; CPU-only controllers can
+# install plain jax and run with --solver numpy.
+RUN pip install --no-cache-dir "jax[tpu]" numpy scipy prometheus_client pyyaml \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+COPY --from=build /src /app
+WORKDIR /app
+ENV PYTHONPATH=/app
+ENTRYPOINT ["python", "-m", "k8s_spot_rescheduler_tpu"]

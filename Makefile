@@ -1,0 +1,36 @@
+# Build/test entry points, in the spirit of the reference's Makefile
+# targets (all/check/test/docker-build; reference Makefile:13-91).
+
+IMAGE ?= k8s-spot-rescheduler-tpu
+VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
+
+.PHONY: all check test bench quality replay demo dryrun docker-build clean
+
+all: check
+
+check: test
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+quality:
+	python bench.py --quality
+
+replay:
+	python bench.py --config 5
+
+demo:
+	python -m k8s_spot_rescheduler_tpu --cluster synthetic:1 --ticks 3 -v 2 \
+		--no-metrics-server --node-drain-delay 1s
+
+dryrun:
+	python __graft_entry__.py 8
+
+docker-build:
+	docker build -t $(IMAGE):v$(VERSION) .
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -57,8 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resources", default=",".join(d.resources),
                    help="comma-separated resource axes to pack")
     p.add_argument("--cluster", default="synthetic:1",
-                   help="cluster source: synthetic:<config#>[:seed] (demo/bench)"
-                        " or kube (real apiserver; not available in this build)")
+                   help="cluster source: synthetic:<config#>[:seed] (demo/bench), "
+                        "kube (apiserver from kubeconfig/in-cluster creds), or "
+                        "kube:<url> (explicit apiserver URL)")
     p.add_argument("--ticks", type=int, default=0,
                    help="run N housekeeping ticks then exit (0 = forever)")
     p.add_argument("--no-metrics-server", action="store_true")
@@ -110,6 +111,7 @@ def main(argv=None) -> int:
 
     from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
     from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.clock import RealClock
 
     if args.cluster.startswith("synthetic:"):
         from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
@@ -131,13 +133,25 @@ def main(argv=None) -> int:
         # termination timers live on it
         clock = client.clock
         recorder = client
-    elif args.cluster == "kube":
-        print(
-            "Error: the real-apiserver client is not wired in this build; "
-            "use --cluster synthetic:<n>",
-            file=sys.stderr,
+    elif args.cluster == "kube" or args.cluster.startswith("kube:"):
+        from k8s_spot_rescheduler_tpu.io.kube import (
+            KubeClusterClient,
+            from_environment,
         )
-        return 1
+
+        try:
+            if args.cluster.startswith("kube:"):
+                # explicit apiserver URL (e.g. kube:http://127.0.0.1:8080)
+                client = KubeClusterClient(args.cluster.split(":", 1)[1])
+            else:
+                client = from_environment(
+                    config.running_in_cluster, config.kubeconfig
+                )
+        except Exception as err:  # noqa: BLE001
+            print(f"Error: failed to create kube client: {err}", file=sys.stderr)
+            return 1
+        clock = RealClock()
+        recorder = client
     else:
         print(f"Error: unknown --cluster {args.cluster!r}", file=sys.stderr)
         return 1

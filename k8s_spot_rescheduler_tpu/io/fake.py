@@ -159,6 +159,10 @@ class FakeCluster:
             free_mem = node.allocatable.get(MEMORY, 0) - sum(
                 p.requests.get(MEMORY, 0) for p in here
             )
+            if pod.anti_affinity_group and any(
+                p.anti_affinity_group == pod.anti_affinity_group for p in here
+            ):
+                continue
             if pod.requests.get(CPU, 0) <= free_cpu and (
                 pod.requests.get(MEMORY, 0) <= free_mem
             ):

@@ -1,0 +1,5 @@
+"""Planner sidecar service."""
+
+from k8s_spot_rescheduler_tpu.sidecar.server import PlannerSidecar
+
+__all__ = ["PlannerSidecar"]

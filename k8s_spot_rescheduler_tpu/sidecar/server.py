@@ -1,0 +1,143 @@
+"""Planner sidecar: the solver behind a JSON/HTTP service boundary.
+
+BASELINE.json's north star splits control loop and solver across a
+process boundary ("the Go side calls a gRPC/JAX sidecar") so an existing
+controller — including the Go reference itself — can delegate only the
+per-tick drain *plan* to the TPU while keeping its own eviction path.
+This is that boundary: POST a cluster snapshot in Kubernetes API shapes
+(the same objects the controller already holds), get back the drain
+decision.
+
+    POST /v1/plan
+      {"nodes": [<k8s Node>...], "pods": [<k8s Pod>...],
+       "pdbs": [<k8s PDB>...]}
+    → {"found": true, "node": "od-17", "pods": [...],
+       "assignments": {"ns/pod": "spot-3", ...},
+       "nCandidates": 2500, "nFeasible": 856, "solveMs": 66.2}
+
+    GET /healthz → {"ok": true, "solver": "pallas"}
+
+One SolverPlanner lives for the process lifetime, so jit caches and the
+high-water-mark padding survive across requests — a steady stream of
+plans never recompiles.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k8s_spot_rescheduler_tpu.io.kube import decode_node, decode_pdb, decode_pod
+from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from k8s_spot_rescheduler_tpu.utils import logging as log
+
+
+class PlannerSidecar:
+    def __init__(self, config: ReschedulerConfig, address: str = "127.0.0.1:8642"):
+        self.config = config
+        self.planner = SolverPlanner(config)
+        self._lock = threading.Lock()  # one solve at a time; jit is cached
+        host, _, port = address.rpartition(":")
+        sidecar = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send({"ok": True, "solver": sidecar.config.solver})
+                return self._send({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if self.path != "/v1/plan":
+                    return self._send({"error": "not found"}, 404)
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length))
+                    result = sidecar.plan(body)
+                except (ValueError, KeyError) as err:
+                    return self._send({"error": str(err)}, 400)
+                except Exception as err:  # noqa: BLE001 — solver failure
+                    log.error("sidecar plan failed: %s", err)
+                    return self._send({"error": str(err)}, 500)
+                return self._send(result)
+
+        self.server = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
+
+    @property
+    def address(self) -> str:
+        host, port = self.server.server_address
+        return f"{host}:{port}"
+
+    def plan(self, body: dict) -> dict:
+        nodes = [decode_node(o) for o in body.get("nodes", [])]
+        pods = [decode_pod(o) for o in body.get("pods", [])]
+        pdbs = [decode_pdb(o) for o in body.get("pdbs", [])]
+        pods_by_node: dict = {}
+        for pod in pods:
+            pods_by_node.setdefault(pod.node_name, []).append(pod)
+        node_map = build_node_map(
+            [n for n in nodes if n.ready],
+            pods_by_node,
+            on_demand_label=self.config.on_demand_node_label,
+            spot_label=self.config.spot_node_label,
+            priority_threshold=self.config.priority_threshold,
+        )
+        with self._lock:
+            report = self.planner.plan(node_map, pdbs)
+        out = {
+            "found": report.plan is not None,
+            "nCandidates": report.n_candidates,
+            "nFeasible": report.n_feasible,
+            "solveMs": round(report.solve_seconds * 1e3, 3),
+        }
+        if report.plan is not None:
+            out["node"] = report.plan.node.node.name
+            out["pods"] = [p.uid for p in report.plan.pods]
+            out["assignments"] = report.plan.assignments
+        return out
+
+    def serve_forever(self) -> None:
+        log.info("planner sidecar listening on %s", self.address)
+        self.server.serve_forever()
+
+    def start_background(self) -> None:
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self) -> None:
+        self.server.shutdown()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="spot-rescheduler-sidecar")
+    ap.add_argument("--listen", default="127.0.0.1:8642")
+    ap.add_argument("--solver", default="jax",
+                    choices=["jax", "numpy", "pallas", "sharded"])
+    ap.add_argument("-v", "--verbosity", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.setup(args.verbosity)
+    sidecar = PlannerSidecar(
+        ReschedulerConfig(solver=args.solver), args.listen
+    )
+    sidecar.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

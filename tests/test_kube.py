@@ -1,0 +1,238 @@
+"""Kube client shim tests against a stateful stub apiserver (stdlib
+http.server) — decode paths, eviction subresource, taint patches, and a
+full control-loop tick over real HTTP."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.io.kube import (
+    KubeClusterClient,
+    decode_node,
+    decode_pod,
+)
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+
+def _node(name, role, cpu="2", ready=True, taints=None):
+    return {
+        "metadata": {"name": name, "labels": {"kubernetes.io/role": role}},
+        "spec": {"taints": taints or []},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": "4Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True" if ready else "False"}],
+        },
+    }
+
+
+def _pod(name, node, cpu="100m", ns="default"):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "labels": {"app": name},
+            "ownerReferences": [
+                {"kind": "ReplicaSet", "name": f"{name}-rs", "controller": True}
+            ],
+        },
+        "spec": {
+            "nodeName": node,
+            "priority": 0,
+            "containers": [
+                {"resources": {"requests": {"cpu": cpu, "memory": "64Mi"}}}
+            ],
+        },
+        "status": {"phase": "Running"},
+    }
+
+
+class StubApiserver:
+    """Just enough apiserver: lists, pod get/evict, node taint patch."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.pods = {}
+        self.patches = []
+        self.evictions = []
+        self.events = []
+        self.auths = []
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                stub.auths.append(self.headers.get("Authorization", ""))
+                path = self.path.split("?")[0]
+                if path == "/api/v1/nodes":
+                    return self._send({"items": list(stub.nodes.values())})
+                if path == "/api/v1/pods":
+                    return self._send({"items": list(stub.pods.values())})
+                if path == "/apis/policy/v1/poddisruptionbudgets":
+                    return self._send({"items": []})
+                if path.startswith("/api/v1/namespaces/") and "/pods/" in path:
+                    name = path.rsplit("/", 1)[1]
+                    for key, pod in stub.pods.items():
+                        if pod["metadata"]["name"] == name:
+                            return self._send(pod)
+                    return self._send({"kind": "Status"}, 404)
+                if path.startswith("/api/v1/nodes/"):
+                    name = path.rsplit("/", 1)[1]
+                    if name in stub.nodes:
+                        return self._send(stub.nodes[name])
+                    return self._send({}, 404)
+                return self._send({}, 404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path.endswith("/eviction"):
+                    name = self.path.split("/pods/")[1].split("/")[0]
+                    stub.evictions.append(name)
+                    stub.pods = {
+                        k: v
+                        for k, v in stub.pods.items()
+                        if v["metadata"]["name"] != name
+                    }
+                    return self._send({"kind": "Status", "status": "Success"})
+                if "/events" in self.path:
+                    stub.events.append(body)
+                    return self._send(body, 201)
+                return self._send({}, 404)
+
+            def do_PATCH(self):
+                # a real apiserver applies strategic-merge semantics (keyed
+                # list entries survive omission); this stub only honors
+                # merge-patch, where the client's taint list replaces
+                # wholesale — reject anything else.
+                if self.headers.get("Content-Type") != "application/merge-patch+json":
+                    return self._send({"kind": "Status"}, 415)
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                name = self.path.rsplit("/", 1)[1]
+                stub.patches.append((name, body))
+                if name in stub.nodes:
+                    stub.nodes[name]["spec"]["taints"] = body["spec"]["taints"]
+                return self._send(stub.nodes.get(name, {}))
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def close(self):
+        self.server.shutdown()
+
+
+@pytest.fixture()
+def stub():
+    s = StubApiserver()
+    yield s
+    s.close()
+
+
+def test_decode_pod_quantities():
+    pod = decode_pod(_pod("web", "n1", cpu="1500m"))
+    assert pod.requests["cpu"] == 1500
+    assert pod.requests["memory"] == 64 * 1024**2
+    assert pod.controller_ref().kind == "ReplicaSet"
+
+
+def test_decode_node():
+    node = decode_node(_node("n1", "worker", cpu="2"))
+    assert node.allocatable["cpu"] == 2000
+    assert node.allocatable["pods"] == 110
+    assert node.ready
+
+
+def test_list_and_partition(stub):
+    stub.nodes["od-1"] = _node("od-1", "worker")
+    stub.nodes["spot-1"] = _node("spot-1", "spot-worker")
+    stub.nodes["dead"] = _node("dead", "worker", ready=False)
+    stub.pods["a"] = _pod("a", "od-1")
+    stub.pods["b"] = _pod("b", "spot-1")
+    client = KubeClusterClient(stub.url)
+    nodes = client.list_ready_nodes()
+    assert sorted(n.name for n in nodes) == ["od-1", "spot-1"]  # dead filtered
+    assert [p.name for p in client.list_pods_on_node("od-1")] == ["a"]
+    assert client.get_pod("default", "a").name == "a"
+    assert client.get_pod("default", "zz") is None
+
+
+def test_full_tick_over_http(stub):
+    """observe -> plan (TPU solver) -> drain, every hop over real HTTP."""
+    stub.nodes["od-1"] = _node("od-1", "worker")
+    stub.nodes["spot-1"] = _node("spot-1", "spot-worker")
+    stub.pods["a"] = _pod("a", "od-1", cpu="300m")
+    stub.pods["b"] = _pod("b", "od-1", cpu="200m")
+
+    client = KubeClusterClient(stub.url)
+    config = ReschedulerConfig(pod_eviction_timeout=5.0, eviction_retry_time=1.0)
+    r = Rescheduler(
+        client, SolverPlanner(config), config, clock=FakeClock(), recorder=client
+    )
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    assert sorted(stub.evictions) == ["a", "b"]
+    # taint added then removed (MarkToBeDeleted / CleanToBeDeleted)
+    assert len(stub.patches) == 2
+    keys_seq = [[t["key"] for t in body["spec"]["taints"]] for _, body in stub.patches]
+    assert keys_seq[0] == ["ToBeDeletedByClusterAutoscaler"]
+    assert keys_seq[1] == []
+    assert any(e["reason"] == "Rescheduler" for e in stub.events)
+
+
+def test_unschedulable_gate_sees_fresh_state(stub):
+    """Regression: the safety gate must not read a stale pod cache — a
+    pod that just became pending has to be visible on the next call."""
+    stub.nodes["od-1"] = _node("od-1", "worker")
+    client = KubeClusterClient(stub.url)
+    assert client.list_unschedulable_pods() == []
+    pending = _pod("homeless", "", cpu="100m")
+    pending["spec"]["nodeName"] = ""
+    pending["status"]["phase"] = "Pending"
+    stub.pods["homeless"] = pending
+    assert [p.name for p in client.list_unschedulable_pods()] == ["homeless"]
+
+
+def test_token_file_rotation(stub, tmp_path):
+    """Regression: projected SA tokens rotate on disk; every request must
+    read the current token (client-go behavior)."""
+    tok = tmp_path / "token"
+    tok.write_text("first")
+    client = KubeClusterClient(stub.url, token_file=str(tok))
+    client.list_ready_nodes()
+    tok.write_text("second")
+    client.list_ready_nodes()
+    assert stub.auths[-2:] == ["Bearer first", "Bearer second"]
+
+
+def test_taint_patch_uses_merge_patch(stub):
+    """Regression: taint removal must use merge-patch semantics (lists
+    replace wholesale) — strategic merge cannot delete keyed entries."""
+    stub.nodes["od-1"] = _node("od-1", "worker")
+    client = KubeClusterClient(stub.url)
+    from k8s_spot_rescheduler_tpu.models.cluster import Taint
+
+    client.add_taint("od-1", Taint("ToBeDeletedByClusterAutoscaler", "", "NoSchedule"))
+    client.remove_taint("od-1", "ToBeDeletedByClusterAutoscaler")
+    assert stub.nodes["od-1"]["spec"]["taints"] == []

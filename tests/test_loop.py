@@ -176,3 +176,28 @@ def test_multi_drain_replans_between_drains():
     # left and refuses the second drain
     assert len(result.drained) == 1
     assert fc.pending == []
+
+
+def test_anti_affinity_respected_end_to_end():
+    """A pod whose anti-affinity group already occupies the only roomy
+    spot node must not be planned onto it — and the drain is refused when
+    no alternative exists."""
+    fc, clock, r = _setup()
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    blocker = make_pod("existing", 100, "spot-1")
+    blocker.anti_affinity_group = "db"
+    fc.add_pod(blocker)
+    mover = make_pod("mover", 100, "od-1")
+    mover.anti_affinity_group = "db"
+    fc.add_pod(mover)
+    result = r.tick()
+    assert result.drained == []
+    assert result.report.n_feasible == 0
+
+    # a second spot node unblocks it
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    clock.advance(700.0)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    assert [p.name for p in fc.list_pods_on_node("spot-2")] == ["mover"]

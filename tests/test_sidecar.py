@@ -1,0 +1,74 @@
+"""Planner-sidecar tests: the solver behind its JSON/HTTP boundary."""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.sidecar.server import PlannerSidecar
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.test_kube import _node, _pod
+
+
+@pytest.fixture()
+def sidecar():
+    s = PlannerSidecar(ReschedulerConfig(), "127.0.0.1:0")
+    s.start_background()
+    yield s
+    s.close()
+
+
+def _post(sidecar, body):
+    req = urllib.request.Request(
+        f"http://{sidecar.address}/v1/plan",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_healthz(sidecar):
+    with urllib.request.urlopen(
+        f"http://{sidecar.address}/healthz", timeout=10
+    ) as resp:
+        assert json.loads(resp.read())["ok"] is True
+
+
+def test_plan_over_http(sidecar):
+    body = {
+        "nodes": [_node("od-1", "worker"), _node("spot-1", "spot-worker")],
+        "pods": [_pod("a", "od-1", cpu="300m"), _pod("b", "od-1", cpu="200m")],
+        "pdbs": [],
+    }
+    out = _post(sidecar, body)
+    assert out["found"] is True
+    assert out["node"] == "od-1"
+    assert out["assignments"] == {"default/a": "spot-1", "default/b": "spot-1"}
+    assert out["nCandidates"] == 1 and out["nFeasible"] == 1
+
+
+def test_plan_infeasible(sidecar):
+    body = {
+        "nodes": [_node("od-1", "worker"), _node("spot-1", "spot-worker", cpu="100m")],
+        "pods": [_pod("a", "od-1", cpu="1900m")],
+    }
+    out = _post(sidecar, body)
+    assert out["found"] is False
+    assert out["nFeasible"] == 0
+
+
+def test_bad_request(sidecar):
+    req = urllib.request.Request(
+        f"http://{sidecar.address}/v1/plan",
+        data=b"not json",
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raised = False
+    except urllib.error.HTTPError as err:
+        raised = True
+        assert err.code == 400
+    assert raised
