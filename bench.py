@@ -154,9 +154,11 @@ def main() -> int:
     # fetches only (idx, found, n, row). NOTE: on this build's tunneled
     # TPU, block_until_ready returns early — the np.asarray fetch is the
     # only honest timing fence, and it is what the loop does anyway.
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_best_fit_fallback
     from k8s_spot_rescheduler_tpu.solver.select import decode_selection
 
-    fused = make_fused_planner(solve_fn)
+    # the production planner path: first-fit + best-fit fallback union
+    fused = make_fused_planner(with_best_fit_fallback(solve_fn))
     device_packed = jax.tree.map(jax.numpy.asarray, packed)
 
     t0 = time.perf_counter()

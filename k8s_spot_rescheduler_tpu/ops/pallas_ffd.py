@@ -65,6 +65,7 @@ def _kernel(
     R: int,
     W: int,
     A: int,
+    best_fit: bool,
 ):
     Cb, S = count.shape
 
@@ -97,7 +98,15 @@ def _kernel(
             aff_a = slot_aff_ref[k, a][:, None].astype(jnp.uint32)
             fit &= (aff[a] & aff_a) == 0
 
-        masked = jnp.where(fit, iota, _BIG)
+        if best_fit:
+            # tightest primary-resource fit; slack values are integral in
+            # f32, so the equality re-scan is exact (ties -> probe order)
+            req_0 = slot_req_ref[k, 0][:, None]
+            slack = jnp.where(fit, free[0] - req_0, jnp.float32(3e38))
+            min_slack = jnp.min(slack, axis=1, keepdims=True)
+            masked = jnp.where(fit & (slack == min_slack), iota, _BIG)
+        else:
+            masked = jnp.where(fit, iota, _BIG)
         first = jnp.min(masked, axis=1, keepdims=True)  # i32 [Cb, 1]
         # Mosaic note: all size-1-minor-dim values stay 32-bit — inserting
         # or broadcasting a minor dim of an i1 is unsupported on TPU.
@@ -124,7 +133,11 @@ def _kernel(
     feasible_ref[...] = feas[...]
 
 
-def plan_ffd_pallas(packed: PackedCluster, interpret: bool | None = None) -> SolveResult:
+def plan_ffd_pallas(
+    packed: PackedCluster,
+    interpret: bool | None = None,
+    best_fit: bool = False,
+) -> SolveResult:
     """Jittable Pallas solve over a PackedCluster (same contract as
     solver/ffd.plan_ffd). Falls back to interpret mode off-TPU."""
     if interpret is None:
@@ -153,7 +166,7 @@ def plan_ffd_pallas(packed: PackedCluster, interpret: bool | None = None) -> Sol
         return jnp.pad(arr, widths)
 
     grid = (C // Cb,)
-    kernel = functools.partial(_kernel, K=K, R=R, W=W, A=A)
+    kernel = functools.partial(_kernel, K=K, R=R, W=W, A=A, best_fit=best_fit)
 
     out_shape = (
         jax.ShapeDtypeStruct((C, 1), jnp.int32),  # feasible
@@ -210,4 +223,6 @@ def plan_ffd_pallas(packed: PackedCluster, interpret: bool | None = None) -> Sol
     return SolveResult(feasible=feasible, assignment=assignment)
 
 
-plan_ffd_pallas_jit = jax.jit(plan_ffd_pallas, static_argnames=("interpret",))
+plan_ffd_pallas_jit = jax.jit(
+    plan_ffd_pallas, static_argnames=("interpret", "best_fit")
+)

@@ -25,7 +25,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.parallel.mesh import CAND_AXIS, SPOT_AXIS, make_mesh
@@ -35,7 +35,7 @@ from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 _BIG = jnp.int32(2**30)
 
 
-def _local_step(static, carry, slot):
+def _local_step(static, best_fit, carry, slot):
     """One pod-slot placement on this device's (cand, spot) block."""
     spot_max_pods, spot_taints, spot_ok, s_local, s_offset = static
     free, count, aff_acc, feasible = carry
@@ -55,8 +55,20 @@ def _local_step(static, carry, slot):
     )  # [Cl, Sl]
 
     local_any = jnp.any(fits, axis=-1)
-    local_first = jnp.argmax(fits, axis=-1).astype(jnp.int32)
-    my_global = jnp.where(local_any, s_offset + local_first, _BIG)
+    if best_fit:
+        # two collectives: elect the global minimum slack, then the first
+        # node achieving it (slack is integral in f32, equality is exact)
+        slack = jnp.where(fits, free[..., 0] - req[:, None, 0], jnp.inf)
+        local_min = jnp.min(slack, axis=-1)
+        global_min = jax.lax.pmin(local_min, SPOT_AXIS)  # [Cl]
+        at_min = fits & (slack == global_min[:, None])
+        local_first = jnp.argmax(at_min, axis=-1).astype(jnp.int32)
+        my_global = jnp.where(
+            jnp.any(at_min, axis=-1), s_offset + local_first, _BIG
+        )
+    else:
+        local_first = jnp.argmax(fits, axis=-1).astype(jnp.int32)
+        my_global = jnp.where(local_any, s_offset + local_first, _BIG)
     # elect the globally-first fitting spot node across spot shards
     winner = jax.lax.pmin(my_global, SPOT_AXIS)  # [Cl]
     any_fit = winner < _BIG
@@ -77,7 +89,7 @@ def _local_step(static, carry, slot):
     return (free, count, aff_acc, feasible), chosen
 
 
-def _sharded_plan_local(packed: PackedCluster):
+def _sharded_plan_local(best_fit, packed: PackedCluster):
     """Runs on every device over its local block (inside shard_map)."""
     Cl = packed.slot_req.shape[0]
     Sl = packed.spot_free.shape[0]
@@ -103,7 +115,7 @@ def _sharded_plan_local(packed: PackedCluster):
         jnp.moveaxis(packed.slot_aff, 1, 0),
     )
     (f, c, a, feasible), chosen = jax.lax.scan(
-        functools.partial(_local_step, static), carry, slots
+        functools.partial(_local_step, static, best_fit), carry, slots
     )
     feasible = feasible & jnp.asarray(packed.cand_valid)
     assignment = jnp.where(feasible[None, :], chosen, -1).T  # [Cl, K]
@@ -149,7 +161,9 @@ def _pad_to_mesh(packed: PackedCluster, mesh: Mesh) -> PackedCluster:
     )
 
 
-def plan_ffd_sharded(mesh: Mesh, packed: PackedCluster) -> SolveResult:
+def plan_ffd_sharded(
+    mesh: Mesh, packed: PackedCluster, best_fit: bool = False
+) -> SolveResult:
     """Shard the PackedCluster over the mesh and solve. Axes that don't
     divide the mesh are padded with inert entries and sliced back out."""
     C = packed.slot_req.shape[0]
@@ -168,11 +182,11 @@ def plan_ffd_sharded(mesh: Mesh, packed: PackedCluster) -> SolveResult:
         spot_aff=P(SPOT_AXIS),
     )
     fn = shard_map(
-        _sharded_plan_local,
+        functools.partial(_sharded_plan_local, best_fit),
         mesh=mesh,
         in_specs=(cand_sharded,),
         out_specs=(P(CAND_AXIS), P(CAND_AXIS, None)),
-        check_rep=False,
+        check_vma=False,
     )
     feasible, assignment = fn(packed)
     return SolveResult(feasible=feasible[:C], assignment=assignment[:C])

@@ -47,15 +47,26 @@ class SolverPlanner:
     def _make_fused(self, name: str):
         from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
 
+        base = self._base_solver(name)
+        if self.config.fallback_best_fit:
+            from k8s_spot_rescheduler_tpu.solver.fallback import with_best_fit_fallback
+
+            return make_fused_planner(with_best_fit_fallback(base))
+        return make_fused_planner(base)
+
+    def _base_solver(self, name: str):
+        """A solve(packed, best_fit=False) callable for the backend."""
         if name == "jax":
             from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
 
-            return make_fused_planner(plan_ffd)
+            return plan_ffd
         try:
             if name == "pallas":
                 from k8s_spot_rescheduler_tpu.ops.pallas_ffd import plan_ffd_pallas
 
-                return make_fused_planner(plan_ffd_pallas)
+                return lambda p, best_fit=False: plan_ffd_pallas(
+                    p, best_fit=best_fit
+                )
             if name == "sharded":
                 import functools
 
@@ -69,9 +80,7 @@ class SolverPlanner:
                     if self.config.mesh_shape != (1, 1)
                     else None
                 )
-                return make_fused_planner(
-                    functools.partial(plan_ffd_sharded, mesh)
-                )
+                return functools.partial(plan_ffd_sharded, mesh)
         except ImportError as err:
             raise ValueError(
                 f"solver {name!r} is not available in this build: {err}"
@@ -108,6 +117,16 @@ class SolverPlanner:
             n_feasible = sel.n_feasible
         else:
             result = self._solve_host(packed)
+            if self.config.fallback_best_fit:
+                from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+
+                bf = self._solve_host(packed, best_fit=True)
+                result = SolveResult(
+                    feasible=result.feasible | bf.feasible,
+                    assignment=np.where(
+                        result.feasible[:, None], result.assignment, bf.assignment
+                    ),
+                )
             feasible = np.asarray(result.feasible)
             n_feasible = int(feasible.sum())
             plan = None

@@ -1,0 +1,37 @@
+"""Best-fit fallback composition.
+
+The reference proves drain feasibility with pure first-fit over the
+sorted spot pool (rescheduler.go:334-370) — fast but not the strongest
+packing. The BASELINE.json north star asks for "first-fit-decreasing +
+local-search": this module is that improvement phase. Candidates that
+first-fit cannot prove get a second pass under best-fit-decreasing
+(tightest primary-resource fit). Both passes produce predicate-valid
+placements, so the union can only *add* drainable nodes over the
+reference — quality strictly ≥, never an invalid drain.
+
+First-fit assignments are preferred when both prove feasibility, keeping
+the drain decision identical to the reference whenever the reference
+could have made one.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+
+
+def with_best_fit_fallback(solve_fn):
+    """Wrap a solve(packed, best_fit=...) callable into one that unions
+    first-fit and best-fit feasibility (one fused program under jit)."""
+
+    def solve(packed) -> SolveResult:
+        ff = solve_fn(packed)
+        bf = solve_fn(packed, best_fit=True)
+        feasible = ff.feasible | bf.feasible
+        assignment = jnp.where(
+            ff.feasible[:, None], ff.assignment, bf.assignment
+        )
+        return SolveResult(feasible=feasible, assignment=assignment)
+
+    return solve

@@ -43,7 +43,7 @@ class _Carry(NamedTuple):
     feasible: jax.Array  # bool [C]
 
 
-def _scan_step(static, carry: _Carry, slot):
+def _scan_step(static, best_fit, carry: _Carry, slot):
     """Place pod-slot k for every candidate lane at once."""
     spot_max_pods, spot_taints, spot_ok = static
     req, valid, tol, aff = slot  # [C,R], [C], [C,W], [C,A]
@@ -62,7 +62,13 @@ def _scan_step(static, carry: _Carry, slot):
     )  # bool [C, S]
 
     any_fit = jnp.any(fits, axis=-1)
-    first = jnp.argmax(fits, axis=-1)  # first fitting spot per lane
+    if best_fit:
+        # fallback packing: tightest primary-resource fit, ties → probe
+        # order (argmin returns the first minimum)
+        slack = jnp.where(fits, carry.free[..., 0] - req[:, None, 0], jnp.inf)
+        first = jnp.argmin(slack, axis=-1)
+    else:
+        first = jnp.argmax(fits, axis=-1)  # first fitting spot per lane
     place = valid & any_fit
 
     S = fits.shape[-1]
@@ -77,8 +83,9 @@ def _scan_step(static, carry: _Carry, slot):
     return _Carry(free, count, aff_acc, feasible), chosen
 
 
-def plan_ffd(packed: PackedCluster) -> SolveResult:
-    """Jittable batched first-fit over a PackedCluster (device arrays)."""
+def plan_ffd(packed: PackedCluster, best_fit: bool = False) -> SolveResult:
+    """Jittable batched first-fit (or, with ``best_fit``, best-fit
+    fallback-mode) solve over a PackedCluster (device arrays)."""
     C = packed.slot_req.shape[0]
     S = packed.spot_free.shape[0]
 
@@ -98,7 +105,7 @@ def plan_ffd(packed: PackedCluster) -> SolveResult:
     )
 
     carry, chosen = jax.lax.scan(
-        functools.partial(_scan_step, static), carry, slots
+        functools.partial(_scan_step, static, best_fit), carry, slots
     )  # chosen: [K, C]
 
     feasible = carry.feasible & jnp.asarray(packed.cand_valid)
@@ -107,4 +114,4 @@ def plan_ffd(packed: PackedCluster) -> SolveResult:
     return SolveResult(feasible=feasible, assignment=assignment)
 
 
-plan_ffd_jit = jax.jit(plan_ffd)
+plan_ffd_jit = jax.jit(plan_ffd, static_argnames=("best_fit",))

@@ -25,7 +25,11 @@ from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 
 
-def plan_oracle(packed: PackedCluster) -> SolveResult:
+def plan_oracle(packed: PackedCluster, best_fit: bool = False) -> SolveResult:
+    """``best_fit=False`` is the reference's first-fit probe order;
+    ``best_fit=True`` places each pod on the admissible node with the
+    least remaining primary-resource slack (ties → probe order) — the
+    fallback packing mode (solver/ffd.py ``plan_ffd``)."""
     C, K, _ = packed.slot_req.shape
     feasible = np.zeros(C, bool)
     assign = np.full((C, K), -1, np.int32)
@@ -56,7 +60,12 @@ def plan_oracle(packed: PackedCluster) -> SolveResult:
             if not fits.any():
                 ok = False  # pod can't be rescheduled on any spot node
                 break
-            s = int(np.argmax(fits))  # first fit in probe order
+            if best_fit:
+                slack = free[:, 0] - packed.slot_req[c, k, 0]
+                slack = np.where(fits, slack, np.inf)
+                s = int(np.argmin(slack))  # tightest fit, ties → probe order
+            else:
+                s = int(np.argmax(fits))  # first fit in probe order
             assign[c, k] = s
             # commit into the fork (rescheduler.go:366)
             free[s] -= packed.slot_req[c, k]

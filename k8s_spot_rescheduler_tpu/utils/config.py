@@ -50,6 +50,11 @@ class ReschedulerConfig:
       sharded solver.
     - ``max_drains_per_tick`` — the reference hard-codes one drain per tick
       (rescheduler.go:286 ``break``); keep 1 for faithful behavior.
+    - ``fallback_best_fit`` — candidates unprovable under the reference's
+      first-fit probe get a second feasibility pass under best-fit-
+      decreasing packing. Placements remain predicate-valid, so this can
+      only *add* drainable nodes (quality ≥ reference); disable for
+      bit-faithful drain selection.
     """
 
     running_in_cluster: bool = True
@@ -73,6 +78,7 @@ class ReschedulerConfig:
     solver: str = "jax"
     mesh_shape: tuple = (1, 1)
     max_drains_per_tick: int = 1
+    fallback_best_fit: bool = True
 
     def __post_init__(self):
         from k8s_spot_rescheduler_tpu.utils.labels import validate_label

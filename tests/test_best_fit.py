@@ -1,0 +1,92 @@
+"""Best-fit fallback mode: cross-solver parity and the quality win it
+exists for (a drain first-fit cannot prove, best-fit can)."""
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.models.cluster import NodeInfo, NodeMap
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.ops.pallas_ffd import plan_ffd_pallas
+from k8s_spot_rescheduler_tpu.parallel.mesh import make_mesh
+from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import plan_ffd_sharded
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_jit
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import ON_DEMAND_LABELS, SPOT_LABELS, make_node, make_pod
+from tests.test_solver import _pack_drain_case, _random_packed, _test_spot_pool
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_best_fit_parity_all_solvers(seed):
+    packed = _random_packed(np.random.default_rng(seed))
+    want = plan_oracle(packed, best_fit=True)
+    mesh = make_mesh((2, 2))
+    for got in (
+        plan_ffd_jit(packed, best_fit=True),
+        plan_ffd_pallas(packed, best_fit=True),
+        plan_ffd_sharded(mesh, packed, best_fit=True),
+    ):
+        np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+        np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+def _ff_fails_bf_wins_case():
+    """Pods 900, 600, 500 onto spot free capacities [1100, 900].
+
+    First-fit: 900→node_a (free 200), 600→node_b (free 300), 500 strands.
+    Best-fit:  900→node_b (exact), 600→node_a (free 500), 500→node_a. ✓
+    """
+    spot = [
+        NodeInfo.build(make_node("node-a", SPOT_LABELS, cpu_millis=1100), []),
+        NodeInfo.build(make_node("node-b", SPOT_LABELS, cpu_millis=900), []),
+    ]
+    od = NodeInfo.build(
+        make_node("od-1", ON_DEMAND_LABELS, cpu_millis=4000),
+        [make_pod(f"p{i}", c, "od-1") for i, c in enumerate([900, 600, 500])],
+    )
+    return pack_cluster(NodeMap(on_demand=[od], spot=spot))
+
+
+def test_best_fit_proves_what_first_fit_cannot():
+    packed, _ = _ff_fails_bf_wins_case()
+    assert not bool(plan_oracle(packed).feasible[0])
+    assert bool(plan_oracle(packed, best_fit=True).feasible[0])
+
+
+@pytest.mark.parametrize("solver", ["numpy", "jax", "pallas"])
+def test_planner_fallback_drains_the_hard_case(solver):
+    """With fallback on (default), the planner proves the drain the
+    reference's first-fit would have missed; with it off, it must not."""
+    spot = [
+        NodeInfo.build(make_node("node-a", SPOT_LABELS, cpu_millis=1100), []),
+        NodeInfo.build(make_node("node-b", SPOT_LABELS, cpu_millis=900), []),
+    ]
+    od = NodeInfo.build(
+        make_node("od-1", ON_DEMAND_LABELS, cpu_millis=4000),
+        [make_pod(f"p{i}", c, "od-1") for i, c in enumerate([900, 600, 500])],
+    )
+    nm = NodeMap(on_demand=[od], spot=spot)
+
+    planner = SolverPlanner(ReschedulerConfig(solver=solver))
+    report = planner.plan(nm, [])
+    assert report.plan is not None and report.plan.node.node.name == "od-1"
+    # the fallback's placements are the best-fit ones
+    assert report.plan.assignments["default/p0"] == "node-b"
+
+    strict = SolverPlanner(
+        ReschedulerConfig(solver=solver, fallback_best_fit=False)
+    )
+    assert strict.plan(nm, []).plan is None
+
+
+def test_first_fit_assignment_preferred_when_both_feasible():
+    """When first-fit already proves the drain, the fallback must not
+    change the reference's placements."""
+    packed, meta = _pack_drain_case(_test_spot_pool(), [500, 300, 100, 100, 100])
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_best_fit_fallback
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    combined = with_best_fit_fallback(plan_ffd)(packed)
+    want = plan_oracle(packed)
+    np.testing.assert_array_equal(np.asarray(combined.assignment), want.assignment)
