@@ -63,6 +63,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ticks", type=int, default=0,
                    help="run N housekeeping ticks then exit (0 = forever)")
     p.add_argument("--no-metrics-server", action="store_true")
+    p.add_argument("--trace-dir", default="",
+                   help="write jax.profiler traces of solver phases here")
     return p
 
 
@@ -104,6 +106,10 @@ def main(argv=None) -> int:
         return 1
 
     log.info("Running Rescheduler")
+    if args.trace_dir:
+        from k8s_spot_rescheduler_tpu.utils import tracing
+
+        tracing.enable_profiler(args.trace_dir)
     if not args.no_metrics_server:
         from k8s_spot_rescheduler_tpu.metrics import registry as metrics
 

@@ -34,6 +34,7 @@ from k8s_spot_rescheduler_tpu.planner.base import Planner, PlanReport
 from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
+from k8s_spot_rescheduler_tpu.utils import tracing
 
 
 @dataclasses.dataclass
@@ -134,27 +135,35 @@ class Rescheduler:
             return TickResult(skipped="unschedulable")
 
         log.vlog(3, "Starting node processing.")
-        node_map = self.observe()
-        if node_map is None:
-            return TickResult(skipped="error")
+        with tracing.phase("observe"):
+            node_map = self.observe()
+            if node_map is None:
+                return TickResult(skipped="error")
 
-        try:
-            pdbs = self.client.list_pdbs()
-        except Exception as err:  # noqa: BLE001
-            log.error("Failed to list PDBs: %s", err)
-            return TickResult(skipped="error")
+            try:
+                pdbs = self.client.list_pdbs()
+            except Exception as err:  # noqa: BLE001
+                log.error("Failed to list PDBs: %s", err)
+                return TickResult(skipped="error")
 
-        self._update_metrics(node_map, pdbs)
+            self._update_metrics(node_map, pdbs)
 
         if not node_map.on_demand:
             log.vlog(2, "No nodes to process.")
 
-        report = self.planner.plan(node_map, pdbs)
+        with tracing.phase("plan"):
+            report = self.planner.plan(node_map, pdbs)
         metrics.observe_plan_duration(
             report.solver, report.solve_seconds, report.n_candidates
         )
 
         result = TickResult(report=report)
+        with tracing.phase("actuate"):
+            self._actuate(result, report)
+        log.vlog(3, "Finished processing nodes.")
+        return result
+
+    def _actuate(self, result: TickResult, report: PlanReport) -> None:
         drains = 0
         while drains < self.config.max_drains_per_tick:
             if drains > 0:
@@ -200,9 +209,6 @@ class Rescheduler:
             # (rescheduler.go:280-286)
             self.next_drain_time = self.clock.now() + self.config.node_drain_delay
             drains += 1
-
-        log.vlog(3, "Finished processing nodes.")
-        return result
 
     def run_forever(self) -> None:
         """reference rescheduler.go:161-164: act every housekeeping_interval."""

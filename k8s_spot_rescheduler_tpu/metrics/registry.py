@@ -63,6 +63,14 @@ plan_candidates = Gauge(
     namespace=NAMESPACE,
 )
 
+tick_phase_duration = Histogram(
+    "tick_phase_duration_seconds",
+    "Wall time of each housekeeping-tick phase (observe/plan/actuate).",
+    ["phase"],
+    namespace=NAMESPACE,
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0),
+)
+
 
 def update_nodes_map(on_demand_label: str, spot_label: str, n_on_demand: int, n_spot: int) -> None:
     """reference metrics/metrics.go:73-80 (labels carry the configured
@@ -86,6 +94,10 @@ def update_node_drain_count(state: str, node_name: str) -> None:
 def observe_plan_duration(solver: str, seconds: float, candidates: int) -> None:
     plan_duration.labels(solver).observe(seconds)
     plan_candidates.set(candidates)
+
+
+def observe_tick_phase(phase: str, seconds: float) -> None:
+    tick_phase_duration.labels(phase).observe(seconds)
 
 
 def serve(listen_address: str) -> None:

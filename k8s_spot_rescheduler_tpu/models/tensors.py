@@ -184,21 +184,49 @@ def pack_cluster(
         spot_aff=np.zeros((S, A), np.uint32),
     )
 
+    # Memoized per-pod mask/request helpers: pods overwhelmingly share
+    # toleration sets and affinity groups, and per-pod np.array creation
+    # dominates packing cost at 50k pods — compute each distinct value
+    # once and batch rows per node.
+    scales = [RESOURCE_SCALE.get(r, 1) for r in resources]
+    tol_cache: dict = {}
+    aff_cache: dict = {}
+
+    def req_row(pod: PodSpec):
+        return [_ceil_div(pod.requests.get(r, 0), d) for r, d in zip(resources, scales)]
+
+    def tol_row(pod: PodSpec):
+        key = tuple(pod.tolerations)
+        row = tol_cache.get(key)
+        if row is None:
+            row = tol_cache[key] = pod_toleration_mask(pod, table)
+        return row
+
+    def aff_row(pod: PodSpec):
+        row = aff_cache.get(pod.anti_affinity_group)
+        if row is None:
+            row = aff_cache[pod.anti_affinity_group] = pod_affinity_mask(pod)
+        return row
+
     for c, (info, pods, blocked) in enumerate(zip(candidates, cand_pods, blocking)):
         # a candidate with no evictable pods is skipped, not drained
         # (reference rescheduler.go:260-265); likewise a blocked one.
         packed.cand_valid[c] = blocked is None and len(pods) > 0
-        for k, pod in enumerate(pods):
-            packed.slot_req[c, k] = scale_request(pod.requests, resources)
-            packed.slot_valid[c, k] = True
-            packed.slot_tol[c, k] = pod_toleration_mask(pod, table)
-            packed.slot_aff[c, k] = pod_affinity_mask(pod)
+        if pods:
+            n = len(pods)
+            packed.slot_req[c, :n] = np.array(
+                [req_row(p) for p in pods], np.float32
+            )
+            packed.slot_valid[c, :n] = True
+            packed.slot_tol[c, :n] = [tol_row(p) for p in pods]
+            packed.slot_aff[c, :n] = [aff_row(p) for p in pods]
 
     for s, info in enumerate(spot):
         alloc = scale_allocatable(info.node.allocatable, resources)
-        used = np.zeros(R, np.float32)
-        for pod in info.pods:
-            used += scale_request(pod.requests, resources)
+        if info.pods:
+            used = np.array([req_row(p) for p in info.pods], np.float32).sum(0)
+        else:
+            used = np.zeros(R, np.float32)
         packed.spot_free[s] = alloc - used
         packed.spot_count[s] = len(info.pods)
         packed.spot_max_pods[s] = int(
@@ -206,7 +234,11 @@ def pack_cluster(
         )
         packed.spot_taints[s] = node_taint_mask(info.node, table)
         packed.spot_ok[s] = info.node.ready and not info.node.unschedulable
-        packed.spot_aff[s] = node_affinity_mask(info.pods)
+        aff = np.zeros(AFFINITY_WORDS, np.uint32)
+        for pod in info.pods:
+            if pod.anti_affinity_group:
+                aff |= aff_row(pod)
+        packed.spot_aff[s] = aff
 
     meta = PackMeta(
         candidates=list(candidates),

@@ -1,0 +1,59 @@
+"""Tracing/profiling hooks.
+
+The reference's only observability into its hot path is glog verbosity
+(SURVEY.md §5.1); here each tick phase is timed into a Prometheus
+histogram (metrics/registry.py ``tick_phase_duration``) and, when a trace
+directory is configured, device work runs under ``jax.profiler`` so the
+solver's XLA/Pallas execution shows up in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.utils import logging as log
+
+_trace_dir: Optional[str] = None
+
+
+def enable_profiler(trace_dir: str) -> None:
+    """Route subsequent ``phase(...)`` blocks through jax.profiler traces
+    written to ``trace_dir``."""
+    global _trace_dir
+    _trace_dir = trace_dir
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Time one tick phase into metrics (+ profiler annotation if on)."""
+    start = time.perf_counter()
+    ctx = contextlib.nullcontext()
+    if _trace_dir is not None:
+        try:
+            import jax.profiler
+
+            ctx = jax.profiler.TraceAnnotation(name)
+        except Exception as err:  # noqa: BLE001 — profiling is best-effort
+            log.vlog(2, "profiler unavailable: %s", err)
+    with ctx:
+        yield
+    metrics.observe_tick_phase(name, time.perf_counter() - start)
+
+
+@contextlib.contextmanager
+def device_trace():
+    """Wrap a region in a jax.profiler trace dump (one file per call)."""
+    if _trace_dir is None:
+        yield
+        return
+    try:
+        import jax.profiler
+
+        with jax.profiler.trace(_trace_dir):
+            yield
+    except Exception as err:  # noqa: BLE001
+        log.vlog(2, "device trace failed: %s", err)
+        yield

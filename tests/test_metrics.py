@@ -44,3 +44,29 @@ def test_plan_duration_histogram():
         _value("spot_rescheduler_plan_duration_seconds_count", {"solver": "jax"})
         >= 1
     )
+
+
+def test_tick_phase_histogram():
+    """Tick phases (observe/plan/actuate) land in the phase histogram."""
+    from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+    from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+    from tests.fixtures import ON_DEMAND_LABELS, SPOT_LABELS, make_node, make_pod
+
+    clock = FakeClock()
+    fc = FakeCluster(clock)
+    fc.add_node(make_node("od", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot", SPOT_LABELS))
+    fc.add_pod(make_pod("a", 100, "od"))
+    cfg = ReschedulerConfig(solver="numpy")
+    Rescheduler(fc, SolverPlanner(cfg), cfg, clock=clock).tick()
+    for phase in ("observe", "plan", "actuate"):
+        assert (
+            _value(
+                "spot_rescheduler_tick_phase_duration_seconds_count",
+                {"phase": phase},
+            )
+            >= 1
+        )
