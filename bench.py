@@ -123,6 +123,8 @@ def main() -> int:
                     help="measure nodes-freed vs ILP oracle (small scale)")
     ap.add_argument("--events", type=int, default=1000,
                     help="event count for --config 5 replay")
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="multiply the config's node/pod counts (headroom runs)")
     args = ap.parse_args()
 
     if args.quality:
@@ -132,7 +134,21 @@ def main() -> int:
 
     import jax
 
-    packed, _ = build_problem(args.config, args.seed)
+    spec = None
+    if args.scale != 1.0:
+        import dataclasses
+
+        from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS
+
+        base = CONFIGS[args.config]
+        spec = dataclasses.replace(
+            base,
+            name=f"{base.name}-x{args.scale:g}",
+            n_on_demand=int(base.n_on_demand * args.scale),
+            n_spot=int(base.n_spot * args.scale),
+            n_pods=int(base.n_pods * args.scale),
+        )
+    packed, _ = build_problem(args.config, args.seed, spec=spec)
 
     from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
 

@@ -149,6 +149,15 @@ def plan_ffd_pallas(
     W = packed.spot_taints.shape[1]
     A = packed.spot_aff.shape[1]
 
+    # VMEM guard: per-block scratch + live temporaries are ~(R+A+5)
+    # [Cb, S] i32 planes; past ~14 MB Mosaic's scoped-vmem allocator
+    # fails (observed at S=5120). Fall back to the HBM scan solver —
+    # same semantics, parity-tested — rather than refusing the solve.
+    if not interpret and min(LANE_BLOCK, C0) * S * 4 * (R + A + 5) > 14 * 2**20:
+        from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+        return plan_ffd(packed, best_fit=best_fit)
+
     # Mosaic requires lane-dim blocks of 128 (or the full axis): small
     # problems run as one block; large ones pad C to a 128 multiple and
     # grid over 128-lane blocks (padding lanes are invalid -> inert).

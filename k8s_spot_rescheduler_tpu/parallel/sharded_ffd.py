@@ -29,7 +29,7 @@ from jax import shard_map
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.parallel.mesh import CAND_AXIS, SPOT_AXIS, make_mesh
-from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask
+from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask_t
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 
 _BIG = jnp.int32(2**30)
@@ -41,14 +41,14 @@ def _local_step(static, best_fit, carry, slot):
     free, count, aff_acc, feasible = carry
     req, valid, tol, aff = slot  # local [Cl,R], [Cl], [Cl,W], [Cl,A]
 
-    fits = fit_mask(
+    fits = fit_mask_t(
         jnp,
-        free=free,
+        free_t=free,  # [Cl, R, Sl] — spot axis minor (see fit_mask_t)
         count=count,
         max_pods=spot_max_pods,
-        node_taints=spot_taints,
+        node_taints_t=spot_taints,  # [W, Sl]
         node_ok=spot_ok,
-        node_aff=aff_acc,
+        node_aff_t=aff_acc,  # [Cl, A, Sl]
         req=req,
         tol=tol,
         aff=aff,
@@ -58,7 +58,7 @@ def _local_step(static, best_fit, carry, slot):
     if best_fit:
         # two collectives: elect the global minimum slack, then the first
         # node achieving it (slack is integral in f32, equality is exact)
-        slack = jnp.where(fits, free[..., 0] - req[:, None, 0], jnp.inf)
+        slack = jnp.where(fits, free[:, 0, :] - req[:, None, 0], jnp.inf)
         local_min = jnp.min(slack, axis=-1)
         global_min = jax.lax.pmin(local_min, SPOT_AXIS)  # [Cl]
         at_min = fits & (slack == global_min[:, None])
@@ -80,9 +80,9 @@ def _local_step(static, best_fit, carry, slot):
         in_shard[:, None]
     )
 
-    free = free - onehot[..., None] * req[:, None, :]
+    free = free - onehot[:, None, :] * req[:, :, None]
     count = count + onehot.astype(count.dtype)
-    aff_acc = aff_acc | jnp.where(onehot[..., None], aff[:, None, :], 0)
+    aff_acc = aff_acc | jnp.where(onehot[:, None, :], aff[:, :, None], 0)
     feasible = feasible & (any_fit | ~valid)
 
     chosen = jnp.where(place, winner, jnp.int32(-1))
@@ -95,15 +95,17 @@ def _sharded_plan_local(best_fit, packed: PackedCluster):
     Sl = packed.spot_free.shape[0]
     s_offset = jax.lax.axis_index(SPOT_AXIS).astype(jnp.int32) * Sl
 
+    free_t = jnp.asarray(packed.spot_free).T  # [R, Sl]
+    aff_t = jnp.asarray(packed.spot_aff).T  # [A, Sl]
     carry = (
-        jnp.broadcast_to(packed.spot_free, (Cl, *packed.spot_free.shape)),
+        jnp.broadcast_to(free_t, (Cl, *free_t.shape)),
         jnp.broadcast_to(packed.spot_count, (Cl, Sl)).astype(jnp.int32),
-        jnp.broadcast_to(packed.spot_aff, (Cl, *packed.spot_aff.shape)),
+        jnp.broadcast_to(aff_t, (Cl, *aff_t.shape)),
         jnp.asarray(packed.cand_valid),
     )
     static = (
         packed.spot_max_pods,
-        packed.spot_taints,
+        jnp.asarray(packed.spot_taints).T,  # [W, Sl]
         packed.spot_ok,
         jnp.int32(Sl),
         s_offset,

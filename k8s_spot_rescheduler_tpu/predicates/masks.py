@@ -143,3 +143,31 @@ def fit_mask(
     taint_ok = xp.all((node_taints & ~tol[..., None, :]) == 0, axis=-1)
     aff_ok = xp.all((node_aff & aff[..., None, :]) == 0, axis=-1)
     return res_ok & cnt_ok & taint_ok & aff_ok & node_ok
+
+
+def fit_mask_t(
+    xp,
+    *,
+    free_t,  # [..., R, S] remaining capacity, S minor
+    count,  # [..., S]
+    max_pods,  # [S]
+    node_taints_t,  # [W, S] uint32
+    node_ok,  # [S] bool
+    node_aff_t,  # [..., A, S] uint32
+    req,  # [..., R]
+    tol,  # [..., W]
+    aff,  # [..., A]
+):
+    """``fit_mask`` with the spot axis minor.
+
+    Device solvers keep their big carries as [..., R, S]/[..., A, S]: on
+    TPU the minor dimension is tiled to 128 lanes, so a minor axis of
+    R=2 would pad 64x in HBM (observed: a [C, S, 2] carry ballooned to
+    12.5 GB). Semantics are identical to ``fit_mask`` — the randomized
+    oracle-parity suites pin the two together.
+    """
+    res_ok = xp.all(free_t >= req[..., :, None], axis=-2)  # [..., S]
+    cnt_ok = count < max_pods
+    taint_ok = xp.all((node_taints_t & ~tol[..., :, None]) == 0, axis=-2)
+    aff_ok = xp.all((node_aff_t & aff[..., :, None]) == 0, axis=-2)
+    return res_ok & cnt_ok & taint_ok & aff_ok & node_ok

@@ -19,8 +19,10 @@ with one compiled program:
   over the boolean fit row (argmax returns the first maximum — exactly the
   reference's linear probe order, rescheduler.go:339-350).
 
-Dtypes: capacities/requests are float32 integers < 2**24 (exact); masks are
-uint32; everything is static-shape so XLA tiles it onto the VPU/MXU.
+Layout: the mutable carries keep the wide spot axis MINOR — [C, R, S] and
+[C, A, S] — because TPU tiles the minor dim to 128 lanes; a minor axis of
+R=2 would pad 64x in HBM (predicates/masks.fit_mask_t). Capacities are
+float32 integers < 2**24 (exact); masks are uint32; shapes are static.
 """
 
 from __future__ import annotations
@@ -32,30 +34,30 @@ import jax
 import jax.numpy as jnp
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
-from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask
+from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask_t
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 
 
 class _Carry(NamedTuple):
-    free: jax.Array  # f32 [C, S, R]
+    free: jax.Array  # f32 [C, R, S]
     count: jax.Array  # i32 [C, S]
-    aff: jax.Array  # u32 [C, S, A]
+    aff: jax.Array  # u32 [C, A, S]
     feasible: jax.Array  # bool [C]
 
 
 def _scan_step(static, best_fit, carry: _Carry, slot):
     """Place pod-slot k for every candidate lane at once."""
-    spot_max_pods, spot_taints, spot_ok = static
+    spot_max_pods, spot_taints_t, spot_ok = static
     req, valid, tol, aff = slot  # [C,R], [C], [C,W], [C,A]
 
-    fits = fit_mask(
+    fits = fit_mask_t(
         jnp,
-        free=carry.free,
+        free_t=carry.free,
         count=carry.count,
         max_pods=spot_max_pods,
-        node_taints=spot_taints,
+        node_taints_t=spot_taints_t,
         node_ok=spot_ok,
-        node_aff=carry.aff,
+        node_aff_t=carry.aff,
         req=req,
         tol=tol,
         aff=aff,
@@ -65,18 +67,18 @@ def _scan_step(static, best_fit, carry: _Carry, slot):
     if best_fit:
         # fallback packing: tightest primary-resource fit, ties → probe
         # order (argmin returns the first minimum)
-        slack = jnp.where(fits, carry.free[..., 0] - req[:, None, 0], jnp.inf)
+        slack = jnp.where(fits, carry.free[:, 0, :] - req[:, None, 0], jnp.inf)
         first = jnp.argmin(slack, axis=-1)
     else:
         first = jnp.argmax(fits, axis=-1)  # first fitting spot per lane
     place = valid & any_fit
 
     S = fits.shape[-1]
-    onehot = (jnp.arange(S)[None, :] == first[:, None]) & place[:, None]
+    onehot = (jnp.arange(S)[None, :] == first[:, None]) & place[:, None]  # [C,S]
 
-    free = carry.free - onehot[..., None] * req[:, None, :]
+    free = carry.free - onehot[:, None, :] * req[:, :, None]
     count = carry.count + onehot.astype(carry.count.dtype)
-    aff_acc = carry.aff | jnp.where(onehot[..., None], aff[:, None, :], 0)
+    aff_acc = carry.aff | jnp.where(onehot[:, None, :], aff[:, :, None], 0)
     feasible = carry.feasible & (any_fit | ~valid)
 
     chosen = jnp.where(place, first.astype(jnp.int32), jnp.int32(-1))
@@ -89,13 +91,19 @@ def plan_ffd(packed: PackedCluster, best_fit: bool = False) -> SolveResult:
     C = packed.slot_req.shape[0]
     S = packed.spot_free.shape[0]
 
+    free_t = jnp.asarray(packed.spot_free).T  # [R, S]
+    aff_t = jnp.asarray(packed.spot_aff).T  # [A, S]
     carry = _Carry(
-        free=jnp.broadcast_to(packed.spot_free, (C, *packed.spot_free.shape)),
+        free=jnp.broadcast_to(free_t, (C, *free_t.shape)),
         count=jnp.broadcast_to(packed.spot_count, (C, S)).astype(jnp.int32),
-        aff=jnp.broadcast_to(packed.spot_aff, (C, *packed.spot_aff.shape)),
+        aff=jnp.broadcast_to(aff_t, (C, *aff_t.shape)),
         feasible=jnp.asarray(packed.cand_valid),
     )
-    static = (packed.spot_max_pods, packed.spot_taints, packed.spot_ok)
+    static = (
+        jnp.asarray(packed.spot_max_pods),
+        jnp.asarray(packed.spot_taints).T,  # [W, S]
+        jnp.asarray(packed.spot_ok),
+    )
 
     slots = (
         jnp.moveaxis(packed.slot_req, 1, 0),  # [K, C, R]
