@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
     # --- TPU-native knobs ---
     p.add_argument("--solver", default=d.solver,
                    choices=["jax", "numpy", "pallas", "sharded"])
+    p.add_argument("--mesh-shape", default="",
+                   help="cand x spot device mesh for --solver sharded, "
+                        "e.g. 4x2 (default: infer from visible devices)")
     p.add_argument("--resources", default=",".join(d.resources),
                    help="comma-separated resource axes to pack")
     p.add_argument("--cluster", default="synthetic:1",
@@ -89,6 +92,11 @@ def config_from_args(args) -> ReschedulerConfig:
         priority_threshold=args.priority_threshold,
         solver=args.solver,
         resources=tuple(r for r in args.resources.split(",") if r),
+        mesh_shape=(
+            tuple(int(x) for x in args.mesh_shape.lower().split("x"))
+            if args.mesh_shape
+            else (1, 1)
+        ),
     )
 
 

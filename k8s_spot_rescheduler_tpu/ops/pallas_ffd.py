@@ -37,6 +37,17 @@ from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 _BIG = 2**30  # python int: jnp constants would be captured by the kernel
 LANE_BLOCK = 128  # candidate lanes per grid step (TPU lane width)
 
+# Mosaic's scoped-vmem budget; past this the kernel cannot hold a lane
+# block's state on chip (observed failure at S=5120: 23.3M > 16M).
+_VMEM_BUDGET = 14 * 2**20
+
+
+def needs_scan_fallback(C: int, S: int, R: int, A: int) -> bool:
+    """True when the per-block VMEM footprint — scratch (R+A+1 planes of
+    [Cb, S] i32) plus ~4 live temporaries — would exceed the budget; the
+    caller then uses the HBM scan solver (same semantics)."""
+    return min(LANE_BLOCK, C) * S * 4 * (R + A + 5) > _VMEM_BUDGET
+
 
 def _kernel(
     # inputs (VMEM refs). Slot tensors carry the pod-slot axis K as the
@@ -149,11 +160,7 @@ def plan_ffd_pallas(
     W = packed.spot_taints.shape[1]
     A = packed.spot_aff.shape[1]
 
-    # VMEM guard: per-block scratch + live temporaries are ~(R+A+5)
-    # [Cb, S] i32 planes; past ~14 MB Mosaic's scoped-vmem allocator
-    # fails (observed at S=5120). Fall back to the HBM scan solver —
-    # same semantics, parity-tested — rather than refusing the solve.
-    if not interpret and min(LANE_BLOCK, C0) * S * 4 * (R + A + 5) > 14 * 2**20:
+    if not interpret and needs_scan_fallback(C0, S, R, A):
         from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
 
         return plan_ffd(packed, best_fit=best_fit)

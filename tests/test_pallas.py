@@ -25,3 +25,29 @@ def test_pallas_matches_oracle_randomized(seed):
     got = plan_ffd_pallas(packed)
     np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
     np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+def test_vmem_guard_thresholds():
+    from k8s_spot_rescheduler_tpu.ops.pallas_ffd import needs_scan_fallback
+
+    # north-star shapes stay on the kernel; 2x falls back to the scan
+    assert not needs_scan_fallback(2560, 2560, 2, 2)
+    assert needs_scan_fallback(5120, 5120, 2, 2)
+    # small problems never fall back
+    assert not needs_scan_fallback(8, 8, 3, 2)
+
+
+def test_repeated_solve_deterministic():
+    """SURVEY §5.2: determinism in place of race detection — identical
+    inputs must give bit-identical plans on every solve and solver."""
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_jit
+
+    packed = _random_packed(np.random.default_rng(123))
+    a = plan_ffd_jit(packed)
+    b = plan_ffd_jit(packed)
+    c = plan_ffd_pallas(packed)
+    np.testing.assert_array_equal(np.asarray(a.feasible), np.asarray(b.feasible))
+    np.testing.assert_array_equal(np.asarray(a.assignment), np.asarray(b.assignment))
+    np.testing.assert_array_equal(np.asarray(a.assignment), np.asarray(c.assignment))
