@@ -1,0 +1,62 @@
+"""Property-based tests (hypothesis): the safety invariant behind every
+solver — an approved drain plan must place every evictable pod within
+real remaining capacity, under every predicate. SURVEY.md §7 hard part
+(e): conservative over-approximation only in the safe direction."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_jit
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from tests.test_solver import _random_packed
+
+
+@st.composite
+def packed_clusters(draw):
+    seed = draw(st.integers(0, 2**31 - 1))
+    return _random_packed(np.random.default_rng(seed))
+
+
+def _check_plan_is_executable(packed, result):
+    """Replay the assignments against the initial pool: no capacity,
+    count, taint or affinity violation; every valid slot of a feasible
+    lane placed; infeasible lanes fully reverted."""
+    C, K, R = packed.slot_req.shape
+    for c in range(C):
+        if not result.feasible[c]:
+            assert (result.assignment[c] == -1).all()
+            continue
+        free = packed.spot_free.copy()
+        count = packed.spot_count.copy()
+        aff = packed.spot_aff.copy()
+        for k in range(K):
+            s = result.assignment[c, k]
+            if not packed.slot_valid[c, k]:
+                assert s == -1
+                continue
+            assert s >= 0, "feasible lane left a valid slot unplaced"
+            assert packed.spot_ok[s]
+            free[s] -= packed.slot_req[c, k]
+            assert (free[s] >= 0).all(), "capacity oversubscribed"
+            count[s] += 1
+            assert count[s] <= packed.spot_max_pods[s]
+            assert (packed.spot_taints[s] & ~packed.slot_tol[c, k]).sum() == 0
+            assert (aff[s] & packed.slot_aff[c, k]).sum() == 0
+            aff[s] |= packed.slot_aff[c, k]
+
+
+@given(packed_clusters())
+@settings(max_examples=40, deadline=None)
+def test_plans_are_always_executable(packed):
+    for best_fit in (False, True):
+        result = plan_oracle(packed, best_fit=best_fit)
+        _check_plan_is_executable(packed, result)
+
+
+@given(packed_clusters())
+@settings(max_examples=25, deadline=None)
+def test_jax_oracle_parity_property(packed):
+    want = plan_oracle(packed)
+    got = plan_ffd_jit(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
