@@ -35,9 +35,10 @@ def build_problem(config_id: int, seed: int = 0, spec=None):
     from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
-    cfg = ReschedulerConfig()
+    spec = spec or CONFIGS[config_id]
+    cfg = ReschedulerConfig(resources=spec.resources)
     t0 = time.perf_counter()
-    client = generate_cluster(spec or CONFIGS[config_id], seed)
+    client = generate_cluster(spec, seed)
     t1 = time.perf_counter()
     nodes = client.list_ready_nodes()
     node_map = build_node_map(

@@ -22,6 +22,7 @@ import numpy as np
 from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
 from k8s_spot_rescheduler_tpu.models.cluster import (
     CPU,
+    EPHEMERAL,
     MEMORY,
     PODS,
     NodeSpec,
@@ -36,11 +37,11 @@ from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 ON_DEMAND_LABELS = {"kubernetes.io/role": "worker"}
 SPOT_LABELS = {"kubernetes.io/role": "spot-worker"}
 
-# machine shapes: (cpu millicores, memory bytes, max pods)
+# machine shapes: (cpu millicores, memory bytes, max pods, ephemeral bytes)
 SHAPES = [
-    (4000, 16 * 1024**3, 110),
-    (8000, 32 * 1024**3, 110),
-    (16000, 64 * 1024**3, 250),
+    (4000, 16 * 1024**3, 110, 100 * 1024**3),
+    (8000, 32 * 1024**3, 110, 200 * 1024**3),
+    (16000, 64 * 1024**3, 250, 400 * 1024**3),
 ]
 
 SPOT_TAINT = Taint("cloud.provider/spot", "true", "NoSchedule")
@@ -62,6 +63,9 @@ class SyntheticSpec:
     # mean utilization targets (fraction of allocatable CPU)
     on_demand_util: float = 0.45
     spot_util: float = 0.50
+    # resource dimensions the solver should pack for this config
+    # (BASELINE.json: config 2 = 2 resources, configs 3-4 = 4 resources)
+    resources: Tuple[str, ...] = (CPU, MEMORY)
 
 
 CONFIGS = {
@@ -69,13 +73,14 @@ CONFIGS = {
     1: SyntheticSpec("fixture-3x3", 3, 3, 20),
     # 2: first scale step — uniform sizes, cpu+mem
     2: SyntheticSpec("500n-5kp", 250, 250, 5_000),
-    # 3: north star — Zipf sizes, taints/tolerations
+    # 3: north star — Zipf sizes, taints/tolerations, 4 resources
     3: SyntheticSpec("5kn-50kp-taints", 2_500, 2_500, 50_000,
-                     zipf_sizes=True, taints=True),
+                     zipf_sizes=True, taints=True,
+                     resources=(CPU, MEMORY, EPHEMERAL, PODS)),
     # 4: combinatorial predicates at scale
     4: SyntheticSpec("5kn-50kp-affinity-pdb", 2_500, 2_500, 50_000,
                      zipf_sizes=True, taints=True, anti_affinity=True,
-                     pdbs=True),
+                     pdbs=True, resources=(CPU, MEMORY, EPHEMERAL, PODS)),
     # 5: streaming replay base cluster (events generated separately)
     5: SyntheticSpec("replay-1k-events", 500, 500, 8_000, zipf_sizes=True),
 }
@@ -103,11 +108,11 @@ def generate_cluster(
     def mk_nodes(count: int, labels: dict, prefix: str, tainted: bool) -> List[NodeSpec]:
         nodes = []
         for i in range(count):
-            cpu, mem, cap = SHAPES[rng.integers(0, len(SHAPES))]
+            cpu, mem, cap, eph = SHAPES[rng.integers(0, len(SHAPES))]
             node = NodeSpec(
                 name=f"{prefix}-{i}",
                 labels=dict(labels),
-                allocatable={CPU: cpu, MEMORY: mem, PODS: cap},
+                allocatable={CPU: cpu, MEMORY: mem, PODS: cap, EPHEMERAL: eph},
                 taints=[SPOT_TAINT] if tainted else [],
             )
             nodes.append(node)
@@ -126,6 +131,9 @@ def generate_cluster(
     # memory request correlated with cpu: ~2-6 MiB per millicore
     mem_per_cpu = rng.integers(2, 6, spec.n_pods).astype(np.int64)
     mems = sizes * mem_per_cpu * 1024**2
+    # ephemeral-storage correlated with cpu: ~16-128 KiB per millicore,
+    # so even a fully packed node stays well under its SHAPES[eph] budget
+    ephs = sizes * rng.integers(16, 128, spec.n_pods).astype(np.int64) * 1024
 
     # Fill the emptiest-fitting node first (biggest pods placed first) via a
     # max-heap on remaining budget — O(P log N), scales to 50k pods.
@@ -163,7 +171,7 @@ def generate_cluster(
             name=f"pod-{p}",
             namespace=f"ns-{app % 16}",
             node_name=node.name,
-            requests={CPU: cpu, MEMORY: int(mems[p])},
+            requests={CPU: cpu, MEMORY: int(mems[p]), EPHEMERAL: int(ephs[p])},
             labels={"app": f"app-{app}"},
             owner_refs=[OwnerRef("ReplicaSet", f"app-{app}-rs")],
             tolerations=tolerations,
@@ -211,11 +219,11 @@ def generate_replay(
             name = live_spot.pop(int(rng.integers(0, len(live_spot))))
             events.append(ReplayEvent(at=t, kind="remove_spot", node_name=name))
         else:
-            cpu, mem, cap = SHAPES[rng.integers(0, len(SHAPES))]
+            cpu, mem, cap, eph = SHAPES[rng.integers(0, len(SHAPES))]
             node = NodeSpec(
                 name=f"spot-new-{extra}",
                 labels=dict(SPOT_LABELS),
-                allocatable={CPU: cpu, MEMORY: mem, PODS: cap},
+                allocatable={CPU: cpu, MEMORY: mem, PODS: cap, EPHEMERAL: eph},
             )
             extra += 1
             live_spot.append(node.name)

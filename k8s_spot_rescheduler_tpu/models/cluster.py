@@ -21,6 +21,7 @@ from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 # "ephemeral-storage" in bytes, "pods" in count.
 CPU = "cpu"
 MEMORY = "memory"
+EPHEMERAL = "ephemeral-storage"
 PODS = "pods"
 
 MIRROR_POD_ANNOTATION = "kubernetes.io/config.mirror"

@@ -115,9 +115,12 @@ class PackMeta:
 
 
 def scale_request(requests: Dict[str, int], resources: Sequence[str]) -> np.ndarray:
+    # "pods" is synthesized: every pod counts exactly 1 toward a node's pod
+    # capacity regardless of its requests dict (kubelet semantics), so no
+    # pod source needs to emit it explicitly.
     return np.array(
         [
-            _ceil_div(requests.get(r, 0), RESOURCE_SCALE.get(r, 1))
+            1 if r == "pods" else _ceil_div(requests.get(r, 0), RESOURCE_SCALE.get(r, 1))
             for r in resources
         ],
         dtype=np.float32,
@@ -125,8 +128,14 @@ def scale_request(requests: Dict[str, int], resources: Sequence[str]) -> np.ndar
 
 
 def scale_allocatable(alloc: Dict[str, int], resources: Sequence[str]) -> np.ndarray:
+    # A node that publishes no pods cap gets the kubelet default, matching
+    # the spot_max_pods predicate — not 0, which would make nothing fit.
     return np.array(
-        [int(alloc.get(r, 0)) // RESOURCE_SCALE.get(r, 1) for r in resources],
+        [
+            int(alloc.get(r, DEFAULT_MAX_PODS if r == "pods" else 0))
+            // RESOURCE_SCALE.get(r, 1)
+            for r in resources
+        ],
         dtype=np.float32,
     )
 
@@ -193,7 +202,12 @@ def pack_cluster(
     aff_cache: dict = {}
 
     def req_row(pod: PodSpec):
-        return [_ceil_div(pod.requests.get(r, 0), d) for r, d in zip(resources, scales)]
+        # "pods" counts 1 per pod (kubelet semantics), never read from the
+        # requests dict — see scale_request.
+        return [
+            1 if r == "pods" else _ceil_div(pod.requests.get(r, 0), d)
+            for r, d in zip(resources, scales)
+        ]
 
     def tol_row(pod: PodSpec):
         key = tuple(pod.tolerations)

@@ -103,7 +103,7 @@ def _random_packed(rng: np.random.Generator) -> PackedCluster:
     C = int(rng.integers(1, 6))
     K = int(rng.integers(1, 7))
     S = int(rng.integers(1, 8))
-    R = int(rng.integers(1, 4))
+    R = int(rng.integers(1, 5))
     W, A = 1, 2
     return PackedCluster(
         slot_req=rng.integers(0, 900, (C, K, R)).astype(np.float32),
@@ -125,6 +125,36 @@ def _random_packed(rng: np.random.Generator) -> PackedCluster:
         )
         * (rng.random((S, A)) < 0.3),
     )
+
+
+def test_config3_packs_four_resources():
+    """BASELINE config 3 promises 4 resource dimensions (cpu, memory,
+    ephemeral-storage, pods); the generator emits all four and the batched
+    solver agrees with the serial oracle on them."""
+    import dataclasses
+
+    from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+
+    spec = dataclasses.replace(CONFIGS[3], n_on_demand=8, n_spot=8, n_pods=120)
+    assert len(spec.resources) == 4
+    client = generate_cluster(spec, seed=7)
+    nodes = client.list_ready_nodes()
+    nm = build_node_map(
+        nodes,
+        {n.name: client.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label="kubernetes.io/role=worker",
+        spot_label="kubernetes.io/role=spot-worker",
+    )
+    packed, _ = pack_cluster(nm, resources=spec.resources)
+    assert packed.slot_req.shape[2] == 4
+    # every pod carries a pods-count request of exactly 1
+    valid = packed.slot_valid
+    np.testing.assert_array_equal(packed.slot_req[..., 3][valid], 1.0)
+    want = plan_oracle(packed)
+    got = plan_ffd_jit(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
 
 
 @pytest.mark.parametrize("seed", range(40))
