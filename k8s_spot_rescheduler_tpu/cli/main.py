@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "e.g. 4x2 (default: infer from visible devices)")
     p.add_argument("--resources", default=",".join(d.resources),
                    help="comma-separated resource axes to pack")
+    p.add_argument("--watch-cache", type=_bool, default=True,
+                   help="serve per-tick reads from watch-backed caches "
+                        "(the reference's lister behavior) instead of "
+                        "polling LISTs; kube cluster mode only")
     p.add_argument("--cluster", default="synthetic:1",
                    help="cluster source: synthetic:<config#>[:seed] (demo/bench), "
                         "kube (apiserver from kubeconfig/in-cluster creds), or "
@@ -164,6 +168,18 @@ def main(argv=None) -> int:
         except Exception as err:  # noqa: BLE001
             print(f"Error: failed to create kube client: {err}", file=sys.stderr)
             return 1
+        if args.watch_cache:
+            from k8s_spot_rescheduler_tpu.io.watch import (
+                WatchingKubeClusterClient,
+            )
+
+            client = WatchingKubeClusterClient(client)
+            try:
+                client.start()
+            except Exception as err:  # noqa: BLE001
+                print(f"Error: watch caches failed to sync: {err}",
+                      file=sys.stderr)
+                return 1
         clock = RealClock()
         recorder = client
     else:

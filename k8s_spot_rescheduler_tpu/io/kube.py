@@ -165,7 +165,9 @@ class KubeClusterClient:
 
     # --- plumbing ---
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None):
+    def _open(self, method: str, path: str, body: Optional[dict],
+              timeout: float):
+        """Authorized HTTP round trip; returns the open response."""
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -186,9 +188,25 @@ class KubeClusterClient:
         if token:
             req.add_header("Authorization", f"Bearer {token}")
         ctx = self._ctx if url.startswith("https") else None
-        with urllib.request.urlopen(req, context=ctx, timeout=30) as resp:
+        return urllib.request.urlopen(req, context=ctx, timeout=timeout)
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        with self._open(method, path, body, timeout=30) as resp:
             payload = resp.read()
         return json.loads(payload) if payload else {}
+
+    def _stream(self, path: str, read_timeout: float = 330.0):
+        """Yield newline-delimited JSON objects from a watch endpoint.
+
+        The timeout exceeds the watch's own ``timeoutSeconds`` so an idle
+        but healthy stream is closed by the server, not by us; the caller
+        (io/watch.py) reconnects from the last resourceVersion either way.
+        """
+        with self._open("GET", path, None, timeout=read_timeout) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
 
     # --- read path ---
 
