@@ -59,6 +59,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "e.g. 4x2 (default: infer from visible devices)")
     p.add_argument("--resources", default=",".join(d.resources),
                    help="comma-separated resource axes to pack")
+    p.add_argument("--leader-elect", type=_bool, default=False,
+                   help="Lease-based leader election so only one replica "
+                        "acts (restores what reference rescheduler.go:139 "
+                        "removed); kube cluster mode only")
+    p.add_argument("--leader-elect-namespace", default="kube-system")
+    p.add_argument("--leader-elect-identity", default="",
+                   help="holder identity (default: hostname_pid_rand)")
+    p.add_argument("--leader-elect-lease-duration", default="15s",
+                   help="takeover after the holder is quiet this long")
     p.add_argument("--watch-cache", type=_bool, default=True,
                    help="serve per-tick reads from watch-backed caches "
                         "(the reference's lister behavior) instead of "
@@ -131,6 +140,7 @@ def main(argv=None) -> int:
     from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
     from k8s_spot_rescheduler_tpu.utils.clock import RealClock
 
+    elector = None
     if args.cluster.startswith("synthetic:"):
         from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
 
@@ -168,6 +178,19 @@ def main(argv=None) -> int:
         except Exception as err:  # noqa: BLE001
             print(f"Error: failed to create kube client: {err}", file=sys.stderr)
             return 1
+        if args.leader_elect:
+            from k8s_spot_rescheduler_tpu.io.lease import LeaseElector
+
+            elector = LeaseElector(
+                client,
+                identity=args.leader_elect_identity,
+                namespace=args.leader_elect_namespace,
+                lease_duration=parse_duration(
+                    args.leader_elect_lease_duration
+                ),
+            )
+            # renew off-loop so a long drain never lets the lease lapse
+            elector.start_background()
         if args.watch_cache:
             from k8s_spot_rescheduler_tpu.io.watch import (
                 WatchingKubeClusterClient,
@@ -195,8 +218,13 @@ def main(argv=None) -> int:
     ticks = 0
     while args.ticks == 0 or ticks < args.ticks:
         clock.sleep(config.housekeeping_interval)
-        result = r.tick()
+        # a follower's skipped interval still counts toward --ticks so
+        # bounded runs terminate whoever holds the lease
         ticks += 1
+        if elector is not None and not elector.is_leader and not elector.ensure():
+            log.vlog(2, "not the leader; standing by")
+            continue
+        result = r.tick()
         if result.drained or result.drain_failed:
             log.info(
                 "tick %d: drained=%s failed=%s", ticks,

@@ -247,6 +247,12 @@ class WatchingKubeClusterClient:
 
     # --- consistent per-tick view ---
 
+    def refresh(self) -> None:
+        """Drop the frozen view so the next read re-freezes from the live
+        stores — called by the control loop before a mid-tick re-observe
+        (multi-drain re-plan), mirroring KubeClusterClient.refresh()."""
+        self._have_tick_view = False
+
     def _freeze(self) -> None:
         by_node: Dict[str, List[PodSpec]] = {}
         for pod in self.pods.snapshot():

@@ -172,6 +172,12 @@ class Rescheduler:
                 # feasibility proof assumed the undisturbed snapshot
                 # (independent fork lanes) — so re-observe and re-plan
                 # before each additional drain to avoid spot overcommit.
+                # Clients with a per-tick cache (polling pod LIST, watch
+                # snapshot) must drop it or the re-observe reads the same
+                # pre-drain view the first plan used.
+                refresh = getattr(self.client, "refresh", None)
+                if refresh is not None:
+                    refresh()
                 node_map = self.observe()
                 if node_map is None:
                     break

@@ -1,0 +1,238 @@
+"""Leader-election tests: Lease acquire / renew / skew-safe takeover /
+CAS-race demotion against a stub apiserver enforcing resourceVersion
+compare-and-swap, driven on a virtual clock."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.io.kube import KubeClusterClient
+from k8s_spot_rescheduler_tpu.io.lease import LeaseElector
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+
+LEASES = "/apis/coordination.k8s.io/v1/namespaces/kube-system/leases"
+
+
+class LeaseStub:
+    def __init__(self):
+        self.lease = None  # the single lease object, or None
+        self.rv = 0
+        self.conflict_next_put = False
+        self.fail_next = 0  # respond 500 to the next N requests
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _gate(self):
+                if stub.fail_next > 0:
+                    stub.fail_next -= 1
+                    self._send({"kind": "Status"}, 500)
+                    return True
+                return False
+
+            def do_GET(self):
+                if self._gate():
+                    return
+                if self.path.startswith(LEASES + "/"):
+                    if stub.lease is None:
+                        return self._send({"kind": "Status"}, 404)
+                    return self._send(stub.lease)
+                return self._send({}, 404)
+
+            def do_POST(self):
+                if self._gate():
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == LEASES:
+                    if stub.lease is not None:
+                        return self._send({"kind": "Status"}, 409)
+                    stub.rv += 1
+                    body["metadata"]["resourceVersion"] = str(stub.rv)
+                    stub.lease = body
+                    return self._send(body, 201)
+                return self._send({}, 404)
+
+            def do_PUT(self):
+                if self._gate():
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not self.path.startswith(LEASES + "/"):
+                    return self._send({}, 404)
+                if stub.conflict_next_put:
+                    stub.conflict_next_put = False
+                    return self._send({"kind": "Status"}, 409)
+                current_rv = (
+                    stub.lease["metadata"]["resourceVersion"]
+                    if stub.lease else ""
+                )
+                if body["metadata"].get("resourceVersion") != current_rv:
+                    return self._send({"kind": "Status"}, 409)
+                stub.rv += 1
+                body["metadata"]["resourceVersion"] = str(stub.rv)
+                stub.lease = body
+                return self._send(body)
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def close(self):
+        self.server.shutdown()
+
+
+@pytest.fixture()
+def stub():
+    s = LeaseStub()
+    yield s
+    s.close()
+
+
+def _elector(stub, clock, ident):
+    return LeaseElector(
+        KubeClusterClient(stub.url),
+        identity=ident,
+        lease_duration=15.0,
+        clock=clock,
+        wall=clock.now,
+    )
+
+
+def test_acquire_when_absent(stub):
+    clock = FakeClock()
+    a = _elector(stub, clock, "a")
+    assert a.ensure()
+    assert stub.lease["spec"]["holderIdentity"] == "a"
+    assert stub.lease["spec"]["leaseTransitions"] == 0
+
+
+def test_renew_keeps_leadership_and_acquire_time(stub):
+    clock = FakeClock()
+    a = _elector(stub, clock, "a")
+    assert a.ensure()
+    t0 = stub.lease["spec"]["acquireTime"]
+    clock.advance(10)
+    assert a.ensure()
+    assert stub.lease["spec"]["acquireTime"] == t0  # renew, not re-acquire
+    assert stub.lease["spec"]["renewTime"] != t0
+
+
+def test_follower_while_holder_renews(stub):
+    clock = FakeClock()
+    a, b = _elector(stub, clock, "a"), _elector(stub, clock, "b")
+    assert a.ensure()
+    # b keeps observing fresh renewals: never becomes leader however long
+    # wall time gets, because the observation clock resets on every change
+    for _ in range(5):
+        clock.advance(10)
+        assert a.ensure()
+        assert not b.ensure()
+
+
+def test_takeover_after_holder_goes_quiet(stub):
+    clock = FakeClock()
+    a, b = _elector(stub, clock, "a"), _elector(stub, clock, "b")
+    assert a.ensure()
+    assert not b.ensure()  # first observation of a's record
+    clock.advance(14.9)
+    assert not b.ensure()  # not yet expired
+    clock.advance(0.2)  # observed_at + 15 passed, a never renewed
+    assert b.ensure()
+    assert stub.lease["spec"]["holderIdentity"] == "b"
+    assert stub.lease["spec"]["leaseTransitions"] == 1
+    # a finds out on its next renew attempt (CAS fails -> follower)
+    assert not a.ensure()
+
+
+def test_cas_conflict_demotes(stub):
+    clock = FakeClock()
+    a = _elector(stub, clock, "a")
+    assert a.ensure()
+    stub.conflict_next_put = True
+    assert not a.ensure()  # renew raced -> follower, no crash
+
+
+def test_apiserver_error_demotes_without_raising(stub):
+    clock = FakeClock()
+    a = _elector(stub, clock, "a")
+    assert a.ensure()
+    stub.fail_next = 1
+    assert not a.ensure()
+    assert a.ensure()  # recovers next tick
+
+
+def test_background_renewal_covers_long_tick(stub):
+    """A leader blocked in a long drain must not go quiet: the renew
+    thread keeps the lease fresh, so a standby never takes over until the
+    leader actually stops. Real clocks, scaled-down durations."""
+    import time as _t
+
+    from k8s_spot_rescheduler_tpu.utils.clock import RealClock
+
+    a = LeaseElector(
+        KubeClusterClient(stub.url), identity="a",
+        lease_duration=1.0, clock=RealClock(),
+    )
+    b = LeaseElector(
+        KubeClusterClient(stub.url), identity="b",
+        lease_duration=1.0, clock=RealClock(),
+    )
+    assert a.ensure()
+    a.start_background(retry_period=0.1)
+    try:
+        # "main thread of A" is busy for longer than the lease duration;
+        # B keeps probing and must stay follower throughout
+        deadline = _t.monotonic() + 1.5
+        while _t.monotonic() < deadline:
+            assert not b.ensure(), "standby stole a live leader's lease"
+            _t.sleep(0.05)
+    finally:
+        a.stop_background()
+    # A is gone for real now; B takes over after a full quiet period
+    deadline = _t.monotonic() + 5.0
+    while _t.monotonic() < deadline and not b.ensure():
+        _t.sleep(0.05)
+    assert b.is_leader
+    assert stub.lease["spec"]["holderIdentity"] == "b"
+
+
+def test_observation_not_remote_timestamps(stub):
+    """Skew safety: a holder whose renewTime is absurdly far in the future
+    (its clock is wrong) is still taken over once *locally* quiet."""
+    clock = FakeClock()
+    b = _elector(stub, clock, "b")
+    stub.rv += 1
+    stub.lease = {
+        "metadata": {"name": "x", "resourceVersion": str(stub.rv)},
+        "spec": {
+            "holderIdentity": "skewed",
+            "leaseDurationSeconds": 15,
+            "renewTime": "2999-01-01T00:00:00.000000Z",
+            "leaseTransitions": 3,
+        },
+    }
+    assert not b.ensure()  # first observation
+    clock.advance(15.1)
+    assert b.ensure()  # local quiet period decides, not the year-2999 stamp
+    assert stub.lease["spec"]["leaseTransitions"] == 4

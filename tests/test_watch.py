@@ -289,6 +289,21 @@ def test_tick_snapshot_is_frozen(watching):
     ]
 
 
+def test_refresh_unfreezes_for_midtick_replan(watching):
+    """Multi-drain mode re-observes mid-tick; refresh() must surface
+    post-drain state instead of the tick-start freeze."""
+    stub, wc = watching
+    stub.objects["nodes"]["uid-od-1"] = _node("od-1", "worker")
+    stub.objects["pods"]["uid-a"] = _pod("a", "od-1")
+    wc.start(timeout=10)
+    wc.list_unschedulable_pods()  # tick freeze
+    stub.push("pods", "ADDED", _pod("b", "od-1"))
+    assert _wait(lambda: len(wc.pods.snapshot()) == 2)
+    assert [p.name for p in wc.list_pods_on_node("od-1")] == ["a"]
+    wc.refresh()  # what the controller calls before a mid-tick re-plan
+    assert sorted(p.name for p in wc.list_pods_on_node("od-1")) == ["a", "b"]
+
+
 def test_gone_triggers_relist(watching):
     stub, wc = watching
     stub.objects["pods"]["uid-a"] = _pod("a", "od-1")
