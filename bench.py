@@ -58,7 +58,7 @@ def build_problem(config_id: int, seed: int = 0, spec=None):
         f"S={packed.spot_free.shape[0]} R={packed.slot_req.shape[2]}",
         file=sys.stderr,
     )
-    return packed, meta
+    return packed, meta, (t3 - t2)
 
 
 def run_quality(seed: int) -> int:
@@ -72,7 +72,7 @@ def run_quality(seed: int) -> int:
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
     spec = SyntheticSpec("quality-40n-300p", 20, 20, 300)
-    packed, _ = build_problem(0, seed, spec=spec)
+    packed, _, _ = build_problem(0, seed, spec=spec)
     ilp = ilp_max_drains(packed)
     client = generate_cluster(spec, seed, reschedule_evicted=True)
     greedy = drain_to_exhaustion(client, ReschedulerConfig())
@@ -149,7 +149,7 @@ def main() -> int:
             n_spot=int(base.n_spot * args.scale),
             n_pods=int(base.n_pods * args.scale),
         )
-    packed, _ = build_problem(args.config, args.seed, spec=spec)
+    packed, _, pack_s = build_problem(args.config, args.seed, spec=spec)
 
     from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
 
@@ -230,6 +230,7 @@ def main() -> int:
         f"compile {compile_s:.1f}s  solve+fetch median {value_ms:.2f} ms "
         f"(min {min(times)*1e3:.2f}, max {max(times)*1e3:.2f})  "
         f"with-upload {e2e_ms:.1f} ms  "
+        f"full tick (pack+upload+solve+fetch) {pack_s*1e3 + e2e_ms:.1f} ms  "
         f"device-only est {device_ms:.2f} ms/solve (tunnel RTT amortized)  "
         f"feasible {sel.n_feasible}/{int(np.asarray(packed.cand_valid).sum())} "
         f"candidates, first={sel.index}  device {jax.devices()[0].device_kind}",

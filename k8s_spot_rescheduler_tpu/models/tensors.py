@@ -180,25 +180,34 @@ def pack_cluster(
         spot_aff=np.zeros((S, A), np.uint32),
     )
 
-    # Memoized per-pod mask/request helpers: pods overwhelmingly share
-    # toleration sets and affinity groups, and per-pod np.array creation
-    # dominates packing cost at 50k pods — compute each distinct value
-    # once and batch rows per node.
+    # Memoized per-pod mask helpers: pods overwhelmingly share toleration
+    # sets and affinity groups — compute each distinct value once. Request
+    # rows are batched per node (req_matrix): per-pod Python helpers were
+    # the packing hot spot at 50k pods (~45% of pack time).
     scales = [RESOURCE_SCALE.get(r, 1) for r in resources]
     tol_cache: dict = {}
     aff_cache: dict = {}
 
-    def req_row(pod: PodSpec):
+    def req_matrix(pods: List[PodSpec]) -> np.ndarray:
         # "pods" is synthesized: every pod counts exactly 1 toward a node's
         # pod capacity regardless of its requests dict (kubelet semantics),
         # so no pod source needs to emit it. As a packed dimension it
         # intentionally duplicates the spot_count/spot_max_pods predicate —
         # BASELINE config 3/4 promise 4 resource dimensions; the VMEM guard
         # (ops/pallas_ffd.needs_scan_fallback) covers the extra plane.
-        return [
-            1 if r == "pods" else _ceil_div(pod.requests.get(r, 0), d)
-            for r, d in zip(resources, scales)
-        ]
+        n = len(pods)
+        out = np.empty((n, R), np.float32)
+        for j, (r, d) in enumerate(zip(resources, scales)):
+            if r == "pods":
+                out[:, j] = 1.0
+            else:
+                col = np.fromiter(
+                    (p.requests.get(r, 0) for p in pods),
+                    dtype=np.int64, count=n,
+                )
+                # vectorized ceil-div: requests round up (safe direction)
+                out[:, j] = -(-col // d) if d != 1 else col
+        return out
 
     def tol_row(pod: PodSpec):
         key = tuple(pod.tolerations)
@@ -219,9 +228,7 @@ def pack_cluster(
         packed.cand_valid[c] = blocked is None and len(pods) > 0
         if pods:
             n = len(pods)
-            packed.slot_req[c, :n] = np.array(
-                [req_row(p) for p in pods], np.float32
-            )
+            packed.slot_req[c, :n] = req_matrix(pods)
             packed.slot_valid[c, :n] = True
             packed.slot_tol[c, :n] = [tol_row(p) for p in pods]
             packed.slot_aff[c, :n] = [aff_row(p) for p in pods]
@@ -229,7 +236,7 @@ def pack_cluster(
     for s, info in enumerate(spot):
         alloc = scale_allocatable(info.node.allocatable, resources)
         if info.pods:
-            used = np.array([req_row(p) for p in info.pods], np.float32).sum(0)
+            used = req_matrix(info.pods).sum(0)
         else:
             used = np.zeros(R, np.float32)
         packed.spot_free[s] = alloc - used
