@@ -30,9 +30,12 @@ TARGET_MS = 200.0
 
 
 def build_problem(config_id: int, seed: int = 0, spec=None):
+    """Generate the synthetic cluster and pack it via the production
+    observe path: the incrementally-maintained columnar mirror
+    (models/columnar.py). The returned pack seconds are the steady-state
+    per-tick observe+pack cost (the mirror is already attached, as it is
+    in the control loop)."""
     from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
-    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
-    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
     spec = spec or CONFIGS[config_id]
@@ -40,20 +43,20 @@ def build_problem(config_id: int, seed: int = 0, spec=None):
     t0 = time.perf_counter()
     client = generate_cluster(spec, seed)
     t1 = time.perf_counter()
-    nodes = client.list_ready_nodes()
-    node_map = build_node_map(
-        nodes,
-        {n.name: client.list_pods_on_node(n.name) for n in nodes},
+    store = client.columnar_store(
+        cfg.resources,
         on_demand_label=cfg.on_demand_node_label,
         spot_label=cfg.spot_node_label,
-        priority_threshold=cfg.priority_threshold,
     )
     pdbs = client.list_pdbs()
     t2 = time.perf_counter()
-    packed, meta = pack_cluster(node_map, pdbs, resources=cfg.resources)
+    packed, meta = store.pack(
+        pdbs, priority_threshold=cfg.priority_threshold
+    )
     t3 = time.perf_counter()
     print(
-        f"generate {t1-t0:.1f}s  observe {t2-t1:.1f}s  pack {t3-t2:.1f}s  "
+        f"generate {t1-t0:.1f}s  ingest(once) {t2-t1:.2f}s  "
+        f"columnar observe+pack {(t3-t2)*1e3:.1f} ms  "
         f"shapes C={packed.slot_req.shape[0]} K={packed.slot_req.shape[1]} "
         f"S={packed.spot_free.shape[0]} R={packed.slot_req.shape[2]}",
         file=sys.stderr,

@@ -72,6 +72,27 @@ class Rescheduler:
 
     # --- observation ---
 
+    def _columnar_store(self):
+        """The vectorized observe path (models/columnar.py): used when the
+        client maintains a columnar mirror, the planner can consume it,
+        and the config hasn't forced the object path."""
+        if not self.config.use_columnar:
+            return None
+        if not getattr(self.planner, "accepts_columnar", False):
+            return None
+        factory = getattr(self.client, "columnar_store", None)
+        if factory is None:
+            return None
+        try:
+            return factory(
+                self.config.resources,
+                on_demand_label=self.config.on_demand_node_label,
+                spot_label=self.config.spot_node_label,
+            )
+        except Exception as err:  # noqa: BLE001 — fall back to objects
+            log.error("Columnar observe unavailable: %s", err)
+            return None
+
     def observe(self) -> Optional[NodeMap]:
         try:
             nodes = self.client.list_ready_nodes()
@@ -116,6 +137,37 @@ class Rescheduler:
                 cfg.spot_node_label, info.node.name, len(pods)
             )
 
+    def _wrap_columnar(self, store, pdbs):
+        from k8s_spot_rescheduler_tpu.models.columnar import ColumnarObservation
+
+        cfg = self.config
+        return ColumnarObservation(
+            store=store,
+            verdicts=store.verdicts(
+                pdbs,
+                priority_threshold=cfg.priority_threshold,
+                delete_non_replicated=cfg.delete_non_replicated_pods,
+            ),
+        )
+
+    def _update_metrics_columnar(self, obs, pdbs) -> None:
+        cfg = self.config
+        od, spot = obs.store.node_pod_counts(
+            pdbs,
+            priority_threshold=cfg.priority_threshold,
+            delete_non_replicated=cfg.delete_non_replicated_pods,
+            verdicts=obs.verdicts,
+        )
+        metrics.update_nodes_map(
+            cfg.on_demand_node_label, cfg.spot_node_label, len(od), len(spot)
+        )
+        if not od:
+            log.vlog(2, "No nodes to process.")
+        for name, count in od:
+            metrics.update_node_pods_count(cfg.on_demand_node_label, name, count)
+        for name, count in spot:
+            metrics.update_node_pods_count(cfg.spot_node_label, name, count)
+
     # --- the tick ---
 
     def tick(self) -> TickResult:
@@ -136,8 +188,10 @@ class Rescheduler:
 
         log.vlog(3, "Starting node processing.")
         with tracing.phase("observe"):
-            node_map = self.observe()
-            if node_map is None:
+            observation = self._columnar_store()
+            if observation is None:
+                observation = self.observe()
+            if observation is None:
                 return TickResult(skipped="error")
 
             try:
@@ -146,13 +200,18 @@ class Rescheduler:
                 log.error("Failed to list PDBs: %s", err)
                 return TickResult(skipped="error")
 
-            self._update_metrics(node_map, pdbs)
-
-        if not node_map.on_demand:
-            log.vlog(2, "No nodes to process.")
+            if isinstance(observation, NodeMap):
+                self._update_metrics(observation, pdbs)
+                if not observation.on_demand:
+                    log.vlog(2, "No nodes to process.")
+            else:
+                # one evictability pass per tick, shared between the
+                # metrics update and the planner's pack
+                observation = self._wrap_columnar(observation, pdbs)
+                self._update_metrics_columnar(observation, pdbs)
 
         with tracing.phase("plan"):
-            report = self.planner.plan(node_map, pdbs)
+            report = self.planner.plan(observation, pdbs)
         metrics.observe_plan_duration(
             report.solver, report.solve_seconds, report.n_candidates
         )
@@ -178,15 +237,17 @@ class Rescheduler:
                 refresh = getattr(self.client, "refresh", None)
                 if refresh is not None:
                     refresh()
-                node_map = self.observe()
-                if node_map is None:
+                observation = self._columnar_store()
+                if observation is None:
+                    observation = self.observe()
+                if observation is None:
                     break
                 try:
                     pdbs = self.client.list_pdbs()
                 except Exception as err:  # noqa: BLE001
                     log.error("Failed to list PDBs: %s", err)
                     break
-                report = self.planner.plan(node_map, pdbs)
+                report = self.planner.plan(observation, pdbs)
             plan = report.plan
             if plan is None:
                 break

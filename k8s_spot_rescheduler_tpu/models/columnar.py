@@ -1,0 +1,788 @@
+"""Columnar cluster state: the zero-copy observe→pack fast path.
+
+SURVEY.md §5.8 names the TPU-native replacement for the reference's
+watch-cache listers (reference rescheduler.go:154-156): *"host-side async
+cluster-state ingestion (watch → arrow/numpy buffers)"*. This module is
+those buffers. ``ColumnarStore`` maintains the whole cluster as a struct
+of numpy arrays — one row per pod / node, updated incrementally as state
+changes — so a housekeeping tick never walks Python objects:
+
+- the reference rebuilds its ``NodeInfo`` map from scratch each tick with
+  one pod LIST per node (reference nodes/nodes.go:63-145, an O(pods)
+  object walk); the object-model path here (``models/cluster.py`` +
+  ``models/tensors.pack_cluster``) reproduces that and costs ~275 ms at
+  the 50k-pod north star;
+- this path amortizes all per-pod work (request scaling, evictability
+  flags, toleration interning, affinity hashing) into ``add_pod`` — each
+  pod pays once when it *changes*, not every tick — and the per-tick
+  ``pack()`` is pure vectorized numpy (sorts, bincounts, scatters) that
+  emits the exact same ``PackedCluster`` tensors as ``pack_cluster``.
+
+Parity contract: given the same cluster, ``pack()`` is **bit-identical**
+to ``pack_cluster`` over a ``build_node_map`` of the same state — same
+sort policies (spot most-requested-CPU-first, on-demand least-first,
+pods biggest-request-first, insertion-order ties; nodes/nodes.go:76-101),
+same evictability semantics (mirror/DaemonSet/terminal skipped, non-
+replicated or exhausted-PDB pods block the node; rescheduler.go:231-256),
+same taint interning order and scaled numerics. ``tests/test_columnar.py``
+pins this across randomized churn.
+
+Known model simplifications (safe direction): a pod's phase, requests,
+labels and tolerations are read once at ``add_pod`` — k8s pods are
+immutable in those fields for scheduling purposes (a phase change to
+Succeeded/Failed is followed by deletion, which removes the row). Node
+taints / readiness / schedulability ARE re-read every ``pack()`` because
+the actuator and the cloud mutate them mid-drain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    CPU,
+    NodeInfo,
+    NodeSpec,
+    PDBSpec,
+    PodSpec,
+)
+from k8s_spot_rescheduler_tpu.models.evictability import BlockingPod
+from k8s_spot_rescheduler_tpu.models.tensors import (
+    DEFAULT_MAX_PODS,
+    RESOURCE_SCALE,
+    PackedCluster,
+    _pad_dim,
+)
+from k8s_spot_rescheduler_tpu.predicates.masks import (
+    AFFINITY_WORDS,
+    HARD_EFFECTS,
+    Taint,
+    TaintTable,
+    pod_affinity_mask,
+    taint_mask,
+    toleration_mask,
+)
+from k8s_spot_rescheduler_tpu.predicates.masks import TO_BE_DELETED_TAINT
+from k8s_spot_rescheduler_tpu.utils.labels import matches_label
+
+# pod flag bits
+_MIRROR = 1
+_DAEMONSET = 2
+_TERMINAL = 4
+_REPLICATED = 8
+
+_ON_DEMAND, _SPOT, _OTHER = 0, 1, 2
+
+
+def _scale_requests(requests: Dict[str, int], resources: Sequence[str]) -> np.ndarray:
+    """Per-pod scaled request row — same asymmetric ceil rounding as
+    ``models/tensors.req_matrix`` (requests round *up*: a plan must never
+    pass on a rounding error)."""
+    out = np.empty(len(resources), np.float32)
+    for j, r in enumerate(resources):
+        if r == "pods":
+            out[j] = 1.0
+        else:
+            d = RESOURCE_SCALE.get(r, 1)
+            v = int(requests.get(r, 0))
+            out[j] = v if d == 1 else -(-v // d)
+    return out
+
+
+@dataclasses.dataclass
+class _Verdicts:
+    """One evictability pass over the columns (see ``_verdicts``)."""
+
+    nhi: int
+    hi: int
+    od_rows: np.ndarray
+    spot_rows: np.ndarray
+    safe_node: np.ndarray  # p_node with -1 clamped to 0 (for fancy indexing)
+    counted: np.ndarray  # bool [hi] — visible to the node model
+    blocks: np.ndarray  # bool [hi] — would abort its node's drain
+    evict: np.ndarray  # bool [hi] — must be re-placed to drain
+    nonrep: np.ndarray  # bool [hi] — blocking because non-replicated
+    pdb_names: Dict[int, str]  # row -> exhausted PDB name
+
+
+@dataclasses.dataclass
+class ColumnarMeta:
+    """Maps solver tensor indices back to cluster objects — the columnar
+    counterpart of ``models/tensors.PackMeta`` (same planner-facing
+    surface: ``n_candidates`` / ``blocking_pods`` / ``build_plan``)."""
+
+    store: "ColumnarStore"
+    cand_rows: np.ndarray  # i32 [C_actual] node rows, candidate order
+    spot_rows: np.ndarray  # i32 [S_actual] node rows, probe order
+    slot_rows: np.ndarray  # i32 pod rows, (candidate, slot) order
+    slot_starts: np.ndarray  # i32 [C_actual] offsets into slot_rows
+    slot_counts: np.ndarray  # i32 [C_actual]
+    blocking: List[Tuple[int, str]]  # (pod row, reason) per blocked candidate
+    resources: Tuple[str, ...]
+
+    @property
+    def n_candidates(self) -> int:
+        return len(self.cand_rows)
+
+    def blocking_pods(self) -> List[BlockingPod]:
+        return [
+            BlockingPod(self.store.pod_objs[row], reason)
+            for row, reason in self.blocking
+        ]
+
+    def candidate_pods(self, c: int) -> List[PodSpec]:
+        rows = self.slot_rows[
+            self.slot_starts[c] : self.slot_starts[c] + self.slot_counts[c]
+        ]
+        return [self.store.pod_objs[int(r)] for r in rows]
+
+    def build_plan(self, c: int, row: np.ndarray):
+        from k8s_spot_rescheduler_tpu.planner.base import DrainPlan
+
+        store = self.store
+        pods = self.candidate_pods(c)
+        assignments = {
+            pod.uid: store.node_objs[int(self.spot_rows[int(row[k])])].name
+            for k, pod in enumerate(pods)
+        }
+        node_row = int(self.cand_rows[c])
+        node = store.node_objs[node_row]
+        on_node = store.pods_on_node_sorted(node_row)
+        return DrainPlan(
+            node=NodeInfo.build(node, on_node),
+            pods=pods,
+            assignments=assignments,
+            candidate_index=c,
+        )
+
+
+@dataclasses.dataclass
+class ColumnarObservation:
+    """A tick-scoped view of a ``ColumnarStore`` carrying one precomputed
+    verdict pass, so metrics and planning share it instead of each paying
+    the evictability scan. Valid only while the cluster does not mutate —
+    i.e. within a single housekeeping tick."""
+
+    store: "ColumnarStore"
+    verdicts: Optional[_Verdicts] = None
+
+    def pack(self, pdbs: Sequence[PDBSpec] = (), **kwargs):
+        return self.store.pack(pdbs, verdicts=self.verdicts, **kwargs)
+
+
+class ColumnarStore:
+    """Struct-of-arrays cluster mirror with incremental updates.
+
+    Attach it to a state source (``FakeCluster.columnar_store`` or the
+    watch cache) which calls ``add_pod``/``remove_pod``/``add_node``/
+    ``remove_node`` as the cluster changes; call ``pack()`` once per tick.
+    """
+
+    def __init__(
+        self,
+        resources: Sequence[str],
+        *,
+        on_demand_label: str,
+        spot_label: str,
+    ):
+        self.resources = tuple(resources)
+        self.on_demand_label = on_demand_label
+        self.spot_label = spot_label
+        R = len(self.resources)
+
+        # --- pod columns ---
+        cap = 1024
+        self.p_req = np.zeros((cap, R), np.float32)
+        self.p_cpu = np.zeros(cap, np.int64)  # raw millicores (sort key)
+        self.p_node = np.full(cap, -1, np.int32)
+        self.p_prio = np.zeros(cap, np.int32)
+        self.p_flags = np.zeros(cap, np.uint8)
+        self.p_tol_id = np.zeros(cap, np.int32)
+        self.p_aff = np.zeros((cap, AFFINITY_WORDS), np.uint32)
+        self.p_seq = np.zeros(cap, np.int64)
+        self.p_live = np.zeros(cap, bool)
+        self.pod_objs: List[Optional[PodSpec]] = [None] * cap
+        self._pod_row: Dict[str, int] = {}  # uid -> row
+        self._pod_free: List[int] = list(range(cap - 1, -1, -1))
+        self._pod_hi = 0  # rows < hi may be live
+        self._seq = 0
+
+        # --- node columns ---
+        ncap = 256
+        self.n_alloc = np.zeros((ncap, R), np.float32)
+        self.n_max_pods = np.zeros(ncap, np.int32)
+        self.n_class = np.full(ncap, _OTHER, np.int8)
+        self.n_ready = np.zeros(ncap, bool)
+        self.n_unsched = np.zeros(ncap, bool)
+        self.n_seq = np.zeros(ncap, np.int64)
+        self.n_live = np.zeros(ncap, bool)
+        self.node_objs: List[Optional[NodeSpec]] = [None] * ncap
+        self._node_row: Dict[str, int] = {}
+        self._node_free: List[int] = list(range(ncap - 1, -1, -1))
+        self._node_hi = 0
+
+        # toleration interning: distinct toleration tuples -> small id;
+        # masks are recomputed only when the taint table changes.
+        self._tol_keys: Dict[tuple, int] = {}
+        self._tol_lists: List[tuple] = []
+        self._table_key: Optional[tuple] = None
+        self._tol_matrix = np.zeros((0, 1), np.uint32)  # [n_tol_ids, W]
+        self._node_mask_cache: Dict[tuple, np.ndarray] = {}
+
+        # label index for PDB selection: (ns, key, value) -> live pod rows
+        self._label_index: Dict[Tuple[str, str, str], Set[int]] = {}
+        self._ns_index: Dict[str, Set[int]] = {}
+
+    # ------------------------------------------------------------------
+    # growth helpers
+
+    def _grow_pods(self) -> None:
+        old = len(self.p_live)
+        new = old * 2
+        R = len(self.resources)
+        for name, shape, fill in (
+            ("p_req", (new, R), 0),
+            ("p_cpu", (new,), 0),
+            ("p_node", (new,), -1),
+            ("p_prio", (new,), 0),
+            ("p_flags", (new,), 0),
+            ("p_tol_id", (new,), 0),
+            ("p_aff", (new, AFFINITY_WORDS), 0),
+            ("p_seq", (new,), 0),
+            ("p_live", (new,), False),
+        ):
+            cur = getattr(self, name)
+            arr = np.full(shape, fill, dtype=cur.dtype)
+            arr[:old] = cur
+            setattr(self, name, arr)
+        self.pod_objs.extend([None] * (new - old))
+        self._pod_free.extend(range(new - 1, old - 1, -1))
+
+    def _grow_nodes(self) -> None:
+        old = len(self.n_live)
+        new = old * 2
+        R = len(self.resources)
+        for name, shape, fill in (
+            ("n_alloc", (new, R), 0),
+            ("n_max_pods", (new,), 0),
+            ("n_class", (new,), _OTHER),
+            ("n_ready", (new,), False),
+            ("n_unsched", (new,), False),
+            ("n_seq", (new,), 0),
+            ("n_live", (new,), False),
+        ):
+            cur = getattr(self, name)
+            arr = np.full(shape, fill, dtype=cur.dtype)
+            arr[:old] = cur
+            setattr(self, name, arr)
+        self.node_objs.extend([None] * (new - old))
+        self._node_free.extend(range(new - 1, old - 1, -1))
+
+    # ------------------------------------------------------------------
+    # incremental updates (the ingestion surface)
+
+    def add_node(self, node: NodeSpec) -> None:
+        if node.name in self._node_row:
+            self.update_node(node)
+            return
+        if not self._node_free:
+            self._grow_nodes()
+        r = self._node_free.pop()
+        self._node_row[node.name] = r
+        self._node_hi = max(self._node_hi, r + 1)
+        self.node_objs[r] = node
+        R = len(self.resources)
+        alloc = np.empty(R, np.float32)
+        for j, res in enumerate(self.resources):
+            default = DEFAULT_MAX_PODS if res == "pods" else 0
+            alloc[j] = int(node.allocatable.get(res, default)) // RESOURCE_SCALE.get(res, 1)
+        self.n_alloc[r] = alloc
+        self.n_max_pods[r] = int(node.allocatable.get("pods", DEFAULT_MAX_PODS))
+        # spot-before-on-demand classification precedence (nodes/nodes.go:82-92)
+        if matches_label(node.labels, self.spot_label):
+            self.n_class[r] = _SPOT
+        elif matches_label(node.labels, self.on_demand_label):
+            self.n_class[r] = _ON_DEMAND
+        else:
+            self.n_class[r] = _OTHER
+        self.n_ready[r] = node.ready
+        self.n_unsched[r] = node.unschedulable
+        self._seq += 1
+        self.n_seq[r] = self._seq
+        self.n_live[r] = True
+
+    def update_node(self, node: NodeSpec) -> None:
+        """Re-read a node's mutable fields (labels/allocatable changes are
+        rare but legal; readiness/taints are also re-read per pack())."""
+        r = self._node_row.get(node.name)
+        if r is None:
+            self.add_node(node)
+            return
+        seq = self.n_seq[r]
+        self.node_objs[r] = node
+        self.n_live[r] = False
+        self._node_row.pop(node.name)
+        self._node_free.append(r)
+        self.add_node(node)
+        self.n_seq[self._node_row[node.name]] = seq  # keep original order
+
+    def remove_node(self, name: str) -> None:
+        r = self._node_row.pop(name, None)
+        if r is None:
+            return
+        # Pods still referencing this row go with it (a watch can deliver
+        # the node delete before its pods' deletes) — otherwise row reuse
+        # by a future add_node would silently reattach them to the new node.
+        hi = self._pod_hi
+        stale = np.nonzero(self.p_live[:hi] & (self.p_node[:hi] == r))[0]
+        for row in stale:
+            pod = self.pod_objs[int(row)]
+            if pod is not None:
+                self.remove_pod(pod.uid)
+        self.n_live[r] = False
+        self.node_objs[r] = None
+        self._node_free.append(r)
+
+    def add_pod(self, pod: PodSpec) -> None:
+        keep_seq = None
+        old_row = self._pod_row.get(pod.uid)
+        if old_row is not None:
+            old_pod = self.pod_objs[old_row]
+            if old_pod is not None and old_pod.node_name == pod.node_name:
+                # same-node upsert (a watch MODIFIED event): the object
+                # path's dict update keeps the pod's position, so keep its
+                # sequence too — slot ties must not reorder (parity).
+                keep_seq = int(self.p_seq[old_row])
+            self.remove_pod(pod.uid)
+        node_row = self._node_row.get(pod.node_name)
+        if node_row is None:
+            return  # pod on an unknown/removed node is invisible
+        if not self._pod_free:
+            self._grow_pods()
+        r = self._pod_free.pop()
+        self._pod_row[pod.uid] = r
+        self._pod_hi = max(self._pod_hi, r + 1)
+        self.pod_objs[r] = pod
+        self.p_req[r] = _scale_requests(pod.requests, self.resources)
+        self.p_cpu[r] = int(pod.requests.get(CPU, 0))
+        self.p_node[r] = node_row
+        self.p_prio[r] = pod.priority
+        flags = 0
+        if pod.is_mirror():
+            flags |= _MIRROR
+        if pod.phase in ("Succeeded", "Failed"):
+            flags |= _TERMINAL
+        ref = pod.controller_ref()
+        if ref is not None:
+            flags |= _REPLICATED
+            if ref.kind == "DaemonSet":
+                flags |= _DAEMONSET
+        self.p_flags[r] = flags
+        key = tuple(pod.tolerations)
+        tid = self._tol_keys.get(key)
+        if tid is None:
+            tid = self._tol_keys[key] = len(self._tol_lists)
+            self._tol_lists.append(key)
+            self._table_key = None  # force toleration matrix rebuild
+        self.p_tol_id[r] = tid
+        self.p_aff[r] = pod_affinity_mask(pod)
+        if keep_seq is not None:
+            self.p_seq[r] = keep_seq
+        else:
+            self._seq += 1
+            self.p_seq[r] = self._seq
+        self.p_live[r] = True
+        # PDB label index
+        self._ns_index.setdefault(pod.namespace, set()).add(r)
+        for k, v in pod.labels.items():
+            self._label_index.setdefault((pod.namespace, k, v), set()).add(r)
+
+    def remove_pod(self, uid: str) -> None:
+        r = self._pod_row.pop(uid, None)
+        if r is None:
+            return
+        pod = self.pod_objs[r]
+        self.p_live[r] = False
+        self.pod_objs[r] = None
+        self._pod_free.append(r)
+        if pod is not None:
+            ns = self._ns_index.get(pod.namespace)
+            if ns is not None:
+                ns.discard(r)
+            for k, v in pod.labels.items():
+                rows = self._label_index.get((pod.namespace, k, v))
+                if rows is not None:
+                    rows.discard(r)
+
+    # ------------------------------------------------------------------
+    # snapshot-time helpers
+
+    def _refresh_nodes(self) -> None:
+        """Re-read the per-node mutable scalars (ready/unschedulable) the
+        actuator and cloud flip mid-operation. O(nodes) attribute reads."""
+        hi = self._node_hi
+        for r in range(hi):
+            obj = self.node_objs[r]
+            if obj is not None:
+                self.n_ready[r] = obj.ready
+                self.n_unsched[r] = obj.unschedulable
+
+    def _build_taint_table(self, spot_order: np.ndarray) -> TaintTable:
+        """Intern hard taints over ready spot nodes in probe order —
+        identical bit layout to ``masks.intern_taints`` over the sorted
+        ``node_map.spot`` (which is how the object path builds it)."""
+        seen: dict = {}
+        for r in spot_order:
+            for t in self.node_objs[int(r)].taints:
+                if t.effect in HARD_EFFECTS and t not in seen:
+                    seen[t] = len(seen)
+        drain = Taint(TO_BE_DELETED_TAINT, "", "NoSchedule")
+        if drain not in seen:
+            seen[drain] = len(seen)
+        taints = list(seen)
+        words = max(1, -(-len(taints) // 32))
+        return TaintTable(taints=taints, words=words)
+
+    def _toleration_matrix(self, table: TaintTable) -> np.ndarray:
+        key = tuple(table.taints)
+        if self._table_key != key or self._tol_matrix.shape[0] != len(self._tol_lists):
+            self._table_key = key
+            self._node_mask_cache.clear()
+            self._tol_matrix = np.stack(
+                [toleration_mask(tols, table) for tols in self._tol_lists]
+            ) if self._tol_lists else np.zeros((0, table.words), np.uint32)
+        return self._tol_matrix
+
+    def _node_taint_mask(self, row: int, table: TaintTable) -> np.ndarray:
+        taints = tuple(
+            t for t in self.node_objs[row].taints if t.effect in HARD_EFFECTS
+        )
+        cached = self._node_mask_cache.get(taints)
+        if cached is None:
+            cached = self._node_mask_cache[taints] = taint_mask(taints, table)
+        return cached
+
+    def pods_on_node_sorted(self, node_row: int) -> List[PodSpec]:
+        """All live pods on a node, biggest-CPU-request-first (insertion-
+        order ties) — materialized only for the one node being drained."""
+        hi = self._pod_hi
+        rows = np.nonzero(self.p_live[:hi] & (self.p_node[:hi] == node_row))[0]
+        order = np.lexsort((self.p_seq[rows], -self.p_cpu[rows]))
+        return [self.pod_objs[int(r)] for r in rows[order]]
+
+    def _pdb_blocked(
+        self, pdbs: Sequence[PDBSpec]
+    ) -> Tuple[np.ndarray, Dict[int, str]]:
+        """Rows blocked by an exhausted PDB + the blocking PDB's name.
+        First matching PDB in list order wins, like the object path."""
+        hi = self._pod_hi
+        blocked = np.zeros(hi, bool)
+        names: Dict[int, str] = {}
+        for pdb in pdbs:
+            if pdb.disruptions_allowed >= 1:
+                continue
+            if pdb.match_labels:
+                sets = [
+                    self._label_index.get((pdb.namespace, k, v), set())
+                    for k, v in pdb.match_labels.items()
+                ]
+                rows = set.intersection(*sorted(sets, key=len)) if all(sets) else set()
+            else:
+                rows = self._ns_index.get(pdb.namespace, set())
+            for r in rows:
+                if r < hi and not blocked[r]:
+                    blocked[r] = True
+                    names[r] = pdb.name
+        return blocked, names
+
+    # ------------------------------------------------------------------
+    # the shared pod-verdict pipeline (pack + metrics)
+
+    def _verdicts(
+        self,
+        pdbs: Sequence[PDBSpec],
+        *,
+        priority_threshold: int,
+        delete_non_replicated: bool,
+    ) -> "_Verdicts":
+        """One vectorized evictability pass over the live columns — the
+        single source of truth for both ``pack()`` and
+        ``node_pod_counts()`` (models/evictability.py semantics)."""
+        self._refresh_nodes()
+        nhi, hi = self._node_hi, self._pod_hi
+
+        # node classification; the controller only ever sees ready nodes
+        # (NewReadyNodeLister, reference rescheduler.go:154,186)
+        n_live = self.n_live[:nhi] & self.n_ready[:nhi]
+        od_rows = np.nonzero(n_live & (self.n_class[:nhi] == _ON_DEMAND))[0]
+        spot_rows = np.nonzero(n_live & (self.n_class[:nhi] == _SPOT))[0]
+
+        # counted pods: live, on a live listed node; low-priority pods are
+        # ignored on spot nodes only (nodes/nodes.go:137-141)
+        p_node = self.p_node[:hi]
+        node_listed = np.zeros(nhi, bool)
+        node_listed[od_rows] = True
+        node_listed[spot_rows] = True
+        safe_node = np.where(p_node >= 0, p_node, 0)
+        p_ok = self.p_live[:hi] & (p_node >= 0) & node_listed[safe_node]
+        node_is_spot = np.zeros(nhi, bool)
+        node_is_spot[spot_rows] = True
+        counted = p_ok & ~(
+            node_is_spot[safe_node] & (self.p_prio[:hi] < priority_threshold)
+        )
+
+        flags = self.p_flags[:hi]
+        skip = (flags & (_MIRROR | _TERMINAL | _DAEMONSET)) != 0
+        pdb_blocked, pdb_names = self._pdb_blocked(pdbs)
+        nonrep = (flags & _REPLICATED) == 0
+        if delete_non_replicated:
+            nonrep = np.zeros(hi, bool)
+        blocks = counted & ~skip & (nonrep | pdb_blocked)
+        evict = counted & ~skip & ~blocks
+        return _Verdicts(
+            nhi=nhi, hi=hi, od_rows=od_rows, spot_rows=spot_rows,
+            safe_node=safe_node, counted=counted, blocks=blocks,
+            evict=evict, nonrep=nonrep, pdb_names=pdb_names,
+        )
+
+    def verdicts(
+        self,
+        pdbs: Sequence[PDBSpec] = (),
+        *,
+        priority_threshold: int = 0,
+        delete_non_replicated: bool = False,
+    ) -> "_Verdicts":
+        """Public handle on the verdict pass for tick-scoped sharing
+        (see ``ColumnarObservation``)."""
+        return self._verdicts(
+            pdbs,
+            priority_threshold=priority_threshold,
+            delete_non_replicated=delete_non_replicated,
+        )
+
+    # ------------------------------------------------------------------
+    # the per-tick pack
+
+    def pack(
+        self,
+        pdbs: Sequence[PDBSpec] = (),
+        *,
+        priority_threshold: int = 0,
+        delete_non_replicated: bool = False,
+        pad_candidates: int = 0,
+        pad_spot: int = 0,
+        pad_slots: int = 0,
+        verdicts: Optional[_Verdicts] = None,
+    ) -> Tuple[PackedCluster, ColumnarMeta]:
+        """Vectorized observe+pack: emits the same ``PackedCluster`` the
+        object path does (build_node_map → pack_cluster), in one pass of
+        numpy ops over the live columns.
+
+        ``verdicts`` may carry a pass precomputed *from the same state and
+        parameters* (the controller computes one per tick and shares it
+        between metrics and planning); it is trusted, not re-validated.
+        """
+        v = verdicts if verdicts is not None else self._verdicts(
+            pdbs,
+            priority_threshold=priority_threshold,
+            delete_non_replicated=delete_non_replicated,
+        )
+        nhi, hi = v.nhi, v.hi
+        od_rows, spot_rows = v.od_rows, v.spot_rows
+        p_node = self.p_node[:hi]
+        safe_node, counted = v.safe_node, v.counted
+        R = len(self.resources)
+
+        # per-node requested CPU -> sort orders (nodes/nodes.go:95-101)
+        req_cpu = np.bincount(
+            p_node[counted], weights=self.p_cpu[:hi][counted].astype(np.float64),
+            minlength=nhi,
+        )
+        od_order = od_rows[
+            np.lexsort((self.n_seq[od_rows], req_cpu[od_rows]))
+        ]  # least-requested first
+        spot_order = spot_rows[
+            np.lexsort((self.n_seq[spot_rows], -req_cpu[spot_rows]))
+        ]  # most-requested first
+
+        table = self._build_taint_table(spot_order)
+        tol_matrix = self._toleration_matrix(table)
+        W = table.words
+        blocks, evict, nonrep = v.blocks, v.evict, v.nonrep
+        pdb_names = v.pdb_names
+
+        # per-candidate verdicts
+        cand_rank = np.full(nhi, -1, np.int32)
+        cand_rank[od_order] = np.arange(len(od_order), dtype=np.int32)
+        C_actual = len(od_order)
+        n_evict = np.bincount(
+            cand_rank[p_node[evict & (cand_rank[safe_node] >= 0)]],
+            minlength=C_actual,
+        ) if C_actual else np.zeros(0, np.int64)
+        block_rows = np.nonzero(blocks & (cand_rank[safe_node] >= 0))[0]
+        has_block = np.zeros(C_actual, bool)
+        has_block[cand_rank[p_node[block_rows]]] = True
+
+        # blocking-pod report: per blocked candidate, the first blocker in
+        # slot order (cpu desc, seq ties) — rescheduler.go:232-238
+        blocking: List[Tuple[int, str]] = []
+        if len(block_rows):
+            order = np.lexsort(
+                (self.p_seq[block_rows], -self.p_cpu[block_rows],
+                 cand_rank[p_node[block_rows]])
+            )
+            seen_cand: Set[int] = set()
+            for r in block_rows[order]:
+                c = int(cand_rank[p_node[r]])
+                if c not in seen_cand:
+                    seen_cand.add(c)
+                    reason = (
+                        "pod is not replicated" if nonrep[r]
+                        else f"not enough pod disruption budget ({pdb_names[int(r)]})"
+                    )
+                    blocking.append((int(r), reason))
+
+        # slot packing: evictable pods of non-blocked candidates, ordered
+        # (candidate, cpu desc, insertion) — nodes/nodes.go:76-80
+        cand_ok = ~has_block
+        pod_cand = cand_rank[safe_node]
+        packable = evict & (pod_cand >= 0)
+        if C_actual:
+            packable &= cand_ok[np.where(pod_cand >= 0, pod_cand, 0)]
+        slot_rows_u = np.nonzero(packable)[0]
+        order = np.lexsort(
+            (self.p_seq[slot_rows_u], -self.p_cpu[slot_rows_u],
+             pod_cand[slot_rows_u])
+        )
+        slot_rows = slot_rows_u[order].astype(np.int32)
+        slot_cand = pod_cand[slot_rows]
+        slot_counts = np.bincount(slot_cand, minlength=C_actual).astype(np.int32)
+        slot_starts = np.concatenate(
+            ([0], np.cumsum(slot_counts[:-1]))
+        ).astype(np.int32) if C_actual else np.zeros(0, np.int32)
+        slot_idx = (
+            np.arange(len(slot_rows), dtype=np.int32) - slot_starts[slot_cand]
+        ) if len(slot_rows) else np.zeros(0, np.int32)
+
+        # static shapes (same padding policy as pack_cluster)
+        C = max(_pad_dim(C_actual), _pad_dim(pad_candidates))
+        S = max(_pad_dim(len(spot_order)), _pad_dim(pad_spot))
+        K = max(
+            _pad_dim(int(slot_counts.max()) if len(slot_counts) else 1),
+            _pad_dim(pad_slots),
+        )
+
+        packed = PackedCluster(
+            slot_req=np.zeros((C, K, R), np.float32),
+            slot_valid=np.zeros((C, K), bool),
+            slot_tol=np.zeros((C, K, W), np.uint32),
+            slot_aff=np.zeros((C, K, AFFINITY_WORDS), np.uint32),
+            cand_valid=np.zeros((C,), bool),
+            spot_free=np.zeros((S, R), np.float32),
+            spot_count=np.zeros((S,), np.int32),
+            spot_max_pods=np.zeros((S,), np.int32),
+            spot_taints=np.zeros((S, W), np.uint32),
+            spot_ok=np.zeros((S,), bool),
+            spot_aff=np.zeros((S, AFFINITY_WORDS), np.uint32),
+        )
+
+        if len(slot_rows):
+            packed.slot_req[slot_cand, slot_idx] = self.p_req[slot_rows]
+            packed.slot_valid[slot_cand, slot_idx] = True
+            packed.slot_tol[slot_cand, slot_idx] = tol_matrix[
+                self.p_tol_id[slot_rows]
+            ]
+            packed.slot_aff[slot_cand, slot_idx] = self.p_aff[slot_rows]
+        if C_actual:
+            packed.cand_valid[:C_actual] = cand_ok & (n_evict > 0)
+
+        S_actual = len(spot_order)
+        if S_actual:
+            # spot pool accounting over counted pods (used = sum of scaled
+            # request rows; exact in f32 — values bounded by allocatable)
+            spot_rank = np.full(nhi, -1, np.int32)
+            spot_rank[spot_order] = np.arange(S_actual, dtype=np.int32)
+            sp_rows = np.nonzero(counted & (spot_rank[safe_node] >= 0))[0]
+            sp = spot_rank[p_node[sp_rows]]
+            used = np.zeros((S_actual, R), np.float64)
+            for j in range(R):
+                used[:, j] = np.bincount(
+                    sp, weights=self.p_req[sp_rows, j].astype(np.float64),
+                    minlength=S_actual,
+                )
+            packed.spot_free[:S_actual] = (
+                self.n_alloc[spot_order] - used.astype(np.float32)
+            )
+            packed.spot_count[:S_actual] = np.bincount(
+                sp, minlength=S_actual
+            ).astype(np.int32)
+            packed.spot_max_pods[:S_actual] = self.n_max_pods[spot_order]
+            packed.spot_ok[:S_actual] = ~self.n_unsched[spot_order]
+            for i, r in enumerate(spot_order):
+                packed.spot_taints[i] = self._node_taint_mask(int(r), table)
+            aff = np.zeros((S_actual, AFFINITY_WORDS), np.uint32)
+            np.bitwise_or.at(aff, sp, self.p_aff[sp_rows])
+            packed.spot_aff[:S_actual] = aff
+
+        meta = ColumnarMeta(
+            store=self,
+            cand_rows=od_order.astype(np.int32),
+            spot_rows=spot_order.astype(np.int32),
+            slot_rows=slot_rows,
+            slot_starts=slot_starts,
+            slot_counts=slot_counts,
+            blocking=blocking,
+            resources=self.resources,
+        )
+        return packed, meta
+
+    # ------------------------------------------------------------------
+    # metrics support (vectorized _update_metrics inputs)
+
+    def node_pod_counts(
+        self,
+        pdbs: Sequence[PDBSpec] = (),
+        *,
+        priority_threshold: int = 0,
+        delete_non_replicated: bool = False,
+        verdicts: Optional[_Verdicts] = None,
+    ) -> Tuple[List[Tuple[str, int]], List[Tuple[str, int]]]:
+        """(on_demand, spot) lists of (node name, pods-the-rescheduler-
+        understands) — what the reference recomputes per node via the drain
+        filter (rescheduler.go:259, 385-399). A blocked node reports 0."""
+        v = verdicts if verdicts is not None else self._verdicts(
+            pdbs,
+            priority_threshold=priority_threshold,
+            delete_non_replicated=delete_non_replicated,
+        )
+        p_node = self.p_node[: v.hi]
+        n_evict = np.bincount(p_node[v.evict], minlength=v.nhi)
+        blocked_nodes = np.zeros(v.nhi, bool)
+        blocked_nodes[p_node[v.blocks]] = True
+        out_od = [
+            (
+                self.node_objs[int(r)].name,
+                0 if blocked_nodes[r] else int(n_evict[r]),
+            )
+            for r in v.od_rows
+        ]
+        out_spot = [
+            (
+                self.node_objs[int(r)].name,
+                0 if blocked_nodes[r] else int(n_evict[r]),
+            )
+            for r in v.spot_rows
+        ]
+        return out_od, out_spot
+
+    # convenience for tests / debugging
+    @property
+    def n_pods(self) -> int:
+        return len(self._pod_row)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self._node_row)

@@ -104,7 +104,11 @@ class PackedCluster(NamedTuple):
 
 @dataclasses.dataclass
 class PackMeta:
-    """Host-side mapping from tensor indices back to cluster objects."""
+    """Host-side mapping from tensor indices back to cluster objects.
+
+    Shares a planner-facing surface (``n_candidates`` / ``blocking_pods``
+    / ``build_plan``) with ``models/columnar.ColumnarMeta``.
+    """
 
     candidates: List[NodeInfo]  # index = candidate lane (unpadded prefix)
     cand_pods: List[List[PodSpec]]  # per lane, slot order
@@ -112,6 +116,28 @@ class PackMeta:
     spot: List[NodeInfo]  # index = spot lane (unpadded prefix)
     taint_table: TaintTable
     resources: Sequence[str]
+
+    @property
+    def n_candidates(self) -> int:
+        return len(self.candidates)
+
+    def blocking_pods(self) -> List[BlockingPod]:
+        return [b for b in self.blocking if b is not None]
+
+    def build_plan(self, c: int, row: np.ndarray):
+        from k8s_spot_rescheduler_tpu.planner.base import DrainPlan
+
+        pods = self.cand_pods[c]
+        assignments = {
+            pod.uid: self.spot[int(row[k])].node.name
+            for k, pod in enumerate(pods)
+        }
+        return DrainPlan(
+            node=self.candidates[c],
+            pods=list(pods),
+            assignments=assignments,
+            candidate_index=c,
+        )
 
 
 def scale_allocatable(alloc: Dict[str, int], resources: Sequence[str]) -> np.ndarray:

@@ -17,13 +17,13 @@ the jitted solver does not recompile every tick as the cluster breathes.
 from __future__ import annotations
 
 import time
-from typing import Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 
-from k8s_spot_rescheduler_tpu.models.cluster import NodeMap, PDBSpec
-from k8s_spot_rescheduler_tpu.models.tensors import PackMeta, pack_cluster
-from k8s_spot_rescheduler_tpu.planner.base import DrainPlan, PlanReport
+from k8s_spot_rescheduler_tpu.models.cluster import PDBSpec
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.planner.base import PlanReport
 from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
@@ -87,33 +87,49 @@ class SolverPlanner:
             ) from err
         raise ValueError(f"unknown solver {name!r}")
 
-    def plan(self, node_map: NodeMap, pdbs: Sequence[PDBSpec]) -> PlanReport:
+    # SolverPlanner can plan straight from a ColumnarStore snapshot (the
+    # vectorized observe path); the control loop checks this before
+    # handing it one instead of a NodeMap.
+    accepts_columnar = True
+
+    def plan(self, observation, pdbs: Sequence[PDBSpec]) -> PlanReport:
+        """``observation`` is either a classified ``NodeMap`` (object
+        path, reference-faithful) or a ``models/columnar.ColumnarStore``
+        (vectorized fast path); both pack to the same tensors."""
         t0 = time.perf_counter()
-        packed, meta = pack_cluster(
-            node_map,
-            pdbs,
-            resources=self.config.resources,
-            delete_non_replicated=self.config.delete_non_replicated_pods,
-            pad_candidates=self._pad_c,
-            pad_spot=self._pad_s,
-            pad_slots=self._pad_k,
-        )
+        cfg = self.config
+        if hasattr(observation, "pack"):  # ColumnarStore
+            packed, meta = observation.pack(
+                pdbs,
+                priority_threshold=cfg.priority_threshold,
+                delete_non_replicated=cfg.delete_non_replicated_pods,
+                pad_candidates=self._pad_c,
+                pad_spot=self._pad_s,
+                pad_slots=self._pad_k,
+            )
+        else:
+            packed, meta = pack_cluster(
+                observation,
+                pdbs,
+                resources=cfg.resources,
+                delete_non_replicated=cfg.delete_non_replicated_pods,
+                pad_candidates=self._pad_c,
+                pad_spot=self._pad_s,
+                pad_slots=self._pad_k,
+            )
         # high-water-mark padding: shapes only ever grow → no recompile churn
         self._pad_c = max(self._pad_c, packed.slot_req.shape[0])
         self._pad_k = max(self._pad_k, packed.slot_req.shape[1])
         self._pad_s = max(self._pad_s, packed.spot_free.shape[0])
 
-        for blocked in meta.blocking:
-            if blocked is not None:
-                log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)
+        for blocked in meta.blocking_pods():
+            log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)
 
         if self._fused is not None:
             from k8s_spot_rescheduler_tpu.solver.select import decode_selection
 
             sel = decode_selection(self._fused(packed))
-            plan = (
-                self._build_plan(meta, sel.index, sel.row) if sel.found else None
-            )
+            plan = meta.build_plan(sel.index, sel.row) if sel.found else None
             n_feasible = sel.n_feasible
         else:
             result = self._solve_host(packed)
@@ -132,29 +148,14 @@ class SolverPlanner:
             plan = None
             if n_feasible:
                 c = int(np.argmax(feasible))
-                plan = self._build_plan(meta, c, np.asarray(result.assignment[c]))
+                plan = meta.build_plan(c, np.asarray(result.assignment[c]))
 
         report = PlanReport(
             plan=plan,
-            n_candidates=len(meta.candidates),
+            n_candidates=meta.n_candidates,
             n_feasible=n_feasible,
             solve_seconds=time.perf_counter() - t0,
             solver=self.config.solver,
             feasible_candidates=[plan] if plan else [],
         )
         return report
-
-    def _build_plan(
-        self, meta: PackMeta, c: int, row: np.ndarray
-    ) -> Optional[DrainPlan]:
-        pods = meta.cand_pods[c]
-        assignments = {
-            pod.uid: meta.spot[int(row[k])].node.name
-            for k, pod in enumerate(pods)
-        }
-        return DrainPlan(
-            node=meta.candidates[c],
-            pods=list(pods),
-            assignments=assignments,
-            candidate_index=c,
-        )

@@ -77,22 +77,32 @@ def intern_taints(nodes: Sequence[NodeSpec]) -> TaintTable:
     return TaintTable(taints=taints, words=words)
 
 
-def node_taint_mask(node: NodeSpec, table: TaintTable) -> np.ndarray:
+def taint_mask(taints: Sequence[Taint], table: TaintTable) -> np.ndarray:
+    """Bitmask of the hard taints present in ``taints``."""
     mask = np.zeros(table.words, dtype=np.uint32)
-    for taint in node.taints:
+    for taint in taints:
         if taint.effect in HARD_EFFECTS:
             i = table.index(taint)
             mask[i // 32] |= np.uint32(1 << (i % 32))
     return mask
 
 
-def pod_toleration_mask(pod: PodSpec, table: TaintTable) -> np.ndarray:
-    """Bit t set iff the pod tolerates interned taint t."""
+def node_taint_mask(node: NodeSpec, table: TaintTable) -> np.ndarray:
+    return taint_mask(node.taints, table)
+
+
+def toleration_mask(tolerations: Sequence, table: TaintTable) -> np.ndarray:
+    """Bit t set iff ``tolerations`` tolerate interned taint t."""
     mask = np.zeros(table.words, dtype=np.uint32)
     for i, taint in enumerate(table.taints):
-        if any(tol.tolerates(taint) for tol in pod.tolerations):
+        if any(tol.tolerates(taint) for tol in tolerations):
             mask[i // 32] |= np.uint32(1 << (i % 32))
     return mask
+
+
+def pod_toleration_mask(pod: PodSpec, table: TaintTable) -> np.ndarray:
+    """Bit t set iff the pod tolerates interned taint t."""
+    return toleration_mask(pod.tolerations, table)
 
 
 def affinity_bits(group: str) -> Tuple[int, int]:

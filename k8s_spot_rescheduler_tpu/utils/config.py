@@ -79,6 +79,11 @@ class ReschedulerConfig:
     mesh_shape: tuple = (1, 1)
     max_drains_per_tick: int = 1
     fallback_best_fit: bool = True
+    # Observe via the incrementally-maintained columnar mirror
+    # (models/columnar.py) when the cluster client provides one — the
+    # vectorized replacement for the per-tick object-model rebuild. Off →
+    # always the reference-faithful object path.
+    use_columnar: bool = True
 
     def __post_init__(self):
         from k8s_spot_rescheduler_tpu.utils.labels import validate_label
