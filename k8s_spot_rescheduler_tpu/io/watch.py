@@ -48,25 +48,44 @@ RECONNECT_BACKOFF_MAX = 30.0
 
 
 class ResourceStore:
-    """Thread-safe keyed store for one resource type, fed by a watcher."""
+    """Thread-safe keyed store for one resource type, fed by a watcher.
+
+    An optional listener (``subscribe``) observes every mutation under the
+    store lock — the hook the columnar delta feed rides on. The listener
+    must be cheap and non-blocking (it appends to a deque).
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._items: Dict[str, object] = {}
         self.synced = threading.Event()
+        self._listener = None  # callable(action, key, obj) under lock
+
+    def subscribe(self, listener) -> List[object]:
+        """Install the mutation listener and return the current items —
+        atomically, so the subscriber misses no event and sees none twice."""
+        with self._lock:
+            self._listener = listener
+            return list(self._items.values())
 
     def replace(self, items: Dict[str, object]) -> None:
         with self._lock:
             self._items = dict(items)
+            if self._listener is not None:
+                self._listener("replace", "", list(items.values()))
         self.synced.set()
 
     def upsert(self, key: str, obj: object) -> None:
         with self._lock:
             self._items[key] = obj
+            if self._listener is not None:
+                self._listener("upsert", key, obj)
 
     def delete(self, key: str) -> None:
         with self._lock:
-            self._items.pop(key, None)
+            old = self._items.pop(key, None)
+            if old is not None and self._listener is not None:
+                self._listener("delete", key, old)
 
     def snapshot(self) -> List[object]:
         with self._lock:
@@ -190,6 +209,64 @@ class Watcher(threading.Thread):
                 backoff = min(backoff * 2, RECONNECT_BACKOFF_MAX)
 
 
+class ColumnarFeed:
+    """Bridges the watch caches into a ``models/columnar.ColumnarStore``.
+
+    Watcher threads enqueue deltas (under the store lock, via
+    ``ResourceStore.subscribe``); the control-loop thread drains the queue
+    once per tick (``sync``) and applies it to the columnar arrays — so
+    the numpy state is only ever touched from one thread, and a tick sees
+    a frozen point-in-time cluster, exactly like the object snapshot.
+
+    A watcher re-list (410 Gone recovery) arrives as one ``replace`` delta
+    and is reconciled by key diff: vanished objects are removed, everything
+    present is upserted (same-node pod upserts keep their slot order).
+    """
+
+    def __init__(self, store, nodes: ResourceStore, pods: ResourceStore):
+        import collections
+
+        self.store = store
+        self._deltas = collections.deque()  # (kind, action, obj)
+        # subscribe atomically: the returned seed lists are exactly the
+        # state before any queued delta (no missed or doubled events)
+        for obj in nodes.subscribe(
+            lambda a, k, o: self._deltas.append(("node", a, o))
+        ):
+            self._apply("node", "upsert", obj)
+        for obj in pods.subscribe(
+            lambda a, k, o: self._deltas.append(("pod", a, o))
+        ):
+            self._apply("pod", "upsert", obj)
+
+    def _apply(self, kind: str, action: str, obj) -> None:
+        store = self.store
+        if kind == "pod":
+            if action == "upsert":
+                store.add_pod(obj)
+            elif action == "delete":
+                store.remove_pod(obj.uid)
+            else:  # replace (re-list after 410 Gone)
+                store.reconcile_pods(obj)
+        else:
+            if action == "upsert":
+                store.add_node(obj)
+            elif action == "delete":
+                store.remove_node(obj.name)
+            else:  # replace
+                store.reconcile_nodes(obj)
+
+    def sync(self) -> int:
+        """Drain queued deltas into the columnar store (tick thread only).
+        Returns the number of deltas applied."""
+        n = 0
+        while self._deltas:
+            kind, action, obj = self._deltas.popleft()
+            self._apply(kind, action, obj)
+            n += 1
+        return n
+
+
 class WatchingKubeClusterClient:
     """ClusterClient served from watch caches; writes pass through.
 
@@ -218,6 +295,41 @@ class WatchingKubeClusterClient:
         self._tick_nodes: List[NodeSpec] = []
         self._tick_pdbs: List[PDBSpec] = []
         self._have_tick_view = False
+        self._feed = None  # lazily attached ColumnarFeed
+
+    # --- columnar fast path ---
+
+    def columnar_store(
+        self, resources, *, on_demand_label: str, spot_label: str
+    ):
+        """The incrementally-maintained columnar mirror, fed by the watch
+        streams (SURVEY.md §5.8 "watch → numpy buffers"). Each call syncs
+        queued watch deltas into the arrays — call it once per tick, from
+        the control-loop thread."""
+        from k8s_spot_rescheduler_tpu.models.columnar import ColumnarStore
+
+        feed = self._feed
+        if (
+            feed is None
+            or feed.store.resources != tuple(resources)
+            or feed.store.on_demand_label != on_demand_label
+            or feed.store.spot_label != spot_label
+        ):
+            store = ColumnarStore(
+                resources,
+                on_demand_label=on_demand_label,
+                spot_label=spot_label,
+            )
+            feed = self._feed = ColumnarFeed(store, self.nodes, self.pods)
+            # the seed read the live stores, which may be newer than the
+            # tick's frozen object view — re-freeze so PDBs and the gate
+            # view line up with the columnar state (one consistent instant)
+            self._freeze()
+        else:
+            # columnar deltas are drained inside _freeze(), so the mirror
+            # is exactly as old as the tick's frozen object/PDB view
+            self._view()
+        return feed.store
 
     @staticmethod
     def _meta_key(obj: dict) -> str:
@@ -254,6 +366,10 @@ class WatchingKubeClusterClient:
         self._have_tick_view = False
 
     def _freeze(self) -> None:
+        # the columnar mirror freezes at the same instant as the object
+        # view and the PDB list: one consistent per-tick cluster state
+        if self._feed is not None:
+            self._feed.sync()
         by_node: Dict[str, List[PodSpec]] = {}
         for pod in self.pods.snapshot():
             by_node.setdefault(pod.node_name, []).append(pod)

@@ -59,13 +59,12 @@ from k8s_spot_rescheduler_tpu.models.tensors import (
 from k8s_spot_rescheduler_tpu.predicates.masks import (
     AFFINITY_WORDS,
     HARD_EFFECTS,
-    Taint,
     TaintTable,
+    intern_taints,
     pod_affinity_mask,
     taint_mask,
     toleration_mask,
 )
-from k8s_spot_rescheduler_tpu.predicates.masks import TO_BE_DELETED_TAINT
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
 # pod flag bits
@@ -236,6 +235,10 @@ class ColumnarStore:
         self._label_index: Dict[Tuple[str, str, str], Set[int]] = {}
         self._ns_index: Dict[str, Set[int]] = {}
 
+        # pods whose node hasn't been observed yet (a watch can deliver a
+        # pod ADDED before its node ADDED); flushed when the node appears
+        self._orphans: Dict[str, Dict[str, PodSpec]] = {}
+
     # ------------------------------------------------------------------
     # growth helpers
 
@@ -313,6 +316,8 @@ class ColumnarStore:
         self._seq += 1
         self.n_seq[r] = self._seq
         self.n_live[r] = True
+        for orphan in self._orphans.pop(node.name, {}).values():
+            self.add_pod(orphan)
 
     def update_node(self, node: NodeSpec) -> None:
         """Re-read a node's mutable fields (labels/allocatable changes are
@@ -333,20 +338,29 @@ class ColumnarStore:
         r = self._node_row.pop(name, None)
         if r is None:
             return
-        # Pods still referencing this row go with it (a watch can deliver
-        # the node delete before its pods' deletes) — otherwise row reuse
-        # by a future add_node would silently reattach them to the new node.
+        # Pods still referencing this row leave the columns with it (a
+        # watch can deliver the node delete before its pods' deletes) —
+        # otherwise row reuse by a future add_node would silently reattach
+        # them to the new node. They park as orphans keyed by this node's
+        # name: a node recreated under the same name (kubelet
+        # re-registration) gets its still-bound pods back, and a pod
+        # DELETED event or re-list purges them.
         hi = self._pod_hi
         stale = np.nonzero(self.p_live[:hi] & (self.p_node[:hi] == r))[0]
         for row in stale:
             pod = self.pod_objs[int(row)]
             if pod is not None:
                 self.remove_pod(pod.uid)
+                self._orphans.setdefault(name, {})[pod.uid] = pod
         self.n_live[r] = False
         self.node_objs[r] = None
         self._node_free.append(r)
 
     def add_pod(self, pod: PodSpec) -> None:
+        if self._orphans:  # a parked copy under any node name is stale now
+            for orphans in self._orphans.values():
+                if orphans.pop(pod.uid, None) is not None:
+                    break
         keep_seq = None
         old_row = self._pod_row.get(pod.uid)
         if old_row is not None:
@@ -359,7 +373,11 @@ class ColumnarStore:
             self.remove_pod(pod.uid)
         node_row = self._node_row.get(pod.node_name)
         if node_row is None:
-            return  # pod on an unknown/removed node is invisible
+            # invisible until its node is observed (unscheduled pods have
+            # node_name "" and stay invisible, like the object path)
+            if pod.node_name:
+                self._orphans.setdefault(pod.node_name, {})[pod.uid] = pod
+            return
         if not self._pod_free:
             self._grow_pods()
         r = self._pod_free.pop()
@@ -403,6 +421,9 @@ class ColumnarStore:
     def remove_pod(self, uid: str) -> None:
         r = self._pod_row.pop(uid, None)
         if r is None:
+            for orphans in self._orphans.values():
+                if orphans.pop(uid, None) is not None:
+                    break
             return
         pod = self.pod_objs[r]
         self.p_live[r] = False
@@ -416,6 +437,30 @@ class ColumnarStore:
                 rows = self._label_index.get((pod.namespace, k, v))
                 if rows is not None:
                     rows.discard(r)
+
+    def reconcile_pods(self, pods: Sequence[PodSpec]) -> None:
+        """Make the pod columns match exactly the given set (a watcher
+        re-list after 410 Gone): vanished pods are removed — including
+        orphans — and everything present is upserted (same-node upserts
+        keep their slot order)."""
+        new_uids = {p.uid for p in pods}
+        for uid in [u for u in self._pod_row if u not in new_uids]:
+            self.remove_pod(uid)
+        for orphans in self._orphans.values():
+            for uid in [u for u in orphans if u not in new_uids]:
+                del orphans[uid]
+        for pod in pods:
+            self.add_pod(pod)
+
+    def reconcile_nodes(self, nodes: Sequence[NodeSpec]) -> None:
+        """Same as ``reconcile_pods`` for the node columns."""
+        new_names = {n.name for n in nodes}
+        for name in [n for n in self._node_row if n not in new_names]:
+            self.remove_node(name)
+        # orphans parked on nodes absent from the re-list stay parked; a
+        # pod re-list purges them if their pod vanished too
+        for node in nodes:
+            self.add_node(node)
 
     # ------------------------------------------------------------------
     # snapshot-time helpers
@@ -431,20 +476,10 @@ class ColumnarStore:
                 self.n_unsched[r] = obj.unschedulable
 
     def _build_taint_table(self, spot_order: np.ndarray) -> TaintTable:
-        """Intern hard taints over ready spot nodes in probe order —
-        identical bit layout to ``masks.intern_taints`` over the sorted
-        ``node_map.spot`` (which is how the object path builds it)."""
-        seen: dict = {}
-        for r in spot_order:
-            for t in self.node_objs[int(r)].taints:
-                if t.effect in HARD_EFFECTS and t not in seen:
-                    seen[t] = len(seen)
-        drain = Taint(TO_BE_DELETED_TAINT, "", "NoSchedule")
-        if drain not in seen:
-            seen[drain] = len(seen)
-        taints = list(seen)
-        words = max(1, -(-len(taints) // 32))
-        return TaintTable(taints=taints, words=words)
+        """Intern hard taints over ready spot nodes in probe order — the
+        object path runs ``intern_taints`` over the sorted ``node_map.spot``,
+        so delegating with the same node order gives the same bit layout."""
+        return intern_taints([self.node_objs[int(r)] for r in spot_order])
 
     def _toleration_matrix(self, table: TaintTable) -> np.ndarray:
         key = tuple(table.taints)

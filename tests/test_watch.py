@@ -354,6 +354,139 @@ def test_unschedulable_pods_from_cache(watching):
     assert wc.list_unschedulable_pods() == []
 
 
+def _columnar(wc):
+    return wc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label="kubernetes.io/role=worker",
+        spot_label="kubernetes.io/role=spot-worker",
+    )
+
+
+def _object_pack(wc):
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+
+    nodes = wc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: wc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label="kubernetes.io/role=worker",
+        spot_label="kubernetes.io/role=spot-worker",
+    )
+    packed, _ = pack_cluster(
+        node_map, wc.list_pdbs(), resources=("cpu", "memory")
+    )
+    return packed
+
+
+def test_columnar_feed_tracks_watch_events(watching):
+    """The columnar mirror follows the watch stream delta by delta and
+    packs the same tensors as the object view frozen at the same point."""
+    import numpy as np
+
+    stub, wc = watching
+    stub.objects["nodes"]["uid-od-1"] = _node("od-1", "worker")
+    stub.objects["nodes"]["uid-spot-1"] = _node("spot-1", "spot-worker")
+    stub.objects["pods"]["uid-a"] = _pod("a", "od-1", cpu="300m")
+    wc.start(timeout=10)
+
+    store = _columnar(wc)
+    assert store.n_pods == 1 and store.n_nodes == 2
+
+    stub.push("pods", "ADDED", _pod("b", "od-1", cpu="200m"))
+    stub.push("pods", "ADDED", _pod("s", "spot-1", cpu="100m"))
+    assert _wait(lambda: len(wc.pods.snapshot()) == 3)
+    stub.push("pods", "DELETED", _pod("a", "od-1"))
+    assert _wait(lambda: len(wc.pods.snapshot()) == 2)
+
+    wc.list_unschedulable_pods()  # freeze the object view
+    store = _columnar(wc)  # sync the columnar view to the same point
+    obj = _object_pack(wc)
+    col, _ = store.pack(wc.list_pdbs())
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+
+
+def test_columnar_feed_orphan_pod_before_node(watching):
+    """A pod whose node hasn't been observed yet parks as an orphan and
+    surfaces when the node ADDED event lands."""
+    stub, wc = watching
+    stub.objects["nodes"]["uid-od-1"] = _node("od-1", "worker")
+    wc.start(timeout=10)
+    store = _columnar(wc)
+
+    stub.push("pods", "ADDED", _pod("early", "spot-9", cpu="100m"))
+    assert _wait(lambda: len(wc.pods.snapshot()) == 1)
+    wc.list_unschedulable_pods()  # next tick: freeze + columnar sync
+    store = _columnar(wc)
+    assert store.n_pods == 0  # parked: node unknown
+
+    stub.push("nodes", "ADDED", _node("spot-9", "spot-worker"))
+    assert _wait(lambda: len(wc.nodes.snapshot()) == 2)
+    wc.list_unschedulable_pods()
+    store = _columnar(wc)
+    assert store.n_pods == 1
+    packed, _ = store.pack([])
+    assert int(packed.spot_count[0]) == 1
+
+
+def test_columnar_node_readd_same_name_recovers_pods(watching):
+    """Kubelet re-registration: node DELETED then ADDED under the same
+    name while its pods stay bound — the mirror must get the pods back
+    (they park as orphans in between)."""
+    stub, wc = watching
+    stub.objects["nodes"]["uid-od-1"] = _node("od-1", "worker")
+    stub.objects["nodes"]["uid-spot-1"] = _node("spot-1", "spot-worker")
+    stub.objects["pods"]["uid-s"] = _pod("s", "spot-1", cpu="500m")
+    wc.start(timeout=10)
+    store = _columnar(wc)
+    assert store.n_pods == 1
+
+    stub.push("nodes", "DELETED", _node("spot-1", "spot-worker"))
+    assert _wait(lambda: len(wc.nodes.snapshot()) == 1)
+    wc.refresh()
+    store = _columnar(wc)
+    assert store.n_pods == 0  # node gone, pod parked
+
+    stub.push("nodes", "ADDED", _node("spot-1", "spot-worker"))
+    assert _wait(lambda: len(wc.nodes.snapshot()) == 2)
+    wc.refresh()
+    store = _columnar(wc)
+    assert store.n_pods == 1  # pod recovered with its node
+    packed, _ = store.pack([])
+    assert int(packed.spot_count[0]) == 1
+    assert packed.spot_free[0, 0] == 2000.0 - 500.0
+
+
+def test_columnar_feed_survives_relist(watching):
+    """A 410-Gone re-list arrives as one replace delta; the mirror
+    reconciles to exactly the re-listed state."""
+    stub, wc = watching
+    stub.objects["nodes"]["uid-od-1"] = _node("od-1", "worker")
+    stub.objects["pods"]["uid-a"] = _pod("a", "od-1")
+    wc.start(timeout=10)
+    store = _columnar(wc)
+    assert store.n_pods == 1
+
+    # state changes behind the cache's back, then the version expires
+    stub.objects["pods"].pop("uid-a")
+    stub.objects["pods"]["uid-b"] = _pod("b", "od-1")
+    stub.objects["pods"]["uid-c"] = _pod("c", "od-1")
+    stub.fail_next_watch["pods"] = {
+        "kind": "Status", "code": 410, "reason": "Expired",
+        "message": "too old resource version",
+    }
+    assert _wait(lambda: stub.list_count["pods"] >= 2)
+    assert _wait(lambda: len(wc.pods.snapshot()) == 2)
+    wc.list_unschedulable_pods()  # next tick: freeze + columnar sync
+    store = _columnar(wc)
+    assert store.n_pods == 2
+    assert "default/a" not in store._pod_row
+    assert {"default/b", "default/c"} <= set(store._pod_row)
+
+
 def test_full_tick_served_from_watch_cache(watching):
     """observe (watch caches) -> plan (TPU solver) -> drain (HTTP writes):
     the watch-backed twin of test_kube.test_full_tick_over_http."""
