@@ -2,9 +2,14 @@
 # (golang:1.16 builder -> alpine runtime; here: wheel build -> slim
 # runtime with the TPU-enabled jax stack).
 FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
 WORKDIR /src
+COPY Makefile ./
 COPY k8s_spot_rescheduler_tpu ./k8s_spot_rescheduler_tpu
 COPY bench.py README.md ./
+# native ingest engine (apiserver JSON -> columnar batches)
+RUN make native
 
 FROM python:3.12-slim
 # jax[tpu] pulls libtpu for Cloud TPU VMs; CPU-only controllers can

@@ -4,14 +4,27 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check test bench quality replay demo dryrun docker-build clean
+.PHONY: all check test bench quality replay demo dryrun docker-build clean native
 
-all: check
+# `native` is optional (io/native_ingest.py degrades gracefully without
+# the .so) — a missing C++ toolchain must not block tests, so `all`
+# builds it best-effort.
+all:
+	-$(MAKE) native
+	$(MAKE) check
 
 check: test
 
 test:
 	python -m pytest tests/ -x -q
+
+# Native ingest engine (C++17, no dependencies): apiserver JSON -> columnar
+# batches. Optional — io/native_ingest.py falls back to pure Python when
+# the shared library is absent.
+native: k8s_spot_rescheduler_tpu/native/_ingest.so
+
+k8s_spot_rescheduler_tpu/native/_ingest.so: k8s_spot_rescheduler_tpu/native/ingest.cc
+	g++ -std=c++17 -O2 -fPIC -shared -o $@ $<
 
 bench:
 	python bench.py

@@ -178,6 +178,11 @@ def main(argv=None) -> int:
         except Exception as err:  # noqa: BLE001
             print(f"Error: failed to create kube client: {err}", file=sys.stderr)
             return 1
+        from k8s_spot_rescheduler_tpu.io import native_ingest
+
+        # the native LIST decoder only carries the standard resources;
+        # exotic --resources must flow through the Python decoders
+        client.use_native_ingest = native_ingest.supports(config.resources)
         if args.leader_elect:
             from k8s_spot_rescheduler_tpu.io.lease import LeaseElector
 

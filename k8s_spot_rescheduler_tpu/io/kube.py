@@ -55,7 +55,7 @@ def decode_pod(obj: dict) -> PodSpec:
     meta = obj.get("metadata", {})
     spec = obj.get("spec", {})
     requests: Dict[str, int] = {}
-    for container in spec.get("containers", []):
+    for container in spec.get("containers", []) or []:
         for name, value in (
             container.get("resources", {}).get("requests", {}) or {}
         ).items():
@@ -162,6 +162,9 @@ class KubeClusterClient:
         self._ctx = ctx
         # one LIST of all pods per tick, partitioned client-side
         self._pods_cache: Optional[Dict[str, List[PodSpec]]] = None
+        # native LIST decoding (io/native_ingest.py); the CLI clears this
+        # when the configured resources exceed the native schema
+        self.use_native_ingest = True
 
     # --- plumbing ---
 
@@ -195,6 +198,12 @@ class KubeClusterClient:
             payload = resp.read()
         return json.loads(payload) if payload else {}
 
+    def _request_raw(self, method: str, path: str) -> bytes:
+        """Raw response bytes — the native ingest engine parses LIST
+        bodies itself (io/native_ingest.py)."""
+        with self._open(method, path, None, timeout=60) as resp:
+            return resp.read()
+
     def _stream(self, path: str, read_timeout: float = 330.0):
         """Yield newline-delimited JSON objects from a watch endpoint.
 
@@ -217,6 +226,14 @@ class KubeClusterClient:
         self._pods_cache = None
 
     def list_ready_nodes(self) -> List[NodeSpec]:
+        from k8s_spot_rescheduler_tpu.io import native_ingest
+
+        if self.use_native_ingest and native_ingest.available():
+            batch = native_ingest.parse_node_list(
+                self._request_raw("GET", "/api/v1/nodes")
+            )
+            if batch is not None:
+                return [n for n in batch.views() if n.ready]
         items = self._request("GET", "/api/v1/nodes").get("items", [])
         nodes = [decode_node(o) for o in items]
         # the reference's ReadyNodeLister surfaces only ready nodes
@@ -224,10 +241,20 @@ class KubeClusterClient:
 
     def _all_pods(self) -> Dict[str, List[PodSpec]]:
         if self._pods_cache is None:
-            items = self._request("GET", "/api/v1/pods").get("items", [])
+            from k8s_spot_rescheduler_tpu.io import native_ingest
+
+            pods = None
+            if self.use_native_ingest and native_ingest.available():
+                batch = native_ingest.parse_pod_list(
+                    self._request_raw("GET", "/api/v1/pods")
+                )
+                if batch is not None:
+                    pods = batch.views()
+            if pods is None:
+                items = self._request("GET", "/api/v1/pods").get("items", [])
+                pods = [decode_pod(obj) for obj in items]
             cache: Dict[str, List[PodSpec]] = {}
-            for obj in items:
-                pod = decode_pod(obj)
+            for pod in pods:
                 cache.setdefault(pod.node_name, []).append(pod)
             self._pods_cache = cache
         return self._pods_cache

@@ -1,0 +1,464 @@
+"""ctypes bindings for the native ingest engine (native/ingest.cc).
+
+The engine parses apiserver LIST JSON (50k pods ~= 30 MB) into columnar
+batches in one native pass — ~10x the pure-Python ``json.loads`` +
+``decode_pod`` path. Rows come back as numpy arrays plus a shared string
+heap; pods/nodes are wrapped in **lazy views** (``PodView``/``NodeView``)
+that quack like ``models/cluster.PodSpec``/``NodeSpec`` but only
+materialize dicts (requests, labels) on first access — the solver path
+reads the numeric columns and never touches them.
+
+Optional: ``available()`` is False when the shared library hasn't been
+built (``make native``) and callers fall back to the pure-Python decode
+(io/kube.py ``decode_pod``/``decode_node``), which stays the semantic
+reference — ``tests/test_native_ingest.py`` pins the two together
+differentially, quantity grammar corner cases included.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    MIRROR_POD_ANNOTATION,
+    NodeSpec,
+    OwnerRef,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native",
+    "_ingest.so",
+)
+
+_UNIT = "\x1f"
+_REC = "\x1e"
+
+# pod flag bits (native/ingest.cc)
+F_MIRROR, F_DAEMONSET, F_REPLICATED, F_TERMINAL, F_PENDING = 1, 2, 4, 8, 16
+# pod column indices
+P_CPU, P_MEM, P_EPH = 0, 1, 2
+P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID = range(5)
+PS_NAME, PS_UID = range(2)
+# interned-table families
+TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS = range(4)
+# node column indices
+N_CPU, N_MEM, N_EPH, N_PODS = range(4)
+N_READY, N_UNSCHED, N_HASPODS = range(3)
+NS_NAME, NS_UID, NS_LABELS, NS_TAINTS = range(4)
+
+
+@functools.lru_cache(maxsize=1)
+def _lib() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.ingest_pods.restype = ctypes.c_void_p
+    lib.ingest_pods.argtypes = [ctypes.c_char_p, ctypes.c_long]
+    lib.ingest_nodes.restype = ctypes.c_void_p
+    lib.ingest_nodes.argtypes = [ctypes.c_char_p, ctypes.c_long]
+    lib.ingest_free.argtypes = [ctypes.c_void_p]
+    lib.batch_count.restype = ctypes.c_long
+    lib.batch_count.argtypes = [ctypes.c_void_p]
+    for name in ("batch_i64", "batch_str"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.POINTER(ctypes.c_int64)
+        fn.argtypes = [ctypes.c_void_p]
+    lib.batch_i32.restype = ctypes.POINTER(ctypes.c_int32)
+    lib.batch_i32.argtypes = [ctypes.c_void_p]
+    lib.batch_u8.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.batch_u8.argtypes = [ctypes.c_void_p]
+    lib.batch_heap.restype = ctypes.POINTER(ctypes.c_char)
+    lib.batch_heap.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)]
+    lib.batch_rv.restype = ctypes.c_char_p
+    lib.batch_rv.argtypes = [ctypes.c_void_p]
+    lib.batch_table.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.batch_table.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+# The native schema carries exactly the resources the framework plans on;
+# exotic resources (e.g. extended/GPU) must take the Python decode path,
+# which preserves arbitrary request/allocatable keys.
+SUPPORTED_RESOURCES = frozenset({"cpu", "memory", "ephemeral-storage", "pods"})
+
+
+def supports(resources) -> bool:
+    """True if the native schema carries every configured resource."""
+    return set(resources) <= SUPPORTED_RESOURCES
+
+
+def _copy_batch(lib, handle, ni64: int, ni32: int, nu8: int, nstr: int,
+                tables: int = 0):
+    """Copy the batch arrays out of native memory and free the handle.
+
+    One memcpy per column family; the string heap comes out as a single
+    Python bytes object the views slice lazily. ``tables`` interned-blob
+    families come out as lists of bytes.
+    """
+    count = lib.batch_count(handle)
+    i64 = np.ctypeslib.as_array(
+        lib.batch_i64(handle), shape=(count * ni64,)
+    ).reshape(count, ni64).copy() if ni64 and count else np.zeros(
+        (count, ni64), np.int64
+    )
+    i32 = np.ctypeslib.as_array(
+        lib.batch_i32(handle), shape=(count * ni32,)
+    ).reshape(count, ni32).copy() if ni32 and count else np.zeros(
+        (count, ni32), np.int32
+    )
+    u8 = np.ctypeslib.as_array(
+        lib.batch_u8(handle), shape=(count * nu8,)
+    ).reshape(count, nu8).copy() if nu8 and count else np.zeros(
+        (count, nu8), np.uint8
+    )
+    stroff = np.ctypeslib.as_array(
+        lib.batch_str(handle), shape=(count * nstr * 2,)
+    ).reshape(count, nstr, 2).copy() if count else np.zeros(
+        (0, nstr, 2), np.int64
+    )
+    hlen = ctypes.c_long()
+    hptr = lib.batch_heap(handle, ctypes.byref(hlen))
+    heap = ctypes.string_at(hptr, hlen.value)
+    tbls: List[List[bytes]] = []
+    for family in range(tables):
+        tcount = ctypes.c_long()
+        toff = lib.batch_table(handle, family, ctypes.byref(tcount))
+        blobs = []
+        for t in range(tcount.value):
+            off, ln = toff[2 * t], toff[2 * t + 1]
+            blobs.append(heap[off : off + ln])
+        tbls.append(blobs)
+    rv = (lib.batch_rv(handle) or b"").decode()
+    lib.ingest_free(handle)
+    return count, i64, i32, u8, stroff, heap, rv, tbls
+
+
+@functools.lru_cache(maxsize=4096)
+def _parse_tolerations(blob: bytes) -> Tuple[Toleration, ...]:
+    out = []
+    for rec in blob.decode().split(_REC):
+        if not rec:
+            continue
+        key, value, operator, effect = rec.split(_UNIT)
+        out.append(
+            Toleration(key=key, value=value, operator=operator, effect=effect)
+        )
+    return tuple(out)
+
+
+def _parse_kv(blob: bytes) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for rec in blob.decode().split(_REC):
+        if rec:
+            k, _, v = rec.partition(_UNIT)
+            out[k] = v
+    return out
+
+
+@functools.lru_cache(maxsize=1024)
+def _parse_taints(blob: bytes) -> Tuple[Taint, ...]:
+    out = []
+    for rec in blob.decode().split(_REC):
+        if not rec:
+            continue
+        key, value, effect = rec.split(_UNIT)
+        out.append(Taint(key, value, effect))
+    return tuple(out)
+
+
+class PodBatch:
+    """Columnar pods from one LIST response, with lazy row views.
+
+    Interned tables (node names, namespaces, toleration sets, label sets)
+    decode once per distinct value; rows carry int32 ids into them.
+    """
+
+    def __init__(self, count, i64, i32, u8, stroff, heap, rv, tables):
+        self.count = count
+        self.i64, self.i32, self.u8 = i64, i32, u8
+        self.stroff, self.heap = stroff, heap
+        self.resource_version = rv
+        self.node_names = [b.decode() for b in tables[TBL_NODE]]
+        self.namespaces = [b.decode() for b in tables[TBL_NS]]
+        self.tol_sets = [_parse_tolerations(b) for b in tables[TBL_TOLS]]
+        self.label_blobs = tables[TBL_LABELS]
+        self._label_sets: List[Optional[Dict[str, str]]] = [None] * len(
+            self.label_blobs
+        )
+
+    def label_set(self, set_id: int) -> Dict[str, str]:
+        cached = self._label_sets[set_id]
+        if cached is None:
+            cached = self._label_sets[set_id] = _parse_kv(
+                self.label_blobs[set_id]
+            )
+        return cached
+
+    def _str(self, i: int, col: int) -> bytes:
+        off, ln = self.stroff[i, col]
+        return self.heap[off : off + ln]
+
+    def view(self, i: int) -> "PodView":
+        return PodView(self, i)
+
+    def views(self) -> List["PodView"]:
+        return [PodView(self, i) for i in range(self.count)]
+
+
+class PodView:
+    """Duck-typed ``PodSpec`` over a batch row; dicts materialize lazily.
+
+    Covers every attribute the framework reads off a pod: the columnar
+    store (requests/priority/flags/tolerations/labels), the evictability
+    filter, the node-map builder, the actuator (name/namespace/uid), and
+    the unschedulable gate (phase/node_name).
+    """
+
+    __slots__ = ("_b", "_i", "_requests", "_labels")
+
+    def __init__(self, batch: PodBatch, i: int):
+        self._b = batch
+        self._i = i
+        self._requests: Optional[Dict[str, int]] = None
+        self._labels: Optional[Dict[str, str]] = None
+
+    @property
+    def name(self) -> str:
+        return self._b._str(self._i, PS_NAME).decode()
+
+    @property
+    def namespace(self) -> str:
+        return self._b.namespaces[self._b.i32[self._i, P_NSID]]
+
+    @property
+    def node_name(self) -> str:
+        return self._b.node_names[self._b.i32[self._i, P_NODEID]]
+
+    @property
+    def uid(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def meta_uid(self) -> str:
+        """metadata.uid — the watch-store key (PodSpec has no analog)."""
+        return self._b._str(self._i, PS_UID).decode()
+
+    @property
+    def requests(self) -> Dict[str, int]:
+        if self._requests is None:
+            row = self._b.i64[self._i]
+            self._requests = {}
+            if row[P_CPU]:
+                self._requests["cpu"] = int(row[P_CPU])
+            if row[P_MEM]:
+                self._requests["memory"] = int(row[P_MEM])
+            if row[P_EPH]:
+                self._requests["ephemeral-storage"] = int(row[P_EPH])
+        return self._requests
+
+    @property
+    def priority(self) -> int:
+        return int(self._b.i32[self._i, P_PRIO])
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        if self._labels is None:
+            self._labels = self._b.label_set(
+                int(self._b.i32[self._i, P_LABELSID])
+            )
+        return self._labels
+
+    @property
+    def annotations(self) -> Dict[str, str]:
+        # only the mirror annotation is ever read; synthesize it from flags
+        if self._b.u8[self._i, 0] & F_MIRROR:
+            return {MIRROR_POD_ANNOTATION: "true"}
+        return {}
+
+    @property
+    def owner_refs(self) -> List[OwnerRef]:
+        flags = self._b.u8[self._i, 0]
+        if flags & F_REPLICATED:
+            kind = "DaemonSet" if flags & F_DAEMONSET else "ReplicaSet"
+            return [OwnerRef(kind=kind, name="", controller=True)]
+        return []
+
+    @property
+    def tolerations(self) -> Tuple[Toleration, ...]:
+        return self._b.tol_sets[self._b.i32[self._i, P_TOLID]]
+
+    @property
+    def anti_affinity_group(self) -> str:
+        return ""  # not mapped from the k8s API (see predicates/masks.py)
+
+    @property
+    def phase(self) -> str:
+        flags = self._b.u8[self._i, 0]
+        if flags & F_PENDING:
+            return "Pending"
+        if flags & F_TERMINAL:
+            return "Succeeded"
+        return "Running"
+
+    def is_mirror(self) -> bool:
+        return bool(self._b.u8[self._i, 0] & F_MIRROR)
+
+    def is_daemonset(self) -> bool:
+        return bool(self._b.u8[self._i, 0] & F_DAEMONSET)
+
+    def controller_ref(self) -> Optional[OwnerRef]:
+        refs = self.owner_refs
+        return refs[0] if refs else None
+
+    def to_pod_spec(self) -> PodSpec:
+        """Full materialization (tests / fallback interop)."""
+        return PodSpec(
+            name=self.name,
+            namespace=self.namespace,
+            node_name=self.node_name,
+            requests=dict(self.requests),
+            priority=self.priority,
+            labels=dict(self.labels),
+            annotations=dict(self.annotations),
+            owner_refs=list(self.owner_refs),
+            tolerations=list(self.tolerations),
+            phase=self.phase,
+        )
+
+    def __repr__(self) -> str:
+        return f"PodView({self.uid} on {self.node_name!r})"
+
+
+class NodeBatch:
+    def __init__(self, count, i64, i32, u8, stroff, heap, rv, tables):
+        self.count = count
+        self.i64, self.u8 = i64, u8
+        self.stroff, self.heap = stroff, heap
+        self.resource_version = rv
+
+    def _str(self, i: int, col: int) -> bytes:
+        off, ln = self.stroff[i, col]
+        return self.heap[off : off + ln]
+
+    def views(self) -> List["NodeView"]:
+        return [NodeView(self, i) for i in range(self.count)]
+
+
+class NodeView:
+    """Duck-typed ``NodeSpec`` over a batch row."""
+
+    __slots__ = ("_b", "_i", "_labels", "_alloc", "_taints")
+
+    def __init__(self, batch: NodeBatch, i: int):
+        self._b = batch
+        self._i = i
+        self._labels: Optional[Dict[str, str]] = None
+        self._alloc: Optional[Dict[str, int]] = None
+        self._taints: Optional[List[Taint]] = None
+
+    @property
+    def name(self) -> str:
+        return self._b._str(self._i, NS_NAME).decode()
+
+    @property
+    def meta_uid(self) -> str:
+        return self._b._str(self._i, NS_UID).decode()
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        if self._labels is None:
+            self._labels = _parse_kv(self._b._str(self._i, NS_LABELS))
+        return self._labels
+
+    @property
+    def allocatable(self) -> Dict[str, int]:
+        if self._alloc is None:
+            row = self._b.i64[self._i]
+            self._alloc = {}
+            if row[N_CPU]:
+                self._alloc["cpu"] = int(row[N_CPU])
+            if row[N_MEM]:
+                self._alloc["memory"] = int(row[N_MEM])
+            if row[N_EPH]:
+                self._alloc["ephemeral-storage"] = int(row[N_EPH])
+            if self._b.u8[self._i, N_HASPODS]:
+                self._alloc["pods"] = int(row[N_PODS])
+        return self._alloc
+
+    @property
+    def taints(self) -> List[Taint]:
+        if self._taints is None:
+            self._taints = list(_parse_taints(self._b._str(self._i, NS_TAINTS)))
+        return self._taints
+
+    # the actuator mutates taints via the apiserver, not on the view;
+    # watch MODIFIED events deliver fresh views
+    @taints.setter
+    def taints(self, value) -> None:
+        self._taints = list(value)
+
+    @property
+    def ready(self) -> bool:
+        return bool(self._b.u8[self._i, N_READY])
+
+    @property
+    def unschedulable(self) -> bool:
+        return bool(self._b.u8[self._i, N_UNSCHED])
+
+    def allocatable_cpu(self) -> int:
+        return int(self.allocatable.get("cpu", 0))
+
+    def to_node_spec(self) -> NodeSpec:
+        return NodeSpec(
+            name=self.name,
+            labels=dict(self.labels),
+            allocatable=dict(self.allocatable),
+            taints=list(self.taints),
+            ready=self.ready,
+            unschedulable=self.unschedulable,
+        )
+
+    def __repr__(self) -> str:
+        return f"NodeView({self.name!r})"
+
+
+def parse_pod_list(data: bytes) -> Optional[PodBatch]:
+    """Parse a PodList JSON body natively; None if the engine is absent
+    or the body doesn't parse (caller falls back to Python)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    handle = lib.ingest_pods(data, len(data))
+    if not handle:
+        return None
+    return PodBatch(*_copy_batch(lib, handle, 3, 5, 1, 2, tables=4))
+
+
+def parse_node_list(data: bytes) -> Optional[NodeBatch]:
+    lib = _lib()
+    if lib is None:
+        return None
+    handle = lib.ingest_nodes(data, len(data))
+    if not handle:
+        return None
+    return NodeBatch(*_copy_batch(lib, handle, 4, 0, 3, 4))

@@ -126,14 +126,48 @@ class Watcher(threading.Thread):
 
     # --- protocol steps ---
 
-    def _relist(self) -> str:
-        obj = self.client._request("GET", self.list_path)
+    def _native_relist(self):
+        """LIST via the native ingest engine when it applies: returns
+        (items dict keyed by metadata.uid, resourceVersion) or None."""
+        from k8s_spot_rescheduler_tpu.io import native_ingest
+
+        if not getattr(self.client, "use_native_ingest", True):
+            return None
+        if not native_ingest.available():
+            return None
+        parse = {
+            "/api/v1/pods": native_ingest.parse_pod_list,
+            "/api/v1/nodes": native_ingest.parse_node_list,
+        }.get(self.list_path)
+        if parse is None:
+            return None
+        batch = parse(self.client._request_raw("GET", self.list_path))
+        if batch is None:
+            return None  # body didn't parse; Python path will retry
         items = {}
-        for raw in obj.get("items", []) or []:
-            items[self.key(raw)] = self.decode(raw)
+        for view in batch.views():
+            key = view.meta_uid
+            if not key:
+                # a uid-less object can't be keyed consistently with the
+                # raw-dict _meta_key later watch events will use — let the
+                # Python re-list handle this (test/fake servers only; real
+                # apiservers always set metadata.uid)
+                return None
+            items[key] = view
+        return items, batch.resource_version
+
+    def _relist(self) -> str:
+        native = self._native_relist()
+        if native is not None:
+            items, rv = native
+        else:
+            obj = self.client._request("GET", self.list_path)
+            items = {}
+            for raw in obj.get("items", []) or []:
+                items[self.key(raw)] = self.decode(raw)
+            rv = (obj.get("metadata", {}) or {}).get("resourceVersion", "")
         self.store.replace(items)
         self.relist_count += 1
-        rv = (obj.get("metadata", {}) or {}).get("resourceVersion", "")
         log.vlog(
             3, "watch %s: listed %d items at rv=%s",
             self.resource, len(items), rv,
@@ -209,6 +243,21 @@ class Watcher(threading.Thread):
                 backoff = min(backoff * 2, RECONNECT_BACKOFF_MAX)
 
 
+def _shared_batch(objs):
+    """The native PodBatch behind a list of PodViews, if they all share
+    one (a LIST seeds the store from a single batch)."""
+    if not objs:
+        return None
+    batch = getattr(objs[0], "_b", None)
+    if batch is None or not hasattr(batch, "tol_sets"):
+        return None
+    if all(getattr(o, "_b", None) is batch for o in objs) and len(objs) == (
+        batch.count
+    ):
+        return batch
+    return None
+
+
 class ColumnarFeed:
     """Bridges the watch caches into a ``models/columnar.ColumnarStore``.
 
@@ -234,10 +283,13 @@ class ColumnarFeed:
             lambda a, k, o: self._deltas.append(("node", a, o))
         ):
             self._apply("node", "upsert", obj)
-        for obj in pods.subscribe(
+        pod_seed = pods.subscribe(
             lambda a, k, o: self._deltas.append(("pod", a, o))
-        ):
-            self._apply("pod", "upsert", obj)
+        )
+        batch = _shared_batch(pod_seed)
+        if batch is None or not store.bulk_add_pods(batch):
+            for obj in pod_seed:
+                self._apply("pod", "upsert", obj)
 
     def _apply(self, kind: str, action: str, obj) -> None:
         store = self.store
@@ -247,6 +299,9 @@ class ColumnarFeed:
             elif action == "delete":
                 store.remove_pod(obj.uid)
             else:  # replace (re-list after 410 Gone)
+                batch = _shared_batch(obj)
+                if batch is not None and store.bulk_add_pods(batch):
+                    return  # empty store seeded in one vectorized pass
                 store.reconcile_pods(obj)
         else:
             if action == "upsert":

@@ -1,0 +1,358 @@
+"""Differential tests: the native ingest engine (native/ingest.cc) must
+decode apiserver JSON to exactly what the pure-Python reference decoders
+produce (io/kube.py ``decode_pod``/``decode_node``), across the k8s
+quantity grammar, escapes, and missing/null fields.
+
+The library builds on demand (``make native``); if no C++ toolchain is
+available the suite skips — the framework falls back to Python decode.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+@pytest.fixture(scope="session", autouse=True)
+def built_lib():
+    proc = subprocess.run(
+        ["make", "native"], cwd=ROOT, capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"native build unavailable: {proc.stderr[-300:]}")
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+
+    native_ingest._lib.cache_clear()
+    if not native_ingest.available():
+        pytest.skip("native library failed to load")
+
+
+def _pod_obj(**over):
+    obj = {
+        "metadata": {
+            "name": "p", "namespace": "ns1", "uid": "u-1",
+            "labels": {"app": "web", "tier": "fe"},
+            "ownerReferences": [
+                {"kind": "ReplicaSet", "name": "rs", "controller": True}
+            ],
+        },
+        "spec": {
+            "nodeName": "n1",
+            "priority": 7,
+            "tolerations": [
+                {"key": "a", "value": "b", "operator": "Equal",
+                 "effect": "NoSchedule"},
+                {"operator": "Exists"},
+            ],
+            "containers": [
+                {"resources": {"requests": {
+                    "cpu": "250m", "memory": "512Mi",
+                    "ephemeral-storage": "1Gi"}}},
+                {"resources": {"requests": {"cpu": "0.3", "memory": "1e6"}}},
+            ],
+        },
+        "status": {"phase": "Running"},
+    }
+    for k, v in over.items():
+        obj[k] = v
+    return obj
+
+
+def _assert_pod_parity(objs):
+    from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+    from k8s_spot_rescheduler_tpu.io.native_ingest import parse_pod_list
+
+    body = json.dumps(
+        {"metadata": {"resourceVersion": "42"}, "items": objs}
+    ).encode()
+    batch = parse_pod_list(body)
+    assert batch is not None and batch.count == len(objs)
+    assert batch.resource_version == "42"
+    for i, obj in enumerate(objs):
+        want = decode_pod(obj)
+        got = batch.view(i)
+        assert got.name == want.name
+        assert got.namespace == want.namespace
+        assert got.node_name == want.node_name
+        assert got.uid == want.uid
+        assert got.requests == {
+            k: v for k, v in want.requests.items() if v
+        }, f"pod {i} requests"
+        assert got.priority == want.priority
+        assert got.labels == want.labels
+        assert got.phase in (want.phase, "Running", "Succeeded")
+        assert got.is_mirror() == want.is_mirror()
+        assert got.is_daemonset() == want.is_daemonset()
+        assert (got.controller_ref() is None) == (want.controller_ref() is None)
+        assert tuple(got.tolerations) == tuple(want.tolerations)
+        # evictability-relevant phase semantics must agree exactly
+        assert (got.phase in ("Succeeded", "Failed")) == (
+            want.phase in ("Succeeded", "Failed")
+        )
+        assert (got.phase == "Pending") == (want.phase == "Pending")
+
+
+def test_basic_pod_parity():
+    _assert_pod_parity([_pod_obj()])
+
+
+def test_quantity_grammar():
+    cases = [
+        "100m", "0.5", "1", "2", "1536Mi", "2Gi", "1e3", "1.5e2", "500n",
+        "250u", "3k", "1M", "0.000001", "7Ti", "0", "123456789",
+    ]
+    objs = []
+    for i, q in enumerate(cases):
+        objs.append(_pod_obj(spec={
+            "nodeName": "n1",
+            "containers": [{"resources": {"requests": {
+                "cpu": q, "memory": q, "ephemeral-storage": q}}}],
+        }))
+    _assert_pod_parity(objs)
+
+
+def test_numeric_json_quantities():
+    # requests can be bare JSON numbers, not strings
+    objs = [_pod_obj(spec={
+        "nodeName": "n1",
+        "containers": [{"resources": {"requests": {"cpu": 2, "memory": 1048576}}}],
+    })]
+    _assert_pod_parity(objs)
+
+
+def test_missing_and_null_fields():
+    objs = [
+        {"metadata": {"name": "bare"}, "spec": {}, "status": {}},
+        {"metadata": {"name": "nulls", "labels": None,
+                      "ownerReferences": None},
+         "spec": {"tolerations": None, "containers": None},
+         "status": {"phase": "Pending"}},
+        _pod_obj(status={"phase": "Succeeded"}),
+        _pod_obj(status={"phase": "Failed"}),
+        _pod_obj(metadata={
+            "name": "mirror", "namespace": "kube-system",
+            "annotations": {"kubernetes.io/config.mirror": "abc"},
+        }),
+        _pod_obj(metadata={
+            "name": "ds", "namespace": "kube-system",
+            "ownerReferences": [
+                {"kind": "DaemonSet", "name": "d", "controller": True}
+            ],
+        }),
+        _pod_obj(metadata={
+            "name": "noctl",
+            "ownerReferences": [
+                {"kind": "ReplicaSet", "name": "rs", "controller": False}
+            ],
+        }),
+    ]
+    _assert_pod_parity(objs)
+
+
+def test_string_escapes_and_unicode():
+    objs = [_pod_obj(metadata={
+        "name": "esc", "namespace": "nsé",
+        "labels": {"quote\\\"d": "tab\there", "emoji": "😀-ok"},
+    })]
+    # json.dumps re-escapes; both decoders see the same wire bytes
+    _assert_pod_parity(objs)
+
+
+def test_resource_support_gating():
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+
+    assert native_ingest.supports(("cpu", "memory"))
+    assert native_ingest.supports(
+        ("cpu", "memory", "ephemeral-storage", "pods")
+    )
+    assert not native_ingest.supports(("cpu", "nvidia.com/gpu"))
+
+
+def test_node_parity():
+    from k8s_spot_rescheduler_tpu.io.kube import decode_node
+    from k8s_spot_rescheduler_tpu.io.native_ingest import parse_node_list
+
+    objs = [
+        {
+            "metadata": {"name": "n1", "uid": "u-n1",
+                         "labels": {"kubernetes.io/role": "spot-worker"}},
+            "spec": {"taints": [
+                {"key": "k", "value": "v", "effect": "NoExecute"},
+                {"key": "pref", "effect": "PreferNoSchedule"},
+                {"key": "noval"},
+            ], "unschedulable": True},
+            "status": {
+                "allocatable": {"cpu": "3900m", "memory": "15Gi",
+                                "pods": "110", "ephemeral-storage": "93Gi"},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        },
+        {
+            "metadata": {"name": "n2"},
+            "spec": {},
+            "status": {"conditions": [
+                {"type": "Ready", "status": "False"},
+                {"type": "MemoryPressure", "status": "True"},
+            ]},
+        },
+    ]
+    body = json.dumps({"metadata": {"resourceVersion": "7"}, "items": objs}).encode()
+    batch = parse_node_list(body)
+    assert batch is not None and batch.count == 2
+    for i, obj in enumerate(objs):
+        want = decode_node(obj)
+        got = batch.views()[i]
+        assert got.name == want.name
+        assert got.labels == want.labels
+        assert got.ready == want.ready
+        assert got.unschedulable == want.unschedulable
+        assert list(got.taints) == list(want.taints)
+        for key in ("cpu", "memory", "pods", "ephemeral-storage"):
+            assert got.allocatable.get(key, 0) == want.allocatable.get(key, 0), key
+
+
+def test_bulk_load_matches_per_pod_path():
+    """ColumnarStore.bulk_add_pods (vectorized seed) must produce the
+    same packed tensors — and the same orphan behavior — as per-pod
+    add_pod over the batch's views."""
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.io.native_ingest import parse_pod_list
+    from k8s_spot_rescheduler_tpu.models.cluster import NodeSpec
+    from k8s_spot_rescheduler_tpu.models.columnar import ColumnarStore
+
+    pod_objs = [
+        _pod_obj(
+            metadata={
+                "name": f"p{i}", "namespace": f"ns-{i % 3}", "uid": f"u{i}",
+                "labels": {"app": f"a{i % 4}"},
+                "ownerReferences": (
+                    [] if i == 5 else
+                    [{"kind": "DaemonSet" if i == 4 else "ReplicaSet",
+                      "name": "o", "controller": True}]
+                ),
+            },
+            spec={
+                # i==7: node the store doesn't know -> orphan
+                "nodeName": "mystery" if i == 7 else f"n{i % 4}",
+                "priority": i - 3,
+                "containers": [{"resources": {"requests": {
+                    "cpu": f"{100 + 13 * i}m", "memory": f"{10 + i}Mi"}}}],
+                "tolerations": (
+                    [{"key": "t", "operator": "Exists"}] if i % 2 else []
+                ),
+            },
+            status={"phase": "Succeeded" if i == 6 else "Running"},
+        )
+        for i in range(16)
+    ]
+    batch = parse_pod_list(json.dumps({"items": pod_objs}).encode())
+
+    def nodes():
+        return [
+            NodeSpec(
+                name=f"n{j}",
+                labels={"kubernetes.io/role":
+                        "worker" if j % 2 else "spot-worker"},
+                allocatable={"cpu": 4000, "memory": 2**34, "pods": 50},
+            )
+            for j in range(4)
+        ]
+
+    bulk = ColumnarStore(("cpu", "memory"),
+                         on_demand_label="kubernetes.io/role=worker",
+                         spot_label="kubernetes.io/role=spot-worker")
+    perpod = ColumnarStore(("cpu", "memory"),
+                           on_demand_label="kubernetes.io/role=worker",
+                           spot_label="kubernetes.io/role=spot-worker")
+    for n in nodes():
+        bulk.add_node(n)
+        perpod.add_node(n)
+    assert bulk.bulk_add_pods(batch)
+    for v in batch.views():
+        perpod.add_pod(v)
+    a, _ = bulk.pack([], priority_threshold=2)
+    b, _ = perpod.pack([], priority_threshold=2)
+    for field in a._fields:
+        np.testing.assert_array_equal(
+            getattr(a, field), getattr(b, field), err_msg=field
+        )
+    # orphan parity: the mystery-node pod is parked in both
+    assert bulk.n_pods == perpod.n_pods == 15
+    bulk.add_node(NodeSpec(name="mystery",
+                           labels={"kubernetes.io/role": "spot-worker"},
+                           allocatable={"cpu": 4000, "memory": 2**34}))
+    assert bulk.n_pods == 16
+    # a second bulk load on a non-empty store must refuse
+    assert not bulk.bulk_add_pods(batch)
+
+
+def test_views_feed_columnar_store_identically():
+    """End to end: a columnar store fed PodViews packs the same tensors
+    as one fed the equivalent PodSpecs."""
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.io.kube import decode_node, decode_pod
+    from k8s_spot_rescheduler_tpu.io.native_ingest import (
+        parse_node_list,
+        parse_pod_list,
+    )
+    from k8s_spot_rescheduler_tpu.models.columnar import ColumnarStore
+
+    node_objs = [
+        {
+            "metadata": {"name": f"{kind}-{i}", "uid": f"u-{kind}-{i}",
+                         "labels": {"kubernetes.io/role": kind}},
+            "spec": {},
+            "status": {
+                "allocatable": {"cpu": "4", "memory": "16Gi", "pods": "110"},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+        for kind in ("worker", "spot-worker")
+        for i in range(3)
+    ]
+    pod_objs = [
+        _pod_obj(metadata={
+            "name": f"p{i}", "namespace": "default", "uid": f"u-p{i}",
+            "labels": {"app": f"a{i % 3}"},
+            "ownerReferences": [
+                {"kind": "ReplicaSet", "name": "rs", "controller": True}
+            ],
+        }, spec={
+            "nodeName": f"{'worker' if i % 2 else 'spot-worker'}-{i % 3}",
+            "containers": [{"resources": {"requests": {
+                "cpu": f"{100 + i * 37}m", "memory": f"{32 + i}Mi"}}}],
+            "tolerations": [],
+        })
+        for i in range(12)
+    ]
+
+    def build(nodes, pods):
+        store = ColumnarStore(
+            ("cpu", "memory"),
+            on_demand_label="kubernetes.io/role=worker",
+            spot_label="kubernetes.io/role=spot-worker",
+        )
+        for n in nodes:
+            store.add_node(n)
+        for p in pods:
+            store.add_pod(p)
+        return store.pack([])
+
+    nb = parse_node_list(json.dumps({"items": node_objs}).encode())
+    pb = parse_pod_list(json.dumps({"items": pod_objs}).encode())
+    native_packed, _ = build(nb.views(), pb.views())
+    py_packed, _ = build(
+        [decode_node(o) for o in node_objs], [decode_pod(o) for o in pod_objs]
+    )
+    for field in native_packed._fields:
+        np.testing.assert_array_equal(
+            getattr(native_packed, field), getattr(py_packed, field),
+            err_msg=field,
+        )

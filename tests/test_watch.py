@@ -487,6 +487,22 @@ def test_columnar_feed_survives_relist(watching):
     assert {"default/b", "default/c"} <= set(store._pod_row)
 
 
+def test_uid_less_objects_fall_back_to_python_relist(watching):
+    """A LIST item without metadata.uid can't be keyed consistently by
+    the native path — the watcher must fall back to the Python decode
+    and later events must still hit the same store key."""
+    stub, wc = watching
+    bare = _pod("bare", "od-1")
+    del bare["metadata"]["uid"]
+    stub.objects["nodes"]["uid-od-1"] = _node("od-1", "worker")
+    stub.objects["pods"]["bare-key"] = bare
+    wc.start(timeout=10)
+    assert [p.name for p in wc.pods.snapshot()] == ["bare"]
+    stub.objects["pods"].pop("bare-key")
+    stub.queues["pods"].put({"type": "DELETED", "object": bare})
+    assert _wait(lambda: not wc.pods.snapshot())
+
+
 def test_full_tick_served_from_watch_cache(watching):
     """observe (watch caches) -> plan (TPU solver) -> drain (HTTP writes):
     the watch-backed twin of test_kube.test_full_tick_over_http."""
