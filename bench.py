@@ -64,9 +64,11 @@ def build_problem(config_id: int, seed: int = 0, spec=None):
     return packed, meta, (t3 - t2)
 
 
-def run_quality(seed: int) -> int:
-    """Greedy-vs-ILP quality ratio on a down-scaled affinity-free cluster
-    (the ILP oracle is only tractable at small scale)."""
+def run_quality(seed: int, sweep: int = 1, solver: str = "numpy") -> int:
+    """Greedy-vs-ILP quality ratio on down-scaled affinity-free clusters
+    (the ILP oracle is only tractable at small scale). ``sweep`` runs
+    seeds [seed, seed+sweep) and reports the WORST ratio — the honest
+    quality number."""
     from k8s_spot_rescheduler_tpu.bench.quality import (
         drain_to_exhaustion,
         ilp_max_drains,
@@ -75,22 +77,32 @@ def run_quality(seed: int) -> int:
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
     spec = SyntheticSpec("quality-40n-300p", 20, 20, 300)
-    packed, _, _ = build_problem(0, seed, spec=spec)
-    ilp = ilp_max_drains(packed)
-    client = generate_cluster(spec, seed, reschedule_evicted=True)
-    greedy = drain_to_exhaustion(client, ReschedulerConfig())
-    ratio = greedy / ilp if ilp else 1.0
+    ratios = []
+    for s in range(seed, seed + max(1, sweep)):
+        packed, _, _ = build_problem(0, s, spec=spec)
+        ilp = ilp_max_drains(packed)
+        client = generate_cluster(spec, s, reschedule_evicted=True)
+        greedy = drain_to_exhaustion(client, ReschedulerConfig(solver=solver))
+        ratio = greedy / ilp if ilp else 1.0
+        ratios.append(ratio)
+        print(
+            f"quality seed {s}: greedy drained {greedy}, ILP oracle {ilp}, "
+            f"ratio {ratio:.3f}",
+            file=sys.stderr,
+        )
+    worst = min(ratios)
     print(
-        f"quality: greedy drained {greedy}, ILP oracle {ilp}, ratio {ratio:.3f}",
+        f"quality over {len(ratios)} seed(s): worst {worst:.3f}, "
+        f"mean {sum(ratios) / len(ratios):.3f}",
         file=sys.stderr,
     )
     print(
         json.dumps(
             {
                 "metric": "nodes_freed_vs_ilp_oracle_ratio",
-                "value": round(ratio, 4),
+                "value": round(worst, 4),
                 "unit": "ratio",
-                "vs_baseline": round(ratio / 0.95, 4),
+                "vs_baseline": round(worst / 0.95, 4),
             }
         )
     )
@@ -121,10 +133,18 @@ def main() -> int:
     ap.add_argument("--config", type=int, default=3)
     ap.add_argument("--repeats", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--solver", default="pallas",
-                    choices=["jax", "sharded", "pallas"])
+    ap.add_argument("--solver", default=None,
+                    choices=["jax", "sharded", "pallas", "numpy"],
+                    help="latency benchmarks default to pallas; --quality "
+                         "defaults to the numpy oracle (the quality metric "
+                         "is solver-independent — the randomized parity "
+                         "suites pin all backends to the oracle — and must "
+                         "not depend on device availability)")
     ap.add_argument("--quality", action="store_true",
                     help="measure nodes-freed vs ILP oracle (small scale)")
+    ap.add_argument("--sweep", type=int, default=1,
+                    help="with --quality: run this many consecutive seeds "
+                         "and report the worst ratio")
     ap.add_argument("--events", type=int, default=1000,
                     help="event count for --config 5 replay")
     ap.add_argument("--scale", type=float, default=1.0,
@@ -132,7 +152,13 @@ def main() -> int:
     args = ap.parse_args()
 
     if args.quality:
-        return run_quality(args.seed)
+        return run_quality(
+            args.seed, sweep=args.sweep, solver=args.solver or "numpy"
+        )
+    args.solver = args.solver or "pallas"
+    if args.solver == "numpy":
+        ap.error("--solver numpy is the host oracle; use it with --quality "
+                 "(the latency benchmark measures the device solvers)")
     if args.config == 5:
         return run_replay_bench(args.seed, args.events)
 
