@@ -183,10 +183,15 @@ class FakeCluster:
 
     def _schedule(self, pod: PodSpec) -> None:
         """Minimal kube-scheduler stand-in: first spot node with room."""
+        if pod.unmodeled_constraints:
+            self.pending.append(pod)  # can't reason about it; stays pending
+            return
         for node in self.nodes.values():
             if not matches_label(node.labels, self.spot_label):
                 continue
             if not node.ready or node.unschedulable:
+                continue
+            if any(node.labels.get(k) != v for k, v in pod.node_selector.items()):
                 continue
             hard = [t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")]
             if any(

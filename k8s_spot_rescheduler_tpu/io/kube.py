@@ -77,6 +77,20 @@ def decode_pod(obj: dict) -> PodSpec:
         )
         for t in spec.get("tolerations", []) or []
     ]
+    # constraints beyond the modeled predicate set (required affinity
+    # expressions, PVC/volume topology) mark the pod conservatively
+    # unplaceable — its node can never be proven drainable, never stranded
+    affinity = spec.get("affinity") or {}
+    required_affinity = any(
+        (affinity.get(branch) or {}).get(
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        )
+        for branch in ("nodeAffinity", "podAffinity", "podAntiAffinity")
+    )
+    has_pvc = any(
+        "persistentVolumeClaim" in (vol or {})
+        for vol in spec.get("volumes", []) or []
+    )
     return PodSpec(
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
@@ -88,6 +102,8 @@ def decode_pod(obj: dict) -> PodSpec:
         owner_refs=owner_refs,
         tolerations=tolerations,
         phase=obj.get("status", {}).get("phase", "Running"),
+        node_selector=spec.get("nodeSelector", {}) or {},
+        unmodeled_constraints=bool(required_affinity or has_pvc),
     )
 
 

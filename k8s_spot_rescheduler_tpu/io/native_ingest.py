@@ -44,12 +44,13 @@ _REC = "\x1e"
 
 # pod flag bits (native/ingest.cc)
 F_MIRROR, F_DAEMONSET, F_REPLICATED, F_TERMINAL, F_PENDING = 1, 2, 4, 8, 16
+F_PVC, F_REQAFF = 32, 64
 # pod column indices
 P_CPU, P_MEM, P_EPH = 0, 1, 2
-P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID = range(5)
+P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID = range(6)
 PS_NAME, PS_UID = range(2)
 # interned-table families
-TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS = range(4)
+TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL = range(5)
 # node column indices
 N_CPU, N_MEM, N_EPH, N_PODS = range(4)
 N_READY, N_UNSCHED, N_HASPODS = range(3)
@@ -89,6 +90,24 @@ def _lib() -> Optional[ctypes.CDLL]:
         ctypes.c_int,
         ctypes.POINTER(ctypes.c_long),
     ]
+    # ABI handshake: a stale .so built for an older column layout would be
+    # silently misread — verify the self-described layout and refuse
+    # (callers fall back to the Python decoders) on any mismatch.
+    try:
+        ok = (
+            lib.pod_ncols_i64() == 3
+            and lib.pod_ncols_i32() == 6
+            and lib.pod_ncols_u8() == 1
+            and lib.pod_ncols_str() == 2
+            and lib.node_ncols_i64() == 4
+            and lib.node_ncols_u8() == 3
+            and lib.node_ncols_str() == 4
+            and lib.table_count() == 5
+        )
+    except AttributeError:
+        ok = False
+    if not ok:
+        return None
     return lib
 
 
@@ -205,6 +224,7 @@ class PodBatch:
         self._label_sets: List[Optional[Dict[str, str]]] = [None] * len(
             self.label_blobs
         )
+        self.selector_sets = [_parse_kv(b) for b in tables[TBL_NODESEL]]
 
     def label_set(self, set_id: int) -> Dict[str, str]:
         cached = self._label_sets[set_id]
@@ -213,6 +233,9 @@ class PodBatch:
                 self.label_blobs[set_id]
             )
         return cached
+
+    def selector_set(self, set_id: int) -> Dict[str, str]:
+        return self.selector_sets[set_id]
 
     def _str(self, i: int, col: int) -> bytes:
         off, ln = self.stroff[i, col]
@@ -309,7 +332,17 @@ class PodView:
 
     @property
     def anti_affinity_group(self) -> str:
-        return ""  # not mapped from the k8s API (see predicates/masks.py)
+        # real required anti-affinity maps to unmodeled_constraints
+        # (conservative); the simplified group field is synthetic-only
+        return ""
+
+    @property
+    def node_selector(self) -> Dict[str, str]:
+        return self._b.selector_set(int(self._b.i32[self._i, P_SELID]))
+
+    @property
+    def unmodeled_constraints(self) -> bool:
+        return bool(self._b.u8[self._i, 0] & (F_PVC | F_REQAFF))
 
     @property
     def phase(self) -> str:
@@ -343,6 +376,8 @@ class PodView:
             owner_refs=list(self.owner_refs),
             tolerations=list(self.tolerations),
             phase=self.phase,
+            node_selector=dict(self.node_selector),
+            unmodeled_constraints=self.unmodeled_constraints,
         )
 
     def __repr__(self) -> str:
@@ -451,7 +486,7 @@ def parse_pod_list(data: bytes) -> Optional[PodBatch]:
     handle = lib.ingest_pods(data, len(data))
     if not handle:
         return None
-    return PodBatch(*_copy_batch(lib, handle, 3, 5, 1, 2, tables=4))
+    return PodBatch(*_copy_batch(lib, handle, 3, 6, 1, 2, tables=5))
 
 
 def parse_node_list(data: bytes) -> Optional[NodeBatch]:

@@ -79,6 +79,16 @@ class PodSpec:
     # co-locate on one node (topologyKey=hostname requiredDuringScheduling).
     anti_affinity_group: str = ""
     phase: str = "Running"
+    # spec.nodeSelector: the pod only schedules onto nodes carrying every
+    # one of these labels (the kube-scheduler's NodeSelector predicate,
+    # part of the reference's CheckPredicates surface, README.md:103-114).
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Scheduling constraints this framework does not model (required node
+    # affinity expressions, PVC/volume topology). Conservative in the safe
+    # direction: such a pod is treated as placeable nowhere, so its node
+    # can never be proven drainable — we may miss a drain the real
+    # scheduler would allow, but never approve one that strands the pod.
+    unmodeled_constraints: bool = False
 
     @property
     def uid(self) -> str:

@@ -59,11 +59,11 @@ from k8s_spot_rescheduler_tpu.models.tensors import (
 from k8s_spot_rescheduler_tpu.predicates.masks import (
     AFFINITY_WORDS,
     HARD_EFFECTS,
+    SelectorBit,
+    Taint,
     TaintTable,
-    intern_taints,
+    intern_constraints,
     pod_affinity_mask,
-    taint_mask,
-    toleration_mask,
 )
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
@@ -230,6 +230,19 @@ class ColumnarStore:
         self._table_key: Optional[tuple] = None
         self._tol_matrix = np.zeros((0, 1), np.uint32)  # [n_tol_ids, W]
         self._node_mask_cache: Dict[tuple, np.ndarray] = {}
+        # Sectioned constraint-table caches. The table is [real taints |
+        # selector pairs | unplaceable]; the real prefix is stable across
+        # ticks while the selector tail follows the current slot set —
+        # caching *bit positions* per section means a universe change
+        # only recomputes the cheap tail, not every toleration mask.
+        self._real_section: tuple = ()
+        self._sel_section: tuple = (0, ())
+        self._sel_keys: List[str] = []  # selector keys in the current table
+        self._unplace_pos: int = 0
+        self._real_tol_pos: Dict[tuple, tuple] = {}
+        self._sel_tol_pos: Dict[tuple, tuple] = {}
+        self._real_node_pos: Dict[tuple, tuple] = {}
+        self._sel_node_pos: Dict[tuple, tuple] = {}
 
         # label index for PDB selection: (ns, key, value) -> live pod rows
         self._label_index: Dict[Tuple[str, str, str], Set[int]] = {}
@@ -399,7 +412,13 @@ class ColumnarStore:
             if ref.kind == "DaemonSet":
                 flags |= _DAEMONSET
         self.p_flags[r] = flags
-        key = tuple(pod.tolerations)
+        # one interned id per distinct scheduling-constraint triple:
+        # (tolerations, nodeSelector, unmodeled-constraints flag)
+        key = (
+            tuple(pod.tolerations),
+            tuple(sorted(pod.node_selector.items())),
+            bool(pod.unmodeled_constraints),
+        )
         tid = self._tol_keys.get(key)
         if tid is None:
             tid = self._tol_keys[key] = len(self._tol_lists)
@@ -495,17 +514,32 @@ class ColumnarStore:
             | ((f & ni.F_TERMINAL) >> 1)
             | ((f & ni.F_REPLICATED) << 1)
         )
-        # toleration-set interning: one lookup per distinct set
-        tolmap = np.empty(len(batch.tol_sets), np.int32)
-        for i, tols in enumerate(batch.tol_sets):
-            key = tuple(tols)
+        # constraint-triple interning: one lookup per distinct
+        # (toleration set, nodeSelector set, unmodeled flag) combination
+        unmod = (f & (ni.F_PVC | ni.F_REQAFF)) != 0
+        combos = np.stack(
+            [
+                batch.i32[keep, ni.P_TOLID],
+                batch.i32[keep, ni.P_SELID],
+                unmod.astype(np.int32),
+            ],
+            axis=1,
+        )
+        uniq, inverse = np.unique(combos, axis=0, return_inverse=True)
+        ids = np.empty(len(uniq), np.int32)
+        for i, (tol_id, sel_id, um) in enumerate(uniq):
+            key = (
+                tuple(batch.tol_sets[tol_id]),
+                tuple(sorted(batch.selector_set(int(sel_id)).items())),
+                bool(um),
+            )
             tid = self._tol_keys.get(key)
             if tid is None:
                 tid = self._tol_keys[key] = len(self._tol_lists)
                 self._tol_lists.append(key)
                 self._table_key = None
-            tolmap[i] = tid
-        self.p_tol_id[:k] = tolmap[batch.i32[keep, ni.P_TOLID]]
+            ids[i] = tid
+        self.p_tol_id[:k] = ids[inverse]
         self.p_aff[:k] = 0  # kube pods carry no anti-affinity group
         seq0 = self._seq + 1
         self._seq += k
@@ -577,29 +611,97 @@ class ColumnarStore:
                 self.n_ready[r] = obj.ready
                 self.n_unsched[r] = obj.unschedulable
 
-    def _build_taint_table(self, spot_order: np.ndarray) -> TaintTable:
-        """Intern hard taints over ready spot nodes in probe order — the
-        object path runs ``intern_taints`` over the sorted ``node_map.spot``,
-        so delegating with the same node order gives the same bit layout."""
-        return intern_taints([self.node_objs[int(r)] for r in spot_order])
+    def _build_taint_table(
+        self, spot_order: np.ndarray, slot_rows: np.ndarray
+    ) -> TaintTable:
+        """Intern the constraint table over ready spot nodes in probe
+        order, with the slot pods' nodeSelector universe as the
+        pseudo-taint tail — identical bit layout to the object packer
+        (``masks.intern_constraints`` over the sorted ``node_map.spot``
+        and the concatenated ``cand_pods``)."""
+        pairs = set()
+        if len(slot_rows):
+            for cid in np.unique(self.p_tol_id[slot_rows]):
+                pairs.update(self._tol_lists[int(cid)][1])
+        return intern_constraints(
+            [self.node_objs[int(r)] for r in spot_order], sorted(pairs)
+        )
+
+    def _refresh_sections(self, table: TaintTable) -> None:
+        real = tuple(e for e in table.taints if isinstance(e, Taint))
+        pairs = tuple(
+            (e.key, e.value) for e in table.taints if isinstance(e, SelectorBit)
+        )
+        offset = len(real)
+        if self._real_section != real:
+            self._real_section = real
+            self._real_tol_pos.clear()
+            self._real_node_pos.clear()
+        if self._sel_section != (offset, pairs):
+            self._sel_section = (offset, pairs)
+            self._sel_tol_pos.clear()
+            self._sel_node_pos.clear()
+            self._sel_keys = sorted({k for k, _ in pairs})
+        self._unplace_pos = offset + len(pairs)
+
+    @staticmethod
+    def _mk_mask(positions, words: int) -> np.ndarray:
+        m = np.zeros(words, np.uint32)
+        for p in positions:
+            m[p // 32] |= np.uint32(1 << (p % 32))
+        return m
 
     def _toleration_matrix(self, table: TaintTable) -> np.ndarray:
         key = tuple(table.taints)
         if self._table_key != key or self._tol_matrix.shape[0] != len(self._tol_lists):
+            self._refresh_sections(table)
             self._table_key = key
-            self._node_mask_cache.clear()
-            self._tol_matrix = np.stack(
-                [toleration_mask(tols, table) for tols in self._tol_lists]
-            ) if self._tol_lists else np.zeros((0, table.words), np.uint32)
+            self._node_mask_cache.clear()  # rebuilt from position caches
+            W = table.words
+            rows = np.zeros((len(self._tol_lists), W), np.uint32)
+            off, pairs = self._sel_section
+            for i, (tols, sel, unmodeled) in enumerate(self._tol_lists):
+                pos = self._real_tol_pos.get(tols)
+                if pos is None:
+                    pos = self._real_tol_pos[tols] = tuple(
+                        j for j, t in enumerate(self._real_section)
+                        if any(tol.tolerates(t) for tol in tols)
+                    )
+                spos = self._sel_tol_pos.get(sel)
+                if spos is None:
+                    required = dict(sel)
+                    spos = self._sel_tol_pos[sel] = tuple(
+                        off + j for j, (k, v) in enumerate(pairs)
+                        if required.get(k) != v
+                    )
+                unplace = () if unmodeled else (self._unplace_pos,)
+                rows[i] = self._mk_mask(pos + spos + unplace, W)
+            self._tol_matrix = rows
         return self._tol_matrix
 
     def _node_taint_mask(self, row: int, table: TaintTable) -> np.ndarray:
-        taints = tuple(
-            t for t in self.node_objs[row].taints if t.effect in HARD_EFFECTS
-        )
-        cached = self._node_mask_cache.get(taints)
+        node = self.node_objs[row]
+        taints = tuple(t for t in node.taints if t.effect in HARD_EFFECTS)
+        labelvals = tuple(node.labels.get(k) for k in self._sel_keys)
+        cached = self._node_mask_cache.get((taints, labelvals))
         if cached is None:
-            cached = self._node_mask_cache[taints] = taint_mask(taints, table)
+            pos = self._real_node_pos.get(taints)
+            if pos is None:
+                index = {t: j for j, t in enumerate(self._real_section)}
+                pos = self._real_node_pos[taints] = tuple(
+                    index[t] for t in taints if t in index
+                )
+            spos = self._sel_node_pos.get(labelvals)
+            if spos is None:
+                off, pairs = self._sel_section
+                labels = node.labels
+                spos = self._sel_node_pos[labelvals] = tuple(
+                    off + j for j, (k, v) in enumerate(pairs)
+                    if labels.get(k) != v
+                )
+            cached = self._node_mask_cache[(taints, labelvals)] = self._mk_mask(
+                pos + spos + (self._unplace_pos,), table.words
+            )
         return cached
 
     def pods_on_node_sorted(self, node_row: int) -> List[PodSpec]:
@@ -745,9 +847,6 @@ class ColumnarStore:
             np.lexsort((self.n_seq[spot_rows], -req_cpu[spot_rows]))
         ]  # most-requested first
 
-        table = self._build_taint_table(spot_order)
-        tol_matrix = self._toleration_matrix(table)
-        W = table.words
         blocks, evict, nonrep = v.blocks, v.evict, v.nonrep
         pdb_names = v.pdb_names
 
@@ -796,6 +895,13 @@ class ColumnarStore:
         )
         slot_rows = slot_rows_u[order].astype(np.int32)
         slot_cand = pod_cand[slot_rows]
+
+        # constraint table: built AFTER the slot set is known — its
+        # pseudo-taint tail is the slot pods' nodeSelector universe
+        # (identical to the object packer's, masks.intern_constraints)
+        table = self._build_taint_table(spot_order, slot_rows)
+        tol_matrix = self._toleration_matrix(table)
+        W = table.words
         slot_counts = np.bincount(slot_cand, minlength=C_actual).astype(np.int32)
         slot_starts = np.concatenate(
             ([0], np.cumsum(slot_counts[:-1]))

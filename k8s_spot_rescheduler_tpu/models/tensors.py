@@ -46,11 +46,12 @@ from k8s_spot_rescheduler_tpu.models.evictability import (
 from k8s_spot_rescheduler_tpu.predicates.masks import (
     AFFINITY_WORDS,
     TaintTable,
-    intern_taints,
+    constraint_mask,
+    intern_constraints,
     node_affinity_mask,
-    node_taint_mask,
+    node_constraint_mask,
     pod_affinity_mask,
-    pod_toleration_mask,
+    selector_universe,
 )
 
 # Scale divisor per resource so packed values stay < 2**24 (float32-exact).
@@ -173,8 +174,6 @@ def pack_cluster(
     """
     candidates = node_map.on_demand
     spot = node_map.spot
-    table = intern_taints([n.node for n in spot])
-    W, A, R = table.words, AFFINITY_WORDS, len(resources)
 
     cand_pods: List[List[PodSpec]] = []
     blocking: List[Optional[BlockingPod]] = []
@@ -184,6 +183,14 @@ def pack_cluster(
         )
         cand_pods.append(pods if not blocked else [])
         blocking.append(blocked)
+
+    # constraint table: the spot pool's hard taints + pseudo-taints for
+    # the slot pods' nodeSelector pairs and unmodeled constraints
+    table = intern_constraints(
+        [n.node for n in spot],
+        selector_universe([p for pods in cand_pods for p in pods]),
+    )
+    W, A, R = table.words, AFFINITY_WORDS, len(resources)
 
     C = max(_pad_dim(len(candidates)), _pad_dim(pad_candidates))
     S = max(_pad_dim(len(spot)), _pad_dim(pad_spot))
@@ -236,10 +243,17 @@ def pack_cluster(
         return out
 
     def tol_row(pod: PodSpec):
-        key = tuple(pod.tolerations)
+        key = (
+            tuple(pod.tolerations),
+            tuple(sorted(pod.node_selector.items())),
+            pod.unmodeled_constraints,
+        )
         row = tol_cache.get(key)
         if row is None:
-            row = tol_cache[key] = pod_toleration_mask(pod, table)
+            row = tol_cache[key] = constraint_mask(
+                pod.tolerations, pod.node_selector,
+                pod.unmodeled_constraints, table,
+            )
         return row
 
     def aff_row(pod: PodSpec):
@@ -270,7 +284,7 @@ def pack_cluster(
         packed.spot_max_pods[s] = int(
             info.node.allocatable.get("pods", DEFAULT_MAX_PODS)
         )
-        packed.spot_taints[s] = node_taint_mask(info.node, table)
+        packed.spot_taints[s] = node_constraint_mask(info.node, table)
         packed.spot_ok[s] = info.node.ready and not info.node.unschedulable
         aff = np.zeros(AFFINITY_WORDS, np.uint32)
         for pod in info.pods:

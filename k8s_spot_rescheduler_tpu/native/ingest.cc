@@ -406,9 +406,10 @@ constexpr char UNIT_SEP = '\x1f';
 constexpr char REC_SEP = '\x1e';
 
 // Interned-string tables: repeated values (node names, namespaces,
-// toleration sets, label sets) are stored once; rows carry int32 ids.
-// At 50k pods this collapses ~200k string decodes into a few thousand.
-enum { TBL_NODE = 0, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_COUNT };
+// toleration sets, label sets, nodeSelector sets) are stored once; rows
+// carry int32 ids. At 50k pods this collapses ~200k string decodes into
+// a few thousand.
+enum { TBL_NODE = 0, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_COUNT };
 
 struct Batch {
   long count = 0;
@@ -443,7 +444,7 @@ struct Batch {
 
 // pod columns
 enum { P_CPU = 0, P_MEM, P_EPH, P_NI64 };
-enum { P_PRIO = 0, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_NI32 };
+enum { P_PRIO = 0, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID, P_NI32 };
 enum { P_FLAGS = 0, P_NU8 };
 enum { PS_NAME = 0, PS_UID, PS_NSTR };
 enum {
@@ -452,7 +453,24 @@ enum {
   F_REPLICATED = 4,
   F_TERMINAL = 8,
   F_PENDING = 16,
+  F_PVC = 32,      // any volume backed by a persistentVolumeClaim
+  F_REQAFF = 64,   // required node/pod (anti-)affinity expressions
 };
+
+// true if the affinity object carries any required-during-scheduling term
+bool has_required_affinity(const Val* affinity) {
+  if (!affinity || affinity->kind != Val::Obj) return false;
+  for (const char* branch :
+       {"nodeAffinity", "podAffinity", "podAntiAffinity"}) {
+    const Val* b = affinity->get(branch);
+    if (!b || b->kind != Val::Obj) continue;
+    const Val* req = b->get("requiredDuringSchedulingIgnoredDuringExecution");
+    if (!req) continue;
+    if (req->kind == Val::Arr && !req->arr.empty()) return true;
+    if (req->kind == Val::Obj && !req->obj.empty()) return true;
+  }
+  return false;
+}
 
 // node columns
 enum { N_CPU = 0, N_MEM, N_EPH, N_PODS, N_NI64 };
@@ -568,6 +586,19 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     }
     if (phase == "Succeeded" || phase == "Failed") flags |= F_TERMINAL;
     if (phase == "Pending") flags |= F_PENDING;
+    if (spec) {
+      if (has_required_affinity(spec->get("affinity"))) flags |= F_REQAFF;
+      if (const Val* vols = spec->get("volumes")) {
+        if (vols->kind == Val::Arr) {
+          for (const Val* vol : vols->arr) {
+            if (vol && vol->get("persistentVolumeClaim")) {
+              flags |= F_PVC;
+              break;
+            }
+          }
+        }
+      }
+    }
     b->u8[(size_t)i * P_NU8 + P_FLAGS] = flags;
 
     std::string tmp;
@@ -587,6 +618,9 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     tmp.clear();
     blob_kv_into(&tmp, meta ? meta->get("labels") : nullptr);
     i32row(P_LABELSID) = b->intern_str(TBL_LABELS, tmp);
+    tmp.clear();
+    blob_kv_into(&tmp, spec ? spec->get("nodeSelector") : nullptr);
+    i32row(P_SELID) = b->intern_str(TBL_NODESEL, tmp);
 
     // tolerations: key\x1fvalue\x1foperator\x1feffect\x1e...
     tmp.clear();
@@ -766,5 +800,6 @@ int pod_ncols_str() { return PS_NSTR; }
 int node_ncols_i64() { return N_NI64; }
 int node_ncols_u8() { return N_NU8; }
 int node_ncols_str() { return NS_NSTR; }
+int table_count() { return TBL_COUNT; }
 
 }  // extern "C"

@@ -77,6 +77,91 @@ def intern_taints(nodes: Sequence[NodeSpec]) -> TaintTable:
     return TaintTable(taints=taints, words=words)
 
 
+# --- pseudo-taints: nodeSelector and unmodeled constraints ---------------
+#
+# The kube-scheduler's NodeSelector/affinity/volume predicates don't fit
+# the "node repels pod" shape of taints, but they DO fit the same bit
+# algebra inverted: define a pseudo-taint per distinct nodeSelector
+# (key, value) pair, set on every node that LACKS the label; a pod that
+# requires the pair simply doesn't tolerate it. Constraints the framework
+# can't express (required node-affinity expressions, PVC topology) become
+# one "unplaceable" pseudo-taint set on every node that only the affected
+# pod fails to tolerate. The payoff: full NodeSelector semantics and
+# safe-direction conservatism for the rest, with ZERO changes to any
+# solver or the Pallas kernel — they already AND these words.
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectorBit:
+    """Pseudo-taint for one required node label (key=value)."""
+
+    key: str
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class UnplaceableBit:
+    """Pseudo-taint carried by every node; only pods with unmodeled
+    constraints fail to tolerate it."""
+
+
+def selector_universe(pods: Sequence[PodSpec]) -> List[Tuple[str, str]]:
+    """Sorted distinct (key, value) pairs across the pods' nodeSelectors —
+    the deterministic pseudo-taint universe both packers must share."""
+    return sorted({(k, v) for p in pods for k, v in p.node_selector.items()})
+
+
+def intern_constraints(
+    nodes: Sequence[NodeSpec],
+    selector_pairs: Sequence[Tuple[str, str]],
+) -> TaintTable:
+    """``intern_taints`` plus the pseudo-taint tail: selector pairs (in
+    the given sorted order) and the always-present unplaceable bit."""
+    base = intern_taints(nodes)
+    taints = list(base.taints)
+    taints.extend(SelectorBit(k, v) for k, v in selector_pairs)
+    taints.append(UnplaceableBit())
+    words = max(1, -(-len(taints) // 32))
+    return TaintTable(taints=taints, words=words)
+
+
+def node_constraint_mask(node: NodeSpec, table: TaintTable) -> np.ndarray:
+    """Node-side bits: real hard taints + selector pairs the node lacks +
+    the unplaceable bit (always set)."""
+    mask = np.zeros(table.words, dtype=np.uint32)
+    for i, entry in enumerate(table.taints):
+        if isinstance(entry, Taint):
+            continue  # real taints handled below via the node's own list
+        if isinstance(entry, SelectorBit):
+            if node.labels.get(entry.key) != entry.value:
+                mask[i // 32] |= np.uint32(1 << (i % 32))
+        else:  # UnplaceableBit
+            mask[i // 32] |= np.uint32(1 << (i % 32))
+    return mask | taint_mask(node.taints, table)
+
+
+def constraint_mask(
+    tolerations: Sequence,
+    node_selector,
+    unmodeled: bool,
+    table: TaintTable,
+) -> np.ndarray:
+    """Pod-side bits: tolerated real taints + selector pairs the pod does
+    NOT require + the unplaceable bit unless the pod carries unmodeled
+    constraints."""
+    mask = np.zeros(table.words, dtype=np.uint32)
+    for i, entry in enumerate(table.taints):
+        if isinstance(entry, Taint):
+            ok = any(tol.tolerates(entry) for tol in tolerations)
+        elif isinstance(entry, SelectorBit):
+            ok = node_selector.get(entry.key) != entry.value
+        else:  # UnplaceableBit
+            ok = not unmodeled
+        if ok:
+            mask[i // 32] |= np.uint32(1 << (i % 32))
+    return mask
+
+
 def taint_mask(taints: Sequence[Taint], table: TaintTable) -> np.ndarray:
     """Bitmask of the hard taints present in ``taints``."""
     mask = np.zeros(table.words, dtype=np.uint32)

@@ -1,0 +1,196 @@
+"""NodeSelector and unmodeled-constraint predicates.
+
+The kube-scheduler's NodeSelector predicate (part of the reference's
+CheckPredicates surface, reference README.md:103-114) is encoded as
+pseudo-taints in the interned constraint table (predicates/masks.py
+``SelectorBit``/``UnplaceableBit``) — these tests pin the semantics across
+the numpy oracle, the object packer, the columnar packer, and the full
+control loop, plus the safe-direction conservatism for constraints the
+framework does not model (required affinity, PVCs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+
+def _cluster():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(
+        make_node("spot-plain", SPOT_LABELS)
+    )
+    gpu_labels = dict(SPOT_LABELS, **{"accelerator": "gpu"})
+    fc.add_node(make_node("spot-gpu", gpu_labels))
+    return fc
+
+
+def _pack(fc, **kw):
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    return pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"), **kw)
+
+
+def test_selector_restricts_placement_to_matching_spot():
+    fc = _cluster()
+    fc.add_pod(
+        make_pod("gpu-pod", 300, "od-1",
+                 node_selector={"accelerator": "gpu"})
+    )
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    # spot order: both empty -> insertion order (spot-plain first); the
+    # pod must land on spot-gpu, not the first-probed plain node
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-gpu"
+
+
+def test_selector_with_no_matching_spot_blocks_drain():
+    fc = _cluster()
+    fc.add_pod(
+        make_pod("picky", 100, "od-1",
+                 node_selector={"zone": "nowhere"})
+    )
+    packed, _ = _pack(fc)
+    result = plan_oracle(packed)
+    assert not result.feasible[:1].any()
+
+
+def test_unmodeled_constraints_block_drain_conservatively():
+    fc = _cluster()
+    fc.add_pod(make_pod("pvc-pod", 100, "od-1", unmodeled_constraints=True))
+    fc.add_pod(make_pod("free", 100, "od-1"))
+    packed, _ = _pack(fc)
+    result = plan_oracle(packed)
+    # ample capacity everywhere, but the PVC pod is unplaceable -> the
+    # node must NOT be provably drainable (safe direction)
+    assert not result.feasible[:1].any()
+
+
+def test_columnar_parity_with_selectors():
+    fc = _cluster()
+    fc.add_pod(make_pod("gpu-pod", 300, "od-1",
+                        node_selector={"accelerator": "gpu"}))
+    fc.add_pod(make_pod("plain", 200, "od-1"))
+    fc.add_pod(make_pod("pvc", 100, "od-1", unmodeled_constraints=True))
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+
+
+def test_loop_drains_selector_pod_to_matching_node():
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-plain", SPOT_LABELS))
+    fc.add_node(make_node("spot-gpu", dict(SPOT_LABELS, accelerator="gpu")))
+    fc.add_pod(make_pod("gpu-pod", 300, "od-1",
+                        node_selector={"accelerator": "gpu"}))
+    config = ReschedulerConfig(solver="numpy")
+    r = Rescheduler(fc, SolverPlanner(config), config, clock=clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    # the fake scheduler honors the selector too: the pod landed on spot-gpu
+    assert [p.name for p in fc.list_pods_on_node("spot-gpu")] == ["gpu-pod"]
+    assert fc.list_pods_on_node("spot-plain") == []
+
+
+def test_loop_never_drains_node_with_unmodeled_pod():
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS, cpu_millis=8000))
+    fc.add_pod(make_pod("pvc-pod", 100, "od-1", unmodeled_constraints=True))
+    config = ReschedulerConfig(solver="numpy")
+    r = Rescheduler(fc, SolverPlanner(config), config, clock=clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == []
+    assert result.report.n_feasible == 0
+    assert fc.evictions == []
+
+
+def test_native_decode_of_selector_affinity_pvc():
+    import json
+    import subprocess
+
+    import pytest
+
+    ROOT = __file__.rsplit("/tests/", 1)[0]
+    proc = subprocess.run(["make", "native"], cwd=ROOT, capture_output=True)
+    if proc.returncode != 0:
+        pytest.skip("native build unavailable")
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+    from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+
+    native_ingest._lib.cache_clear()
+    if not native_ingest.available():
+        pytest.skip("native library failed to load")
+
+    objs = [
+        {"metadata": {"name": "sel", "uid": "u1"},
+         "spec": {"nodeName": "n1",
+                  "nodeSelector": {"accelerator": "gpu", "zone": "a"},
+                  "containers": []},
+         "status": {"phase": "Running"}},
+        {"metadata": {"name": "aff", "uid": "u2"},
+         "spec": {"nodeName": "n1", "containers": [],
+                  "affinity": {"nodeAffinity": {
+                      "requiredDuringSchedulingIgnoredDuringExecution": {
+                          "nodeSelectorTerms": [{"matchExpressions": []}]
+                      }}}},
+         "status": {"phase": "Running"}},
+        {"metadata": {"name": "pvc", "uid": "u3"},
+         "spec": {"nodeName": "n1", "containers": [],
+                  "volumes": [{"name": "v",
+                               "persistentVolumeClaim": {"claimName": "c"}}]},
+         "status": {"phase": "Running"}},
+        {"metadata": {"name": "prefaff", "uid": "u4"},
+         "spec": {"nodeName": "n1", "containers": [],
+                  "affinity": {"nodeAffinity": {
+                      "preferredDuringSchedulingIgnoredDuringExecution": [
+                          {"weight": 1}
+                      ]}},
+                  "volumes": [{"name": "v", "emptyDir": {}}]},
+         "status": {"phase": "Running"}},
+    ]
+    batch = native_ingest.parse_pod_list(
+        json.dumps({"items": objs}).encode()
+    )
+    for i, obj in enumerate(objs):
+        want = decode_pod(obj)
+        got = batch.view(i)
+        assert got.node_selector == want.node_selector, i
+        assert got.unmodeled_constraints == want.unmodeled_constraints, i
