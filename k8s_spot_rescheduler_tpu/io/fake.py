@@ -211,6 +211,15 @@ class FakeCluster:
                 p.anti_affinity_group == pod.anti_affinity_group for p in here
             ):
                 continue
+            # selector anti-affinity, both directions (the scheduler
+            # respects existing pods' required anti-affinity too)
+            def _repels(a: PodSpec, b: PodSpec) -> bool:
+                return bool(a.anti_affinity_match) and a.namespace == b.namespace and all(
+                    b.labels.get(k) == v for k, v in a.anti_affinity_match.items()
+                )
+
+            if any(_repels(pod, p) or _repels(p, pod) for p in here):
+                continue
             if pod.requests.get(CPU, 0) <= free_cpu and (
                 pod.requests.get(MEMORY, 0) <= free_mem
             ):

@@ -85,8 +85,12 @@ def decode_pod(obj: dict) -> PodSpec:
         (affinity.get(branch) or {}).get(
             "requiredDuringSchedulingIgnoredDuringExecution"
         )
-        for branch in ("nodeAffinity", "podAffinity", "podAntiAffinity")
+        for branch in ("nodeAffinity", "podAffinity")
     )
+    anti_affinity_match, anti_unmodeled = decode_anti_affinity(
+        affinity.get("podAntiAffinity") or {}
+    )
+    required_affinity = required_affinity or anti_unmodeled
     has_pvc = any(
         "persistentVolumeClaim" in (vol or {})
         for vol in spec.get("volumes", []) or []
@@ -103,8 +107,38 @@ def decode_pod(obj: dict) -> PodSpec:
         tolerations=tolerations,
         phase=obj.get("status", {}).get("phase", "Running"),
         node_selector=spec.get("nodeSelector", {}) or {},
+        anti_affinity_match=anti_affinity_match,
         unmodeled_constraints=bool(required_affinity or has_pvc),
     )
+
+
+def decode_anti_affinity(anti: dict) -> tuple:
+    """(matchLabels, unmodeled) for a podAntiAffinity object.
+
+    The modeled shape — kept in exact lockstep with the native engine's
+    ``extract_anti_affinity`` (native/ingest.cc) — is ONE required term
+    with topologyKey=kubernetes.io/hostname and a non-empty
+    matchLabels-only selector in the pod's own namespace. Anything else
+    required is unmodeled (conservatively unplaceable)."""
+    req = anti.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not req:
+        return {}, False
+    if not isinstance(req, list) or len(req) != 1:
+        return {}, True
+    term = req[0] or {}
+    if term.get("topologyKey") != "kubernetes.io/hostname":
+        return {}, True
+    if term.get("namespaces"):
+        return {}, True
+    sel = term.get("labelSelector")
+    if not isinstance(sel, dict):
+        return {}, True
+    if sel.get("matchExpressions"):
+        return {}, True
+    match = sel.get("matchLabels")
+    if not isinstance(match, dict) or not match:
+        return {}, True
+    return dict(match), False
 
 
 def decode_node(obj: dict) -> NodeSpec:

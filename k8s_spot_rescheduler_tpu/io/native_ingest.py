@@ -47,10 +47,11 @@ F_MIRROR, F_DAEMONSET, F_REPLICATED, F_TERMINAL, F_PENDING = 1, 2, 4, 8, 16
 F_PVC, F_REQAFF = 32, 64
 # pod column indices
 P_CPU, P_MEM, P_EPH = 0, 1, 2
-P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID = range(6)
+(P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID,
+ P_AAFFID) = range(7)
 PS_NAME, PS_UID = range(2)
 # interned-table families
-TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL = range(5)
+TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_AAFF = range(6)
 # node column indices
 N_CPU, N_MEM, N_EPH, N_PODS = range(4)
 N_READY, N_UNSCHED, N_HASPODS = range(3)
@@ -96,13 +97,13 @@ def _lib() -> Optional[ctypes.CDLL]:
     try:
         ok = (
             lib.pod_ncols_i64() == 3
-            and lib.pod_ncols_i32() == 6
+            and lib.pod_ncols_i32() == 7
             and lib.pod_ncols_u8() == 1
             and lib.pod_ncols_str() == 2
             and lib.node_ncols_i64() == 4
             and lib.node_ncols_u8() == 3
             and lib.node_ncols_str() == 4
-            and lib.table_count() == 5
+            and lib.table_count() == 6
         )
     except AttributeError:
         ok = False
@@ -225,6 +226,10 @@ class PodBatch:
             self.label_blobs
         )
         self.selector_sets = [_parse_kv(b) for b in tables[TBL_NODESEL]]
+        self.match_sets = [_parse_kv(b) for b in tables[TBL_AAFF]]
+
+    def match_set(self, set_id: int) -> Dict[str, str]:
+        return self.match_sets[set_id]
 
     def label_set(self, set_id: int) -> Dict[str, str]:
         cached = self._label_sets[set_id]
@@ -332,9 +337,11 @@ class PodView:
 
     @property
     def anti_affinity_group(self) -> str:
-        # real required anti-affinity maps to unmodeled_constraints
-        # (conservative); the simplified group field is synthetic-only
-        return ""
+        return ""  # the simplified group field is synthetic-only
+
+    @property
+    def anti_affinity_match(self) -> Dict[str, str]:
+        return self._b.match_set(int(self._b.i32[self._i, P_AAFFID]))
 
     @property
     def node_selector(self) -> Dict[str, str]:
@@ -377,6 +384,7 @@ class PodView:
             tolerations=list(self.tolerations),
             phase=self.phase,
             node_selector=dict(self.node_selector),
+            anti_affinity_match=dict(self.anti_affinity_match),
             unmodeled_constraints=self.unmodeled_constraints,
         )
 
@@ -486,7 +494,7 @@ def parse_pod_list(data: bytes) -> Optional[PodBatch]:
     handle = lib.ingest_pods(data, len(data))
     if not handle:
         return None
-    return PodBatch(*_copy_batch(lib, handle, 3, 6, 1, 2, tables=5))
+    return PodBatch(*_copy_batch(lib, handle, 3, 7, 1, 2, tables=6))
 
 
 def parse_node_list(data: bytes) -> Optional[NodeBatch]:

@@ -78,6 +78,14 @@ class PodSpec:
     # Simplified pod-anti-affinity: pods sharing a non-empty group refuse to
     # co-locate on one node (topologyKey=hostname requiredDuringScheduling).
     anti_affinity_group: str = ""
+    # The standard k8s spread pattern, modeled exactly: required
+    # podAntiAffinity with topologyKey=hostname and a matchLabels selector
+    # (scoped to the pod's namespace). The pod refuses nodes hosting any
+    # pod matched by this selector, and — symmetrically, like the real
+    # scheduler — matched pods refuse nodes hosting this pod. Shapes
+    # beyond this (matchExpressions, other topology keys, multiple terms)
+    # fall back to ``unmodeled_constraints``.
+    anti_affinity_match: Dict[str, str] = dataclasses.field(default_factory=dict)
     phase: str = "Running"
     # spec.nodeSelector: the pod only schedules onto nodes carrying every
     # one of these labels (the kube-scheduler's NodeSelector predicate,

@@ -62,8 +62,9 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     SelectorBit,
     Taint,
     TaintTable,
+    affinity_bits,
     intern_constraints,
-    pod_affinity_mask,
+    match_affinity_mask,
 )
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
@@ -200,7 +201,7 @@ class ColumnarStore:
         self.p_prio = np.zeros(cap, np.int32)
         self.p_flags = np.zeros(cap, np.uint8)
         self.p_tol_id = np.zeros(cap, np.int32)
-        self.p_aff = np.zeros((cap, AFFINITY_WORDS), np.uint32)
+        self.p_aff_id = np.zeros(cap, np.int32)
         self.p_seq = np.zeros(cap, np.int64)
         self.p_live = np.zeros(cap, bool)
         self.pod_objs: List[Optional[PodSpec]] = [None] * cap
@@ -244,6 +245,14 @@ class ColumnarStore:
         self._real_node_pos: Dict[tuple, tuple] = {}
         self._sel_node_pos: Dict[tuple, tuple] = {}
 
+        # affinity-profile interning: (group, ns, match sel, labels) -> id;
+        # the per-profile mask matrix depends on the tick's selector
+        # universe and is rebuilt only when either changes
+        self._aff_keys: Dict[tuple, int] = {}
+        self._aff_lists: List[tuple] = []
+        self._aff_universe_key: Optional[tuple] = None
+        self._aff_matrix = np.zeros((0, AFFINITY_WORDS), np.uint32)
+
         # label index for PDB selection: (ns, key, value) -> live pod rows
         self._label_index: Dict[Tuple[str, str, str], Set[int]] = {}
         self._ns_index: Dict[str, Set[int]] = {}
@@ -266,7 +275,7 @@ class ColumnarStore:
             ("p_prio", (new,), 0),
             ("p_flags", (new,), 0),
             ("p_tol_id", (new,), 0),
-            ("p_aff", (new, AFFINITY_WORDS), 0),
+            ("p_aff_id", (new,), 0),
             ("p_seq", (new,), 0),
             ("p_live", (new,), False),
         ):
@@ -425,7 +434,20 @@ class ColumnarStore:
             self._tol_lists.append(key)
             self._table_key = None  # force toleration matrix rebuild
         self.p_tol_id[r] = tid
-        self.p_aff[r] = pod_affinity_mask(pod)
+        # affinity profile: (group, ns, match selector, labels) determines
+        # the pod's affinity mask for any selector universe
+        akey = (
+            pod.anti_affinity_group,
+            pod.namespace,
+            tuple(sorted(pod.anti_affinity_match.items())),
+            tuple(sorted(pod.labels.items())),
+        )
+        aid = self._aff_keys.get(akey)
+        if aid is None:
+            aid = self._aff_keys[akey] = len(self._aff_lists)
+            self._aff_lists.append(akey)
+            self._aff_universe_key = None  # force matrix rebuild
+        self.p_aff_id[r] = aid
         if keep_seq is not None:
             self.p_seq[r] = keep_seq
         else:
@@ -540,7 +562,31 @@ class ColumnarStore:
                 self._table_key = None
             ids[i] = tid
         self.p_tol_id[:k] = ids[inverse]
-        self.p_aff[:k] = 0  # kube pods carry no anti-affinity group
+        # affinity-profile interning per distinct (ns, selector, labels)
+        acombos = np.stack(
+            [
+                batch.i32[keep, ni.P_NSID],
+                batch.i32[keep, ni.P_AAFFID],
+                batch.i32[keep, ni.P_LABELSID],
+            ],
+            axis=1,
+        )
+        auniq, ainv = np.unique(acombos, axis=0, return_inverse=True)
+        aids = np.empty(len(auniq), np.int32)
+        for i, (ns_id, aaff_id, l_id) in enumerate(auniq):
+            akey = (
+                "",  # kube pods carry no synthetic group
+                batch.namespaces[ns_id],
+                tuple(sorted(batch.match_set(int(aaff_id)).items())),
+                tuple(sorted(batch.label_set(int(l_id)).items())),
+            )
+            aid = self._aff_keys.get(akey)
+            if aid is None:
+                aid = self._aff_keys[akey] = len(self._aff_lists)
+                self._aff_lists.append(akey)
+                self._aff_universe_key = None
+            aids[i] = aid
+        self.p_aff_id[:k] = aids[ainv]
         seq0 = self._seq + 1
         self._seq += k
         self.p_seq[:k] = np.arange(seq0, seq0 + k, dtype=np.int64)
@@ -703,6 +749,32 @@ class ColumnarStore:
                 pos + spos + (self._unplace_pos,), table.words
             )
         return cached
+
+    def _affinity_matrix(self, counted_rows: np.ndarray) -> np.ndarray:
+        """Per-profile affinity masks for the current tick's selector
+        universe (distinct ``anti_affinity_match`` selectors among the
+        counted pods). Rebuilt only when the universe or the profile list
+        changes; plain clusters keep a zero universe and never rebuild."""
+        ids = np.unique(self.p_aff_id[counted_rows]) if len(counted_rows) else []
+        universe = sorted(
+            {
+                (self._aff_lists[int(i)][1], self._aff_lists[int(i)][2])
+                for i in ids
+                if self._aff_lists[int(i)][2]
+            }
+        )
+        key = (tuple(universe), len(self._aff_lists))
+        if self._aff_universe_key != key:
+            self._aff_universe_key = key
+            rows = np.zeros((len(self._aff_lists), AFFINITY_WORDS), np.uint32)
+            for i, (group, ns, match_items, labels) in enumerate(self._aff_lists):
+                m = match_affinity_mask(ns, match_items, dict(labels), universe)
+                if group:
+                    w, b = affinity_bits(group)
+                    m[w] |= np.uint32(1 << b)
+                rows[i] = m
+            self._aff_matrix = rows
+        return self._aff_matrix
 
     def pods_on_node_sorted(self, node_row: int) -> List[PodSpec]:
         """All live pods on a node, biggest-CPU-request-first (insertion-
@@ -902,6 +974,7 @@ class ColumnarStore:
         table = self._build_taint_table(spot_order, slot_rows)
         tol_matrix = self._toleration_matrix(table)
         W = table.words
+        aff_matrix = self._affinity_matrix(np.nonzero(counted)[0])
         slot_counts = np.bincount(slot_cand, minlength=C_actual).astype(np.int32)
         slot_starts = np.concatenate(
             ([0], np.cumsum(slot_counts[:-1]))
@@ -938,7 +1011,9 @@ class ColumnarStore:
             packed.slot_tol[slot_cand, slot_idx] = tol_matrix[
                 self.p_tol_id[slot_rows]
             ]
-            packed.slot_aff[slot_cand, slot_idx] = self.p_aff[slot_rows]
+            packed.slot_aff[slot_cand, slot_idx] = aff_matrix[
+                self.p_aff_id[slot_rows]
+            ]
         if C_actual:
             packed.cand_valid[:C_actual] = cand_ok & (n_evict > 0)
 
@@ -967,7 +1042,7 @@ class ColumnarStore:
             for i, r in enumerate(spot_order):
                 packed.spot_taints[i] = self._node_taint_mask(int(r), table)
             aff = np.zeros((S_actual, AFFINITY_WORDS), np.uint32)
-            np.bitwise_or.at(aff, sp, self.p_aff[sp_rows])
+            np.bitwise_or.at(aff, sp, aff_matrix[self.p_aff_id[sp_rows]])
             packed.spot_aff[:S_actual] = aff
 
         meta = ColumnarMeta(

@@ -46,9 +46,10 @@ from k8s_spot_rescheduler_tpu.models.evictability import (
 from k8s_spot_rescheduler_tpu.predicates.masks import (
     AFFINITY_WORDS,
     TaintTable,
+    collect_match_universe,
     constraint_mask,
     intern_constraints,
-    node_affinity_mask,
+    match_affinity_mask,
     node_constraint_mask,
     pod_affinity_mask,
     selector_universe,
@@ -190,6 +191,12 @@ def pack_cluster(
         [n.node for n in spot],
         selector_universe([p for pods in cand_pods for p in pods]),
     )
+    # anti-affinity selector universe spans every counted pod (resident
+    # spot pods repel incoming matches and vice versa)
+    match_universe = collect_match_universe(
+        [p for info in candidates for p in info.pods]
+        + [p for info in spot for p in info.pods]
+    )
     W, A, R = table.words, AFFINITY_WORDS, len(resources)
 
     C = max(_pad_dim(len(candidates)), _pad_dim(pad_candidates))
@@ -257,9 +264,17 @@ def pack_cluster(
         return row
 
     def aff_row(pod: PodSpec):
-        row = aff_cache.get(pod.anti_affinity_group)
+        key = (
+            pod.anti_affinity_group,
+            pod.namespace,
+            tuple(sorted(pod.anti_affinity_match.items())),
+            tuple(sorted(pod.labels.items())),
+        )
+        row = aff_cache.get(key)
         if row is None:
-            row = aff_cache[pod.anti_affinity_group] = pod_affinity_mask(pod)
+            row = aff_cache[key] = pod_affinity_mask(pod) | match_affinity_mask(
+                pod.namespace, key[2], pod.labels, match_universe
+            )
         return row
 
     for c, (info, pods, blocked) in enumerate(zip(candidates, cand_pods, blocking)):
@@ -288,7 +303,7 @@ def pack_cluster(
         packed.spot_ok[s] = info.node.ready and not info.node.unschedulable
         aff = np.zeros(AFFINITY_WORDS, np.uint32)
         for pod in info.pods:
-            if pod.anti_affinity_group:
+            if pod.anti_affinity_group or pod.anti_affinity_match or match_universe:
                 aff |= aff_row(pod)
         packed.spot_aff[s] = aff
 

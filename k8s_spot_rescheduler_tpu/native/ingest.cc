@@ -406,10 +406,18 @@ constexpr char UNIT_SEP = '\x1f';
 constexpr char REC_SEP = '\x1e';
 
 // Interned-string tables: repeated values (node names, namespaces,
-// toleration sets, label sets, nodeSelector sets) are stored once; rows
-// carry int32 ids. At 50k pods this collapses ~200k string decodes into
-// a few thousand.
-enum { TBL_NODE = 0, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_COUNT };
+// toleration sets, label sets, nodeSelector sets, anti-affinity
+// selectors) are stored once; rows carry int32 ids. At 50k pods this
+// collapses ~200k string decodes into a few thousand.
+enum {
+  TBL_NODE = 0,
+  TBL_NS,
+  TBL_TOLS,
+  TBL_LABELS,
+  TBL_NODESEL,
+  TBL_AAFF,
+  TBL_COUNT,
+};
 
 struct Batch {
   long count = 0;
@@ -444,7 +452,16 @@ struct Batch {
 
 // pod columns
 enum { P_CPU = 0, P_MEM, P_EPH, P_NI64 };
-enum { P_PRIO = 0, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID, P_NI32 };
+enum {
+  P_PRIO = 0,
+  P_NODEID,
+  P_NSID,
+  P_TOLID,
+  P_LABELSID,
+  P_SELID,
+  P_AAFFID,
+  P_NI32,
+};
 enum { P_FLAGS = 0, P_NU8 };
 enum { PS_NAME = 0, PS_UID, PS_NSTR };
 enum {
@@ -454,22 +471,62 @@ enum {
   F_TERMINAL = 8,
   F_PENDING = 16,
   F_PVC = 32,      // any volume backed by a persistentVolumeClaim
-  F_REQAFF = 64,   // required node/pod (anti-)affinity expressions
+  F_REQAFF = 64,   // required affinity beyond the modeled spread shape
 };
 
-// true if the affinity object carries any required-during-scheduling term
-bool has_required_affinity(const Val* affinity) {
-  if (!affinity || affinity->kind != Val::Obj) return false;
-  for (const char* branch :
-       {"nodeAffinity", "podAffinity", "podAntiAffinity"}) {
+// The modeled anti-affinity shape (mirrors io/kube.py decode_pod): ONE
+// required podAntiAffinity term with topologyKey=kubernetes.io/hostname
+// and a matchLabels-only labelSelector. Returns the matchLabels object
+// and leaves *unmodeled false; anything else required sets *unmodeled.
+const Val* extract_anti_affinity(const Val* affinity, bool* unmodeled) {
+  if (!affinity || affinity->kind != Val::Obj) return nullptr;
+  for (const char* branch : {"nodeAffinity", "podAffinity"}) {
     const Val* b = affinity->get(branch);
     if (!b || b->kind != Val::Obj) continue;
     const Val* req = b->get("requiredDuringSchedulingIgnoredDuringExecution");
     if (!req) continue;
-    if (req->kind == Val::Arr && !req->arr.empty()) return true;
-    if (req->kind == Val::Obj && !req->obj.empty()) return true;
+    if ((req->kind == Val::Arr && !req->arr.empty()) ||
+        (req->kind == Val::Obj && !req->obj.empty()))
+      *unmodeled = true;
   }
-  return false;
+  const Val* anti = affinity->get("podAntiAffinity");
+  if (!anti || anti->kind != Val::Obj) return nullptr;
+  const Val* req = anti->get("requiredDuringSchedulingIgnoredDuringExecution");
+  if (!req || req->kind != Val::Arr || req->arr.empty()) return nullptr;
+  if (req->arr.size() != 1) {
+    *unmodeled = true;
+    return nullptr;
+  }
+  const Val* term = req->arr[0];
+  if (!term || term->kind != Val::Obj) return nullptr;
+  const Val* topo = term->get("topologyKey");
+  if (!topo || topo->kind != Val::Str ||
+      topo->text != "kubernetes.io/hostname") {
+    *unmodeled = true;
+    return nullptr;
+  }
+  const Val* ns_list = term->get("namespaces");
+  if (ns_list && ns_list->kind == Val::Arr && !ns_list->arr.empty()) {
+    *unmodeled = true;  // cross-namespace terms are not modeled
+    return nullptr;
+  }
+  const Val* sel = term->get("labelSelector");
+  if (!sel || sel->kind != Val::Obj) {
+    *unmodeled = true;
+    return nullptr;
+  }
+  if (const Val* me = sel->get("matchExpressions")) {
+    if (me->kind == Val::Arr && !me->arr.empty()) {
+      *unmodeled = true;
+      return nullptr;
+    }
+  }
+  const Val* ml = sel->get("matchLabels");
+  if (!ml || ml->kind != Val::Obj || ml->obj.empty()) {
+    *unmodeled = true;  // empty selector = matches everything; not modeled
+    return nullptr;
+  }
+  return ml;
 }
 
 // node columns
@@ -586,8 +643,12 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     }
     if (phase == "Succeeded" || phase == "Failed") flags |= F_TERMINAL;
     if (phase == "Pending") flags |= F_PENDING;
+    const Val* anti_affinity_labels = nullptr;
     if (spec) {
-      if (has_required_affinity(spec->get("affinity"))) flags |= F_REQAFF;
+      bool unmodeled = false;
+      anti_affinity_labels =
+          extract_anti_affinity(spec->get("affinity"), &unmodeled);
+      if (unmodeled) flags |= F_REQAFF;
       if (const Val* vols = spec->get("volumes")) {
         if (vols->kind == Val::Arr) {
           for (const Val* vol : vols->arr) {
@@ -621,6 +682,9 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     tmp.clear();
     blob_kv_into(&tmp, spec ? spec->get("nodeSelector") : nullptr);
     i32row(P_SELID) = b->intern_str(TBL_NODESEL, tmp);
+    tmp.clear();
+    blob_kv_into(&tmp, anti_affinity_labels);
+    i32row(P_AAFFID) = b->intern_str(TBL_AAFF, tmp);
 
     // tolerations: key\x1fvalue\x1foperator\x1feffect\x1e...
     tmp.clear();

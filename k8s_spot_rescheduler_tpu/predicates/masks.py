@@ -213,6 +213,59 @@ def node_affinity_mask(pods: Sequence[PodSpec]) -> np.ndarray:
     return mask
 
 
+# --- selector-based hostname anti-affinity (the k8s spread pattern) ------
+#
+# A pod with ``anti_affinity_match`` S refuses nodes hosting pods matched
+# by S, and matched pods symmetrically refuse nodes hosting it (what the
+# real scheduler enforces for existing pods' required anti-affinity).
+# Encoding: hash each distinct (namespace, selector) to a bit; a pod's
+# affinity mask is its own selector's bit (requirement) OR'd with the bit
+# of every universe selector that MATCHES the pod (presence). Since the
+# same mask is both the fit check and the placement contribution, any
+# requirement/presence overlap between two pods forbids co-location —
+# exactly the scheduler's symmetric check, over-restricting only in one
+# corner (two plain pods both merely *matched* by some third selector),
+# which is the safe direction: collisions can only lose a drain, never
+# strand a pod.
+
+
+def match_selector_key(namespace: str, items: Tuple[Tuple[str, str], ...]) -> str:
+    return namespace + "\x1d" + "\x1e".join(
+        f"{k}\x1f{v}" for k, v in items
+    )
+
+
+def collect_match_universe(pods) -> List[Tuple[str, Tuple[Tuple[str, str], ...]]]:
+    """Sorted distinct (namespace, selector items) across the pods —
+    deterministic, shared by both packers."""
+    return sorted(
+        {
+            (p.namespace, tuple(sorted(p.anti_affinity_match.items())))
+            for p in pods
+            if p.anti_affinity_match
+        }
+    )
+
+
+def match_affinity_mask(
+    namespace: str,
+    match_items: Tuple[Tuple[str, str], ...],
+    labels,
+    universe: Sequence[Tuple[str, Tuple[Tuple[str, str], ...]]],
+) -> np.ndarray:
+    """Requirement bit (own selector) | presence bits (universe selectors
+    matching this pod's labels, namespace-scoped)."""
+    mask = np.zeros(AFFINITY_WORDS, dtype=np.uint32)
+    if match_items:
+        w, b = affinity_bits(match_selector_key(namespace, match_items))
+        mask[w] |= np.uint32(1 << b)
+    for ns, items in universe:
+        if ns == namespace and all(labels.get(k) == v for k, v in items):
+            w, b = affinity_bits(match_selector_key(ns, items))
+            mask[w] |= np.uint32(1 << b)
+    return mask
+
+
 def fit_mask(
     xp,
     *,

@@ -1,0 +1,227 @@
+"""Selector-based hostname anti-affinity (the k8s spread pattern).
+
+A required podAntiAffinity with topologyKey=hostname and a matchLabels
+selector is modeled exactly (predicates/masks.py ``match_affinity_mask``):
+the pod refuses nodes hosting matched pods, and — symmetrically, like the
+real scheduler's check against existing pods' required anti-affinity —
+matched pods refuse nodes hosting it. These tests pin the semantics in
+the oracle, the packer parity, the native decoder, and the closed loop.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+
+def _pack(fc):
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    return pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+
+
+def spread_pod(name, cpu, node, app="db"):
+    return make_pod(
+        name, cpu, node,
+        labels={"app": app},
+        anti_affinity_match={"app": app},
+    )
+
+
+def test_spread_pair_lands_on_distinct_nodes():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    fc.add_pod(spread_pod("db-0", 300, "od-1"))
+    fc.add_pod(spread_pod("db-1", 200, "od-1"))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    targets = {
+        meta.spot[int(result.assignment[0, k])].node.name for k in range(2)
+    }
+    assert len(targets) == 2  # spread across both spot nodes
+
+
+def test_spread_infeasible_with_single_spot_node():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS, cpu_millis=8000))
+    fc.add_pod(spread_pod("db-0", 100, "od-1"))
+    fc.add_pod(spread_pod("db-1", 100, "od-1"))
+    packed, _ = _pack(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+
+
+def test_incoming_spread_pod_repelled_by_plain_resident():
+    """Directional: the resident matched pod has NO affinity of its own,
+    but the incoming spread pod must still avoid its node."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    # plain app=db pod already on spot-1 (most-requested -> probed first)
+    fc.add_pod(make_pod("resident", 500, "spot-1", labels={"app": "db"}))
+    fc.add_pod(spread_pod("db-new", 300, "od-1"))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-2"
+
+
+def test_incoming_matched_pod_repelled_by_resident_spread_pod():
+    """Symmetric: a plain pod that MATCHES a resident pod's required
+    anti-affinity selector must avoid that node (the real scheduler
+    enforces existing pods' required anti-affinity)."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    fc.add_pod(spread_pod("guard", 500, "spot-1"))
+    fc.add_pod(make_pod("plain-db", 300, "od-1", labels={"app": "db"}))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-2"
+
+
+def test_unrelated_pods_unaffected():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_pod(spread_pod("guard", 500, "spot-1"))
+    fc.add_pod(make_pod("web", 300, "od-1", labels={"app": "web"}))
+    packed, _ = _pack(fc)
+    assert bool(plan_oracle(packed).feasible[0])
+
+
+def test_columnar_parity_with_match_selectors():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-2", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    fc.add_pod(spread_pod("db-0", 300, "od-1"))
+    fc.add_pod(spread_pod("db-1", 250, "od-2"))
+    fc.add_pod(make_pod("plain-db", 150, "od-1", labels={"app": "db"}))
+    fc.add_pod(spread_pod("cache", 100, "spot-1", app="cache"))
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+
+
+def test_loop_spreads_drained_pods():
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    fc.add_pod(spread_pod("db-0", 300, "od-1"))
+    fc.add_pod(spread_pod("db-1", 200, "od-1"))
+    config = ReschedulerConfig(solver="numpy")
+    r = Rescheduler(fc, SolverPlanner(config), config, clock=clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    placed = {
+        n: [p.name for p in fc.list_pods_on_node(n)]
+        for n in ("spot-1", "spot-2")
+    }
+    assert sorted(len(v) for v in placed.values()) == [1, 1]
+    assert fc.pending == []
+
+
+def test_native_decode_of_anti_affinity_shapes():
+    ROOT = __file__.rsplit("/tests/", 1)[0]
+    proc = subprocess.run(["make", "native"], cwd=ROOT, capture_output=True)
+    if proc.returncode != 0:
+        pytest.skip("native build unavailable")
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+    from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+
+    native_ingest._lib.cache_clear()
+    if not native_ingest.available():
+        pytest.skip("native library failed to load")
+
+    def anti(term):
+        return {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": term}}
+
+    shapes = [
+        # the modeled spread shape
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"app": "db"}}}]),
+        # zone topology -> unmodeled
+        anti([{"topologyKey": "topology.kubernetes.io/zone",
+               "labelSelector": {"matchLabels": {"app": "db"}}}]),
+        # matchExpressions -> unmodeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchExpressions": [
+                   {"key": "app", "operator": "In", "values": ["db"]}]}}]),
+        # two terms -> unmodeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"app": "a"}}},
+              {"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"app": "b"}}}]),
+        # empty selector -> unmodeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {}}}]),
+        # cross-namespace -> unmodeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "namespaces": ["other"],
+               "labelSelector": {"matchLabels": {"app": "db"}}}]),
+        # preferred only -> no constraint at all
+        {"podAntiAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 1}]}},
+    ]
+    objs = [
+        {"metadata": {"name": f"p{i}", "uid": f"u{i}"},
+         "spec": {"nodeName": "n1", "containers": [], "affinity": aff},
+         "status": {"phase": "Running"}}
+        for i, aff in enumerate(shapes)
+    ]
+    batch = native_ingest.parse_pod_list(json.dumps({"items": objs}).encode())
+    for i, obj in enumerate(objs):
+        want = decode_pod(obj)
+        got = batch.view(i)
+        assert got.anti_affinity_match == want.anti_affinity_match, i
+        assert got.unmodeled_constraints == want.unmodeled_constraints, i
+    assert batch.view(0).anti_affinity_match == {"app": "db"}
+    assert not batch.view(0).unmodeled_constraints
+    assert not batch.view(6).unmodeled_constraints
