@@ -20,13 +20,117 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
+import traceback
 
 import numpy as np
 
 
 TARGET_MS = 200.0
+
+# --- backend acquisition + failure containment ---------------------------
+#
+# The TPU on this machine is reached through a tunnel whose backend can be
+# slow or flat-out unavailable at process start (round 1's driver run died
+# inside the first device_put with "Unable to initialize backend 'axon'",
+# and a bare jax.devices() has been observed to hang for minutes). The
+# bench must NEVER leave the driver with a stack dump and no JSON line, so:
+#
+#  - backend readiness is probed in a SUBPROCESS (killable on hang, unlike
+#    an in-process jax init) with bounded retry/backoff;
+#  - a watchdog hard-exits with a diagnostic JSON line if the whole bench
+#    overruns its budget;
+#  - main() is wrapped so any exception still emits the one-line JSON with
+#    an "error" field — the driver's `parsed` is never null.
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices()[0];"
+    "jnp.ones((8, 8)).sum().block_until_ready();"
+    "print(d.platform + '/' + d.device_kind)"
+)
+
+_emit_once = threading.Lock()
+
+
+def emit(obj: dict) -> None:
+    """Print THE one JSON line (at most once per process). The lock is
+    acquired and never released: whichever thread (main or watchdog) wins
+    the non-blocking acquire is the only one that prints."""
+    if not _emit_once.acquire(blocking=False):
+        return
+    print(json.dumps(obj), flush=True)
+
+
+def emit_error(metric: str, unit: str, error: str) -> None:
+    emit(
+        {
+            "metric": metric,
+            "value": None,
+            "unit": unit,
+            "vs_baseline": None,
+            "error": error[-600:],
+        }
+    )
+
+
+def start_watchdog(seconds: float, metric: str, unit: str) -> threading.Timer:
+    """Hard-exit with a diagnostic JSON line if the bench overruns —
+    a hung device fetch cannot be interrupted any other way."""
+
+    def fire() -> None:
+        emit_error(metric, unit, f"watchdog: bench exceeded {seconds:.0f}s budget")
+        sys.stdout.flush()
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def acquire_backend(
+    budget_s: float = 300.0, probe_timeout_s: float = 90.0
+) -> tuple:
+    """Probe jax backend readiness in killable subprocesses with backoff.
+
+    Returns (platform_desc or None, attempts, last_error). Success means a
+    fresh process completed device discovery AND a tiny computation within
+    the timeout, so the main process's own init is very likely to succeed
+    promptly."""
+    deadline = time.monotonic() + budget_s
+    attempt, last_err = 0, "no probe attempted"
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None, attempt - 1, last_err
+        this_timeout = min(probe_timeout_s, max(10.0, remaining))
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=this_timeout,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip().splitlines()[-1], attempt, None
+            last_err = (r.stderr or r.stdout).strip()[-400:] or (
+                "probe rc=%d" % r.returncode
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"backend probe hung >{this_timeout:.0f}s (killed)"
+        print(
+            f"backend probe attempt {attempt} failed: {last_err.splitlines()[-1] if last_err else '?'}",
+            file=sys.stderr,
+        )
+        if time.monotonic() >= deadline:
+            return None, attempt, last_err
+        time.sleep(min(15.0, 2.0 * attempt))
 
 
 def build_problem(config_id: int, seed: int = 0, spec=None):
@@ -96,36 +200,45 @@ def run_quality(seed: int, sweep: int = 1, solver: str = "numpy") -> int:
         f"mean {sum(ratios) / len(ratios):.3f}",
         file=sys.stderr,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "nodes_freed_vs_ilp_oracle_ratio",
-                "value": round(worst, 4),
-                "unit": "ratio",
-                "vs_baseline": round(worst / 0.95, 4),
-            }
-        )
+    emit(
+        {
+            "metric": "nodes_freed_vs_ilp_oracle_ratio",
+            "value": round(worst, 4),
+            "unit": "ratio",
+            "vs_baseline": round(worst / 0.95, 4),
+        }
     )
     return 0
 
 
-def run_replay_bench(seed: int, n_events: int) -> int:
+def run_replay_bench(seed: int, n_events: int, note=None) -> int:
     from k8s_spot_rescheduler_tpu.bench.replay import run_replay
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
     stats = run_replay(ReschedulerConfig(), n_events=n_events, seed=seed)
     print(f"replay: {stats}", file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": "replay_replan_ms_p50_1k_events",
-                "value": round(stats["replan_ms_p50"], 3),
-                "unit": "ms",
-                "vs_baseline": round(TARGET_MS / max(stats["replan_ms_p50"], 1e-9), 3),
-            }
-        )
-    )
+    out = {
+        "metric": "replay_replan_ms_p50_1k_events",
+        "value": round(stats["replan_ms_p50"], 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / max(stats["replan_ms_p50"], 1e-9), 3),
+    }
+    if note:
+        out["error"] = note
+    emit(out)
     return 0
+
+
+def _metric_for(args) -> tuple:
+    """(metric name, unit) this invocation will report — known up front so
+    failure paths can emit a well-formed JSON line."""
+    if args.quality:
+        return "nodes_freed_vs_ilp_oracle_ratio", "ratio"
+    if args.config == 5:
+        return "replay_replan_ms_p50_1k_events", "ms"
+    if args.config in (3, 4):
+        return "drain_plan_ms_config%d_50kpods_5knodes" % args.config, "ms"
+    return "drain_plan_ms_config%d" % args.config, "ms"
 
 
 def main() -> int:
@@ -149,8 +262,29 @@ def main() -> int:
                     help="event count for --config 5 replay")
     ap.add_argument("--scale", type=float, default=1.0,
                     help="multiply the config's node/pod counts (headroom runs)")
+    ap.add_argument("--watchdog", type=float, default=1500.0,
+                    help="hard wall-clock budget in seconds; 0 disables")
+    ap.add_argument("--backend-budget", type=float, default=300.0,
+                    help="max seconds spent acquiring a working jax backend")
+    ap.add_argument("--no-cpu-fallback", action="store_true",
+                    help="fail (with a JSON error line) instead of running "
+                         "on CPU when the TPU backend never comes up")
     args = ap.parse_args()
 
+    metric, unit = _metric_for(args)
+    if args.watchdog > 0:
+        start_watchdog(args.watchdog, metric, unit)
+
+    try:
+        return _dispatch(ap, args, metric, unit)
+    except SystemExit:
+        raise
+    except BaseException:
+        emit_error(metric, unit, traceback.format_exc())
+        return 1
+
+
+def _dispatch(ap, args, metric: str, unit: str) -> int:
     if args.quality:
         return run_quality(
             args.seed, sweep=args.sweep, solver=args.solver or "numpy"
@@ -159,9 +293,45 @@ def main() -> int:
     if args.solver == "numpy":
         ap.error("--solver numpy is the host oracle; use it with --quality "
                  "(the latency benchmark measures the device solvers)")
-    if args.config == 5:
-        return run_replay_bench(args.seed, args.events)
 
+    # Device paths (latency + replay): prove the backend is reachable from
+    # a killable subprocess BEFORE this process commits to a jax init.
+    platform, attempts, err = acquire_backend(budget_s=args.backend_budget)
+    backend_note = None
+    if platform is None:
+        if args.no_cpu_fallback:
+            emit_error(
+                metric, unit,
+                f"no usable jax backend after {attempts} probes: {err}",
+            )
+            return 1
+        backend_note = (
+            f"tpu backend unavailable after {attempts} probes "
+            f"({(err or '').splitlines()[-1] if err else '?'}); ran on CPU"
+        )
+        # The site customization snapshots JAX_PLATFORMS at interpreter
+        # start, so the env var alone is ignored by now — the config
+        # update after import is what actually reroutes to CPU.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        args.repeats = min(args.repeats, 3)
+        if args.solver == "pallas":
+            args.solver = "jax"  # interpret-mode pallas is unusable at scale
+        print(f"FALLBACK: {backend_note}", file=sys.stderr)
+    else:
+        print(
+            f"backend ready: {platform} (probe attempts: {attempts})",
+            file=sys.stderr,
+        )
+
+    if args.config == 5:
+        return run_replay_bench(args.seed, args.events, note=backend_note)
+    return _run_latency(args, metric, unit, backend_note)
+
+
+def _run_latency(args, metric: str, unit: str, backend_note) -> int:
     import jax
 
     spec = None
@@ -230,28 +400,33 @@ def main() -> int:
     # a network tunnel whose round trip (~65 ms) dwarfs the actual solve.
     # Chain N dependent solves in one program, fetch once, subtract the
     # round-trip floor — the per-solve quotient is what a locally attached
-    # v5e would see per tick.
+    # v5e would see per tick. (Skipped on the CPU fallback: 50 chained
+    # config-3 solves on host would blow the watchdog for no information.)
     N_CHAIN = 50
+    device_ms = float("nan")
+    if not backend_note:
 
-    def chained(p):
-        def step(i, acc):
-            p2 = p._replace(slot_req=p.slot_req + acc * 0.0)
-            return acc + fused(p2).sum().astype(jax.numpy.float32)
+        def chained(p):
+            def step(i, acc):
+                p2 = p._replace(slot_req=p.slot_req + acc * 0.0)
+                return acc + fused(p2).sum().astype(jax.numpy.float32)
 
-        return jax.lax.fori_loop(0, N_CHAIN, step, jax.numpy.float32(0.0))
+            return jax.lax.fori_loop(0, N_CHAIN, step, jax.numpy.float32(0.0))
 
-    chained_jit = jax.jit(chained)
-    rtt_jit = jax.jit(lambda p: p.cand_valid.sum())
-    np.asarray(chained_jit(device_packed)), np.asarray(rtt_jit(device_packed))
-    chain_t, rtt_t = [], []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        np.asarray(chained_jit(device_packed))
-        chain_t.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        np.asarray(rtt_jit(device_packed))
-        rtt_t.append(time.perf_counter() - t0)
-    device_ms = max(0.0, (np.median(chain_t) - np.median(rtt_t)) / N_CHAIN * 1e3)
+        chained_jit = jax.jit(chained)
+        rtt_jit = jax.jit(lambda p: p.cand_valid.sum())
+        np.asarray(chained_jit(device_packed)), np.asarray(rtt_jit(device_packed))
+        chain_t, rtt_t = [], []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(chained_jit(device_packed))
+            chain_t.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            np.asarray(rtt_jit(device_packed))
+            rtt_t.append(time.perf_counter() - t0)
+        device_ms = max(
+            0.0, (np.median(chain_t) - np.median(rtt_t)) / N_CHAIN * 1e3
+        )
 
     value_ms = float(np.median(times) * 1e3)
     e2e_ms = float(np.median(e2e) * 1e3)
@@ -265,20 +440,16 @@ def main() -> int:
         f"candidates, first={sel.index}  device {jax.devices()[0].device_kind}",
         file=sys.stderr,
     )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "drain_plan_ms_config%d_50kpods_5knodes" % args.config
-                    if args.config in (3, 4)
-                    else "drain_plan_ms_config%d" % args.config
-                ),
-                "value": round(value_ms, 3),
-                "unit": "ms",
-                "vs_baseline": round(TARGET_MS / value_ms, 3),
-            }
-        )
-    )
+    out = {
+        "metric": metric,
+        "value": round(value_ms, 3),
+        "unit": unit,
+        "vs_baseline": round(TARGET_MS / value_ms, 3),
+        "device": jax.devices()[0].device_kind,
+    }
+    if backend_note:
+        out["error"] = backend_note
+    emit(out)
     return 0
 
 

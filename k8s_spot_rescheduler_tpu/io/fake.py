@@ -101,11 +101,21 @@ class FakeCluster:
     def add_pod(self, pod: PodSpec) -> None:
         assert pod.node_name in self.nodes, f"unknown node {pod.node_name}"
         stale = self.pods.get(pod.uid)
+        self.pods[pod.uid] = pod  # dict upsert: position is preserved
         if stale is not None and stale.node_name != pod.node_name:
-            # a re-add under the same uid is a move: one placement only
+            # a re-add under the same uid is a move: one placement only.
+            # The production watch path derives its per-node view from
+            # the uid-keyed dict, where the upsert kept the pod's global
+            # position — rebuild the destination bucket in that order so
+            # CPU-tie slot order matches (moves are rare; O(pods)).
             self._by_node.get(stale.node_name, {}).pop(pod.uid, None)
-        self.pods[pod.uid] = pod
-        self._by_node.setdefault(pod.node_name, {})[pod.uid] = pod
+            self._by_node[pod.node_name] = {
+                p.uid: p
+                for p in self.pods.values()
+                if p.node_name == pod.node_name
+            }
+        else:
+            self._by_node.setdefault(pod.node_name, {})[pod.uid] = pod
         if self._columnar is not None:
             self._columnar.add_pod(pod)
 

@@ -125,10 +125,17 @@ def decode_anti_affinity(anti: dict) -> tuple:
         return {}, False
     if not isinstance(req, list) or len(req) != 1:
         return {}, True
-    term = req[0] or {}
+    term = req[0]
+    if not isinstance(term, dict):
+        return {}, True  # malformed element — conservatively unmodeled
     if term.get("topologyKey") != "kubernetes.io/hostname":
         return {}, True
     if term.get("namespaces"):
+        return {}, True
+    # namespaceSelector (k8s ≥1.21) widens the term beyond the pod's own
+    # namespace — even {} means "all namespaces". Presence of the key at
+    # all is outside the modeled own-namespace shape: unmodeled.
+    if "namespaceSelector" in term:
         return {}, True
     sel = term.get("labelSelector")
     if not isinstance(sel, dict):

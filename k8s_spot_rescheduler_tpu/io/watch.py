@@ -91,6 +91,15 @@ class ResourceStore:
         with self._lock:
             return list(self._items.values())
 
+    @property
+    def lock(self) -> threading.Lock:
+        """The store's mutation lock — for multi-store atomic freezes."""
+        return self._lock
+
+    def items_unlocked(self) -> List[object]:
+        """Like snapshot() but the caller already holds .lock."""
+        return list(self._items.values())
+
 
 class _Expired(Exception):
     """resourceVersion too old — fall back to a fresh LIST."""
@@ -421,16 +430,21 @@ class WatchingKubeClusterClient:
         self._have_tick_view = False
 
     def _freeze(self) -> None:
-        # the columnar mirror freezes at the same instant as the object
-        # view and the PDB list: one consistent per-tick cluster state
-        if self._feed is not None:
-            self._feed.sync()
-        by_node: Dict[str, List[PodSpec]] = {}
-        for pod in self.pods.snapshot():
-            by_node.setdefault(pod.node_name, []).append(pod)
-        self._pods_by_node = by_node
-        self._tick_nodes = list(self.nodes.snapshot())
-        self._tick_pdbs = list(self.pdbs.snapshot())
+        # The columnar mirror freezes at the same instant as the object
+        # view and the PDB list: one consistent per-tick cluster state.
+        # All three store locks are held while the delta feed drains and
+        # the object views are copied — watcher threads mutate (and
+        # enqueue deltas) only under their store's lock, so nothing can
+        # land between the mirror drain and the object snapshot.
+        with self.nodes.lock, self.pods.lock, self.pdbs.lock:
+            if self._feed is not None:
+                self._feed.sync()
+            by_node: Dict[str, List[PodSpec]] = {}
+            for pod in self.pods.items_unlocked():
+                by_node.setdefault(pod.node_name, []).append(pod)
+            self._pods_by_node = by_node
+            self._tick_nodes = list(self.nodes.items_unlocked())
+            self._tick_pdbs = list(self.pdbs.items_unlocked())
         self._have_tick_view = True
 
     def _view(self) -> None:

@@ -260,6 +260,10 @@ class ColumnarStore:
         # pods whose node hasn't been observed yet (a watch can deliver a
         # pod ADDED before its node ADDED); flushed when the node appears
         self._orphans: Dict[str, Dict[str, PodSpec]] = {}
+        # slot sequence of a parked pod: the object path's dict keeps a
+        # parked pod's insertion position, so when it un-parks it must get
+        # its old seq back, not a fresh one (CPU-tie slot-order parity)
+        self._parked_seq: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # growth helpers
@@ -372,8 +376,10 @@ class ColumnarStore:
         for row in stale:
             pod = self.pod_objs[int(row)]
             if pod is not None:
+                seq = int(self.p_seq[int(row)])
                 self.remove_pod(pod.uid)
                 self._orphans.setdefault(name, {})[pod.uid] = pod
+                self._parked_seq[pod.uid] = seq
         self.n_live[r] = False
         self.node_objs[r] = None
         self._node_free.append(r)
@@ -387,10 +393,13 @@ class ColumnarStore:
         old_row = self._pod_row.get(pod.uid)
         if old_row is not None:
             old_pod = self.pod_objs[old_row]
-            if old_pod is not None and old_pod.node_name == pod.node_name:
-                # same-node upsert (a watch MODIFIED event): the object
-                # path's dict update keeps the pod's position, so keep its
-                # sequence too — slot ties must not reorder (parity).
+            if old_pod is not None:
+                # upsert (a watch MODIFIED event): the object path's dict
+                # update keeps the pod's position regardless of which
+                # field changed, so keep its sequence too — slot ties must
+                # not reorder (parity). Real k8s never changes
+                # spec.nodeName for a uid, but synthetic/fake feeds can,
+                # and the bit-parity contract must hold there as well.
                 keep_seq = int(self.p_seq[old_row])
             self.remove_pod(pod.uid)
         node_row = self._node_row.get(pod.node_name)
@@ -399,6 +408,11 @@ class ColumnarStore:
             # node_name "" and stay invisible, like the object path)
             if pod.node_name:
                 self._orphans.setdefault(pod.node_name, {})[pod.uid] = pod
+                if keep_seq is not None:
+                    # a live pod moving to an unseen node keeps its dict
+                    # position on the object path — remember its seq for
+                    # the un-park
+                    self._parked_seq[pod.uid] = keep_seq
             return
         if not self._pod_free:
             self._grow_pods()
@@ -448,6 +462,10 @@ class ColumnarStore:
             self._aff_lists.append(akey)
             self._aff_universe_key = None  # force matrix rebuild
         self.p_aff_id[r] = aid
+        if keep_seq is None:
+            keep_seq = self._parked_seq.pop(pod.uid, None)  # un-park
+        else:
+            self._parked_seq.pop(pod.uid, None)
         if keep_seq is not None:
             self.p_seq[r] = keep_seq
         else:
@@ -465,6 +483,7 @@ class ColumnarStore:
             for orphans in self._orphans.values():
                 if orphans.pop(uid, None) is not None:
                     break
+            self._parked_seq.pop(uid, None)
             return
         pod = self.pod_objs[r]
         self.p_live[r] = False
@@ -512,6 +531,7 @@ class ColumnarStore:
         # orphans either reappear in this batch (and re-park below if
         # their node is still unknown) or no longer exist
         self._orphans.clear()
+        self._parked_seq.clear()
 
         # numeric columns, scaled exactly like _scale_requests
         req = np.empty((k, R), np.float32)
@@ -631,6 +651,7 @@ class ColumnarStore:
         for orphans in self._orphans.values():
             for uid in [u for u in orphans if u not in new_uids]:
                 del orphans[uid]
+                self._parked_seq.pop(uid, None)
         for pod in pods:
             self.add_pod(pod)
 

@@ -474,6 +474,24 @@ enum {
   F_REQAFF = 64,   // required affinity beyond the modeled spread shape
 };
 
+// Python truthiness of a JSON value — the decode contract is "exact
+// lockstep with io/kube.py", whose guards are plain `if value:` checks.
+bool py_truthy(const Val* v) {
+  if (!v) return false;
+  switch (v->kind) {
+    case Val::Null: return false;
+    case Val::Bool: return v->b;
+    case Val::Num: {
+      std::string txt(v->text);
+      return strtod(txt.c_str(), nullptr) != 0.0;
+    }
+    case Val::Str: return !v->text.empty();
+    case Val::Arr: return !v->arr.empty();
+    case Val::Obj: return !v->obj.empty();
+  }
+  return false;
+}
+
 // The modeled anti-affinity shape (mirrors io/kube.py decode_pod): ONE
 // required podAntiAffinity term with topologyKey=kubernetes.io/hostname
 // and a matchLabels-only labelSelector. Returns the matchLabels object
@@ -483,31 +501,44 @@ const Val* extract_anti_affinity(const Val* affinity, bool* unmodeled) {
   for (const char* branch : {"nodeAffinity", "podAffinity"}) {
     const Val* b = affinity->get(branch);
     if (!b || b->kind != Val::Obj) continue;
-    const Val* req = b->get("requiredDuringSchedulingIgnoredDuringExecution");
-    if (!req) continue;
-    if ((req->kind == Val::Arr && !req->arr.empty()) ||
-        (req->kind == Val::Obj && !req->obj.empty()))
+    if (py_truthy(b->get("requiredDuringSchedulingIgnoredDuringExecution")))
       *unmodeled = true;
   }
   const Val* anti = affinity->get("podAntiAffinity");
   if (!anti || anti->kind != Val::Obj) return nullptr;
   const Val* req = anti->get("requiredDuringSchedulingIgnoredDuringExecution");
-  if (!req || req->kind != Val::Arr || req->arr.empty()) return nullptr;
+  if (!req) return nullptr;
+  if (req->kind != Val::Arr) {
+    // Python lockstep: a truthy non-list is unmodeled, a falsy value
+    // (null/false/0/""/{}) counts as absent.
+    if (py_truthy(req)) *unmodeled = true;
+    return nullptr;
+  }
+  if (req->arr.empty()) return nullptr;
   if (req->arr.size() != 1) {
     *unmodeled = true;
     return nullptr;
   }
   const Val* term = req->arr[0];
-  if (!term || term->kind != Val::Obj) return nullptr;
+  if (!term || term->kind != Val::Obj) {
+    *unmodeled = true;  // malformed element — Python marks it unmodeled
+    return nullptr;
+  }
   const Val* topo = term->get("topologyKey");
   if (!topo || topo->kind != Val::Str ||
       topo->text != "kubernetes.io/hostname") {
     *unmodeled = true;
     return nullptr;
   }
-  const Val* ns_list = term->get("namespaces");
-  if (ns_list && ns_list->kind == Val::Arr && !ns_list->arr.empty()) {
+  if (py_truthy(term->get("namespaces"))) {
     *unmodeled = true;  // cross-namespace terms are not modeled
+    return nullptr;
+  }
+  // namespaceSelector (k8s >=1.21) widens the term beyond the pod's own
+  // namespace; even {} means "all namespaces". Key presence at all is
+  // outside the modeled own-namespace shape (Python lockstep).
+  if (term->get("namespaceSelector") != nullptr) {
+    *unmodeled = true;
     return nullptr;
   }
   const Val* sel = term->get("labelSelector");

@@ -205,6 +205,25 @@ def test_native_decode_of_anti_affinity_shapes():
         anti([{"topologyKey": "kubernetes.io/hostname",
                "namespaces": ["other"],
                "labelSelector": {"matchLabels": {"app": "db"}}}]),
+        # namespaceSelector present (even {}) widens beyond the pod's own
+        # namespace -> unmodeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "namespaceSelector": {},
+               "labelSelector": {"matchLabels": {"app": "db"}}}]),
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "namespaceSelector": {"matchLabels": {"team": "x"}},
+               "labelSelector": {"matchLabels": {"app": "db"}}}]),
+        # required present but not an array (malformed) -> unmodeled
+        anti({"topologyKey": "kubernetes.io/hostname"}),
+        # required falsy non-array (malformed) -> treated as absent
+        anti({}),
+        # null / non-object element inside required -> unmodeled
+        anti([None]),
+        anti(["x"]),
+        # truthy non-array namespaces (malformed) -> unmodeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "namespaces": "other",
+               "labelSelector": {"matchLabels": {"app": "db"}}}]),
         # preferred only -> no constraint at all
         {"podAntiAffinity": {
             "preferredDuringSchedulingIgnoredDuringExecution": [
@@ -224,4 +243,11 @@ def test_native_decode_of_anti_affinity_shapes():
         assert got.unmodeled_constraints == want.unmodeled_constraints, i
     assert batch.view(0).anti_affinity_match == {"app": "db"}
     assert not batch.view(0).unmodeled_constraints
-    assert not batch.view(6).unmodeled_constraints
+    assert batch.view(6).unmodeled_constraints  # namespaceSelector {}
+    assert batch.view(7).unmodeled_constraints  # namespaceSelector set
+    assert batch.view(8).unmodeled_constraints  # non-array required
+    assert not batch.view(9).unmodeled_constraints  # falsy required
+    assert batch.view(10).unmodeled_constraints  # [null] element
+    assert batch.view(11).unmodeled_constraints  # ["x"] element
+    assert batch.view(12).unmodeled_constraints  # namespaces: "other"
+    assert not batch.view(13).unmodeled_constraints  # preferred only

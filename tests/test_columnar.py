@@ -272,6 +272,51 @@ def test_same_node_upsert_keeps_slot_order():
     assert col.slot_req[0, :2, 1].tolist() == [64.0, 128.0]  # a first
 
 
+def test_node_changing_upsert_keeps_slot_order():
+    """A MODIFIED event that moves a uid across nodes keeps the pod's
+    dict position on the object path — the mirror must keep its seq."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-2", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    a = make_pod("a", 300, "od-1", memory=64 * 1024**2)
+    fc.add_pod(a)
+    store = columnar(fc, ("cpu", "memory"))
+    fc.add_pod(make_pod("b", 300, "od-2", memory=128 * 1024**2))
+    fc.add_pod(dataclasses.replace(a, node_name="od-2"))  # move: a first
+    obj, _ = object_pack(fc, ("cpu", "memory"))
+    col, _ = store.pack([])
+    assert_packed_equal(obj, col)
+
+
+def test_move_to_unseen_node_then_node_appears_keeps_slot_order():
+    """A move to a not-yet-observed node parks the pod; when the node
+    shows up, the un-parked pod must resume its original slot position
+    (the object path's dict never moved it)."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    a = make_pod("a", 300, "od-1", memory=64 * 1024**2)
+    b = make_pod("b", 300, "od-1", memory=128 * 1024**2)
+    fc.add_pod(a)
+    fc.add_pod(b)
+    store = columnar(fc, ("cpu", "memory"))
+    # watch ordering the fake forbids but a real stream can deliver:
+    # both pods move to od-2 (b first, then a) BEFORE od-2 is observed
+    od2 = make_node("od-2", ON_DEMAND_LABELS)
+    store.add_pod(dataclasses.replace(b, node_name="od-2"))
+    store.add_pod(dataclasses.replace(a, node_name="od-2"))
+    store.add_node(od2)
+    # bring the object truth to the same end state (its dict order is
+    # insertion order: a then b, positions unmoved by the updates)
+    fc.add_node(od2)
+    fc.add_pod(dataclasses.replace(b, node_name="od-2"))
+    fc.add_pod(dataclasses.replace(a, node_name="od-2"))
+    obj, _ = object_pack(fc, ("cpu", "memory"))
+    col, _ = store.pack([])
+    assert_packed_equal(obj, col)
+
+
 def test_loop_parity_columnar_vs_object():
     """Same cluster, same solver: the columnar and object observe paths
     must drain the same nodes tick for tick."""
