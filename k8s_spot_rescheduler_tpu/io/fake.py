@@ -23,6 +23,7 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
     PodSpec,
     Taint,
 )
+from k8s_spot_rescheduler_tpu.predicates.masks import match_node_affinity
 from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
@@ -202,6 +203,8 @@ class FakeCluster:
             if not node.ready or node.unschedulable:
                 continue
             if any(node.labels.get(k) != v for k, v in pod.node_selector.items()):
+                continue
+            if not match_node_affinity(pod.node_affinity, node.labels):
                 continue
             hard = [t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")]
             if any(

@@ -77,20 +77,27 @@ def decode_pod(obj: dict) -> PodSpec:
         )
         for t in spec.get("tolerations", []) or []
     ]
-    # constraints beyond the modeled predicate set (required affinity
-    # expressions, PVC/volume topology) mark the pod conservatively
-    # unplaceable — its node can never be proven drainable, never stranded
+    # constraints beyond the modeled predicate set (required pod-affinity,
+    # matchFields node-affinity, PVC/volume topology) mark the pod
+    # conservatively unplaceable — its node can never be proven drainable,
+    # never stranded. Required node-affinity matchExpressions ARE modeled:
+    # they canonicalize into per-requirement pseudo-taint bits
+    # (predicates/masks.NodeAffinityBit), replacing the reference's
+    # delegation to the real scheduler's affinity predicate
+    # (rescheduler.go:344; README.md:103-114).
     affinity = spec.get("affinity") or {}
-    required_affinity = any(
-        (affinity.get(branch) or {}).get(
+    required_affinity = bool(
+        (affinity.get("podAffinity") or {}).get(
             "requiredDuringSchedulingIgnoredDuringExecution"
         )
-        for branch in ("nodeAffinity", "podAffinity")
+    )
+    node_affinity, naff_unmodeled = decode_node_affinity(
+        affinity.get("nodeAffinity") or {}
     )
     anti_affinity_match, anti_unmodeled = decode_anti_affinity(
         affinity.get("podAntiAffinity") or {}
     )
-    required_affinity = required_affinity or anti_unmodeled
+    required_affinity = required_affinity or naff_unmodeled or anti_unmodeled
     has_pvc = any(
         "persistentVolumeClaim" in (vol or {})
         for vol in spec.get("volumes", []) or []
@@ -108,8 +115,71 @@ def decode_pod(obj: dict) -> PodSpec:
         phase=obj.get("status", {}).get("phase", "Running"),
         node_selector=spec.get("nodeSelector", {}) or {},
         anti_affinity_match=anti_affinity_match,
+        node_affinity=node_affinity,
         unmodeled_constraints=bool(required_affinity or has_pvc),
     )
+
+
+_NODE_AFFINITY_OPS = ("In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt")
+
+
+def decode_node_affinity(node_aff: dict) -> tuple:
+    """(canonical terms, unmodeled) for a nodeAffinity object.
+
+    The modeled shape is requiredDuringSchedulingIgnoredDuringExecution
+    .nodeSelectorTerms where every term uses only matchExpressions with
+    the six NodeSelectorOperator values. Canonical form: terms and the
+    expressions within each term sorted, In/NotIn value lists
+    sorted+deduped — so equal requirements intern to one pseudo-taint
+    bit. Terms that match nothing (empty) are dropped (k8s: a nil/empty
+    term selects no objects); if every term drops, the requirement
+    matches no node — conservatively unmodeled (same unplaceable
+    effect). matchFields (node metadata, not labels) is unmodeled."""
+    req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not req:
+        return (), False
+    if not isinstance(req, dict):
+        return (), True
+    term_list = req.get("nodeSelectorTerms")
+    if not isinstance(term_list, list) or not term_list:
+        return (), True
+    terms = []
+    for term in term_list:
+        if not isinstance(term, dict):
+            return (), True
+        if term.get("matchFields"):
+            return (), True
+        exprs_in = term.get("matchExpressions") or []
+        if not isinstance(exprs_in, list):
+            return (), True
+        exprs = []
+        for e in exprs_in:
+            if not isinstance(e, dict):
+                return (), True
+            key, op = e.get("key"), e.get("operator")
+            if not isinstance(key, str) or op not in _NODE_AFFINITY_OPS:
+                return (), True
+            values = e.get("values") or []
+            if not isinstance(values, list) or not all(
+                isinstance(v, str) for v in values
+            ):
+                return (), True
+            if op in ("Exists", "DoesNotExist"):
+                values = ()
+            elif op in ("Gt", "Lt"):
+                if len(values) != 1:
+                    return (), True
+                values = tuple(values)
+            else:  # In / NotIn with at least one value (k8s validation)
+                if not values:
+                    return (), True
+                values = tuple(sorted(set(values)))
+            exprs.append((key, op, values))
+        if exprs:
+            terms.append(tuple(sorted(exprs)))
+    if not terms:
+        return (), True  # all terms match nothing: unplaceable
+    return tuple(sorted(set(terms))), False
 
 
 def decode_anti_affinity(anti: dict) -> tuple:

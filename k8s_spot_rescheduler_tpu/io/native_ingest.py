@@ -348,6 +348,13 @@ class PodView:
         return self._b.selector_set(int(self._b.i32[self._i, P_SELID]))
 
     @property
+    def node_affinity(self) -> tuple:
+        # the native engine flags any required nodeAffinity as F_REQAFF
+        # (unmodeled) rather than canonicalizing terms, so the modeled
+        # requirement is always empty on this path
+        return ()
+
+    @property
     def unmodeled_constraints(self) -> bool:
         return bool(self._b.u8[self._i, 0] & (F_PVC | F_REQAFF))
 

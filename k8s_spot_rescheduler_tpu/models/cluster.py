@@ -91,11 +91,21 @@ class PodSpec:
     # one of these labels (the kube-scheduler's NodeSelector predicate,
     # part of the reference's CheckPredicates surface, README.md:103-114).
     node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
-    # Scheduling constraints this framework does not model (required node
-    # affinity expressions, PVC/volume topology). Conservative in the safe
-    # direction: such a pod is treated as placeable nowhere, so its node
-    # can never be proven drainable — we may miss a drain the real
-    # scheduler would allow, but never approve one that strands the pod.
+    # Required node-affinity (spec.affinity.nodeAffinity.requiredDuring
+    # SchedulingIgnoredDuringExecution), canonicalized: a tuple of terms
+    # (OR), each a tuple of (key, operator, values) expressions (AND)
+    # with operators In/NotIn/Exists/DoesNotExist/Gt/Lt — the full
+    # NodeSelectorTerm matchExpressions surface. Evaluated host-side per
+    # node (predicates/masks.match_node_affinity) and interned as one
+    # pseudo-taint bit per distinct requirement. matchFields and
+    # malformed shapes fall back to ``unmodeled_constraints``.
+    node_affinity: Tuple = ()
+    # Scheduling constraints this framework does not model (PVC/volume
+    # topology, matchFields node affinity, required pod-affinity).
+    # Conservative in the safe direction: such a pod is treated as
+    # placeable nowhere, so its node can never be proven drainable — we
+    # may miss a drain the real scheduler would allow, but never approve
+    # one that strands the pod.
     unmodeled_constraints: bool = False
 
     @property

@@ -59,12 +59,14 @@ from k8s_spot_rescheduler_tpu.models.tensors import (
 from k8s_spot_rescheduler_tpu.predicates.masks import (
     AFFINITY_WORDS,
     HARD_EFFECTS,
+    NodeAffinityBit,
     SelectorBit,
     Taint,
     TaintTable,
     affinity_bits,
     intern_constraints,
     match_affinity_mask,
+    match_node_affinity,
 )
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
@@ -232,18 +234,23 @@ class ColumnarStore:
         self._tol_matrix = np.zeros((0, 1), np.uint32)  # [n_tol_ids, W]
         self._node_mask_cache: Dict[tuple, np.ndarray] = {}
         # Sectioned constraint-table caches. The table is [real taints |
-        # selector pairs | unplaceable]; the real prefix is stable across
-        # ticks while the selector tail follows the current slot set —
-        # caching *bit positions* per section means a universe change
-        # only recomputes the cheap tail, not every toleration mask.
+        # selector pairs | node-affinity requirements | unplaceable]; the
+        # real prefix is stable across ticks while the pseudo-taint tail
+        # follows the current slot set — caching *bit positions* per
+        # section means a universe change only recomputes the cheap
+        # tail, not every toleration mask.
         self._real_section: tuple = ()
         self._sel_section: tuple = (0, ())
         self._sel_keys: List[str] = []  # selector keys in the current table
+        self._naff_section: tuple = (0, ())
+        self._naff_keys: List[str] = []  # label keys affinity exprs read
         self._unplace_pos: int = 0
         self._real_tol_pos: Dict[tuple, tuple] = {}
         self._sel_tol_pos: Dict[tuple, tuple] = {}
+        self._naff_tol_pos: Dict[tuple, tuple] = {}
         self._real_node_pos: Dict[tuple, tuple] = {}
         self._sel_node_pos: Dict[tuple, tuple] = {}
+        self._naff_node_pos: Dict[tuple, tuple] = {}
 
         # affinity-profile interning: (group, ns, match sel, labels) -> id;
         # the per-profile mask matrix depends on the tick's selector
@@ -435,11 +442,12 @@ class ColumnarStore:
             if ref.kind == "DaemonSet":
                 flags |= _DAEMONSET
         self.p_flags[r] = flags
-        # one interned id per distinct scheduling-constraint triple:
-        # (tolerations, nodeSelector, unmodeled-constraints flag)
+        # one interned id per distinct scheduling-constraint profile:
+        # (tolerations, nodeSelector, node-affinity, unmodeled flag)
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
+            pod.node_affinity,
             bool(pod.unmodeled_constraints),
         )
         tid = self._tol_keys.get(key)
@@ -570,9 +578,13 @@ class ColumnarStore:
         uniq, inverse = np.unique(combos, axis=0, return_inverse=True)
         ids = np.empty(len(uniq), np.int32)
         for i, (tol_id, sel_id, um) in enumerate(uniq):
+            # native pods carry no modeled node-affinity yet: the engine
+            # flags any required nodeAffinity as unmodeled (F_REQAFF), so
+            # the terms entry is always () on this path
             key = (
                 tuple(batch.tol_sets[tol_id]),
                 tuple(sorted(batch.selector_set(int(sel_id)).items())),
+                (),
                 bool(um),
             )
             tid = self._tol_keys.get(key)
@@ -687,17 +699,26 @@ class ColumnarStore:
         (``masks.intern_constraints`` over the sorted ``node_map.spot``
         and the concatenated ``cand_pods``)."""
         pairs = set()
+        naffs = set()
         if len(slot_rows):
             for cid in np.unique(self.p_tol_id[slot_rows]):
-                pairs.update(self._tol_lists[int(cid)][1])
+                profile = self._tol_lists[int(cid)]
+                pairs.update(profile[1])
+                if profile[2]:
+                    naffs.add(profile[2])
         return intern_constraints(
-            [self.node_objs[int(r)] for r in spot_order], sorted(pairs)
+            [self.node_objs[int(r)] for r in spot_order],
+            sorted(pairs),
+            sorted(naffs),
         )
 
     def _refresh_sections(self, table: TaintTable) -> None:
         real = tuple(e for e in table.taints if isinstance(e, Taint))
         pairs = tuple(
             (e.key, e.value) for e in table.taints if isinstance(e, SelectorBit)
+        )
+        naffs = tuple(
+            e.terms for e in table.taints if isinstance(e, NodeAffinityBit)
         )
         offset = len(real)
         if self._real_section != real:
@@ -709,7 +730,15 @@ class ColumnarStore:
             self._sel_tol_pos.clear()
             self._sel_node_pos.clear()
             self._sel_keys = sorted({k for k, _ in pairs})
-        self._unplace_pos = offset + len(pairs)
+        naff_off = offset + len(pairs)
+        if self._naff_section != (naff_off, naffs):
+            self._naff_section = (naff_off, naffs)
+            self._naff_tol_pos.clear()
+            self._naff_node_pos.clear()
+            self._naff_keys = sorted(
+                {e[0] for terms in naffs for term in terms for e in term}
+            )
+        self._unplace_pos = naff_off + len(naffs)
 
     @staticmethod
     def _mk_mask(positions, words: int) -> np.ndarray:
@@ -727,7 +756,8 @@ class ColumnarStore:
             W = table.words
             rows = np.zeros((len(self._tol_lists), W), np.uint32)
             off, pairs = self._sel_section
-            for i, (tols, sel, unmodeled) in enumerate(self._tol_lists):
+            naff_off, naffs = self._naff_section
+            for i, (tols, sel, naff, unmodeled) in enumerate(self._tol_lists):
                 pos = self._real_tol_pos.get(tols)
                 if pos is None:
                     pos = self._real_tol_pos[tols] = tuple(
@@ -741,8 +771,15 @@ class ColumnarStore:
                         off + j for j, (k, v) in enumerate(pairs)
                         if required.get(k) != v
                     )
+                npos = self._naff_tol_pos.get(naff)
+                if npos is None:
+                    # tolerate every requirement bit except the pod's own
+                    npos = self._naff_tol_pos[naff] = tuple(
+                        naff_off + j for j, t in enumerate(naffs)
+                        if t != naff
+                    )
                 unplace = () if unmodeled else (self._unplace_pos,)
-                rows[i] = self._mk_mask(pos + spos + unplace, W)
+                rows[i] = self._mk_mask(pos + spos + npos + unplace, W)
             self._tol_matrix = rows
         return self._tol_matrix
 
@@ -750,7 +787,9 @@ class ColumnarStore:
         node = self.node_objs[row]
         taints = tuple(t for t in node.taints if t.effect in HARD_EFFECTS)
         labelvals = tuple(node.labels.get(k) for k in self._sel_keys)
-        cached = self._node_mask_cache.get((taints, labelvals))
+        nlabelvals = tuple(node.labels.get(k) for k in self._naff_keys)
+        cache_key = (taints, labelvals, nlabelvals)
+        cached = self._node_mask_cache.get(cache_key)
         if cached is None:
             pos = self._real_node_pos.get(taints)
             if pos is None:
@@ -766,8 +805,21 @@ class ColumnarStore:
                     off + j for j, (k, v) in enumerate(pairs)
                     if labels.get(k) != v
                 )
-            cached = self._node_mask_cache[(taints, labelvals)] = self._mk_mask(
-                pos + spos + (self._unplace_pos,), table.words
+            npos = self._naff_node_pos.get(nlabelvals)
+            if npos is None:
+                naff_off, naffs = self._naff_section
+                # affinity exprs read only _naff_keys, so this dict is a
+                # complete stand-in for the node's labels here
+                labels = dict(zip(self._naff_keys, nlabelvals))
+                npos = self._naff_node_pos[nlabelvals] = tuple(
+                    naff_off + j for j, terms in enumerate(naffs)
+                    if not match_node_affinity(
+                        terms,
+                        {k: v for k, v in labels.items() if v is not None},
+                    )
+                )
+            cached = self._node_mask_cache[cache_key] = self._mk_mask(
+                pos + spos + npos + (self._unplace_pos,), table.words
             )
         return cached
 

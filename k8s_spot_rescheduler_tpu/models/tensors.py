@@ -50,6 +50,7 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     constraint_mask,
     intern_constraints,
     match_affinity_mask,
+    node_affinity_universe,
     node_constraint_mask,
     pod_affinity_mask,
     selector_universe,
@@ -186,10 +187,13 @@ def pack_cluster(
         blocking.append(blocked)
 
     # constraint table: the spot pool's hard taints + pseudo-taints for
-    # the slot pods' nodeSelector pairs and unmodeled constraints
+    # the slot pods' nodeSelector pairs, required node-affinity
+    # expressions, and unmodeled constraints
+    slot_pods_flat = [p for pods in cand_pods for p in pods]
     table = intern_constraints(
         [n.node for n in spot],
-        selector_universe([p for pods in cand_pods for p in pods]),
+        selector_universe(slot_pods_flat),
+        node_affinity_universe(slot_pods_flat),
     )
     # anti-affinity selector universe spans every counted pod (resident
     # spot pods repel incoming matches and vice versa)
@@ -253,6 +257,7 @@ def pack_cluster(
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
+            pod.node_affinity,
             pod.unmodeled_constraints,
         )
         row = tol_cache.get(key)
@@ -260,6 +265,7 @@ def pack_cluster(
             row = tol_cache[key] = constraint_mask(
                 pod.tolerations, pod.node_selector,
                 pod.unmodeled_constraints, table,
+                node_affinity=pod.node_affinity,
             )
         return row
 

@@ -416,6 +416,7 @@ enum {
   TBL_LABELS,
   TBL_NODESEL,
   TBL_AAFF,
+  TBL_NAFF,  // required node-affinity blobs (see extract_node_affinity)
   TBL_COUNT,
 };
 
@@ -460,6 +461,7 @@ enum {
   P_LABELSID,
   P_SELID,
   P_AAFFID,
+  P_NAFFID,
   P_NI32,
 };
 enum { P_FLAGS = 0, P_NU8 };
@@ -558,6 +560,123 @@ const Val* extract_anti_affinity(const Val* affinity, bool* unmodeled) {
     return nullptr;
   }
   return ml;
+}
+
+// Required node-affinity, in lockstep with io/kube.py
+// decode_node_affinity's MODELED/UNMODELED decisions. The blob carries
+// the terms in source order — canonicalization (sorting, dedup) happens
+// once on the Python side when the blob is parsed, so no cross-language
+// sort-order contract is needed. Encoding (k8s label keys/values are
+// control-char-free): terms '\x1d', exprs within a term '\x1e' (REC_SEP),
+// expr fields key/op/values '\x1f' (UNIT_SEP), values '\x1c'. Empty blob
+// = no modeled requirement.
+constexpr char TERM_SEP = '\x1d';
+constexpr char VAL_SEP = '\x1c';
+
+static const char* const kNaffOps[] = {"In",     "NotIn", "Exists",
+                                       "DoesNotExist", "Gt", "Lt"};
+
+void extract_node_affinity(const Val* naff, bool* unmodeled,
+                           std::string* blob) {
+  blob->clear();
+  if (!naff || naff->kind != Val::Obj) return;
+  const Val* req = naff->get("requiredDuringSchedulingIgnoredDuringExecution");
+  if (!py_truthy(req)) return;  // falsy: no requirement
+  if (req->kind != Val::Obj) {
+    *unmodeled = true;
+    return;
+  }
+  const Val* term_list = req->get("nodeSelectorTerms");
+  if (!term_list || term_list->kind != Val::Arr || term_list->arr.empty()) {
+    *unmodeled = true;
+    return;
+  }
+  std::string out;
+  bool any_term = false;
+  for (const Val* term : term_list->arr) {
+    if (!term || term->kind != Val::Obj) {
+      *unmodeled = true;
+      return;
+    }
+    if (py_truthy(term->get("matchFields"))) {
+      *unmodeled = true;  // node metadata fields are not modeled
+      return;
+    }
+    const Val* exprs = term->get("matchExpressions");
+    if (!py_truthy(exprs)) continue;  // empty term matches nothing: drop
+    if (exprs->kind != Val::Arr) {
+      *unmodeled = true;
+      return;
+    }
+    std::string term_out;
+    bool first_expr = true;
+    for (const Val* e : exprs->arr) {
+      if (!e || e->kind != Val::Obj) {
+        *unmodeled = true;
+        return;
+      }
+      const Val* key = e->get("key");
+      const Val* op = e->get("operator");
+      if (!key || key->kind != Val::Str || !op || op->kind != Val::Str) {
+        *unmodeled = true;
+        return;
+      }
+      bool known = false;
+      for (const char* k : kNaffOps) known |= (op->text == k);
+      if (!known) {
+        *unmodeled = true;
+        return;
+      }
+      const Val* values = e->get("values");
+      size_t n_values = 0;
+      if (values && py_truthy(values)) {
+        if (values->kind != Val::Arr) {
+          *unmodeled = true;
+          return;
+        }
+        for (const Val* v : values->arr) {
+          if (!v || v->kind != Val::Str) {
+            *unmodeled = true;
+            return;
+          }
+        }
+        n_values = values->arr.size();
+      }
+      bool exists_op =
+          op->text == "Exists" || op->text == "DoesNotExist";
+      if (op->text == "Gt" || op->text == "Lt") {
+        if (n_values != 1) {
+          *unmodeled = true;
+          return;
+        }
+      } else if (!exists_op && n_values == 0) {  // In/NotIn need values
+        *unmodeled = true;
+        return;
+      }
+      if (!first_expr) term_out += REC_SEP;
+      first_expr = false;
+      term_out.append(key->text.data(), key->text.size());
+      term_out += UNIT_SEP;
+      term_out.append(op->text.data(), op->text.size());
+      term_out += UNIT_SEP;
+      if (!exists_op) {
+        for (size_t vi = 0; vi < n_values; ++vi) {
+          if (vi) term_out += VAL_SEP;
+          const auto& t = values->arr[vi]->text;
+          term_out.append(t.data(), t.size());
+        }
+      }
+    }
+    if (term_out.empty()) continue;  // all-empty term: drop
+    if (any_term) out += TERM_SEP;
+    any_term = true;
+    out += term_out;
+  }
+  if (!any_term) {
+    *unmodeled = true;  // every term matches nothing: unplaceable
+    return;
+  }
+  *blob = std::move(out);
 }
 
 // node columns

@@ -100,6 +100,23 @@ class SelectorBit:
 
 
 @dataclasses.dataclass(frozen=True)
+class NodeAffinityBit:
+    """Pseudo-taint for one distinct required node-affinity expression
+    set (canonical terms: OR of ANDs of (key, op, values)). Set on every
+    node that does NOT satisfy the requirement; only pods carrying
+    exactly this requirement fail to tolerate it.
+
+    This generalizes the SelectorBit trick: ANY pure node-property
+    predicate collapses to one interned bit whose node side is evaluated
+    on host at pack time — the solvers' bit algebra never changes.
+    Replaces the reference's reliance on the real scheduler's
+    node-affinity predicate (reference rescheduler.go:344; predicate
+    list README.md:103-114)."""
+
+    terms: Tuple  # ((key, op, (values...)), ...) per term, OR of terms
+
+
+@dataclasses.dataclass(frozen=True)
 class UnplaceableBit:
     """Pseudo-taint carried by every node; only pods with unmodeled
     constraints fail to tolerate it."""
@@ -111,15 +128,58 @@ def selector_universe(pods: Sequence[PodSpec]) -> List[Tuple[str, str]]:
     return sorted({(k, v) for p in pods for k, v in p.node_selector.items()})
 
 
+def node_affinity_universe(pods: Sequence[PodSpec]) -> List[Tuple]:
+    """Sorted distinct canonical required-node-affinity terms across the
+    pods — the NodeAffinityBit universe both packers must share."""
+    return sorted({p.node_affinity for p in pods if p.node_affinity})
+
+
+def match_expr(expr: Tuple, labels) -> bool:
+    """One NodeSelectorRequirement against a node's labels — semantics of
+    k8s.io/apimachinery labels.Requirement.Matches (NotIn/DoesNotExist
+    match when the key is absent; Gt/Lt are base-10 integer compares)."""
+    key, op, values = expr
+    v = labels.get(key)
+    if op == "In":
+        return v is not None and v in values
+    if op == "NotIn":
+        return v is None or v not in values
+    if op == "Exists":
+        return v is not None
+    if op == "DoesNotExist":
+        return v is None
+    if op in ("Gt", "Lt"):
+        if v is None or len(values) != 1:
+            return False
+        try:
+            lv, rv = int(v), int(values[0])
+        except ValueError:
+            return False
+        return lv > rv if op == "Gt" else lv < rv
+    return False
+
+
+def match_node_affinity(terms: Tuple, labels) -> bool:
+    """Required node-affinity: OR over terms, AND within a term (empty
+    terms tuple = no constraint; decode drops empty terms, which k8s
+    defines to match nothing)."""
+    if not terms:
+        return True
+    return any(all(match_expr(e, labels) for e in term) for term in terms)
+
+
 def intern_constraints(
     nodes: Sequence[NodeSpec],
     selector_pairs: Sequence[Tuple[str, str]],
+    affinity_terms: Sequence[Tuple] = (),
 ) -> TaintTable:
     """``intern_taints`` plus the pseudo-taint tail: selector pairs (in
-    the given sorted order) and the always-present unplaceable bit."""
+    the given sorted order), node-affinity requirement bits, and the
+    always-present unplaceable bit."""
     base = intern_taints(nodes)
     taints = list(base.taints)
     taints.extend(SelectorBit(k, v) for k, v in selector_pairs)
+    taints.extend(NodeAffinityBit(t) for t in affinity_terms)
     taints.append(UnplaceableBit())
     words = max(1, -(-len(taints) // 32))
     return TaintTable(taints=taints, words=words)
@@ -127,13 +187,17 @@ def intern_constraints(
 
 def node_constraint_mask(node: NodeSpec, table: TaintTable) -> np.ndarray:
     """Node-side bits: real hard taints + selector pairs the node lacks +
-    the unplaceable bit (always set)."""
+    affinity requirements the node fails + the unplaceable bit (always
+    set)."""
     mask = np.zeros(table.words, dtype=np.uint32)
     for i, entry in enumerate(table.taints):
         if isinstance(entry, Taint):
             continue  # real taints handled below via the node's own list
         if isinstance(entry, SelectorBit):
             if node.labels.get(entry.key) != entry.value:
+                mask[i // 32] |= np.uint32(1 << (i % 32))
+        elif isinstance(entry, NodeAffinityBit):
+            if not match_node_affinity(entry.terms, node.labels):
                 mask[i // 32] |= np.uint32(1 << (i % 32))
         else:  # UnplaceableBit
             mask[i // 32] |= np.uint32(1 << (i % 32))
@@ -145,16 +209,19 @@ def constraint_mask(
     node_selector,
     unmodeled: bool,
     table: TaintTable,
+    node_affinity: Tuple = (),
 ) -> np.ndarray:
     """Pod-side bits: tolerated real taints + selector pairs the pod does
-    NOT require + the unplaceable bit unless the pod carries unmodeled
-    constraints."""
+    NOT require + affinity requirements that are not the pod's own + the
+    unplaceable bit unless the pod carries unmodeled constraints."""
     mask = np.zeros(table.words, dtype=np.uint32)
     for i, entry in enumerate(table.taints):
         if isinstance(entry, Taint):
             ok = any(tol.tolerates(entry) for tol in tolerations)
         elif isinstance(entry, SelectorBit):
             ok = node_selector.get(entry.key) != entry.value
+        elif isinstance(entry, NodeAffinityBit):
+            ok = entry.terms != node_affinity
         else:  # UnplaceableBit
             ok = not unmodeled
         if ok:

@@ -1,0 +1,264 @@
+"""Required node-affinity as interned pseudo-taint bits.
+
+The reference delegates node-affinity to the real kube-scheduler's
+predicate (reference rescheduler.go:344; predicate list README.md:103-114).
+Here each distinct required nodeAffinity expression set canonicalizes to
+one ``NodeAffinityBit`` evaluated host-side per node — these tests pin
+(a) the k8s NodeSelectorRequirement matcher semantics, (b) the decode
+canonicalization, (c) oracle/packer behavior, (d) object-vs-columnar
+bit parity, and (e) the end-to-end loop placing affinity pods on
+matching spot nodes only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.io.kube import decode_node_affinity
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.predicates.masks import (
+    match_expr,
+    match_node_affinity,
+)
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+
+# --- matcher semantics (k8s labels.Requirement.Matches) -------------------
+
+def test_match_expr_in():
+    assert match_expr(("z", "In", ("a", "b")), {"z": "a"})
+    assert not match_expr(("z", "In", ("a", "b")), {"z": "c"})
+    assert not match_expr(("z", "In", ("a", "b")), {})  # missing key
+
+
+def test_match_expr_not_in_matches_missing_key():
+    assert match_expr(("z", "NotIn", ("a",)), {"z": "b"})
+    assert not match_expr(("z", "NotIn", ("a",)), {"z": "a"})
+    assert match_expr(("z", "NotIn", ("a",)), {})  # k8s: absent key matches
+
+
+def test_match_expr_exists_and_absent():
+    assert match_expr(("z", "Exists", ()), {"z": ""})
+    assert not match_expr(("z", "Exists", ()), {})
+    assert match_expr(("z", "DoesNotExist", ()), {})
+    assert not match_expr(("z", "DoesNotExist", ()), {"z": "x"})
+
+
+def test_match_expr_gt_lt_integer_base10():
+    assert match_expr(("n", "Gt", ("5",)), {"n": "6"})
+    assert not match_expr(("n", "Gt", ("5",)), {"n": "5"})
+    assert match_expr(("n", "Lt", ("5",)), {"n": "4"})
+    assert not match_expr(("n", "Lt", ("5",)), {})  # missing key
+    assert not match_expr(("n", "Gt", ("5",)), {"n": "abc"})  # unparseable
+
+
+def test_match_terms_or_of_ands():
+    terms = (
+        (("a", "In", ("1",)), ("b", "Exists", ())),  # a=1 AND b present
+        (("c", "In", ("9",)),),  # OR c=9
+    )
+    assert match_node_affinity(terms, {"a": "1", "b": "x"})
+    assert match_node_affinity(terms, {"c": "9"})
+    assert not match_node_affinity(terms, {"a": "1"})  # b missing
+    assert match_node_affinity((), {"anything": "1"})  # no constraint
+
+
+# --- decode canonicalization ---------------------------------------------
+
+def _aff(terms):
+    return {"requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": terms}}
+
+
+def test_decode_modeled_shape():
+    terms, unmodeled = decode_node_affinity(_aff([
+        {"matchExpressions": [
+            {"key": "zone", "operator": "In", "values": ["b", "a", "b"]},
+            {"key": "arch", "operator": "Exists"},
+        ]},
+    ]))
+    assert not unmodeled
+    # values sorted+deduped, exprs sorted, Exists drops values
+    assert terms == ((("arch", "Exists", ()), ("zone", "In", ("a", "b"))),)
+
+
+def test_decode_equal_requirements_intern_identically():
+    a, _ = decode_node_affinity(_aff([
+        {"matchExpressions": [
+            {"key": "z", "operator": "In", "values": ["x", "y"]}]}]))
+    b, _ = decode_node_affinity(_aff([
+        {"matchExpressions": [
+            {"key": "z", "operator": "In", "values": ["y", "x"]}]}]))
+    assert a == b
+
+
+def test_decode_unmodeled_shapes():
+    # matchFields reads node metadata, not labels
+    assert decode_node_affinity(_aff([
+        {"matchFields": [
+            {"key": "metadata.name", "operator": "In", "values": ["n1"]}]}
+    ]))[1]
+    # unknown operator
+    assert decode_node_affinity(_aff([
+        {"matchExpressions": [
+            {"key": "z", "operator": "Glob", "values": ["*"]}]}]))[1]
+    # Gt needs exactly one value
+    assert decode_node_affinity(_aff([
+        {"matchExpressions": [
+            {"key": "z", "operator": "Gt", "values": ["1", "2"]}]}]))[1]
+    # In with no values (fails k8s validation)
+    assert decode_node_affinity(_aff([
+        {"matchExpressions": [
+            {"key": "z", "operator": "In", "values": []}]}]))[1]
+    # all terms empty -> requirement matches nothing
+    assert decode_node_affinity(_aff([{"matchExpressions": []}]))[1]
+    # no requirement at all -> modeled, empty
+    assert decode_node_affinity({}) == ((), False)
+
+
+# --- oracle / packer behavior --------------------------------------------
+
+def _cluster():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-plain", SPOT_LABELS))
+    fc.add_node(make_node("spot-zone-b", dict(SPOT_LABELS, zone="b")))
+    return fc
+
+
+def _pack(fc, **kw):
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    return pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"), **kw)
+
+
+ZONE_B = ((("zone", "In", ("b",)),),)
+NOT_ZONE_B = ((("zone", "NotIn", ("b",)),),)
+
+
+def test_affinity_restricts_placement_to_matching_spot():
+    fc = _cluster()
+    fc.add_pod(make_pod("aff-pod", 300, "od-1", node_affinity=ZONE_B))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-zone-b"
+
+
+def test_not_in_affinity_avoids_matching_spot():
+    fc = _cluster()
+    fc.add_pod(make_pod("anti-b", 300, "od-1", node_affinity=NOT_ZONE_B))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-plain"  # zone label absent: NotIn matches
+
+
+def test_affinity_with_no_matching_spot_blocks_drain():
+    fc = _cluster()
+    fc.add_pod(make_pod("picky", 100, "od-1",
+                        node_affinity=((("zone", "In", ("mars",)),),)))
+    packed, _ = _pack(fc)
+    result = plan_oracle(packed)
+    assert not result.feasible[:1].any()
+
+
+def test_two_pods_distinct_requirements_share_table():
+    fc = _cluster()
+    fc.add_pod(make_pod("to-b", 300, "od-1", node_affinity=ZONE_B))
+    fc.add_pod(make_pod("not-b", 300, "od-1", node_affinity=NOT_ZONE_B))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    names = [meta.spot[int(result.assignment[0, k])].node.name
+             for k in range(2)]
+    assert sorted(names) == ["spot-plain", "spot-zone-b"]
+
+
+def test_columnar_parity_with_node_affinity():
+    fc = _cluster()
+    fc.add_pod(make_pod("to-b", 300, "od-1", node_affinity=ZONE_B))
+    fc.add_pod(make_pod("not-b", 200, "od-1", node_affinity=NOT_ZONE_B))
+    fc.add_pod(make_pod("plain", 100, "od-1"))
+    fc.add_pod(make_pod("resident", 100, "spot-zone-b"))
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+
+
+def test_columnar_parity_after_universe_change():
+    """The sectioned caches must refresh when the affinity universe
+    changes between ticks (new requirement arrives, old one drains)."""
+    fc = _cluster()
+    fc.add_pod(make_pod("to-b", 300, "od-1", node_affinity=ZONE_B))
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(getattr(obj, field), getattr(col, field))
+    # tick 2: a different requirement joins
+    fc.add_pod(make_pod("not-b", 200, "od-1", node_affinity=NOT_ZONE_B))
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(getattr(obj, field), getattr(col, field))
+    # tick 3: the first requirement leaves
+    fc._remove_pod("default/to-b")
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+
+
+# --- end-to-end loop ------------------------------------------------------
+
+def test_loop_drains_affinity_pod_to_matching_node():
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-plain", SPOT_LABELS))
+    fc.add_node(make_node("spot-zone-b", dict(SPOT_LABELS, zone="b")))
+    fc.add_pod(make_pod("aff-pod", 300, "od-1", node_affinity=ZONE_B))
+    config = ReschedulerConfig(solver="numpy")
+    r = Rescheduler(fc, SolverPlanner(config), config, clock=clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    # the fake scheduler honors the affinity too
+    assert [p.name for p in fc.list_pods_on_node("spot-zone-b")] == ["aff-pod"]
+    assert fc.list_pods_on_node("spot-plain") == []
+    assert fc.pending == []
