@@ -122,6 +122,17 @@ def decode_pod(obj: dict) -> PodSpec:
 
 _NODE_AFFINITY_OPS = ("In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt")
 
+# NodeSelectorRequirement.values are NOT apiserver-validated as label
+# values — they may contain the native blob's separator bytes
+# (\x1c-\x1f). Such requirements are conservatively unmodeled, in exact
+# lockstep with native/ingest.cc has_sep_bytes, so the two decode paths
+# can never diverge on them.
+_SEP_BYTES = ("\x1c", "\x1d", "\x1e", "\x1f")
+
+
+def _has_sep_bytes(s: str) -> bool:
+    return any(ch in s for ch in _SEP_BYTES)
+
 
 def decode_node_affinity(node_aff: dict) -> tuple:
     """(canonical terms, unmodeled) for a nodeAffinity object.
@@ -159,9 +170,11 @@ def decode_node_affinity(node_aff: dict) -> tuple:
             key, op = e.get("key"), e.get("operator")
             if not isinstance(key, str) or op not in _NODE_AFFINITY_OPS:
                 return (), True
+            if _has_sep_bytes(key):
+                return (), True
             values = e.get("values") or []
             if not isinstance(values, list) or not all(
-                isinstance(v, str) for v in values
+                isinstance(v, str) and not _has_sep_bytes(v) for v in values
             ):
                 return (), True
             if op in ("Exists", "DoesNotExist"):

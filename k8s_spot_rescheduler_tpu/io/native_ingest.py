@@ -41,6 +41,8 @@ _LIB_PATH = os.path.join(
 
 _UNIT = "\x1f"
 _REC = "\x1e"
+_TERM = "\x1d"  # node-affinity blob: term separator (ingest.cc TERM_SEP)
+_VAL = "\x1c"  # node-affinity blob: In/NotIn value separator (VAL_SEP)
 
 # pod flag bits (native/ingest.cc)
 F_MIRROR, F_DAEMONSET, F_REPLICATED, F_TERMINAL, F_PENDING = 1, 2, 4, 8, 16
@@ -48,10 +50,11 @@ F_PVC, F_REQAFF = 32, 64
 # pod column indices
 P_CPU, P_MEM, P_EPH = 0, 1, 2
 (P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID,
- P_AAFFID) = range(7)
+ P_AAFFID, P_NAFFID) = range(8)
 PS_NAME, PS_UID = range(2)
 # interned-table families
-TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_AAFF = range(6)
+(TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_AAFF,
+ TBL_NAFF) = range(7)
 # node column indices
 N_CPU, N_MEM, N_EPH, N_PODS = range(4)
 N_READY, N_UNSCHED, N_HASPODS = range(3)
@@ -97,13 +100,13 @@ def _lib() -> Optional[ctypes.CDLL]:
     try:
         ok = (
             lib.pod_ncols_i64() == 3
-            and lib.pod_ncols_i32() == 7
+            and lib.pod_ncols_i32() == 8
             and lib.pod_ncols_u8() == 1
             and lib.pod_ncols_str() == 2
             and lib.node_ncols_i64() == 4
             and lib.node_ncols_u8() == 3
             and lib.node_ncols_str() == 4
-            and lib.table_count() == 6
+            and lib.table_count() == 7
         )
     except AttributeError:
         ok = False
@@ -195,6 +198,31 @@ def _parse_kv(blob: bytes) -> Dict[str, str]:
     return out
 
 
+@functools.lru_cache(maxsize=4096)
+def _parse_node_affinity(blob: bytes) -> Tuple:
+    """Node-affinity blob (ingest.cc extract_node_affinity) -> the exact
+    canonical tuples io/kube.py ``decode_node_affinity`` produces: terms
+    and their expressions sorted, In/NotIn value lists sorted+deduped.
+    The engine emits source order; canonicalization lives here so the two
+    languages share no sort-order contract."""
+    if not blob:
+        return ()
+    terms = []
+    for term_rec in blob.decode().split(_TERM):
+        exprs = []
+        for rec in term_rec.split(_REC):
+            key, op, values = rec.split(_UNIT)
+            if op in ("Exists", "DoesNotExist"):
+                vals: Tuple[str, ...] = ()
+            elif op in ("Gt", "Lt"):
+                vals = (values,)
+            else:  # In / NotIn
+                vals = tuple(sorted(set(values.split(_VAL))))
+            exprs.append((key, op, vals))
+        terms.append(tuple(sorted(exprs)))
+    return tuple(sorted(set(terms)))
+
+
 @functools.lru_cache(maxsize=1024)
 def _parse_taints(blob: bytes) -> Tuple[Taint, ...]:
     out = []
@@ -227,6 +255,7 @@ class PodBatch:
         )
         self.selector_sets = [_parse_kv(b) for b in tables[TBL_NODESEL]]
         self.match_sets = [_parse_kv(b) for b in tables[TBL_AAFF]]
+        self.naff_sets = [_parse_node_affinity(b) for b in tables[TBL_NAFF]]
 
     def match_set(self, set_id: int) -> Dict[str, str]:
         return self.match_sets[set_id]
@@ -349,10 +378,7 @@ class PodView:
 
     @property
     def node_affinity(self) -> tuple:
-        # the native engine flags any required nodeAffinity as F_REQAFF
-        # (unmodeled) rather than canonicalizing terms, so the modeled
-        # requirement is always empty on this path
-        return ()
+        return self._b.naff_sets[int(self._b.i32[self._i, P_NAFFID])]
 
     @property
     def unmodeled_constraints(self) -> bool:
@@ -392,6 +418,7 @@ class PodView:
             phase=self.phase,
             node_selector=dict(self.node_selector),
             anti_affinity_match=dict(self.anti_affinity_match),
+            node_affinity=self.node_affinity,
             unmodeled_constraints=self.unmodeled_constraints,
         )
 
@@ -501,7 +528,7 @@ def parse_pod_list(data: bytes) -> Optional[PodBatch]:
     handle = lib.ingest_pods(data, len(data))
     if not handle:
         return None
-    return PodBatch(*_copy_batch(lib, handle, 3, 7, 1, 2, tables=6))
+    return PodBatch(*_copy_batch(lib, handle, 3, 8, 1, 2, tables=7))
 
 
 def parse_node_list(data: bytes) -> Optional[NodeBatch]:

@@ -564,27 +564,25 @@ class ColumnarStore:
             | ((f & ni.F_TERMINAL) >> 1)
             | ((f & ni.F_REPLICATED) << 1)
         )
-        # constraint-triple interning: one lookup per distinct
-        # (toleration set, nodeSelector set, unmodeled flag) combination
+        # constraint-profile interning: one lookup per distinct
+        # (toleration set, nodeSelector set, node-affinity, unmodeled)
         unmod = (f & (ni.F_PVC | ni.F_REQAFF)) != 0
         combos = np.stack(
             [
                 batch.i32[keep, ni.P_TOLID],
                 batch.i32[keep, ni.P_SELID],
+                batch.i32[keep, ni.P_NAFFID],
                 unmod.astype(np.int32),
             ],
             axis=1,
         )
         uniq, inverse = np.unique(combos, axis=0, return_inverse=True)
         ids = np.empty(len(uniq), np.int32)
-        for i, (tol_id, sel_id, um) in enumerate(uniq):
-            # native pods carry no modeled node-affinity yet: the engine
-            # flags any required nodeAffinity as unmodeled (F_REQAFF), so
-            # the terms entry is always () on this path
+        for i, (tol_id, sel_id, naff_id, um) in enumerate(uniq):
             key = (
                 tuple(batch.tol_sets[tol_id]),
                 tuple(sorted(batch.selector_set(int(sel_id)).items())),
-                (),
+                batch.naff_sets[int(naff_id)],
                 bool(um),
             )
             tid = self._tol_keys.get(key)

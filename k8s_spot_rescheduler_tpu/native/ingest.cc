@@ -500,10 +500,12 @@ bool py_truthy(const Val* v) {
 // and leaves *unmodeled false; anything else required sets *unmodeled.
 const Val* extract_anti_affinity(const Val* affinity, bool* unmodeled) {
   if (!affinity || affinity->kind != Val::Obj) return nullptr;
-  for (const char* branch : {"nodeAffinity", "podAffinity"}) {
-    const Val* b = affinity->get(branch);
-    if (!b || b->kind != Val::Obj) continue;
-    if (py_truthy(b->get("requiredDuringSchedulingIgnoredDuringExecution")))
+  // Required podAffinity is unmodeled; required nodeAffinity is handled
+  // by extract_node_affinity (modeled matchExpressions intern into
+  // NodeAffinityBit pseudo-taints on the Python side).
+  if (const Val* b = affinity->get("podAffinity")) {
+    if (b->kind == Val::Obj &&
+        py_truthy(b->get("requiredDuringSchedulingIgnoredDuringExecution")))
       *unmodeled = true;
   }
   const Val* anti = affinity->get("podAntiAffinity");
@@ -576,6 +578,17 @@ constexpr char VAL_SEP = '\x1c';
 static const char* const kNaffOps[] = {"In",     "NotIn", "Exists",
                                        "DoesNotExist", "Gt", "Lt"};
 
+// Unlike labels/nodeSelector (apiserver-validated label syntax),
+// NodeSelectorRequirement.values are NOT validated as label values — a
+// value may contain the blob separator bytes. Such requirements are
+// conservatively unmodeled (in lockstep with io/kube.py
+// decode_node_affinity) so the blob framing can never be corrupted.
+bool has_sep_bytes(std::string_view s) {
+  for (char c : s)
+    if (c >= '\x1c' && c <= '\x1f') return true;
+  return false;
+}
+
 void extract_node_affinity(const Val* naff, bool* unmodeled,
                            std::string* blob) {
   blob->clear();
@@ -621,6 +634,10 @@ void extract_node_affinity(const Val* naff, bool* unmodeled,
         *unmodeled = true;
         return;
       }
+      if (has_sep_bytes(key->text)) {
+        *unmodeled = true;
+        return;
+      }
       bool known = false;
       for (const char* k : kNaffOps) known |= (op->text == k);
       if (!known) {
@@ -635,7 +652,7 @@ void extract_node_affinity(const Val* naff, bool* unmodeled,
           return;
         }
         for (const Val* v : values->arr) {
-          if (!v || v->kind != Val::Str) {
+          if (!v || v->kind != Val::Str || has_sep_bytes(v->text)) {
             *unmodeled = true;
             return;
           }
@@ -794,10 +811,15 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     if (phase == "Succeeded" || phase == "Failed") flags |= F_TERMINAL;
     if (phase == "Pending") flags |= F_PENDING;
     const Val* anti_affinity_labels = nullptr;
+    std::string naff_blob;
     if (spec) {
       bool unmodeled = false;
-      anti_affinity_labels =
-          extract_anti_affinity(spec->get("affinity"), &unmodeled);
+      const Val* affinity = spec->get("affinity");
+      anti_affinity_labels = extract_anti_affinity(affinity, &unmodeled);
+      extract_node_affinity(
+          affinity && affinity->kind == Val::Obj ? affinity->get("nodeAffinity")
+                                                 : nullptr,
+          &unmodeled, &naff_blob);
       if (unmodeled) flags |= F_REQAFF;
       if (const Val* vols = spec->get("volumes")) {
         if (vols->kind == Val::Arr) {
@@ -835,6 +857,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     tmp.clear();
     blob_kv_into(&tmp, anti_affinity_labels);
     i32row(P_AAFFID) = b->intern_str(TBL_AAFF, tmp);
+    i32row(P_NAFFID) = b->intern_str(TBL_NAFF, naff_blob);
 
     // tolerations: key\x1fvalue\x1foperator\x1feffect\x1e...
     tmp.clear();

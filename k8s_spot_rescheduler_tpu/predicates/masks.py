@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import re
 from typing import List, Sequence, Tuple
 
 import numpy as np
@@ -40,6 +41,23 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
 )
 
 HARD_EFFECTS = ("NoSchedule", "NoExecute")
+
+# strconv.ParseInt(s, 10, 64)-compatible integer literal: optional sign
+# (Go accepts '+' and '-'), ASCII digits only (\d would admit Unicode
+# digits Go rejects), no '_' or whitespace; range-checked to int64 below.
+_INT_RE = re.compile(r"[+-]?[0-9]+")
+_INT64_MIN, _INT64_MAX = -(2**63), 2**63 - 1
+
+
+def _parse_int64(s: str):
+    """int(s) under Go strconv.ParseInt(s, 10, 64) rules; None on any
+    input Go rejects (syntax or 64-bit range)."""
+    if not _INT_RE.fullmatch(s):
+        return None
+    v = int(s)
+    if not _INT64_MIN <= v <= _INT64_MAX:
+        return None
+    return v
 
 # Anti-affinity groups hash onto 64 bits = 2 uint32 words.
 AFFINITY_WORDS = 2
@@ -151,9 +169,12 @@ def match_expr(expr: Tuple, labels) -> bool:
     if op in ("Gt", "Lt"):
         if v is None or len(values) != 1:
             return False
-        try:
-            lv, rv = int(v), int(values[0])
-        except ValueError:
+        # Exact strconv.ParseInt parity: Python's int() also accepts
+        # '_', whitespace and arbitrary precision, which would deem a
+        # node affinity-satisfying when the real scheduler rejects it —
+        # the non-conservative direction.
+        lv, rv = _parse_int64(v), _parse_int64(values[0])
+        if lv is None or rv is None:
             return False
         return lv > rv if op == "Gt" else lv < rv
     return False

@@ -66,6 +66,23 @@ def test_match_expr_gt_lt_integer_base10():
     assert not match_expr(("n", "Gt", ("5",)), {"n": "abc"})  # unparseable
 
 
+def test_match_expr_gt_lt_strict_parse_like_strconv():
+    # Exact strconv.ParseInt(s, 10, 64) parity. Python's int() accepts
+    # underscores, whitespace, Unicode digits, and arbitrary precision —
+    # deeming those satisfying would approve a drain whose pods then
+    # fail to place (non-conservative).
+    assert not match_expr(("n", "Gt", ("5",)), {"n": "1_0"})
+    assert not match_expr(("n", "Gt", ("5",)), {"n": " 10"})
+    assert not match_expr(("n", "Gt", ("1_0",)), {"n": "20"})
+    assert not match_expr(("n", "Gt", ("5",)), {"n": "١٠"})
+    # int64 overflow: ParseInt returns ErrRange -> expr does not match
+    assert not match_expr(("n", "Gt", ("5",)), {"n": str(2**63)})
+    assert match_expr(("n", "Gt", ("5",)), {"n": str(2**63 - 1)})
+    # Go accepts a leading '+' or '-'
+    assert match_expr(("n", "Gt", ("5",)), {"n": "+10"})
+    assert match_expr(("n", "Gt", ("-5",)), {"n": "-4"})
+
+
 def test_match_terms_or_of_ands():
     terms = (
         (("a", "In", ("1",)), ("b", "Exists", ())),  # a=1 AND b present
