@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "e.g. 4x2 (default: infer from visible devices)")
     p.add_argument("--resources", default=",".join(d.resources),
                    help="comma-separated resource axes to pack")
+    p.add_argument("--repair-rounds", type=int, default=d.repair_rounds,
+                   help="eject-and-reinsert local-search rounds for "
+                        "candidates greedy packing can't prove (0=off)")
     p.add_argument("--leader-elect", type=_bool, default=False,
                    help="Lease-based leader election so only one replica "
                         "acts (restores what reference rescheduler.go:139 "
@@ -104,6 +107,7 @@ def config_from_args(args) -> ReschedulerConfig:
         spot_node_label=args.spot_node_label,
         priority_threshold=args.priority_threshold,
         solver=args.solver,
+        repair_rounds=args.repair_rounds,
         resources=tuple(r for r in args.resources.split(",") if r),
         mesh_shape=(
             tuple(int(x) for x in args.mesh_shape.lower().split("x"))

@@ -48,6 +48,12 @@ class SolverPlanner:
         from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
 
         base = self._base_solver(name)
+        if self.config.fallback_best_fit and self.config.repair_rounds > 0:
+            from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
+
+            return make_fused_planner(
+                with_repair(base, self.config.repair_rounds)
+            )
         if self.config.fallback_best_fit:
             from k8s_spot_rescheduler_tpu.solver.fallback import with_best_fit_fallback
 
@@ -143,6 +149,22 @@ class SolverPlanner:
                         result.feasible[:, None], result.assignment, bf.assignment
                     ),
                 )
+                if self.config.repair_rounds > 0:
+                    from k8s_spot_rescheduler_tpu.solver.repair import (
+                        plan_repair_oracle,
+                    )
+
+                    rp = plan_repair_oracle(
+                        packed, rounds=self.config.repair_rounds
+                    )
+                    result = SolveResult(
+                        feasible=result.feasible | rp.feasible,
+                        assignment=np.where(
+                            result.feasible[:, None],
+                            result.assignment,
+                            rp.assignment,
+                        ),
+                    )
             feasible = np.asarray(result.feasible)
             n_feasible = int(feasible.sum())
             plan = None

@@ -35,3 +35,30 @@ def with_best_fit_fallback(solve_fn):
         return SolveResult(feasible=feasible, assignment=assignment)
 
     return solve
+
+
+def with_repair(solve_fn, rounds: int):
+    """First-fit ∪ best-fit ∪ bounded local-search repair
+    (solver/repair.py), still one fused device program.
+
+    Preference order keeps the drain decision identical to the
+    reference whenever the reference could have made one: a lane's
+    first-fit placement wins when first-fit proves it, then best-fit,
+    then the repaired assignment. Repair placements are re-proven from
+    scratch (solver/validate.py), so the union can only add drainable
+    nodes — never an invalid drain."""
+    from k8s_spot_rescheduler_tpu.solver.repair import plan_repair
+
+    def solve(packed) -> SolveResult:
+        ff = solve_fn(packed)
+        bf = solve_fn(packed, best_fit=True)
+        rp = plan_repair(packed, rounds=rounds)
+        feasible = ff.feasible | bf.feasible | rp.feasible
+        assignment = jnp.where(
+            ff.feasible[:, None],
+            ff.assignment,
+            jnp.where(bf.feasible[:, None], bf.assignment, rp.assignment),
+        )
+        return SolveResult(feasible=feasible, assignment=assignment)
+
+    return solve

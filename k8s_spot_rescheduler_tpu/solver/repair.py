@@ -1,0 +1,331 @@
+"""Bounded eject-and-reinsert local search — the "+ local-search" half
+of the north-star kernel (SURVEY.md §7 step 5, BASELINE.md).
+
+Greedy packing (first-fit or best-fit decreasing) fails a candidate lane
+the moment one pod fits nowhere, even when relocating a single
+already-placed pod would unlock it — the regime where the reference's
+serial probe nest (reference rescheduler.go:334-370) and any one-pass
+heuristic lose drains at high spot utilization. This module recovers
+those lanes:
+
+1. **Partial pass** — the best-fit-decreasing scan of solver/ffd.py but
+   *continue on failure*: place every pod that fits, leave gaps
+   (``assignment == -1``) instead of aborting the lane.
+2. **Repair rounds** — a fixed-length ``lax.scan``; each round, every
+   unfinished lane in parallel picks its first unplaced pod ``p``,
+   searches the already-placed pods ``q`` whose ejection would let
+   ``p`` take their node, rotates deterministically through those
+   unlockers across rounds, and executes the relocation
+   ``q → elsewhere, p → q's node`` when ``q`` itself re-places.
+3. **Validation** — the final assignment is re-proven from scratch
+   (solver/validate.py) on device; only fully-placed, predicate-valid
+   lanes report feasible. The search can therefore never approve an
+   invalid drain, no matter what (hard part (e): conservative only).
+
+TPU shape discipline matches solver/ffd.py: carries keep the spot axis
+minor ([C, R, S] / [C, A, S]), shapes are static, rounds are a scan.
+One deliberate conservatism: node affinity masks only ever accumulate
+(ejecting ``q`` does not clear its group bits from its old node), so
+affinity-driven swaps are skipped rather than risked — resource
+contention, the dominant failure mode, is fully repaired.
+
+Cost: each round is O(K·(R+A) + S·(R+W)) per lane vs the greedy scan's
+O(K·S·(R+W)) — ``ROUNDS`` rounds add well under 2x total solve time.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask, fit_mask_t
+from k8s_spot_rescheduler_tpu.solver.ffd import _Carry, _scan_step
+from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+from k8s_spot_rescheduler_tpu.solver.validate import validate_assignment
+
+DEFAULT_ROUNDS = 8
+
+
+class _RepairCarry(NamedTuple):
+    free: jax.Array  # f32 [C, R, S]
+    count: jax.Array  # i32 [C, S]
+    aff: jax.Array  # u32 [C, A, S] (monotone — see module docstring)
+    assign: jax.Array  # i32 [C, K]
+
+
+def _partial_scan_step(static, carry: _Carry, slot):
+    """solver/ffd.py ``_scan_step`` in best-fit mode, but a pod that fits
+    nowhere leaves a gap instead of failing the lane."""
+    new_carry, chosen = _scan_step(static, True, carry, slot)
+    # keep scanning: feasibility tracking is repair's job now
+    return new_carry._replace(feasible=carry.feasible), chosen
+
+
+def _repair_round(static, state: _RepairCarry, round_idx):
+    (spot_max_pods, spot_taints_t, spot_ok, slot_req, slot_valid,
+     slot_tol, slot_aff) = static
+    C, K, R = slot_req.shape
+    S = state.free.shape[-1]
+
+    unplaced = slot_valid & (state.assign < 0)  # [C, K]
+    has_gap = jnp.any(unplaced, axis=-1)  # [C]
+    p = jnp.argmax(unplaced, axis=-1)  # first unplaced slot per lane
+
+    req_p = jnp.take_along_axis(slot_req, p[:, None, None], axis=1)[:, 0]
+    tol_p = jnp.take_along_axis(slot_tol, p[:, None, None], axis=1)[:, 0]
+    aff_p = jnp.take_along_axis(slot_aff, p[:, None, None], axis=1)[:, 0]
+
+    # static admission of p per spot node (taints/selector words + ok)
+    word_ok = jnp.all(
+        (spot_taints_t & ~tol_p[:, :, None]) == 0, axis=1
+    )  # [C, S]
+    static_p = word_ok & spot_ok  # [C, S]
+
+    placed = state.assign >= 0  # [C, K]
+    s_q = jnp.clip(state.assign, 0, S - 1)  # [C, K]
+
+    # would p fit on q's node if q were ejected?
+    free_at_q = jnp.take_along_axis(
+        state.free, s_q[:, None, :], axis=2
+    )  # [C, R, K]
+    req_t = jnp.swapaxes(slot_req, 1, 2)  # [C, R, K]
+    res_ok = jnp.all(
+        free_at_q + req_t - req_p[:, :, None] >= 0, axis=1
+    )  # [C, K]
+    static_at_q = jnp.take_along_axis(static_p, s_q, axis=1)  # [C, K]
+    aff_at_q = jnp.take_along_axis(
+        state.aff, s_q[:, None, :], axis=2
+    )  # [C, A, K]
+    aff_ok = jnp.all((aff_p[:, :, None] & aff_at_q) == 0, axis=1)  # [C, K]
+
+    unlock = placed & res_ok & static_at_q & aff_ok  # [C, K]
+    n_unlock = unlock.sum(axis=-1)  # [C]
+
+    # deterministic rotation: try a different unlocker each round
+    rank = jnp.cumsum(unlock, axis=-1) - 1
+    want = jnp.where(
+        n_unlock > 0, round_idx % jnp.maximum(n_unlock, 1), -1
+    )
+    is_q = unlock & (rank == want[:, None])
+    q = jnp.argmax(is_q, axis=-1)  # [C]
+    any_q = jnp.any(is_q, axis=-1)
+
+    # can q itself re-place somewhere else under current state?
+    req_q = jnp.take_along_axis(slot_req, q[:, None, None], axis=1)[:, 0]
+    tol_q = jnp.take_along_axis(slot_tol, q[:, None, None], axis=1)[:, 0]
+    aff_q = jnp.take_along_axis(slot_aff, q[:, None, None], axis=1)[:, 0]
+    sq_star = jnp.take_along_axis(s_q, q[:, None], axis=1)[:, 0]  # [C]
+
+    fits_q = fit_mask_t(
+        jnp,
+        free_t=state.free,
+        count=state.count,
+        max_pods=spot_max_pods,
+        node_taints_t=spot_taints_t,
+        node_ok=spot_ok,
+        node_aff_t=state.aff,
+        req=req_q,
+        tol=tol_q,
+        aff=aff_q,
+    )  # [C, S]
+    fits_q &= jnp.arange(S)[None, :] != sq_star[:, None]
+    s2 = jnp.argmax(fits_q, axis=-1)  # [C]
+    can_move = jnp.any(fits_q, axis=-1)
+
+    do = has_gap & any_q & can_move  # [C]
+
+    onehot_sq = jnp.arange(S)[None, :] == sq_star[:, None]  # [C, S]
+    onehot_s2 = jnp.arange(S)[None, :] == s2[:, None]
+    delta = (
+        onehot_sq[:, None, :] * (req_q - req_p)[:, :, None]
+        - onehot_s2[:, None, :] * req_q[:, :, None]
+    )
+    free = jnp.where(do[:, None, None], state.free + delta, state.free)
+    count = jnp.where(
+        do[:, None], state.count + onehot_s2.astype(state.count.dtype),
+        state.count,
+    )
+    aff = jnp.where(
+        do[:, None, None],
+        state.aff
+        | jnp.where(onehot_s2[:, None, :], aff_q[:, :, None], 0)
+        | jnp.where(onehot_sq[:, None, :], aff_p[:, :, None], 0),
+        state.aff,
+    )
+    ks = jnp.arange(K)[None, :]
+    assign = jnp.where(
+        do[:, None],
+        jnp.where(
+            ks == p[:, None],
+            sq_star[:, None].astype(state.assign.dtype),
+            jnp.where(
+                ks == q[:, None], s2[:, None].astype(state.assign.dtype),
+                state.assign,
+            ),
+        ),
+        state.assign,
+    )
+    return _RepairCarry(free, count, aff, assign), ()
+
+
+def plan_repair(
+    packed: PackedCluster, rounds: int = DEFAULT_ROUNDS
+) -> SolveResult:
+    """Jittable partial-pack + bounded repair + from-scratch validation."""
+    C, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+
+    free_t = jnp.asarray(packed.spot_free).T
+    aff_t = jnp.asarray(packed.spot_aff).T
+    carry = _Carry(
+        free=jnp.broadcast_to(free_t, (C, *free_t.shape)),
+        count=jnp.broadcast_to(packed.spot_count, (C, S)).astype(jnp.int32),
+        aff=jnp.broadcast_to(aff_t, (C, *aff_t.shape)),
+        feasible=jnp.asarray(packed.cand_valid),
+    )
+    scan_static = (
+        jnp.asarray(packed.spot_max_pods),
+        jnp.asarray(packed.spot_taints).T,
+        jnp.asarray(packed.spot_ok),
+    )
+    slots = (
+        jnp.moveaxis(packed.slot_req, 1, 0),
+        jnp.moveaxis(packed.slot_valid, 1, 0),
+        jnp.moveaxis(packed.slot_tol, 1, 0),
+        jnp.moveaxis(packed.slot_aff, 1, 0),
+    )
+    carry, chosen = jax.lax.scan(
+        functools.partial(_partial_scan_step, scan_static), carry, slots
+    )
+    assign0 = jnp.swapaxes(chosen, 0, 1).astype(jnp.int32)  # [C, K]
+
+    state = _RepairCarry(
+        free=carry.free, count=carry.count, aff=carry.aff, assign=assign0
+    )
+    repair_static = (
+        *scan_static,
+        jnp.asarray(packed.slot_req),
+        jnp.asarray(packed.slot_valid),
+        jnp.asarray(packed.slot_tol),
+        jnp.asarray(packed.slot_aff),
+    )
+    state, _ = jax.lax.scan(
+        functools.partial(_repair_round, repair_static),
+        state,
+        jnp.arange(rounds),
+    )
+
+    feasible = validate_assignment(jnp, packed, state.assign)
+    assignment = jnp.where(feasible[:, None], state.assign, -1)
+    return SolveResult(feasible=feasible, assignment=assignment)
+
+
+plan_repair_jit = jax.jit(plan_repair, static_argnames=("rounds",))
+
+
+def plan_repair_oracle(
+    packed: PackedCluster, rounds: int = DEFAULT_ROUNDS
+) -> SolveResult:
+    """Serial NumPy mirror of ``plan_repair`` — identical partial pass,
+    rotation, conservative affinity accumulation, and validation, for
+    bit-parity tests against the device solver."""
+    C, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    assign = np.full((C, K), -1, np.int32)
+    frees = np.broadcast_to(packed.spot_free, (C, S, R)).copy()
+    counts = np.broadcast_to(packed.spot_count, (C, S)).astype(np.int64).copy()
+    affs = np.broadcast_to(packed.spot_aff, (C, *packed.spot_aff.shape)).copy()
+
+    # partial best-fit pass with gaps
+    for c in range(C):
+        for k in range(K):
+            if not packed.slot_valid[c, k]:
+                continue
+            fits = fit_mask(
+                np,
+                free=frees[c],
+                count=counts[c],
+                max_pods=packed.spot_max_pods,
+                node_taints=packed.spot_taints,
+                node_ok=packed.spot_ok,
+                node_aff=affs[c],
+                req=packed.slot_req[c, k],
+                tol=packed.slot_tol[c, k],
+                aff=packed.slot_aff[c, k],
+            )
+            if not fits.any():
+                continue  # leave the gap for repair
+            slack = np.where(
+                fits, frees[c, :, 0] - packed.slot_req[c, k, 0], np.inf
+            )
+            s = int(np.argmin(slack))
+            assign[c, k] = s
+            frees[c, s] -= packed.slot_req[c, k]
+            counts[c, s] += 1
+            affs[c, s] |= packed.slot_aff[c, k]
+
+    for rnd in range(rounds):
+        for c in range(C):
+            unplaced = packed.slot_valid[c] & (assign[c] < 0)
+            if not unplaced.any():
+                continue
+            p = int(np.argmax(unplaced))
+            req_p = packed.slot_req[c, p]
+            tol_p = packed.slot_tol[c, p]
+            aff_p = packed.slot_aff[c, p]
+            static_p = (
+                np.all((packed.spot_taints & ~tol_p) == 0, axis=-1)
+                & packed.spot_ok
+            )
+            unlock = np.zeros(K, bool)
+            for k in range(K):
+                s = assign[c, k]
+                if s < 0:
+                    continue
+                if not static_p[s]:
+                    continue
+                if not np.all(
+                    frees[c, s] + packed.slot_req[c, k] - req_p >= 0
+                ):
+                    continue
+                if np.any(aff_p & affs[c, s]):
+                    continue
+                unlock[k] = True
+            n_unlock = int(unlock.sum())
+            if not n_unlock:
+                continue
+            want = rnd % n_unlock
+            q = int(np.flatnonzero(unlock)[want])
+            sq = int(assign[c, q])
+            fits_q = fit_mask(
+                np,
+                free=frees[c],
+                count=counts[c],
+                max_pods=packed.spot_max_pods,
+                node_taints=packed.spot_taints,
+                node_ok=packed.spot_ok,
+                node_aff=affs[c],
+                req=packed.slot_req[c, q],
+                tol=packed.slot_tol[c, q],
+                aff=packed.slot_aff[c, q],
+            )
+            fits_q[sq] = False
+            if not fits_q.any():
+                continue
+            s2 = int(np.argmax(fits_q))
+            assign[c, p] = sq
+            assign[c, q] = s2
+            frees[c, sq] += packed.slot_req[c, q] - req_p
+            frees[c, s2] -= packed.slot_req[c, q]
+            counts[c, s2] += 1
+            affs[c, s2] |= packed.slot_aff[c, q]
+            affs[c, sq] |= aff_p
+
+    feasible = np.asarray(validate_assignment(np, packed, assign))
+    assignment = np.where(feasible[:, None], assign, -1).astype(np.int32)
+    return SolveResult(feasible=feasible, assignment=assignment)

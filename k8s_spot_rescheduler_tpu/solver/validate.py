@@ -1,0 +1,108 @@
+"""From-scratch assignment validation — the repair phase's safety net.
+
+The reference never needs this: its probe nest only ever commits
+placements the predicate checker just approved (reference
+rescheduler.go:344, 366). The repair solver (solver/repair.py) moves
+already-placed pods around, so instead of trusting the search's
+incremental bookkeeping, every lane's final assignment is re-proven
+here against the ORIGINAL packed state: resources, pod counts, taints/
+selector words, readiness, and pairwise anti-affinity. A lane that
+fails any check reports infeasible — a search bug can lose a drain but
+can never strand a pod (SURVEY.md §7 hard part (e): conservative in the
+safe direction only).
+
+``xp`` is ``numpy`` or ``jax.numpy`` — the device solver and the test
+suite run the identical math.
+"""
+
+from __future__ import annotations
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+
+
+def validate_assignment(xp, packed: PackedCluster, assign):
+    """bool [C]: lane c's assignment row is a complete, predicate-valid
+    placement of all its valid slots onto the spot pool.
+
+    ``assign`` is int [C, K]; -1 = unplaced. Checks, all against the
+    original (un-depleted) spot state:
+
+    - completeness: every valid slot placed, every padding slot -1;
+    - bounds: placements index real spot lanes;
+    - capacity: per-node summed requests fit ``spot_free``;
+    - pod count: ``spot_count`` + placements <= ``spot_max_pods``;
+    - static admission: taint/selector/unplaceable words and ``spot_ok``
+      per placed (slot, node) pair;
+    - anti-affinity: no placed pair sharing a group bit co-locates, and
+      no placed slot shares a bit with its node's existing pods.
+    """
+    C, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    assign = xp.asarray(assign)
+    valid = xp.asarray(packed.slot_valid)
+    placed = assign >= 0
+
+    complete = xp.all(placed == valid, axis=-1)  # [C]
+    in_bounds = xp.all(xp.where(placed, assign < S, True), axis=-1)
+
+    s_idx = xp.clip(assign, 0, S - 1).astype(xp.int32)
+    onehot = (
+        (s_idx[..., None] == xp.arange(S)) & (placed & valid)[..., None]
+    )  # [C, K, S]
+    onehot_f = onehot.astype(packed.slot_req.dtype)
+
+    load = xp.einsum("cks,ckr->csr", onehot_f, xp.asarray(packed.slot_req))
+    n_on = onehot.sum(axis=1)  # [C, S]
+    # capacity binds only nodes that received placements: an untouched
+    # node may legitimately carry negative free (over-committed in the
+    # observed cluster) — placing on one is what's forbidden, matching
+    # the greedy solvers' per-step ``free >= req`` gate
+    used = n_on > 0
+    res_ok = xp.all(
+        (xp.asarray(packed.spot_free)[None] - load >= 0)
+        | ~used[..., None],
+        axis=(-2, -1),
+    )  # [C]
+    cnt_ok = xp.all(
+        (
+            xp.asarray(packed.spot_count)[None] + n_on
+            <= xp.asarray(packed.spot_max_pods)[None]
+        )
+        | ~used,
+        axis=-1,
+    )
+
+    # per-placement static admission word check
+    taints = xp.asarray(packed.spot_taints)  # [S, W]
+    node_words = taints[s_idx]  # [C, K, W] (gather)
+    word_ok = xp.all(
+        (node_words & ~xp.asarray(packed.slot_tol)) == 0, axis=-1
+    )  # [C, K]
+    ok_lane = xp.asarray(packed.spot_ok)[s_idx]  # [C, K]
+    static_ok = xp.all(
+        xp.where(placed & valid, word_ok & ok_lane, True), axis=-1
+    )
+
+    # anti-affinity: pairwise within a node + against the node's own mask.
+    aff = xp.asarray(packed.slot_aff)  # [C, K, A] uint32
+    live = placed & valid
+    share = xp.any(aff[:, :, None, :] & aff[:, None, :, :], axis=-1)  # [C,K,K]
+    same = (s_idx[:, :, None] == s_idx[:, None, :]) & (
+        live[:, :, None] & live[:, None, :]
+    )
+    off_diag = ~xp.eye(K, dtype=bool)[None]
+    pair_ok = ~xp.any(share & same & off_diag, axis=(-2, -1))
+    node0 = xp.asarray(packed.spot_aff)[s_idx]  # [C, K, A]
+    share0 = xp.any(aff & node0, axis=-1)  # [C, K]
+    node_aff_ok = ~xp.any(share0 & live, axis=-1)
+
+    return (
+        xp.asarray(packed.cand_valid)
+        & complete
+        & in_bounds
+        & res_ok
+        & cnt_ok
+        & static_ok
+        & pair_ok
+        & node_aff_ok
+    )

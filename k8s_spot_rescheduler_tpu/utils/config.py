@@ -55,6 +55,9 @@ class ReschedulerConfig:
       decreasing packing. Placements remain predicate-valid, so this can
       only *add* drainable nodes (quality ≥ reference); disable for
       bit-faithful drain selection.
+    - ``repair_rounds`` — bounded eject-and-reinsert local-search rounds
+      (solver/repair.py) for lanes both greedy passes fail; repaired
+      placements are re-proven from scratch before use. 0 disables.
     """
 
     running_in_cluster: bool = True
@@ -79,6 +82,7 @@ class ReschedulerConfig:
     mesh_shape: tuple = (1, 1)
     max_drains_per_tick: int = 1
     fallback_best_fit: bool = True
+    repair_rounds: int = 8
     # Observe via the incrementally-maintained columnar mirror
     # (models/columnar.py) when the cluster client provides one — the
     # vectorized replacement for the per-tick object-model rebuild. Off →

@@ -1,0 +1,150 @@
+"""Local-search repair tests (solver/repair.py + solver/validate.py).
+
+The repair phase is the "+ local-search" half of the north-star kernel
+(SURVEY.md §7 step 5): when greedy packing (first-fit / best-fit, the
+reference's rescheduler.go:334-370 semantics and its strengthening)
+cannot prove a candidate drain, a bounded eject-and-reinsert search may.
+Safety invariant: repaired placements are re-proven from scratch, so a
+feasible verdict is ALWAYS executable — checked here by independent
+serial replay, not by the validator that produced it.
+"""
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
+from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd, plan_ffd_jit
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.solver.repair import (
+    plan_repair_jit,
+    plan_repair_oracle,
+)
+from k8s_spot_rescheduler_tpu.solver.validate import validate_assignment
+from tests.test_properties import _check_plan_is_executable
+from tests.test_solver import _random_packed
+
+
+def _swap_case() -> PackedCluster:
+    """Greedy fails, one relocation fixes it.
+
+    Spot pool: n0 free=11, n1 free=5 (n1 carries taint bit0). Candidate
+    pods decreasing: a=6 (tolerates), b=5 (tolerates), c=5 (does NOT
+    tolerate bit0 — selector-bound to n0). Greedy (first- and best-fit):
+    a->n0 (5 left), b->n0 (ties break to probe order; 0 left), c fits
+    only n0 -> stuck. Repair: eject b (free 0+5 >= 5), b re-places on
+    n1, c takes n0.
+    """
+    W, A = 1, 2
+    return PackedCluster(
+        slot_req=np.array([[[6.0], [5.0], [5.0]]], np.float32),
+        slot_valid=np.ones((1, 3), bool),
+        slot_tol=np.array([[[1], [1], [0]]], np.uint32),
+        slot_aff=np.zeros((1, 3, A), np.uint32),
+        cand_valid=np.ones((1,), bool),
+        spot_free=np.array([[11.0], [5.0]], np.float32),
+        spot_count=np.zeros((2,), np.int32),
+        spot_max_pods=np.full((2,), 10, np.int32),
+        spot_taints=np.array([[0], [1]], np.uint32),
+        spot_ok=np.ones((2,), bool),
+        spot_aff=np.zeros((2, A), np.uint32),
+    )
+
+
+def test_repair_fixes_greedy_failure():
+    packed = _swap_case()
+    assert not plan_oracle(packed).feasible[0]
+    assert not plan_oracle(packed, best_fit=True).feasible[0]
+    res = plan_repair_oracle(packed)
+    assert bool(res.feasible[0])
+    # c -> n0, b -> n1, a -> n0
+    assert list(res.assignment[0]) == [0, 1, 0]
+    _check_plan_is_executable(packed, res)
+
+
+def test_repair_device_matches_fixture():
+    packed = _swap_case()
+    got = plan_repair_jit(packed)
+    assert bool(np.asarray(got.feasible)[0])
+    assert list(np.asarray(got.assignment)[0]) == [0, 1, 0]
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_repair_oracle_jax_parity_randomized(seed):
+    """Device repair is bit-identical to the serial mirror: same partial
+    pass, rotation, conservative affinity handling, validation."""
+    packed = _random_packed(np.random.default_rng(seed))
+    want = plan_repair_oracle(packed)
+    got = plan_repair_jit(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(
+        np.asarray(got.assignment), want.assignment
+    )
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_repair_plans_always_executable(seed):
+    """Safety: every feasible repair verdict replays cleanly against the
+    ORIGINAL spot pool under the serial predicate semantics — the search
+    can lose a drain but can never approve an invalid one."""
+    packed = _random_packed(np.random.default_rng(1000 + seed))
+    res = plan_repair_oracle(packed)
+    _check_plan_is_executable(packed, res)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_union_never_loses_greedy_feasibility(seed):
+    """with_repair >= first-fit and >= best-fit on every lane, and keeps
+    the reference's assignment whenever first-fit proves the lane."""
+    packed = _random_packed(np.random.default_rng(2000 + seed))
+    ff = plan_oracle(packed)
+    bf = plan_oracle(packed, best_fit=True)
+    union = with_repair(plan_ffd, rounds=8)(packed)
+    u_f = np.asarray(union.feasible)
+    assert (u_f | ~ff.feasible).all()
+    assert (u_f | ~bf.feasible).all()
+    np.testing.assert_array_equal(
+        np.asarray(union.assignment)[ff.feasible],
+        ff.assignment[ff.feasible],
+    )
+    _check_plan_is_executable(packed, union)
+
+
+def test_repair_deterministic():
+    packed = _random_packed(np.random.default_rng(77))
+    a = plan_repair_jit(packed)
+    b = plan_repair_jit(packed)
+    np.testing.assert_array_equal(np.asarray(a.feasible), np.asarray(b.feasible))
+    np.testing.assert_array_equal(
+        np.asarray(a.assignment), np.asarray(b.assignment)
+    )
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_validator_agrees_with_serial_replay(seed):
+    """validate_assignment(np) must accept exactly the assignments the
+    serial replay accepts: run it on greedy plans (known-valid) and on
+    deliberately corrupted ones (must reject)."""
+    packed = _random_packed(np.random.default_rng(3000 + seed))
+    res = plan_oracle(packed)
+    ok = np.asarray(validate_assignment(np, packed, res.assignment))
+    # greedy-feasible lanes are valid by construction
+    np.testing.assert_array_equal(ok[res.feasible], True)
+    # corrupt a feasible lane that actually placed something: dropping a
+    # placement must invalidate it
+    placed_lanes = res.feasible & packed.slot_valid.any(axis=1)
+    if placed_lanes.any():
+        c = int(np.argmax(placed_lanes))
+        bad = res.assignment.copy()
+        k = int(np.argmax(packed.slot_valid[c]))
+        bad[c, k] = -1  # incomplete placement
+        assert not validate_assignment(np, packed, bad)[c]
+
+
+def test_validator_rejects_oversubscription():
+    packed = _swap_case()
+    # all three pods on n1 (free 5 < 16, and c doesn't tolerate bit0)
+    bad = np.array([[1, 1, 1]], np.int32)
+    assert not validate_assignment(np, packed, bad)[0]
+    good = np.array([[0, 1, 0]], np.int32)
+    assert validate_assignment(np, packed, good)[0]
