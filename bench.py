@@ -133,6 +133,22 @@ def acquire_backend(
         time.sleep(min(15.0, 2.0 * attempt))
 
 
+def _scaled_spec(base, scale: float):
+    """Multiply a config's node/pod counts by ``scale`` (1.0 = unchanged);
+    shared by the latency and quality-scale benchmarks."""
+    if scale == 1.0:
+        return base
+    import dataclasses
+
+    return dataclasses.replace(
+        base,
+        name=f"{base.name}-x{scale:g}",
+        n_on_demand=int(base.n_on_demand * scale),
+        n_spot=int(base.n_spot * scale),
+        n_pods=int(base.n_pods * scale),
+    )
+
+
 def build_problem(config_id: int, seed: int = 0, spec=None):
     """Generate the synthetic cluster and pack it via the production
     observe path: the incrementally-maintained columnar mirror
@@ -169,37 +185,59 @@ def build_problem(config_id: int, seed: int = 0, spec=None):
 
 
 def run_quality(seed: int, sweep: int = 1, solver: str = "numpy") -> int:
-    """Greedy-vs-ILP quality ratio on down-scaled affinity-free clusters
-    (the ILP oracle is only tractable at small scale). ``sweep`` runs
-    seeds [seed, seed+sweep) and reports the WORST ratio — the honest
-    quality number."""
+    """Nodes-freed quality vs the ILP oracle across the quality configs
+    (io/synthetic.QUALITY_CONFIGS): the balanced regime plus the
+    adversarial high-utilization pool configs where one-pass greedy
+    demonstrably loses drains and the local-search repair phase
+    (solver/repair.py) recovers them. Per config, both the reference-
+    faithful pure first-fit planner and the shipped solver (first-fit ∪
+    best-fit ∪ repair) drain to exhaustion; the reported metric is the
+    WORST shipped ratio across configs × seeds [seed, seed+sweep)."""
     from k8s_spot_rescheduler_tpu.bench.quality import (
         drain_to_exhaustion,
         ilp_max_drains,
+        pack_quality,
     )
-    from k8s_spot_rescheduler_tpu.io.synthetic import SyntheticSpec, generate_cluster
+    from k8s_spot_rescheduler_tpu.io.synthetic import (
+        QUALITY_CONFIGS,
+        generate_quality_cluster,
+    )
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
-    spec = SyntheticSpec("quality-40n-300p", 20, 20, 300)
-    ratios = []
-    for s in range(seed, seed + max(1, sweep)):
-        packed, _, _ = build_problem(0, s, spec=spec)
-        ilp = ilp_max_drains(packed)
-        client = generate_cluster(spec, s, reschedule_evicted=True)
-        greedy = drain_to_exhaustion(client, ReschedulerConfig(solver=solver))
-        ratio = greedy / ilp if ilp else 1.0
-        ratios.append(ratio)
-        print(
-            f"quality seed {s}: greedy drained {greedy}, ILP oracle {ilp}, "
-            f"ratio {ratio:.3f}",
-            file=sys.stderr,
-        )
-    worst = min(ratios)
+    rows, worst = [], 1.0
+    for name, spec in QUALITY_CONFIGS.items():
+        for s in range(seed, seed + max(1, sweep)):
+            packed = pack_quality(spec, s)
+            ilp = ilp_max_drains(packed)
+            achieved = {}
+            for variant, cfg in (
+                ("ffd", ReschedulerConfig(
+                    solver=solver, fallback_best_fit=False, repair_rounds=0,
+                    resources=spec.resources)),
+                ("shipped", ReschedulerConfig(
+                    solver=solver, resources=spec.resources)),
+            ):
+                client = generate_quality_cluster(
+                    spec, s, reschedule_evicted=True
+                )
+                achieved[variant] = drain_to_exhaustion(client, cfg)
+            r_ffd = achieved["ffd"] / ilp if ilp else 1.0
+            r_full = achieved["shipped"] / ilp if ilp else 1.0
+            worst = min(worst, r_full)
+            rows.append((name, s, ilp, achieved["ffd"], r_ffd,
+                         achieved["shipped"], r_full))
+            print(
+                f"quality {name} seed {s}: ILP {ilp}  "
+                f"pure-FFD {achieved['ffd']} ({r_ffd:.3f})  "
+                f"shipped {achieved['shipped']} ({r_full:.3f})",
+                file=sys.stderr,
+            )
     print(
-        f"quality over {len(ratios)} seed(s): worst {worst:.3f}, "
-        f"mean {sum(ratios) / len(ratios):.3f}",
+        "quality table (config, seed, ilp, ffd, ffd_ratio, shipped, "
+        f"shipped_ratio): {rows}",
         file=sys.stderr,
     )
+    print(f"worst shipped ratio: {worst:.4f}", file=sys.stderr)
     emit(
         {
             "metric": "nodes_freed_vs_ilp_oracle_ratio",
@@ -208,6 +246,69 @@ def run_quality(seed: int, sweep: int = 1, solver: str = "numpy") -> int:
             "vs_baseline": round(worst / 0.95, 4),
         }
     )
+    return 0
+
+
+def run_quality_scale(args, metric: str, unit: str, backend_note) -> int:
+    """Quality at north-star scale, where the ILP is intractable: the
+    LP-relaxation/Hall upper bound (bench/quality.lp_upper_bound) vs the
+    controller draining to exhaustion in multi-drain mode. Achieved/bound
+    UNDERSTATES true quality (the bound relaxes per-node bins and
+    anti-affinity), so a high ratio here is strong evidence."""
+    from k8s_spot_rescheduler_tpu.bench.quality import (
+        drain_to_exhaustion,
+        lp_upper_bound,
+    )
+    from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    # Exhaustion costs one solve PER DRAIN (the controller re-plans
+    # between drains to avoid spot overcommit) — ~1k drains at full
+    # config-3 scale. On the CPU fallback that cannot fit any sane
+    # budget, _dispatch scales the problem down; the bound and the
+    # achieved count then describe the SAME (scaled) cluster.
+    spec = _scaled_spec(CONFIGS[args.config], args.scale)
+    packed, _, _ = build_problem(args.config, args.seed, spec=spec)
+    t0 = time.perf_counter()
+    bound = lp_upper_bound(packed)
+    t_bound = time.perf_counter() - t0
+    if bound is None:
+        emit_error(metric, unit, "lp_upper_bound failed (linprog unsuccessful)")
+        return 1
+    print(
+        f"LP/Hall upper bound ({spec.name}, seed {args.seed}): "
+        f"{bound} drainable of {int(np.asarray(packed.cand_valid).sum())} "
+        f"candidates ({t_bound:.1f}s)",
+        file=sys.stderr,
+    )
+    cfg = ReschedulerConfig(
+        solver=args.solver,
+        resources=spec.resources,
+        max_drains_per_tick=256,
+    )
+    client = generate_cluster(spec, args.seed, reschedule_evicted=True)
+    t0 = time.perf_counter()
+    achieved = drain_to_exhaustion(client, cfg, max_ticks=200)
+    t_drain = time.perf_counter() - t0
+    ratio = achieved / bound if bound else 1.0
+    print(
+        f"achieved {achieved} drains in {t_drain:.0f}s; "
+        f"achieved/bound {ratio:.3f} (bound relaxes bins+affinity: true "
+        f"oracle ratio is >= this)",
+        file=sys.stderr,
+    )
+    out = {
+        "metric": metric,
+        "value": round(ratio, 4),
+        "unit": unit,
+        "vs_baseline": round(ratio / 0.95, 4),
+        "bound": bound,
+        "achieved": achieved,
+        "scale": args.scale,
+    }
+    if backend_note:
+        out["error"] = backend_note
+    emit(out)
     return 0
 
 
@@ -234,6 +335,11 @@ def _metric_for(args) -> tuple:
     failure paths can emit a well-formed JSON line."""
     if args.quality:
         return "nodes_freed_vs_ilp_oracle_ratio", "ratio"
+    if args.quality_scale:
+        return (
+            "nodes_freed_vs_lp_bound_ratio_config%d" % args.config,
+            "ratio",
+        )
     if args.config == 5:
         return "replay_replan_ms_p50_1k_events", "ms"
     if args.config in (3, 4):
@@ -254,7 +360,12 @@ def main() -> int:
                          "suites pin all backends to the oracle — and must "
                          "not depend on device availability)")
     ap.add_argument("--quality", action="store_true",
-                    help="measure nodes-freed vs ILP oracle (small scale)")
+                    help="measure nodes-freed vs ILP oracle across the "
+                         "quality configs (balanced + adversarial pools)")
+    ap.add_argument("--quality-scale", action="store_true",
+                    help="quality at full scale: controller drains to "
+                         "exhaustion vs the LP/Hall upper bound (the ILP "
+                         "is intractable at config 3/4 scale)")
     ap.add_argument("--sweep", type=int, default=1,
                     help="with --quality: run this many consecutive seeds "
                          "and report the worst ratio")
@@ -289,6 +400,35 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         return run_quality(
             args.seed, sweep=args.sweep, solver=args.solver or "numpy"
         )
+    if args.quality_scale:
+        # host-side controller + solver at scale; the jax CPU/device solver
+        # drives the multi-drain exhaustion run
+        args.solver = args.solver or "jax"
+        platform, attempts, err = acquire_backend(budget_s=args.backend_budget)
+        note = None
+        if platform is None:
+            if args.no_cpu_fallback:
+                emit_error(
+                    metric, unit,
+                    f"no usable jax backend after {attempts} probes: {err}",
+                )
+                return 1
+            note = (
+                f"tpu backend unavailable after {attempts} probes; ran on CPU"
+            )
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            if args.solver == "pallas":
+                args.solver = "jax"
+            if args.scale == 1.0:
+                # exhaustion = one solve per drain; full config-3 scale on
+                # CPU is ~1k x seconds — scale down, and say so
+                args.scale = 0.2
+                note += "; auto-scaled problem to 0.2x"
+        return run_quality_scale(args, metric, unit, note)
+
     args.solver = args.solver or "pallas"
     if args.solver == "numpy":
         ap.error("--solver numpy is the host oracle; use it with --quality "
@@ -336,18 +476,9 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
 
     spec = None
     if args.scale != 1.0:
-        import dataclasses
-
         from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS
 
-        base = CONFIGS[args.config]
-        spec = dataclasses.replace(
-            base,
-            name=f"{base.name}-x{args.scale:g}",
-            n_on_demand=int(base.n_on_demand * args.scale),
-            n_spot=int(base.n_spot * args.scale),
-            n_pods=int(base.n_pods * args.scale),
-        )
+        spec = _scaled_spec(CONFIGS[args.config], args.scale)
     packed, _, pack_s = build_problem(args.config, args.seed, spec=spec)
 
     from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
@@ -370,11 +501,14 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
     # fetches only (idx, found, n, row). NOTE: on this build's tunneled
     # TPU, block_until_ready returns early — the np.asarray fetch is the
     # only honest timing fence, and it is what the loop does anyway.
-    from k8s_spot_rescheduler_tpu.solver.fallback import with_best_fit_fallback
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
     from k8s_spot_rescheduler_tpu.solver.select import decode_selection
 
-    # the production planner path: first-fit + best-fit fallback union
-    union_fn = with_best_fit_fallback(solve_fn)
+    from k8s_spot_rescheduler_tpu.solver.repair import DEFAULT_ROUNDS
+
+    # the production planner path: first-fit ∪ best-fit ∪ local-search
+    # repair, one fused device program (what SolverPlanner ships)
+    union_fn = with_repair(solve_fn, DEFAULT_ROUNDS)
     fused = make_fused_planner(union_fn)
     device_packed = jax.tree.map(jax.numpy.asarray, packed)
 

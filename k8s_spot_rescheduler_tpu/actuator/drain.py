@@ -7,10 +7,12 @@ Host-side reimplementation of the reference's ``scaler`` package
    onto it mid-drain (scaler.go:77 ``MarkToBeDeleted``);
 2. evict every pod, retrying each failed eviction every
    ``eviction_retry_time`` until ``pod_eviction_timeout`` expires
-   (scaler.go:47-62; the reference fans out one goroutine per pod and
-   fans in over a channel, scaler.go:93-113 — here the same retry
-   schedule runs as round-robin passes over the not-yet-evicted set,
-   which preserves the per-pod retry cadence without threads);
+   (scaler.go:47-62). The reference fans out one goroutine per pod and
+   fans in over a channel (scaler.go:93-113); here each retry round
+   fans the not-yet-evicted set out over a bounded thread pool — one
+   slow apiserver call costs one pod-latency per round, not one per
+   pod — and emits the reference's per-pod Normal event before the
+   first attempt (scaler.go:44);
 3. poll every 5 s until every pod is confirmed off the node or the
    timeout passes (scaler.go:119-144);
 4. on success un-taint — the drained node stays schedulable as spare
@@ -21,7 +23,8 @@ Host-side reimplementation of the reference's ``scaler`` package
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence, Tuple
 
 from k8s_spot_rescheduler_tpu.io.cluster import ClusterClient, EventSink
 from k8s_spot_rescheduler_tpu.metrics import registry as metrics
@@ -35,6 +38,41 @@ from k8s_spot_rescheduler_tpu.utils.clock import Clock
 from k8s_spot_rescheduler_tpu.utils import logging as log
 
 VERIFY_POLL_INTERVAL = 5.0  # scaler.go:143 time.Sleep(5 * time.Second)
+
+# The reference spawns one goroutine per pod (scaler.go:93-98); Python
+# threads are heavier, so the fan-out is bounded. Workers only call the
+# (thread-safe) eviction endpoint and bump a (thread-safe) counter —
+# events and retry bookkeeping stay on the actuator thread.
+EVICTION_POOL_SIZE = 32
+
+
+def _evict_round(
+    client: ClusterClient,
+    pods: Sequence[PodSpec],
+    max_graceful_termination: int,
+) -> Tuple[List[PodSpec], Optional[Exception]]:
+    """One parallel eviction pass; returns (failed pods, last error)."""
+
+    def attempt(pod: PodSpec) -> Optional[Exception]:
+        try:
+            client.evict_pod(pod, max_graceful_termination)
+            metrics.update_evictions_count()
+            return None
+        except Exception as err:  # noqa: BLE001 — retried until deadline
+            return err
+
+    if len(pods) == 1:  # no pool for the common one-pod round
+        errs = [attempt(pods[0])]
+    else:
+        with ThreadPoolExecutor(
+            max_workers=min(len(pods), EVICTION_POOL_SIZE)
+        ) as pool:
+            errs = list(pool.map(attempt, pods))
+    failed = [pod for pod, err in zip(pods, errs) if err is not None]
+    last_error = next(
+        (err for err in reversed(errs) if err is not None), None
+    )
+    return failed, last_error
 
 
 class DrainError(Exception):
@@ -72,20 +110,24 @@ def drain_node(
     try:
         retry_until = clock.now() + pod_eviction_timeout
 
+        # Per-pod announcement before the first attempt (scaler.go:44).
+        for pod in pods:
+            recorder.event(
+                "Pod", pod.uid, "Normal", "Rescheduler",
+                "deleting pod from on-demand node",
+            )
+
         # Eviction fan-out with the reference's retry cadence: every pod is
-        # attempted, then the failed set is retried each retry period until
-        # the deadline (scaler.go:47-62 per-pod loop, flattened into rounds).
+        # attempted in parallel (bounded pool standing in for scaler.go's
+        # goroutine-per-pod, 93-113), then the failed set is retried each
+        # retry period until the deadline (scaler.go:47-62).
         remaining: List[PodSpec] = list(pods)
         while remaining:
-            failed: List[PodSpec] = []
-            for pod in remaining:
-                try:
-                    client.evict_pod(pod, max_graceful_termination)
-                    metrics.update_evictions_count()
-                except Exception as err:  # noqa: BLE001 — retry any apiserver
-                    failed.append(pod)  # error until deadline (scaler.go:47-62)
-                    last_error = err
-            remaining = failed
+            remaining, err = _evict_round(
+                client, remaining, max_graceful_termination
+            )
+            if err is not None:
+                last_error = err
             if remaining:
                 if clock.now() + eviction_retry_time >= retry_until:
                     for pod in remaining:

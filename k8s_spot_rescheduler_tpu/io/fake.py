@@ -62,6 +62,13 @@ class FakeCluster:
         self.eviction_failures: Dict[str, int] = {}
         self.evictions: List[str] = []  # audit log of successful evictions
         self._columnar = None  # lazily attached ColumnarStore mirror
+        # pod uid -> spot node name: the planner's proven placement for an
+        # imminent eviction (DrainPlan.assignments). When set, _schedule
+        # tries this node first — standing in for a scheduler that honors
+        # the drain plan (the real kube-scheduler re-places pods by its own
+        # scoring, README.md:116-123; the quality benchmarks measure
+        # *planner* quality, so they route by the proof).
+        self.placement_hints: Dict[str, str] = {}
 
     # --- columnar fast path ---
 
@@ -192,50 +199,63 @@ class FakeCluster:
         for pod in waiting:
             self._schedule(pod)
 
+    def _can_place(self, pod: PodSpec, node: NodeSpec) -> bool:
+        """The fake scheduler's admission check for one (pod, node) pair —
+        the same predicate surface _schedule always enforced."""
+        if not matches_label(node.labels, self.spot_label):
+            return False
+        if not node.ready or node.unschedulable:
+            return False
+        if any(node.labels.get(k) != v for k, v in pod.node_selector.items()):
+            return False
+        if not match_node_affinity(pod.node_affinity, node.labels):
+            return False
+        hard = [t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")]
+        if any(
+            not any(tol.tolerates(t) for tol in pod.tolerations) for t in hard
+        ):
+            return False
+        here = self.list_pods_on_node(node.name)
+        if len(here) >= node.allocatable.get(PODS, 110):
+            return False
+        free_cpu = node.allocatable.get(CPU, 0) - sum(
+            p.requests.get(CPU, 0) for p in here
+        )
+        free_mem = node.allocatable.get(MEMORY, 0) - sum(
+            p.requests.get(MEMORY, 0) for p in here
+        )
+        if pod.anti_affinity_group and any(
+            p.anti_affinity_group == pod.anti_affinity_group for p in here
+        ):
+            return False
+
+        # selector anti-affinity, both directions (the scheduler
+        # respects existing pods' required anti-affinity too)
+        def _repels(a: PodSpec, b: PodSpec) -> bool:
+            return bool(a.anti_affinity_match) and a.namespace == b.namespace and all(
+                b.labels.get(k) == v for k, v in a.anti_affinity_match.items()
+            )
+
+        if any(_repels(pod, p) or _repels(p, pod) for p in here):
+            return False
+        return pod.requests.get(CPU, 0) <= free_cpu and (
+            pod.requests.get(MEMORY, 0) <= free_mem
+        )
+
     def _schedule(self, pod: PodSpec) -> None:
-        """Minimal kube-scheduler stand-in: first spot node with room."""
+        """Minimal kube-scheduler stand-in: the planner's hinted node if one
+        is recorded and still admissible, else first spot node with room."""
         if pod.unmodeled_constraints:
             self.pending.append(pod)  # can't reason about it; stays pending
             return
+        hint = self.placement_hints.pop(pod.uid, None)
+        if hint is not None:
+            node = self.nodes.get(hint)
+            if node is not None and self._can_place(pod, node):
+                self.add_pod(dataclasses.replace(pod, node_name=node.name))
+                return
         for node in self.nodes.values():
-            if not matches_label(node.labels, self.spot_label):
-                continue
-            if not node.ready or node.unschedulable:
-                continue
-            if any(node.labels.get(k) != v for k, v in pod.node_selector.items()):
-                continue
-            if not match_node_affinity(pod.node_affinity, node.labels):
-                continue
-            hard = [t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")]
-            if any(
-                not any(tol.tolerates(t) for tol in pod.tolerations) for t in hard
-            ):
-                continue
-            here = self.list_pods_on_node(node.name)
-            if len(here) >= node.allocatable.get(PODS, 110):
-                continue
-            free_cpu = node.allocatable.get(CPU, 0) - sum(
-                p.requests.get(CPU, 0) for p in here
-            )
-            free_mem = node.allocatable.get(MEMORY, 0) - sum(
-                p.requests.get(MEMORY, 0) for p in here
-            )
-            if pod.anti_affinity_group and any(
-                p.anti_affinity_group == pod.anti_affinity_group for p in here
-            ):
-                continue
-            # selector anti-affinity, both directions (the scheduler
-            # respects existing pods' required anti-affinity too)
-            def _repels(a: PodSpec, b: PodSpec) -> bool:
-                return bool(a.anti_affinity_match) and a.namespace == b.namespace and all(
-                    b.labels.get(k) == v for k, v in a.anti_affinity_match.items()
-                )
-
-            if any(_repels(pod, p) or _repels(p, pod) for p in here):
-                continue
-            if pod.requests.get(CPU, 0) <= free_cpu and (
-                pod.requests.get(MEMORY, 0) <= free_mem
-            ):
+            if self._can_place(pod, node):
                 self.add_pod(dataclasses.replace(pod, node_name=node.name))
                 return
         self.pending.append(pod)

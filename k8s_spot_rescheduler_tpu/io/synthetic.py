@@ -194,6 +194,144 @@ def generate_cluster(
     return fc
 
 
+@dataclasses.dataclass(frozen=True)
+class ContendedSpec:
+    """Adversarial quality config: node pools at high spot utilization
+    where greedy packing demonstrably loses drains.
+
+    The cluster is G independent pools (apps pinned to their pool's spot
+    nodes via ``spec.nodeSelector`` — the standard multi-node-pool k8s
+    pattern). Pool kinds, drawn per seed:
+
+    - **easy** — ample slack; any solver proves the drain.
+    - **swap** — the regime where one-pass greedy fails: the pool's
+      untainted spot capacity is scarce and exactly fits the candidate's
+      *intolerant* pod, but a *tolerant* pod is slightly bigger and sorts
+      first, so first-fit (probe order: most-requested-first, reference
+      rescheduler.go:336-344) and best-fit (tightest slack) both burn the
+      untainted node on the tolerant pod and strand the intolerant one.
+      Relocating the tolerant pod to the pool's looser *tainted* node —
+      one eject-and-reinsert move (solver/repair.py) — unlocks the drain
+      the ILP oracle finds.
+    - **blocked** — the candidate's pod exceeds every pool node's slack;
+      no solver (nor the oracle) drains it.
+
+    Spot nodes in swap pools sit at ≥0.85 utilization; sizes jitter per
+    seed so no solver can pattern-match the construction.
+    """
+
+    name: str
+    n_groups: int = 12
+    swap_frac: float = 0.5
+    easy_frac: float = 0.35  # remainder of groups is blocked
+    node_cpu: int = 4000
+    resources: Tuple[str, ...] = (CPU, MEMORY)
+
+
+QUALITY_CONFIGS = {
+    # the round-1/2 balanced regime (greedy ties the oracle here — kept as
+    # the regression guard that quality never drops below 1.0 on it)
+    "balanced": SyntheticSpec("quality-40n-300p", 20, 20, 300),
+    # contention: high-utilization pools, taints, selector-pinned apps
+    "contended": ContendedSpec("quality-contended-12g"),
+    # contention + Zipf-skewed background load on the easy pools
+    "contended-zipf": ContendedSpec("quality-contended-zipf-16g", n_groups=16,
+                                    swap_frac=0.4, easy_frac=0.45),
+}
+
+
+def _mem_for(cpu: int) -> int:
+    return int(cpu) * 2 * 1024**2  # 2 MiB per millicore: mem never binds
+
+
+def generate_contended_cluster(
+    spec: ContendedSpec, seed: int = 0, **fake_kwargs
+) -> FakeCluster:
+    rng = np.random.default_rng(seed)
+    fc = FakeCluster(FakeClock(), **fake_kwargs)
+    mem = 16 * 1024**3
+    zipfish = "zipf" in spec.name
+
+    def add_node(name, labels, taints=()):
+        node = NodeSpec(
+            name=name,
+            labels=dict(labels),
+            allocatable={CPU: spec.node_cpu, MEMORY: mem, PODS: 110,
+                         EPHEMERAL: 100 * 1024**3},
+            taints=list(taints),
+        )
+        fc.add_node(node)
+        return node
+
+    def add_pod(name, node, cpu, *, app, tolerations=(), selector=None):
+        fc.add_pod(PodSpec(
+            name=name,
+            namespace=f"ns-{app % 16}",
+            node_name=node,
+            requests={CPU: int(cpu), MEMORY: _mem_for(cpu),
+                      EPHEMERAL: int(cpu) * 64 * 1024},
+            labels={"app": f"app-{app}"},
+            owner_refs=[OwnerRef("ReplicaSet", f"app-{app}-rs")],
+            tolerations=list(tolerations),
+            node_selector=dict(selector or {}),
+        ))
+
+    kinds = (["swap"] * round(spec.n_groups * spec.swap_frac)
+             + ["easy"] * round(spec.n_groups * spec.easy_frac))
+    kinds += ["blocked"] * (spec.n_groups - len(kinds))
+    rng.shuffle(kinds)
+
+    for g, kind in enumerate(kinds):
+        pool = {"pool": f"g{g}"}
+        spot_labels = {**SPOT_LABELS, **pool}
+        add_node(f"od-{g}", ON_DEMAND_LABELS)
+        if kind == "swap":
+            # untainted node: slack exactly one intolerant-pod-sized hole,
+            # >=0.85 utilized; tainted node: loose enough to take the
+            # tolerant pod after the repair move
+            slack_u = int(rng.integers(540, 600))
+            t_cpu = slack_u - int(rng.integers(5, 25))
+            i_cpu = t_cpu - int(rng.integers(5, 15))
+            slack_z = t_cpu + int(rng.integers(60, 140))
+            add_node(f"spot-u-{g}", spot_labels)
+            add_node(f"spot-z-{g}", spot_labels, [SPOT_TAINT])
+            add_pod(f"res-u-{g}", f"spot-u-{g}", spec.node_cpu - slack_u,
+                    app=g)
+            add_pod(f"res-z-{g}", f"spot-z-{g}", spec.node_cpu - slack_z,
+                    app=g, tolerations=[SPOT_TOLERATION])
+            add_pod(f"tol-{g}", f"od-{g}", t_cpu, app=g,
+                    tolerations=[SPOT_TOLERATION], selector=pool)
+            add_pod(f"intol-{g}", f"od-{g}", i_cpu, app=g, selector=pool)
+        elif kind == "easy":
+            # two small pods, one spot node with comfortable slack
+            if zipfish:
+                sizes = (rng.zipf(2.2, 2) * 60).clip(60, 700).astype(int)
+            else:
+                sizes = rng.integers(150, 320, 2)
+            slack = int(sizes.sum() + rng.integers(120, 260))
+            add_node(f"spot-u-{g}", spot_labels)
+            add_pod(f"res-u-{g}", f"spot-u-{g}", spec.node_cpu - slack,
+                    app=g)
+            for j, cpu in enumerate(sizes):
+                add_pod(f"app-{g}-{j}", f"od-{g}", int(cpu), app=g,
+                        selector=pool)
+        else:  # blocked: pod larger than any slack in its pool
+            slack = int(rng.integers(300, 480))
+            add_node(f"spot-u-{g}", spot_labels)
+            add_pod(f"res-u-{g}", f"spot-u-{g}", spec.node_cpu - slack,
+                    app=g)
+            add_pod(f"big-{g}", f"od-{g}", slack + int(rng.integers(300, 700)),
+                    app=g, selector=pool)
+    return fc
+
+
+def generate_quality_cluster(spec, seed: int = 0, **fake_kwargs) -> FakeCluster:
+    """Dispatch: SyntheticSpec (balanced random fill) or ContendedSpec."""
+    if isinstance(spec, ContendedSpec):
+        return generate_contended_cluster(spec, seed, **fake_kwargs)
+    return generate_cluster(spec, seed, **fake_kwargs)
+
+
 @dataclasses.dataclass
 class ReplayEvent:
     at: float  # seconds from start

@@ -63,6 +63,33 @@ plan_candidates = Gauge(
     namespace=NAMESPACE,
 )
 
+# Conservatism observability (VERDICT round-2 task 4): the planner's
+# safe-direction over-approximations can silently pin the controller at
+# zero drains (one unmodeled-constraint pod per on-demand node is enough).
+# These series tell the operator WHY no drain happened — the reference
+# only logs the blocking pod per node (rescheduler.go:232-238).
+
+unplaceable_pods = Gauge(
+    "unplaceable_pods",
+    "Evictable pods on candidate nodes whose scheduling constraints the "
+    "planner does not model (treated as placeable nowhere; such a pod's "
+    "node can never be proven drainable).",
+    namespace=NAMESPACE,
+)
+
+blocked_candidates = Gauge(
+    "blocked_candidates",
+    "Candidate on-demand nodes whose drain could not be approved this "
+    "tick, by reason: unmodeled (carries an unplaceable pod), pdb "
+    "(disruption budget exhausted), non-replicated (bare pod without "
+    "--delete-non-replicated-pods), no-capacity (solver proved no "
+    "predicate-valid placement exists).",
+    ["reason"],
+    namespace=NAMESPACE,
+)
+
+BLOCKED_REASONS = ("unmodeled", "pdb", "non-replicated", "no-capacity")
+
 tick_phase_duration = Histogram(
     "tick_phase_duration_seconds",
     "Wall time of each housekeeping-tick phase (observe/plan/actuate).",
@@ -98,6 +125,15 @@ def observe_plan_duration(solver: str, seconds: float, candidates: int) -> None:
 
 def observe_tick_phase(phase: str, seconds: float) -> None:
     tick_phase_duration.labels(phase).observe(seconds)
+
+
+def update_conservatism(n_unplaceable: int, by_reason: dict) -> None:
+    """Refresh the why-no-drain gauges after each solve. Every reason
+    label is written every tick (absent -> 0) so a recovered cluster
+    reads 0, not a stale count."""
+    unplaceable_pods.set(n_unplaceable)
+    for reason in BLOCKED_REASONS:
+        blocked_candidates.labels(reason).set(int(by_reason.get(reason, 0)))
 
 
 def serve(listen_address: str) -> None:

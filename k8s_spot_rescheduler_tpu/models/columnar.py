@@ -135,6 +135,43 @@ class ColumnarMeta:
             for row, reason in self.blocking
         ]
 
+    def _unmodeled_slot_mask(self) -> np.ndarray:
+        """bool per slot row: the pod's constraint profile is unmodeled.
+        Computed once per pack (the conservatism report reads it twice
+        per plan call)."""
+        cached = getattr(self, "_unmod_slots", None)
+        if cached is not None:
+            return cached
+        store = self.store
+        if not len(self.slot_rows):
+            mask = np.zeros(0, bool)
+        else:
+            unmod_by_tid = np.fromiter(
+                (u for (_, _, _, u) in store._tol_lists),
+                bool,
+                count=len(store._tol_lists),
+            )
+            mask = unmod_by_tid[store.p_tol_id[self.slot_rows]]
+        self._unmod_slots = mask
+        return mask
+
+    def unmodeled_candidate_mask(self) -> np.ndarray:
+        """bool [n_candidates]: lane carries >=1 unmodeled-constraint pod
+        (packed as placeable-nowhere -> the lane can never prove).
+        Vectorized: one gather over the interned constraint profiles."""
+        C = self.n_candidates
+        if not C:
+            return np.zeros(0, bool)
+        slot_unmod = self._unmodeled_slot_mask()
+        out = np.zeros(C, bool)
+        if len(slot_unmod):
+            cand_of_slot = np.repeat(np.arange(C), self.slot_counts)
+            np.logical_or.at(out, cand_of_slot, slot_unmod)
+        return out
+
+    def unplaceable_pod_count(self) -> int:
+        return int(self._unmodeled_slot_mask().sum())
+
     def candidate_pods(self, c: int) -> List[PodSpec]:
         rows = self.slot_rows[
             self.slot_starts[c] : self.slot_starts[c] + self.slot_counts[c]

@@ -127,6 +127,22 @@ class PackMeta:
     def blocking_pods(self) -> List[BlockingPod]:
         return [b for b in self.blocking if b is not None]
 
+    def unmodeled_candidate_mask(self) -> np.ndarray:
+        """bool [n_candidates]: lane carries >=1 unmodeled-constraint pod
+        (packed as placeable-nowhere -> the lane can never prove)."""
+        return np.array(
+            [any(p.unmodeled_constraints for p in pods) for pods in self.cand_pods],
+            bool,
+        )
+
+    def unplaceable_pod_count(self) -> int:
+        return sum(
+            1
+            for pods in self.cand_pods
+            for p in pods
+            if p.unmodeled_constraints
+        )
+
     def build_plan(self, c: int, row: np.ndarray):
         from k8s_spot_rescheduler_tpu.planner.base import DrainPlan
 

@@ -149,7 +149,13 @@ class SolverPlanner:
                         result.feasible[:, None], result.assignment, bf.assignment
                     ),
                 )
-                if self.config.repair_rounds > 0:
+                need_repair = bool(
+                    np.any(np.asarray(packed.cand_valid) & ~result.feasible)
+                )
+                if self.config.repair_rounds > 0 and need_repair:
+                    # mirror of the device path's lax.cond gate
+                    # (solver/fallback.with_repair): repair results are
+                    # only consumed for lanes greedy failed
                     from k8s_spot_rescheduler_tpu.solver.repair import (
                         plan_repair_oracle,
                     )
@@ -172,6 +178,8 @@ class SolverPlanner:
                 c = int(np.argmax(feasible))
                 plan = meta.build_plan(c, np.asarray(result.assignment[c]))
 
+        self._report_conservatism(packed, meta, n_feasible)
+
         report = PlanReport(
             plan=plan,
             n_candidates=meta.n_candidates,
@@ -181,3 +189,38 @@ class SolverPlanner:
             feasible_candidates=[plan] if plan else [],
         )
         return report
+
+    def _report_conservatism(self, packed, meta, n_feasible: int) -> None:
+        """Why-no-drain observability (metrics/registry.py conservatism
+        gauges): classify every non-drainable candidate. The reference
+        only logs the blocking pod per node (rescheduler.go:232-238);
+        here the safe-direction over-approximations (unmodeled
+        constraints pack as placeable-nowhere) additionally surface as
+        metrics, because one such pod per on-demand node silently pins
+        the controller at zero drains forever."""
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+        by_reason = {"pdb": 0, "non-replicated": 0}
+        for blocked in meta.blocking_pods():
+            if blocked.reason.startswith("pod is not replicated"):
+                by_reason["non-replicated"] += 1
+            else:
+                by_reason["pdb"] += 1
+        unmodeled_mask = meta.unmodeled_candidate_mask()
+        by_reason["unmodeled"] = int(unmodeled_mask.sum())
+        cand_valid = np.asarray(packed.cand_valid)[: meta.n_candidates]
+        by_reason["no-capacity"] = max(
+            0,
+            int(cand_valid.sum()) - n_feasible - by_reason["unmodeled"],
+        )
+        n_unplaceable = meta.unplaceable_pod_count()
+        metrics.update_conservatism(n_unplaceable, by_reason)
+        if n_feasible == 0 and any(by_reason.values()):
+            log.vlog(
+                2,
+                "No drainable candidate: %d blocked (%s); %d unplaceable "
+                "pod(s) on candidate nodes.",
+                sum(by_reason.values()),
+                ", ".join(f"{k}={v}" for k, v in sorted(by_reason.items()) if v),
+                n_unplaceable,
+            )

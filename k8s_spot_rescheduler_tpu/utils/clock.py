@@ -10,6 +10,7 @@ testable with a virtual clock.
 from __future__ import annotations
 
 import heapq
+import threading
 import time as _time
 from typing import Protocol
 
@@ -37,21 +38,28 @@ class FakeClock:
         self._now = float(start)
         self._timers: list = []  # heap of (when, seq, fn)
         self._seq = 0
+        # the actuator's eviction fan-out schedules termination timers
+        # from worker threads (actuator/drain.py)
+        self._lock = threading.Lock()
 
     def now(self) -> float:
         return self._now
 
     def call_at(self, when: float, fn) -> None:
-        heapq.heappush(self._timers, (float(when), self._seq, fn))
-        self._seq += 1
+        with self._lock:
+            heapq.heappush(self._timers, (float(when), self._seq, fn))
+            self._seq += 1
 
     def sleep(self, seconds: float) -> None:
         self.advance(max(0.0, seconds))
 
     def advance(self, seconds: float) -> None:
         deadline = self._now + float(seconds)
-        while self._timers and self._timers[0][0] <= deadline:
-            when, _, fn = heapq.heappop(self._timers)
-            self._now = max(self._now, when)
-            fn()
+        while True:
+            with self._lock:
+                if not self._timers or self._timers[0][0] > deadline:
+                    break
+                when, _, fn = heapq.heappop(self._timers)
+                self._now = max(self._now, when)
+            fn()  # outside the lock: fn may schedule follow-up timers
         self._now = deadline

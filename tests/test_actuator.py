@@ -80,3 +80,52 @@ def test_pod_stuck_on_node_fails_verification():
     with pytest.raises(DrainError, match="pods remaining"):
         _drain(fc, clock, pods, pod_eviction_timeout=30.0)
     assert fc.nodes["od-1"].taints == []
+
+
+def test_per_pod_normal_event_emitted():
+    """Reference scaler.go:44: each pod gets a Normal 'deleting pod from
+    on-demand node' event before its eviction is attempted."""
+    fc, clock, pods = _cluster_with_node(n_pods=3)
+    _drain(fc, clock, pods)
+    deleting = [
+        e for e in fc.events
+        if e.kind == "Pod" and e.event_type == "Normal"
+        and "deleting pod from on-demand node" in e.message
+    ]
+    assert sorted(e.name for e in deleting) == sorted(p.uid for p in pods)
+    # announced once per pod per drain, even though retries may loop
+    assert len(deleting) == len(pods)
+
+
+def test_eviction_fanout_parallelizes_slow_evictions():
+    """50 slow evictions complete a round in ~a pod-latency, not 50 of
+    them (reference fans out one goroutine per pod, scaler.go:93-113)."""
+    import time as _time
+
+    fc, clock, pods = _cluster_with_node(n_pods=50)
+    original = fc.evict_pod
+    PER_POD = 0.05
+
+    def slow(pod, grace):
+        _time.sleep(PER_POD)  # wall latency: the apiserver round trip
+        return original(pod, grace)
+
+    fc.evict_pod = slow
+    t0 = _time.perf_counter()
+    _drain(fc, clock, pods)
+    wall = _time.perf_counter() - t0
+    assert sorted(fc.evictions) == sorted(p.uid for p in pods)
+    # serial would be >= 50 * PER_POD = 2.5 s; the bounded pool (32) needs
+    # ceil(50/32)=2 waves plus overhead — assert well under serial time
+    assert wall < 25 * PER_POD, f"eviction round took {wall:.2f}s (serial?)"
+
+
+def test_fanout_retry_failures_still_respected():
+    """Parallel rounds preserve the retry cadence: pods with injected
+    failures get retried next round and eventually succeed."""
+    fc, clock, pods = _cluster_with_node(n_pods=8)
+    for p in pods[::2]:
+        fc.eviction_failures[p.uid] = 2  # fail twice, succeed third round
+    _drain(fc, clock, pods)
+    assert sorted(set(fc.evictions)) == sorted(p.uid for p in pods)
+    assert fc.list_pods_on_node("od-1") == []

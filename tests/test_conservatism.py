@@ -1,0 +1,98 @@
+"""Conservatism observability (VERDICT round-2 task 4).
+
+The planner's safe-direction over-approximations (unmodeled constraints
+pack as placeable-nowhere) can silently pin the controller at zero
+drains. These tests pin the why-no-drain metrics: an operator reading
+/metrics must see unplaceable-pod counts and per-reason blocked-candidate
+counts — the reference only logs the blocking pod per node
+(rescheduler.go:232-238).
+"""
+
+import dataclasses
+
+import pytest
+from prometheus_client import REGISTRY
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.models.cluster import PDBSpec
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import ON_DEMAND_LABELS, SPOT_LABELS, make_node, make_pod
+
+
+def _value(name, labels=None):
+    return REGISTRY.get_sample_value(f"spot_rescheduler_{name}", labels or {})
+
+
+def _blocked(reason):
+    return _value("blocked_candidates", {"reason": reason})
+
+
+def _tick(fc, *, use_columnar):
+    cfg = ReschedulerConfig(solver="numpy", use_columnar=use_columnar)
+    clock = fc.clock
+    return Rescheduler(fc, SolverPlanner(cfg), cfg, clock=clock).tick()
+
+
+@pytest.mark.parametrize("use_columnar", [True, False])
+def test_unmodeled_pod_counts_as_unplaceable(use_columnar):
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot", SPOT_LABELS))
+    fc.add_pod(make_pod("poison", 100, "od", unmodeled_constraints=True))
+    fc.add_pod(make_pod("fine", 100, "od"))
+    result = _tick(fc, use_columnar=use_columnar)
+    assert not result.drained
+    assert _value("unplaceable_pods") == 1
+    assert _blocked("unmodeled") == 1
+    assert _blocked("no-capacity") == 0
+    assert _blocked("pdb") == 0
+
+
+@pytest.mark.parametrize("use_columnar", [True, False])
+def test_pdb_and_nonreplicated_reasons(use_columnar):
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od2", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot", SPOT_LABELS, cpu_millis=4000))
+    fc.add_pod(make_pod("pdbpod", 100, "od1", labels={"app": "a"}))
+    fc.pdbs.append(
+        PDBSpec(name="pdb-a", namespace="default",
+                match_labels={"app": "a"}, disruptions_allowed=0)
+    )
+    fc.add_pod(make_pod("bare", 100, "od2", replicated=False))
+    result = _tick(fc, use_columnar=use_columnar)
+    assert not result.drained
+    assert _blocked("pdb") == 1
+    assert _blocked("non-replicated") == 1
+    assert _value("unplaceable_pods") == 0
+
+
+@pytest.mark.parametrize("use_columnar", [True, False])
+def test_no_capacity_reason(use_columnar):
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot", SPOT_LABELS, cpu_millis=500))
+    fc.add_pod(make_pod("big", 1800, "od"))
+    result = _tick(fc, use_columnar=use_columnar)
+    assert not result.drained
+    assert _blocked("no-capacity") == 1
+    assert _blocked("unmodeled") == 0
+    assert _value("unplaceable_pods") == 0
+
+
+def test_gauges_reset_when_cluster_recovers():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot", SPOT_LABELS, cpu_millis=500))
+    fc.add_pod(make_pod("big", 1800, "od"))
+    _tick(fc, use_columnar=True)
+    assert _blocked("no-capacity") == 1
+    # capacity arrives: the blocked count must drop back to zero
+    fc.add_node(make_node("spot2", SPOT_LABELS, cpu_millis=4000))
+    result = _tick(fc, use_columnar=True)
+    assert result.drained == ["od"]
+    assert _blocked("no-capacity") == 0
+    assert _value("unplaceable_pods") == 0

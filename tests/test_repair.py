@@ -14,7 +14,7 @@ import pytest
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
-from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd, plan_ffd_jit
+from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
 from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.solver.repair import (
     plan_repair_jit,
