@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check test bench quality replay demo dryrun docker-build clean native
+.PHONY: all check lint test bench quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -13,7 +13,14 @@ all:
 	-$(MAKE) native
 	$(MAKE) check
 
-check: test
+# The CI entry: lint+format gate, then tests — mirroring the reference's
+# fmt/golangci-lint/vet/test chain (reference Makefile:36-65). tools/
+# lint.py is the zero-dependency stand-in (this image ships no Python
+# linter and installs are forbidden).
+check: lint test
+
+lint:
+	python tools/lint.py
 
 # best-effort native build first: the native differential suite fails
 # (not skips) when a toolchain exists but the library won't load

@@ -15,7 +15,7 @@ not exhausted — the regime the rescheduler operates in (README.md:136-149).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 

@@ -16,8 +16,6 @@ from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
 from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, SyntheticSpec, generate_cluster
 from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
 from k8s_spot_rescheduler_tpu.models.cluster import (
-    CPU,
-    MEMORY,
     PDBSpec,
     Taint,
     build_node_map,

@@ -8,7 +8,6 @@ counts — the reference only logs the blocking pod per node
 (rescheduler.go:232-238).
 """
 
-import dataclasses
 
 import pytest
 from prometheus_client import REGISTRY

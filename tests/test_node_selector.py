@@ -11,7 +11,6 @@ framework does not model (required affinity, PVCs).
 
 from __future__ import annotations
 
-import dataclasses
 
 import numpy as np
 

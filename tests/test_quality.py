@@ -1,7 +1,6 @@
 """Quality-oracle and replay-harness tests (BASELINE.md configs 4/5
 machinery at test scale)."""
 
-import numpy as np
 
 from k8s_spot_rescheduler_tpu.bench.quality import (
     drain_to_exhaustion,

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Zero-dependency lint + format gate.
+
+The reference's ``make check`` chains gofmt + golangci-lint + go vet +
+tests (reference Makefile:36-65, configure:1-115). This environment ships
+no Python linter and forbids installing one, so this is the stdlib
+equivalent: an AST/token pass enforcing the high-signal subset —
+
+  lint (vet analog)
+    unused-import      import never referenced (skipped in __init__.py
+                       re-export shims; ``as _x`` aliases exempt)
+    redefinition       same top-level def/class bound twice
+    bare-except        ``except:`` swallowing SystemExit/KeyboardInterrupt
+    none-compare       ``== None`` / ``!= None`` instead of ``is``
+    empty-fstring      f-string with no placeholders
+    mutable-default    list/dict/set literal as a parameter default
+
+  format (gofmt analog)
+    trailing-space     whitespace at end of line
+    tab-indent         hard tabs in indentation
+    no-final-newline   file does not end with exactly one newline
+    crlf               carriage returns
+
+``# noqa`` on the offending line suppresses lint findings for that line.
+Exit status 0 = clean, 1 = findings (printed as path:line: code message).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+SKIP_DIRS = {".git", "__pycache__", ".claude", "node_modules"}
+
+
+def iter_py_files(roots):
+    for root in roots:
+        p = Path(root)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+            continue
+        for f in sorted(p.rglob("*.py")):
+            if not any(part in SKIP_DIRS for part in f.parts):
+                yield f
+
+
+def _noqa_lines(source: str):
+    return {
+        i
+        for i, line in enumerate(source.splitlines(), 1)
+        if "# noqa" in line
+    }
+
+
+class _Lint(ast.NodeVisitor):
+    def __init__(self, path: Path, source: str):
+        self.path = path
+        self.is_init = path.name == "__init__.py"
+        self.noqa = _noqa_lines(source)
+        self.findings = []
+        self.imports = []  # (lineno, alias bound name)
+        self.used = set()
+
+    def add(self, lineno: int, code: str, msg: str) -> None:
+        if lineno not in self.noqa:
+            self.findings.append((self.path, lineno, code, msg))
+
+    # --- usage collection ---
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        # "import a.b" is used via "a.b.c" — the Name visitor catches the
+        # base; dotted-module imports bind the first segment only
+        self.generic_visit(node)
+
+    # --- checks ---
+
+    def _collect_import(self, node, name: str) -> None:
+        bound = name.split(".")[0]
+        if not bound.startswith("_"):
+            self.imports.append((node.lineno, bound))
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            self._collect_import(node, alias.asname or alias.name)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":  # compiler directive, not a binding
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self._collect_import(node, alias.asname or alias.name)
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.add(node.lineno, "bare-except",
+                     "bare 'except:' also catches SystemExit; name the "
+                     "exception (or use 'except Exception')")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        for op, comp in zip(node.ops, node.comparators):
+            if (
+                isinstance(op, (ast.Eq, ast.NotEq))
+                and isinstance(comp, ast.Constant)
+                and comp.value is None
+            ):
+                self.add(node.lineno, "none-compare",
+                         "comparison to None should be 'is [not] None'")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.add(node.lineno, "empty-fstring",
+                     "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node):
+        # a format spec (":.0f") is itself a JoinedStr — recursing into it
+        # would flag every formatted placeholder as an empty f-string
+        self.visit(node.value)
+        if node.format_spec is not None:
+            for part in node.format_spec.values:
+                if isinstance(part, ast.FormattedValue):
+                    self.visit(part)
+
+    def _check_defaults(self, node):
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.add(default.lineno, "mutable-default",
+                         "mutable literal as parameter default")
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def finish(self, tree) -> None:
+        # top-level redefinitions (second def/class under the same name)
+        seen = {}
+        for stmt in tree.body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                if stmt.name in seen:
+                    self.add(stmt.lineno, "redefinition",
+                             f"'{stmt.name}' already defined at line "
+                             f"{seen[stmt.name]}")
+                seen[stmt.name] = stmt.lineno
+        if not self.is_init:  # __init__.py imports are the re-export API
+            for lineno, bound in self.imports:
+                if bound not in self.used:
+                    self.add(lineno, "unused-import",
+                             f"'{bound}' imported but unused")
+
+
+def check_format(path: Path, raw: bytes):
+    findings = []
+    if b"\r" in raw:
+        findings.append((path, 1, "crlf", "carriage returns present"))
+    text = raw.decode("utf-8", errors="replace")
+    for i, line in enumerate(text.splitlines(), 1):
+        if line != line.rstrip():
+            findings.append((path, i, "trailing-space",
+                             "trailing whitespace"))
+        stripped = line.lstrip(" ")
+        if stripped.startswith("\t"):
+            findings.append((path, i, "tab-indent", "tab in indentation"))
+    if raw and not raw.endswith(b"\n"):
+        findings.append((path, text.count("\n") + 1, "no-final-newline",
+                         "file does not end with a newline"))
+    return findings
+
+
+def run(roots) -> int:
+    findings = []
+    for path in iter_py_files(roots):
+        raw = path.read_bytes()
+        findings.extend(check_format(path, raw))
+        source = raw.decode("utf-8", errors="replace")
+        try:
+            tree = ast.parse(source, filename=str(path))
+        except SyntaxError as err:
+            findings.append((path, err.lineno or 1, "syntax-error", err.msg))
+            continue
+        lint = _Lint(path, source)
+        lint.visit(tree)
+        lint.finish(tree)
+        findings.extend(lint.findings)
+
+    for path, lineno, code, msg in sorted(
+        findings, key=lambda f: (str(f[0]), f[1])
+    ):
+        print(f"{path}:{lineno}: {code} {msg}")
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    roots = sys.argv[1:] or [
+        "k8s_spot_rescheduler_tpu", "tests", "tools",
+        "bench.py", "__graft_entry__.py",
+    ]
+    sys.exit(run(roots))
