@@ -34,7 +34,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--running-in-cluster", type=_bool, default=d.running_in_cluster,
                    help="use in-cluster credentials (reference rescheduler.go:53)")
     p.add_argument("--namespace", default=d.namespace)
-    p.add_argument("--kube-api-content-type", default=d.kube_api_content_type)
+    # NOTE: the reference's --kube-api-content-type (rescheduler.go:60-61,
+    # protobuf wire format) is deliberately NOT reproduced: this client
+    # speaks JSON only, and its answer to protobuf's decode-cost
+    # motivation is the native columnar ingest engine (native/ingest.cc),
+    # which decodes a 50k-pod JSON LIST faster than the Python protobuf
+    # path could. A flag that silently did nothing would be worse than
+    # no flag.
     p.add_argument("--housekeeping-interval", default="10s",
                    help="how often rescheduler takes actions (Go duration)")
     p.add_argument("--node-drain-delay", default="10m",
@@ -95,7 +101,6 @@ def config_from_args(args) -> ReschedulerConfig:
     return ReschedulerConfig(
         running_in_cluster=args.running_in_cluster,
         namespace=args.namespace,
-        kube_api_content_type=args.kube_api_content_type,
         housekeeping_interval=parse_duration(args.housekeeping_interval),
         node_drain_delay=parse_duration(args.node_drain_delay),
         pod_eviction_timeout=parse_duration(args.pod_eviction_timeout),

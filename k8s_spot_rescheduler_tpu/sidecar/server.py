@@ -36,9 +36,37 @@ from k8s_spot_rescheduler_tpu.utils import logging as log
 
 
 class PlannerSidecar:
-    def __init__(self, config: ReschedulerConfig, address: str = "127.0.0.1:8642"):
+    """Deployable solver service (deploy/sidecar.yaml ships it), so its
+    edges are bounded:
+
+    - ``max_body_bytes`` caps the snapshot size (413 beyond it; a 50k-pod
+      cluster LIST is ~30 MB, so the default leaves ample headroom while
+      keeping a misdirected upload from exhausting memory);
+    - one solve runs at a time (jit caches are per-process; concurrent
+      tracing would thrash them); a request whose turn has not come
+      within ``busy_timeout_s`` gets 503 + Retry-After. The solve itself
+      is not interruptible (an XLA dispatch cannot be safely cancelled
+      mid-flight), so the busy timeout is the deadline knob. Note the
+      bound this buys: queue *time* per request is capped, not queue
+      depth — a burst of N requests each under the timeout all execute
+      in turn, each holding its parsed body (ThreadingHTTPServer is
+      thread-per-request), so worst-case transient memory is
+      N x max_body_bytes. Size busy_timeout_s near the caller's tick
+      interval to keep N small.
+    """
+
+    def __init__(
+        self,
+        config: ReschedulerConfig,
+        address: str = "127.0.0.1:8642",
+        *,
+        max_body_bytes: int = 128 << 20,
+        busy_timeout_s: float = 30.0,
+    ):
         self.config = config
         self.planner = SolverPlanner(config)
+        self.max_body_bytes = int(max_body_bytes)
+        self.busy_timeout_s = float(busy_timeout_s)
         self._lock = threading.Lock()  # one solve at a time; jit is cached
         host, _, port = address.rpartition(":")
         sidecar = self
@@ -47,11 +75,13 @@ class PlannerSidecar:
             def log_message(self, *a):
                 pass
 
-            def _send(self, obj, code=200):
+            def _send(self, obj, code=200, headers=()):
                 data = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -65,13 +95,40 @@ class PlannerSidecar:
                     return self._send({"error": "not found"}, 404)
                 try:
                     length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    return self._send({"error": "bad Content-Length"}, 400)
+                if length < 0:
+                    # a negative length must not reach rfile.read(-1),
+                    # which would buffer the stream until EOF — the exact
+                    # exhaustion the size cap exists to prevent
+                    return self._send({"error": "bad Content-Length"}, 400)
+                if length > sidecar.max_body_bytes:
+                    return self._send(
+                        {
+                            "error": "snapshot exceeds %d-byte limit"
+                            % sidecar.max_body_bytes
+                        },
+                        413,
+                    )
+                try:
                     body = json.loads(self.rfile.read(length))
-                    result = sidecar.plan(body)
+                except ValueError as err:
+                    return self._send({"error": str(err)}, 400)
+                if not sidecar._lock.acquire(timeout=sidecar.busy_timeout_s):
+                    return self._send(
+                        {"error": "planner busy (solve in progress)"},
+                        503,
+                        headers=[("Retry-After", "1")],
+                    )
+                try:
+                    result = sidecar.plan_locked(body)
                 except (ValueError, KeyError) as err:
                     return self._send({"error": str(err)}, 400)
                 except Exception as err:  # noqa: BLE001 — solver failure
                     log.error("sidecar plan failed: %s", err)
                     return self._send({"error": str(err)}, 500)
+                finally:
+                    sidecar._lock.release()
                 return self._send(result)
 
         self.server = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
@@ -82,6 +139,17 @@ class PlannerSidecar:
         return f"{host}:{port}"
 
     def plan(self, body: dict) -> dict:
+        """Decode + solve, serialized on the sidecar lock (public entry
+        for in-process callers; the HTTP handler holds the lock already
+        and calls plan_locked)."""
+        if not self._lock.acquire(timeout=self.busy_timeout_s):
+            raise TimeoutError("planner busy (solve in progress)")
+        try:
+            return self.plan_locked(body)
+        finally:
+            self._lock.release()
+
+    def plan_locked(self, body: dict) -> dict:
         nodes = [decode_node(o) for o in body.get("nodes", [])]
         pods = [decode_pod(o) for o in body.get("pods", [])]
         pdbs = [decode_pdb(o) for o in body.get("pdbs", [])]
@@ -95,8 +163,7 @@ class PlannerSidecar:
             spot_label=self.config.spot_node_label,
             priority_threshold=self.config.priority_threshold,
         )
-        with self._lock:
-            report = self.planner.plan(node_map, pdbs)
+        report = self.planner.plan(node_map, pdbs)
         out = {
             "found": report.plan is not None,
             "nCandidates": report.n_candidates,
@@ -127,11 +194,18 @@ def main(argv=None) -> int:
     ap.add_argument("--listen", default="127.0.0.1:8642")
     ap.add_argument("--solver", default="jax",
                     choices=["jax", "numpy", "pallas", "sharded"])
+    ap.add_argument("--max-body-mb", type=int, default=128,
+                    help="reject /v1/plan snapshots larger than this (413)")
+    ap.add_argument("--busy-timeout", type=float, default=30.0,
+                    help="seconds a request may wait for the in-flight "
+                         "solve before 503 (backpressure, not queueing)")
     ap.add_argument("-v", "--verbosity", type=int, default=0)
     args = ap.parse_args(argv)
     log.setup(args.verbosity)
     sidecar = PlannerSidecar(
-        ReschedulerConfig(solver=args.solver), args.listen
+        ReschedulerConfig(solver=args.solver), args.listen,
+        max_body_bytes=args.max_body_mb << 20,
+        busy_timeout_s=args.busy_timeout,
     )
     sidecar.serve_forever()
     return 0

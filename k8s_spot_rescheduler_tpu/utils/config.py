@@ -22,8 +22,13 @@ class ReschedulerConfig:
 
     - ``running_in_cluster``      — rescheduler.go:53-55
     - ``namespace``               — rescheduler.go:57-58
-    - ``kube_api_content_type``   — rescheduler.go:60-61
     - ``housekeeping_interval``   — rescheduler.go:63-64 (10 s)
+
+    Deliberately absent: the reference's ``--kube-api-content-type``
+    (rescheduler.go:60-61). This client is JSON-only; the decode-cost
+    problem protobuf solves is answered here by the native columnar
+    ingest engine (native/ingest.cc). Carrying a flag the client ignores
+    would mislead operators.
     - ``node_drain_delay``        — rescheduler.go:66-67 (10 min)
     - ``pod_eviction_timeout``    — rescheduler.go:69-71 (2 min)
     - ``max_graceful_termination``— rescheduler.go:73-75 (2 min)
@@ -62,7 +67,6 @@ class ReschedulerConfig:
 
     running_in_cluster: bool = True
     namespace: str = "kube-system"
-    kube_api_content_type: str = "application/vnd.kubernetes.protobuf"
     housekeeping_interval: float = 10.0
     node_drain_delay: float = 600.0
     pod_eviction_timeout: float = 120.0

@@ -72,3 +72,129 @@ def test_bad_request(sidecar):
         raised = True
         assert err.code == 400
     assert raised
+
+
+def _post_raw(sidecar, data, headers=None):
+    req = urllib.request.Request(
+        f"http://{sidecar.address}/v1/plan",
+        data=data,
+        headers=headers or {"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def test_oversized_snapshot_rejected():
+    s = PlannerSidecar(
+        ReschedulerConfig(solver="numpy"), "127.0.0.1:0", max_body_bytes=1024
+    )
+    s.start_background()
+    try:
+        code, body = _post_raw(s, b"x" * 2048)
+        assert code == 413
+        assert "limit" in body["error"]
+        # the server survives and stays healthy
+        with urllib.request.urlopen(
+            f"http://{s.address}/healthz", timeout=10
+        ) as resp:
+            assert json.loads(resp.read())["ok"] is True
+    finally:
+        s.close()
+
+
+def test_busy_timeout_yields_503():
+    """A request that cannot get its turn within busy_timeout_s gets 503 +
+    Retry-After instead of queueing unboundedly."""
+    import threading
+    import time
+
+    s = PlannerSidecar(
+        ReschedulerConfig(solver="numpy"), "127.0.0.1:0", busy_timeout_s=0.2
+    )
+    inner = s.planner
+
+    class Slow:
+        def plan(self, node_map, pdbs):
+            time.sleep(1.5)
+            return inner.plan(node_map, pdbs)
+
+    s.planner = Slow()
+    s.start_background()
+    try:
+        body = json.dumps({
+            "nodes": [_node("od-1", "worker"), _node("spot-1", "spot-worker")],
+            "pods": [_pod("a", "od-1", cpu="100m")],
+        }).encode()
+        results = []
+
+        def fire():
+            results.append(_post_raw(s, body))
+
+        threads = [threading.Thread(target=fire) for _ in range(3)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)  # ensure one holds the lock first
+        for t in threads:
+            t.join()
+        codes = sorted(c for c, _ in results)
+        assert codes[0] == 200, f"no request succeeded: {results}"
+        assert 503 in codes, f"no request saw backpressure: {codes}"
+    finally:
+        s.close()
+
+
+def test_concurrent_requests_all_served():
+    """Within the busy timeout, concurrent requests serialize on the solve
+    lock and all succeed."""
+    import threading
+
+    s = PlannerSidecar(
+        ReschedulerConfig(solver="numpy"), "127.0.0.1:0", busy_timeout_s=30.0
+    )
+    s.start_background()
+    try:
+        body = json.dumps({
+            "nodes": [_node("od-1", "worker"), _node("spot-1", "spot-worker")],
+            "pods": [_pod("a", "od-1", cpu="100m")],
+        }).encode()
+        results = []
+
+        def fire():
+            results.append(_post_raw(s, body))
+
+        threads = [threading.Thread(target=fire) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 8
+        assert all(code == 200 for code, _ in results), results
+        assert all(out["found"] for _, out in results)
+    finally:
+        s.close()
+
+
+def test_negative_content_length_rejected():
+    """A negative Content-Length must not reach rfile.read(-1) (which
+    would buffer until EOF, bypassing the size cap)."""
+    import http.client
+
+    s = PlannerSidecar(
+        ReschedulerConfig(solver="numpy"), "127.0.0.1:0", max_body_bytes=1024
+    )
+    s.start_background()
+    try:
+        host, _, port = s.address.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.putrequest("POST", "/v1/plan", skip_accept_encoding=True)
+        conn.putheader("Content-Length", "-1")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        conn.close()
+    finally:
+        s.close()

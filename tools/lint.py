@@ -72,14 +72,11 @@ class _Lint(ast.NodeVisitor):
         self.used.add(node.id)
         self.generic_visit(node)
 
-    def visit_Attribute(self, node):
-        # "import a.b" is used via "a.b.c" — the Name visitor catches the
-        # base; dotted-module imports bind the first segment only
-        self.generic_visit(node)
-
     # --- checks ---
 
     def _collect_import(self, node, name: str) -> None:
+        # "import a.b" binds only "a"; usage via "a.b.c" is caught by the
+        # Name visitor on the attribute chain's base
         bound = name.split(".")[0]
         if not bound.startswith("_"):
             self.imports.append((node.lineno, bound))
@@ -164,11 +161,10 @@ class _Lint(ast.NodeVisitor):
                              f"'{bound}' imported but unused")
 
 
-def check_format(path: Path, raw: bytes):
+def check_format(path: Path, raw: bytes, text: str):
     findings = []
     if b"\r" in raw:
         findings.append((path, 1, "crlf", "carriage returns present"))
-    text = raw.decode("utf-8", errors="replace")
     for i, line in enumerate(text.splitlines(), 1):
         if line != line.rstrip():
             findings.append((path, i, "trailing-space",
@@ -186,8 +182,8 @@ def run(roots) -> int:
     findings = []
     for path in iter_py_files(roots):
         raw = path.read_bytes()
-        findings.extend(check_format(path, raw))
         source = raw.decode("utf-8", errors="replace")
+        findings.extend(check_format(path, raw, source))
         try:
             tree = ast.parse(source, filename=str(path))
         except SyntaxError as err:
