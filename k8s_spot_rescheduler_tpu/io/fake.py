@@ -208,7 +208,7 @@ class FakeCluster:
             return False
         if any(node.labels.get(k) != v for k, v in pod.node_selector.items()):
             return False
-        if not match_node_affinity(pod.node_affinity, node.labels):
+        if not match_node_affinity(pod.node_affinity, node.labels, node.name):
             return False
         hard = [t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")]
         if any(

@@ -78,10 +78,10 @@ def decode_pod(obj: dict) -> PodSpec:
         for t in spec.get("tolerations", []) or []
     ]
     # constraints beyond the modeled predicate set (required pod-affinity,
-    # matchFields node-affinity, PVC/volume topology) mark the pod
-    # conservatively unplaceable — its node can never be proven drainable,
-    # never stranded. Required node-affinity matchExpressions ARE modeled:
-    # they canonicalize into per-requirement pseudo-taint bits
+    # PVC/volume topology) mark the pod conservatively unplaceable — its
+    # node can never be proven drainable, never stranded. Required
+    # node-affinity matchExpressions AND metadata.name matchFields ARE
+    # modeled: they canonicalize into per-requirement pseudo-taint bits
     # (predicates/masks.NodeAffinityBit), replacing the reference's
     # delegation to the real scheduler's affinity predicate
     # (rescheduler.go:344; README.md:103-114).
@@ -138,14 +138,18 @@ def decode_node_affinity(node_aff: dict) -> tuple:
     """(canonical terms, unmodeled) for a nodeAffinity object.
 
     The modeled shape is requiredDuringSchedulingIgnoredDuringExecution
-    .nodeSelectorTerms where every term uses only matchExpressions with
-    the six NodeSelectorOperator values. Canonical form: terms and the
-    expressions within each term sorted, In/NotIn value lists
-    sorted+deduped — so equal requirements intern to one pseudo-taint
-    bit. Terms that match nothing (empty) are dropped (k8s: a nil/empty
-    term selects no objects); if every term drops, the requirement
-    matches no node — conservatively unmodeled (same unplaceable
-    effect). matchFields (node metadata, not labels) is unmodeled."""
+    .nodeSelectorTerms where every term uses matchExpressions with the
+    six NodeSelectorOperator values and/or matchFields on
+    ``metadata.name`` with In/NotIn (the only field selector k8s
+    defines; apiserver validation rejects everything else). Field
+    expressions canonicalize with reserved operators FieldIn/FieldNotIn
+    so a node LABEL literally named "metadata.name" can never collide
+    with the field. Canonical form: terms and the expressions within
+    each term sorted, In/NotIn value lists sorted+deduped — so equal
+    requirements intern to one pseudo-taint bit. Terms that match
+    nothing (empty) are dropped (k8s: a nil/empty term selects no
+    objects); if every term drops, the requirement matches no node —
+    conservatively unmodeled (same unplaceable effect)."""
     req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
     if not req:
         return (), False
@@ -158,10 +162,9 @@ def decode_node_affinity(node_aff: dict) -> tuple:
     for term in term_list:
         if not isinstance(term, dict):
             return (), True
-        if term.get("matchFields"):
-            return (), True
         exprs_in = term.get("matchExpressions") or []
-        if not isinstance(exprs_in, list):
+        fields_in = term.get("matchFields") or []
+        if not isinstance(exprs_in, list) or not isinstance(fields_in, list):
             return (), True
         exprs = []
         for e in exprs_in:
@@ -188,6 +191,22 @@ def decode_node_affinity(node_aff: dict) -> tuple:
                     return (), True
                 values = tuple(sorted(set(values)))
             exprs.append((key, op, values))
+        for e in fields_in:
+            if not isinstance(e, dict):
+                return (), True
+            key, op = e.get("key"), e.get("operator")
+            # metadata.name is the only node field selector k8s defines
+            if key != "metadata.name" or op not in ("In", "NotIn"):
+                return (), True
+            values = e.get("values") or []
+            if not isinstance(values, list) or not values or not all(
+                isinstance(v, str) and not _has_sep_bytes(v) for v in values
+            ):
+                return (), True
+            exprs.append(
+                (key, "FieldIn" if op == "In" else "FieldNotIn",
+                 tuple(sorted(set(values))))
+            )
         if exprs:
             terms.append(tuple(sorted(exprs)))
     if not terms:

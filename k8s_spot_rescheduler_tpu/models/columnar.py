@@ -281,6 +281,7 @@ class ColumnarStore:
         self._sel_keys: List[str] = []  # selector keys in the current table
         self._naff_section: tuple = (0, ())
         self._naff_keys: List[str] = []  # label keys affinity exprs read
+        self._naff_uses_name = False  # any FieldIn/FieldNotIn term active
         self._unplace_pos: int = 0
         self._real_tol_pos: Dict[tuple, tuple] = {}
         self._sel_tol_pos: Dict[tuple, tuple] = {}
@@ -770,8 +771,23 @@ class ColumnarStore:
             self._naff_section = (naff_off, naffs)
             self._naff_tol_pos.clear()
             self._naff_node_pos.clear()
+            # label keys the affinity exprs read (Field* exprs read the
+            # node NAME, not labels — exclude them here and key the node
+            # mask cache by name instead, below)
             self._naff_keys = sorted(
-                {e[0] for terms in naffs for term in terms for e in term}
+                {
+                    e[0]
+                    for terms in naffs
+                    for term in terms
+                    for e in term
+                    if e[1] not in ("FieldIn", "FieldNotIn")
+                }
+            )
+            self._naff_uses_name = any(
+                e[1] in ("FieldIn", "FieldNotIn")
+                for terms in naffs
+                for term in terms
+                for e in term
             )
         self._unplace_pos = naff_off + len(naffs)
 
@@ -823,6 +839,10 @@ class ColumnarStore:
         taints = tuple(t for t in node.taints if t.effect in HARD_EFFECTS)
         labelvals = tuple(node.labels.get(k) for k in self._sel_keys)
         nlabelvals = tuple(node.labels.get(k) for k in self._naff_keys)
+        if self._naff_uses_name:
+            # matchFields terms read metadata.name: the label profile no
+            # longer determines the mask — key per node name too
+            nlabelvals = (node.name, *nlabelvals)
         cache_key = (taints, labelvals, nlabelvals)
         cached = self._node_mask_cache.get(cache_key)
         if cached is None:
@@ -843,14 +863,20 @@ class ColumnarStore:
             npos = self._naff_node_pos.get(nlabelvals)
             if npos is None:
                 naff_off, naffs = self._naff_section
-                # affinity exprs read only _naff_keys, so this dict is a
-                # complete stand-in for the node's labels here
-                labels = dict(zip(self._naff_keys, nlabelvals))
+                # affinity label exprs read only _naff_keys and Field*
+                # exprs read the name (nlabelvals[0] when present), so
+                # this pair is a complete stand-in for the node here
+                if self._naff_uses_name:
+                    name, labelvals_only = nlabelvals[0], nlabelvals[1:]
+                else:
+                    name, labelvals_only = "", nlabelvals
+                labels = dict(zip(self._naff_keys, labelvals_only))
                 npos = self._naff_node_pos[nlabelvals] = tuple(
                     naff_off + j for j, terms in enumerate(naffs)
                     if not match_node_affinity(
                         terms,
                         {k: v for k, v in labels.items() if v is not None},
+                        name,
                     )
                 )
             cached = self._node_mask_cache[cache_key] = self._mk_mask(

@@ -611,18 +611,66 @@ void extract_node_affinity(const Val* naff, bool* unmodeled,
       *unmodeled = true;
       return;
     }
-    if (py_truthy(term->get("matchFields"))) {
-      *unmodeled = true;  // node metadata fields are not modeled
-      return;
-    }
     const Val* exprs = term->get("matchExpressions");
-    if (!py_truthy(exprs)) continue;  // empty term matches nothing: drop
-    if (exprs->kind != Val::Arr) {
+    const Val* fields = term->get("matchFields");
+    bool have_exprs = py_truthy(exprs);
+    bool have_fields = py_truthy(fields);
+    if (!have_exprs && !have_fields) continue;  // empty term: drop
+    if ((have_exprs && exprs->kind != Val::Arr) ||
+        (have_fields && fields->kind != Val::Arr)) {
       *unmodeled = true;
       return;
     }
     std::string term_out;
     bool first_expr = true;
+    if (have_fields) {
+      // matchFields: metadata.name In/NotIn only (the one field selector
+      // k8s defines). Emitted with the reserved FieldIn/FieldNotIn ops —
+      // exact lockstep with io/kube.py decode_node_affinity.
+      for (const Val* e : fields->arr) {
+        if (!e || e->kind != Val::Obj) {
+          *unmodeled = true;
+          return;
+        }
+        const Val* key = e->get("key");
+        const Val* op = e->get("operator");
+        if (!key || key->kind != Val::Str || key->text != "metadata.name" ||
+            !op || op->kind != Val::Str ||
+            (op->text != "In" && op->text != "NotIn")) {
+          *unmodeled = true;
+          return;
+        }
+        const Val* values = e->get("values");
+        if (!values || values->kind != Val::Arr || values->arr.empty()) {
+          *unmodeled = true;
+          return;
+        }
+        for (const Val* v : values->arr) {
+          if (!v || v->kind != Val::Str || has_sep_bytes(v->text)) {
+            *unmodeled = true;
+            return;
+          }
+        }
+        if (!first_expr) term_out += REC_SEP;
+        first_expr = false;
+        term_out += "metadata.name";
+        term_out += UNIT_SEP;
+        term_out += (op->text == "In") ? "FieldIn" : "FieldNotIn";
+        term_out += UNIT_SEP;
+        for (size_t vi = 0; vi < values->arr.size(); ++vi) {
+          if (vi) term_out += VAL_SEP;
+          const auto& t = values->arr[vi]->text;
+          term_out.append(t.data(), t.size());
+        }
+      }
+    }
+    if (!have_exprs) {
+      if (term_out.empty()) continue;
+      if (any_term) out += TERM_SEP;
+      any_term = true;
+      out += term_out;
+      continue;
+    }
     for (const Val* e : exprs->arr) {
       if (!e || e->kind != Val::Obj) {
         *unmodeled = true;

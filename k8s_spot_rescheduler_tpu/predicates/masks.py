@@ -152,11 +152,19 @@ def node_affinity_universe(pods: Sequence[PodSpec]) -> List[Tuple]:
     return sorted({p.node_affinity for p in pods if p.node_affinity})
 
 
-def match_expr(expr: Tuple, labels) -> bool:
+def match_expr(expr: Tuple, labels, node_name: str) -> bool:
     """One NodeSelectorRequirement against a node's labels — semantics of
     k8s.io/apimachinery labels.Requirement.Matches (NotIn/DoesNotExist
-    match when the key is absent; Gt/Lt are base-10 integer compares)."""
+    match when the key is absent; Gt/Lt are base-10 integer compares).
+    The reserved FieldIn/FieldNotIn operators are matchFields on
+    ``metadata.name`` (io/kube.decode_node_affinity) and compare
+    ``node_name``, never labels — a label literally named
+    "metadata.name" cannot shadow the field."""
     key, op, values = expr
+    if op == "FieldIn":
+        return node_name in values
+    if op == "FieldNotIn":
+        return node_name not in values
     v = labels.get(key)
     if op == "In":
         return v is not None and v in values
@@ -180,13 +188,15 @@ def match_expr(expr: Tuple, labels) -> bool:
     return False
 
 
-def match_node_affinity(terms: Tuple, labels) -> bool:
+def match_node_affinity(terms: Tuple, labels, node_name: str) -> bool:
     """Required node-affinity: OR over terms, AND within a term (empty
     terms tuple = no constraint; decode drops empty terms, which k8s
     defines to match nothing)."""
     if not terms:
         return True
-    return any(all(match_expr(e, labels) for e in term) for term in terms)
+    return any(
+        all(match_expr(e, labels, node_name) for e in term) for term in terms
+    )
 
 
 def intern_constraints(
@@ -218,7 +228,7 @@ def node_constraint_mask(node: NodeSpec, table: TaintTable) -> np.ndarray:
             if node.labels.get(entry.key) != entry.value:
                 mask[i // 32] |= np.uint32(1 << (i % 32))
         elif isinstance(entry, NodeAffinityBit):
-            if not match_node_affinity(entry.terms, node.labels):
+            if not match_node_affinity(entry.terms, node.labels, node.name):
                 mask[i // 32] |= np.uint32(1 << (i % 32))
         else:  # UnplaceableBit
             mask[i // 32] |= np.uint32(1 << (i % 32))

@@ -228,6 +228,17 @@ def test_node_affinity_modeled_shapes():
         _affinity_pod("dropped", _naff([
             {}, {"matchExpressions": [
                 {"key": "k", "operator": "In", "values": ["v"]}]}])),
+        # matchFields on metadata.name: modeled as FieldIn/FieldNotIn
+        _affinity_pod("mf", _naff([{"matchFields": [
+            {"key": "metadata.name", "operator": "In",
+             "values": ["n2", "n1", "n2"]}]}])),
+        # mixed matchExpressions + matchFields in one term (AND)
+        _affinity_pod("mixed", _naff([{
+            "matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["a"]}],
+            "matchFields": [
+                {"key": "metadata.name", "operator": "NotIn",
+                 "values": ["n9"]}]}])),
         # preferred-only affinity: no requirement at all
         _affinity_pod("pref", {"nodeAffinity": {
             "preferredDuringSchedulingIgnoredDuringExecution": [
@@ -241,9 +252,15 @@ def test_node_affinity_modeled_shapes():
 
 def test_node_affinity_unmodeled_shapes():
     objs = [
-        # matchFields reads node metadata, not labels
-        _affinity_pod("mf", _naff([{"matchFields": [
-            {"key": "metadata.name", "operator": "In", "values": ["n1"]}]}])),
+        # matchFields on any other key is not a field k8s defines
+        _affinity_pod("mfuid", _naff([{"matchFields": [
+            {"key": "metadata.uid", "operator": "In", "values": ["x"]}]}])),
+        # matchFields with a non-membership operator
+        _affinity_pod("mfex", _naff([{"matchFields": [
+            {"key": "metadata.name", "operator": "Exists"}]}])),
+        # matchFields with no values
+        _affinity_pod("mf0", _naff([{"matchFields": [
+            {"key": "metadata.name", "operator": "In", "values": []}]}])),
         # Gt needs exactly one value
         _affinity_pod("gt2", _naff([{"matchExpressions": [
             {"key": "n", "operator": "Gt", "values": ["1", "2"]}]}])),

@@ -40,30 +40,30 @@ from tests.fixtures import (
 # --- matcher semantics (k8s labels.Requirement.Matches) -------------------
 
 def test_match_expr_in():
-    assert match_expr(("z", "In", ("a", "b")), {"z": "a"})
-    assert not match_expr(("z", "In", ("a", "b")), {"z": "c"})
-    assert not match_expr(("z", "In", ("a", "b")), {})  # missing key
+    assert match_expr(("z", "In", ("a", "b")), {"z": "a"}, "")
+    assert not match_expr(("z", "In", ("a", "b")), {"z": "c"}, "")
+    assert not match_expr(("z", "In", ("a", "b")), {}, "")  # missing key
 
 
 def test_match_expr_not_in_matches_missing_key():
-    assert match_expr(("z", "NotIn", ("a",)), {"z": "b"})
-    assert not match_expr(("z", "NotIn", ("a",)), {"z": "a"})
-    assert match_expr(("z", "NotIn", ("a",)), {})  # k8s: absent key matches
+    assert match_expr(("z", "NotIn", ("a",)), {"z": "b"}, "")
+    assert not match_expr(("z", "NotIn", ("a",)), {"z": "a"}, "")
+    assert match_expr(("z", "NotIn", ("a",)), {}, "")  # k8s: absent key matches
 
 
 def test_match_expr_exists_and_absent():
-    assert match_expr(("z", "Exists", ()), {"z": ""})
-    assert not match_expr(("z", "Exists", ()), {})
-    assert match_expr(("z", "DoesNotExist", ()), {})
-    assert not match_expr(("z", "DoesNotExist", ()), {"z": "x"})
+    assert match_expr(("z", "Exists", ()), {"z": ""}, "")
+    assert not match_expr(("z", "Exists", ()), {}, "")
+    assert match_expr(("z", "DoesNotExist", ()), {}, "")
+    assert not match_expr(("z", "DoesNotExist", ()), {"z": "x"}, "")
 
 
 def test_match_expr_gt_lt_integer_base10():
-    assert match_expr(("n", "Gt", ("5",)), {"n": "6"})
-    assert not match_expr(("n", "Gt", ("5",)), {"n": "5"})
-    assert match_expr(("n", "Lt", ("5",)), {"n": "4"})
-    assert not match_expr(("n", "Lt", ("5",)), {})  # missing key
-    assert not match_expr(("n", "Gt", ("5",)), {"n": "abc"})  # unparseable
+    assert match_expr(("n", "Gt", ("5",)), {"n": "6"}, "")
+    assert not match_expr(("n", "Gt", ("5",)), {"n": "5"}, "")
+    assert match_expr(("n", "Lt", ("5",)), {"n": "4"}, "")
+    assert not match_expr(("n", "Lt", ("5",)), {}, "")  # missing key
+    assert not match_expr(("n", "Gt", ("5",)), {"n": "abc"}, "")  # unparseable
 
 
 def test_match_expr_gt_lt_strict_parse_like_strconv():
@@ -71,16 +71,16 @@ def test_match_expr_gt_lt_strict_parse_like_strconv():
     # underscores, whitespace, Unicode digits, and arbitrary precision —
     # deeming those satisfying would approve a drain whose pods then
     # fail to place (non-conservative).
-    assert not match_expr(("n", "Gt", ("5",)), {"n": "1_0"})
-    assert not match_expr(("n", "Gt", ("5",)), {"n": " 10"})
-    assert not match_expr(("n", "Gt", ("1_0",)), {"n": "20"})
-    assert not match_expr(("n", "Gt", ("5",)), {"n": "١٠"})
+    assert not match_expr(("n", "Gt", ("5",)), {"n": "1_0"}, "")
+    assert not match_expr(("n", "Gt", ("5",)), {"n": " 10"}, "")
+    assert not match_expr(("n", "Gt", ("1_0",)), {"n": "20"}, "")
+    assert not match_expr(("n", "Gt", ("5",)), {"n": "١٠"}, "")
     # int64 overflow: ParseInt returns ErrRange -> expr does not match
-    assert not match_expr(("n", "Gt", ("5",)), {"n": str(2**63)})
-    assert match_expr(("n", "Gt", ("5",)), {"n": str(2**63 - 1)})
+    assert not match_expr(("n", "Gt", ("5",)), {"n": str(2**63)}, "")
+    assert match_expr(("n", "Gt", ("5",)), {"n": str(2**63 - 1)}, "")
     # Go accepts a leading '+' or '-'
-    assert match_expr(("n", "Gt", ("5",)), {"n": "+10"})
-    assert match_expr(("n", "Gt", ("-5",)), {"n": "-4"})
+    assert match_expr(("n", "Gt", ("5",)), {"n": "+10"}, "")
+    assert match_expr(("n", "Gt", ("-5",)), {"n": "-4"}, "")
 
 
 def test_match_terms_or_of_ands():
@@ -88,10 +88,10 @@ def test_match_terms_or_of_ands():
         (("a", "In", ("1",)), ("b", "Exists", ())),  # a=1 AND b present
         (("c", "In", ("9",)),),  # OR c=9
     )
-    assert match_node_affinity(terms, {"a": "1", "b": "x"})
-    assert match_node_affinity(terms, {"c": "9"})
-    assert not match_node_affinity(terms, {"a": "1"})  # b missing
-    assert match_node_affinity((), {"anything": "1"})  # no constraint
+    assert match_node_affinity(terms, {"a": "1", "b": "x"}, "")
+    assert match_node_affinity(terms, {"c": "9"}, "")
+    assert not match_node_affinity(terms, {"a": "1"}, "")  # b missing
+    assert match_node_affinity((), {"anything": "1"}, "")  # no constraint
 
 
 # --- decode canonicalization ---------------------------------------------
@@ -123,11 +123,56 @@ def test_decode_equal_requirements_intern_identically():
     assert a == b
 
 
+def test_decode_match_fields_modeled():
+    """metadata.name matchFields (the one field selector k8s defines)
+    canonicalize with the reserved FieldIn/FieldNotIn operators."""
+    terms, unmodeled = decode_node_affinity(_aff([
+        {"matchFields": [
+            {"key": "metadata.name", "operator": "In",
+             "values": ["n2", "n1", "n2"]}]}
+    ]))
+    assert not unmodeled
+    assert terms == ((("metadata.name", "FieldIn", ("n1", "n2")),),)
+    # mixed matchExpressions + matchFields AND within the term
+    terms, unmodeled = decode_node_affinity(_aff([
+        {"matchExpressions": [
+            {"key": "zone", "operator": "In", "values": ["a"]}],
+         "matchFields": [
+            {"key": "metadata.name", "operator": "NotIn", "values": ["n9"]}]}
+    ]))
+    assert not unmodeled
+    assert terms == ((
+        ("metadata.name", "FieldNotIn", ("n9",)),
+        ("zone", "In", ("a",)),
+    ),)
+
+
+def test_match_fields_evaluation():
+    terms = ((("metadata.name", "FieldIn", ("n1", "n2")),),)
+    assert match_node_affinity(terms, {}, "n1")
+    assert not match_node_affinity(terms, {}, "n3")
+    # a label literally named metadata.name cannot shadow the field
+    assert not match_node_affinity(terms, {"metadata.name": "n1"}, "n3")
+    neg = ((("metadata.name", "FieldNotIn", ("n1",)),),)
+    assert not match_node_affinity(neg, {}, "n1")
+    assert match_node_affinity(neg, {}, "n2")
+
+
 def test_decode_unmodeled_shapes():
-    # matchFields reads node metadata, not labels
+    # matchFields on any key but metadata.name is not a thing k8s defines
     assert decode_node_affinity(_aff([
         {"matchFields": [
-            {"key": "metadata.name", "operator": "In", "values": ["n1"]}]}
+            {"key": "metadata.uid", "operator": "In", "values": ["x"]}]}
+    ]))[1]
+    # matchFields with a non-membership operator
+    assert decode_node_affinity(_aff([
+        {"matchFields": [
+            {"key": "metadata.name", "operator": "Exists"}]}
+    ]))[1]
+    # matchFields with no values
+    assert decode_node_affinity(_aff([
+        {"matchFields": [
+            {"key": "metadata.name", "operator": "In", "values": []}]}
     ]))[1]
     # unknown operator
     assert decode_node_affinity(_aff([
@@ -279,3 +324,90 @@ def test_loop_drains_affinity_pod_to_matching_node():
     assert [p.name for p in fc.list_pods_on_node("spot-zone-b")] == ["aff-pod"]
     assert fc.list_pods_on_node("spot-plain") == []
     assert fc.pending == []
+
+
+# --- matchFields (metadata.name) end to end -------------------------------
+
+PIN_PLAIN = ((("metadata.name", "FieldIn", ("spot-plain",)),),)
+AVOID_PLAIN = ((("metadata.name", "FieldNotIn", ("spot-plain",)),),)
+
+
+def test_match_fields_pins_placement_to_named_node():
+    fc = _cluster()
+    fc.add_pod(make_pod("pinned", 300, "od-1", node_affinity=PIN_PLAIN))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-plain"
+
+
+def test_match_fields_not_in_avoids_named_node():
+    fc = _cluster()
+    fc.add_pod(make_pod("averse", 300, "od-1", node_affinity=AVOID_PLAIN))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-zone-b"
+
+
+def test_match_fields_no_such_node_blocks_drain():
+    fc = _cluster()
+    fc.add_pod(make_pod("ghost", 100, "od-1",
+                        node_affinity=((("metadata.name", "FieldIn",
+                                         ("no-such-node",)),),)))
+    packed, _ = _pack(fc)
+    result = plan_oracle(packed)
+    assert not result.feasible[:1].any()
+
+
+def test_match_fields_columnar_parity():
+    """Two spot nodes share the SAME label profile but different names —
+    the columnar node-mask cache must key by name once a Field term is
+    in the universe."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a", SPOT_LABELS))
+    fc.add_node(make_node("spot-b", SPOT_LABELS))  # identical labels
+    fc.add_pod(make_pod("pin-b", 300, "od-1",
+                        node_affinity=((("metadata.name", "FieldIn",
+                                         ("spot-b",)),),)))
+    fc.add_pod(make_pod("plain", 100, "od-1"))
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, meta = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+    result = plan_oracle(obj)
+    assert bool(result.feasible[0])
+    pods = meta.cand_pods[0]
+    k = next(i for i, p in enumerate(pods) if p.name == "pin-b")
+    assert meta.spot[int(result.assignment[0, k])].node.name == "spot-b"
+
+
+def test_match_fields_drain_through_loop():
+    from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a", SPOT_LABELS))
+    fc.add_node(make_node("spot-b", SPOT_LABELS))
+    fc.add_pod(make_pod("pin-b", 300, "od-1",
+                        node_affinity=((("metadata.name", "FieldIn",
+                                         ("spot-b",)),),)))
+    cfg = ReschedulerConfig(solver="numpy", node_drain_delay=0.0)
+    r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=fc.clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    fc.clock.advance(10.0)
+    moved = fc.pods["default/pin-b"]
+    assert moved.node_name == "spot-b"
