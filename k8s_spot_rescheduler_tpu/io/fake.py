@@ -23,7 +23,10 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
     PodSpec,
     Taint,
 )
-from k8s_spot_rescheduler_tpu.predicates.masks import match_node_affinity
+from k8s_spot_rescheduler_tpu.predicates.masks import (
+    hosts_affinity_match,
+    match_node_affinity,
+)
 from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
@@ -237,6 +240,13 @@ class FakeCluster:
             )
 
         if any(_repels(pod, p) or _repels(p, pod) for p in here):
+            return False
+        # required positive pod-affinity: the node must already host a
+        # match (hostname topology, own namespace) — the same predicate
+        # the packers' PodAffinityBit node side evaluates
+        if pod.pod_affinity_match and not hosts_affinity_match(
+            here, pod.namespace, tuple(pod.pod_affinity_match.items())
+        ):
             return False
         return pod.requests.get(CPU, 0) <= free_cpu and (
             pod.requests.get(MEMORY, 0) <= free_mem

@@ -77,27 +77,27 @@ def decode_pod(obj: dict) -> PodSpec:
         )
         for t in spec.get("tolerations", []) or []
     ]
-    # constraints beyond the modeled predicate set (required pod-affinity,
-    # PVC/volume topology) mark the pod conservatively unplaceable — its
-    # node can never be proven drainable, never stranded. Required
-    # node-affinity matchExpressions AND metadata.name matchFields ARE
-    # modeled: they canonicalize into per-requirement pseudo-taint bits
-    # (predicates/masks.NodeAffinityBit), replacing the reference's
-    # delegation to the real scheduler's affinity predicate
-    # (rescheduler.go:344; README.md:103-114).
+    # constraints beyond the modeled predicate set (PVC/volume topology,
+    # affinity shapes outside the canonical forms below) mark the pod
+    # conservatively unplaceable — its node can never be proven
+    # drainable, never stranded. Modeled, interned as pseudo-taint bits
+    # replacing the reference's delegation to the real scheduler
+    # (rescheduler.go:344; README.md:103-114): required node-affinity
+    # matchExpressions and metadata.name matchFields
+    # (masks.NodeAffinityBit), hostname anti-affinity (selector groups),
+    # and required positive hostname pod-affinity (masks.PodAffinityBit:
+    # only nodes already hosting a match admit the pod).
     affinity = spec.get("affinity") or {}
-    required_affinity = bool(
-        (affinity.get("podAffinity") or {}).get(
-            "requiredDuringSchedulingIgnoredDuringExecution"
-        )
-    )
     node_affinity, naff_unmodeled = decode_node_affinity(
         affinity.get("nodeAffinity") or {}
     )
     anti_affinity_match, anti_unmodeled = decode_anti_affinity(
         affinity.get("podAntiAffinity") or {}
     )
-    required_affinity = required_affinity or naff_unmodeled or anti_unmodeled
+    pod_affinity_match, paff_unmodeled = decode_pod_affinity(
+        affinity.get("podAffinity") or {}
+    )
+    required_affinity = naff_unmodeled or anti_unmodeled or paff_unmodeled
     has_pvc = any(
         "persistentVolumeClaim" in (vol or {})
         for vol in spec.get("volumes", []) or []
@@ -115,6 +115,7 @@ def decode_pod(obj: dict) -> PodSpec:
         phase=obj.get("status", {}).get("phase", "Running"),
         node_selector=spec.get("nodeSelector", {}) or {},
         anti_affinity_match=anti_affinity_match,
+        pod_affinity_match=pod_affinity_match,
         node_affinity=node_affinity,
         unmodeled_constraints=bool(required_affinity or has_pvc),
     )
@@ -214,15 +215,15 @@ def decode_node_affinity(node_aff: dict) -> tuple:
     return tuple(sorted(set(terms))), False
 
 
-def decode_anti_affinity(anti: dict) -> tuple:
-    """(matchLabels, unmodeled) for a podAntiAffinity object.
+def _decode_affinity_block(block: dict) -> tuple:
+    """(matchLabels, unmodeled) for a podAffinity/podAntiAffinity object.
 
     The modeled shape — kept in exact lockstep with the native engine's
-    ``extract_anti_affinity`` (native/ingest.cc) — is ONE required term
+    ``extract_affinity_term`` (native/ingest.cc) — is ONE required term
     with topologyKey=kubernetes.io/hostname and a non-empty
     matchLabels-only selector in the pod's own namespace. Anything else
     required is unmodeled (conservatively unplaceable)."""
-    req = anti.get("requiredDuringSchedulingIgnoredDuringExecution")
+    req = block.get("requiredDuringSchedulingIgnoredDuringExecution")
     if not req:
         return {}, False
     if not isinstance(req, list) or len(req) != 1:
@@ -248,6 +249,19 @@ def decode_anti_affinity(anti: dict) -> tuple:
     if not isinstance(match, dict) or not match:
         return {}, True
     return dict(match), False
+
+
+def decode_anti_affinity(anti: dict) -> tuple:
+    """(matchLabels, unmodeled) for a podAntiAffinity object."""
+    return _decode_affinity_block(anti)
+
+
+def decode_pod_affinity(paff: dict) -> tuple:
+    """(matchLabels, unmodeled) for a required POSITIVE podAffinity
+    object — same canonical shape as anti-affinity; the planner admits
+    the pod only on nodes already hosting a match
+    (predicates/masks.PodAffinityBit)."""
+    return _decode_affinity_block(paff)
 
 
 def decode_node(obj: dict) -> NodeSpec:

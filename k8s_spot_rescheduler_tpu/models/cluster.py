@@ -86,6 +86,16 @@ class PodSpec:
     # beyond this (matchExpressions, other topology keys, multiple terms)
     # fall back to ``unmodeled_constraints``.
     anti_affinity_match: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Required POSITIVE pod-affinity, modeled in the same canonical shape
+    # (one required term, topologyKey=hostname, matchLabels selector,
+    # own namespace): the pod may only schedule onto a node already
+    # hosting a pod matched by this selector. The planner is conservative
+    # about the dynamics: only pods RESIDENT on a spot node before the
+    # plan count as matches (placements made by the plan itself could
+    # only create additional matches, so ignoring them can only lose a
+    # drain, never strand a pod). Shapes beyond this fall back to
+    # ``unmodeled_constraints``.
+    pod_affinity_match: Dict[str, str] = dataclasses.field(default_factory=dict)
     phase: str = "Running"
     # spec.nodeSelector: the pod only schedules onto nodes carrying every
     # one of these labels (the kube-scheduler's NodeSelector predicate,

@@ -60,6 +60,7 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     AFFINITY_WORDS,
     HARD_EFFECTS,
     NodeAffinityBit,
+    PodAffinityBit,
     SelectorBit,
     Taint,
     TaintTable,
@@ -147,7 +148,7 @@ class ColumnarMeta:
             mask = np.zeros(0, bool)
         else:
             unmod_by_tid = np.fromiter(
-                (u for (_, _, _, u) in store._tol_lists),
+                (prof[-1] for prof in store._tol_lists),
                 bool,
                 count=len(store._tol_lists),
             )
@@ -282,10 +283,16 @@ class ColumnarStore:
         self._naff_section: tuple = (0, ())
         self._naff_keys: List[str] = []  # label keys affinity exprs read
         self._naff_uses_name = False  # any FieldIn/FieldNotIn term active
+        self._paff_section: tuple = (0, ())  # positive pod-affinity bits
         self._unplace_pos: int = 0
         self._real_tol_pos: Dict[tuple, tuple] = {}
         self._sel_tol_pos: Dict[tuple, tuple] = {}
         self._naff_tol_pos: Dict[tuple, tuple] = {}
+        self._paff_tol_pos: Dict[tuple, tuple] = {}
+        # per-tick positive-affinity match matrix cache (see
+        # _pod_affinity_node_bits)
+        self._paff_match_key: Optional[tuple] = None
+        self._paff_match_matrix = np.zeros((0, 0), bool)
         self._real_node_pos: Dict[tuple, tuple] = {}
         self._sel_node_pos: Dict[tuple, tuple] = {}
         self._naff_node_pos: Dict[tuple, tuple] = {}
@@ -481,11 +488,17 @@ class ColumnarStore:
                 flags |= _DAEMONSET
         self.p_flags[r] = flags
         # one interned id per distinct scheduling-constraint profile:
-        # (tolerations, nodeSelector, node-affinity, unmodeled flag)
+        # (tolerations, nodeSelector, node-affinity, pod-affinity,
+        # unmodeled flag)
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
             pod.node_affinity,
+            (
+                (pod.namespace, tuple(sorted(pod.pod_affinity_match.items())))
+                if pod.pod_affinity_match
+                else ()
+            ),
             bool(pod.unmodeled_constraints),
         )
         tid = self._tol_keys.get(key)
@@ -603,24 +616,45 @@ class ColumnarStore:
             | ((f & ni.F_REPLICATED) << 1)
         )
         # constraint-profile interning: one lookup per distinct
-        # (toleration set, nodeSelector set, node-affinity, unmodeled)
+        # (toleration set, nodeSelector set, node-affinity, pod-affinity,
+        # unmodeled). The pod-affinity identity is namespace-scoped, so
+        # the namespace joins the combo only when the selector is
+        # non-empty (keeping plain pods to one profile per shape).
         unmod = (f & (ni.F_PVC | ni.F_REQAFF)) != 0
+        paff_ids = batch.i32[keep, ni.P_PAFFID]
+        paff_nonempty = np.fromiter(
+            (len(s) > 0 for s in batch.paff_sets),
+            bool,
+            count=len(batch.paff_sets),
+        )[paff_ids]
+        ns_eff = np.where(
+            paff_nonempty, batch.i32[keep, ni.P_NSID], np.int32(-1)
+        )
         combos = np.stack(
             [
                 batch.i32[keep, ni.P_TOLID],
                 batch.i32[keep, ni.P_SELID],
                 batch.i32[keep, ni.P_NAFFID],
+                paff_ids,
+                ns_eff,
                 unmod.astype(np.int32),
             ],
             axis=1,
         )
         uniq, inverse = np.unique(combos, axis=0, return_inverse=True)
         ids = np.empty(len(uniq), np.int32)
-        for i, (tol_id, sel_id, naff_id, um) in enumerate(uniq):
+        for i, (tol_id, sel_id, naff_id, paff_id, ns_id, um) in enumerate(uniq):
+            paff_set = batch.paff_set(int(paff_id))
             key = (
                 tuple(batch.tol_sets[tol_id]),
                 tuple(sorted(batch.selector_set(int(sel_id)).items())),
                 batch.naff_sets[int(naff_id)],
+                (
+                    (batch.namespaces[int(ns_id)],
+                     tuple(sorted(paff_set.items())))
+                    if paff_set
+                    else ()
+                ),
                 bool(um),
             )
             tid = self._tol_keys.get(key)
@@ -736,16 +770,20 @@ class ColumnarStore:
         and the concatenated ``cand_pods``)."""
         pairs = set()
         naffs = set()
+        paffs = set()
         if len(slot_rows):
             for cid in np.unique(self.p_tol_id[slot_rows]):
                 profile = self._tol_lists[int(cid)]
                 pairs.update(profile[1])
                 if profile[2]:
                     naffs.add(profile[2])
+                if profile[3]:
+                    paffs.add(profile[3])
         return intern_constraints(
             [self.node_objs[int(r)] for r in spot_order],
             sorted(pairs),
             sorted(naffs),
+            sorted(paffs),
         )
 
     def _refresh_sections(self, table: TaintTable) -> None:
@@ -789,7 +827,17 @@ class ColumnarStore:
                 for term in terms
                 for e in term
             )
-        self._unplace_pos = naff_off + len(naffs)
+        paffs = tuple(
+            (e.namespace, e.items)
+            for e in table.taints
+            if isinstance(e, PodAffinityBit)
+        )
+        paff_off = naff_off + len(naffs)
+        if self._paff_section != (paff_off, paffs):
+            self._paff_section = (paff_off, paffs)
+            self._paff_tol_pos.clear()
+            self._paff_match_key = None
+        self._unplace_pos = paff_off + len(paffs)
 
     @staticmethod
     def _mk_mask(positions, words: int) -> np.ndarray:
@@ -808,7 +856,10 @@ class ColumnarStore:
             rows = np.zeros((len(self._tol_lists), W), np.uint32)
             off, pairs = self._sel_section
             naff_off, naffs = self._naff_section
-            for i, (tols, sel, naff, unmodeled) in enumerate(self._tol_lists):
+            paff_off, paffs = self._paff_section
+            for i, (tols, sel, naff, paff, unmodeled) in enumerate(
+                self._tol_lists
+            ):
                 pos = self._real_tol_pos.get(tols)
                 if pos is None:
                     pos = self._real_tol_pos[tols] = tuple(
@@ -829,10 +880,55 @@ class ColumnarStore:
                         naff_off + j for j, t in enumerate(naffs)
                         if t != naff
                     )
+                ppos = self._paff_tol_pos.get(paff)
+                if ppos is None:
+                    ppos = self._paff_tol_pos[paff] = tuple(
+                        paff_off + j for j, t in enumerate(paffs)
+                        if t != paff
+                    )
                 unplace = () if unmodeled else (self._unplace_pos,)
-                rows[i] = self._mk_mask(pos + spos + npos + unplace, W)
+                rows[i] = self._mk_mask(
+                    pos + spos + npos + ppos + unplace, W
+                )
             self._tol_matrix = rows
         return self._tol_matrix
+
+
+    def _pod_affinity_node_bits(
+        self, sp_rows: np.ndarray, sp: np.ndarray, S_actual: int, W: int
+    ) -> Optional[np.ndarray]:
+        """Per-spot-node PodAffinityBit words for this tick: bit j set on
+        nodes hosting NO counted resident matched by universe selector j
+        (masks.hosts_affinity_match, vectorized). The node side depends
+        on resident pods, so it lives outside the label-keyed node-mask
+        cache; the per-aff-profile match matrix is cached until either
+        the selector universe or the profile list changes."""
+        paff_off, paffs = self._paff_section
+        if not paffs:
+            return None
+        key = (self._paff_section, len(self._aff_lists))
+        if self._paff_match_key != key:
+            self._paff_match_key = key
+            m = np.zeros((len(self._aff_lists), len(paffs)), bool)
+            for i, (_, ns, _, labels) in enumerate(self._aff_lists):
+                have = dict(labels)
+                for j, (pns, items) in enumerate(paffs):
+                    m[i, j] = ns == pns and all(
+                        have.get(k) == v for k, v in items
+                    )
+            self._paff_match_matrix = m
+        hosted = np.zeros((S_actual, len(paffs)), bool)
+        if len(sp_rows):
+            np.logical_or.at(
+                hosted, sp, self._paff_match_matrix[self.p_aff_id[sp_rows]]
+            )
+        bits = np.zeros((S_actual, W), np.uint32)
+        for j in range(len(paffs)):
+            pos = paff_off + j
+            bits[:, pos // 32] |= np.where(
+                hosted[:, j], np.uint32(0), np.uint32(1 << (pos % 32))
+            )
+        return bits
 
     def _node_taint_mask(self, row: int, table: TaintTable) -> np.ndarray:
         node = self.node_objs[row]
@@ -1175,6 +1271,9 @@ class ColumnarStore:
             packed.spot_ok[:S_actual] = ~self.n_unsched[spot_order]
             for i, r in enumerate(spot_order):
                 packed.spot_taints[i] = self._node_taint_mask(int(r), table)
+            paff_bits = self._pod_affinity_node_bits(sp_rows, sp, S_actual, W)
+            if paff_bits is not None:
+                packed.spot_taints[:S_actual] |= paff_bits
             aff = np.zeros((S_actual, AFFINITY_WORDS), np.uint32)
             np.bitwise_or.at(aff, sp, aff_matrix[self.p_aff_id[sp_rows]])
             packed.spot_aff[:S_actual] = aff

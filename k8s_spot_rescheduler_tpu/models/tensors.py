@@ -52,7 +52,9 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     match_affinity_mask,
     node_affinity_universe,
     node_constraint_mask,
+    pod_affinity_key,
     pod_affinity_mask,
+    pod_affinity_universe,
     selector_universe,
 )
 
@@ -210,6 +212,7 @@ def pack_cluster(
         [n.node for n in spot],
         selector_universe(slot_pods_flat),
         node_affinity_universe(slot_pods_flat),
+        pod_affinity_universe(slot_pods_flat),
     )
     # anti-affinity selector universe spans every counted pod (resident
     # spot pods repel incoming matches and vice versa)
@@ -270,10 +273,12 @@ def pack_cluster(
         return out
 
     def tol_row(pod: PodSpec):
+        paff = pod_affinity_key(pod)
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
             pod.node_affinity,
+            paff,
             pod.unmodeled_constraints,
         )
         row = tol_cache.get(key)
@@ -282,6 +287,7 @@ def pack_cluster(
                 pod.tolerations, pod.node_selector,
                 pod.unmodeled_constraints, table,
                 node_affinity=pod.node_affinity,
+                pod_affinity=paff,
             )
         return row
 
@@ -321,7 +327,9 @@ def pack_cluster(
         packed.spot_max_pods[s] = int(
             info.node.allocatable.get("pods", DEFAULT_MAX_PODS)
         )
-        packed.spot_taints[s] = node_constraint_mask(info.node, table)
+        packed.spot_taints[s] = node_constraint_mask(
+            info.node, table, residents=info.pods
+        )
         packed.spot_ok[s] = info.node.ready and not info.node.unschedulable
         aff = np.zeros(AFFINITY_WORDS, np.uint32)
         for pod in info.pods:

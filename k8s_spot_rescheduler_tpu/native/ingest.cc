@@ -417,6 +417,7 @@ enum {
   TBL_NODESEL,
   TBL_AAFF,
   TBL_NAFF,  // required node-affinity blobs (see extract_node_affinity)
+  TBL_PAFF,  // required POSITIVE pod-affinity matchLabels blobs
   TBL_COUNT,
 };
 
@@ -462,6 +463,7 @@ enum {
   P_SELID,
   P_AAFFID,
   P_NAFFID,
+  P_PAFFID,
   P_NI32,
 };
 enum { P_FLAGS = 0, P_NU8 };
@@ -494,23 +496,14 @@ bool py_truthy(const Val* v) {
   return false;
 }
 
-// The modeled anti-affinity shape (mirrors io/kube.py decode_pod): ONE
-// required podAntiAffinity term with topologyKey=kubernetes.io/hostname
-// and a matchLabels-only labelSelector. Returns the matchLabels object
-// and leaves *unmodeled false; anything else required sets *unmodeled.
-const Val* extract_anti_affinity(const Val* affinity, bool* unmodeled) {
-  if (!affinity || affinity->kind != Val::Obj) return nullptr;
-  // Required podAffinity is unmodeled; required nodeAffinity is handled
-  // by extract_node_affinity (modeled matchExpressions intern into
-  // NodeAffinityBit pseudo-taints on the Python side).
-  if (const Val* b = affinity->get("podAffinity")) {
-    if (b->kind == Val::Obj &&
-        py_truthy(b->get("requiredDuringSchedulingIgnoredDuringExecution")))
-      *unmodeled = true;
-  }
-  const Val* anti = affinity->get("podAntiAffinity");
-  if (!anti || anti->kind != Val::Obj) return nullptr;
-  const Val* req = anti->get("requiredDuringSchedulingIgnoredDuringExecution");
+// The modeled affinity-term shape (mirrors io/kube.py
+// _decode_affinity_block, shared by podAffinity AND podAntiAffinity):
+// ONE required term with topologyKey=kubernetes.io/hostname and a
+// matchLabels-only labelSelector. Returns the matchLabels object and
+// leaves *unmodeled false; anything else required sets *unmodeled.
+const Val* extract_affinity_term(const Val* block, bool* unmodeled) {
+  if (!block || block->kind != Val::Obj) return nullptr;
+  const Val* req = block->get("requiredDuringSchedulingIgnoredDuringExecution");
   if (!req) return nullptr;
   if (req->kind != Val::Arr) {
     // Python lockstep: a truthy non-list is unmodeled, a falsy value
@@ -665,7 +658,9 @@ void extract_node_affinity(const Val* naff, bool* unmodeled,
       }
     }
     if (!have_exprs) {
-      if (term_out.empty()) continue;
+      // term_out is necessarily non-empty here: have_fields held (else
+      // the term was dropped above) and every field either appended a
+      // record or returned unmodeled
       if (any_term) out += TERM_SEP;
       any_term = true;
       out += term_out;
@@ -859,14 +854,19 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     if (phase == "Succeeded" || phase == "Failed") flags |= F_TERMINAL;
     if (phase == "Pending") flags |= F_PENDING;
     const Val* anti_affinity_labels = nullptr;
+    const Val* pod_affinity_labels = nullptr;
     std::string naff_blob;
     if (spec) {
       bool unmodeled = false;
       const Val* affinity = spec->get("affinity");
-      anti_affinity_labels = extract_anti_affinity(affinity, &unmodeled);
+      const Val* aff_obj =
+          (affinity && affinity->kind == Val::Obj) ? affinity : nullptr;
+      anti_affinity_labels = extract_affinity_term(
+          aff_obj ? aff_obj->get("podAntiAffinity") : nullptr, &unmodeled);
+      pod_affinity_labels = extract_affinity_term(
+          aff_obj ? aff_obj->get("podAffinity") : nullptr, &unmodeled);
       extract_node_affinity(
-          affinity && affinity->kind == Val::Obj ? affinity->get("nodeAffinity")
-                                                 : nullptr,
+          aff_obj ? aff_obj->get("nodeAffinity") : nullptr,
           &unmodeled, &naff_blob);
       if (unmodeled) flags |= F_REQAFF;
       if (const Val* vols = spec->get("volumes")) {
@@ -906,6 +906,9 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     blob_kv_into(&tmp, anti_affinity_labels);
     i32row(P_AAFFID) = b->intern_str(TBL_AAFF, tmp);
     i32row(P_NAFFID) = b->intern_str(TBL_NAFF, naff_blob);
+    tmp.clear();
+    blob_kv_into(&tmp, pod_affinity_labels);
+    i32row(P_PAFFID) = b->intern_str(TBL_PAFF, tmp);
 
     // tolerations: key\x1fvalue\x1foperator\x1feffect\x1e...
     tmp.clear();

@@ -135,6 +135,27 @@ class NodeAffinityBit:
 
 
 @dataclasses.dataclass(frozen=True)
+class PodAffinityBit:
+    """Pseudo-taint for one distinct required POSITIVE pod-affinity
+    selector (namespace-scoped hostname matchLabels — the canonical
+    shape io/kube.decode_pod_affinity models). Set on every spot node
+    that does NOT currently host a pod matched by the selector; only
+    pods carrying exactly this requirement fail to tolerate it — the
+    inverted-taint encoding of "may only join a node with a match".
+
+    Unlike every other pseudo-taint, the node side depends on the pods
+    RESIDENT on the node this tick, not on node properties — so it is
+    evaluated against the packers' per-tick resident view and excluded
+    from any label-keyed node-mask caches. Conservative dynamics: the
+    plan's own placements could only create additional matches, so
+    counting pre-plan residents only can lose a drain but never approve
+    a stranding one."""
+
+    namespace: str
+    items: Tuple  # sorted (key, value) pairs of the matchLabels selector
+
+
+@dataclasses.dataclass(frozen=True)
 class UnplaceableBit:
     """Pseudo-taint carried by every node; only pods with unmodeled
     constraints fail to tolerate it."""
@@ -150,6 +171,33 @@ def node_affinity_universe(pods: Sequence[PodSpec]) -> List[Tuple]:
     """Sorted distinct canonical required-node-affinity terms across the
     pods — the NodeAffinityBit universe both packers must share."""
     return sorted({p.node_affinity for p in pods if p.node_affinity})
+
+
+def pod_affinity_key(pod: PodSpec) -> Tuple:
+    """(namespace, sorted selector items) — the PodAffinityBit identity
+    for a pod's required positive affinity; () when it has none."""
+    if not pod.pod_affinity_match:
+        return ()
+    return (pod.namespace, tuple(sorted(pod.pod_affinity_match.items())))
+
+
+def pod_affinity_universe(pods: Sequence[PodSpec]) -> List[Tuple]:
+    """Sorted distinct (namespace, selector items) across the pods'
+    required positive affinities — the PodAffinityBit universe both
+    packers must share."""
+    return sorted({pod_affinity_key(p) for p in pods} - {()})
+
+
+def hosts_affinity_match(
+    residents: Sequence[PodSpec], namespace: str, items: Tuple
+) -> bool:
+    """Does any resident pod satisfy the (namespace, matchLabels)
+    selector? The node-side evaluation of PodAffinityBit."""
+    return any(
+        p.namespace == namespace
+        and all(p.labels.get(k) == v for k, v in items)
+        for p in residents
+    )
 
 
 def match_expr(expr: Tuple, labels, node_name: str) -> bool:
@@ -203,23 +251,29 @@ def intern_constraints(
     nodes: Sequence[NodeSpec],
     selector_pairs: Sequence[Tuple[str, str]],
     affinity_terms: Sequence[Tuple] = (),
+    pod_affinity_keys: Sequence[Tuple] = (),
 ) -> TaintTable:
     """``intern_taints`` plus the pseudo-taint tail: selector pairs (in
-    the given sorted order), node-affinity requirement bits, and the
-    always-present unplaceable bit."""
+    the given sorted order), node-affinity requirement bits, positive
+    pod-affinity bits, and the always-present unplaceable bit."""
     base = intern_taints(nodes)
     taints = list(base.taints)
     taints.extend(SelectorBit(k, v) for k, v in selector_pairs)
     taints.extend(NodeAffinityBit(t) for t in affinity_terms)
+    taints.extend(PodAffinityBit(ns, items) for ns, items in pod_affinity_keys)
     taints.append(UnplaceableBit())
     words = max(1, -(-len(taints) // 32))
     return TaintTable(taints=taints, words=words)
 
 
-def node_constraint_mask(node: NodeSpec, table: TaintTable) -> np.ndarray:
+def node_constraint_mask(
+    node: NodeSpec, table: TaintTable, residents: Sequence[PodSpec] = ()
+) -> np.ndarray:
     """Node-side bits: real hard taints + selector pairs the node lacks +
-    affinity requirements the node fails + the unplaceable bit (always
-    set)."""
+    affinity requirements the node fails + positive pod-affinity
+    selectors no resident matches + the unplaceable bit (always set).
+    ``residents`` is the node's model-visible pods this tick (only read
+    by PodAffinityBit entries)."""
     mask = np.zeros(table.words, dtype=np.uint32)
     for i, entry in enumerate(table.taints):
         if isinstance(entry, Taint):
@@ -229,6 +283,9 @@ def node_constraint_mask(node: NodeSpec, table: TaintTable) -> np.ndarray:
                 mask[i // 32] |= np.uint32(1 << (i % 32))
         elif isinstance(entry, NodeAffinityBit):
             if not match_node_affinity(entry.terms, node.labels, node.name):
+                mask[i // 32] |= np.uint32(1 << (i % 32))
+        elif isinstance(entry, PodAffinityBit):
+            if not hosts_affinity_match(residents, entry.namespace, entry.items):
                 mask[i // 32] |= np.uint32(1 << (i % 32))
         else:  # UnplaceableBit
             mask[i // 32] |= np.uint32(1 << (i % 32))
@@ -241,10 +298,13 @@ def constraint_mask(
     unmodeled: bool,
     table: TaintTable,
     node_affinity: Tuple = (),
+    pod_affinity: Tuple = (),
 ) -> np.ndarray:
     """Pod-side bits: tolerated real taints + selector pairs the pod does
     NOT require + affinity requirements that are not the pod's own + the
-    unplaceable bit unless the pod carries unmodeled constraints."""
+    unplaceable bit unless the pod carries unmodeled constraints.
+    ``pod_affinity`` is the pod's own PodAffinityBit identity
+    (``pod_affinity_key``), or ()."""
     mask = np.zeros(table.words, dtype=np.uint32)
     for i, entry in enumerate(table.taints):
         if isinstance(entry, Taint):
@@ -253,6 +313,8 @@ def constraint_mask(
             ok = node_selector.get(entry.key) != entry.value
         elif isinstance(entry, NodeAffinityBit):
             ok = entry.terms != node_affinity
+        elif isinstance(entry, PodAffinityBit):
+            ok = (entry.namespace, entry.items) != pod_affinity
         else:  # UnplaceableBit
             ok = not unmodeled
         if ok:

@@ -122,6 +122,9 @@ def _assert_pod_parity(objs):
         assert got.anti_affinity_match == want.anti_affinity_match, (
             f"pod {i} anti-affinity"
         )
+        assert got.pod_affinity_match == want.pod_affinity_match, (
+            f"pod {i} pod-affinity"
+        )
         assert got.node_affinity == want.node_affinity, f"pod {i} node-aff"
         assert got.unmodeled_constraints == want.unmodeled_constraints, (
             f"pod {i} unmodeled"
@@ -200,6 +203,44 @@ def _naff(terms):
     return {"nodeAffinity": {
         "requiredDuringSchedulingIgnoredDuringExecution": {
             "nodeSelectorTerms": terms}}}
+
+
+def test_pod_affinity_shapes():
+    objs = [
+        # the modeled positive-affinity shape
+        _affinity_pod("pa", {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "kubernetes.io/hostname",
+                 "labelSelector": {"matchLabels": {"app": "db"}}}]}}),
+        # zone topology -> unmodeled
+        _affinity_pod("paz", {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "topology.kubernetes.io/zone",
+                 "labelSelector": {"matchLabels": {"app": "db"}}}]}}),
+        # matchExpressions selector -> unmodeled
+        _affinity_pod("pae", {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "kubernetes.io/hostname",
+                 "labelSelector": {"matchExpressions": [
+                     {"key": "app", "operator": "In",
+                      "values": ["db"]}]}}]}}),
+        # preferred only -> unconstrained
+        _affinity_pod("pap", {"podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 1}]}}),
+        # positive AND anti affinity together, both modeled
+        _affinity_pod("both", {
+            "podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"app": "db"}}}]},
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"app": "web"}}}]},
+        }),
+    ]
+    _assert_pod_parity(objs)
 
 
 def test_node_affinity_modeled_shapes():

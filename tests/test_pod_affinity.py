@@ -1,0 +1,248 @@
+"""Required POSITIVE pod-affinity as inverted pseudo-taint bits.
+
+The reference gets inter-pod affinity free from the real scheduler's
+predicate (reference rescheduler.go:344; predicate list
+README.md:103-114); previously any required podAffinity collapsed to the
+conservative unplaceable bit, silently pinning such pods' nodes at
+zero drains. The modeled shape (one required term, hostname topology,
+matchLabels, own namespace — mirroring the anti-affinity canonical form)
+now interns as ``PodAffinityBit``: set on every spot node NOT currently
+hosting a match, untolerated only by the requiring pod. Conservative
+dynamics: only pre-plan residents count as matches.
+"""
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+
+# --- decode ----------------------------------------------------------------
+
+def _pod_obj(affinity):
+    return {
+        "metadata": {"name": "p", "namespace": "ns1"},
+        "spec": {"nodeName": "n1", "containers": [], "affinity": affinity},
+        "status": {"phase": "Running"},
+    }
+
+
+def _paff(term):
+    return {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": term}}
+
+
+def test_decode_modeled_pod_affinity():
+    pod = decode_pod(_pod_obj(_paff([{
+        "topologyKey": "kubernetes.io/hostname",
+        "labelSelector": {"matchLabels": {"app": "db"}},
+    }])))
+    assert pod.pod_affinity_match == {"app": "db"}
+    assert not pod.unmodeled_constraints
+
+
+def test_decode_unmodeled_pod_affinity_shapes():
+    for term in (
+        # zone topology
+        [{"topologyKey": "topology.kubernetes.io/zone",
+          "labelSelector": {"matchLabels": {"app": "db"}}}],
+        # matchExpressions selector
+        [{"topologyKey": "kubernetes.io/hostname",
+          "labelSelector": {"matchExpressions": [
+              {"key": "app", "operator": "In", "values": ["db"]}]}}],
+        # two terms
+        [{"topologyKey": "kubernetes.io/hostname",
+          "labelSelector": {"matchLabels": {"a": "1"}}},
+         {"topologyKey": "kubernetes.io/hostname",
+          "labelSelector": {"matchLabels": {"b": "2"}}}],
+        # cross-namespace
+        [{"topologyKey": "kubernetes.io/hostname",
+          "namespaces": ["other"],
+          "labelSelector": {"matchLabels": {"app": "db"}}}],
+    ):
+        pod = decode_pod(_pod_obj(_paff(term)))
+        assert pod.pod_affinity_match == {}
+        assert pod.unmodeled_constraints, term
+
+
+def test_decode_preferred_only_is_unconstrained():
+    pod = decode_pod(_pod_obj({"podAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [{"weight": 1}]}}))
+    assert pod.pod_affinity_match == {}
+    assert not pod.unmodeled_constraints
+
+
+# --- oracle / packer -------------------------------------------------------
+
+def _cluster(*, match_on="spot-with-db"):
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-plain", SPOT_LABELS))
+    fc.add_node(make_node("spot-with-db", SPOT_LABELS))
+    if match_on:
+        fc.add_pod(make_pod("db-0", 100, match_on, labels={"app": "db"}))
+    return fc
+
+
+def _pack(fc):
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    return pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+
+
+def test_affinity_pod_placed_only_where_match_resides():
+    fc = _cluster()
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_match={"app": "db"}))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-with-db"
+
+
+def test_affinity_pod_with_no_resident_match_blocks_drain():
+    fc = _cluster(match_on=None)
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_match={"app": "db"}))
+    packed, _ = _pack(fc)
+    result = plan_oracle(packed)
+    assert not result.feasible[:1].any()
+
+
+def test_match_on_candidate_node_does_not_count():
+    """Conservative dynamics: a match that itself must move (it lives on
+    the on-demand node) cannot anchor the affinity pod."""
+    fc = _cluster(match_on=None)
+    fc.add_pod(make_pod("db-0", 100, "od-1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_match={"app": "db"}))
+    packed, _ = _pack(fc)
+    result = plan_oracle(packed)
+    assert not result.feasible[:1].any()
+
+
+def test_namespace_scoping():
+    fc = _cluster()  # db-0 resides in namespace "default"
+    fc.add_pod(make_pod("web", 300, "od-1", namespace="other",
+                        pod_affinity_match={"app": "db"}))
+    packed, _ = _pack(fc)
+    result = plan_oracle(packed)
+    assert not result.feasible[:1].any()
+
+
+def test_plain_pods_unaffected_by_universe():
+    fc = _cluster()
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_match={"app": "db"}))
+    fc.add_pod(make_pod("plain", 200, "od-1"))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    pods = meta.cand_pods[0]
+    k = next(i for i, p in enumerate(pods) if p.name == "web")
+    assert meta.spot[int(result.assignment[0, k])].node.name == "spot-with-db"
+
+
+# --- columnar parity -------------------------------------------------------
+
+def _columnar_parity(fc):
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+    return store
+
+
+def test_columnar_parity_with_pod_affinity():
+    fc = _cluster()
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_match={"app": "db"}))
+    fc.add_pod(make_pod("plain", 100, "od-1"))
+    _columnar_parity(fc)
+
+
+def test_columnar_parity_tracks_match_arrival_and_departure():
+    """Presence bits must refresh per tick as matching residents come
+    and go — they live outside the label-keyed node-mask cache."""
+    fc = _cluster(match_on=None)
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_match={"app": "db"}))
+    store = _columnar_parity(fc)  # no match anywhere
+
+    fc.add_pod(make_pod("db-0", 100, "spot-plain", labels={"app": "db"}))
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+    assert bool(plan_oracle(col).feasible[0])
+
+    fc.evict_pod(fc.pods["default/db-0"], 0)
+    fc.clock.advance(5.0)
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+    assert not plan_oracle(col).feasible[:1].any()
+
+
+# --- end to end ------------------------------------------------------------
+
+def test_drain_places_affinity_pod_with_its_match():
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a", SPOT_LABELS))
+    fc.add_node(make_node("spot-b", SPOT_LABELS))
+    fc.add_pod(make_pod("db-0", 100, "spot-b", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_match={"app": "db"}))
+    cfg = ReschedulerConfig(solver="numpy", node_drain_delay=0.0)
+    r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=fc.clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    fc.clock.advance(10.0)
+    moved = fc.pods["default/web"]
+    assert moved.node_name == "spot-b"
+
+
+def test_fake_scheduler_enforces_positive_affinity():
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a", SPOT_LABELS))
+    pod = make_pod("web", 300, "od-1", pod_affinity_match={"app": "db"})
+    fc.add_pod(pod)
+    fc.evict_pod(pod, 0)
+    fc.clock.advance(5.0)
+    assert "default/web" not in fc.pods  # pending, not placed on spot-a
+    assert any(p.name == "web" for p in fc.pending)
