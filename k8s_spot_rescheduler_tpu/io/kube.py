@@ -102,6 +102,18 @@ def decode_pod(obj: dict) -> PodSpec:
         "persistentVolumeClaim" in (vol or {})
         for vol in spec.get("volumes", []) or []
     )
+    # Hard topology-spread constraints are scheduling predicates the
+    # reference's CheckPredicates enforces (PodTopologySpread plugin,
+    # README.md:103-114) but this model does not: ignoring them would
+    # approve drains the real scheduler then refuses — the unsafe
+    # direction. whenUnsatisfiable defaults to DoNotSchedule (hard);
+    # only explicit ScheduleAnyway entries are soft and ignorable.
+    spread = spec.get("topologySpreadConstraints") or []
+    hard_spread = not isinstance(spread, list) or any(
+        not isinstance(c, dict)
+        or c.get("whenUnsatisfiable", "DoNotSchedule") != "ScheduleAnyway"
+        for c in spread
+    )
     return PodSpec(
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
@@ -117,7 +129,7 @@ def decode_pod(obj: dict) -> PodSpec:
         anti_affinity_match=anti_affinity_match,
         pod_affinity_match=pod_affinity_match,
         node_affinity=node_affinity,
-        unmodeled_constraints=bool(required_affinity or has_pvc),
+        unmodeled_constraints=bool(required_affinity or has_pvc or hard_spread),
     )
 
 

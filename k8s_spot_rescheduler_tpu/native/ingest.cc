@@ -879,6 +879,27 @@ Batch* ingest_pods_impl(const char* buf, long n) {
           }
         }
       }
+      // Hard topology-spread constraints (whenUnsatisfiable defaults to
+      // DoNotSchedule) are unmodeled predicates — exact lockstep with
+      // io/kube.py decode_pod's hard_spread computation.
+      if (const Val* spread = spec->get("topologySpreadConstraints")) {
+        if (py_truthy(spread)) {
+          if (spread->kind != Val::Arr) {
+            flags |= F_REQAFF;
+          } else {
+            for (const Val* c : spread->arr) {
+              const Val* wu = c && c->kind == Val::Obj
+                                  ? c->get("whenUnsatisfiable")
+                                  : nullptr;
+              if (!c || c->kind != Val::Obj || !wu || wu->kind != Val::Str ||
+                  wu->text != "ScheduleAnyway") {
+                flags |= F_REQAFF;
+                break;
+              }
+            }
+          }
+        }
+      }
     }
     b->u8[(size_t)i * P_NU8 + P_FLAGS] = flags;
 

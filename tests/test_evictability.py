@@ -73,3 +73,31 @@ def test_pdb_in_other_namespace_ignored():
     pdb = PDBSpec("web-pdb", namespace="dev", match_labels={"app": "web"})
     out, blocking = get_pods_for_deletion([pod], [pdb])
     assert blocking is None
+
+
+def test_hard_topology_spread_is_unmodeled():
+    """whenUnsatisfiable=DoNotSchedule spread constraints are predicates
+    the reference's CheckPredicates enforces (PodTopologySpread); this
+    model must treat such pods as unplaceable, never as unconstrained."""
+    from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+
+    def pod(spread):
+        return decode_pod({
+            "metadata": {"name": "p"},
+            "spec": {"nodeName": "n", "containers": [],
+                     "topologySpreadConstraints": spread},
+            "status": {"phase": "Running"},
+        })
+
+    hard = {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "x"}}}
+    soft = dict(hard, whenUnsatisfiable="ScheduleAnyway")
+    default = {k: v for k, v in hard.items() if k != "whenUnsatisfiable"}
+
+    assert pod([hard]).unmodeled_constraints
+    assert pod([default]).unmodeled_constraints  # k8s default is hard
+    assert not pod([soft]).unmodeled_constraints
+    assert not pod([]).unmodeled_constraints
+    assert pod([soft, hard]).unmodeled_constraints
+    assert pod("garbage").unmodeled_constraints  # malformed: conservative

@@ -205,6 +205,30 @@ def _naff(terms):
             "nodeSelectorTerms": terms}}}
 
 
+def test_topology_spread_shapes():
+    def spread_pod(name, spread):
+        return _pod_obj(metadata={"name": name, "namespace": "ns1"},
+                        spec={"nodeName": "n1", "containers": [],
+                              "topologySpreadConstraints": spread})
+
+    hard = {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "x"}}}
+    soft = dict(hard, whenUnsatisfiable="ScheduleAnyway")
+    objs = [
+        spread_pod("hard", [hard]),
+        spread_pod("soft", [soft]),
+        spread_pod("default", [{k: v for k, v in hard.items()
+                                if k != "whenUnsatisfiable"}]),
+        spread_pod("mixed", [soft, hard]),
+        spread_pod("empty", []),
+        spread_pod("null", None),
+        spread_pod("malformed", "garbage"),
+        spread_pod("badentry", [None]),
+    ]
+    _assert_pod_parity(objs)
+
+
 def test_pod_affinity_shapes():
     objs = [
         # the modeled positive-affinity shape
