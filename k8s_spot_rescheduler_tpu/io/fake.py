@@ -24,6 +24,7 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
     Taint,
 )
 from k8s_spot_rescheduler_tpu.predicates.masks import (
+    ZONE_LABEL,
     hosts_affinity_match,
     match_node_affinity,
 )
@@ -248,6 +249,28 @@ class FakeCluster:
             here, pod.namespace, tuple(pod.pod_affinity_match.items())
         ):
             return False
+        # zone-topology anti-affinity, both directions, across the whole
+        # zone (nodes without the zone label never conflict)
+        zone = node.labels.get(ZONE_LABEL)
+        if zone is not None:
+            def _zone_pods():
+                for n2 in self.nodes.values():
+                    if n2.labels.get(ZONE_LABEL) == zone:
+                        yield from self.list_pods_on_node(n2.name)
+
+            if pod.anti_affinity_zone_match and hosts_affinity_match(
+                list(_zone_pods()),
+                pod.namespace,
+                tuple(pod.anti_affinity_zone_match.items()),
+            ):
+                return False
+            for p in _zone_pods():
+                if p.anti_affinity_zone_match and hosts_affinity_match(
+                    [pod],
+                    p.namespace,
+                    tuple(p.anti_affinity_zone_match.items()),
+                ):
+                    return False
         return pod.requests.get(CPU, 0) <= free_cpu and (
             pod.requests.get(MEMORY, 0) <= free_mem
         )

@@ -91,7 +91,7 @@ def decode_pod(obj: dict) -> PodSpec:
     node_affinity, naff_unmodeled = decode_node_affinity(
         affinity.get("nodeAffinity") or {}
     )
-    anti_affinity_match, anti_unmodeled = decode_anti_affinity(
+    anti_affinity_match, anti_zone_match, anti_unmodeled = decode_anti_affinity(
         affinity.get("podAntiAffinity") or {}
     )
     pod_affinity_match, paff_unmodeled = decode_pod_affinity(
@@ -127,6 +127,7 @@ def decode_pod(obj: dict) -> PodSpec:
         phase=obj.get("status", {}).get("phase", "Running"),
         node_selector=spec.get("nodeSelector", {}) or {},
         anti_affinity_match=anti_affinity_match,
+        anti_affinity_zone_match=anti_zone_match,
         pod_affinity_match=pod_affinity_match,
         node_affinity=node_affinity,
         unmodeled_constraints=bool(required_affinity or has_pvc or hard_spread),
@@ -227,53 +228,68 @@ def decode_node_affinity(node_aff: dict) -> tuple:
     return tuple(sorted(set(terms))), False
 
 
-def _decode_affinity_block(block: dict) -> tuple:
-    """(matchLabels, unmodeled) for a podAffinity/podAntiAffinity object.
+from k8s_spot_rescheduler_tpu.predicates.masks import (
+    ZONE_LABEL as ZONE_TOPOLOGY_KEY,
+)
+
+
+def _decode_affinity_block(block: dict, topology_keys: tuple) -> tuple:
+    """(matchLabels, topologyKey, unmodeled) for a podAffinity /
+    podAntiAffinity object.
 
     The modeled shape — kept in exact lockstep with the native engine's
     ``extract_affinity_term`` (native/ingest.cc) — is ONE required term
-    with topologyKey=kubernetes.io/hostname and a non-empty
+    with a topologyKey from ``topology_keys`` and a non-empty
     matchLabels-only selector in the pod's own namespace. Anything else
     required is unmodeled (conservatively unplaceable)."""
     req = block.get("requiredDuringSchedulingIgnoredDuringExecution")
     if not req:
-        return {}, False
+        return {}, "", False
     if not isinstance(req, list) or len(req) != 1:
-        return {}, True
+        return {}, "", True
     term = req[0]
     if not isinstance(term, dict):
-        return {}, True  # malformed element — conservatively unmodeled
-    if term.get("topologyKey") != "kubernetes.io/hostname":
-        return {}, True
+        return {}, "", True  # malformed element — conservatively unmodeled
+    topo = term.get("topologyKey")
+    if topo not in topology_keys:
+        return {}, "", True
     if term.get("namespaces"):
-        return {}, True
+        return {}, "", True
     # namespaceSelector (k8s ≥1.21) widens the term beyond the pod's own
     # namespace — even {} means "all namespaces". Presence of the key at
     # all is outside the modeled own-namespace shape: unmodeled.
     if "namespaceSelector" in term:
-        return {}, True
+        return {}, "", True
     sel = term.get("labelSelector")
     if not isinstance(sel, dict):
-        return {}, True
+        return {}, "", True
     if sel.get("matchExpressions"):
-        return {}, True
+        return {}, "", True
     match = sel.get("matchLabels")
     if not isinstance(match, dict) or not match:
-        return {}, True
-    return dict(match), False
+        return {}, "", True
+    return dict(match), topo, False
 
 
 def decode_anti_affinity(anti: dict) -> tuple:
-    """(matchLabels, unmodeled) for a podAntiAffinity object."""
-    return _decode_affinity_block(anti)
+    """(hostname matchLabels, zone matchLabels, unmodeled) for a
+    podAntiAffinity object; at most one of the selectors is non-empty."""
+    match, topo, unmodeled = _decode_affinity_block(
+        anti, ("kubernetes.io/hostname", ZONE_TOPOLOGY_KEY)
+    )
+    if topo == ZONE_TOPOLOGY_KEY:
+        return {}, match, unmodeled
+    return match, {}, unmodeled
 
 
 def decode_pod_affinity(paff: dict) -> tuple:
     """(matchLabels, unmodeled) for a required POSITIVE podAffinity
-    object — same canonical shape as anti-affinity; the planner admits
-    the pod only on nodes already hosting a match
-    (predicates/masks.PodAffinityBit)."""
-    return _decode_affinity_block(paff)
+    object — hostname topology only; the planner admits the pod only on
+    nodes already hosting a match (predicates/masks.PodAffinityBit)."""
+    match, _, unmodeled = _decode_affinity_block(
+        paff, ("kubernetes.io/hostname",)
+    )
+    return match, unmodeled
 
 
 def decode_node(obj: dict) -> NodeSpec:

@@ -50,11 +50,11 @@ F_PVC, F_REQAFF = 32, 64
 # pod column indices
 P_CPU, P_MEM, P_EPH = 0, 1, 2
 (P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID,
- P_AAFFID, P_NAFFID, P_PAFFID) = range(9)
+ P_AAFFID, P_NAFFID, P_PAFFID, P_ZAFFID) = range(10)
 PS_NAME, PS_UID = range(2)
 # interned-table families
 (TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_AAFF,
- TBL_NAFF, TBL_PAFF) = range(8)
+ TBL_NAFF, TBL_PAFF, TBL_ZAFF) = range(9)
 # node column indices
 N_CPU, N_MEM, N_EPH, N_PODS = range(4)
 N_READY, N_UNSCHED, N_HASPODS = range(3)
@@ -100,13 +100,13 @@ def _lib() -> Optional[ctypes.CDLL]:
     try:
         ok = (
             lib.pod_ncols_i64() == 3
-            and lib.pod_ncols_i32() == 9
+            and lib.pod_ncols_i32() == 10
             and lib.pod_ncols_u8() == 1
             and lib.pod_ncols_str() == 2
             and lib.node_ncols_i64() == 4
             and lib.node_ncols_u8() == 3
             and lib.node_ncols_str() == 4
-            and lib.table_count() == 8
+            and lib.table_count() == 9
         )
     except AttributeError:
         ok = False
@@ -256,6 +256,7 @@ class PodBatch:
         self.selector_sets = [_parse_kv(b) for b in tables[TBL_NODESEL]]
         self.match_sets = [_parse_kv(b) for b in tables[TBL_AAFF]]
         self.paff_sets = [_parse_kv(b) for b in tables[TBL_PAFF]]
+        self.zaff_sets = [_parse_kv(b) for b in tables[TBL_ZAFF]]
         self.naff_sets = [_parse_node_affinity(b) for b in tables[TBL_NAFF]]
 
     def match_set(self, set_id: int) -> Dict[str, str]:
@@ -263,6 +264,9 @@ class PodBatch:
 
     def paff_set(self, set_id: int) -> Dict[str, str]:
         return self.paff_sets[set_id]
+
+    def zaff_set(self, set_id: int) -> Dict[str, str]:
+        return self.zaff_sets[set_id]
 
     def label_set(self, set_id: int) -> Dict[str, str]:
         cached = self._label_sets[set_id]
@@ -381,6 +385,10 @@ class PodView:
         return self._b.paff_set(int(self._b.i32[self._i, P_PAFFID]))
 
     @property
+    def anti_affinity_zone_match(self) -> Dict[str, str]:
+        return self._b.zaff_set(int(self._b.i32[self._i, P_ZAFFID]))
+
+    @property
     def node_selector(self) -> Dict[str, str]:
         return self._b.selector_set(int(self._b.i32[self._i, P_SELID]))
 
@@ -426,6 +434,7 @@ class PodView:
             phase=self.phase,
             node_selector=dict(self.node_selector),
             anti_affinity_match=dict(self.anti_affinity_match),
+            anti_affinity_zone_match=dict(self.anti_affinity_zone_match),
             pod_affinity_match=dict(self.pod_affinity_match),
             node_affinity=self.node_affinity,
             unmodeled_constraints=self.unmodeled_constraints,
@@ -537,7 +546,7 @@ def parse_pod_list(data: bytes) -> Optional[PodBatch]:
     handle = lib.ingest_pods(data, len(data))
     if not handle:
         return None
-    return PodBatch(*_copy_batch(lib, handle, 3, 9, 1, 2, tables=8))
+    return PodBatch(*_copy_batch(lib, handle, 3, 10, 1, 2, tables=9))
 
 
 def parse_node_list(data: bytes) -> Optional[NodeBatch]:

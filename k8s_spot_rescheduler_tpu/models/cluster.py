@@ -86,6 +86,19 @@ class PodSpec:
     # beyond this (matchExpressions, other topology keys, multiple terms)
     # fall back to ``unmodeled_constraints``.
     anti_affinity_match: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Required anti-affinity with topologyKey=topology.kubernetes.io/zone
+    # (same canonical selector shape, own namespace): the pod refuses
+    # nodes in any ZONE hosting a matched pod, and — symmetrically —
+    # matched pods refuse zones hosting this pod. Zones come from the
+    # standard node label. Modeled statically per tick via zone-salted
+    # affinity-group bits (predicates/masks.zone_match_affinity_mask);
+    # when two zone-involved pods share one candidate lane the packers
+    # conservatively mark them unplaceable (static bits cannot prove the
+    # in-plan interaction safe). Legacy zone label keys and other
+    # topology keys fall back to ``unmodeled_constraints``.
+    anti_affinity_zone_match: Dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )
     # Required POSITIVE pod-affinity, modeled in the same canonical shape
     # (one required term, topologyKey=hostname, matchLabels selector,
     # own namespace): the pod may only schedule onto a node already

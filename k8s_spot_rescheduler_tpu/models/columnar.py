@@ -68,6 +68,9 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     intern_constraints,
     match_affinity_mask,
     match_node_affinity,
+    ZONE_LABEL,
+    zone_lane_guard,
+    zone_match_affinity_mask,
 )
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
@@ -304,6 +307,9 @@ class ColumnarStore:
         self._aff_lists: List[tuple] = []
         self._aff_universe_key: Optional[tuple] = None
         self._aff_matrix = np.zeros((0, AFFINITY_WORDS), np.uint32)
+        self._host_matrix = np.zeros((0, AFFINITY_WORDS), np.uint32)
+        self._zone_matrix = np.zeros((0, AFFINITY_WORDS), np.uint32)
+        self._zone_universe: tuple = ()
 
         # label index for PDB selection: (ns, key, value) -> live pod rows
         self._label_index: Dict[Tuple[str, str, str], Set[int]] = {}
@@ -507,12 +513,13 @@ class ColumnarStore:
             self._tol_lists.append(key)
             self._table_key = None  # force toleration matrix rebuild
         self.p_tol_id[r] = tid
-        # affinity profile: (group, ns, match selector, labels) determines
-        # the pod's affinity mask for any selector universe
+        # affinity profile: (group, ns, hostname selector, zone selector,
+        # labels) determines the pod's affinity mask for any universe
         akey = (
             pod.anti_affinity_group,
             pod.namespace,
             tuple(sorted(pod.anti_affinity_match.items())),
+            tuple(sorted(pod.anti_affinity_zone_match.items())),
             tuple(sorted(pod.labels.items())),
         )
         aid = self._aff_keys.get(akey)
@@ -664,22 +671,25 @@ class ColumnarStore:
                 self._table_key = None
             ids[i] = tid
         self.p_tol_id[:k] = ids[inverse]
-        # affinity-profile interning per distinct (ns, selector, labels)
+        # affinity-profile interning per distinct (ns, hostname selector,
+        # zone selector, labels)
         acombos = np.stack(
             [
                 batch.i32[keep, ni.P_NSID],
                 batch.i32[keep, ni.P_AAFFID],
+                batch.i32[keep, ni.P_ZAFFID],
                 batch.i32[keep, ni.P_LABELSID],
             ],
             axis=1,
         )
         auniq, ainv = np.unique(acombos, axis=0, return_inverse=True)
         aids = np.empty(len(auniq), np.int32)
-        for i, (ns_id, aaff_id, l_id) in enumerate(auniq):
+        for i, (ns_id, aaff_id, zaff_id, l_id) in enumerate(auniq):
             akey = (
                 "",  # kube pods carry no synthetic group
                 batch.namespaces[ns_id],
                 tuple(sorted(batch.match_set(int(aaff_id)).items())),
+                tuple(sorted(batch.zaff_set(int(zaff_id)).items())),
                 tuple(sorted(batch.label_set(int(l_id)).items())),
             )
             aid = self._aff_keys.get(akey)
@@ -910,7 +920,7 @@ class ColumnarStore:
         if self._paff_match_key != key:
             self._paff_match_key = key
             m = np.zeros((len(self._aff_lists), len(paffs)), bool)
-            for i, (_, ns, _, labels) in enumerate(self._aff_lists):
+            for i, (_, ns, _, _, labels) in enumerate(self._aff_lists):
                 have = dict(labels)
                 for j, (pns, items) in enumerate(paffs):
                     m[i, j] = ns == pns and all(
@@ -993,17 +1003,38 @@ class ColumnarStore:
                 if self._aff_lists[int(i)][2]
             }
         )
-        key = (tuple(universe), len(self._aff_lists))
+        zone_universe = sorted(
+            {
+                (self._aff_lists[int(i)][1], self._aff_lists[int(i)][3])
+                for i in ids
+                if self._aff_lists[int(i)][3]
+            }
+        )
+        key = (tuple(universe), tuple(zone_universe), len(self._aff_lists))
         if self._aff_universe_key != key:
             self._aff_universe_key = key
             rows = np.zeros((len(self._aff_lists), AFFINITY_WORDS), np.uint32)
-            for i, (group, ns, match_items, labels) in enumerate(self._aff_lists):
-                m = match_affinity_mask(ns, match_items, dict(labels), universe)
+            hrows = np.zeros((len(self._aff_lists), AFFINITY_WORDS), np.uint32)
+            zrows = np.zeros((len(self._aff_lists), AFFINITY_WORDS), np.uint32)
+            for i, (group, ns, match_items, zone_items, labels) in enumerate(
+                self._aff_lists
+            ):
+                lbl = dict(labels)
+                m = match_affinity_mask(ns, match_items, lbl, universe)
                 if group:
                     w, b = affinity_bits(group)
                     m[w] |= np.uint32(1 << b)
-                rows[i] = m
+                z = zone_match_affinity_mask(ns, zone_items, lbl, zone_universe)
+                hrows[i] = m
+                zrows[i] = z
+                rows[i] = m | z  # pod side (slot_aff)
             self._aff_matrix = rows
+            # node side: a resident contributes hostname bits to its OWN
+            # node only; zone bits flow exclusively through the zone-wide
+            # accumulation (a zoneless node must never acquire them)
+            self._host_matrix = hrows
+            self._zone_matrix = zrows
+            self._zone_universe = tuple(zone_universe)
         return self._aff_matrix
 
     def pods_on_node_sorted(self, node_row: int) -> List[PodSpec]:
@@ -1244,6 +1275,24 @@ class ColumnarStore:
             packed.slot_aff[slot_cand, slot_idx] = aff_matrix[
                 self.p_aff_id[slot_rows]
             ]
+            if self._zone_universe:
+                # zone lane guard (masks.zone_lane_guard, shared with the
+                # object packer): lanes holding a zone-anti CARRIER get
+                # the per-lane safety analysis; flagged pods lose their
+                # unplaceable-bit tolerance
+                carrier = np.fromiter(
+                    (bool(prof[3]) for prof in self._aff_lists),
+                    bool,
+                    count=len(self._aff_lists),
+                )[self.p_aff_id[slot_rows]]
+                if carrier.any():
+                    up = self._unplace_pos
+                    uw, ub = up // 32, np.uint32(1 << (up % 32))
+                    for c in np.unique(slot_cand[carrier]):
+                        rows = slot_rows[slot_cand == c]
+                        pods = [self.pod_objs[int(r)] for r in rows]
+                        for k in zone_lane_guard(pods):
+                            packed.slot_tol[int(c), int(k), uw] &= ~ub
         if C_actual:
             packed.cand_valid[:C_actual] = cand_ok & (n_evict > 0)
 
@@ -1275,7 +1324,32 @@ class ColumnarStore:
             if paff_bits is not None:
                 packed.spot_taints[:S_actual] |= paff_bits
             aff = np.zeros((S_actual, AFFINITY_WORDS), np.uint32)
-            np.bitwise_or.at(aff, sp, aff_matrix[self.p_aff_id[sp_rows]])
+            np.bitwise_or.at(aff, sp, self._host_matrix[self.p_aff_id[sp_rows]])
+            if self._zone_universe:
+                # zone-wide presence: OR the zone-family masks of EVERY
+                # counted pod (any node class) into its node's zone, then
+                # into each spot node in that zone
+                zone_ids: Dict[str, int] = {}
+                zid_node = np.full(nhi, -1, np.int32)
+                for nr in range(nhi):
+                    obj = self.node_objs[nr]
+                    if obj is None:
+                        continue
+                    z = obj.labels.get(ZONE_LABEL)
+                    if z is not None:
+                        zid_node[nr] = zone_ids.setdefault(z, len(zone_ids))
+                if zone_ids:
+                    crows = np.nonzero(counted)[0]
+                    pz = zid_node[p_node[crows]]
+                    live = pz >= 0
+                    accum = np.zeros((len(zone_ids), AFFINITY_WORDS), np.uint32)
+                    np.bitwise_or.at(
+                        accum, pz[live],
+                        self._zone_matrix[self.p_aff_id[crows[live]]],
+                    )
+                    spot_z = zid_node[spot_order]
+                    has_z = spot_z >= 0
+                    aff[has_z] |= accum[spot_z[has_z]]
             packed.spot_aff[:S_actual] = aff
 
         meta = ColumnarMeta(

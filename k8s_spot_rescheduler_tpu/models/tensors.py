@@ -56,6 +56,10 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     pod_affinity_mask,
     pod_affinity_universe,
     selector_universe,
+    ZONE_LABEL,
+    collect_zone_universe,
+    zone_lane_guard,
+    zone_match_affinity_mask,
 )
 
 # Scale divisor per resource so packed values stay < 2**24 (float32-exact).
@@ -214,12 +218,14 @@ def pack_cluster(
         node_affinity_universe(slot_pods_flat),
         pod_affinity_universe(slot_pods_flat),
     )
-    # anti-affinity selector universe spans every counted pod (resident
-    # spot pods repel incoming matches and vice versa)
-    match_universe = collect_match_universe(
-        [p for info in candidates for p in info.pods]
-        + [p for info in spot for p in info.pods]
-    )
+    # anti-affinity selector universes span every counted pod (resident
+    # pods repel incoming matches and vice versa; zone identities reach
+    # across node classes because zones do)
+    counted_pods = [p for info in candidates for p in info.pods] + [
+        p for info in spot for p in info.pods
+    ]
+    match_universe = collect_match_universe(counted_pods)
+    zone_universe = collect_zone_universe(counted_pods)
     W, A, R = table.words, AFFINITY_WORDS, len(resources)
 
     C = max(_pad_dim(len(candidates)), _pad_dim(pad_candidates))
@@ -291,19 +297,75 @@ def pack_cluster(
             )
         return row
 
-    def aff_row(pod: PodSpec):
+    zone_cache: dict = {}
+
+    def zone_row(pod: PodSpec):
+        """Zone-family bits only (aggregated zone-wide on the node side)."""
+        key = (
+            pod.namespace,
+            tuple(sorted(pod.anti_affinity_zone_match.items())),
+            tuple(sorted(pod.labels.items())),
+        )
+        row = zone_cache.get(key)
+        if row is None:
+            row = zone_cache[key] = zone_match_affinity_mask(
+                pod.namespace, key[1], pod.labels, zone_universe
+            )
+        return row
+
+    host_cache: dict = {}
+
+    def host_row(pod: PodSpec):
+        """Hostname-family bits only — what a resident contributes to
+        its OWN node's mask. Zone bits must never ride along here: they
+        flow exclusively through the zone-wide accumulation below, so a
+        zoneless node never acquires zone conflicts."""
         key = (
             pod.anti_affinity_group,
             pod.namespace,
             tuple(sorted(pod.anti_affinity_match.items())),
             tuple(sorted(pod.labels.items())),
         )
-        row = aff_cache.get(key)
+        row = host_cache.get(key)
         if row is None:
-            row = aff_cache[key] = pod_affinity_mask(pod) | match_affinity_mask(
+            row = host_cache[key] = pod_affinity_mask(pod) | match_affinity_mask(
                 pod.namespace, key[2], pod.labels, match_universe
             )
         return row
+
+    def aff_row(pod: PodSpec):
+        """Pod-side mask (slots): hostname family | zone family."""
+        key = (
+            pod.anti_affinity_group,
+            pod.namespace,
+            tuple(sorted(pod.anti_affinity_match.items())),
+            tuple(sorted(pod.anti_affinity_zone_match.items())),
+            tuple(sorted(pod.labels.items())),
+        )
+        row = aff_cache.get(key)
+        if row is None:
+            row = aff_cache[key] = host_row(pod) | zone_row(pod)
+        return row
+
+    # zone-wide presence: OR of the zone-family masks of every counted
+    # pod, keyed by its node's zone label (nodes without the label are
+    # zoneless and neither contribute nor receive)
+    zone_accum: dict = {}
+    if zone_universe:
+        for info in list(candidates) + list(spot):
+            zone = info.node.labels.get(ZONE_LABEL)
+            if zone is None:
+                continue
+            for pod in info.pods:
+                acc = zone_accum.get(zone)
+                row = zone_row(pod)
+                zone_accum[zone] = row.copy() if acc is None else acc | row
+
+    # the unplaceable bit is always the table's last entry
+    unplace_idx = len(table.taints) - 1
+    unplace_word, unplace_bit = unplace_idx // 32, np.uint32(
+        1 << (unplace_idx % 32)
+    )
 
     for c, (info, pods, blocked) in enumerate(zip(candidates, cand_pods, blocking)):
         # a candidate with no evictable pods is skipped, not drained
@@ -315,6 +377,12 @@ def pack_cluster(
             packed.slot_valid[c, :n] = True
             packed.slot_tol[c, :n] = [tol_row(p) for p in pods]
             packed.slot_aff[c, :n] = [aff_row(p) for p in pods]
+            if zone_universe:
+                # two zone-involved pods in one lane: static zone bits
+                # cannot prove their in-plan interaction safe — mark
+                # them unplaceable (clears the lane, conservatively)
+                for k in zone_lane_guard(pods):
+                    packed.slot_tol[c, k, unplace_word] &= ~unplace_bit
 
     for s, info in enumerate(spot):
         alloc = scale_allocatable(info.node.allocatable, resources)
@@ -334,7 +402,11 @@ def pack_cluster(
         aff = np.zeros(AFFINITY_WORDS, np.uint32)
         for pod in info.pods:
             if pod.anti_affinity_group or pod.anti_affinity_match or match_universe:
-                aff |= aff_row(pod)
+                aff |= host_row(pod)
+        if zone_universe:
+            zone = info.node.labels.get(ZONE_LABEL)
+            if zone is not None and zone in zone_accum:
+                aff |= zone_accum[zone]
         packed.spot_aff[s] = aff
 
     meta = PackMeta(

@@ -418,6 +418,7 @@ enum {
   TBL_AAFF,
   TBL_NAFF,  // required node-affinity blobs (see extract_node_affinity)
   TBL_PAFF,  // required POSITIVE pod-affinity matchLabels blobs
+  TBL_ZAFF,  // zone-topology anti-affinity matchLabels blobs
   TBL_COUNT,
 };
 
@@ -464,6 +465,7 @@ enum {
   P_AAFFID,
   P_NAFFID,
   P_PAFFID,
+  P_ZAFFID,
   P_NI32,
 };
 enum { P_FLAGS = 0, P_NU8 };
@@ -498,10 +500,14 @@ bool py_truthy(const Val* v) {
 
 // The modeled affinity-term shape (mirrors io/kube.py
 // _decode_affinity_block, shared by podAffinity AND podAntiAffinity):
-// ONE required term with topologyKey=kubernetes.io/hostname and a
-// matchLabels-only labelSelector. Returns the matchLabels object and
-// leaves *unmodeled false; anything else required sets *unmodeled.
-const Val* extract_affinity_term(const Val* block, bool* unmodeled) {
+// ONE required term with a modeled topologyKey (hostname always;
+// topology.kubernetes.io/zone additionally when allow_zone — the anti
+// block) and a matchLabels-only labelSelector. Returns the matchLabels
+// object, sets *is_zone for a zone term, and leaves *unmodeled false;
+// anything else required sets *unmodeled.
+const Val* extract_affinity_term(const Val* block, bool allow_zone,
+                                 bool* is_zone, bool* unmodeled) {
+  *is_zone = false;
   if (!block || block->kind != Val::Obj) return nullptr;
   const Val* req = block->get("requiredDuringSchedulingIgnoredDuringExecution");
   if (!req) return nullptr;
@@ -522,8 +528,13 @@ const Val* extract_affinity_term(const Val* block, bool* unmodeled) {
     return nullptr;
   }
   const Val* topo = term->get("topologyKey");
-  if (!topo || topo->kind != Val::Str ||
-      topo->text != "kubernetes.io/hostname") {
+  if (!topo || topo->kind != Val::Str) {
+    *unmodeled = true;
+    return nullptr;
+  }
+  if (allow_zone && topo->text == "topology.kubernetes.io/zone") {
+    *is_zone = true;
+  } else if (topo->text != "kubernetes.io/hostname") {
     *unmodeled = true;
     return nullptr;
   }
@@ -854,6 +865,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     if (phase == "Succeeded" || phase == "Failed") flags |= F_TERMINAL;
     if (phase == "Pending") flags |= F_PENDING;
     const Val* anti_affinity_labels = nullptr;
+    const Val* zone_anti_labels = nullptr;
     const Val* pod_affinity_labels = nullptr;
     std::string naff_blob;
     if (spec) {
@@ -861,10 +873,18 @@ Batch* ingest_pods_impl(const char* buf, long n) {
       const Val* affinity = spec->get("affinity");
       const Val* aff_obj =
           (affinity && affinity->kind == Val::Obj) ? affinity : nullptr;
-      anti_affinity_labels = extract_affinity_term(
-          aff_obj ? aff_obj->get("podAntiAffinity") : nullptr, &unmodeled);
+      bool anti_zone = false, paff_zone = false;
+      const Val* anti_labels = extract_affinity_term(
+          aff_obj ? aff_obj->get("podAntiAffinity") : nullptr,
+          /*allow_zone=*/true, &anti_zone, &unmodeled);
+      if (anti_zone) {
+        zone_anti_labels = anti_labels;
+      } else {
+        anti_affinity_labels = anti_labels;
+      }
       pod_affinity_labels = extract_affinity_term(
-          aff_obj ? aff_obj->get("podAffinity") : nullptr, &unmodeled);
+          aff_obj ? aff_obj->get("podAffinity") : nullptr,
+          /*allow_zone=*/false, &paff_zone, &unmodeled);
       extract_node_affinity(
           aff_obj ? aff_obj->get("nodeAffinity") : nullptr,
           &unmodeled, &naff_blob);
@@ -930,6 +950,9 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     tmp.clear();
     blob_kv_into(&tmp, pod_affinity_labels);
     i32row(P_PAFFID) = b->intern_str(TBL_PAFF, tmp);
+    tmp.clear();
+    blob_kv_into(&tmp, zone_anti_labels);
+    i32row(P_ZAFFID) = b->intern_str(TBL_ZAFF, tmp);
 
     // tolerations: key\x1fvalue\x1foperator\x1feffect\x1e...
     tmp.clear();

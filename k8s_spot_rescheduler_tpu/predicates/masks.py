@@ -426,6 +426,88 @@ def match_affinity_mask(
     return mask
 
 
+# --- zone-topology anti-affinity (static, zone-salted group bits) ---------
+#
+# Required anti-affinity with topologyKey=topology.kubernetes.io/zone uses
+# the SAME requirement|presence hashing as the hostname machinery above,
+# but with a zone salt in the key and zone-wide node-side aggregation: a
+# spot node's affinity word ORs in the zone masks of every counted pod in
+# its entire ZONE (any node class), so a requirer refuses zones hosting a
+# match and a matched pod refuses zones hosting a requirer — the
+# scheduler's symmetric semantics, statically per tick. What static bits
+# CANNOT prove safe is two zone-involved pods inside one candidate lane
+# (their in-plan placements could collide zone-wide); the packers mark
+# those pods unplaceable (see lane guard in models/tensors.py /
+# models/columnar.py). Hash collisions only ever forbid placements — the
+# safe direction.
+
+ZONE_LABEL = "topology.kubernetes.io/zone"
+
+
+def zone_selector_key(namespace: str, items: Tuple[Tuple[str, str], ...]) -> str:
+    return "zone\x1c" + match_selector_key(namespace, items)
+
+
+def collect_zone_universe(pods) -> List[Tuple[str, Tuple[Tuple[str, str], ...]]]:
+    """Sorted distinct (namespace, selector items) across the pods' zone
+    anti-affinities — deterministic, shared by both packers."""
+    return sorted(
+        {
+            (p.namespace, tuple(sorted(p.anti_affinity_zone_match.items())))
+            for p in pods
+            if p.anti_affinity_zone_match
+        }
+    )
+
+
+def zone_match_affinity_mask(
+    namespace: str,
+    zone_items: Tuple[Tuple[str, str], ...],
+    labels,
+    universe: Sequence[Tuple[str, Tuple[Tuple[str, str], ...]]],
+) -> np.ndarray:
+    """Requirement bit (own zone selector) | presence bits (universe zone
+    selectors matching this pod's labels, namespace-scoped) — the
+    zone-family analog of ``match_affinity_mask``."""
+    mask = np.zeros(AFFINITY_WORDS, dtype=np.uint32)
+    if zone_items:
+        w, b = affinity_bits(zone_selector_key(namespace, zone_items))
+        mask[w] |= np.uint32(1 << b)
+    for ns, items in universe:
+        if ns == namespace and all(labels.get(k) == v for k, v in items):
+            w, b = affinity_bits(zone_selector_key(ns, items))
+            mask[w] |= np.uint32(1 << b)
+    return mask
+
+
+def zone_lane_guard(pods: Sequence[PodSpec]) -> set:
+    """Slot indices (within one candidate lane) to mark unplaceable.
+
+    For each zone identity CARRIED by a lane pod: if two or more lane
+    pods are involved with it (carry it, or are matched by its
+    selector), their in-plan placements could collide zone-wide in ways
+    the static zone bits cannot see — mark every involved pod, which
+    conservatively fails the lane. A single involved pod per identity is
+    fully covered by the static bits. Shared by both packers so the
+    decision is bit-identical."""
+    carried: dict = {}
+    for i, p in enumerate(pods):
+        if p.anti_affinity_zone_match:
+            key = (p.namespace, tuple(sorted(p.anti_affinity_zone_match.items())))
+            carried.setdefault(key, set()).add(i)
+    out: set = set()
+    for (ns, items), involved in carried.items():
+        involved = set(involved)
+        for i, p in enumerate(pods):
+            if p.namespace == ns and all(
+                p.labels.get(k) == v for k, v in items
+            ):
+                involved.add(i)
+        if len(involved) >= 2:
+            out |= involved
+    return out
+
+
 def fit_mask(
     xp,
     *,

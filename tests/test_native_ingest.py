@@ -125,6 +125,9 @@ def _assert_pod_parity(objs):
         assert got.pod_affinity_match == want.pod_affinity_match, (
             f"pod {i} pod-affinity"
         )
+        assert got.anti_affinity_zone_match == want.anti_affinity_zone_match, (
+            f"pod {i} zone-anti-affinity"
+        )
         assert got.node_affinity == want.node_affinity, f"pod {i} node-aff"
         assert got.unmodeled_constraints == want.unmodeled_constraints, (
             f"pod {i} unmodeled"
@@ -225,6 +228,34 @@ def test_topology_spread_shapes():
         spread_pod("null", None),
         spread_pod("malformed", "garbage"),
         spread_pod("badentry", [None]),
+    ]
+    _assert_pod_parity(objs)
+
+
+def test_zone_anti_affinity_shapes():
+    objs = [
+        # modeled zone-topology anti-affinity
+        _affinity_pod("za", {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "topology.kubernetes.io/zone",
+                 "labelSelector": {"matchLabels": {"app": "db"}}}]}}),
+        # legacy zone key -> unmodeled
+        _affinity_pod("zleg", {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "failure-domain.beta.kubernetes.io/zone",
+                 "labelSelector": {"matchLabels": {"app": "db"}}}]}}),
+        # zone topology on POSITIVE affinity -> unmodeled (hostname only)
+        _affinity_pod("zpa", {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "topology.kubernetes.io/zone",
+                 "labelSelector": {"matchLabels": {"app": "db"}}}]}}),
+        # hostname anti + zone anti cannot coexist (two terms) -> unmodeled
+        _affinity_pod("two", {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "kubernetes.io/hostname",
+                 "labelSelector": {"matchLabels": {"a": "1"}}},
+                {"topologyKey": "topology.kubernetes.io/zone",
+                 "labelSelector": {"matchLabels": {"b": "2"}}}]}}),
     ]
     _assert_pod_parity(objs)
 

@@ -1,0 +1,336 @@
+"""Zone-topology anti-affinity via zone-salted affinity-group bits.
+
+Required podAntiAffinity with topologyKey=topology.kubernetes.io/zone
+previously collapsed to the unplaceable bit. It is now modeled
+statically per tick: a spot node's affinity word ORs in the zone-family
+masks of every counted pod in its entire zone (any node class), giving
+both scheduler directions — a requirer refuses zones hosting a match,
+and a matched pod refuses zones hosting a requirer. The one case static
+bits cannot prove safe — two zone-involved pods inside one candidate
+lane — is conservatively killed by the shared lane guard
+(masks.zone_lane_guard).
+"""
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.predicates.masks import ZONE_LABEL
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+
+def _zone_labels(base, zone):
+    return dict(base, **{ZONE_LABEL: zone})
+
+
+# --- decode ----------------------------------------------------------------
+
+def _pod_obj(anti_term):
+    return {
+        "metadata": {"name": "p", "namespace": "ns1"},
+        "spec": {"nodeName": "n1", "containers": [],
+                 "affinity": {"podAntiAffinity": {
+                     "requiredDuringSchedulingIgnoredDuringExecution":
+                         anti_term}}},
+        "status": {"phase": "Running"},
+    }
+
+
+def test_decode_zone_topology_modeled():
+    pod = decode_pod(_pod_obj([{
+        "topologyKey": "topology.kubernetes.io/zone",
+        "labelSelector": {"matchLabels": {"app": "db"}},
+    }]))
+    assert pod.anti_affinity_zone_match == {"app": "db"}
+    assert pod.anti_affinity_match == {}
+    assert not pod.unmodeled_constraints
+
+
+def test_decode_legacy_zone_key_unmodeled():
+    pod = decode_pod(_pod_obj([{
+        "topologyKey": "failure-domain.beta.kubernetes.io/zone",
+        "labelSelector": {"matchLabels": {"app": "db"}},
+    }]))
+    assert pod.anti_affinity_zone_match == {}
+    assert pod.unmodeled_constraints
+
+
+def test_decode_hostname_still_hostname():
+    pod = decode_pod(_pod_obj([{
+        "topologyKey": "kubernetes.io/hostname",
+        "labelSelector": {"matchLabels": {"app": "db"}},
+    }]))
+    assert pod.anti_affinity_match == {"app": "db"}
+    assert pod.anti_affinity_zone_match == {}
+    assert not pod.unmodeled_constraints
+
+
+# --- oracle / packer -------------------------------------------------------
+
+def _cluster():
+    """Zone A: spot-a1 (hosts app=db), spot-a2. Zone B: spot-b1. One
+    zoneless spot node."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-a2", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_node(make_node("spot-nz", SPOT_LABELS))
+    fc.add_pod(make_pod("db-0", 100, "spot-a1", labels={"app": "db"}))
+    return fc
+
+
+def _pack(fc):
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    return pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+
+
+def _placement(fc, pod_name):
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    for c, pods in enumerate(meta.cand_pods):
+        for k, p in enumerate(pods):
+            if p.name == pod_name:
+                if not result.feasible[c]:
+                    return None
+                return meta.spot[int(result.assignment[c, k])].node.name
+    raise AssertionError(f"{pod_name} not in any lane")
+
+
+def test_requirer_avoids_zone_hosting_match():
+    fc = _cluster()
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    target = _placement(fc, "web")
+    # zone a hosts app=db -> both zone-a nodes repel; b or zoneless ok
+    assert target in ("spot-b1", "spot-nz")
+
+
+def test_matcher_avoids_zone_hosting_requirer():
+    """Symmetric direction: a resident requirer in zone a repels matched
+    pods from the WHOLE zone, even from a different node."""
+    fc = _cluster()
+    fc.add_pod(make_pod("guard", 100, "spot-a2",
+                        anti_affinity_zone_match={"tier": "cache"}))
+    fc.add_pod(make_pod("cache", 300, "od-1", labels={"tier": "cache"}))
+    target = _placement(fc, "cache")
+    assert target in ("spot-b1", "spot-nz")
+
+
+def test_requirer_blocked_when_every_zone_hosts_match():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-a", 100, "spot-a1", labels={"app": "db"}))
+    fc.add_pod(make_pod("db-b", 100, "spot-b1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    packed, _ = _pack(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+
+
+def test_zoneless_nodes_never_conflict():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-nz1", SPOT_LABELS))
+    fc.add_pod(make_pod("db-0", 100, "spot-nz1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    # k8s: a node without the topology key cannot match the term
+    assert _placement(fc, "web") == "spot-nz1"
+
+
+def test_match_on_od_node_repels_same_zone_spot():
+    """Zone presence reaches across node classes: a match resident on an
+    ON-DEMAND node in zone a repels the requirer from zone-a SPOT
+    nodes."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-2", _zone_labels(ON_DEMAND_LABELS, "a")))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-0", 100, "od-2", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    assert _placement(fc, "web") == "spot-b1"
+
+
+def test_lane_guard_two_requirers():
+    """Two pods carrying the same zone identity in one lane: static bits
+    cannot prove the in-plan interaction safe -> lane conservatively
+    infeasible even though two clean zones exist."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("w1", 300, "od-1", labels={"app": "web"},
+                        anti_affinity_zone_match={"app": "web"}))
+    fc.add_pod(make_pod("w2", 300, "od-1", labels={"app": "web"},
+                        anti_affinity_zone_match={"app": "web"}))
+    packed, _ = _pack(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+
+
+def test_lane_guard_requirer_plus_matcher():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("req", 200, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    fc.add_pod(make_pod("match", 200, "od-1", labels={"app": "db"}))
+    packed, _ = _pack(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+
+
+def test_single_requirer_with_plain_peers_still_drains():
+    fc = _cluster()
+    fc.add_pod(make_pod("web", 200, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    fc.add_pod(make_pod("plain", 200, "od-1"))
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    pods = meta.cand_pods[0]
+    k = next(i for i, p in enumerate(pods) if p.name == "web")
+    assert meta.spot[int(result.assignment[0, k])].node.name in (
+        "spot-b1", "spot-nz"
+    )
+
+
+# --- columnar parity -------------------------------------------------------
+
+def _parity(fc):
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+    return store
+
+
+def test_columnar_parity_zone_bits():
+    fc = _cluster()
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    fc.add_pod(make_pod("plain", 100, "od-1"))
+    _parity(fc)
+
+
+def test_columnar_parity_cross_class_zone_presence():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-2", _zone_labels(ON_DEMAND_LABELS, "a")))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-0", 100, "od-2", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    _parity(fc)
+
+
+def test_columnar_parity_lane_guard():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("w1", 300, "od-1", labels={"app": "web"},
+                        anti_affinity_zone_match={"app": "web"}))
+    fc.add_pod(make_pod("w2", 300, "od-1", labels={"app": "web"},
+                        anti_affinity_zone_match={"app": "web"}))
+    fc.add_pod(make_pod("plain", 100, "od-1"))
+    _parity(fc)
+
+
+def test_columnar_parity_tracks_zone_match_departure():
+    fc = _cluster()
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    store = _parity(fc)
+    # the zone-a match leaves: zone a opens up next tick
+    fc.evict_pod(fc.pods["default/db-0"], 0)
+    fc.clock.advance(5.0)
+    obj, _ = _pack(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+
+
+# --- end to end ------------------------------------------------------------
+
+def test_drain_respects_zone_spread():
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-0", 100, "spot-a1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    cfg = ReschedulerConfig(solver="numpy", node_drain_delay=0.0)
+    r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=fc.clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    fc.clock.advance(10.0)
+    assert fc.pods["default/web"].node_name == "spot-b1"
+
+
+def test_fake_scheduler_enforces_zone_anti_affinity():
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_pod(make_pod("db-0", 100, "spot-a1", labels={"app": "db"}))
+    pod = make_pod("web", 300, "od-1", anti_affinity_zone_match={"app": "db"})
+    fc.add_pod(pod)
+    fc.evict_pod(pod, 0)
+    fc.clock.advance(5.0)
+    assert "default/web" not in fc.pods
+    assert any(p.name == "web" for p in fc.pending)
+
+
+def test_zoneless_node_with_residents_never_acquires_zone_bits():
+    """Regression (review finding): a resident's POD-side mask includes
+    zone-family bits, but its contribution to its own node must be
+    hostname-family only — else a zoneless node hosting a match would
+    repel the requirer, diverging from the scheduler (and from the
+    columnar/object parity contract). The hostname universe is forced
+    non-empty to exercise the object packer's accumulation branch."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-nz1", SPOT_LABELS))
+    fc.add_pod(make_pod("db-0", 100, "spot-nz1", labels={"app": "db"}))
+    # unrelated hostname-anti pod makes match_universe non-empty
+    fc.add_pod(make_pod("spread", 50, "od-1",
+                        anti_affinity_match={"tier": "x"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    assert _placement(fc, "web") == "spot-nz1"
+    _parity(fc)
