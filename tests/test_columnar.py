@@ -105,21 +105,48 @@ def test_pack_parity_under_churn():
             for uid in rng.choice(uids, size=min(15, len(uids)), replace=False):
                 pod = fc.pods[str(uid)]
                 fc._remove_pod(pod.uid)
-        elif action == 1:  # pods appear (reschedule path)
+        elif action == 1:  # pods appear (reschedule path), randomly
+            # carrying every modeled constraint surface — the universe
+            # caches must refresh identically on both packers
             nodes = list(fc.nodes)
             for i in range(10):
                 node = str(rng.choice(nodes))
+                extra = {}
+                roll = int(rng.integers(0, 8))
+                if roll == 1:
+                    extra["node_selector"] = {"pool": f"g{i % 3}"}
+                elif roll == 2:
+                    extra["node_affinity"] = (
+                        (("zone", "In", (f"z{i % 2}",)),),
+                    )
+                elif roll == 3:
+                    extra["node_affinity"] = (
+                        (("metadata.name", "FieldIn", (node,)),),
+                    )
+                elif roll == 4:
+                    extra["anti_affinity_match"] = {"churn": f"a{i % 2}"}
+                    extra["labels"] = {"churn": f"a{i % 2}"}
+                elif roll == 5:
+                    extra["anti_affinity_zone_match"] = {"churn": f"z{i % 2}"}
+                elif roll == 6:
+                    extra["pod_affinity_match"] = {"churn": f"p{i % 2}"}
+                elif roll == 7:
+                    extra["unmodeled_constraints"] = True
                 fc.add_pod(
                     make_pod(
                         f"churn-{step}-{i}", int(rng.integers(50, 800)),
-                        node, memory=64 * 1024**2,
+                        node, memory=64 * 1024**2, **extra,
                     )
                 )
-        elif action == 2:  # spot interruption + replacement
+        elif action == 2:  # spot interruption + replacement (half the
+            # replacements land in a zone, exercising zone aggregation)
             spots = [n for n in fc.nodes if n.startswith("spot-")]
             if spots:
                 fc.remove_node(str(rng.choice(spots)))
-            fc.add_node(make_node(f"spot-new-{step}", SPOT_LABELS))
+            labels = dict(SPOT_LABELS)
+            if step % 2:
+                labels["topology.kubernetes.io/zone"] = f"z{step % 3}"
+            fc.add_node(make_node(f"spot-new-{step}", labels))
         else:  # actuator-style taint + readiness flips
             names = list(fc.nodes)
             name = str(rng.choice(names))
