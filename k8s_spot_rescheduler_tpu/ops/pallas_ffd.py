@@ -42,11 +42,18 @@ LANE_BLOCK = 128  # candidate lanes per grid step (TPU lane width)
 _VMEM_BUDGET = 14 * 2**20
 
 
+def _footprint_per_spot(C: int, R: int, A: int) -> int:
+    """Per-spot-column VMEM bytes of one lane block: scratch (R+A+1
+    planes of [Cb, S] i32) plus ~4 live temporaries. The single source
+    of truth for both the fallback guard and the chunk sizing."""
+    return min(LANE_BLOCK, C) * 4 * (R + A + 5)
+
+
 def needs_scan_fallback(C: int, S: int, R: int, A: int) -> bool:
-    """True when the per-block VMEM footprint — scratch (R+A+1 planes of
-    [Cb, S] i32) plus ~4 live temporaries — would exceed the budget; the
-    caller then uses the HBM scan solver (same semantics)."""
-    return min(LANE_BLOCK, C) * S * 4 * (R + A + 5) > _VMEM_BUDGET
+    """True when the per-block VMEM footprint would exceed the budget;
+    the caller then chunks the spot axis (first-fit) or uses the HBM
+    scan solver (best-fit; same semantics)."""
+    return _footprint_per_spot(C, R, A) * S > _VMEM_BUDGET
 
 
 def _kernel(
@@ -161,20 +168,82 @@ def plan_ffd_pallas(
     best_fit: bool = False,
 ) -> SolveResult:
     """Jittable Pallas solve over a PackedCluster (same contract as
-    solver/ffd.plan_ffd). Falls back to interpret mode off-TPU."""
+    solver/ffd.plan_ffd). Falls back to interpret mode off-TPU.
+
+    Shapes whose lane-block state exceeds VMEM take the chunked path
+    (first-fit; see ``_plan_ffd_chunked``) or the HBM scan solver
+    (best-fit, which needs a global tightest-slack election and does not
+    decompose over spot chunks)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    C0, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    A = packed.spot_aff.shape[1]
+
+    if needs_scan_fallback(C0, S, R, A):
+        if best_fit or interpret or S % 128:
+            from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+            return plan_ffd(packed, best_fit=best_fit)
+        return _plan_ffd_chunked(packed, interpret)
+
+    feasible, chosen = _invoke_kernel(packed, interpret, best_fit)
+    assignment = jnp.where(feasible[:, None], chosen, -1)
+    return SolveResult(feasible=feasible, assignment=assignment)
+
+
+def _plan_ffd_chunked(packed: PackedCluster, interpret: bool) -> SolveResult:
+    """First-fit over spot CHUNKS that each fit VMEM.
+
+    First-fit decomposes exactly over an ordered partition of the spot
+    axis: per-spot state is independent across chunks and first-fit
+    prefers earlier spots, so placing every pod that fits chunk 0 (in
+    slot order), then offering the leftovers to chunk 1, and so on,
+    reproduces the global first-fit placement pod for pod. The kernel
+    already places pods regardless of lane feasibility, so each chunk
+    pass is just the kernel with ``slot_valid`` masked to the
+    still-unplaced pods; a lane is feasible iff nothing remains. (This
+    does NOT hold for best-fit — its tightest-slack election is global —
+    which keeps the HBM scan fallback.)"""
+    C0, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    A = packed.spot_aff.shape[1]
+    per_spot = _footprint_per_spot(C0, R, A)
+    Sc = max(128, (_VMEM_BUDGET // per_spot) // 128 * 128)
+
+    remaining = jnp.asarray(packed.slot_valid)
+    chosen_total = jnp.full((C0, K), -1, jnp.int32)
+    for off in range(0, S, Sc):
+        end = min(off + Sc, S)
+        sub = packed._replace(
+            slot_valid=remaining,
+            spot_free=packed.spot_free[off:end],
+            spot_count=packed.spot_count[off:end],
+            spot_max_pods=packed.spot_max_pods[off:end],
+            spot_taints=packed.spot_taints[off:end],
+            spot_ok=packed.spot_ok[off:end],
+            spot_aff=packed.spot_aff[off:end],
+        )
+        _, chosen_b = _invoke_kernel(sub, interpret, best_fit=False)
+        placed_b = chosen_b >= 0
+        chosen_total = jnp.where(placed_b, chosen_b + off, chosen_total)
+        remaining = remaining & ~placed_b
+    feasible = jnp.asarray(packed.cand_valid) & ~jnp.any(remaining, axis=1)
+    assignment = jnp.where(feasible[:, None], chosen_total, -1)
+    return SolveResult(feasible=feasible, assignment=assignment)
+
+
+def _invoke_kernel(
+    packed: PackedCluster, interpret: bool, best_fit: bool
+):
+    """One kernel invocation; returns (feasible [C0] bool, chosen [C0, K]
+    i32 with -1 for unplaced slots, UNmasked by lane feasibility)."""
     slot_req = jnp.asarray(packed.slot_req, jnp.float32)
     C0, K, R = slot_req.shape
     S = packed.spot_free.shape[0]
     W = packed.spot_taints.shape[1]
     A = packed.spot_aff.shape[1]
-
-    if not interpret and needs_scan_fallback(C0, S, R, A):
-        from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
-
-        return plan_ffd(packed, best_fit=best_fit)
 
     # Mosaic requires lane-dim blocks of 128 (or the full axis): small
     # problems run as one block; large ones pad C to a 128 multiple and
@@ -246,8 +315,7 @@ def plan_ffd_pallas(
     )
 
     feasible = feasible_i[:C0, 0] != 0
-    assignment = jnp.where(feasible[:, None], chosen[:, 0, :C0].T, -1)
-    return SolveResult(feasible=feasible, assignment=assignment)
+    return feasible, chosen[:, 0, :C0].T
 
 
 plan_ffd_pallas_jit = jax.jit(

@@ -21,13 +21,32 @@ import jax.numpy as jnp
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 
 
+def _cond_solve(need, solve_thunk, like: SolveResult) -> SolveResult:
+    """Run ``solve_thunk`` under ``lax.cond``: improvement passes
+    (best-fit, repair) are only CONSUMED for lanes the preceding pass
+    failed, so a tick where everything already proved skips their cost
+    at runtime — identical results either way."""
+    import jax
+
+    return jax.lax.cond(
+        need,
+        solve_thunk,
+        lambda: SolveResult(
+            feasible=jnp.zeros_like(like.feasible),
+            assignment=jnp.full_like(like.assignment, -1),
+        ),
+    )
+
+
 def with_best_fit_fallback(solve_fn):
     """Wrap a solve(packed, best_fit=...) callable into one that unions
-    first-fit and best-fit feasibility (one fused program under jit)."""
+    first-fit and best-fit feasibility (one fused program under jit).
+    Best-fit only runs when first-fit left a valid lane unproven."""
 
     def solve(packed) -> SolveResult:
         ff = solve_fn(packed)
-        bf = solve_fn(packed, best_fit=True)
+        need = jnp.any(jnp.asarray(packed.cand_valid) & ~ff.feasible)
+        bf = _cond_solve(need, lambda: solve_fn(packed, best_fit=True), ff)
         feasible = ff.feasible | bf.feasible
         assignment = jnp.where(
             ff.feasible[:, None], ff.assignment, bf.assignment
@@ -48,31 +67,24 @@ def with_repair(solve_fn, rounds: int):
     scratch (solver/validate.py), so the union can only add drainable
     nodes — never an invalid drain.
 
-    Repair results are only ever CONSUMED for lanes both greedy passes
-    failed, so the whole repair phase (partial pass + rounds + revalidate
-    — measured ~60 ms device time at config-3 scale vs ~2 ms for the
-    greedy scans) runs under ``lax.cond``: a tick where greedy proves
-    every valid lane — the common, uncontended case — skips it entirely
-    at runtime. Identical results either way."""
-    import jax
-
+    Each improvement pass is only ever CONSUMED for lanes the passes
+    before it failed, so best-fit AND the repair phase (partial pass +
+    rounds + revalidate — measured ~60 ms device time at config-3 scale
+    vs ~2 ms for the first-fit scan) run under ``lax.cond``: a tick
+    where first-fit proves every valid lane — the common, uncontended
+    case — skips both entirely at runtime. Identical results either
+    way."""
     from k8s_spot_rescheduler_tpu.solver.repair import plan_repair
 
     def solve(packed) -> SolveResult:
+        cand_valid = jnp.asarray(packed.cand_valid)
         ff = solve_fn(packed)
-        bf = solve_fn(packed, best_fit=True)
+        need_bf = jnp.any(cand_valid & ~ff.feasible)
+        bf = _cond_solve(need_bf, lambda: solve_fn(packed, best_fit=True), ff)
         greedy_feasible = ff.feasible | bf.feasible
-        need_repair = jnp.any(
-            jnp.asarray(packed.cand_valid) & ~greedy_feasible
-        )
-        rp = jax.lax.cond(
-            need_repair,
-            lambda p: plan_repair(p, rounds=rounds),
-            lambda p: SolveResult(
-                feasible=jnp.zeros_like(greedy_feasible),
-                assignment=jnp.full_like(ff.assignment, -1),
-            ),
-            packed,
+        need_repair = jnp.any(cand_valid & ~greedy_feasible)
+        rp = _cond_solve(
+            need_repair, lambda: plan_repair(packed, rounds=rounds), ff
         )
         feasible = greedy_feasible | rp.feasible
         assignment = jnp.where(

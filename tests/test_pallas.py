@@ -51,3 +51,69 @@ def test_repeated_solve_deterministic():
     np.testing.assert_array_equal(np.asarray(a.feasible), np.asarray(b.feasible))
     np.testing.assert_array_equal(np.asarray(a.assignment), np.asarray(b.assignment))
     np.testing.assert_array_equal(np.asarray(a.assignment), np.asarray(c.assignment))
+
+
+def test_chunked_first_fit_matches_oracle(monkeypatch):
+    """First-fit decomposes exactly over ordered spot chunks
+    (ops/pallas_ffd._plan_ffd_chunked): per-spot state is independent
+    across chunks and first-fit prefers earlier spots, so chunked
+    placement is bit-identical to the global solve. Forced here onto
+    multi-chunk splits via a tiny VMEM budget, in interpret mode."""
+    import k8s_spot_rescheduler_tpu.ops.pallas_ffd as pf
+    from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+
+    rng = np.random.default_rng(7)
+    # tiny budget -> Sc floors at 128 -> S=384 gives 3 chunks
+    monkeypatch.setattr(pf, "_VMEM_BUDGET", 1)
+    for trial in range(6):
+        base = _random_packed(rng)
+        C, K, R = base.slot_req.shape
+        S = 384
+        packed = base._replace(
+            spot_free=rng.integers(-100, 2000, (S, R)).astype(np.float32),
+            spot_count=rng.integers(0, 5, (S,)).astype(np.int32),
+            spot_max_pods=rng.integers(1, 8, (S,)).astype(np.int32),
+            spot_taints=rng.integers(0, 4, (S, 1)).astype(np.uint32),
+            spot_ok=rng.random((S,)) < 0.6,
+            spot_aff=(
+                np.uint32(1) << rng.integers(0, 32, (S, 2)).astype(np.uint32)
+            ) * (rng.random((S, 2)) < 0.3),
+        )
+        got = pf._plan_ffd_chunked(packed, interpret=True)
+        want = plan_oracle(packed)
+        np.testing.assert_array_equal(
+            np.asarray(got.feasible), want.feasible, err_msg=f"t{trial}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), want.assignment, err_msg=f"t{trial}"
+        )
+
+
+def test_oversize_first_fit_routes_to_chunked(monkeypatch):
+    """On TPU-sized problems past the VMEM budget, first-fit must take
+    the chunked kernel path and best-fit the scan fallback."""
+    import k8s_spot_rescheduler_tpu.ops.pallas_ffd as pf
+
+    calls = []
+    monkeypatch.setattr(pf, "_VMEM_BUDGET", 1)
+    monkeypatch.setattr(
+        pf, "_plan_ffd_chunked",
+        lambda packed, interpret: calls.append("chunked") or None,
+    )
+    rng = np.random.default_rng(3)
+    base = _random_packed(rng)
+    C, K, R = base.slot_req.shape
+    packed = base._replace(
+        spot_free=np.zeros((256, R), np.float32),
+        spot_count=np.zeros(256, np.int32),
+        spot_max_pods=np.ones(256, np.int32),
+        spot_taints=np.zeros((256, 1), np.uint32),
+        spot_ok=np.ones(256, bool),
+        spot_aff=np.zeros((256, 2), np.uint32),
+    )
+    pf.plan_ffd_pallas(packed, interpret=False, best_fit=False)
+    assert calls == ["chunked"]
+    # best-fit: global election does not decompose -> scan fallback
+    out = pf.plan_ffd_pallas(packed, interpret=False, best_fit=True)
+    assert calls == ["chunked"]  # chunked not called again
+    assert out is not None
