@@ -342,9 +342,13 @@ def _metric_for(args) -> tuple:
         )
     if args.config == 5:
         return "replay_replan_ms_p50_1k_events", "ms"
+    suffix = "_x%g" % args.scale if args.scale != 1.0 else ""
     if args.config in (3, 4):
-        return "drain_plan_ms_config%d_50kpods_5knodes" % args.config, "ms"
-    return "drain_plan_ms_config%d" % args.config, "ms"
+        return (
+            "drain_plan_ms_config%d_50kpods_5knodes%s" % (args.config, suffix),
+            "ms",
+        )
+    return "drain_plan_ms_config%d%s" % (args.config, suffix), "ms"
 
 
 def main() -> int:
