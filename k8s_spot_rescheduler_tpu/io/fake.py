@@ -60,6 +60,12 @@ class FakeCluster:
         self.pods: Dict[str, PodSpec] = {}  # keyed by namespace/name
         self._by_node: Dict[str, Dict[str, PodSpec]] = {}  # node -> uid -> pod
         self.pdbs: List[PDBSpec] = []
+        # volume topology: claims keyed by uid, volumes by name. Pods are
+        # resolved against these at add_pod (models/volumes.py) — add
+        # PVs/PVCs BEFORE their pods, as a real cluster's bindings
+        # pre-date the running pods the planner moves.
+        self.pvcs: Dict[str, object] = {}
+        self.pvs: Dict[str, object] = {}
         self.events: List[Event] = []
         self.pending: List[PodSpec] = []  # unschedulable (evicted, unplaced)
         # pod uid -> number of eviction calls that must fail first
@@ -112,6 +118,12 @@ class FakeCluster:
 
     def add_pod(self, pod: PodSpec) -> None:
         assert pod.node_name in self.nodes, f"unknown node {pod.node_name}"
+        if pod.pvc_resolvable:
+            from k8s_spot_rescheduler_tpu.models.volumes import (
+                resolve_volume_affinity,
+            )
+
+            pod = resolve_volume_affinity(pod, self.pvcs, self.pvs)
         stale = self.pods.get(pod.uid)
         self.pods[pod.uid] = pod  # dict upsert: position is preserved
         if stale is not None and stale.node_name != pod.node_name:

@@ -98,10 +98,25 @@ def decode_pod(obj: dict) -> PodSpec:
         affinity.get("podAffinity") or {}
     )
     required_affinity = naff_unmodeled or anti_unmodeled or paff_unmodeled
-    has_pvc = any(
-        "persistentVolumeClaim" in (vol or {})
-        for vol in spec.get("volumes", []) or []
-    )
+    # PVC-backed volumes: conservatively unplaceable at decode; the
+    # volume-affinity resolver (models/volumes.py) lifts this when every
+    # claim proves Bound to a modelable PV. Claims whose names are
+    # malformed keep has_pvc set with no resolvable names — never lifted.
+    pvc_names = []
+    has_pvc = False
+    for vol in spec.get("volumes", []) or []:
+        if isinstance(vol, dict) and "persistentVolumeClaim" in vol:
+            # key presence on a dict volume, like ingest.cc's Obj get
+            has_pvc = True
+            claim = vol.get("persistentVolumeClaim")
+            name = claim.get("claimName") if isinstance(claim, dict) else None
+            # sep-byte guard keeps the native blob framing safe, in
+            # lockstep with ingest.cc (malformed -> never resolvable)
+            if isinstance(name, str) and name and not _has_sep_bytes(name):
+                pvc_names.append(name)
+            else:
+                pvc_names = []
+                break
     # Hard topology-spread constraints are scheduling predicates the
     # reference's CheckPredicates enforces (PodTopologySpread plugin,
     # README.md:103-114) but this model does not: ignoring them would
@@ -130,6 +145,10 @@ def decode_pod(obj: dict) -> PodSpec:
         anti_affinity_zone_match=anti_zone_match,
         pod_affinity_match=pod_affinity_match,
         node_affinity=node_affinity,
+        pvc_names=tuple(pvc_names),
+        pvc_resolvable=bool(
+            has_pvc and pvc_names and not (required_affinity or hard_spread)
+        ),
         unmodeled_constraints=bool(required_affinity or has_pvc or hard_spread),
     )
 
@@ -292,6 +311,53 @@ def decode_pod_affinity(paff: dict) -> tuple:
     return match, unmodeled
 
 
+def decode_pvc(obj: dict) -> "PVCSpec":
+    from k8s_spot_rescheduler_tpu.models.cluster import PVCSpec
+
+    meta = obj.get("metadata", {})
+    return PVCSpec(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        volume_name=(obj.get("spec", {}) or {}).get("volumeName", "") or "",
+        phase=(obj.get("status", {}) or {}).get("phase", "") or "",
+    )
+
+
+def decode_pv(obj: dict) -> "PVSpec":
+    """PV node-affinity (spec.nodeAffinity.required is a plain
+    NodeSelector) reuses the pod-side canonicalizer by wrapping it in the
+    requiredDuringScheduling envelope — identical modeled/unmodeled
+    rules, so PV terms can merge straight into pod terms."""
+    from k8s_spot_rescheduler_tpu.models.cluster import PVSpec
+
+    meta = obj.get("metadata", {})
+    naff = (obj.get("spec", {}) or {}).get("nodeAffinity")
+    terms: tuple = ()
+    unmodeled = False
+    if naff is not None:
+        if not isinstance(naff, dict):
+            unmodeled = True
+        else:
+            required = naff.get("required")
+            if required is not None:
+                if not required:
+                    # present-but-empty NodeSelector: the scheduler's
+                    # matcher treats non-nil empty terms as matching NO
+                    # node — resolving it as "no constraint" would be
+                    # the unsafe direction, so: unmodeled
+                    unmodeled = True
+                else:
+                    terms, unmodeled = decode_node_affinity(
+                        {"requiredDuringSchedulingIgnoredDuringExecution":
+                             required}
+                    )
+    return PVSpec(
+        name=meta.get("name", ""),
+        node_affinity=terms,
+        unmodeled=unmodeled,
+    )
+
+
 def decode_node(obj: dict) -> NodeSpec:
     meta = obj.get("metadata", {})
     spec = obj.get("spec", {})
@@ -445,20 +511,67 @@ class KubeClusterClient:
             from k8s_spot_rescheduler_tpu.io import native_ingest
 
             pods = None
+            pvc_hint = None
             if self.use_native_ingest and native_ingest.available():
                 batch = native_ingest.parse_pod_list(
                     self._request_raw("GET", "/api/v1/pods")
                 )
                 if batch is not None:
                     pods = batch.views()
+                    pvc_hint = bool(
+                        (batch.u8[:, 0] & native_ingest.F_PVC).any()
+                    )
             if pods is None:
                 items = self._request("GET", "/api/v1/pods").get("items", [])
                 pods = [decode_pod(obj) for obj in items]
+            pods = self._resolve_volumes(pods, pvc_hint)
             cache: Dict[str, List[PodSpec]] = {}
             for pod in pods:
                 cache.setdefault(pod.node_name, []).append(pod)
             self._pods_cache = cache
         return self._pods_cache
+
+    def _resolve_volumes(self, pods, pvc_hint=None):
+        """Lift PVC-pod conservatism where provable: fetch same-tick
+        PVC/PV LISTs (only when some pod actually carries resolvable
+        claims) and fold bound PVs' nodeAffinity into the pods
+        (models/volumes.py). Any fetch/decode failure leaves the pods as
+        decoded — placeable nowhere, the safe direction. ``pvc_hint``
+        False skips the per-pod scan entirely (the native batch path
+        precomputes it vectorized — 50k lazy property reads per tick
+        would cost real time on the hot path)."""
+        if pvc_hint is False:
+            return pods
+        if not any(getattr(p, "pvc_resolvable", False) for p in pods):
+            return pods
+        from k8s_spot_rescheduler_tpu.models.volumes import (
+            maybe_resolve_view,
+            resolve_volume_affinity,
+        )
+
+        try:
+            pvcs = {
+                (c := decode_pvc(o)).uid: c
+                for o in self._request(
+                    "GET", "/api/v1/persistentvolumeclaims"
+                ).get("items", [])
+            }
+            pvs = {
+                (v := decode_pv(o)).name: v
+                for o in self._request(
+                    "GET", "/api/v1/persistentvolumes"
+                ).get("items", [])
+            }
+        except Exception as err:  # noqa: BLE001 — stay conservative
+            log.error("PVC/PV list failed; volume pods stay unmodeled: %s", err)
+            return pods
+        out = []
+        for pod in pods:
+            if isinstance(pod, PodSpec):
+                out.append(resolve_volume_affinity(pod, pvcs, pvs))
+            else:  # lazy native view: materialize only if it resolves
+                out.append(maybe_resolve_view(pod, pvcs, pvs) or pod)
+        return out
 
     def list_pods_on_node(self, node_name: str) -> List[PodSpec]:
         return list(self._all_pods().get(node_name, []))

@@ -50,11 +50,11 @@ F_PVC, F_REQAFF = 32, 64
 # pod column indices
 P_CPU, P_MEM, P_EPH = 0, 1, 2
 (P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID,
- P_AAFFID, P_NAFFID, P_PAFFID, P_ZAFFID) = range(10)
+ P_AAFFID, P_NAFFID, P_PAFFID, P_ZAFFID, P_PVCID) = range(11)
 PS_NAME, PS_UID = range(2)
 # interned-table families
 (TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_AAFF,
- TBL_NAFF, TBL_PAFF, TBL_ZAFF) = range(9)
+ TBL_NAFF, TBL_PAFF, TBL_ZAFF, TBL_PVC) = range(10)
 # node column indices
 N_CPU, N_MEM, N_EPH, N_PODS = range(4)
 N_READY, N_UNSCHED, N_HASPODS = range(3)
@@ -100,13 +100,13 @@ def _lib() -> Optional[ctypes.CDLL]:
     try:
         ok = (
             lib.pod_ncols_i64() == 3
-            and lib.pod_ncols_i32() == 10
+            and lib.pod_ncols_i32() == 11
             and lib.pod_ncols_u8() == 1
             and lib.pod_ncols_str() == 2
             and lib.node_ncols_i64() == 4
             and lib.node_ncols_u8() == 3
             and lib.node_ncols_str() == 4
-            and lib.table_count() == 9
+            and lib.table_count() == 10
         )
     except AttributeError:
         ok = False
@@ -257,6 +257,9 @@ class PodBatch:
         self.match_sets = [_parse_kv(b) for b in tables[TBL_AAFF]]
         self.paff_sets = [_parse_kv(b) for b in tables[TBL_PAFF]]
         self.zaff_sets = [_parse_kv(b) for b in tables[TBL_ZAFF]]
+        self.pvc_lists = [
+            tuple(b.decode().split(_REC)) if b else () for b in tables[TBL_PVC]
+        ]
         self.naff_sets = [_parse_node_affinity(b) for b in tables[TBL_NAFF]]
 
     def match_set(self, set_id: int) -> Dict[str, str]:
@@ -267,6 +270,9 @@ class PodBatch:
 
     def zaff_set(self, set_id: int) -> Dict[str, str]:
         return self.zaff_sets[set_id]
+
+    def pvc_list(self, set_id: int) -> tuple:
+        return self.pvc_lists[set_id]
 
     def label_set(self, set_id: int) -> Dict[str, str]:
         cached = self._label_sets[set_id]
@@ -389,6 +395,22 @@ class PodView:
         return self._b.zaff_set(int(self._b.i32[self._i, P_ZAFFID]))
 
     @property
+    def pvc_names(self) -> tuple:
+        return self._b.pvc_list(int(self._b.i32[self._i, P_PVCID]))
+
+    @property
+    def pvc_resolvable(self) -> bool:
+        # decode_pod lockstep: claims present with a clean name list and
+        # no other unmodeled constraint (F_REQAFF covers affinity shapes
+        # AND hard spread constraints on the native side)
+        flags = self._b.u8[self._i, 0]
+        return bool(
+            (flags & F_PVC)
+            and self.pvc_names
+            and not (flags & F_REQAFF)
+        )
+
+    @property
     def node_selector(self) -> Dict[str, str]:
         return self._b.selector_set(int(self._b.i32[self._i, P_SELID]))
 
@@ -435,6 +457,8 @@ class PodView:
             node_selector=dict(self.node_selector),
             anti_affinity_match=dict(self.anti_affinity_match),
             anti_affinity_zone_match=dict(self.anti_affinity_zone_match),
+            pvc_names=self.pvc_names,
+            pvc_resolvable=self.pvc_resolvable,
             pod_affinity_match=dict(self.pod_affinity_match),
             node_affinity=self.node_affinity,
             unmodeled_constraints=self.unmodeled_constraints,
@@ -546,7 +570,7 @@ def parse_pod_list(data: bytes) -> Optional[PodBatch]:
     handle = lib.ingest_pods(data, len(data))
     if not handle:
         return None
-    return PodBatch(*_copy_batch(lib, handle, 3, 10, 1, 2, tables=9))
+    return PodBatch(*_copy_batch(lib, handle, 3, 11, 1, 2, tables=10))
 
 
 def parse_node_list(data: bytes) -> Optional[NodeBatch]:

@@ -123,12 +123,23 @@ class PodSpec:
     # pseudo-taint bit per distinct requirement. matchFields and
     # malformed shapes fall back to ``unmodeled_constraints``.
     node_affinity: Tuple = ()
-    # Scheduling constraints this framework does not model (PVC/volume
-    # topology, matchFields node affinity, required pod-affinity).
-    # Conservative in the safe direction: such a pod is treated as
-    # placeable nowhere, so its node can never be proven drainable — we
-    # may miss a drain the real scheduler would allow, but never approve
-    # one that strands the pod.
+    # PersistentVolumeClaim names this pod's volumes reference (the
+    # pod's own namespace). Decode marks such pods unmodeled; the
+    # volume-affinity resolver (models/volumes.py) lifts that when every
+    # claim is Bound to a PV whose nodeAffinity is absent or modelable,
+    # folding the PVs' terms into ``node_affinity``.
+    pvc_names: Tuple = ()
+    # True iff the ONLY reason this pod is unmodeled is its PVCs — the
+    # resolver may clear ``unmodeled_constraints`` exactly then. Keeping
+    # the flag separate keeps every unresolved path fail-safe: a pod
+    # that never meets the resolver stays placeable-nowhere.
+    pvc_resolvable: bool = False
+    # Scheduling constraints this framework does not model (unresolved
+    # volume topology, cross-namespace affinity, hard spread
+    # constraints, ...). Conservative in the safe direction: such a pod
+    # is treated as placeable nowhere, so its node can never be proven
+    # drainable — we may miss a drain the real scheduler would allow,
+    # but never approve one that strands the pod.
     unmodeled_constraints: bool = False
 
     @property
@@ -162,6 +173,32 @@ class NodeSpec:
 
     def allocatable_cpu(self) -> int:
         return int(self.allocatable.get(CPU, 0))
+
+
+@dataclasses.dataclass
+class PVCSpec:
+    """PersistentVolumeClaim, reduced to the binding the volume-affinity
+    resolver needs."""
+
+    name: str
+    namespace: str = "default"
+    volume_name: str = ""  # bound PV name; "" while unbound
+    phase: str = "Bound"
+
+    @property
+    def uid(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclasses.dataclass
+class PVSpec:
+    """PersistentVolume, reduced to its node-affinity constraint
+    (spec.nodeAffinity.required — zonal/local volumes pin their pods to
+    matching nodes; the same canonical terms form as pod nodeAffinity)."""
+
+    name: str
+    node_affinity: Tuple = ()  # canonical terms; () = no constraint
+    unmodeled: bool = False  # affinity shape beyond the canonical form
 
 
 @dataclasses.dataclass

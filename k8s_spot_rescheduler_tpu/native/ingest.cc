@@ -419,6 +419,7 @@ enum {
   TBL_NAFF,  // required node-affinity blobs (see extract_node_affinity)
   TBL_PAFF,  // required POSITIVE pod-affinity matchLabels blobs
   TBL_ZAFF,  // zone-topology anti-affinity matchLabels blobs
+  TBL_PVC,   // PVC claim-name lists (REC_SEP-joined)
   TBL_COUNT,
 };
 
@@ -466,6 +467,7 @@ enum {
   P_NAFFID,
   P_PAFFID,
   P_ZAFFID,
+  P_PVCID,
   P_NI32,
 };
 enum { P_FLAGS = 0, P_NU8 };
@@ -868,6 +870,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     const Val* zone_anti_labels = nullptr;
     const Val* pod_affinity_labels = nullptr;
     std::string naff_blob;
+    std::string pvc_blob;
     if (spec) {
       bool unmodeled = false;
       const Val* affinity = spec->get("affinity");
@@ -891,11 +894,24 @@ Batch* ingest_pods_impl(const char* buf, long n) {
       if (unmodeled) flags |= F_REQAFF;
       if (const Val* vols = spec->get("volumes")) {
         if (vols->kind == Val::Arr) {
+          bool names_ok = true;
           for (const Val* vol : vols->arr) {
-            if (vol && vol->get("persistentVolumeClaim")) {
-              flags |= F_PVC;
-              break;
+            const Val* claim = vol ? vol->get("persistentVolumeClaim") : nullptr;
+            if (!claim) continue;
+            flags |= F_PVC;
+            // claim names feed the volume-affinity resolver; any
+            // malformed (or blob-unsafe) name voids the whole list so
+            // the pod can never be resolved - decode_pod lockstep
+            const Val* cn =
+                claim->kind == Val::Obj ? claim->get("claimName") : nullptr;
+            if (!names_ok || !cn || cn->kind != Val::Str || cn->text.empty() ||
+                has_sep_bytes(cn->text)) {
+              names_ok = false;
+              pvc_blob.clear();
+              continue;
             }
+            if (!pvc_blob.empty()) pvc_blob += REC_SEP;
+            pvc_blob.append(cn->text.data(), cn->text.size());
           }
         }
       }
@@ -953,6 +969,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     tmp.clear();
     blob_kv_into(&tmp, zone_anti_labels);
     i32row(P_ZAFFID) = b->intern_str(TBL_ZAFF, tmp);
+    i32row(P_PVCID) = b->intern_str(TBL_PVC, pvc_blob);
 
     // tolerations: key\x1fvalue\x1foperator\x1feffect\x1e...
     tmp.clear();

@@ -426,6 +426,42 @@ def match_affinity_mask(
     return mask
 
 
+MERGE_TERM_CAP = 16
+
+
+def merge_affinity_terms(*term_sets: Tuple):
+    """AND several canonical required-affinity term sets (each an OR of
+    AND-terms) into one canonical OR-of-ANDs, by distribution:
+    (A1|A2) & (B1|B2) = A1B1 | A1B2 | A2B1 | A2B2. Used to fold bound
+    PersistentVolumes' nodeAffinity into a pod's own requirement
+    (models/volumes.py) so the result flows through the existing
+    NodeAffinityBit machinery unchanged.
+
+    An empty set means "no constraint" (identity). Returns None when the
+    distributed product exceeds MERGE_TERM_CAP terms — the caller treats
+    the pod as conservatively unmodeled rather than interning a huge
+    requirement."""
+    merged: Tuple = ()
+    for terms in term_sets:
+        if not terms:
+            continue
+        if not merged:
+            merged = terms
+            continue
+        if len(merged) * len(terms) > MERGE_TERM_CAP:
+            return None
+        merged = tuple(
+            sorted(
+                {
+                    tuple(sorted(set(a) | set(b)))
+                    for a in merged
+                    for b in terms
+                }
+            )
+        )
+    return merged
+
+
 # --- zone-topology anti-affinity (static, zone-salted group bits) ---------
 #
 # Required anti-affinity with topologyKey=topology.kubernetes.io/zone uses

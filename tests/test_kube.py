@@ -57,6 +57,8 @@ class StubApiserver:
     def __init__(self):
         self.nodes = {}
         self.pods = {}
+        self.pvcs = {}
+        self.pvs = {}
         self.patches = []
         self.evictions = []
         self.events = []
@@ -85,6 +87,10 @@ class StubApiserver:
                     return self._send({"items": list(stub.pods.values())})
                 if path == "/apis/policy/v1/poddisruptionbudgets":
                     return self._send({"items": []})
+                if path == "/api/v1/persistentvolumeclaims":
+                    return self._send({"items": list(stub.pvcs.values())})
+                if path == "/api/v1/persistentvolumes":
+                    return self._send({"items": list(stub.pvs.values())})
                 if path.startswith("/api/v1/namespaces/") and "/pods/" in path:
                     name = path.rsplit("/", 1)[1]
                     for key, pod in stub.pods.items():
@@ -236,3 +242,53 @@ def test_taint_patch_uses_merge_patch(stub):
     client.add_taint("od-1", Taint("ToBeDeletedByClusterAutoscaler", "", "NoSchedule"))
     client.remove_taint("od-1", "ToBeDeletedByClusterAutoscaler")
     assert stub.nodes["od-1"]["spec"]["taints"] == []
+
+
+def test_volume_affinity_resolved_over_http(stub):
+    """A PVC pod bound to a zonal PV resolves through the polling
+    client's same-tick PVC/PV LISTs and drains into the volume's zone
+    (models/volumes.py); an unresolvable claim stays unplaceable."""
+    stub.nodes["od-1"] = _node("od-1", "worker")
+    spot_a = _node("spot-a", "spot-worker")
+    spot_a["metadata"]["labels"]["zone"] = "a"
+    spot_b = _node("spot-b", "spot-worker")
+    spot_b["metadata"]["labels"]["zone"] = "b"
+    stub.nodes["spot-a"] = spot_a
+    stub.nodes["spot-b"] = spot_b
+    pod = _pod("web", "od-1", cpu="300m")
+    pod["spec"]["volumes"] = [
+        {"persistentVolumeClaim": {"claimName": "data"}}
+    ]
+    stub.pods["web"] = pod
+    stub.pvcs["data"] = {
+        "metadata": {"name": "data", "namespace": "default"},
+        "spec": {"volumeName": "pv-1"},
+        "status": {"phase": "Bound"},
+    }
+    stub.pvs["pv-1"] = {
+        "metadata": {"name": "pv-1"},
+        "spec": {"nodeAffinity": {"required": {"nodeSelectorTerms": [
+            {"matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["a"]}]}]}}},
+    }
+
+    client = KubeClusterClient(stub.url)
+    config = ReschedulerConfig(pod_eviction_timeout=5.0, eviction_retry_time=1.0)
+    r = Rescheduler(
+        client, SolverPlanner(config), config, clock=FakeClock(), recorder=client
+    )
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    assert stub.evictions == ["web"]
+
+    # now break the binding: the pod must become unplaceable again
+    stub.evictions.clear()
+    stub.pvcs["data"]["spec"]["volumeName"] = ""
+    client.refresh()
+    client._pods_cache = None
+    r2 = Rescheduler(
+        client, SolverPlanner(config), config, clock=FakeClock(), recorder=client
+    )
+    result = r2.tick()
+    assert result.drained == []
+    assert stub.evictions == []

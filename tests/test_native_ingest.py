@@ -128,6 +128,10 @@ def _assert_pod_parity(objs):
         assert got.anti_affinity_zone_match == want.anti_affinity_zone_match, (
             f"pod {i} zone-anti-affinity"
         )
+        assert tuple(got.pvc_names) == tuple(want.pvc_names), f"pod {i} pvcs"
+        assert got.pvc_resolvable == want.pvc_resolvable, (
+            f"pod {i} pvc_resolvable"
+        )
         assert got.node_affinity == want.node_affinity, f"pod {i} node-aff"
         assert got.unmodeled_constraints == want.unmodeled_constraints, (
             f"pod {i} unmodeled"
@@ -206,6 +210,34 @@ def _naff(terms):
     return {"nodeAffinity": {
         "requiredDuringSchedulingIgnoredDuringExecution": {
             "nodeSelectorTerms": terms}}}
+
+
+def test_pvc_shapes():
+    def vol_pod(name, volumes):
+        return _pod_obj(metadata={"name": name, "namespace": "ns1"},
+                        spec={"nodeName": "n1", "containers": [],
+                              "volumes": volumes})
+
+    objs = [
+        # clean claim list -> resolvable
+        vol_pod("v1", [{"persistentVolumeClaim": {"claimName": "data"}},
+                       {"configMap": {"name": "cm"}},
+                       {"persistentVolumeClaim": {"claimName": "logs"}}]),
+        # missing claimName voids the whole list
+        vol_pod("v2", [{"persistentVolumeClaim": {"claimName": "ok"}},
+                       {"persistentVolumeClaim": {}}]),
+        # null claim value still counts as a PVC volume (key presence)
+        vol_pod("v3", [{"persistentVolumeClaim": None}]),
+        # empty name voids
+        vol_pod("v4", [{"persistentVolumeClaim": {"claimName": ""}}]),
+        # separator byte in a name voids (blob framing safety)
+        vol_pod("v5", [{"persistentVolumeClaim":
+                        {"claimName": "bad\u001ename"}}]),
+        # no volumes at all
+        vol_pod("v6", None),
+        vol_pod("v7", []),
+    ]
+    _assert_pod_parity(objs)
 
 
 def test_topology_spread_shapes():

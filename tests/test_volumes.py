@@ -1,0 +1,273 @@
+"""Volume topology: PVC -> PV -> node-affinity resolution.
+
+The reference inherits volume predicates from the scheduler
+(CheckPredicates; reference README.md:103-114). Here, decode marks every
+PVC pod conservatively unplaceable and models/volumes.py LIFTS that only
+when every claim proves Bound to a PV whose nodeAffinity is absent or in
+the canonical form — the PV terms then merge into the pod's own
+requirement by distribution (masks.merge_affinity_terms) and ride the
+NodeAffinityBit machinery end to end.
+"""
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.io.kube import decode_pod, decode_pv, decode_pvc
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.models.cluster import PVCSpec, PVSpec, build_node_map
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.models.volumes import resolve_volume_affinity
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.predicates.masks import merge_affinity_terms
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+ZONE_A = ((("zone", "In", ("a",)),),)
+ZONE_B = ((("zone", "In", ("b",)),),)
+
+
+# --- term merging ----------------------------------------------------------
+
+def test_merge_identity_and_single():
+    assert merge_affinity_terms() == ()
+    assert merge_affinity_terms((), ZONE_A, ()) == ZONE_A
+
+
+def test_merge_distributes_and_of_ors():
+    left = ((("a", "In", ("1",)),), (("b", "In", ("2",)),))
+    right = ((("c", "Exists", ()),),)
+    merged = merge_affinity_terms(left, right)
+    assert merged == (
+        (("a", "In", ("1",)), ("c", "Exists", ())),
+        (("b", "In", ("2",)), ("c", "Exists", ())),
+    )
+
+
+def test_merge_dedupes_shared_exprs():
+    merged = merge_affinity_terms(ZONE_A, ZONE_A)
+    assert merged == ZONE_A
+
+
+def test_merge_caps_blowup():
+    many = tuple(((f"k{i}", "Exists", ()),) for i in range(5))
+    assert merge_affinity_terms(many, many) is None  # 25 > cap 16
+
+
+# --- decode ----------------------------------------------------------------
+
+def test_decode_pvc():
+    c = decode_pvc({
+        "metadata": {"name": "data", "namespace": "ns1"},
+        "spec": {"volumeName": "pv-7"},
+        "status": {"phase": "Bound"},
+    })
+    assert (c.uid, c.volume_name, c.phase) == ("ns1/data", "pv-7", "Bound")
+
+
+def test_decode_pv_affinity_shapes():
+    pv = decode_pv({
+        "metadata": {"name": "pv-7"},
+        "spec": {"nodeAffinity": {"required": {"nodeSelectorTerms": [
+            {"matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["a"]}]}]}}},
+    })
+    assert pv.node_affinity == ZONE_A and not pv.unmodeled
+    # no affinity at all: unconstrained
+    pv = decode_pv({"metadata": {"name": "pv-8"}, "spec": {}})
+    assert pv.node_affinity == () and not pv.unmodeled
+    # present-but-empty required NodeSelector matches NO node in the
+    # scheduler's matcher — resolving it as unconstrained would be the
+    # unsafe direction (review regression)
+    pv = decode_pv({"metadata": {"name": "pv-e"},
+                    "spec": {"nodeAffinity": {"required": {}}}})
+    assert pv.unmodeled
+    pv = decode_pv({"metadata": {"name": "pv-e2"},
+                    "spec": {"nodeAffinity": {"required": []}}})
+    assert pv.unmodeled
+    # malformed affinity: unmodeled
+    pv = decode_pv({
+        "metadata": {"name": "pv-9"},
+        "spec": {"nodeAffinity": {"required": {"nodeSelectorTerms": [
+            {"matchFields": [
+                {"key": "metadata.uid", "operator": "In", "values": ["x"]}]}
+        ]}}},
+    })
+    assert pv.unmodeled
+
+
+def _pod_obj(volumes):
+    return {
+        "metadata": {"name": "p", "namespace": "ns1"},
+        "spec": {"nodeName": "n1", "containers": [], "volumes": volumes},
+        "status": {"phase": "Running"},
+    }
+
+
+def test_decode_pod_pvc_names():
+    pod = decode_pod(_pod_obj([
+        {"persistentVolumeClaim": {"claimName": "data"}},
+        {"configMap": {"name": "cm"}},
+        {"persistentVolumeClaim": {"claimName": "logs"}},
+    ]))
+    assert pod.pvc_names == ("data", "logs")
+    assert pod.unmodeled_constraints  # conservative until resolved
+    assert pod.pvc_resolvable
+
+
+def test_decode_pod_malformed_claim_never_resolvable():
+    pod = decode_pod(_pod_obj([
+        {"persistentVolumeClaim": {"claimName": "ok"}},
+        {"persistentVolumeClaim": {}},
+    ]))
+    assert pod.pvc_names == ()
+    assert pod.unmodeled_constraints and not pod.pvc_resolvable
+
+
+def test_decode_pod_pvc_plus_unmodeled_affinity_not_resolvable():
+    obj = _pod_obj([{"persistentVolumeClaim": {"claimName": "data"}}])
+    obj["spec"]["affinity"] = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"topologyKey": "rack", "labelSelector": {"matchLabels": {"a": "1"}}}]}}
+    pod = decode_pod(obj)
+    assert pod.unmodeled_constraints and not pod.pvc_resolvable
+
+
+# --- resolution ------------------------------------------------------------
+
+def _pvc_pod(**kw):
+    return make_pod(
+        "web", 300, "od-1", namespace="ns1",
+        pvc_names=("data",), pvc_resolvable=True,
+        unmodeled_constraints=True, **kw,
+    )
+
+
+def test_resolution_folds_pv_affinity():
+    pod = _pvc_pod()
+    out = resolve_volume_affinity(
+        pod,
+        {"ns1/data": PVCSpec("data", "ns1", volume_name="pv-1")},
+        {"pv-1": PVSpec("pv-1", node_affinity=ZONE_A)},
+    )
+    assert out.node_affinity == ZONE_A
+    assert not out.unmodeled_constraints and not out.pvc_resolvable
+
+
+def test_resolution_merges_with_own_affinity():
+    pod = _pvc_pod(node_affinity=((("arch", "Exists", ()),),))
+    out = resolve_volume_affinity(
+        pod,
+        {"ns1/data": PVCSpec("data", "ns1", volume_name="pv-1")},
+        {"pv-1": PVSpec("pv-1", node_affinity=ZONE_A)},
+    )
+    assert out.node_affinity == (
+        (("arch", "Exists", ()), ("zone", "In", ("a",))),
+    )
+
+
+def test_resolution_fail_safe_paths():
+    pod = _pvc_pod()
+    # unbound claim
+    out = resolve_volume_affinity(
+        pod, {"ns1/data": PVCSpec("data", "ns1", volume_name="")}, {}
+    )
+    assert out is pod
+    # missing PV
+    out = resolve_volume_affinity(
+        pod, {"ns1/data": PVCSpec("data", "ns1", volume_name="pv-x")}, {}
+    )
+    assert out is pod
+    # unmodeled PV affinity
+    out = resolve_volume_affinity(
+        pod,
+        {"ns1/data": PVCSpec("data", "ns1", volume_name="pv-1")},
+        {"pv-1": PVSpec("pv-1", unmodeled=True)},
+    )
+    assert out is pod
+    # wrong namespace claim does not match
+    out = resolve_volume_affinity(
+        pod, {"other/data": PVCSpec("data", "other", volume_name="pv-1")},
+        {"pv-1": PVSpec("pv-1")},
+    )
+    assert out is pod
+
+
+def test_resolution_no_affinity_pv_just_lifts():
+    pod = _pvc_pod()
+    out = resolve_volume_affinity(
+        pod,
+        {"ns1/data": PVCSpec("data", "ns1", volume_name="pv-1")},
+        {"pv-1": PVSpec("pv-1")},
+    )
+    assert out.node_affinity == ()
+    assert not out.unmodeled_constraints
+
+
+# --- end to end ------------------------------------------------------------
+
+def _cluster():
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.pvs["pv-1"] = PVSpec("pv-1", node_affinity=ZONE_A)
+    fc.pvcs["default/data"] = PVCSpec("data", "default", volume_name="pv-1")
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a", dict(SPOT_LABELS, zone="a")))
+    fc.add_node(make_node("spot-b", dict(SPOT_LABELS, zone="b")))
+    fc.add_pod(make_pod("web", 300, "od-1", pvc_names=("data",),
+                        pvc_resolvable=True, unmodeled_constraints=True))
+    return fc
+
+
+def test_drain_places_pvc_pod_in_volume_zone():
+    fc = _cluster()
+    cfg = ReschedulerConfig(solver="numpy", node_drain_delay=0.0)
+    r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=fc.clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    fc.clock.advance(10.0)
+    assert fc.pods["default/web"].node_name == "spot-a"
+
+
+def test_unresolvable_pvc_pod_blocks_drain():
+    fc = _cluster()
+    fc.add_pod(make_pod("stuck", 100, "od-1", pvc_names=("ghost",),
+                        pvc_resolvable=True, unmodeled_constraints=True))
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    packed, _ = pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+    assert not plan_oracle(packed).feasible[:1].any()
+
+
+def test_columnar_parity_with_pvc_pods():
+    fc = _cluster()
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
