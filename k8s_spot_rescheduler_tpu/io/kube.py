@@ -531,6 +531,25 @@ class KubeClusterClient:
             self._pods_cache = cache
         return self._pods_cache
 
+    def list_volume_snapshots(self):
+        """(pvc-by-uid, pv-by-name) decoded from cluster-wide LISTs —
+        shared by this client's polling path and the watch-mode client's
+        per-tick retry. Raises on HTTP/decode failure; callers stay
+        conservative."""
+        pvcs = {
+            (c := decode_pvc(o)).uid: c
+            for o in self._request(
+                "GET", "/api/v1/persistentvolumeclaims"
+            ).get("items", [])
+        }
+        pvs = {
+            (v := decode_pv(o)).name: v
+            for o in self._request(
+                "GET", "/api/v1/persistentvolumes"
+            ).get("items", [])
+        }
+        return pvcs, pvs
+
     def _resolve_volumes(self, pods, pvc_hint=None):
         """Lift PVC-pod conservatism where provable: fetch same-tick
         PVC/PV LISTs (only when some pod actually carries resolvable
@@ -550,18 +569,7 @@ class KubeClusterClient:
         )
 
         try:
-            pvcs = {
-                (c := decode_pvc(o)).uid: c
-                for o in self._request(
-                    "GET", "/api/v1/persistentvolumeclaims"
-                ).get("items", [])
-            }
-            pvs = {
-                (v := decode_pv(o)).name: v
-                for o in self._request(
-                    "GET", "/api/v1/persistentvolumes"
-                ).get("items", [])
-            }
+            pvcs, pvs = self.list_volume_snapshots()
         except Exception as err:  # noqa: BLE001 — stay conservative
             log.error("PVC/PV list failed; volume pods stay unmodeled: %s", err)
             return pods

@@ -91,6 +91,24 @@ class ResourceStore:
         with self._lock:
             return list(self._items.values())
 
+    def snapshot_items(self) -> List[tuple]:
+        """(key, obj) pairs — for callers that must upsert back under the
+        SAME key (watch keys are apiserver UIDs, not ns/name)."""
+        with self._lock:
+            return list(self._items.items())
+
+    def replace_if_same(self, key: str, old: object, new: object) -> bool:
+        """Upsert ``new`` only if ``key`` still maps to ``old`` — the
+        compare-and-swap for read-resolve-writeback callers racing the
+        watcher thread (a concurrent MODIFIED/DELETED wins)."""
+        with self._lock:
+            if self._items.get(key) is not old:
+                return False
+            self._items[key] = new
+            if self._listener is not None:
+                self._listener("upsert", key, new)
+            return True
+
     @property
     def lock(self) -> threading.Lock:
         """The store's mutation lock — for multi-store atomic freezes."""
@@ -346,10 +364,17 @@ class WatchingKubeClusterClient:
         self.nodes = ResourceStore()
         self.pods = ResourceStore()
         self.pdbs = ResourceStore()
+        # PVC/PV snapshots for volume-affinity resolution
+        # (models/volumes.py): seeded before the pod watcher starts (a
+        # running pod's binding pre-dates it) and refreshed per tick
+        # while unresolved claims remain. Resolution failures leave pods
+        # conservatively unplaceable.
+        self._pvcs: Dict[str, object] = {}
+        self._pvs: Dict[str, object] = {}
         self._watchers = [
             Watcher(client, "/api/v1/nodes", decode_node,
                     self._meta_key, self.nodes, name="nodes"),
-            Watcher(client, "/api/v1/pods", decode_pod,
+            Watcher(client, "/api/v1/pods", self._decode_pod_resolved,
                     self._meta_key, self.pods, name="pods"),
             Watcher(client, "/apis/policy/v1/poddisruptionbudgets",
                     decode_pdb, self._meta_key, self.pdbs, name="pdbs"),
@@ -402,12 +427,66 @@ class WatchingKubeClusterClient:
             meta.get("namespace", "") + "/" + meta.get("name", "")
         )
 
+    # --- volume-affinity resolution ---
+
+    def _decode_pod_resolved(self, obj: dict):
+        from k8s_spot_rescheduler_tpu.models.volumes import (
+            resolve_volume_affinity,
+        )
+
+        pod = decode_pod(obj)
+        if pod.pvc_resolvable:
+            pod = resolve_volume_affinity(pod, self._pvcs, self._pvs)
+        return pod
+
+    def _refresh_volumes(self, force: bool = False) -> None:
+        """Refetch the PVC/PV snapshots (cheap LISTs — these objects are
+        few relative to pods) and re-resolve any still-unresolved PVC
+        pods in the store. Skipped entirely while no pod carries
+        resolvable claims; any failure keeps the old snapshot (pods stay
+        conservatively unplaceable)."""
+        import dataclasses
+
+        from k8s_spot_rescheduler_tpu.models.cluster import PodSpec
+        from k8s_spot_rescheduler_tpu.models.volumes import (
+            resolve_volume_affinity,
+            terminally_unresolvable,
+        )
+
+        unresolved = [
+            (key, p) for key, p in self.pods.snapshot_items()
+            if getattr(p, "pvc_resolvable", False)
+        ]
+        if not unresolved and not force:
+            return
+        try:
+            self._pvcs, self._pvs = self.client.list_volume_snapshots()
+        except Exception as err:  # noqa: BLE001 — stay conservative
+            log.error("PVC/PV list failed; volume pods stay unmodeled: %s", err)
+            return
+        for key, pod in unresolved:
+            spec = pod if isinstance(pod, PodSpec) else pod.to_pod_spec()
+            resolved = resolve_volume_affinity(spec, self._pvcs, self._pvs)
+            if resolved is spec:
+                if terminally_unresolvable(spec, self._pvcs, self._pvs):
+                    # PV affinity is immutable: stop re-LISTing volumes
+                    # for this pod every tick; it stays unmodeled
+                    resolved = dataclasses.replace(spec, pvc_resolvable=False)
+                else:
+                    continue  # binding may still appear: retry next tick
+            # writeback races the watcher thread: a concurrent MODIFIED/
+            # DELETED event must win over this stale-read resolution
+            self.pods.replace_if_same(key, pod, resolved)
+
     # --- lifecycle ---
 
     def start(self, timeout: Optional[float] = 30.0) -> None:
         """Start the watchers and block until every store has synced its
         initial LIST — the reference likewise waits for informer cache
         sync before the loop's first tick."""
+        # seed the PVC/PV maps BEFORE the pod watcher so JSON watch
+        # events decode resolved from the first pod...
+        self._refresh_volumes(force=True)
         for w in self._watchers:
             w.start()
         for w in self._watchers:
@@ -416,6 +495,9 @@ class WatchingKubeClusterClient:
                     f"watch cache for {w.resource} failed to sync "
                     f"within {timeout}s"
                 )
+        # ...and resolve again AFTER the seed sync: the native bulk
+        # relist path emits lazy views that bypass the decode hook
+        self._refresh_volumes()
 
     def stop(self) -> None:
         for w in self._watchers:
@@ -426,7 +508,10 @@ class WatchingKubeClusterClient:
     def refresh(self) -> None:
         """Drop the frozen view so the next read re-freezes from the live
         stores — called by the control loop before a mid-tick re-observe
-        (multi-drain re-plan), mirroring KubeClusterClient.refresh()."""
+        (multi-drain re-plan), mirroring KubeClusterClient.refresh().
+        Also the per-tick hook where unresolved PVC pods retry against a
+        fresh PVC/PV snapshot (no-op while none exist)."""
+        self._refresh_volumes()
         self._have_tick_view = False
 
     def _freeze(self) -> None:
@@ -454,7 +539,10 @@ class WatchingKubeClusterClient:
     # --- read path (lister equivalents) ---
 
     def list_unschedulable_pods(self) -> List[PodSpec]:
-        # first read of every tick: refresh the frozen view
+        # first read of every tick: retry any unresolved PVC pods
+        # against a fresh PVC/PV snapshot (no-op while none exist),
+        # then refresh the frozen view
+        self._refresh_volumes()
         self._freeze()
         return [
             p for p in self._pods_by_node.get("", [])

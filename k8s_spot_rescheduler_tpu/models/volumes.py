@@ -17,11 +17,11 @@ module is the step that LIFTS that conservatism when it can prove more:
   blow-up) leaves the pod exactly as decode made it: placeable nowhere.
 
 Resolution happens where pods enter the model — the polling kube client
-decorates its LIST results using same-tick PVC/PV LISTs, and the fake
-cluster decorates at add_pod (bindings are immutable for running pods,
-which are the only pods the planner ever moves). The watch-mode client
-does not resolve yet: its PVC pods simply stay conservatively
-unplaceable, never the unsafe direction.
+decorates its LIST results using same-tick PVC/PV LISTs, the fake
+cluster decorates at add_pod, and the watch-mode client resolves at
+event decode plus a per-tick retry for late bindings
+(io/watch.WatchingKubeClusterClient._refresh_volumes). Bindings are
+immutable for running pods — the only pods the planner ever moves.
 """
 
 from __future__ import annotations
@@ -73,3 +73,19 @@ def maybe_resolve_view(pod, pvc_map, pv_map) -> Optional[PodSpec]:
     spec = pod.to_pod_spec()
     resolved = resolve_volume_affinity(spec, pvc_map, pv_map)
     return resolved if resolved is not spec else None
+
+
+def terminally_unresolvable(pod: PodSpec, pvcs, pvs) -> bool:
+    """True when resolution failed for a reason that can never clear:
+    every claim is Bound to a PRESENT PV, yet resolution still declined
+    (an unmodeled PV affinity shape, or term blow-up). PV affinity is
+    immutable, so retrying such a pod re-LISTs the cluster's volumes
+    every tick for zero possible progress — the watch client flips its
+    ``pvc_resolvable`` off instead (staying unmodeled: conservative)."""
+    for claim in pod.pvc_names:
+        pvc = pvcs.get(f"{pod.namespace}/{claim}")
+        if pvc is None or pvc.phase != "Bound" or not pvc.volume_name:
+            return False  # binding may still happen: keep retrying
+        if pvs.get(pvc.volume_name) is None:
+            return False  # PV may still appear
+    return True

@@ -76,6 +76,8 @@ class StreamingStub:
 
     def __init__(self):
         self.objects = {"nodes": {}, "pods": {}, "pdbs": {}}
+        self.pvcs = {}
+        self.pvs = {}
         self.rv = {"nodes": 10, "pods": 10, "pdbs": 10}
         self.queues = {r: queue.Queue() for r in self.rv}
         # one-shot injected watch failures: resource -> status object
@@ -139,6 +141,10 @@ class StreamingStub:
                         "metadata": {"resourceVersion": str(stub.rv[resource])},
                         "items": list(stub.objects[resource].values()),
                     })
+                if parsed.path == "/api/v1/persistentvolumeclaims":
+                    return self._send({"items": list(stub.pvcs.values())})
+                if parsed.path == "/api/v1/persistentvolumes":
+                    return self._send({"items": list(stub.pvs.values())})
                 if parsed.path.startswith("/api/v1/namespaces/") and \
                         "/pods/" in parsed.path:
                     name = parsed.path.rsplit("/", 1)[1]
@@ -527,3 +533,97 @@ def test_full_tick_served_from_watch_cache(watching):
     assert keys_seq[-1] == []
     # reads were served from the caches: exactly the seeding LISTs
     assert stub.list_count == {"nodes": 1, "pods": 1, "pdbs": 1}
+
+
+def test_volume_affinity_resolves_in_watch_mode(stub):
+    """PVC pods resolve against the PVC/PV snapshot seeded before the
+    pod watcher starts, and a claim arriving LATE resolves on the next
+    tick's refresh — never the unsafe direction in between."""
+    stub.pvcs["data"] = {
+        "metadata": {"name": "data", "namespace": "default"},
+        "spec": {"volumeName": "pv-1"},
+        "status": {"phase": "Bound"},
+    }
+    stub.pvs["pv-1"] = {
+        "metadata": {"name": "pv-1"},
+        "spec": {"nodeAffinity": {"required": {"nodeSelectorTerms": [
+            {"matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["a"]}]}]}}},
+    }
+    pod = _pod("web", "od-1")
+    pod["spec"]["volumes"] = [{"persistentVolumeClaim": {"claimName": "data"}}]
+    stub.objects["pods"]["web"] = pod
+    stub.objects["nodes"]["od-1"] = _node("od-1", "worker")
+
+    from k8s_spot_rescheduler_tpu.io.kube import KubeClusterClient
+    from k8s_spot_rescheduler_tpu.io.watch import WatchingKubeClusterClient
+
+    client = WatchingKubeClusterClient(KubeClusterClient(stub.url))
+    client.start(timeout=10.0)
+    try:
+        [resolved] = [p for p in client.pods.snapshot() if p.name == "web"]
+        assert not resolved.unmodeled_constraints
+        assert resolved.node_affinity == ((("zone", "In", ("a",)),),)
+
+        # a SECOND pvc pod arrives whose claim is not yet listed: it
+        # stays conservatively unplaceable...
+        late = _pod("late", "od-1")
+        late["spec"]["volumes"] = [
+            {"persistentVolumeClaim": {"claimName": "late-data"}}
+        ]
+        stub.push("pods", "ADDED", late)
+        _wait(lambda: any(p.name == "late" for p in client.pods.snapshot()))
+        [lp] = [p for p in client.pods.snapshot() if p.name == "late"]
+        assert lp.unmodeled_constraints and lp.pvc_resolvable
+
+        # ...until the claim+volume appear and the next tick refreshes
+        stub.pvcs["late-data"] = {
+            "metadata": {"name": "late-data", "namespace": "default"},
+            "spec": {"volumeName": "pv-2"},
+            "status": {"phase": "Bound"},
+        }
+        stub.pvs["pv-2"] = {"metadata": {"name": "pv-2"}, "spec": {}}
+        # the genuine per-tick entry (the loop's first read each tick)
+        client.refresh()
+        client.list_unschedulable_pods()
+        [lp] = [p for p in client.pods.snapshot() if p.name == "late"]
+        assert not lp.unmodeled_constraints
+    finally:
+        client.stop()
+
+
+def test_terminally_unresolvable_pvc_stops_retrying(stub):
+    """A claim Bound to a PV with an unmodeled affinity shape can never
+    resolve (PV affinity is immutable): the pod stays unmodeled and the
+    per-tick retry stops re-LISTing the cluster's volumes for it."""
+    stub.pvcs["data"] = {
+        "metadata": {"name": "data", "namespace": "default"},
+        "spec": {"volumeName": "pv-1"},
+        "status": {"phase": "Bound"},
+    }
+    stub.pvs["pv-1"] = {
+        "metadata": {"name": "pv-1"},
+        "spec": {"nodeAffinity": {"required": {"nodeSelectorTerms": [
+            {"matchFields": [{"key": "metadata.uid", "operator": "In",
+                              "values": ["x"]}]}]}}},
+    }
+    pod = _pod("web", "od-1")
+    pod["spec"]["volumes"] = [{"persistentVolumeClaim": {"claimName": "data"}}]
+    stub.objects["pods"]["web"] = pod
+    stub.objects["nodes"]["od-1"] = _node("od-1", "worker")
+
+    from k8s_spot_rescheduler_tpu.io.kube import KubeClusterClient
+    from k8s_spot_rescheduler_tpu.io.watch import WatchingKubeClusterClient
+
+    client = WatchingKubeClusterClient(KubeClusterClient(stub.url))
+    client.start(timeout=10.0)
+    try:
+        [p] = [p for p in client.pods.snapshot() if p.name == "web"]
+        assert p.unmodeled_constraints and not p.pvc_resolvable
+        # with nothing retryable, further ticks skip the volume LISTs
+        client.refresh()
+        client.list_unschedulable_pods()
+        [p] = [p for p in client.pods.snapshot() if p.name == "web"]
+        assert p.unmodeled_constraints and not p.pvc_resolvable
+    finally:
+        client.stop()
