@@ -147,6 +147,10 @@ class Watcher(threading.Thread):
         # observability for tests and debugging
         self.relist_count = 0
         self.event_count = 0
+        # invoked after every successful re-LIST (seed or 410 recovery);
+        # the watching client uses it to re-arm scans that full store
+        # replacement could invalidate (e.g. unresolved-PVC tracking)
+        self.on_relist: Optional[Callable[[], None]] = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -195,6 +199,8 @@ class Watcher(threading.Thread):
             rv = (obj.get("metadata", {}) or {}).get("resourceVersion", "")
         self.store.replace(items)
         self.relist_count += 1
+        if self.on_relist is not None:
+            self.on_relist()
         log.vlog(
             3, "watch %s: listed %d items at rv=%s",
             self.resource, len(items), rv,
@@ -371,6 +377,13 @@ class WatchingKubeClusterClient:
         # conservatively unplaceable.
         self._pvcs: Dict[str, object] = {}
         self._pvs: Dict[str, object] = {}
+        # re-scan the pod store for unresolved PVC pods only when
+        # something could have produced one: the decode hook saw an
+        # unresolved pod, or a re-LIST replaced the store wholesale
+        # (the native bulk path bypasses the hook). Keeps the per-tick
+        # _refresh_volumes a pure no-op for clusters without claims —
+        # a 50k-pod python scan per tick would cost real time.
+        self._vol_scan_needed = True
         self._watchers = [
             Watcher(client, "/api/v1/nodes", decode_node,
                     self._meta_key, self.nodes, name="nodes"),
@@ -379,6 +392,7 @@ class WatchingKubeClusterClient:
             Watcher(client, "/apis/policy/v1/poddisruptionbudgets",
                     decode_pdb, self._meta_key, self.pdbs, name="pdbs"),
         ]
+        self._watchers[1].on_relist = self._arm_volume_scan
         # per-tick frozen view: node_name -> pods
         self._pods_by_node: Dict[str, List[PodSpec]] = {}
         self._tick_nodes: List[NodeSpec] = []
@@ -437,7 +451,12 @@ class WatchingKubeClusterClient:
         pod = decode_pod(obj)
         if pod.pvc_resolvable:
             pod = resolve_volume_affinity(pod, self._pvcs, self._pvs)
+            if pod.pvc_resolvable:  # still unresolved: retry per tick
+                self._vol_scan_needed = True
         return pod
+
+    def _arm_volume_scan(self) -> None:
+        self._vol_scan_needed = True
 
     def _refresh_volumes(self, force: bool = False) -> None:
         """Refetch the PVC/PV snapshots (cheap LISTs — these objects are
@@ -453,12 +472,16 @@ class WatchingKubeClusterClient:
             terminally_unresolvable,
         )
 
+        if not self._vol_scan_needed and not force:
+            return
         unresolved = [
             (key, p) for key, p in self.pods.snapshot_items()
             if getattr(p, "pvc_resolvable", False)
         ]
-        if not unresolved and not force:
-            return
+        if not unresolved:
+            self._vol_scan_needed = False
+            if not force:
+                return
         try:
             self._pvcs, self._pvs = self.client.list_volume_snapshots()
         except Exception as err:  # noqa: BLE001 — stay conservative
@@ -477,6 +500,11 @@ class WatchingKubeClusterClient:
             # writeback races the watcher thread: a concurrent MODIFIED/
             # DELETED event must win over this stale-read resolution
             self.pods.replace_if_same(key, pod, resolved)
+        # retry only while a non-terminal unresolved pod remains
+        self._vol_scan_needed = any(
+            getattr(p, "pvc_resolvable", False)
+            for p in self.pods.snapshot()
+        )
 
     # --- lifecycle ---
 

@@ -16,6 +16,7 @@ could have made one.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
@@ -26,8 +27,6 @@ def _cond_solve(need, solve_thunk, like: SolveResult) -> SolveResult:
     (best-fit, repair) are only CONSUMED for lanes the preceding pass
     failed, so a tick where everything already proved skips their cost
     at runtime — identical results either way."""
-    import jax
-
     return jax.lax.cond(
         need,
         solve_thunk,

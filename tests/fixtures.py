@@ -64,3 +64,20 @@ def make_node(
         allocatable={CPU: cpu_millis, MEMORY: memory, PODS: max_pods},
         **kwargs,
     )
+
+
+def pack_fake(fc, resources=("cpu", "memory"), **kw):
+    """Pack a FakeCluster through the object path (build_node_map +
+    pack_cluster) with the standard labels — the boilerplate every
+    predicate test suite needs."""
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    return pack_cluster(node_map, fc.pdbs, resources=resources, **kw)

@@ -16,13 +16,12 @@ import numpy as np
 from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
 from k8s_spot_rescheduler_tpu.io.kube import decode_pod
 from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
-from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
-from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
 from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
 from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from tests.fixtures import (
+    pack_fake,
     ON_DEMAND_LABEL,
     ON_DEMAND_LABELS,
     SPOT_LABEL,
@@ -100,14 +99,7 @@ def _cluster(*, match_on="spot-with-db"):
 
 
 def _pack(fc):
-    nodes = fc.list_ready_nodes()
-    node_map = build_node_map(
-        nodes,
-        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
-        on_demand_label=ON_DEMAND_LABEL,
-        spot_label=SPOT_LABEL,
-    )
-    return pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+    return pack_fake(fc)
 
 
 def test_affinity_pod_placed_only_where_match_resides():

@@ -14,15 +14,15 @@ import numpy as np
 from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
 from k8s_spot_rescheduler_tpu.io.kube import decode_pod, decode_pv, decode_pvc
 from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
-from k8s_spot_rescheduler_tpu.models.cluster import PVCSpec, PVSpec, build_node_map
-from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.models.cluster import PVCSpec, PVSpec
 from k8s_spot_rescheduler_tpu.models.volumes import resolve_volume_affinity
 from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
 from k8s_spot_rescheduler_tpu.predicates.masks import merge_affinity_terms
 from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
-from tests.fixtures import (
+from tests.fixtures import (  # noqa: F401
+    pack_fake,
     ON_DEMAND_LABEL,
     ON_DEMAND_LABELS,
     SPOT_LABEL,
@@ -240,14 +240,7 @@ def test_unresolvable_pvc_pod_blocks_drain():
     fc = _cluster()
     fc.add_pod(make_pod("stuck", 100, "od-1", pvc_names=("ghost",),
                         pvc_resolvable=True, unmodeled_constraints=True))
-    nodes = fc.list_ready_nodes()
-    node_map = build_node_map(
-        nodes,
-        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
-        on_demand_label=ON_DEMAND_LABEL,
-        spot_label=SPOT_LABEL,
-    )
-    packed, _ = pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+    packed, _ = pack_fake(fc)
     assert not plan_oracle(packed).feasible[:1].any()
 
 
@@ -258,14 +251,7 @@ def test_columnar_parity_with_pvc_pods():
         on_demand_label=ON_DEMAND_LABEL,
         spot_label=SPOT_LABEL,
     )
-    nodes = fc.list_ready_nodes()
-    node_map = build_node_map(
-        nodes,
-        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
-        on_demand_label=ON_DEMAND_LABEL,
-        spot_label=SPOT_LABEL,
-    )
-    obj, _ = pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+    obj, _ = pack_fake(fc)
     col, _ = store.pack(fc.pdbs)
     for field in obj._fields:
         np.testing.assert_array_equal(
