@@ -1,0 +1,115 @@
+"""Kitchen-sink interaction test: every modeled predicate at once.
+
+Each predicate family has its own suite; this one pins that they
+compose — one candidate node carrying a nodeSelector pod, a
+metadata.name-pinned pod, a zonal-PVC pod, a positive-affinity pod, a
+zone-anti-affinity pod, and a hostname-anti pod drains in a single
+tick with every pod landing on a node that satisfies ALL of its
+constraints, on both packers, with the oracle's plan honored end to
+end.
+"""
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.models.cluster import PVCSpec, PVSpec
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.predicates.masks import ZONE_LABEL
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+    pack_fake,
+)
+
+
+def _kitchen_sink():
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.pvs["pv-a"] = PVSpec(
+        "pv-a", node_affinity=(((ZONE_LABEL, "In", ("a",)),),)
+    )
+    fc.pvcs["default/data"] = PVCSpec("data", "default", volume_name="pv-a")
+
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS, cpu_millis=4000))
+    # zone a: pool-labeled + hosts the app=db match the affinity pod needs
+    fc.add_node(make_node(
+        "spot-a1", dict(SPOT_LABELS, **{ZONE_LABEL: "a", "pool": "gold"})
+    ))
+    # zone b: hosts an app=cache pod (repels the zone-anti pod from b)
+    fc.add_node(make_node("spot-b1", dict(SPOT_LABELS, **{ZONE_LABEL: "b"})))
+    # zoneless plain node
+    fc.add_node(make_node("spot-nz", SPOT_LABELS))
+    fc.add_pod(make_pod("db-0", 100, "spot-a1", labels={"app": "db"}))
+    fc.add_pod(make_pod("cache-b", 100, "spot-b1", labels={"app": "cache"}))
+
+    # the candidate's pods, one per constraint family
+    fc.add_pod(make_pod("sel", 200, "od-1", node_selector={"pool": "gold"}))
+    fc.add_pod(make_pod("pin", 200, "od-1", node_affinity=(
+        (("metadata.name", "FieldIn", ("spot-nz",)),),
+    )))
+    fc.add_pod(make_pod("vol", 200, "od-1", pvc_names=("data",),
+                        pvc_resolvable=True, unmodeled_constraints=True))
+    fc.add_pod(make_pod("buddy", 200, "od-1",
+                        pod_affinity_match={"app": "db"}))
+    fc.add_pod(make_pod("spread", 200, "od-1", labels={"app": "web"},
+                        anti_affinity_zone_match={"app": "cache"}))
+    fc.add_pod(make_pod("hostanti", 200, "od-1",
+                        anti_affinity_match={"app": "db"},
+                        labels={"tier": "x"}))
+    return fc
+
+
+EXPECTED = {
+    "default/sel": {"spot-a1"},  # only pool=gold node
+    "default/pin": {"spot-nz"},  # metadata.name pin
+    "default/vol": {"spot-a1"},  # zonal volume -> zone a
+    "default/buddy": {"spot-a1"},  # must join app=db
+    "default/spread": {"spot-a1", "spot-nz"},  # zone b hosts app=cache
+    "default/hostanti": {"spot-b1", "spot-nz"},  # not beside app=db
+}
+
+
+def test_all_predicates_compose_in_one_plan():
+    fc = _kitchen_sink()
+    packed, meta = pack_fake(fc)
+    from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    pods = meta.cand_pods[0]
+    for k, pod in enumerate(pods):
+        target = meta.spot[int(result.assignment[0, k])].node.name
+        assert target in EXPECTED[pod.uid], (pod.uid, target)
+
+
+def test_columnar_parity_kitchen_sink():
+    fc = _kitchen_sink()
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label="kubernetes.io/role=worker",
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = pack_fake(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+
+
+def test_drain_through_loop_honors_every_constraint():
+    fc = _kitchen_sink()
+    cfg = ReschedulerConfig(solver="numpy", node_drain_delay=0.0)
+    r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=fc.clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    fc.clock.advance(10.0)
+    for uid, allowed in EXPECTED.items():
+        assert fc.pods[uid].node_name in allowed, (
+            uid, fc.pods[uid].node_name
+        )
