@@ -485,6 +485,32 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
         spec = _scaled_spec(CONFIGS[args.config], args.scale)
     packed, _, pack_s = build_problem(args.config, args.seed, spec=spec)
 
+    # single-chip HBM guard — the same dispatch the production planner
+    # runs (solver/memory.py): past the budget with a mesh available, the
+    # solve reroutes to the sharded backend; with ONE chip it proceeds to
+    # the backend's honest OOM, annotated with the designed answer.
+    from k8s_spot_rescheduler_tpu.solver import memory as solver_memory
+
+    hbm_est = solver_memory.estimate_union_hbm_bytes(
+        *solver_memory.packed_shapes(packed)
+    )
+    hbm_budget = solver_memory.device_hbm_budget()
+    n_devices = len(jax.devices())
+    past_chip = hbm_est > hbm_budget
+    scale_note = None
+    if past_chip:
+        scale_note = (
+            f"problem est {hbm_est / 1e9:.1f} GB exceeds single-chip budget "
+            f"{hbm_budget / 1e9:.1f} GB"
+        )
+        if n_devices > 1 and args.solver != "sharded":
+            args.solver = "sharded"
+            scale_note += (
+                f"; auto-dispatched to mesh-sharded solver over "
+                f"{n_devices} devices (repair phase unavailable at this scale)"
+            )
+        print(f"HBM guard: {scale_note}", file=sys.stderr)
+
     from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
 
     if args.solver == "jax":
@@ -511,14 +537,33 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
     from k8s_spot_rescheduler_tpu.solver.repair import DEFAULT_ROUNDS
 
     # the production planner path: first-fit ∪ best-fit ∪ local-search
-    # repair, one fused device program (what SolverPlanner ships)
-    union_fn = with_repair(solve_fn, DEFAULT_ROUNDS)
+    # repair, one fused device program (what SolverPlanner ships). Past
+    # single-chip HBM the repair phase is dropped, mirroring the
+    # planner's auto-shard reroute (its search state is single-chip).
+    if past_chip:
+        from k8s_spot_rescheduler_tpu.solver.fallback import (
+            with_best_fit_fallback,
+        )
+
+        union_fn = with_best_fit_fallback(solve_fn)
+    else:
+        union_fn = with_repair(solve_fn, DEFAULT_ROUNDS)
     fused = make_fused_planner(union_fn)
     device_packed = jax.tree.map(jax.numpy.asarray, packed)
 
-    t0 = time.perf_counter()
-    sel = decode_selection(fused(device_packed))
-    compile_s = time.perf_counter() - t0
+    try:
+        t0 = time.perf_counter()
+        sel = decode_selection(fused(device_packed))
+        compile_s = time.perf_counter() - t0
+    except Exception as err:  # noqa: BLE001 — annotate the honest OOM
+        if past_chip and n_devices <= 1:
+            raise RuntimeError(
+                f"{str(err)[-250:]} | {scale_note}; this host exposes one "
+                "chip, so the mesh-sharded solver (the designed scale "
+                "path, auto-dispatched when >1 device is visible — see "
+                "MULTICHIP_r04 for its 8x proof) cannot engage here"
+            ) from err
+        raise
 
     times = []
     for _ in range(args.repeats):
@@ -536,22 +581,17 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
 
     # Amortized device-only estimate: this machine reaches its TPU through
     # a network tunnel whose round trip (~65 ms) dwarfs the actual solve.
-    # Chain N dependent solves in one program, fetch once, subtract the
-    # round-trip floor — the per-solve quotient is what a locally attached
-    # v5e would see per tick. (Skipped on the CPU fallback: 50 chained
-    # config-3 solves on host would blow the watchdog for no information.)
-    N_CHAIN = 50
+    # The protocol (chain N dependent solves, fetch once, subtract the
+    # round-trip floor) is pinned + unit-tested in bench/protocol.py; its
+    # raw inputs ride along in the JSON line. (Skipped on the CPU
+    # fallback: 50 chained config-3 solves on host would blow the
+    # watchdog for no information.)
+    from k8s_spot_rescheduler_tpu.bench import protocol as bench_protocol
+
     device_ms = float("nan")
+    protocol_rec = None
     if not backend_note:
-
-        def chained(p):
-            def step(i, acc):
-                p2 = p._replace(slot_req=p.slot_req + acc * 0.0)
-                return acc + fused(p2).sum().astype(jax.numpy.float32)
-
-            return jax.lax.fori_loop(0, N_CHAIN, step, jax.numpy.float32(0.0))
-
-        chained_jit = jax.jit(chained)
+        chained_jit = bench_protocol.make_chained(fused)
         rtt_jit = jax.jit(lambda p: p.cand_valid.sum())
         np.asarray(chained_jit(device_packed)), np.asarray(rtt_jit(device_packed))
         chain_t, rtt_t = [], []
@@ -562,9 +602,8 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
             t0 = time.perf_counter()
             np.asarray(rtt_jit(device_packed))
             rtt_t.append(time.perf_counter() - t0)
-        device_ms = max(
-            0.0, (np.median(chain_t) - np.median(rtt_t)) / N_CHAIN * 1e3
-        )
+        device_ms = bench_protocol.device_only_ms(chain_t, rtt_t)
+        protocol_rec = bench_protocol.protocol_record(chain_t, rtt_t)
 
     value_ms = float(np.median(times) * 1e3)
     e2e_ms = float(np.median(e2e) * 1e3)
@@ -585,6 +624,11 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
         "vs_baseline": round(TARGET_MS / value_ms, 3),
         "device": jax.devices()[0].device_kind,
     }
+    if scale_note is not None:
+        out["scale_note"] = scale_note
+        out["solver"] = args.solver
+    if protocol_rec is not None:
+        out["device_only"] = protocol_rec
     if backend_note:
         out["error"] = backend_note
     emit(out)

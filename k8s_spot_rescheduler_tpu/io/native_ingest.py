@@ -274,6 +274,29 @@ class PodBatch:
     def pvc_list(self, set_id: int) -> tuple:
         return self.pvc_lists[set_id]
 
+    def any_pvc_resolvable(self) -> bool:
+        """Vectorized ``any(view.pvc_resolvable)`` over the batch — the
+        same predicate PodView evaluates (F_PVC set, non-empty claim
+        list, no F_REQAFF), without materializing 50k lazy views on the
+        polling hot path (advisor r3). The per-list emptiness check runs
+        over the small interned table, not per pod."""
+        import numpy as np
+
+        flags = self.u8[: self.count, 0]
+        pvc = (flags & F_PVC) != 0
+        if not pvc.any():
+            return False
+        nonempty = np.fromiter(
+            (bool(l) for l in self.pvc_lists), bool, count=len(self.pvc_lists)
+        )
+        return bool(
+            (
+                pvc
+                & ((flags & F_REQAFF) == 0)
+                & nonempty[self.i32[: self.count, P_PVCID]]
+            ).any()
+        )
+
     def label_set(self, set_id: int) -> Dict[str, str]:
         cached = self._label_sets[set_id]
         if cached is None:

@@ -28,7 +28,7 @@ events) pass through to the underlying client unchanged.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from k8s_spot_rescheduler_tpu.io.kube import (
     KubeClusterClient,
@@ -374,9 +374,13 @@ class WatchingKubeClusterClient:
         # (models/volumes.py): seeded before the pod watcher starts (a
         # running pod's binding pre-dates it) and refreshed per tick
         # while unresolved claims remain. Resolution failures leave pods
-        # conservatively unplaceable.
-        self._pvcs: Dict[str, object] = {}
-        self._pvs: Dict[str, object] = {}
+        # conservatively unplaceable. Held as ONE tuple so the watcher
+        # thread's decode reads a consistent (pvcs, pvs) pair while the
+        # tick thread reassigns it (advisor r3: two separate attribute
+        # loads could pair a new PVC map with an old PV map).
+        self._vol_snapshot: Tuple[Dict[str, object], Dict[str, object]] = (
+            {}, {},
+        )
         # re-scan the pod store for unresolved PVC pods only when
         # something could have produced one: the decode hook saw an
         # unresolved pod, or a re-LIST replaced the store wholesale
@@ -450,7 +454,8 @@ class WatchingKubeClusterClient:
 
         pod = decode_pod(obj)
         if pod.pvc_resolvable:
-            pod = resolve_volume_affinity(pod, self._pvcs, self._pvs)
+            pvcs, pvs = self._vol_snapshot  # one load: consistent pair
+            pod = resolve_volume_affinity(pod, pvcs, pvs)
             if pod.pvc_resolvable:  # still unresolved: retry per tick
                 self._vol_scan_needed = True
         return pod
@@ -483,15 +488,16 @@ class WatchingKubeClusterClient:
             if not force:
                 return
         try:
-            self._pvcs, self._pvs = self.client.list_volume_snapshots()
+            pvcs, pvs = self.client.list_volume_snapshots()
+            self._vol_snapshot = (pvcs, pvs)  # single atomic reassignment
         except Exception as err:  # noqa: BLE001 — stay conservative
             log.error("PVC/PV list failed; volume pods stay unmodeled: %s", err)
             return
         for key, pod in unresolved:
             spec = pod if isinstance(pod, PodSpec) else pod.to_pod_spec()
-            resolved = resolve_volume_affinity(spec, self._pvcs, self._pvs)
+            resolved = resolve_volume_affinity(spec, pvcs, pvs)
             if resolved is spec:
-                if terminally_unresolvable(spec, self._pvcs, self._pvs):
+                if terminally_unresolvable(spec, pvcs, pvs):
                     # PV affinity is immutable: stop re-LISTing volumes
                     # for this pod every tick; it stays unmodeled
                     resolved = dataclasses.replace(spec, pvc_resolvable=False)

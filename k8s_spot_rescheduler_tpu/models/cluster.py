@@ -134,8 +134,22 @@ class PodSpec:
     # the flag separate keeps every unresolved path fail-safe: a pod
     # that never meets the resolver stays placeable-nowhere.
     pvc_resolvable: bool = False
+    # Hard topologySpreadConstraints (whenUnsatisfiable=DoNotSchedule,
+    # the k8s default), modeled in the canonical shape: topologyKey is
+    # hostname or the standard zone label, a non-empty matchLabels
+    # selector (own namespace), integer maxSkew >= 1, and none of the
+    # counting-semantics modifiers (minDomains, matchLabelKeys,
+    # nodeAffinityPolicy, nodeTaintsPolicy). Each entry is a canonical
+    # tuple (topology_key, max_skew, sorted selector items); any number
+    # of entries (the hostname+zone pair is the common Deployment
+    # shape). The packers turn each into a per-carrier SpreadBit
+    # pseudo-taint (predicates/masks.py) whose refused-domain set is
+    # computed from this tick's per-domain match counts; ScheduleAnyway
+    # entries are soft and ignored; shapes beyond the canonical form
+    # fall back to ``unmodeled_constraints``.
+    spread_constraints: Tuple = ()
     # Scheduling constraints this framework does not model (unresolved
-    # volume topology, cross-namespace affinity, hard spread
+    # volume topology, cross-namespace affinity, non-canonical spread
     # constraints, ...). Conservative in the safe direction: such a pod
     # is treated as placeable nowhere, so its node can never be proven
     # drainable — we may miss a drain the real scheduler would allow,
@@ -269,10 +283,19 @@ class NodeInfo:
 @dataclasses.dataclass
 class NodeMap:
     """Reference nodes/nodes.go:37-39, 54-60 ``Map``: node infos keyed by
-    class, in planning order."""
+    class, in planning order.
+
+    ``other`` holds ready nodes matching neither class label. The
+    reference drops them outright (nodes/nodes.go:90-91) and so does our
+    planning surface — but their RESIDENT PODS still exist to the real
+    scheduler, so zone-topology anti-affinity presence must span them
+    (a requirer on a control-plane node repels matches zone-wide). The
+    packers fold ``other`` pods into the zone accumulation only; they
+    never become candidates or placement targets."""
 
     on_demand: List[NodeInfo]
     spot: List[NodeInfo]
+    other: List[NodeInfo] = dataclasses.field(default_factory=list)
 
 
 def is_spot_node(node: NodeSpec, spot_label: str) -> bool:
@@ -306,6 +329,7 @@ def build_node_map(
     """
     on_demand: List[NodeInfo] = []
     spot: List[NodeInfo] = []
+    other: List[NodeInfo] = []
 
     for node in nodes:
         spot_node = is_spot_node(node, spot_label)
@@ -320,10 +344,14 @@ def build_node_map(
             spot.append(info)
         elif is_on_demand_node(node, on_demand_label):
             on_demand.append(info)
-        # nodes matching neither label are ignored (nodes/nodes.go:90-91)
+        else:
+            # Unclassified nodes are not planning surface (the reference
+            # ignores them, nodes/nodes.go:90-91) but their pods are kept
+            # visible for zone-wide anti-affinity presence (NodeMap.other).
+            other.append(info)
 
     # Python's sort is stable, like Go's sort.Slice is not — but ties keep
     # input order here, which is deterministic for our packers.
     spot.sort(key=lambda n: n.requested_cpu, reverse=True)
     on_demand.sort(key=lambda n: n.requested_cpu)
-    return NodeMap(on_demand=on_demand, spot=spot)
+    return NodeMap(on_demand=on_demand, spot=spot, other=other)

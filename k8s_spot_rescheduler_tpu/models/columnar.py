@@ -990,12 +990,20 @@ class ColumnarStore:
             )
         return cached
 
-    def _affinity_matrix(self, counted_rows: np.ndarray) -> np.ndarray:
+    def _affinity_matrix(
+        self, counted_rows: np.ndarray, zone_rows: Optional[np.ndarray] = None
+    ) -> np.ndarray:
         """Per-profile affinity masks for the current tick's selector
         universe (distinct ``anti_affinity_match`` selectors among the
-        counted pods). Rebuilt only when the universe or the profile list
-        changes; plain clusters keep a zero universe and never rebuild."""
+        counted pods). The ZONE universe spans ``zone_rows`` — counted
+        pods plus pods on unclassified ready nodes (zone presence reaches
+        any node class; see pack()). Rebuilt only when a universe or the
+        profile list changes; plain clusters keep a zero universe and
+        never rebuild."""
         ids = np.unique(self.p_aff_id[counted_rows]) if len(counted_rows) else []
+        if zone_rows is None:
+            zone_rows = counted_rows
+        zids = np.unique(self.p_aff_id[zone_rows]) if len(zone_rows) else []
         universe = sorted(
             {
                 (self._aff_lists[int(i)][1], self._aff_lists[int(i)][2])
@@ -1006,7 +1014,7 @@ class ColumnarStore:
         zone_universe = sorted(
             {
                 (self._aff_lists[int(i)][1], self._aff_lists[int(i)][3])
-                for i in ids
+                for i in zids
                 if self._aff_lists[int(i)][3]
             }
         )
@@ -1235,7 +1243,18 @@ class ColumnarStore:
         table = self._build_taint_table(spot_order, slot_rows)
         tol_matrix = self._toleration_matrix(table)
         W = table.words
-        aff_matrix = self._affinity_matrix(np.nonzero(counted)[0])
+        # zone presence spans pods on unclassified ready nodes too (a
+        # requirer on e.g. a control-plane node repels zone-wide; the
+        # object packer folds NodeMap.other pods identically)
+        node_other = self.n_live[:nhi] & self.n_ready[:nhi] & (
+            self.n_class[:nhi] == _OTHER
+        )
+        zone_counted = counted | (
+            self.p_live[:hi] & (p_node >= 0) & node_other[safe_node]
+        )
+        aff_matrix = self._affinity_matrix(
+            np.nonzero(counted)[0], np.nonzero(zone_counted)[0]
+        )
         slot_counts = np.bincount(slot_cand, minlength=C_actual).astype(np.int32)
         slot_starts = np.concatenate(
             ([0], np.cumsum(slot_counts[:-1]))
@@ -1327,8 +1346,9 @@ class ColumnarStore:
             np.bitwise_or.at(aff, sp, self._host_matrix[self.p_aff_id[sp_rows]])
             if self._zone_universe:
                 # zone-wide presence: OR the zone-family masks of EVERY
-                # counted pod (any node class) into its node's zone, then
-                # into each spot node in that zone
+                # counted pod plus every pod on an unclassified ready
+                # node (any node class) into its node's zone, then into
+                # each spot node in that zone
                 zone_ids: Dict[str, int] = {}
                 zid_node = np.full(nhi, -1, np.int32)
                 for nr in range(nhi):
@@ -1339,7 +1359,7 @@ class ColumnarStore:
                     if z is not None:
                         zid_node[nr] = zone_ids.setdefault(z, len(zone_ids))
                 if zone_ids:
-                    crows = np.nonzero(counted)[0]
+                    crows = np.nonzero(zone_counted)[0]
                     pz = zid_node[p_node[crows]]
                     live = pz >= 0
                     accum = np.zeros((len(zone_ids), AFFINITY_WORDS), np.uint32)

@@ -220,12 +220,20 @@ def pack_cluster(
     )
     # anti-affinity selector universes span every counted pod (resident
     # pods repel incoming matches and vice versa; zone identities reach
-    # across node classes because zones do)
+    # across node classes because zones do). The ZONE family additionally
+    # spans pods on unclassified ready nodes (NodeMap.other): a requirer
+    # or match resident on e.g. a control-plane node still repels
+    # zone-wide in the real scheduler, and missing it would approve a
+    # drain whose pod then strands. Hostname-family presence stays scoped
+    # to candidates+spot — we never place onto unclassified nodes, so
+    # their residents cannot create per-node conflicts.
+    other = node_map.other
     counted_pods = [p for info in candidates for p in info.pods] + [
         p for info in spot for p in info.pods
     ]
+    zone_pods = counted_pods + [p for info in other for p in info.pods]
     match_universe = collect_match_universe(counted_pods)
-    zone_universe = collect_zone_universe(counted_pods)
+    zone_universe = collect_zone_universe(zone_pods)
     W, A, R = table.words, AFFINITY_WORDS, len(resources)
 
     C = max(_pad_dim(len(candidates)), _pad_dim(pad_candidates))
@@ -348,11 +356,12 @@ def pack_cluster(
         return row
 
     # zone-wide presence: OR of the zone-family masks of every counted
-    # pod, keyed by its node's zone label (nodes without the label are
-    # zoneless and neither contribute nor receive)
+    # pod — plus every pod on an unclassified ready node — keyed by its
+    # node's zone label (nodes without the label are zoneless and
+    # neither contribute nor receive)
     zone_accum: dict = {}
     if zone_universe:
-        for info in list(candidates) + list(spot):
+        for info in list(candidates) + list(spot) + list(other):
             zone = info.node.labels.get(ZONE_LABEL)
             if zone is None:
                 continue

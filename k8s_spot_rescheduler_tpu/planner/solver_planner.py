@@ -39,6 +39,8 @@ class SolverPlanner:
         self._pad_s = 0
         self._pad_k = config.max_pods_per_node_hint
         self._fused = None  # device path
+        self._fused_sharded = None  # lazy auto-shard reroute (see plan())
+        self.last_solver = config.solver  # what the last plan actually ran
         if config.solver == "numpy":
             self._solve_host = plan_oracle
         else:
@@ -93,6 +95,77 @@ class SolverPlanner:
             ) from err
         raise ValueError(f"unknown solver {name!r}")
 
+    def _sharded_fused_planner(self):
+        """The auto-shard reroute: first-fit ∪ best-fit over the device
+        mesh (parallel/sharded_ffd.py), built once on first use. The
+        repair phase is deliberately absent — its eject-reinsert search
+        state is single-chip, which is exactly what no longer fits when
+        this path engages. Conservative: may prove fewer drains than the
+        union program would have, never an invalid one."""
+        if self._fused_sharded is None:
+            import functools
+
+            from k8s_spot_rescheduler_tpu.parallel.mesh import make_mesh
+            from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
+                plan_ffd_sharded,
+            )
+            from k8s_spot_rescheduler_tpu.solver.fallback import (
+                with_best_fit_fallback,
+            )
+            from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
+
+            mesh = make_mesh(
+                self.config.mesh_shape
+                if self.config.mesh_shape != (1, 1)
+                else None
+            )
+            base = functools.partial(plan_ffd_sharded, mesh)
+            self._mesh_shape = tuple(mesh.devices.shape)
+            self._fused_sharded = make_fused_planner(
+                with_best_fit_fallback(base)
+                if self.config.fallback_best_fit
+                else base
+            )
+        return self._fused_sharded
+
+    def _maybe_shard(self, packed):
+        """Pick the device program for this problem's shapes: the
+        configured solver, or — past the single-chip HBM estimate with a
+        mesh available — the sharded reroute (solver/memory.py). The
+        scale story of SURVEY.md §5.7: the mesh engages BY ITSELF where
+        the single-chip kernel gives out."""
+        cfg = self.config
+        if (
+            not cfg.auto_shard
+            or self._fused is None
+            or cfg.solver == "sharded"  # already the mesh path
+        ):
+            return self._fused, cfg.solver
+        from k8s_spot_rescheduler_tpu.solver import memory
+
+        try:
+            import jax
+
+            n_devices = len(jax.devices())
+        except Exception:  # noqa: BLE001 — no backend: keep configured path
+            return self._fused, cfg.solver
+        if not memory.should_shard(
+            packed, n_devices, budget_bytes=cfg.solver_hbm_budget or None
+        ):
+            return self._fused, cfg.solver
+        fused = self._sharded_fused_planner()
+        label = f"{cfg.solver}+sharded"
+        est = memory.estimate_union_hbm_bytes(*memory.packed_shapes(packed))
+        log.info(
+            "Problem exceeds single-chip HBM (est %.1f GB > budget); "
+            "dispatching to mesh-sharded solver over %d devices (%s mesh); "
+            "repair phase unavailable at this scale",
+            est / 1e9,
+            n_devices,
+            "x".join(map(str, getattr(self, "_mesh_shape", ()))),
+        )
+        return fused, label
+
     # SolverPlanner can plan straight from a ColumnarStore snapshot (the
     # vectorized observe path); the control loop checks this before
     # handing it one instead of a NodeMap.
@@ -131,10 +204,12 @@ class SolverPlanner:
         for blocked in meta.blocking_pods():
             log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)
 
+        solver_label = cfg.solver
         if self._fused is not None:
             from k8s_spot_rescheduler_tpu.solver.select import decode_selection
 
-            sel = decode_selection(self._fused(packed))
+            fused, solver_label = self._maybe_shard(packed)
+            sel = decode_selection(fused(packed))
             plan = meta.build_plan(sel.index, sel.row) if sel.found else None
             n_feasible = sel.n_feasible
         else:
@@ -180,12 +255,13 @@ class SolverPlanner:
 
         self._report_conservatism(packed, meta, n_feasible)
 
+        self.last_solver = solver_label
         report = PlanReport(
             plan=plan,
             n_candidates=meta.n_candidates,
             n_feasible=n_feasible,
             solve_seconds=time.perf_counter() - t0,
-            solver=self.config.solver,
+            solver=solver_label,
             feasible_candidates=[plan] if plan else [],
         )
         return report

@@ -156,6 +156,29 @@ class PodAffinityBit:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpreadBit:
+    """Pseudo-taint for one hard topologySpreadConstraint CARRIER
+    CONTEXT: the set of topology domains a specific moving pod may not
+    enter without exceeding its maxSkew, precomputed from this tick's
+    per-domain match counts (``compute_spread_bit``). Set on every spot
+    node that lacks the topology key (PodTopologySpread filters such
+    nodes) or whose domain is in ``refused``; only the carrier fails to
+    tolerate it.
+
+    Like PodAffinityBit, the node side depends on per-tick cluster
+    state (match counts), not node properties alone — the packers
+    evaluate it outside any label-keyed cache. Two carriers whose
+    contexts produce the same (topology_key, refused) verdict share one
+    bit harmlessly. What static verdicts cannot prove is two in-plan
+    movers involved with one spread identity (their placements shift
+    each other's counts) — ``spread_lane_guard`` conservatively kills
+    those lanes, exactly like the zone guard."""
+
+    topology_key: str
+    refused: Tuple  # sorted domain values the carrier may not enter
+
+
+@dataclasses.dataclass(frozen=True)
 class UnplaceableBit:
     """Pseudo-taint carried by every node; only pods with unmodeled
     constraints fail to tolerate it."""
@@ -252,15 +275,18 @@ def intern_constraints(
     selector_pairs: Sequence[Tuple[str, str]],
     affinity_terms: Sequence[Tuple] = (),
     pod_affinity_keys: Sequence[Tuple] = (),
+    spread_bits: Sequence["SpreadBit"] = (),
 ) -> TaintTable:
     """``intern_taints`` plus the pseudo-taint tail: selector pairs (in
     the given sorted order), node-affinity requirement bits, positive
-    pod-affinity bits, and the always-present unplaceable bit."""
+    pod-affinity bits, spread-verdict bits, and the always-present
+    unplaceable bit."""
     base = intern_taints(nodes)
     taints = list(base.taints)
     taints.extend(SelectorBit(k, v) for k, v in selector_pairs)
     taints.extend(NodeAffinityBit(t) for t in affinity_terms)
     taints.extend(PodAffinityBit(ns, items) for ns, items in pod_affinity_keys)
+    taints.extend(spread_bits)
     taints.append(UnplaceableBit())
     words = max(1, -(-len(taints) // 32))
     return TaintTable(taints=taints, words=words)
@@ -287,6 +313,10 @@ def node_constraint_mask(
         elif isinstance(entry, PodAffinityBit):
             if not hosts_affinity_match(residents, entry.namespace, entry.items):
                 mask[i // 32] |= np.uint32(1 << (i % 32))
+        elif isinstance(entry, SpreadBit):
+            domain = node.labels.get(entry.topology_key)
+            if domain is None or domain in entry.refused:
+                mask[i // 32] |= np.uint32(1 << (i % 32))
         else:  # UnplaceableBit
             mask[i // 32] |= np.uint32(1 << (i % 32))
     return mask | taint_mask(node.taints, table)
@@ -299,12 +329,14 @@ def constraint_mask(
     table: TaintTable,
     node_affinity: Tuple = (),
     pod_affinity: Tuple = (),
+    spread_bits: frozenset = frozenset(),
 ) -> np.ndarray:
     """Pod-side bits: tolerated real taints + selector pairs the pod does
     NOT require + affinity requirements that are not the pod's own + the
     unplaceable bit unless the pod carries unmodeled constraints.
     ``pod_affinity`` is the pod's own PodAffinityBit identity
-    (``pod_affinity_key``), or ()."""
+    (``pod_affinity_key``), or (); ``spread_bits`` the pod's own
+    SpreadBit contexts (every other pod tolerates them)."""
     mask = np.zeros(table.words, dtype=np.uint32)
     for i, entry in enumerate(table.taints):
         if isinstance(entry, Taint):
@@ -315,6 +347,8 @@ def constraint_mask(
             ok = entry.terms != node_affinity
         elif isinstance(entry, PodAffinityBit):
             ok = (entry.namespace, entry.items) != pod_affinity
+        elif isinstance(entry, SpreadBit):
+            ok = entry not in spread_bits
         else:  # UnplaceableBit
             ok = not unmodeled
         if ok:
@@ -468,7 +502,9 @@ def merge_affinity_terms(*term_sets: Tuple):
 # the SAME requirement|presence hashing as the hostname machinery above,
 # but with a zone salt in the key and zone-wide node-side aggregation: a
 # spot node's affinity word ORs in the zone masks of every counted pod in
-# its entire ZONE (any node class), so a requirer refuses zones hosting a
+# its entire ZONE — spanning all ready nodes of ANY class, including
+# unclassified ones (NodeMap.other / columnar _OTHER): a requirer on a
+# control-plane node still repels zone-wide — so a requirer refuses zones hosting a
 # match and a matched pod refuses zones hosting a requirer — the
 # scheduler's symmetric semantics, statically per tick. What static bits
 # CANNOT prove safe is two zone-involved pods inside one candidate lane
@@ -531,6 +567,106 @@ def zone_lane_guard(pods: Sequence[PodSpec]) -> set:
         if p.anti_affinity_zone_match:
             key = (p.namespace, tuple(sorted(p.anti_affinity_zone_match.items())))
             carried.setdefault(key, set()).add(i)
+    out: set = set()
+    for (ns, items), involved in carried.items():
+        involved = set(involved)
+        for i, p in enumerate(pods):
+            if p.namespace == ns and all(
+                p.labels.get(k) == v for k, v in items
+            ):
+                involved.add(i)
+        if len(involved) >= 2:
+            out |= involved
+    return out
+
+
+# --- hard topologySpreadConstraints (per-carrier static verdicts) ---------
+#
+# A hard (DoNotSchedule) spread constraint bounds, for the pod CARRYING
+# it at ITS schedule time, the per-domain count of selector-matched pods:
+# placing p in domain d must keep count(d) - min-over-domains <= maxSkew.
+# Unlike anti-affinity there is no symmetric direction — resident
+# carriers never repel incoming pods — so only MOVING carriers need
+# modeling. The verdict is computed statically per tick per carrier
+# (compute_spread_bit) and interned as a SpreadBit pseudo-taint:
+#
+# - counts tally selector matches over every model-visible pod (counted
+#   pods of both classes + pods on unclassified ready nodes), keyed by
+#   the node's topology-key value; nodes lacking the key contribute
+#   nothing and admit nothing (PodTopologySpread filters them);
+# - domains span every visible ready node's key value, INCLUDING
+#   zero-count domains — the min is what makes skew bite;
+# - the carrier's own departure is exact: if p itself matches its
+#   selector, its source domain's count drops by one, which can lower
+#   the global min (stricter) and lowers its own domain's bar by one
+#   (the "d == own" offset);
+# - domain-eligibility filtering the real scheduler applies
+#   (nodeAffinityPolicy=Honor) is deliberately ignored: a min over MORE
+#   domains is never larger, so the verdict is only ever stricter —
+#   the safe direction. Below-threshold spot pods are invisible here
+#   exactly as they are to the reference's own snapshot
+#   (nodes/nodes.go:137-141: presumed preemptible).
+#
+# What the static verdict cannot see is in-plan interaction: a second
+# mover involved with the same identity (carrying it or matched by its
+# selector) shifts counts mid-plan — spread_lane_guard marks all
+# involved slots unplaceable, conservatively failing the lane.
+
+
+def spread_self_match(pod: PodSpec, items: Tuple) -> bool:
+    """Does the carrier match its own selector (Deployment spread does)?
+    Only then does its move shift the counts its verdict depends on."""
+    return all(pod.labels.get(k) == v for k, v in items)
+
+
+def compute_spread_bit(
+    topology_key: str,
+    max_skew: int,
+    own_domain,
+    counts,
+    all_domains,
+    self_match: bool,
+) -> "SpreadBit":
+    """The refused-domain verdict for one carrier context.
+
+    ``counts``: matching-pod tally per domain (zero-count domains may be
+    absent); ``all_domains``: every topology-key value among visible
+    ready nodes; ``own_domain``: the carrier's current domain (None when
+    its node lacks the key); ``self_match``: does the carrier match its
+    own selector (kube-scheduler's selfMatchNum — only then does its
+    own move shift counts, and only then does its arrival count).
+    Refused(d) ⇔ counts_excl(d) + selfMatch - min_excl > maxSkew, with
+    counts_excl the tally after the carrier's departure (kube-scheduler
+    computes the same check over existing pods at the re-schedule
+    instant, when the carrier has already left its node). No domains at
+    all ⇒ nothing to enumerate; keyless nodes are always refused by the
+    node-side rule."""
+    full = {d: int(counts.get(d, 0)) for d in all_domains}
+    if self_match and own_domain is not None and own_domain in full:
+        full = {
+            d: v - (1 if d == own_domain else 0) for d, v in full.items()
+        }
+    if not full:
+        return SpreadBit(topology_key=topology_key, refused=())
+    limit = min(full.values()) + max_skew - (1 if self_match else 0)
+    return SpreadBit(
+        topology_key=topology_key,
+        refused=tuple(sorted(d for d, v in full.items() if v > limit)),
+    )
+
+
+def spread_lane_guard(pods: Sequence[PodSpec]) -> set:
+    """Slot indices (within one candidate lane) to mark unplaceable:
+    for each spread selector identity carried by a lane pod, if two or
+    more lane pods are involved with it (carry it, or are matched by
+    it), their in-plan placements shift each other's domain counts in
+    ways the static verdicts cannot see. Same shape as
+    ``zone_lane_guard``; shared by both packers so the decision is
+    bit-identical."""
+    carried: dict = {}
+    for i, p in enumerate(pods):
+        for _, _, items in p.spread_constraints:
+            carried.setdefault((p.namespace, items), set()).add(i)
     out: set = set()
     for (ns, items), involved in carried.items():
         involved = set(involved)

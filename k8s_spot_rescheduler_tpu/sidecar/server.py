@@ -46,13 +46,12 @@ class PlannerSidecar:
       tracing would thrash them); a request whose turn has not come
       within ``busy_timeout_s`` gets 503 + Retry-After. The solve itself
       is not interruptible (an XLA dispatch cannot be safely cancelled
-      mid-flight), so the busy timeout is the deadline knob. Note the
-      bound this buys: queue *time* per request is capped, not queue
-      depth — a burst of N requests each under the timeout all execute
-      in turn, each holding its parsed body (ThreadingHTTPServer is
-      thread-per-request), so worst-case transient memory is
-      N x max_body_bytes. Size busy_timeout_s near the caller's tick
-      interval to keep N small.
+      mid-flight), so the busy timeout is the deadline knob;
+    - ``max_inflight`` caps queue DEPTH: past it, /v1/plan returns 503
+      immediately — before the body is even read — so a burst cannot
+      hold more than max_inflight x max_body_bytes of request memory
+      (ThreadingHTTPServer is thread-per-request; the busy timeout
+      alone only capped queue *time*).
     """
 
     def __init__(
@@ -62,12 +61,16 @@ class PlannerSidecar:
         *,
         max_body_bytes: int = 128 << 20,
         busy_timeout_s: float = 30.0,
+        max_inflight: int = 4,
     ):
         self.config = config
         self.planner = SolverPlanner(config)
         self.max_body_bytes = int(max_body_bytes)
         self.busy_timeout_s = float(busy_timeout_s)
+        self.max_inflight = int(max_inflight)
         self._lock = threading.Lock()  # one solve at a time; jit is cached
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         host, _, port = address.rpartition(":")
         sidecar = self
 
@@ -110,28 +113,56 @@ class PlannerSidecar:
                         },
                         413,
                     )
-                try:
-                    body = json.loads(self.rfile.read(length))
-                except ValueError as err:
-                    return self._send({"error": str(err)}, 400)
-                if not sidecar._lock.acquire(timeout=sidecar.busy_timeout_s):
+                # depth guard BEFORE the body read: a rejected request
+                # never buffers its payload, so a burst holds at most
+                # max_inflight parsed bodies regardless of its size
+                if not sidecar._admit():
                     return self._send(
-                        {"error": "planner busy (solve in progress)"},
+                        {
+                            "error": "planner overloaded (%d requests in "
+                            "flight)" % sidecar.max_inflight
+                        },
                         503,
                         headers=[("Retry-After", "1")],
                     )
                 try:
-                    result = sidecar.plan_locked(body)
-                except (ValueError, KeyError) as err:
-                    return self._send({"error": str(err)}, 400)
-                except Exception as err:  # noqa: BLE001 — solver failure
-                    log.error("sidecar plan failed: %s", err)
-                    return self._send({"error": str(err)}, 500)
+                    try:
+                        body = json.loads(self.rfile.read(length))
+                    except ValueError as err:
+                        return self._send({"error": str(err)}, 400)
+                    if not sidecar._lock.acquire(
+                        timeout=sidecar.busy_timeout_s
+                    ):
+                        return self._send(
+                            {"error": "planner busy (solve in progress)"},
+                            503,
+                            headers=[("Retry-After", "1")],
+                        )
+                    try:
+                        result = sidecar.plan_locked(body)
+                    except (ValueError, KeyError) as err:
+                        return self._send({"error": str(err)}, 400)
+                    except Exception as err:  # noqa: BLE001 — solver failure
+                        log.error("sidecar plan failed: %s", err)
+                        return self._send({"error": str(err)}, 500)
+                    finally:
+                        sidecar._lock.release()
+                    return self._send(result)
                 finally:
-                    sidecar._lock.release()
-                return self._send(result)
+                    sidecar._release()
 
         self.server = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
+
+    def _admit(self) -> bool:
+        with self._inflight_lock:
+            if self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            return True
+
+    def _release(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
 
     @property
     def address(self) -> str:
@@ -199,6 +230,10 @@ def main(argv=None) -> int:
     ap.add_argument("--busy-timeout", type=float, default=30.0,
                     help="seconds a request may wait for the in-flight "
                          "solve before 503 (backpressure, not queueing)")
+    ap.add_argument("--max-inflight", type=int, default=4,
+                    help="reject /v1/plan immediately (503) past this many "
+                         "concurrent requests — bounds worst-case request "
+                         "memory at max-inflight x max-body-mb")
     ap.add_argument("-v", "--verbosity", type=int, default=0)
     args = ap.parse_args(argv)
     log.setup(args.verbosity)
@@ -206,6 +241,7 @@ def main(argv=None) -> int:
         ReschedulerConfig(solver=args.solver), args.listen,
         max_body_bytes=args.max_body_mb << 20,
         busy_timeout_s=args.busy_timeout,
+        max_inflight=args.max_inflight,
     )
     sidecar.serve_forever()
     return 0

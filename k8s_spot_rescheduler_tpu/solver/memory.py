@@ -1,0 +1,95 @@
+"""Single-chip HBM guard + mesh-shard dispatch decision.
+
+The fused union program (first-fit ∪ best-fit ∪ repair,
+solver/fallback.py) carries per-candidate spot-pool state: [C, R, S]
+free, [C, S] count, [C, A, S] affinity — double-buffered through the
+``lax.scan``, plus the per-step boolean/slack temporaries. Even though
+the best-fit and repair passes *run* only under ``lax.cond``, XLA still
+allocates their buffers, so the program's footprint is set by these
+carries regardless of runtime skipping. Past ~4× north-star scale the
+allocation exceeds a v5e's HBM at compile time (docs/RESULTS.md "Scaling
+past the north star").
+
+The designed answer is the mesh-sharded solver
+(parallel/sharded_ffd.py): candidate and spot axes shard over the
+device mesh, dividing the carry footprint by the device count. This
+module is the dispatch decision: *estimate* the single-chip footprint
+from the packed shapes, compare against the device budget, and tell the
+planner when to reroute (SolverPlanner auto-dispatch; SURVEY.md §5.7 —
+cluster size is this framework's "long context", and the mesh is how it
+scales past one chip, replacing the reference's serial O(P×N) nest,
+rescheduler.go:334-370).
+
+The estimate is deliberately simple and pinned by tests against the
+measured reality (4× fits a 16 GB chip, 8× does not —
+tests/test_sharding.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# Default assumed HBM when the backend won't say (v5e = 16 GB);
+# fraction left to the solver after runtime/program overheads.
+DEFAULT_HBM_BYTES = 16 * 1024**3
+BUDGET_FRACTION = 0.85
+
+
+def estimate_union_hbm_bytes(
+    C: int, K: int, S: int, R: int, W: int, A: int
+) -> int:
+    """Estimated peak HBM of the fused union solver at these shapes.
+
+    Dominant terms: the scan carries — one [C, S] plane per resource
+    (free), per affinity word (aff), plus one (count) — double-buffered
+    by the scan (x2), plus ~3 per-step temporary planes (fit mask,
+    slack, onehot live ranges); then the scan slot inputs and the
+    assignment outputs. Spot-static rows are O(S) and negligible but
+    included for completeness.
+    """
+    plane = C * S * 4  # one f32/i32/u32 [C, S] plane
+    carries = 2 * (R + A + 1) * plane  # double-buffered scan state
+    temporaries = 3 * plane
+    slots = K * C * (R * 4 + 1 + W * 4 + A * 4)
+    outputs = 2 * C * K * 4  # chosen [K, C] + assignment [C, K]
+    spot_static = S * (R * 4 + 4 + 4 + W * 4 + 1 + A * 4)
+    return carries + temporaries + slots + outputs + spot_static
+
+
+def packed_shapes(packed) -> tuple:
+    """(C, K, S, R, W, A) from a PackedCluster (host or device arrays)."""
+    C, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    W = packed.spot_taints.shape[1]
+    A = packed.spot_aff.shape[1]
+    return C, K, S, R, W, A
+
+
+def device_hbm_budget(device=None) -> int:
+    """The per-device byte budget: ``bytes_limit`` from the backend's
+    memory stats when available (TPU runtimes publish it), else the
+    v5e default — scaled by the budget fraction."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        stats = device.memory_stats() or {}
+        limit = int(stats.get("bytes_limit") or 0)
+    except Exception:  # noqa: BLE001 — CPU/older runtimes: no stats
+        limit = 0
+    return int((limit or DEFAULT_HBM_BYTES) * BUDGET_FRACTION)
+
+
+def should_shard(
+    packed,
+    n_devices: int,
+    *,
+    budget_bytes: Optional[int] = None,
+) -> bool:
+    """True when the union program won't fit one chip AND a mesh exists
+    to shard it over. With one device this is always False — the caller
+    keeps the single-chip path and its honest OOM."""
+    if n_devices <= 1:
+        return False
+    budget = budget_bytes if budget_bytes else device_hbm_budget()
+    return estimate_union_hbm_bytes(*packed_shapes(packed)) > budget

@@ -63,6 +63,16 @@ class ReschedulerConfig:
     - ``repair_rounds`` — bounded eject-and-reinsert local-search rounds
       (solver/repair.py) for lanes both greedy passes fail; repaired
       placements are re-proven from scratch before use. 0 disables.
+    - ``auto_shard`` — when the packed problem's estimated footprint
+      exceeds one chip's HBM (solver/memory.py) and more than one device
+      is visible, the planner automatically reroutes the solve to the
+      mesh-sharded backend (first-fit ∪ best-fit over the device mesh;
+      the repair phase — whose search state is single-chip — is skipped
+      there, a conservative tradeoff: fewer proven drains, never an
+      invalid one). Off → the configured solver runs unconditionally
+      and a past-HBM problem fails with the backend's own OOM.
+    - ``solver_hbm_budget`` — per-device byte budget for that decision;
+      0 = auto-detect from the backend (v5e default 16 GB x 0.85).
     """
 
     running_in_cluster: bool = True
@@ -87,6 +97,8 @@ class ReschedulerConfig:
     max_drains_per_tick: int = 1
     fallback_best_fit: bool = True
     repair_rounds: int = 8
+    auto_shard: bool = True
+    solver_hbm_budget: int = 0
     # Observe via the incrementally-maintained columnar mirror
     # (models/columnar.py) when the cluster client provides one — the
     # vectorized replacement for the per-tick object-model rebuild. Off →

@@ -1,0 +1,79 @@
+"""Pin the bench's device-only estimation protocol (bench/protocol.py).
+
+The 1.03 ms/solve headline rests on (chain - rtt)/N math through a ~65 ms
+tunnel; these tests freeze the chain length, the median arithmetic, the
+zero clamp, and the chain program's actual iteration count so the
+methodology cannot silently change meaning between rounds.
+"""
+
+import math
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.bench.protocol import (
+    N_CHAIN,
+    device_only_ms,
+    make_chained,
+    protocol_record,
+)
+
+
+def test_chain_length_is_pinned():
+    # the recorded device-only numbers are quotients by THIS constant;
+    # changing it is a deliberate protocol break, not a refactor
+    assert N_CHAIN == 50
+
+
+def test_device_only_math():
+    # chain median 80 ms over 50 solves above a 60 ms floor -> 0.4 ms
+    chain = [0.081, 0.080, 0.079]
+    rtt = [0.060, 0.061, 0.060]
+    est = device_only_ms(chain, rtt, 50)
+    assert abs(est - (0.080 - 0.060) / 50 * 1e3) < 1e-9
+
+
+def test_device_only_uses_medians_not_means():
+    chain = [0.080, 0.080, 10.0]  # one straggler must not move the estimate
+    rtt = [0.060, 0.060, 5.0]
+    assert abs(device_only_ms(chain, rtt, 50) - 0.4) < 1e-9
+
+
+def test_device_only_clamps_negative_to_zero():
+    # tunnel variance: chain measured under the floor -> 0, not negative
+    assert device_only_ms([0.055], [0.060], 50) == 0.0
+
+
+def test_device_only_degenerate_inputs_are_nan():
+    assert math.isnan(device_only_ms([], [0.06], 50))
+    assert math.isnan(device_only_ms([0.08], [], 50))
+    assert math.isnan(device_only_ms([0.08], [0.06], 0))
+
+
+class _P(NamedTuple):
+    slot_req: jnp.ndarray
+
+
+def test_chained_program_runs_n_dependent_solves():
+    """The chained program must execute the solver exactly n times (its
+    scalar result is n x one solve's reduction) with each iteration
+    data-dependent on the last — the stub solver sums slot_req, so any
+    dropped or collapsed iteration changes the total."""
+    p = _P(slot_req=jnp.arange(6, dtype=jnp.float32).reshape(2, 3))
+    fused = lambda q: q.slot_req  # noqa: E731 — reducible output, like the planner's
+
+    for n in (1, 7):
+        chained = make_chained(fused, n)
+        got = float(np.asarray(chained(p)))
+        assert got == n * float(np.asarray(p.slot_req.sum())), n
+
+
+def test_protocol_record_carries_raw_inputs():
+    rec = protocol_record([0.080], [0.060], 50)
+    assert rec == {
+        "chain_len": 50,
+        "chain_ms": 80.0,
+        "rtt_ms": 60.0,
+        "device_only_ms": 0.4,
+    }

@@ -240,6 +240,45 @@ def test_pvc_shapes():
     _assert_pod_parity(objs)
 
 
+def test_any_pvc_resolvable_matches_views():
+    """The vectorized polling-path hint must equal the per-view scan it
+    replaces (kube._all_pods skips the 50k-view Python walk on it)."""
+    from k8s_spot_rescheduler_tpu.io.native_ingest import parse_pod_list
+
+    def vol_pod(name, volumes):
+        return _pod_obj(metadata={"name": name, "namespace": "ns1"},
+                        spec={"nodeName": "n1", "containers": [],
+                              "volumes": volumes})
+
+    cases = [
+        # no PVC anywhere -> False
+        [vol_pod("a", None), vol_pod("b", [])],
+        # resolvable claim -> True
+        [vol_pod("a", None),
+         vol_pod("b", [{"persistentVolumeClaim": {"claimName": "d"}}])],
+        # PVC present but voided name list -> False (F_PVC set, empty list)
+        [vol_pod("a", [{"persistentVolumeClaim": {}}])],
+        # PVC + unmodeled affinity (F_REQAFF) -> False
+        [_pod_obj(metadata={"name": "a", "namespace": "ns1"},
+                  spec={"nodeName": "n1", "containers": [],
+                        "volumes": [{"persistentVolumeClaim":
+                                     {"claimName": "d"}}],
+                        "affinity": {"podAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution":
+                                [{"topologyKey": "weird/key",
+                                  "labelSelector":
+                                      {"matchLabels": {"x": "y"}}}]}}})],
+    ]
+    for objs in cases:
+        body = json.dumps(
+            {"metadata": {"resourceVersion": "1"}, "items": objs}
+        ).encode()
+        batch = parse_pod_list(body)
+        assert batch is not None
+        want = any(v.pvc_resolvable for v in batch.views())
+        assert batch.any_pvc_resolvable() == want, objs
+
+
 def test_topology_spread_shapes():
     def spread_pod(name, spread):
         return _pod_obj(metadata={"name": name, "namespace": "ns1"},

@@ -46,3 +46,108 @@ def test_sharded_matches_oracle_randomized(seed):
     got = plan_ffd_sharded(mesh, packed)
     np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
     np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+# --- single-chip HBM guard + automatic mesh dispatch ----------------------
+
+def test_hbm_estimate_pins_measured_boundary():
+    """The dispatch estimate must reproduce the measured single-chip
+    envelope (docs/RESULTS.md): configs through 4x north star fit a
+    16 GB v5e; 8x does not. These shapes are the real packed dims of
+    config 3 at each scale (C=S=2560x, K=32, R=4, A=2)."""
+    from k8s_spot_rescheduler_tpu.solver.memory import (
+        BUDGET_FRACTION,
+        DEFAULT_HBM_BYTES,
+        estimate_union_hbm_bytes,
+    )
+
+    budget = int(DEFAULT_HBM_BYTES * BUDGET_FRACTION)
+    for mult in (1, 2, 4):
+        n = 2560 * mult
+        assert estimate_union_hbm_bytes(n, 32, n, 4, 2, 2) <= budget, mult
+    assert estimate_union_hbm_bytes(20480, 32, 20480, 4, 2, 2) > budget
+
+
+def test_should_shard_requires_mesh_and_pressure():
+    from k8s_spot_rescheduler_tpu.solver.memory import should_shard
+
+    packed, _ = _pack_drain_case(_test_spot_pool(), [500, 300])
+    # tiny problem: never shards, any device count
+    assert not should_shard(packed, 8)
+    # past budget but single device: keep the single-chip path (honest OOM)
+    assert not should_shard(packed, 1, budget_bytes=1)
+    # past budget with a mesh: shard
+    assert should_shard(packed, 8, budget_bytes=1)
+
+
+def _drainable_fake():
+    from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from tests.fixtures import (
+        ON_DEMAND_LABEL,
+        ON_DEMAND_LABELS,
+        SPOT_LABEL,
+        SPOT_LABELS,
+        make_node,
+        make_pod,
+    )
+
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("od-2", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    fc.add_pod(make_pod("a", 300, "od-1"))
+    fc.add_pod(make_pod("b", 200, "od-1"))
+    fc.add_pod(make_pod("c", 700, "od-2"))
+    nodes = fc.list_ready_nodes()
+    return build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+
+
+def test_planner_auto_dispatches_to_mesh_past_budget():
+    """End to end: a planner configured for the single-chip solver must
+    reroute to the mesh automatically when the problem exceeds the
+    (here: artificially tiny) HBM budget — same drain decision, solver
+    label records the reroute."""
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    node_map = _drainable_fake()
+    want = SolverPlanner(ReschedulerConfig(solver="numpy")).plan(node_map, [])
+
+    cfg = ReschedulerConfig(solver="jax", solver_hbm_budget=1)
+    planner = SolverPlanner(cfg)
+    report = planner.plan(node_map, [])
+    assert report.solver == "jax+sharded"
+    assert planner.last_solver == "jax+sharded"
+    assert report.n_feasible == want.n_feasible
+    assert report.plan is not None and want.plan is not None
+    assert report.plan.node.node.name == want.plan.node.node.name
+    assert report.plan.assignments == want.plan.assignments
+
+
+def test_planner_auto_dispatch_off_keeps_configured_path():
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    node_map = _drainable_fake()
+    cfg = ReschedulerConfig(
+        solver="jax", solver_hbm_budget=1, auto_shard=False
+    )
+    report = SolverPlanner(cfg).plan(node_map, [])
+    assert report.solver == "jax"
+
+
+def test_planner_no_dispatch_under_budget():
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    node_map = _drainable_fake()
+    report = SolverPlanner(ReschedulerConfig(solver="jax")).plan(node_map, [])
+    assert report.solver == "jax"

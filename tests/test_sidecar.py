@@ -153,7 +153,8 @@ def test_concurrent_requests_all_served():
     import threading
 
     s = PlannerSidecar(
-        ReschedulerConfig(solver="numpy"), "127.0.0.1:0", busy_timeout_s=30.0
+        ReschedulerConfig(solver="numpy"), "127.0.0.1:0",
+        busy_timeout_s=30.0, max_inflight=8,
     )
     s.start_background()
     try:
@@ -175,6 +176,65 @@ def test_concurrent_requests_all_served():
         assert all(code == 200 for code, _ in results), results
         assert all(out["found"] for _, out in results)
     finally:
+        s.close()
+
+
+def test_inflight_depth_cap_rejects_immediately():
+    """Past max_inflight concurrent requests, /v1/plan 503s IMMEDIATELY —
+    before reading the body — so a burst of oversize-adjacent requests
+    holds at most max_inflight bodies in memory (the busy timeout alone
+    capped queue time, not depth)."""
+    import threading
+    import time
+
+    s = PlannerSidecar(
+        ReschedulerConfig(solver="numpy"), "127.0.0.1:0",
+        busy_timeout_s=30.0, max_inflight=2,
+    )
+    release = threading.Event()
+    inner = s.planner
+
+    class Gated:
+        def plan(self, node_map, pdbs):
+            release.wait(timeout=30)
+            return inner.plan(node_map, pdbs)
+
+    s.planner = Gated()
+    s.start_background()
+    try:
+        body = json.dumps({
+            "nodes": [_node("od-1", "worker"), _node("spot-1", "spot-worker")],
+            "pods": [_pod("a", "od-1", cpu="100m")],
+        }).encode()
+        slow_results = []
+
+        def fire_slow():
+            slow_results.append(_post_raw(s, body))
+
+        # fill both inflight slots: one solving (gated), one lock-waiting
+        occupants = [threading.Thread(target=fire_slow) for _ in range(2)]
+        for t in occupants:
+            t.start()
+            time.sleep(0.2)
+
+        # burst past the cap: each must reject fast (well under the 30 s
+        # busy timeout) while the gate still holds both slots
+        t0 = time.monotonic()
+        burst = [_post_raw(s, body) for _ in range(4)]
+        burst_s = time.monotonic() - t0
+        assert all(code == 503 for code, _ in burst), burst
+        assert all("overloaded" in out["error"] for _, out in burst), burst
+        assert burst_s < 5.0, f"depth rejection waited: {burst_s:.1f}s"
+
+        release.set()
+        for t in occupants:
+            t.join()
+        assert sorted(c for c, _ in slow_results) == [200, 200], slow_results
+        # slots drain: a fresh request is admitted again
+        code, out = _post_raw(s, body)
+        assert code == 200 and out["found"]
+    finally:
+        release.set()
         s.close()
 
 

@@ -168,6 +168,60 @@ def test_match_on_od_node_repels_same_zone_spot():
     assert _placement(fc, "web") == "spot-b1"
 
 
+def test_match_on_unclassified_node_repels_same_zone_spot():
+    """Regression (advisor r3, medium): zone presence must span pods on
+    UNCLASSIFIED ready nodes — a match resident on e.g. a control-plane
+    node in zone a still repels the requirer from every zone-a node in
+    the real scheduler. Before the fix this drain planned into zone a
+    and the pod stranded."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("cp-1", _zone_labels({}, "a")))  # neither label
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-0", 100, "cp-1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    assert _placement(fc, "web") == "spot-b1"
+    _parity(fc)
+
+
+def test_requirer_on_unclassified_node_repels_matches():
+    """Symmetric direction: a REQUIRER on an unclassified zone-a node
+    repels matched pods zone-wide — its selector must reach the zone
+    universe even though the pod is on no listed node class."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("cp-1", _zone_labels({}, "a")))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("guard", 100, "cp-1",
+                        anti_affinity_zone_match={"tier": "cache"}))
+    fc.add_pod(make_pod("cache", 300, "od-1", labels={"tier": "cache"}))
+    assert _placement(fc, "cache") == "spot-b1"
+    _parity(fc)
+
+
+def test_unready_unclassified_node_invisible_both_paths():
+    """An UNREADY unclassified node's pods stay invisible on both paths
+    (the polling lister only returns ready nodes; the columnar widening
+    gates on readiness to keep bit parity)."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    cp = make_node("cp-1", _zone_labels({}, "a"))
+    cp.ready = False
+    fc.add_node(cp)
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-0", 100, "cp-1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    # note: spot-a1 sorts before spot-b1 (ties keep insertion order), so
+    # with cp-1 invisible the requirer lands in zone a
+    assert _placement(fc, "web") == "spot-a1"
+    _parity(fc)
+
+
 def test_lane_guard_two_requirers():
     """Two pods carrying the same zone identity in one lane: static bits
     cannot prove the in-plan interaction safe -> lane conservatively
