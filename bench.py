@@ -249,6 +249,52 @@ def run_quality(seed: int, sweep: int = 1, solver: str = "numpy") -> int:
     return 0
 
 
+def run_quality_boundary(seed: int, sweep: int = 1) -> int:
+    """The PUBLISHED repair boundary (docs/RESULTS.md): configs where
+    shipped < ILP by construction — the two-pod interlock that depth-1
+    eject-reinsert cannot express. Kept out of the headline worst-ratio
+    metric; this mode documents the number and watches it for drift."""
+    from k8s_spot_rescheduler_tpu.bench.quality import (
+        drain_to_exhaustion,
+        ilp_max_drains,
+        pack_quality,
+    )
+    from k8s_spot_rescheduler_tpu.io.synthetic import (
+        BOUNDARY_CONFIGS,
+        generate_quality_cluster,
+    )
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    worst = 1.0
+    for name, spec in BOUNDARY_CONFIGS.items():
+        for s in range(seed, seed + max(1, sweep)):
+            packed = pack_quality(spec, s)
+            ilp = ilp_max_drains(packed)
+            client = generate_quality_cluster(spec, s, reschedule_evicted=True)
+            shipped = drain_to_exhaustion(
+                client, ReschedulerConfig(solver="numpy",
+                                          resources=spec.resources)
+            )
+            ratio = shipped / ilp if ilp else 1.0
+            worst = min(worst, ratio)
+            print(
+                f"boundary {name} seed {s}: ILP {ilp}  shipped {shipped} "
+                f"({ratio:.3f})",
+                file=sys.stderr,
+            )
+    emit(
+        {
+            "metric": "repair_boundary_interlock_ratio",
+            "value": round(worst, 4),
+            "unit": "ratio",
+            "vs_baseline": None,
+            "note": "published depth-1 eject-reinsert boundary; see "
+                    "docs/RESULTS.md",
+        }
+    )
+    return 0
+
+
 def run_quality_scale(args, metric: str, unit: str, backend_note) -> int:
     """Quality at north-star scale, where the ILP is intractable: the
     LP-relaxation/Hall upper bound (bench/quality.lp_upper_bound) vs the
@@ -335,6 +381,8 @@ def _metric_for(args) -> tuple:
     failure paths can emit a well-formed JSON line."""
     if args.quality:
         return "nodes_freed_vs_ilp_oracle_ratio", "ratio"
+    if args.quality_boundary:
+        return "repair_boundary_interlock_ratio", "ratio"
     if args.quality_scale:
         return (
             "nodes_freed_vs_lp_bound_ratio_config%d" % args.config,
@@ -370,6 +418,10 @@ def main() -> int:
                     help="quality at full scale: controller drains to "
                          "exhaustion vs the LP/Hall upper bound (the ILP "
                          "is intractable at config 3/4 scale)")
+    ap.add_argument("--quality-boundary", action="store_true",
+                    help="document the published repair boundary (two-pod "
+                         "interlock pools where shipped < ILP by "
+                         "construction; excluded from the headline metric)")
     ap.add_argument("--sweep", type=int, default=1,
                     help="with --quality: run this many consecutive seeds "
                          "and report the worst ratio")
@@ -404,6 +456,8 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         return run_quality(
             args.seed, sweep=args.sweep, solver=args.solver or "numpy"
         )
+    if args.quality_boundary:
+        return run_quality_boundary(args.seed, sweep=args.sweep)
     if args.quality_scale:
         # host-side controller + solver at scale; the jax CPU/device solver
         # drives the multi-drain exhaustion run

@@ -33,8 +33,19 @@ def ilp_max_drains(
     Variables: y_c (drain candidate c), x_{(c,k),s} (slot (c,k) placed on
     spot s, only for statically-admissible pairs). Constraints:
     sum_s x = y_c per valid slot; per-spot resource capacity; per-spot pod
-    count. Anti-affinity is not modeled — use affinity-free clusters for
-    quality runs. Returns None if the solver fails.
+    count; hostname anti-affinity as (a) static exclusion of spots whose
+    RESIDENT bits conflict with the slot and (b) pairwise
+    ``x_i,s + x_j,s <= 1`` for slot pairs with overlapping affinity
+    words. The bit-overlap rule is exact for the self-selecting group
+    pattern the quality configs use (each group's pods carry and are
+    matched by one distinct selector, so overlap ⇔ a genuine scheduler
+    conflict); for arbitrary selector soups the overlap over-approximates
+    conflicts (masks.py's safe direction), which would TIGHTEN this
+    oracle below the true optimum — keep quality clusters to the
+    self-selecting shape. Zone-family bits get the same per-node pair
+    rule, which is weaker than the real zone-wide constraint — weaker
+    only ever loosens the oracle, so the bound stays valid. Returns None
+    if the solver fails.
     """
     C, K, R = packed.slot_req.shape
     S = packed.spot_free.shape[0]
@@ -44,12 +55,17 @@ def ilp_max_drains(
     if not cands:
         return 0
 
-    # static admissibility per (slot, spot): taints + node_ok
+    # static admissibility per (slot, spot): taints + node_ok + resident
+    # anti-affinity bits
     taint_ok = np.all(
         (packed.spot_taints[None, None] & ~packed.slot_tol[:, :, None]) == 0,
         axis=-1,
     )  # [C,K,S]
-    ok_spots = packed.spot_ok[None, None] & taint_ok
+    aff_ok = np.all(
+        (packed.spot_aff[None, None] & packed.slot_aff[:, :, None]) == 0,
+        axis=-1,
+    )  # [C,K,S]
+    ok_spots = packed.spot_ok[None, None] & taint_ok & aff_ok
 
     # variable layout: y for each cand, then x for admissible pairs
     y_index = {c: i for i, c in enumerate(cands)}
@@ -100,6 +116,27 @@ def ilp_max_drains(
             lb.append(-np.inf)
             ub.append(float(packed.spot_max_pods[s] - packed.spot_count[s]))
             row += 1
+
+    # pairwise anti-affinity: two moved slots with overlapping affinity
+    # words may not share one spot node (same or different lanes)
+    x_index = {(c, k, s): j for j, (c, k, s) in enumerate(x_pairs)}
+    aff_slots = [sl for sl in slots if packed.slot_aff[sl[0], sl[1]].any()]
+    for a in range(len(aff_slots)):
+        c1, k1 = aff_slots[a]
+        w1 = packed.slot_aff[c1, k1]
+        for b in range(a + 1, len(aff_slots)):
+            c2, k2 = aff_slots[b]
+            if not np.any(w1 & packed.slot_aff[c2, k2]):
+                continue
+            for s in range(S):
+                j1 = x_index.get((c1, k1, s))
+                j2 = x_index.get((c2, k2, s))
+                if j1 is None or j2 is None:
+                    continue
+                rows.append(row), cols.append(n_y + j1), vals.append(1.0)
+                rows.append(row), cols.append(n_y + j2), vals.append(1.0)
+                lb.append(-np.inf), ub.append(1.0)
+                row += 1
 
     A = sp.csr_matrix((vals, (rows, cols)), shape=(row, n))
     c_obj = np.zeros(n)

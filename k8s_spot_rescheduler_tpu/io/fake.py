@@ -171,6 +171,11 @@ class FakeCluster:
         # nodes are invisible to the controller.
         return [n for n in self.nodes.values() if n.ready]
 
+    def list_unready_nodes(self) -> List[NodeSpec]:
+        # presence-only visibility (NodeMap.unready): zone/spread counts
+        # span these nodes' pods; they are never planning surface
+        return [n for n in self.nodes.values() if not n.ready]
+
     def list_pods_on_node(self, node_name: str) -> List[PodSpec]:
         return list(self._by_node.get(node_name, {}).values())
 
@@ -283,6 +288,28 @@ class FakeCluster:
                     tuple(p.anti_affinity_zone_match.items()),
                 ):
                     return False
+        # hard topology-spread (canonical shapes): refuse placements
+        # that would exceed maxSkew — kube-scheduler's PodTopologySpread
+        # filter over existing pods (the evicted pod is pending, so it
+        # is already off its old node here), incl. the selfMatch rule
+        for topo, skew, items in pod.spread_constraints:
+            d = node.labels.get(topo)
+            if d is None:
+                return False  # nodes lacking the key are filtered
+            counts: Dict[str, int] = {}
+            for n2 in self.nodes.values():
+                d2 = n2.labels.get(topo)
+                if d2 is None:
+                    continue
+                counts.setdefault(d2, 0)
+                for p in self.list_pods_on_node(n2.name):
+                    if p.namespace == pod.namespace and all(
+                        p.labels.get(k) == v for k, v in items
+                    ):
+                        counts[d2] += 1
+            self_m = all(pod.labels.get(k) == v for k, v in items)
+            if counts[d] + (1 if self_m else 0) - min(counts.values()) > skew:
+                return False
         return pod.requests.get(CPU, 0) <= free_cpu and (
             pod.requests.get(MEMORY, 0) <= free_mem
         )

@@ -119,15 +119,13 @@ def decode_pod(obj: dict) -> PodSpec:
                 break
     # Hard topology-spread constraints are scheduling predicates the
     # reference's CheckPredicates enforces (PodTopologySpread plugin,
-    # README.md:103-114) but this model does not: ignoring them would
-    # approve drains the real scheduler then refuses — the unsafe
-    # direction. whenUnsatisfiable defaults to DoNotSchedule (hard);
-    # only explicit ScheduleAnyway entries are soft and ignorable.
-    spread = spec.get("topologySpreadConstraints") or []
-    hard_spread = not isinstance(spread, list) or any(
-        not isinstance(c, dict)
-        or c.get("whenUnsatisfiable", "DoNotSchedule") != "ScheduleAnyway"
-        for c in spread
+    # README.md:103-114). The canonical shape is modeled
+    # (decode_topology_spread → SpreadBit pseudo-taints in the packers);
+    # anything beyond it stays conservatively unplaceable — ignoring a
+    # hard constraint would approve drains the real scheduler then
+    # refuses, the unsafe direction.
+    spread_constraints, hard_spread = decode_topology_spread(
+        spec.get("topologySpreadConstraints")
     )
     return PodSpec(
         name=meta.get("name", ""),
@@ -145,6 +143,7 @@ def decode_pod(obj: dict) -> PodSpec:
         anti_affinity_zone_match=anti_zone_match,
         pod_affinity_match=pod_affinity_match,
         node_affinity=node_affinity,
+        spread_constraints=spread_constraints,
         pvc_names=tuple(pvc_names),
         pvc_resolvable=bool(
             has_pvc and pvc_names and not (required_affinity or hard_spread)
@@ -309,6 +308,67 @@ def decode_pod_affinity(paff: dict) -> tuple:
         paff, ("kubernetes.io/hostname",)
     )
     return match, unmodeled
+
+
+# Fields whose presence changes PodTopologySpread counting semantics in
+# ways this model does not reproduce; a hard constraint carrying any of
+# them stays conservatively unmodeled (even an explicit default value —
+# mirroring the namespaceSelector treatment in _decode_affinity_block).
+_SPREAD_MODIFIER_KEYS = (
+    "minDomains",
+    "matchLabelKeys",
+    "nodeAffinityPolicy",
+    "nodeTaintsPolicy",
+)
+_SPREAD_TOPOLOGY_KEYS = ("kubernetes.io/hostname", ZONE_TOPOLOGY_KEY)
+
+
+def decode_topology_spread(spread) -> tuple:
+    """(canonical hard constraints, unmodeled) for a pod's
+    topologySpreadConstraints list.
+
+    Modeled (in exact lockstep with native/ingest.cc): each HARD entry
+    (whenUnsatisfiable absent or DoNotSchedule — the k8s default) with
+    topologyKey hostname/zone, integer maxSkew >= 1, a non-empty
+    matchLabels-only labelSelector, and none of the counting-semantics
+    modifier fields (minDomains, matchLabelKeys, nodeAffinityPolicy,
+    nodeTaintsPolicy). Explicit ScheduleAnyway entries are soft —
+    advisory to the real scheduler — and dropped. Any hard entry beyond
+    the canonical shape marks the whole pod unmodeled (conservatively
+    unplaceable). Canonical form: (topology_key, max_skew, sorted
+    selector items), entry list sorted+deduped."""
+    if not spread:
+        return (), False
+    if not isinstance(spread, list):
+        return (), True
+    out = []
+    for c in spread:
+        if not isinstance(c, dict):
+            return (), True
+        if c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway":
+            continue  # soft: the scheduler only prefers, never refuses
+        if any(k in c for k in _SPREAD_MODIFIER_KEYS):
+            return (), True
+        topo = c.get("topologyKey")
+        if topo not in _SPREAD_TOPOLOGY_KEYS:
+            return (), True
+        skew = c.get("maxSkew")
+        if not isinstance(skew, int) or isinstance(skew, bool) or skew < 1:
+            return (), True
+        sel = c.get("labelSelector")
+        if not isinstance(sel, dict) or sel.get("matchExpressions"):
+            return (), True
+        match = sel.get("matchLabels")
+        if not isinstance(match, dict) or not match:
+            return (), True
+        if any(
+            not isinstance(k, str) or not isinstance(v, str)
+            or _has_sep_bytes(k) or _has_sep_bytes(v)
+            for k, v in match.items()
+        ):
+            return (), True
+        out.append((topo, skew, tuple(sorted(match.items()))))
+    return tuple(sorted(set(out))), False
 
 
 def decode_pvc(obj: dict) -> "PVCSpec":
@@ -506,6 +566,22 @@ class KubeClusterClient:
         # the reference's ReadyNodeLister surfaces only ready nodes
         return [n for n in nodes if n.ready]
 
+    def list_unready_nodes(self) -> List[NodeSpec]:
+        """Presence-only node view (NodeMap.unready): zone/spread counts
+        must span not-ready nodes' pods (they still exist to the real
+        scheduler; PodTopologySpread's default nodeTaintsPolicy=Ignore
+        counts their domains)."""
+        from k8s_spot_rescheduler_tpu.io import native_ingest
+
+        if self.use_native_ingest and native_ingest.available():
+            batch = native_ingest.parse_node_list(
+                self._request_raw("GET", "/api/v1/nodes")
+            )
+            if batch is not None:
+                return [n for n in batch.views() if not n.ready]
+        items = self._request("GET", "/api/v1/nodes").get("items", [])
+        return [n for n in (decode_node(o) for o in items) if not n.ready]
+
     def _all_pods(self) -> Dict[str, List[PodSpec]]:
         if self._pods_cache is None:
             from k8s_spot_rescheduler_tpu.io import native_ingest
@@ -518,9 +594,11 @@ class KubeClusterClient:
                 )
                 if batch is not None:
                     pods = batch.views()
-                    pvc_hint = bool(
-                        (batch.u8[:, 0] & native_ingest.F_PVC).any()
-                    )
+                    # exact vectorized "any pod is resolvable" — not just
+                    # "any pod has a PVC", which would send every tick of
+                    # a PVC-carrying cluster through a 50k-view Python
+                    # scan below (advisor r3)
+                    pvc_hint = batch.any_pvc_resolvable()
             if pods is None:
                 items = self._request("GET", "/api/v1/pods").get("items", [])
                 pods = [decode_pod(obj) for obj in items]
@@ -556,12 +634,16 @@ class KubeClusterClient:
         claims) and fold bound PVs' nodeAffinity into the pods
         (models/volumes.py). Any fetch/decode failure leaves the pods as
         decoded — placeable nowhere, the safe direction. ``pvc_hint``
-        False skips the per-pod scan entirely (the native batch path
-        precomputes it vectorized — 50k lazy property reads per tick
-        would cost real time on the hot path)."""
+        (the native batch path precomputes it vectorized, exactly the
+        PodView.pvc_resolvable predicate) is authoritative in BOTH
+        directions: False skips the per-pod scan entirely, True skips
+        the redundant re-check — 50k lazy property reads per tick would
+        cost real time on the hot path."""
         if pvc_hint is False:
             return pods
-        if not any(getattr(p, "pvc_resolvable", False) for p in pods):
+        if pvc_hint is None and not any(
+            getattr(p, "pvc_resolvable", False) for p in pods
+        ):
             return pods
         from k8s_spot_rescheduler_tpu.models.volumes import (
             maybe_resolve_view,

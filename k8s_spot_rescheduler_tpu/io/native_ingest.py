@@ -50,11 +50,11 @@ F_PVC, F_REQAFF = 32, 64
 # pod column indices
 P_CPU, P_MEM, P_EPH = 0, 1, 2
 (P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID,
- P_AAFFID, P_NAFFID, P_PAFFID, P_ZAFFID, P_PVCID) = range(11)
+ P_AAFFID, P_NAFFID, P_PAFFID, P_ZAFFID, P_PVCID, P_SPREADID) = range(12)
 PS_NAME, PS_UID = range(2)
 # interned-table families
 (TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_AAFF,
- TBL_NAFF, TBL_PAFF, TBL_ZAFF, TBL_PVC) = range(10)
+ TBL_NAFF, TBL_PAFF, TBL_ZAFF, TBL_PVC, TBL_SPREAD) = range(11)
 # node column indices
 N_CPU, N_MEM, N_EPH, N_PODS = range(4)
 N_READY, N_UNSCHED, N_HASPODS = range(3)
@@ -100,13 +100,13 @@ def _lib() -> Optional[ctypes.CDLL]:
     try:
         ok = (
             lib.pod_ncols_i64() == 3
-            and lib.pod_ncols_i32() == 11
+            and lib.pod_ncols_i32() == 12
             and lib.pod_ncols_u8() == 1
             and lib.pod_ncols_str() == 2
             and lib.node_ncols_i64() == 4
             and lib.node_ncols_u8() == 3
             and lib.node_ncols_str() == 4
-            and lib.table_count() == 10
+            and lib.table_count() == 11
         )
     except AttributeError:
         ok = False
@@ -199,6 +199,25 @@ def _parse_kv(blob: bytes) -> Dict[str, str]:
 
 
 @functools.lru_cache(maxsize=4096)
+def _parse_spread(blob: bytes) -> Tuple:
+    """Spread blob (ingest.cc extract_topology_spread) -> the exact
+    canonical tuples io/kube.py ``decode_topology_spread`` produces:
+    (topology_key, max_skew, sorted selector items), entries
+    sorted+deduped. The engine emits source order; canonicalization
+    lives here (same contract as the node-affinity blob)."""
+    if not blob:
+        return ()
+    out = []
+    for rec in blob.decode().split(_REC):
+        topo, skew, pairs = rec.split(_UNIT)
+        items = tuple(
+            sorted(tuple(p.split(_VAL, 1)) for p in pairs.split(_TERM))
+        )
+        out.append((topo, int(skew), items))
+    return tuple(sorted(set(out)))
+
+
+@functools.lru_cache(maxsize=4096)
 def _parse_node_affinity(blob: bytes) -> Tuple:
     """Node-affinity blob (ingest.cc extract_node_affinity) -> the exact
     canonical tuples io/kube.py ``decode_node_affinity`` produces: terms
@@ -261,6 +280,7 @@ class PodBatch:
             tuple(b.decode().split(_REC)) if b else () for b in tables[TBL_PVC]
         ]
         self.naff_sets = [_parse_node_affinity(b) for b in tables[TBL_NAFF]]
+        self.spread_sets = [_parse_spread(b) for b in tables[TBL_SPREAD]]
 
     def match_set(self, set_id: int) -> Dict[str, str]:
         return self.match_sets[set_id]
@@ -434,6 +454,10 @@ class PodView:
         )
 
     @property
+    def spread_constraints(self) -> tuple:
+        return self._b.spread_sets[int(self._b.i32[self._i, P_SPREADID])]
+
+    @property
     def node_selector(self) -> Dict[str, str]:
         return self._b.selector_set(int(self._b.i32[self._i, P_SELID]))
 
@@ -484,6 +508,7 @@ class PodView:
             pvc_resolvable=self.pvc_resolvable,
             pod_affinity_match=dict(self.pod_affinity_match),
             node_affinity=self.node_affinity,
+            spread_constraints=self.spread_constraints,
             unmodeled_constraints=self.unmodeled_constraints,
         )
 
@@ -593,7 +618,7 @@ def parse_pod_list(data: bytes) -> Optional[PodBatch]:
     handle = lib.ingest_pods(data, len(data))
     if not handle:
         return None
-    return PodBatch(*_copy_batch(lib, handle, 3, 11, 1, 2, tables=10))
+    return PodBatch(*_copy_batch(lib, handle, 3, 12, 1, 2, tables=11))
 
 
 def parse_node_list(data: bytes) -> Optional[NodeBatch]:

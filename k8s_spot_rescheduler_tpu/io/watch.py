@@ -587,6 +587,11 @@ class WatchingKubeClusterClient:
         self._view()
         return [n for n in self._tick_nodes if n.ready]
 
+    def list_unready_nodes(self) -> List[NodeSpec]:
+        # presence-only view (NodeMap.unready; zone/spread counts)
+        self._view()
+        return [n for n in self._tick_nodes if not n.ready]
+
     def list_pods_on_node(self, node_name: str) -> List[PodSpec]:
         self._view()
         return list(self._pods_by_node.get(node_name, []))

@@ -96,8 +96,16 @@ class Rescheduler:
     def observe(self) -> Optional[NodeMap]:
         try:
             nodes = self.client.list_ready_nodes()
+            # not-ready nodes are presence-only (zone/spread counts —
+            # their pods still exist to the real scheduler). All in-tree
+            # clients implement the lister; the fallback exists for
+            # third-party clients, whose spread/zone verdicts then rest
+            # on ready-node visibility alone.
+            lister = getattr(self.client, "list_unready_nodes", None)
+            unready = lister() if lister is not None else []
             pods_by_node = {
-                n.name: self.client.list_pods_on_node(n.name) for n in nodes
+                n.name: self.client.list_pods_on_node(n.name)
+                for n in list(nodes) + list(unready)
             }
         except Exception as err:  # noqa: BLE001 — skip tick on any API error
             log.error("Failed to list cluster state: %s", err)
@@ -108,6 +116,7 @@ class Rescheduler:
             on_demand_label=self.config.on_demand_node_label,
             spot_label=self.config.spot_node_label,
             priority_threshold=self.config.priority_threshold,
+            unready_nodes=unready,
         )
 
     def _update_metrics(self, node_map: NodeMap, pdbs) -> None:

@@ -285,17 +285,21 @@ class NodeMap:
     """Reference nodes/nodes.go:37-39, 54-60 ``Map``: node infos keyed by
     class, in planning order.
 
-    ``other`` holds ready nodes matching neither class label. The
-    reference drops them outright (nodes/nodes.go:90-91) and so does our
-    planning surface — but their RESIDENT PODS still exist to the real
-    scheduler, so zone-topology anti-affinity presence must span them
-    (a requirer on a control-plane node repels matches zone-wide). The
-    packers fold ``other`` pods into the zone accumulation only; they
-    never become candidates or placement targets."""
+    ``other`` holds ready nodes matching neither class label; ``unready``
+    holds not-ready nodes of ANY class (the reference's lister drops
+    both, rescheduler.go:154 / nodes/nodes.go:90-91, and so does our
+    planning surface) — but their RESIDENT PODS still exist to the real
+    scheduler: zone anti-affinity presence reaches them, and
+    PodTopologySpread counts their domains and pods (NotReady manifests
+    as taints, which the default nodeTaintsPolicy=Ignore ignores).
+    Missing either could approve a drain the scheduler then refuses.
+    The packers fold both buckets into the zone/spread presence only;
+    they never become candidates or placement targets."""
 
     on_demand: List[NodeInfo]
     spot: List[NodeInfo]
     other: List[NodeInfo] = dataclasses.field(default_factory=list)
+    unready: List[NodeInfo] = dataclasses.field(default_factory=list)
 
 
 def is_spot_node(node: NodeSpec, spot_label: str) -> bool:
@@ -313,6 +317,7 @@ def build_node_map(
     on_demand_label: str,
     spot_label: str,
     priority_threshold: int = 0,
+    unready_nodes: Sequence[NodeSpec] = (),
 ) -> NodeMap:
     """Classify and sort nodes; reference nodes/nodes.go:63-119 ``NewNodeMap``
     + ``newNodeInfo`` + ``getPodsOnNode``.
@@ -354,4 +359,10 @@ def build_node_map(
     # input order here, which is deterministic for our packers.
     spot.sort(key=lambda n: n.requested_cpu, reverse=True)
     on_demand.sort(key=lambda n: n.requested_cpu)
-    return NodeMap(on_demand=on_demand, spot=spot, other=other)
+    # not-ready nodes (any class): presence-only visibility, no planning
+    unready = [
+        NodeInfo.build(n, pods_by_node.get(n.name, []))
+        for n in unready_nodes
+    ]
+    return NodeMap(on_demand=on_demand, spot=spot, other=other,
+                   unready=unready)

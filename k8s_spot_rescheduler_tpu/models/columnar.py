@@ -62,12 +62,14 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     NodeAffinityBit,
     PodAffinityBit,
     SelectorBit,
+    SpreadBit,
     Taint,
     TaintTable,
     affinity_bits,
     intern_constraints,
     match_affinity_mask,
     match_node_affinity,
+    spread_lane_guard,
     ZONE_LABEL,
     zone_lane_guard,
     zone_match_affinity_mask,
@@ -287,6 +289,7 @@ class ColumnarStore:
         self._naff_keys: List[str] = []  # label keys affinity exprs read
         self._naff_uses_name = False  # any FieldIn/FieldNotIn term active
         self._paff_section: tuple = (0, ())  # positive pod-affinity bits
+        self._spread_section: tuple = (0, ())  # per-tick spread verdicts
         self._unplace_pos: int = 0
         self._real_tol_pos: Dict[tuple, tuple] = {}
         self._sel_tol_pos: Dict[tuple, tuple] = {}
@@ -495,7 +498,7 @@ class ColumnarStore:
         self.p_flags[r] = flags
         # one interned id per distinct scheduling-constraint profile:
         # (tolerations, nodeSelector, node-affinity, pod-affinity,
-        # unmodeled flag)
+        # spread constraints, unmodeled flag)
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
@@ -503,6 +506,11 @@ class ColumnarStore:
             (
                 (pod.namespace, tuple(sorted(pod.pod_affinity_match.items())))
                 if pod.pod_affinity_match
+                else ()
+            ),
+            (
+                (pod.namespace, tuple(pod.spread_constraints))
+                if getattr(pod, "spread_constraints", ())
                 else ()
             ),
             bool(pod.unmodeled_constraints),
@@ -634,8 +642,19 @@ class ColumnarStore:
             bool,
             count=len(batch.paff_sets),
         )[paff_ids]
+        spread_ids = batch.i32[keep, ni.P_SPREADID]
+        spread_nonempty = np.fromiter(
+            (len(s) > 0 for s in batch.spread_sets),
+            bool,
+            count=len(batch.spread_sets),
+        )[spread_ids]
+        # paff and spread identities are namespace-scoped: the namespace
+        # joins the combo only when either is non-empty (keeping plain
+        # pods to one profile per shape)
         ns_eff = np.where(
-            paff_nonempty, batch.i32[keep, ni.P_NSID], np.int32(-1)
+            paff_nonempty | spread_nonempty,
+            batch.i32[keep, ni.P_NSID],
+            np.int32(-1),
         )
         combos = np.stack(
             [
@@ -643,6 +662,7 @@ class ColumnarStore:
                 batch.i32[keep, ni.P_SELID],
                 batch.i32[keep, ni.P_NAFFID],
                 paff_ids,
+                spread_ids,
                 ns_eff,
                 unmod.astype(np.int32),
             ],
@@ -650,8 +670,11 @@ class ColumnarStore:
         )
         uniq, inverse = np.unique(combos, axis=0, return_inverse=True)
         ids = np.empty(len(uniq), np.int32)
-        for i, (tol_id, sel_id, naff_id, paff_id, ns_id, um) in enumerate(uniq):
+        for i, (tol_id, sel_id, naff_id, paff_id, spread_id, ns_id, um) in (
+            enumerate(uniq)
+        ):
             paff_set = batch.paff_set(int(paff_id))
+            spread_set = batch.spread_sets[int(spread_id)]
             key = (
                 tuple(batch.tol_sets[tol_id]),
                 tuple(sorted(batch.selector_set(int(sel_id)).items())),
@@ -660,6 +683,11 @@ class ColumnarStore:
                     (batch.namespaces[int(ns_id)],
                      tuple(sorted(paff_set.items())))
                     if paff_set
+                    else ()
+                ),
+                (
+                    (batch.namespaces[int(ns_id)], tuple(spread_set))
+                    if spread_set
                     else ()
                 ),
                 bool(um),
@@ -771,13 +799,18 @@ class ColumnarStore:
                 self.n_unsched[r] = obj.unschedulable
 
     def _build_taint_table(
-        self, spot_order: np.ndarray, slot_rows: np.ndarray
+        self,
+        spot_order: np.ndarray,
+        slot_rows: np.ndarray,
+        spread_bits: Sequence = (),
     ) -> TaintTable:
         """Intern the constraint table over ready spot nodes in probe
         order, with the slot pods' nodeSelector universe as the
         pseudo-taint tail — identical bit layout to the object packer
         (``masks.intern_constraints`` over the sorted ``node_map.spot``
-        and the concatenated ``cand_pods``)."""
+        and the concatenated ``cand_pods``). ``spread_bits`` is the
+        tick's sorted SpreadBit universe (computed in pack() — it needs
+        match counts, which live there)."""
         pairs = set()
         naffs = set()
         paffs = set()
@@ -794,7 +827,113 @@ class ColumnarStore:
             sorted(pairs),
             sorted(naffs),
             sorted(paffs),
+            spread_bits,
         )
+
+    def _spread_contexts(
+        self,
+        slot_rows: np.ndarray,
+        p_node: np.ndarray,
+        visible: np.ndarray,
+        presence_extra: np.ndarray,
+        od_rows: np.ndarray,
+        spot_rows: np.ndarray,
+    ) -> Tuple[Dict[int, frozenset], list]:
+        """Per-carrier-slot SpreadBit sets + the sorted universe — the
+        columnar mirror of tensors._build_spread_bits, bit-identical by
+        construction (same compute_spread_bit, same visibility rule:
+        counted pods of both classes + pods on unclassified-ready and
+        not-ready nodes; domains over every visible node). Carriers are
+        found via a per-profile flag array indexed by p_tol_id (plain
+        clusters pay O(#profiles), not O(#slots)); matches come from
+        the PDB label index."""
+        if not len(slot_rows):
+            return {}, []
+        prof_has_spread = np.fromiter(
+            (bool(prof[4]) for prof in self._tol_lists),
+            bool,
+            count=len(self._tol_lists),
+        )
+        has_spread = prof_has_spread[self.p_tol_id[slot_rows]]
+        if not has_spread.any():
+            return {}, []
+        from k8s_spot_rescheduler_tpu.predicates.masks import (
+            compute_spread_bit,
+            spread_self_match,
+        )
+
+        hi = len(visible)
+        visible_nodes = sorted(
+            set(int(r) for r in od_rows)
+            | set(int(r) for r in spot_rows)
+            | set(np.nonzero(presence_extra)[0].tolist())
+        )
+        domain_cache: Dict = {}
+        count_cache: Dict = {}
+        bit_cache: Dict = {}
+
+        def all_domains(topo):
+            doms = domain_cache.get(topo)
+            if doms is None:
+                vals = set()
+                for nr in visible_nodes:
+                    obj = self.node_objs[nr]
+                    if obj is not None:
+                        d = obj.labels.get(topo)
+                        if d is not None:
+                            vals.add(d)
+                doms = domain_cache[topo] = sorted(vals)
+            return doms
+
+        def counts_for(ns, topo, items):
+            key = (ns, topo, items)
+            c = count_cache.get(key)
+            if c is not None:
+                return c
+            c = count_cache[key] = {}
+            sets = [self._label_index.get((ns, k, v), set()) for k, v in items]
+            rows = (
+                set.intersection(*sorted(sets, key=len)) if all(sets) else set()
+            )
+            for r in rows:
+                if r >= hi or not visible[r]:
+                    continue
+                nr = int(p_node[r])
+                if nr < 0:
+                    continue
+                obj = self.node_objs[nr]
+                if obj is None:
+                    continue
+                d = obj.labels.get(topo)
+                if d is not None:
+                    c[d] = c.get(d, 0) + 1
+            return c
+
+        out: Dict[int, frozenset] = {}
+        universe: set = set()
+        for j in np.nonzero(has_spread)[0]:
+            r = int(slot_rows[j])
+            pod = self.pod_objs[r]
+            own_node = self.node_objs[int(p_node[r])]
+            bits = []
+            for topo, skew, items in pod.spread_constraints:
+                self_m = spread_self_match(pod, items)
+                own = own_node.labels.get(topo) if own_node else None
+                bkey = (pod.namespace, topo, skew, items, own, self_m)
+                bit = bit_cache.get(bkey)
+                if bit is None:
+                    bit = bit_cache[bkey] = compute_spread_bit(
+                        topo,
+                        skew,
+                        own,
+                        counts_for(pod.namespace, topo, items),
+                        all_domains(topo),
+                        self_m,
+                    )
+                bits.append(bit)
+            out[int(j)] = frozenset(bits)
+            universe.update(bits)
+        return out, sorted(universe, key=lambda b: (b.topology_key, b.refused))
 
     def _refresh_sections(self, table: TaintTable) -> None:
         real = tuple(e for e in table.taints if isinstance(e, Taint))
@@ -847,7 +986,17 @@ class ColumnarStore:
             self._paff_section = (paff_off, paffs)
             self._paff_tol_pos.clear()
             self._paff_match_key = None
-        self._unplace_pos = paff_off + len(paffs)
+        # spread section: per-carrier-context verdict bits, recomputed
+        # per tick from match counts (pack() passes them to the table
+        # build); every profile tolerates them — carriers get their own
+        # bits cleared per slot in pack(), since the verdict depends on
+        # the carrier's LANE, which a per-profile row cannot know
+        spreads = tuple(
+            e for e in table.taints if isinstance(e, SpreadBit)
+        )
+        spread_off = paff_off + len(paffs)
+        self._spread_section = (spread_off, spreads)
+        self._unplace_pos = spread_off + len(spreads)
 
     @staticmethod
     def _mk_mask(positions, words: int) -> np.ndarray:
@@ -867,7 +1016,11 @@ class ColumnarStore:
             off, pairs = self._sel_section
             naff_off, naffs = self._naff_section
             paff_off, paffs = self._paff_section
-            for i, (tols, sel, naff, paff, unmodeled) in enumerate(
+            spread_off, spread_entries = self._spread_section
+            spread_pos = tuple(
+                range(spread_off, spread_off + len(spread_entries))
+            )
+            for i, (tols, sel, naff, paff, _spread, unmodeled) in enumerate(
                 self._tol_lists
             ):
                 pos = self._real_tol_pos.get(tols)
@@ -898,7 +1051,7 @@ class ColumnarStore:
                     )
                 unplace = () if unmodeled else (self._unplace_pos,)
                 rows[i] = self._mk_mask(
-                    pos + spos + npos + ppos + unplace, W
+                    pos + spos + npos + ppos + spread_pos + unplace, W
                 )
             self._tol_matrix = rows
         return self._tol_matrix
@@ -1237,21 +1390,32 @@ class ColumnarStore:
         slot_rows = slot_rows_u[order].astype(np.int32)
         slot_cand = pod_cand[slot_rows]
 
+        # presence visibility: counted pods plus pods on unclassified
+        # ready nodes AND not-ready nodes of any class (a requirer/match
+        # there still exists to the real scheduler, and spread's
+        # domain-min must see their domains; the object packer folds
+        # NodeMap.other/.unready identically) — shared by zone presence
+        # and spread counts
+        presence_extra = self.n_live[:nhi] & (
+            ~self.n_ready[:nhi] | (self.n_class[:nhi] == _OTHER)
+        )
+        zone_counted = counted | (
+            self.p_live[:hi] & (p_node >= 0) & presence_extra[safe_node]
+        )
+        # hard topology-spread carrier contexts (masks.SpreadBit): per
+        # carrier slot, the refused-domain verdict from this tick's
+        # match counts — must exist before the table is interned
+        slot_spread_bits, spread_universe = self._spread_contexts(
+            slot_rows, p_node, zone_counted, presence_extra,
+            od_rows, spot_rows,
+        )
+
         # constraint table: built AFTER the slot set is known — its
         # pseudo-taint tail is the slot pods' nodeSelector universe
         # (identical to the object packer's, masks.intern_constraints)
-        table = self._build_taint_table(spot_order, slot_rows)
+        table = self._build_taint_table(spot_order, slot_rows, spread_universe)
         tol_matrix = self._toleration_matrix(table)
         W = table.words
-        # zone presence spans pods on unclassified ready nodes too (a
-        # requirer on e.g. a control-plane node repels zone-wide; the
-        # object packer folds NodeMap.other pods identically)
-        node_other = self.n_live[:nhi] & self.n_ready[:nhi] & (
-            self.n_class[:nhi] == _OTHER
-        )
-        zone_counted = counted | (
-            self.p_live[:hi] & (p_node >= 0) & node_other[safe_node]
-        )
         aff_matrix = self._affinity_matrix(
             np.nonzero(counted)[0], np.nonzero(zone_counted)[0]
         )
@@ -1312,6 +1476,32 @@ class ColumnarStore:
                         pods = [self.pod_objs[int(r)] for r in rows]
                         for k in zone_lane_guard(pods):
                             packed.slot_tol[int(c), int(k), uw] &= ~ub
+            if slot_spread_bits:
+                # spread carriers lose tolerance of their own verdict
+                # bits (per slot — the verdict depends on the lane's
+                # node, which the per-profile toleration row cannot know)
+                spread_pos = {
+                    e: i
+                    for i, e in enumerate(table.taints)
+                    if isinstance(e, SpreadBit)
+                }
+                for j, bits in slot_spread_bits.items():
+                    c, k = int(slot_cand[j]), int(slot_idx[j])
+                    for b in bits:
+                        pos = spread_pos[b]
+                        packed.slot_tol[c, k, pos // 32] &= ~np.uint32(
+                            1 << (pos % 32)
+                        )
+                # spread lane guard (masks.spread_lane_guard, shared
+                # with the object packer): >=2 in-plan movers involved
+                # with one identity shift each other's counts
+                up = self._unplace_pos
+                uw, ub = up // 32, np.uint32(1 << (up % 32))
+                for c in np.unique(slot_cand[sorted(slot_spread_bits)]):
+                    rows = slot_rows[slot_cand == c]
+                    pods = [self.pod_objs[int(r)] for r in rows]
+                    for k in spread_lane_guard(pods):
+                        packed.slot_tol[int(c), int(k), uw] &= ~ub
         if C_actual:
             packed.cand_valid[:C_actual] = cand_ok & (n_evict > 0)
 
@@ -1342,6 +1532,22 @@ class ColumnarStore:
             paff_bits = self._pod_affinity_node_bits(sp_rows, sp, S_actual, W)
             if paff_bits is not None:
                 packed.spot_taints[:S_actual] |= paff_bits
+            if spread_universe:
+                # spread node side: a spot node repels a carrier when it
+                # lacks the topology key or sits in a refused domain
+                entries = [
+                    (i, e)
+                    for i, e in enumerate(table.taints)
+                    if isinstance(e, SpreadBit)
+                ]
+                for si, r in enumerate(spot_order):
+                    labels = self.node_objs[int(r)].labels
+                    for pos, e in entries:
+                        d = labels.get(e.topology_key)
+                        if d is None or d in e.refused:
+                            packed.spot_taints[si, pos // 32] |= np.uint32(
+                                1 << (pos % 32)
+                            )
             aff = np.zeros((S_actual, AFFINITY_WORDS), np.uint32)
             np.bitwise_or.at(aff, sp, self._host_matrix[self.p_aff_id[sp_rows]])
             if self._zone_universe:

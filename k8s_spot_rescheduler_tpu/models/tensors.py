@@ -47,6 +47,7 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     AFFINITY_WORDS,
     TaintTable,
     collect_match_universe,
+    compute_spread_bit,
     constraint_mask,
     intern_constraints,
     match_affinity_mask,
@@ -56,6 +57,8 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     pod_affinity_mask,
     pod_affinity_universe,
     selector_universe,
+    spread_lane_guard,
+    spread_self_match,
     ZONE_LABEL,
     collect_zone_universe,
     zone_lane_guard,
@@ -178,6 +181,84 @@ def scale_allocatable(alloc: Dict[str, int], resources: Sequence[str]) -> np.nda
     )
 
 
+def _build_spread_bits(node_map, candidates, cand_pods) -> Dict:
+    """(lane, slot) -> frozenset of SpreadBit for hard-spread carriers.
+
+    The static verdict machinery of predicates/masks.py: per carrier
+    context, the refused-domain set from this tick's per-domain match
+    counts. Counts and domains span every model-visible node — both
+    classes, unclassified ready nodes (NodeMap.other), AND not-ready
+    nodes of any class (NodeMap.unready: kube-scheduler's default
+    nodeTaintsPolicy=Ignore counts their domains and pods, and an
+    unseen low-count domain would overstate the min — the permissive
+    direction); spot residents below the priority threshold are
+    invisible exactly as they are to the reference's own snapshot
+    (nodes/nodes.go:137-141). Replaces the reference's delegation to
+    the PodTopologySpread plugin inside CheckPredicates
+    (rescheduler.go:344; README.md:103-114)."""
+    if not any(p.spread_constraints for pods in cand_pods for p in pods):
+        return {}
+    infos = (
+        list(node_map.on_demand) + list(node_map.spot)
+        + list(node_map.other) + list(node_map.unready)
+    )
+    domain_cache: Dict = {}
+    count_cache: Dict = {}
+    bit_cache: Dict = {}
+
+    def all_domains(topo):
+        doms = domain_cache.get(topo)
+        if doms is None:
+            doms = domain_cache[topo] = sorted(
+                {
+                    info.node.labels[topo]
+                    for info in infos
+                    if topo in info.node.labels
+                }
+            )
+        return doms
+
+    def counts_for(ns, topo, items):
+        key = (ns, topo, items)
+        c = count_cache.get(key)
+        if c is None:
+            c = count_cache[key] = {}
+            for info in infos:
+                d = info.node.labels.get(topo)
+                if d is None:
+                    continue
+                for p in info.pods:
+                    if p.namespace == ns and all(
+                        p.labels.get(k) == v for k, v in items
+                    ):
+                        c[d] = c.get(d, 0) + 1
+        return c
+
+    out: Dict = {}
+    for c, (info, pods) in enumerate(zip(candidates, cand_pods)):
+        for k, p in enumerate(pods):
+            if not p.spread_constraints:
+                continue
+            bits = []
+            for topo, skew, items in p.spread_constraints:
+                self_m = spread_self_match(p, items)
+                own = info.node.labels.get(topo)
+                bkey = (p.namespace, topo, skew, items, own, self_m)
+                bit = bit_cache.get(bkey)
+                if bit is None:
+                    bit = bit_cache[bkey] = compute_spread_bit(
+                        topo,
+                        skew,
+                        own,
+                        counts_for(p.namespace, topo, items),
+                        all_domains(topo),
+                        self_m,
+                    )
+                bits.append(bit)
+            out[(c, k)] = frozenset(bits)
+    return out
+
+
 def pack_cluster(
     node_map: NodeMap,
     pdbs: Sequence[PDBSpec] = (),
@@ -210,28 +291,39 @@ def pack_cluster(
 
     # constraint table: the spot pool's hard taints + pseudo-taints for
     # the slot pods' nodeSelector pairs, required node-affinity
-    # expressions, and unmodeled constraints
+    # expressions, spread verdicts, and unmodeled constraints
     slot_pods_flat = [p for pods in cand_pods for p in pods]
+    spread_bits_by = _build_spread_bits(
+        node_map, candidates, cand_pods
+    )  # (lane, slot) -> frozenset(SpreadBit)
+    spread_universe = sorted(
+        {b for bits in spread_bits_by.values() for b in bits},
+        key=lambda b: (b.topology_key, b.refused),
+    )
     table = intern_constraints(
         [n.node for n in spot],
         selector_universe(slot_pods_flat),
         node_affinity_universe(slot_pods_flat),
         pod_affinity_universe(slot_pods_flat),
+        spread_universe,
     )
     # anti-affinity selector universes span every counted pod (resident
     # pods repel incoming matches and vice versa; zone identities reach
     # across node classes because zones do). The ZONE family additionally
-    # spans pods on unclassified ready nodes (NodeMap.other): a requirer
-    # or match resident on e.g. a control-plane node still repels
-    # zone-wide in the real scheduler, and missing it would approve a
-    # drain whose pod then strands. Hostname-family presence stays scoped
-    # to candidates+spot — we never place onto unclassified nodes, so
-    # their residents cannot create per-node conflicts.
-    other = node_map.other
+    # spans pods on unclassified ready nodes (NodeMap.other) AND on
+    # not-ready nodes of any class (NodeMap.unready): a requirer or
+    # match resident there still repels zone-wide in the real scheduler,
+    # and missing it would approve a drain whose pod then strands.
+    # Hostname-family presence stays scoped to candidates+spot — we
+    # never place onto those nodes, so their residents cannot create
+    # per-node conflicts.
+    presence_extra = list(node_map.other) + list(node_map.unready)
     counted_pods = [p for info in candidates for p in info.pods] + [
         p for info in spot for p in info.pods
     ]
-    zone_pods = counted_pods + [p for info in other for p in info.pods]
+    zone_pods = counted_pods + [
+        p for info in presence_extra for p in info.pods
+    ]
     match_universe = collect_match_universe(counted_pods)
     zone_universe = collect_zone_universe(zone_pods)
     W, A, R = table.words, AFFINITY_WORDS, len(resources)
@@ -286,13 +378,17 @@ def pack_cluster(
                 out[:, j] = -(-col // d) if d != 1 else col
         return out
 
-    def tol_row(pod: PodSpec):
+    def tol_row(pod: PodSpec, sbits: frozenset = frozenset()):
         paff = pod_affinity_key(pod)
+        # sbits joins the key: a carrier's verdict depends on its LANE's
+        # node (own domain), so identical pods on different candidates
+        # may carry different SpreadBits
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
             pod.node_affinity,
             paff,
+            sbits,
             pod.unmodeled_constraints,
         )
         row = tol_cache.get(key)
@@ -302,6 +398,7 @@ def pack_cluster(
                 pod.unmodeled_constraints, table,
                 node_affinity=pod.node_affinity,
                 pod_affinity=paff,
+                spread_bits=sbits,
             )
         return row
 
@@ -356,12 +453,12 @@ def pack_cluster(
         return row
 
     # zone-wide presence: OR of the zone-family masks of every counted
-    # pod — plus every pod on an unclassified ready node — keyed by its
-    # node's zone label (nodes without the label are zoneless and
-    # neither contribute nor receive)
+    # pod — plus every pod on an unclassified-ready or not-ready node —
+    # keyed by its node's zone label (nodes without the label are
+    # zoneless and neither contribute nor receive)
     zone_accum: dict = {}
     if zone_universe:
-        for info in list(candidates) + list(spot) + list(other):
+        for info in list(candidates) + list(spot) + presence_extra:
             zone = info.node.labels.get(ZONE_LABEL)
             if zone is None:
                 continue
@@ -384,13 +481,21 @@ def pack_cluster(
             n = len(pods)
             packed.slot_req[c, :n] = req_matrix(pods)
             packed.slot_valid[c, :n] = True
-            packed.slot_tol[c, :n] = [tol_row(p) for p in pods]
+            packed.slot_tol[c, :n] = [
+                tol_row(p, spread_bits_by.get((c, k), frozenset()))
+                for k, p in enumerate(pods)
+            ]
             packed.slot_aff[c, :n] = [aff_row(p) for p in pods]
             if zone_universe:
                 # two zone-involved pods in one lane: static zone bits
                 # cannot prove their in-plan interaction safe — mark
                 # them unplaceable (clears the lane, conservatively)
                 for k in zone_lane_guard(pods):
+                    packed.slot_tol[c, k, unplace_word] &= ~unplace_bit
+            if spread_universe:
+                # likewise for spread: two in-plan movers involved with
+                # one spread identity shift each other's domain counts
+                for k in spread_lane_guard(pods):
                     packed.slot_tol[c, k, unplace_word] &= ~unplace_bit
 
     for s, info in enumerate(spot):

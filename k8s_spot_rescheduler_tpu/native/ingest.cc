@@ -420,6 +420,7 @@ enum {
   TBL_PAFF,  // required POSITIVE pod-affinity matchLabels blobs
   TBL_ZAFF,  // zone-topology anti-affinity matchLabels blobs
   TBL_PVC,   // PVC claim-name lists (REC_SEP-joined)
+  TBL_SPREAD,  // canonical hard topologySpreadConstraints blobs
   TBL_COUNT,
 };
 
@@ -468,6 +469,7 @@ enum {
   P_PAFFID,
   P_ZAFFID,
   P_PVCID,
+  P_SPREADID,
   P_NI32,
 };
 enum { P_FLAGS = 0, P_NU8 };
@@ -593,6 +595,98 @@ bool has_sep_bytes(std::string_view s) {
   for (char c : s)
     if (c >= '\x1c' && c <= '\x1f') return true;
   return false;
+}
+
+// Hard topologySpreadConstraints, in exact lockstep with io/kube.py
+// decode_topology_spread: each hard entry (whenUnsatisfiable absent or
+// anything but the literal "ScheduleAnyway") must have topologyKey
+// hostname/zone, an integer maxSkew >= 1, a non-empty matchLabels-only
+// labelSelector, and none of the counting-modifier fields — else the
+// whole pod is unmodeled. Soft entries are dropped. Blob: entries
+// joined by REC_SEP; entry = topo UNIT_SEP skew UNIT_SEP pairs, pairs
+// joined by TERM_SEP, pair = key VAL_SEP value. Source order; the
+// Python side canonicalizes (sort + dedup) on parse.
+static const char* const kSpreadModifierKeys[] = {
+    "minDomains", "matchLabelKeys", "nodeAffinityPolicy",
+    "nodeTaintsPolicy"};
+
+bool json_int_ge1(const Val* v) {
+  // Python's json gives int only for digit literals (no '.', no
+  // exponent); bool is excluded there by the isinstance(bool) guard.
+  if (!v || v->kind != Val::Num) return false;
+  std::string_view t = v->text;
+  size_t i = (t.size() && (t[0] == '-' || t[0] == '+')) ? 1 : 0;
+  if (i >= t.size()) return false;
+  for (size_t j = i; j < t.size(); ++j)
+    if (t[j] < '0' || t[j] > '9') return false;
+  return t[0] != '-' && !(t == "0") && !(i == 1 && t == "+0");
+}
+
+void extract_topology_spread(const Val* spread, bool* unmodeled,
+                             std::string* blob) {
+  blob->clear();
+  if (!spread || !py_truthy(spread)) return;
+  if (spread->kind != Val::Arr) {
+    *unmodeled = true;
+    return;
+  }
+  std::string out;
+  for (const Val* c : spread->arr) {
+    if (!c || c->kind != Val::Obj) {
+      *unmodeled = true;
+      return;
+    }
+    const Val* wu = c->get("whenUnsatisfiable");
+    if (wu && wu->kind == Val::Str && wu->text == "ScheduleAnyway")
+      continue;  // soft: advisory only
+    for (const char* key : kSpreadModifierKeys) {
+      if (c->get(key) != nullptr) {
+        *unmodeled = true;
+        return;
+      }
+    }
+    const Val* topo = c->get("topologyKey");
+    if (!topo || topo->kind != Val::Str ||
+        (topo->text != "kubernetes.io/hostname" &&
+         topo->text != "topology.kubernetes.io/zone")) {
+      *unmodeled = true;
+      return;
+    }
+    const Val* skew = c->get("maxSkew");
+    if (!json_int_ge1(skew)) {
+      *unmodeled = true;
+      return;
+    }
+    const Val* sel = c->get("labelSelector");
+    if (!sel || sel->kind != Val::Obj || py_truthy(sel->get("matchExpressions"))) {
+      *unmodeled = true;
+      return;
+    }
+    const Val* ml = sel->get("matchLabels");
+    if (!ml || ml->kind != Val::Obj || ml->obj.empty()) {
+      *unmodeled = true;
+      return;
+    }
+    std::string pairs;
+    for (const auto& kv : ml->obj) {
+      if (!kv.second || kv.second->kind != Val::Str ||
+          has_sep_bytes(kv.first) || has_sep_bytes(kv.second->text)) {
+        *unmodeled = true;
+        return;
+      }
+      if (!pairs.empty()) pairs += TERM_SEP;
+      pairs.append(kv.first.data(), kv.first.size());
+      pairs += VAL_SEP;
+      pairs.append(kv.second->text.data(), kv.second->text.size());
+    }
+    if (!out.empty()) out += REC_SEP;
+    out.append(topo->text.data(), topo->text.size());
+    out += UNIT_SEP;
+    out.append(skew->text.data(), skew->text.size());
+    out += UNIT_SEP;
+    out += pairs;
+  }
+  *blob = out;
 }
 
 void extract_node_affinity(const Val* naff, bool* unmodeled,
@@ -871,6 +965,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     const Val* pod_affinity_labels = nullptr;
     std::string naff_blob;
     std::string pvc_blob;
+    std::string spread_blob;
     if (spec) {
       bool unmodeled = false;
       const Val* affinity = spec->get("affinity");
@@ -915,25 +1010,17 @@ Batch* ingest_pods_impl(const char* buf, long n) {
           }
         }
       }
-      // Hard topology-spread constraints (whenUnsatisfiable defaults to
-      // DoNotSchedule) are unmodeled predicates — exact lockstep with
-      // io/kube.py decode_pod's hard_spread computation.
-      if (const Val* spread = spec->get("topologySpreadConstraints")) {
-        if (py_truthy(spread)) {
-          if (spread->kind != Val::Arr) {
-            flags |= F_REQAFF;
-          } else {
-            for (const Val* c : spread->arr) {
-              const Val* wu = c && c->kind == Val::Obj
-                                  ? c->get("whenUnsatisfiable")
-                                  : nullptr;
-              if (!c || c->kind != Val::Obj || !wu || wu->kind != Val::Str ||
-                  wu->text != "ScheduleAnyway") {
-                flags |= F_REQAFF;
-                break;
-              }
-            }
-          }
+      // Hard topology-spread constraints: canonical shapes are modeled
+      // (blob -> SpreadBit verdicts in the packers); anything beyond
+      // stays unmodeled — exact lockstep with io/kube.py
+      // decode_topology_spread.
+      {
+        bool spread_unmodeled = false;
+        extract_topology_spread(spec->get("topologySpreadConstraints"),
+                                &spread_unmodeled, &spread_blob);
+        if (spread_unmodeled) {
+          flags |= F_REQAFF;
+          spread_blob.clear();
         }
       }
     }
@@ -970,6 +1057,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     blob_kv_into(&tmp, zone_anti_labels);
     i32row(P_ZAFFID) = b->intern_str(TBL_ZAFF, tmp);
     i32row(P_PVCID) = b->intern_str(TBL_PVC, pvc_blob);
+    i32row(P_SPREADID) = b->intern_str(TBL_SPREAD, spread_blob);
 
     // tolerations: key\x1fvalue\x1foperator\x1feffect\x1e...
     tmp.clear();

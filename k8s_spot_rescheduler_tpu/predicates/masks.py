@@ -591,11 +591,13 @@ def zone_lane_guard(pods: Sequence[PodSpec]) -> set:
 # (compute_spread_bit) and interned as a SpreadBit pseudo-taint:
 #
 # - counts tally selector matches over every model-visible pod (counted
-#   pods of both classes + pods on unclassified ready nodes), keyed by
-#   the node's topology-key value; nodes lacking the key contribute
-#   nothing and admit nothing (PodTopologySpread filters them);
-# - domains span every visible ready node's key value, INCLUDING
-#   zero-count domains — the min is what makes skew bite;
+#   pods of both classes + pods on unclassified-ready and NOT-READY
+#   nodes — kube-scheduler's default nodeTaintsPolicy=Ignore counts
+#   dead nodes' domains and pods), keyed by the node's topology-key
+#   value; nodes lacking the key contribute nothing and admit nothing
+#   (PodTopologySpread filters them);
+# - domains span every visible node's key value, INCLUDING zero-count
+#   domains — the min is what makes skew bite;
 # - the carrier's own departure is exact: if p itself matches its
 #   selector, its source domain's count drops by one, which can lower
 #   the global min (stricter) and lowers its own domain's bar by one

@@ -24,10 +24,19 @@ those lanes:
 
 TPU shape discipline matches solver/ffd.py: carries keep the spot axis
 minor ([C, R, S] / [C, A, S]), shapes are static, rounds are a scan.
-One deliberate conservatism: node affinity masks only ever accumulate
-(ejecting ``q`` does not clear its group bits from its old node), so
-affinity-driven swaps are skipped rather than risked — resource
-contention, the dominant failure mode, is fully repaired.
+
+Affinity ejection is EXACT (round 4; was monotone-conservative before):
+the per-node affinity state starts exact after the partial pass (static
+resident bits OR placed pods' bits — no ejections yet) and every
+relocation recomputes the ejected node's word from scratch (static bits
+OR the bits of pods still assigned there), so ejecting ``q`` genuinely
+clears its group bits and affinity-driven unlocks — a group member
+vacating the node its group-mate needs — are found. The unlock
+*election* stays cheap (resources + static words only); the elected
+move is gated by the exact recompute, and the deterministic rotation
+tries a different unlocker next round when the gate fails. Every final
+assignment is still re-proven from scratch, so no exactness bug can
+ever approve an invalid drain.
 
 Cost: each round is O(K·(R+A) + S·(R+W)) per lane vs the greedy scan's
 O(K·S·(R+W)) — ``ROUNDS`` rounds add well under 2x total solve time.
@@ -54,7 +63,7 @@ DEFAULT_ROUNDS = 8
 class _RepairCarry(NamedTuple):
     free: jax.Array  # f32 [C, R, S]
     count: jax.Array  # i32 [C, S]
-    aff: jax.Array  # u32 [C, A, S] (monotone — see module docstring)
+    aff: jax.Array  # u32 [C, A, S] (exact — see module docstring)
     assign: jax.Array  # i32 [C, K]
 
 
@@ -67,8 +76,8 @@ def _partial_scan_step(static, carry: _Carry, slot):
 
 
 def _repair_round(static, state: _RepairCarry, round_idx):
-    (spot_max_pods, spot_taints_t, spot_ok, slot_req, slot_valid,
-     slot_tol, slot_aff) = static
+    (spot_max_pods, spot_taints_t, spot_ok, spot_aff_static,
+     slot_req, slot_valid, slot_tol, slot_aff) = static
     C, K, R = slot_req.shape
     S = state.free.shape[-1]
 
@@ -89,7 +98,10 @@ def _repair_round(static, state: _RepairCarry, round_idx):
     placed = state.assign >= 0  # [C, K]
     s_q = jnp.clip(state.assign, 0, S - 1)  # [C, K]
 
-    # would p fit on q's node if q were ejected?
+    # would p fit on q's node if q were ejected? (resources + static
+    # words; the affinity gate is exact and applied to the ELECTED
+    # unlocker below — a per-candidate exact recompute here would cost
+    # O(K^2·A) for nothing, since rotation retries next round anyway)
     free_at_q = jnp.take_along_axis(
         state.free, s_q[:, None, :], axis=2
     )  # [C, R, K]
@@ -98,12 +110,8 @@ def _repair_round(static, state: _RepairCarry, round_idx):
         free_at_q + req_t - req_p[:, :, None] >= 0, axis=1
     )  # [C, K]
     static_at_q = jnp.take_along_axis(static_p, s_q, axis=1)  # [C, K]
-    aff_at_q = jnp.take_along_axis(
-        state.aff, s_q[:, None, :], axis=2
-    )  # [C, A, K]
-    aff_ok = jnp.all((aff_p[:, :, None] & aff_at_q) == 0, axis=1)  # [C, K]
 
-    unlock = placed & res_ok & static_at_q & aff_ok  # [C, K]
+    unlock = placed & res_ok & static_at_q  # [C, K]
     n_unlock = unlock.sum(axis=-1)  # [C]
 
     # deterministic rotation: try a different unlocker each round
@@ -137,7 +145,20 @@ def _repair_round(static, state: _RepairCarry, round_idx):
     s2 = jnp.argmax(fits_q, axis=-1)  # [C]
     can_move = jnp.any(fits_q, axis=-1)
 
-    do = has_gap & any_q & can_move  # [C]
+    # exact affinity of q's node AFTER q leaves: static resident bits OR
+    # the bits of pods still assigned there — ejection genuinely clears
+    # q's contribution (a group member vacating for its group-mate)
+    ks = jnp.arange(K)[None, :]
+    others = placed & (state.assign == sq_star[:, None]) & (ks != q[:, None])
+    contrib = jnp.where(
+        others[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
+    )  # [C, A, K]
+    aff_ej = jax.lax.reduce(
+        contrib, np.uint32(0), jax.lax.bitwise_or, (2,)
+    ) | spot_aff_static[sq_star]  # [C, A]
+    aff_ok_p = jnp.all((aff_p & aff_ej) == 0, axis=1)  # [C]
+
+    do = has_gap & any_q & can_move & aff_ok_p  # [C]
 
     onehot_sq = jnp.arange(S)[None, :] == sq_star[:, None]  # [C, S]
     onehot_s2 = jnp.arange(S)[None, :] == s2[:, None]
@@ -150,14 +171,12 @@ def _repair_round(static, state: _RepairCarry, round_idx):
         do[:, None], state.count + onehot_s2.astype(state.count.dtype),
         state.count,
     )
-    aff = jnp.where(
-        do[:, None, None],
-        state.aff
-        | jnp.where(onehot_s2[:, None, :], aff_q[:, :, None], 0)
-        | jnp.where(onehot_sq[:, None, :], aff_p[:, :, None], 0),
-        state.aff,
-    )
-    ks = jnp.arange(K)[None, :]
+    # s_q's column is REPLACED by the exact recompute (plus p's arrival);
+    # s2 (≠ s_q, fits_q excludes it) accumulates q's bits
+    aff_after = jnp.where(
+        onehot_sq[:, None, :], (aff_ej | aff_p)[:, :, None], state.aff
+    ) | jnp.where(onehot_s2[:, None, :], aff_q[:, :, None], jnp.uint32(0))
+    aff = jnp.where(do[:, None, None], aff_after, state.aff)
     assign = jnp.where(
         do[:, None],
         jnp.where(
@@ -209,6 +228,7 @@ def plan_repair(
     )
     repair_static = (
         *scan_static,
+        jnp.asarray(packed.spot_aff),  # static resident bits, [S, A]
         jnp.asarray(packed.slot_req),
         jnp.asarray(packed.slot_valid),
         jnp.asarray(packed.slot_tol),
@@ -232,8 +252,8 @@ def plan_repair_oracle(
     packed: PackedCluster, rounds: int = DEFAULT_ROUNDS
 ) -> SolveResult:
     """Serial NumPy mirror of ``plan_repair`` — identical partial pass,
-    rotation, conservative affinity accumulation, and validation, for
-    bit-parity tests against the device solver."""
+    rotation, exact affinity ejection, and validation, for bit-parity
+    tests against the device solver."""
     C, K, R = packed.slot_req.shape
     S = packed.spot_free.shape[0]
     assign = np.full((C, K), -1, np.int32)
@@ -293,8 +313,6 @@ def plan_repair_oracle(
                     frees[c, s] + packed.slot_req[c, k] - req_p >= 0
                 ):
                     continue
-                if np.any(aff_p & affs[c, s]):
-                    continue
                 unlock[k] = True
             n_unlock = int(unlock.sum())
             if not n_unlock:
@@ -302,6 +320,14 @@ def plan_repair_oracle(
             want = rnd % n_unlock
             q = int(np.flatnonzero(unlock)[want])
             sq = int(assign[c, q])
+            # exact aff of q's node after q leaves (device lockstep):
+            # static resident bits OR pods still assigned there
+            aff_ej = np.asarray(packed.spot_aff[sq]).copy()
+            for k in range(K):
+                if k != q and assign[c, k] == sq:
+                    aff_ej |= packed.slot_aff[c, k]
+            if np.any(aff_p & aff_ej):
+                continue  # rotation tries a different unlocker next round
             fits_q = fit_mask(
                 np,
                 free=frees[c],
@@ -324,7 +350,7 @@ def plan_repair_oracle(
             frees[c, s2] -= packed.slot_req[c, q]
             counts[c, s2] += 1
             affs[c, s2] |= packed.slot_aff[c, q]
-            affs[c, sq] |= aff_p
+            affs[c, sq] = aff_ej | aff_p  # exact replacement, not OR
 
     feasible = np.asarray(validate_assignment(np, packed, assign))
     assignment = np.where(feasible[:, None], assign, -1).astype(np.int32)

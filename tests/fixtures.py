@@ -74,10 +74,15 @@ def pack_fake(fc, resources=("cpu", "memory"), **kw):
     from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
 
     nodes = fc.list_ready_nodes()
+    unready = fc.list_unready_nodes()
     node_map = build_node_map(
         nodes,
-        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        {
+            n.name: fc.list_pods_on_node(n.name)
+            for n in list(nodes) + list(unready)
+        },
         on_demand_label=ON_DEMAND_LABEL,
         spot_label=SPOT_LABEL,
+        unready_nodes=unready,
     )
     return pack_cluster(node_map, fc.pdbs, resources=resources, **kw)

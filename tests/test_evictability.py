@@ -75,10 +75,12 @@ def test_pdb_in_other_namespace_ignored():
     assert blocking is None
 
 
-def test_hard_topology_spread_is_unmodeled():
+def test_hard_topology_spread_decode():
     """whenUnsatisfiable=DoNotSchedule spread constraints are predicates
-    the reference's CheckPredicates enforces (PodTopologySpread); this
-    model must treat such pods as unplaceable, never as unconstrained."""
+    the reference's CheckPredicates enforces (PodTopologySpread). Since
+    round 4 the CANONICAL shape is modeled (spread_constraints +
+    SpreadBit verdicts, tests/test_spread.py); non-canonical hard shapes
+    must still collapse to unplaceable, never to unconstrained."""
     from k8s_spot_rescheduler_tpu.io.kube import decode_pod
 
     def pod(spread):
@@ -94,10 +96,15 @@ def test_hard_topology_spread_is_unmodeled():
             "labelSelector": {"matchLabels": {"app": "x"}}}
     soft = dict(hard, whenUnsatisfiable="ScheduleAnyway")
     default = {k: v for k, v in hard.items() if k != "whenUnsatisfiable"}
+    beyond = dict(hard, minDomains=2)  # counting modifier: not modeled
 
-    assert pod([hard]).unmodeled_constraints
-    assert pod([default]).unmodeled_constraints  # k8s default is hard
+    assert not pod([hard]).unmodeled_constraints  # canonical: modeled
+    assert pod([hard]).spread_constraints
+    assert not pod([default]).unmodeled_constraints  # k8s default is hard
+    assert pod([default]).spread_constraints
     assert not pod([soft]).unmodeled_constraints
+    assert not pod([soft]).spread_constraints  # soft: dropped
     assert not pod([]).unmodeled_constraints
-    assert pod([soft, hard]).unmodeled_constraints
+    assert pod([beyond]).unmodeled_constraints
+    assert not pod([beyond]).spread_constraints
     assert pod("garbage").unmodeled_constraints  # malformed: conservative

@@ -133,6 +133,9 @@ def _assert_pod_parity(objs):
             f"pod {i} pvc_resolvable"
         )
         assert got.node_affinity == want.node_affinity, f"pod {i} node-aff"
+        assert got.spread_constraints == want.spread_constraints, (
+            f"pod {i} spread"
+        )
         assert got.unmodeled_constraints == want.unmodeled_constraints, (
             f"pod {i} unmodeled"
         )
@@ -290,15 +293,33 @@ def test_topology_spread_shapes():
             "labelSelector": {"matchLabels": {"app": "x"}}}
     soft = dict(hard, whenUnsatisfiable="ScheduleAnyway")
     objs = [
-        spread_pod("hard", [hard]),
+        spread_pod("hard", [hard]),  # canonical: modeled on BOTH paths
         spread_pod("soft", [soft]),
         spread_pod("default", [{k: v for k, v in hard.items()
                                 if k != "whenUnsatisfiable"}]),
         spread_pod("mixed", [soft, hard]),
+        spread_pod("pair", [hard, dict(hard,
+                                       topologyKey="kubernetes.io/hostname")]),
         spread_pod("empty", []),
         spread_pod("null", None),
         spread_pod("malformed", "garbage"),
         spread_pod("badentry", [None]),
+        # beyond-canonical hard shapes: unmodeled on both paths
+        spread_pod("modifier", [dict(hard, minDomains=2)]),
+        spread_pod("labelkeys", [dict(hard, matchLabelKeys=["rev"])]),
+        spread_pod("floatskew", [dict(hard, maxSkew=1.0)]),
+        spread_pod("zeroskew", [dict(hard, maxSkew=0)]),
+        spread_pod("boolskew", [dict(hard, maxSkew=True)]),
+        spread_pod("othertopo", [dict(hard, topologyKey="rack")]),
+        spread_pod("noselector", [{k: v for k, v in hard.items()
+                                   if k != "labelSelector"}]),
+        spread_pod("exprs", [dict(hard, labelSelector={
+            "matchLabels": {"app": "x"},
+            "matchExpressions": [{"key": "a", "operator": "Exists"}]})]),
+        spread_pod("multikv", [dict(hard, labelSelector={
+            "matchLabels": {"app": "x", "tier": "db"}})]),
+        # a soft entry carrying a modifier is still just soft (dropped)
+        spread_pod("softmod", [dict(soft, minDomains=2)]),
     ]
     _assert_pod_parity(objs)
 
@@ -594,6 +615,18 @@ def test_bulk_load_matches_per_pod_path():
                         {"key": "metadata.name", "operator": "In",
                          "values": ["n1"]}]}]) if i == 9 else None
                 ),
+                # i==11: canonical hard spread (modeled, SpreadBit path);
+                # i==13: beyond-canonical (unmodeled)
+                "topologySpreadConstraints": (
+                    [{"maxSkew": 1,
+                      "topologyKey": "topology.kubernetes.io/zone",
+                      "whenUnsatisfiable": "DoNotSchedule",
+                      "labelSelector": {"matchLabels": {"app": "a3"}}}]
+                    if i == 11 else
+                    [{"maxSkew": 1, "topologyKey": "rack",
+                      "labelSelector": {"matchLabels": {"app": "a1"}}}]
+                    if i == 13 else None
+                ),
             },
             status={"phase": "Succeeded" if i == 6 else "Running"},
         )
@@ -606,7 +639,8 @@ def test_bulk_load_matches_per_pod_path():
             NodeSpec(
                 name=f"n{j}",
                 labels={"kubernetes.io/role":
-                        "worker" if j % 2 else "spot-worker"},
+                        "worker" if j % 2 else "spot-worker",
+                        "topology.kubernetes.io/zone": f"z{j % 2}"},
                 allocatable={"cpu": 4000, "memory": 2**34, "pods": 50},
             )
             for j in range(4)

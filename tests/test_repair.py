@@ -69,6 +69,102 @@ def test_repair_device_matches_fixture():
     assert list(np.asarray(got.assignment)[0]) == [0, 1, 0]
 
 
+def _affinity_swap_case() -> PackedCluster:
+    """Greedy fails BECAUSE of anti-affinity; only an affinity-driven
+    ejection fixes it (round 4: exact ejection — the old monotone
+    accumulation skipped this unlock, leaving the lane infeasible).
+
+    Spot pool: n0 free=9 (clean — the TIGHTER fit for T, so first-fit
+    AND best-fit both burn it), n1 free=10 (taint bit0). Pods
+    decreasing: T=8 (group bit1, tolerates the taint), I=7 (group bit1,
+    intolerant). Greedy: T->n0; I: n1 refused (taint), n0 refused
+    (group-mate T) -> stuck. Repair must eject T (clearing its group bit
+    from n0 — impossible under monotone accumulation), re-place T on n1
+    and land I on n0."""
+    W, A = 1, 2
+    group = np.array([2, 0], np.uint32)  # bit1 in word 0 of the aff words
+    return PackedCluster(
+        slot_req=np.array([[[8.0], [7.0]]], np.float32),
+        slot_valid=np.ones((1, 2), bool),
+        slot_tol=np.array([[[1], [0]]], np.uint32),
+        slot_aff=np.array([[group, group]], np.uint32),
+        cand_valid=np.ones((1,), bool),
+        spot_free=np.array([[9.0], [10.0]], np.float32),
+        spot_count=np.zeros((2,), np.int32),
+        spot_max_pods=np.full((2,), 10, np.int32),
+        spot_taints=np.array([[0], [1]], np.uint32),
+        spot_ok=np.ones((2,), bool),
+        spot_aff=np.zeros((2, A), np.uint32),
+    )
+
+
+def test_exact_ejection_recovers_affinity_blocked_lane():
+    packed = _affinity_swap_case()
+    assert not plan_oracle(packed).feasible[0]
+    assert not plan_oracle(packed, best_fit=True).feasible[0]
+    res = plan_repair_oracle(packed)
+    assert bool(res.feasible[0]), "affinity ejection unlock not found"
+    assert list(res.assignment[0]) == [1, 0]  # T -> n1, I -> n0
+    _check_plan_is_executable(packed, res)
+    got = plan_repair_jit(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), res.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), res.assignment)
+
+
+def test_exact_ejection_respects_remaining_group_mate():
+    """Ejecting q clears ONLY q's bits: if another group-mate remains on
+    the node (placed there by the partial pass), the recompute keeps its
+    bits and the unlock must still be refused."""
+    W, A = 1, 2
+    group = np.array([2, 0], np.uint32)
+    packed = PackedCluster(
+        # X=6 (plain, group-bit carrier? no — X carries the group TOO but
+        # lands on n0 first; T=5 group; I=4 group). After the partial
+        # pass n0 holds X and... two group-mates cannot colocate, so
+        # instead: X carries a DIFFERENT overlap — X and I share bit1,
+        # X and T do not (T uses bit2). Ejecting T from n0 leaves X's
+        # bit1 -> I still refused on n0.
+        slot_req=np.array(
+            [[[6.0], [5.0], [4.0]]], np.float32
+        ),  # X, T, I decreasing
+        slot_valid=np.ones((1, 3), bool),
+        slot_tol=np.array([[[1], [1], [0]]], np.uint32),
+        slot_aff=np.array(
+            [[[2, 0], [4, 0], [2, 0]]], np.uint32
+        ),  # X:bit1, T:bit2, I:bit1
+        cand_valid=np.ones((1,), bool),
+        spot_free=np.array([[11.0], [5.0]], np.float32),
+        spot_count=np.zeros((2,), np.int32),
+        spot_max_pods=np.full((2,), 10, np.int32),
+        spot_taints=np.array([[0], [1]], np.uint32),
+        spot_ok=np.ones((2,), bool),
+        spot_aff=np.zeros((2, A), np.uint32),
+    )
+    # partial pass: X->n0 (11-6=5), T->n0 (5-5=0), I: n1 taint-refused,
+    # n0 has bit1 (X) -> gap. Eject T: n0 free 5 >= 4 but X's bit1
+    # remains -> refused. Eject X: (rotation) n0 free 0+6-4 >= 0 ok,
+    # X re-places... n1 free 5 < 6: no. Lane must stay infeasible, and
+    # CRUCIALLY never place I next to X.
+    res = plan_repair_oracle(packed)
+    assert not res.feasible[0]
+    got = plan_repair_jit(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), res.feasible)
+
+
+def test_repair_parity_on_affinity_quality_pack():
+    """Device/oracle bit parity over the round-4 affinity quality config
+    (real packed shapes with group bits, selectors, taints)."""
+    from k8s_spot_rescheduler_tpu.bench.quality import pack_quality
+    from k8s_spot_rescheduler_tpu.io.synthetic import AffinitySpec
+
+    packed = pack_quality(AffinitySpec("aff-parity", n_groups=4), 0)
+    want = plan_repair_oracle(packed)
+    got = plan_repair_jit(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+    _check_plan_is_executable(packed, want)
+
+
 @pytest.mark.parametrize("seed", range(40))
 def test_repair_oracle_jax_parity_randomized(seed):
     """Device repair is bit-identical to the serial mirror: same partial

@@ -1,0 +1,532 @@
+"""Hard topologySpreadConstraints, modeled (round 4).
+
+The k8s-default DoNotSchedule spread constraint previously collapsed to
+the unplaceable bit — a spread-constrained pod pinned its node
+undrainable forever. It is now modeled in the canonical shape
+(topologyKey hostname/zone, matchLabels selector, integer maxSkew,
+no counting modifiers): per carrier, a static refused-domain verdict
+computed from this tick's per-domain match counts
+(predicates/masks.compute_spread_bit), interned as a SpreadBit
+pseudo-taint. The reference gets this via the PodTopologySpread plugin
+inside CheckPredicates (reference rescheduler.go:344; README.md:103-114).
+"""
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.io.kube import decode_pod, decode_topology_spread
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.predicates.masks import (
+    ZONE_LABEL,
+    SpreadBit,
+    compute_spread_bit,
+    spread_lane_guard,
+)
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    pack_fake,
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def _host_labels(base, name):
+    return dict(base, **{HOSTNAME: name})
+
+
+def _zone_labels(base, zone):
+    return dict(base, **{ZONE_LABEL: zone})
+
+
+# --- decode ----------------------------------------------------------------
+
+def _spread_pod(spread):
+    return {
+        "metadata": {"name": "p", "namespace": "ns1",
+                     "labels": {"app": "web"}},
+        "spec": {"nodeName": "n1", "containers": [],
+                 "topologySpreadConstraints": spread},
+        "status": {"phase": "Running"},
+    }
+
+
+_CANON = {
+    "maxSkew": 1,
+    "topologyKey": ZONE_LABEL,
+    "whenUnsatisfiable": "DoNotSchedule",
+    "labelSelector": {"matchLabels": {"app": "web"}},
+}
+
+
+def test_decode_canonical_hard_spread_modeled():
+    pod = decode_pod(_spread_pod([_CANON]))
+    assert pod.spread_constraints == (
+        (ZONE_LABEL, 1, (("app", "web"),)),
+    )
+    assert not pod.unmodeled_constraints
+
+
+def test_decode_default_when_unsatisfiable_is_hard():
+    entry = {k: v for k, v in _CANON.items() if k != "whenUnsatisfiable"}
+    pod = decode_pod(_spread_pod([entry]))
+    assert pod.spread_constraints and not pod.unmodeled_constraints
+
+
+def test_decode_hostname_and_pair():
+    host = dict(_CANON, topologyKey=HOSTNAME)
+    pod = decode_pod(_spread_pod([host, _CANON]))
+    assert pod.spread_constraints == (
+        (HOSTNAME, 1, (("app", "web"),)),
+        (ZONE_LABEL, 1, (("app", "web"),)),
+    )
+    assert not pod.unmodeled_constraints
+
+
+def test_decode_soft_entries_ignored():
+    soft = dict(_CANON, whenUnsatisfiable="ScheduleAnyway")
+    pod = decode_pod(_spread_pod([soft]))
+    assert pod.spread_constraints == ()
+    assert not pod.unmodeled_constraints
+
+
+@pytest.mark.parametrize("mutate", [
+    {"topologyKey": "rack"},                      # unmodeled topology
+    {"maxSkew": 0},                               # k8s-invalid skew
+    {"maxSkew": "1"},                             # non-int skew
+    {"maxSkew": True},                            # bool is not an int here
+    {"labelSelector": {}},                        # no matchLabels
+    {"labelSelector": {"matchLabels": {}}},       # empty selector
+    {"labelSelector": {"matchLabels": {"a": "b"},
+                       "matchExpressions": [{}]}},  # expressions
+    {"minDomains": 2},                            # counting modifier
+    {"matchLabelKeys": ["rev"]},
+    {"nodeAffinityPolicy": "Honor"},              # even the default value
+    {"nodeTaintsPolicy": "Ignore"},
+])
+def test_decode_beyond_canonical_unmodeled(mutate):
+    entry = dict(_CANON)
+    entry.update(mutate)
+    pod = decode_pod(_spread_pod([entry]))
+    assert pod.spread_constraints == ()
+    assert pod.unmodeled_constraints
+
+
+def test_decode_malformed_list_unmodeled():
+    for spread in ("garbage", [None], [[]]):
+        constraints, unmodeled = decode_topology_spread(spread)
+        assert constraints == () and unmodeled
+
+
+# --- the verdict math (compute_spread_bit) --------------------------------
+
+def test_verdict_basic_skew():
+    # domains a:2 b:0 c:1, maxSkew 1, self-matching carrier from a
+    # keyless node: placing adds 1; min=0 -> allowed count <= 0
+    bit = compute_spread_bit(
+        ZONE_LABEL, 1, None, {"a": 2, "c": 1}, ["a", "b", "c"], True
+    )
+    assert bit.refused == ("a", "c")
+
+
+def test_verdict_own_domain_offset():
+    # carrier currently in a (count includes it): after departure a:1.
+    # min over (a:1, b:0) = 0 -> allowed <= 0 -> a (1) refused, b ok
+    bit = compute_spread_bit(
+        ZONE_LABEL, 1, "a", {"a": 2}, ["a", "b"], True
+    )
+    assert bit.refused == ("a",)
+
+
+def test_verdict_departure_lowers_min():
+    # all domains hold exactly 1 and the carrier is one of them: after
+    # departure its domain has 0, so min drops to 0 — placements into
+    # the OTHER domains (still 1) must now be refused at maxSkew 1
+    bit = compute_spread_bit(
+        ZONE_LABEL, 1, "a", {"a": 1, "b": 1, "c": 1}, ["a", "b", "c"], True
+    )
+    assert bit.refused == ("b", "c")
+
+
+def test_verdict_non_self_match_carrier():
+    # carrier doesn't match its own selector: arrival adds nothing,
+    # departure shifts nothing — counts a:1 b:0, maxSkew 1: a allowed
+    # (1 - 0 <= 1), b allowed
+    bit = compute_spread_bit(
+        ZONE_LABEL, 1, "a", {"a": 1}, ["a", "b"], False
+    )
+    assert bit.refused == ()
+
+
+def test_verdict_max_skew_widens():
+    # a:2 b:0, min 0: at maxSkew 2, placing in a gives 2+1-0 = 3 > 2 —
+    # still refused; at maxSkew 3 it is allowed
+    assert compute_spread_bit(
+        ZONE_LABEL, 2, None, {"a": 2}, ["a", "b"], True
+    ).refused == ("a",)
+    assert compute_spread_bit(
+        ZONE_LABEL, 3, None, {"a": 2}, ["a", "b"], True
+    ).refused == ()
+
+
+def test_verdict_no_domains():
+    bit = compute_spread_bit(ZONE_LABEL, 1, None, {}, [], True)
+    assert bit == SpreadBit(topology_key=ZONE_LABEL, refused=())
+
+
+def test_lane_guard_two_carriers():
+    a = make_pod("a", 100, labels={"app": "web"},
+                 spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),))
+    b = make_pod("b", 100, labels={"app": "web"},
+                 spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),))
+    plain = make_pod("c", 100)
+    assert spread_lane_guard([a, b, plain]) == {0, 1}
+
+
+def test_lane_guard_carrier_plus_matched_mover():
+    a = make_pod("a", 100, labels={"tier": "x"},
+                 spread_constraints=((HOSTNAME, 1, (("app", "web"),)),))
+    b = make_pod("b", 100, labels={"app": "web"})
+    assert spread_lane_guard([a, b]) == {0, 1}
+
+
+def test_lane_guard_single_carrier_ok():
+    a = make_pod("a", 100, labels={"app": "web"},
+                 spread_constraints=((HOSTNAME, 1, (("app", "web"),)),))
+    plain = make_pod("b", 100)
+    assert spread_lane_guard([a, plain]) == set()
+
+
+# --- oracle / packer (object path) ----------------------------------------
+
+def _placement(fc, pod_name):
+    packed, meta = pack_fake(fc)
+    result = plan_oracle(packed)
+    for c, pods in enumerate(meta.cand_pods):
+        for k, p in enumerate(pods):
+            if p.name == pod_name:
+                if not result.feasible[c]:
+                    return None
+                return meta.spot[int(result.assignment[c, k])].node.name
+    raise AssertionError(f"{pod_name} not in any lane")
+
+
+def _zone_cluster():
+    """Zone a: spot-a1 holds one app=web match. Zone b: spot-b1 empty.
+    Candidate od-1 (zone a) holds the mover."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zone_labels(ON_DEMAND_LABELS, "a")))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("web-0", 100, "spot-a1", labels={"app": "web"}))
+    return fc
+
+
+def test_zone_spread_prefers_empty_zone():
+    """Mover web-1 (app=web, zone spread maxSkew 1) currently in zone a;
+    after departure zone counts are a:1 b:0 — zone a (1+1-0=2>1)
+    refused, zone b allowed."""
+    fc = _zone_cluster()
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    assert _placement(fc, "web-1") == "spot-b1"
+
+
+def test_zone_spread_blocked_when_all_zones_full():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zone_labels(ON_DEMAND_LABELS, "c")))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("web-a", 100, "spot-a1", labels={"app": "web"}))
+    fc.add_pod(make_pod("web-b", 100, "spot-b1", labels={"app": "web"}))
+    # mover from zone c (its departure empties c -> min 0): both spot
+    # zones at 1, 1+1-0 = 2 > 1 -> nothing admits it
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    packed, _ = pack_fake(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+
+
+def test_zone_spread_max_skew_2_allows():
+    fc = _zone_cluster()
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 2, (("app", "web"),)),),
+    ))
+    # a:1 b:0 after departure; placing in a: 1+1-0 = 2 <= 2 — first-fit
+    # takes the first admitting spot in probe order
+    assert _placement(fc, "web-1") in ("spot-a1", "spot-b1")
+
+
+def test_hostname_spread_one_per_node():
+    """The classic one-replica-per-node pattern: maxSkew 1 hostname
+    spread with an empty node available — must pick the empty one."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _host_labels(ON_DEMAND_LABELS, "od-1")))
+    fc.add_node(make_node("spot-1", _host_labels(SPOT_LABELS, "spot-1")))
+    fc.add_node(make_node("spot-2", _host_labels(SPOT_LABELS, "spot-2")))
+    fc.add_pod(make_pod("web-0", 500, "spot-1", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((HOSTNAME, 1, (("app", "web"),)),),
+    ))
+    assert _placement(fc, "web-1") == "spot-2"
+
+
+def test_keyless_nodes_refuse_spread_carrier():
+    """PodTopologySpread filters nodes lacking the topology key: a spot
+    node without the zone label cannot take the carrier even though it
+    is otherwise empty."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zone_labels(ON_DEMAND_LABELS, "a")))
+    fc.add_node(make_node("spot-nz", SPOT_LABELS))  # keyless
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    assert _placement(fc, "web-1") == "spot-b1"
+
+
+def test_spread_counts_span_unclassified_nodes():
+    """A match resident on an unclassified (e.g. control-plane) node in
+    zone b raises zone b's count — with a:0 b:1 and maxSkew 1 the
+    carrier must go to zone a."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zone_labels(ON_DEMAND_LABELS, "c")))
+    fc.add_node(make_node("cp-1", _zone_labels({}, "b")))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("web-0", 100, "cp-1", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    # a:0, b:1 (cp-1 resident), c:0 after departure; placing in b:
+    # 1+1-0 = 2 > 1 refused; a allowed
+    assert _placement(fc, "web-1") == "spot-a1"
+
+
+def test_unready_node_lowers_the_domain_min():
+    """Regression (round-4 review): kube-scheduler counts domains on
+    NotReady nodes (default nodeTaintsPolicy=Ignore ignores the
+    not-ready taints). A dead empty node in zone c drops the true min
+    to 0, so with every ready zone at count 1 the carrier must be
+    refused EVERYWHERE — missing the unready domain would overstate the
+    min and approve a stranding drain."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zone_labels(ON_DEMAND_LABELS, "d")))
+    dead = make_node("cp-1", _zone_labels({}, "c"))
+    dead.ready = False
+    fc.add_node(dead)
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("web-a", 100, "spot-a1", labels={"app": "web"}))
+    fc.add_pod(make_pod("web-b", 100, "spot-b1", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    # ready-only view: min over {a:1, b:1, d:0}... d is od-1's own zone
+    # (count 0 after departure) — add a match there so the unready
+    # domain is the ONLY zero: without cp-1's zone the model would
+    # approve zone a or b
+    fc.add_pod(make_pod("web-d", 100, "od-1", labels={"app": "web"}))
+    packed, _ = pack_fake(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+    _parity(fc)
+
+
+def test_unready_node_pods_count_in_target_domain():
+    """Matched pods on a not-ready node in the TARGET zone raise its
+    count — missing them would understate the target and approve what
+    the scheduler refuses."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zone_labels(ON_DEMAND_LABELS, "c")))
+    dead = make_node("cp-1", _zone_labels({}, "a"))
+    dead.ready = False
+    fc.add_node(dead)
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("web-0", 100, "cp-1", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    # a:1 (on the dead node!), b:0, c:0 -> zone a refused (1+1-0 > 1)
+    assert _placement(fc, "web-1") == "spot-b1"
+    _parity(fc)
+
+
+def test_two_involved_movers_fail_lane():
+    fc = _zone_cluster()
+    for i in (1, 2):
+        fc.add_pod(make_pod(
+            f"web-{i}", 200, "od-1", labels={"app": "web"},
+            spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+        ))
+    packed, _ = pack_fake(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+
+
+def test_hostname_and_zone_pair_constraint():
+    """The common Deployment shape: hostname + zone spread together.
+    spot-a2 is in the already-loaded zone a -> zone constraint refuses
+    it; spot-b1 hosts a match -> hostname refuses it; spot-b2 clean."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node(
+        "od-1", _host_labels(_zone_labels(ON_DEMAND_LABELS, "a"), "od-1")))
+    fc.add_node(make_node(
+        "spot-a2", _host_labels(_zone_labels(SPOT_LABELS, "a"), "spot-a2")))
+    fc.add_node(make_node(
+        "spot-b1", _host_labels(_zone_labels(SPOT_LABELS, "b"), "spot-b1")))
+    fc.add_node(make_node(
+        "spot-b2", _host_labels(_zone_labels(SPOT_LABELS, "b"), "spot-b2")))
+    fc.add_pod(make_pod("web-a", 100, "spot-a2", labels={"app": "web"}))
+    fc.add_pod(make_pod("web-b", 100, "spot-b1", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=(
+            (ZONE_LABEL, 2, (("app", "web"),)),
+            (HOSTNAME, 1, (("app", "web"),)),
+        ),
+    ))
+    assert _placement(fc, "web-1") == "spot-b2"
+
+
+def test_plain_peers_unaffected_by_carrier():
+    fc = _zone_cluster()
+    fc.add_pod(make_pod(
+        "web-1", 200, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    fc.add_pod(make_pod("plain", 200, "od-1"))
+    packed, meta = pack_fake(fc)
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    pods = meta.cand_pods[0]
+    k = next(i for i, p in enumerate(pods) if p.name == "web-1")
+    assert meta.spot[int(result.assignment[0, k])].node.name == "spot-b1"
+
+
+# --- columnar parity -------------------------------------------------------
+
+def _parity(fc):
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = pack_fake(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+    return store
+
+
+def test_columnar_parity_zone_spread():
+    fc = _zone_cluster()
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    fc.add_pod(make_pod("plain", 100, "od-1"))
+    _parity(fc)
+
+
+def test_columnar_parity_hostname_zone_pair():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node(
+        "od-1", _host_labels(_zone_labels(ON_DEMAND_LABELS, "a"), "od-1")))
+    fc.add_node(make_node(
+        "spot-a2", _host_labels(_zone_labels(SPOT_LABELS, "a"), "spot-a2")))
+    fc.add_node(make_node(
+        "spot-b1", _host_labels(_zone_labels(SPOT_LABELS, "b"), "spot-b1")))
+    fc.add_pod(make_pod("web-a", 100, "spot-a2", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=(
+            (ZONE_LABEL, 2, (("app", "web"),)),
+            (HOSTNAME, 1, (("app", "web"),)),
+        ),
+    ))
+    _parity(fc)
+
+
+def test_columnar_parity_lane_guard():
+    fc = _zone_cluster()
+    for i in (1, 2):
+        fc.add_pod(make_pod(
+            f"web-{i}", 200, "od-1", labels={"app": "web"},
+            spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+        ))
+    fc.add_pod(make_pod("plain", 100, "od-1"))
+    _parity(fc)
+
+
+def test_columnar_parity_counts_span_unclassified():
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zone_labels(ON_DEMAND_LABELS, "c")))
+    fc.add_node(make_node("cp-1", _zone_labels({}, "b")))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("web-0", 100, "cp-1", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    _parity(fc)
+
+
+def test_columnar_parity_tracks_match_departure():
+    """Churn: the zone-a match leaves; next tick's verdicts must open
+    zone a on both paths identically (counts are per tick)."""
+    fc = _zone_cluster()
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    store = _parity(fc)
+    fc.evict_pod(fc.pods["default/web-0"], 0)
+    fc.clock.advance(5.0)
+    obj, _ = pack_fake(fc)
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
+
+
+# --- end to end ------------------------------------------------------------
+
+def test_drain_respects_spread_end_to_end():
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.add_node(make_node("od-1", _zone_labels(ON_DEMAND_LABELS, "a")))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("web-0", 100, "spot-a1", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "web-1", 300, "od-1", labels={"app": "web"},
+        spread_constraints=((ZONE_LABEL, 1, (("app", "web"),)),),
+    ))
+    cfg = ReschedulerConfig(solver="numpy", node_drain_delay=0.0)
+    r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=fc.clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    fc.clock.advance(10.0)
+    assert fc.pods["default/web-1"].node_name == "spot-b1"

@@ -202,10 +202,12 @@ def test_requirer_on_unclassified_node_repels_matches():
     _parity(fc)
 
 
-def test_unready_unclassified_node_invisible_both_paths():
-    """An UNREADY unclassified node's pods stay invisible on both paths
-    (the polling lister only returns ready nodes; the columnar widening
-    gates on readiness to keep bit parity)."""
+def test_unready_node_presence_visible_both_paths():
+    """An UNREADY node's pods are presence-visible (round-4 widening:
+    zone conflicts and spread counts still exist to the real scheduler
+    on not-ready nodes — NodeMap.unready / columnar presence_extra):
+    the zone-a match on the unready node repels the requirer from zone
+    a on BOTH paths, bit-identically."""
     fc = FakeCluster(FakeClock())
     fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
     cp = make_node("cp-1", _zone_labels({}, "a"))
@@ -216,9 +218,25 @@ def test_unready_unclassified_node_invisible_both_paths():
     fc.add_pod(make_pod("db-0", 100, "cp-1", labels={"app": "db"}))
     fc.add_pod(make_pod("web", 300, "od-1",
                         anti_affinity_zone_match={"app": "db"}))
-    # note: spot-a1 sorts before spot-b1 (ties keep insertion order), so
-    # with cp-1 invisible the requirer lands in zone a
-    assert _placement(fc, "web") == "spot-a1"
+    assert _placement(fc, "web") == "spot-b1"
+    _parity(fc)
+
+
+def test_unready_spot_node_is_presence_not_capacity():
+    """A not-ready SPOT node never joins the placement pool, but its
+    resident zone conflicts stay visible."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    dead = make_node("spot-a1", _zone_labels(SPOT_LABELS, "a"))
+    dead.ready = False
+    fc.add_node(dead)
+    fc.add_node(make_node("spot-a2", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-0", 100, "spot-a1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        anti_affinity_zone_match={"app": "db"}))
+    # the match on the dead zone-a node repels web from ALL of zone a
+    assert _placement(fc, "web") == "spot-b1"
     _parity(fc)
 
 
