@@ -358,18 +358,31 @@ def run_quality_scale(args, metric: str, unit: str, backend_note) -> int:
     return 0
 
 
-def run_replay_bench(seed: int, n_events: int, note=None) -> int:
+def run_replay_bench(
+    seed: int, n_events: int, note=None, constrained: bool = False
+) -> int:
     from k8s_spot_rescheduler_tpu.bench.replay import run_replay
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
-    stats = run_replay(ReschedulerConfig(), n_events=n_events, seed=seed)
+    stats = run_replay(
+        ReschedulerConfig(), n_events=n_events, seed=seed,
+        constrained=constrained,
+    )
     print(f"replay: {stats}", file=sys.stderr)
     out = {
-        "metric": "replay_replan_ms_p50_1k_events",
+        "metric": (
+            "replay_constrained_replan_ms_p50_1k_events"
+            if constrained
+            else "replay_replan_ms_p50_1k_events"
+        ),
         "value": round(stats["replan_ms_p50"], 3),
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / max(stats["replan_ms_p50"], 1e-9), 3),
     }
+    if constrained:
+        out["stranded_by_drain"] = stats["stranded_by_drain"]
+        out["drained_nodes"] = stats["drained_nodes"]
+        out["unplaceable_pods_gauge"] = stats["unplaceable_pods_gauge"]
     if note:
         out["error"] = note
     emit(out)
@@ -389,6 +402,8 @@ def _metric_for(args) -> tuple:
             "ratio",
         )
     if args.config == 5:
+        if args.constrained:
+            return "replay_constrained_replan_ms_p50_1k_events", "ms"
         return "replay_replan_ms_p50_1k_events", "ms"
     suffix = "_x%g" % args.scale if args.scale != 1.0 else ""
     if args.config in (3, 4):
@@ -427,6 +442,10 @@ def main() -> int:
                          "and report the worst ratio")
     ap.add_argument("--events", type=int, default=1000,
                     help="event count for --config 5 replay")
+    ap.add_argument("--constrained", action="store_true",
+                    help="with --config 5: replay the full-predicate "
+                         "cluster (taints, affinity groups, PDBs, hard "
+                         "spread) and report the stranding invariant")
     ap.add_argument("--scale", type=float, default=1.0,
                     help="multiply the config's node/pod counts (headroom runs)")
     ap.add_argument("--watchdog", type=float, default=1500.0,
@@ -525,7 +544,10 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         )
 
     if args.config == 5:
-        return run_replay_bench(args.seed, args.events, note=backend_note)
+        return run_replay_bench(
+            args.seed, args.events, note=backend_note,
+            constrained=args.constrained,
+        )
     return _run_latency(args, metric, unit, backend_note)
 
 

@@ -18,6 +18,7 @@ import numpy as np
 
 from k8s_spot_rescheduler_tpu.io.synthetic import (
     CONFIGS,
+    REPLAY_CONSTRAINED,
     generate_replay,
 )
 from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
@@ -31,9 +32,20 @@ def run_replay(
     config_id: int = 5,
     n_events: int = 1000,
     seed: int = 0,
+    constrained: bool = False,
 ) -> Dict[str, float]:
-    """Returns summary stats of a full replay run."""
-    client, events = generate_replay(CONFIGS[config_id], n_events, seed)
+    """Returns summary stats of a full replay run.
+
+    ``constrained`` swaps in the REPLAY_CONSTRAINED spec — config-5
+    churn with the full predicate surface loaded on (taints,
+    anti-affinity groups, PDBs, sparse hostname/zone hard spread) — and
+    additionally tracks the safety invariant: a pod evicted by OUR
+    drain that fails to re-place immediately is a STRANDING (the plan
+    proved its placement); pods displaced by spot interruptions may
+    legitimately pend (capacity vanished). Conservatism gauge values
+    (metrics/registry.py) ride along in the stats."""
+    spec = REPLAY_CONSTRAINED if constrained else CONFIGS[config_id]
+    client, events = generate_replay(spec, n_events, seed)
     # drains every cooldown-free tick so churn keeps being consolidated
     config = dataclasses.replace(config, node_drain_delay=0.0)
     r = Rescheduler(
@@ -44,6 +56,7 @@ def run_replay(
     drained = 0
     displaced = 0
     interruptions = 0
+    stranded_by_drain = 0
     i = 0
     t_end = events[-1].at if events else 0.0
     now = 0.0
@@ -63,12 +76,20 @@ def run_replay(
                 client.add_node(ev.node)
             i += 1
         client.clock.advance(config.housekeeping_interval)
+        evictions_before = len(client.evictions)
         result = r.tick()
         if result.report is not None:
             plan_ms.append(result.report.solve_seconds * 1e3)
         drained += len(result.drained)
+        if result.drained:
+            # the proven-placement invariant: none of THIS tick's drain
+            # evictions may end the tick pending
+            tick_evicted = set(client.evictions[evictions_before:])
+            stranded_by_drain += sum(
+                1 for p in client.pending if p.uid in tick_evicted
+            )
 
-    return {
+    stats = {
         "ticks": len(plan_ms),
         "events": float(len(events)),
         "interruptions": float(interruptions),
@@ -79,4 +100,14 @@ def run_replay(
             float(np.percentile(plan_ms, 99)) if plan_ms else 0.0
         ),
         "pending_at_end": float(len(client.pending)),
+        "stranded_by_drain": float(stranded_by_drain),
     }
+    if constrained:
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+        snap = metrics.conservatism_snapshot()
+        stats["unplaceable_pods_gauge"] = float(snap["unplaceable_pods"])
+        stats["blocked_unmodeled_gauge"] = float(
+            snap["blocked"].get("unmodeled", 0.0)
+        )
+    return stats

@@ -91,11 +91,15 @@ def decode_pod(obj: dict) -> PodSpec:
     node_affinity, naff_unmodeled = decode_node_affinity(
         affinity.get("nodeAffinity") or {}
     )
+    # `or "default"` (not a dict default): the native engine normalizes
+    # null/empty namespace to "default" too — lockstep for the
+    # own-namespace `namespaces` verdict below
+    pod_ns = meta.get("namespace") or "default"
     anti_affinity_match, anti_zone_match, anti_unmodeled = decode_anti_affinity(
-        affinity.get("podAntiAffinity") or {}
+        affinity.get("podAntiAffinity") or {}, pod_ns
     )
     pod_affinity_match, paff_unmodeled = decode_pod_affinity(
-        affinity.get("podAffinity") or {}
+        affinity.get("podAffinity") or {}, pod_ns
     )
     required_affinity = naff_unmodeled or anti_unmodeled or paff_unmodeled
     # PVC-backed volumes: conservatively unplaceable at decode; the
@@ -129,7 +133,7 @@ def decode_pod(obj: dict) -> PodSpec:
     )
     return PodSpec(
         name=meta.get("name", ""),
-        namespace=meta.get("namespace", "default"),
+        namespace=pod_ns,
         node_name=spec.get("nodeName", "") or "",
         requests=requests,
         priority=int(spec.get("priority", 0) or 0),
@@ -251,63 +255,146 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
 )
 
 
-def _decode_affinity_block(block: dict, topology_keys: tuple) -> tuple:
-    """(matchLabels, topologyKey, unmodeled) for a podAffinity /
-    podAntiAffinity object.
+# Sentinel for "this term's selector can never match any pod" (e.g. a
+# folded key required to equal two different values): an anti-affinity
+# term like that constrains nothing and is DROPPED exactly; a positive
+# term like that can never be satisfied — unmodeled (= unplaceable,
+# which is also exact).
+_MATCHES_NOTHING = object()
 
-    The modeled shape — kept in exact lockstep with the native engine's
-    ``extract_affinity_term`` (native/ingest.cc) — is ONE required term
-    with a topologyKey from ``topology_keys`` and a non-empty
-    matchLabels-only selector in the pod's own namespace. Anything else
-    required is unmodeled (conservatively unplaceable)."""
-    req = block.get("requiredDuringSchedulingIgnoredDuringExecution")
-    if not req:
-        return {}, "", False
-    if not isinstance(req, list) or len(req) != 1:
-        return {}, "", True
-    term = req[0]
-    if not isinstance(term, dict):
-        return {}, "", True  # malformed element — conservatively unmodeled
-    topo = term.get("topologyKey")
-    if topo not in topology_keys:
-        return {}, "", True
-    if term.get("namespaces"):
-        return {}, "", True
-    # namespaceSelector (k8s ≥1.21) widens the term beyond the pod's own
-    # namespace — even {} means "all namespaces". Presence of the key at
-    # all is outside the modeled own-namespace shape: unmodeled.
+
+def _decode_term_selector(term: dict, namespace: str):
+    """The selector of one required affinity term, canonicalized to a
+    matchLabels-equivalent dict (round-4 widened shape, exact native
+    lockstep):
+
+    - ``namespaces`` may be absent/empty OR name only the pod's own
+      namespace (still own-namespace semantics);
+    - ``namespaceSelector`` presence at all stays unmodeled ({} means
+      "all namespaces");
+    - ``matchExpressions`` entries fold into the dict when every one is
+      a single-value ``In`` (exactly equivalent to a matchLabels pair);
+      Exists/NotIn/DoesNotExist/multi-value stay unmodeled;
+    - a key required to equal two different values makes the selector
+      match nothing → ``_MATCHES_NOTHING``.
+
+    Returns (dict | _MATCHES_NOTHING, unmodeled)."""
+    ns_list = term.get("namespaces")
+    if ns_list:
+        if not isinstance(ns_list, list) or not all(
+            x == namespace for x in ns_list
+        ):
+            return {}, True
     if "namespaceSelector" in term:
-        return {}, "", True
+        return {}, True
     sel = term.get("labelSelector")
     if not isinstance(sel, dict):
-        return {}, "", True
-    if sel.get("matchExpressions"):
-        return {}, "", True
+        return {}, True
     match = sel.get("matchLabels")
-    if not isinstance(match, dict) or not match:
-        return {}, "", True
-    return dict(match), topo, False
+    if match is None:
+        match = {}
+    if not isinstance(match, dict):
+        return {}, True
+    # value-type validation BEFORE expression folding — the native
+    # engine rejects non-string matchLabels values at collection time,
+    # so a type error must win over a later key conflict (lockstep)
+    if any(
+        not isinstance(k, str) or not isinstance(v, str)
+        for k, v in match.items()
+    ):
+        return {}, True
+    out = dict(match)
+    exprs = sel.get("matchExpressions")
+    if exprs:
+        if not isinstance(exprs, list):
+            return {}, True
+        for e in exprs:
+            if not isinstance(e, dict) or e.get("operator") != "In":
+                return {}, True
+            key, values = e.get("key"), e.get("values")
+            if (
+                not isinstance(key, str)
+                or not isinstance(values, list)
+                or len(values) != 1
+                or not isinstance(values[0], str)
+            ):
+                return {}, True
+            if key in out and out[key] != values[0]:
+                return _MATCHES_NOTHING, False
+            out[key] = values[0]
+    if not out:
+        return {}, True  # empty selector: not modeled
+    # separator-byte guard last, like the native emit loop (a conflict
+    # verdict wins over a sep-byte one on both paths)
+    if any(_has_sep_bytes(k) or _has_sep_bytes(v) for k, v in out.items()):
+        return {}, True
+    return out, False
 
 
-def decode_anti_affinity(anti: dict) -> tuple:
+def decode_anti_affinity(anti: dict, namespace: str = "default") -> tuple:
     """(hostname matchLabels, zone matchLabels, unmodeled) for a
-    podAntiAffinity object; at most one of the selectors is non-empty."""
-    match, topo, unmodeled = _decode_affinity_block(
-        anti, ("kubernetes.io/hostname", ZONE_TOPOLOGY_KEY)
-    )
-    if topo == ZONE_TOPOLOGY_KEY:
-        return {}, match, unmodeled
-    return match, {}, unmodeled
+    podAntiAffinity object — round-4 widened canonical shape, in exact
+    lockstep with native/ingest.cc ``extract_anti_affinity``:
+
+    up to TWO required terms, at most one per topology family
+    (hostname + zone — the common belt-and-suspenders Deployment pair),
+    each with the widened selector of ``_decode_term_selector``. Two
+    terms of the SAME family would need multiple selectors per family
+    and stay unmodeled; a term whose selector matches nothing
+    constrains nothing and is dropped exactly."""
+    req = anti.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not req:
+        return {}, {}, False
+    if not isinstance(req, list) or len(req) > 2:
+        return {}, {}, True
+    host: dict = {}
+    zone: dict = {}
+    for term in req:
+        if not isinstance(term, dict):
+            return {}, {}, True
+        topo = term.get("topologyKey")
+        if topo == "kubernetes.io/hostname":
+            family = "host"
+        elif topo == ZONE_TOPOLOGY_KEY:
+            family = "zone"
+        else:
+            return {}, {}, True
+        sel, unmodeled = _decode_term_selector(term, namespace)
+        if unmodeled:
+            return {}, {}, True
+        if sel is _MATCHES_NOTHING:
+            continue  # constrains nothing — exact to drop
+        if family == "host":
+            if host:
+                return {}, {}, True  # two hostname terms: one slot only
+            host = sel
+        else:
+            if zone:
+                return {}, {}, True
+            zone = sel
+    return host, zone, False
 
 
-def decode_pod_affinity(paff: dict) -> tuple:
+def decode_pod_affinity(paff: dict, namespace: str = "default") -> tuple:
     """(matchLabels, unmodeled) for a required POSITIVE podAffinity
-    object — hostname topology only; the planner admits the pod only on
-    nodes already hosting a match (predicates/masks.PodAffinityBit)."""
-    match, _, unmodeled = _decode_affinity_block(
-        paff, ("kubernetes.io/hostname",)
-    )
-    return match, unmodeled
+    object — ONE hostname-topology term with the widened selector; the
+    planner admits the pod only on nodes already hosting a match
+    (predicates/masks.PodAffinityBit). A never-matching selector can
+    never be satisfied: unmodeled (= unplaceable, which is exact)."""
+    req = paff.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not req:
+        return {}, False
+    if not isinstance(req, list) or len(req) != 1:
+        return {}, True
+    term = req[0]
+    if not isinstance(term, dict):
+        return {}, True
+    if term.get("topologyKey") != "kubernetes.io/hostname":
+        return {}, True
+    sel, unmodeled = _decode_term_selector(term, namespace)
+    if unmodeled or sel is _MATCHES_NOTHING:
+        return {}, True
+    return sel, False
 
 
 # Fields whose presence changes PodTopologySpread counting semantics in

@@ -60,6 +60,10 @@ class SyntheticSpec:
     taints: bool = False  # spot taint + partial toleration coverage
     anti_affinity: bool = False
     pdbs: bool = False
+    # hostname/zone labels on every node + hard topologySpreadConstraints
+    # on a sparse subset of apps (the round-4 modeled predicate under
+    # churn; constrained replay)
+    spread: bool = False
     # mean utilization targets (fraction of allocatable CPU)
     on_demand_util: float = 0.45
     spot_util: float = 0.50
@@ -85,6 +89,15 @@ CONFIGS = {
     5: SyntheticSpec("replay-1k-events", 500, 500, 8_000, zipf_sizes=True),
 }
 
+# Config-5 churn with the full predicate surface loaded on (round 4):
+# taints + partial tolerations, anti-affinity groups, PDBs, and sparse
+# hostname/zone hard spread constraints — the constrained replay row of
+# docs/RESULTS.md (bench.py --config 5 --constrained).
+REPLAY_CONSTRAINED = SyntheticSpec(
+    "replay-constrained", 500, 500, 8_000,
+    zipf_sizes=True, taints=True, anti_affinity=True, pdbs=True, spread=True,
+)
+
 
 def _pod_sizes(rng: np.random.Generator, n: int, zipf: bool) -> np.ndarray:
     """CPU requests in millicores. Zipf-ish skew: many small pods, a few
@@ -109,9 +122,14 @@ def generate_cluster(
         nodes = []
         for i in range(count):
             cpu, mem, cap, eph = SHAPES[rng.integers(0, len(SHAPES))]
+            node_labels = dict(labels)
+            if spec.spread:
+                name = f"{prefix}-{i}"
+                node_labels["kubernetes.io/hostname"] = name
+                node_labels["topology.kubernetes.io/zone"] = f"z{i % 4}"
             node = NodeSpec(
                 name=f"{prefix}-{i}",
-                labels=dict(labels),
+                labels=node_labels,
                 allocatable={CPU: cpu, MEMORY: mem, PODS: cap, EPHEMERAL: eph},
                 taints=[SPOT_TAINT] if tainted else [],
             )
@@ -161,12 +179,26 @@ def generate_cluster(
         node = all_nodes[best][0]
         if cnt + 1 < node.allocatable[PODS] - 5:
             heapq.heappush(heap, (neg_room + cpu, cnt + 1, best))
-        is_spot = node.labels == SPOT_LABELS
+        # role-key check, not dict equality — spread mode adds
+        # hostname/zone labels to every node
+        is_spot = (
+            node.labels.get("kubernetes.io/role")
+            == SPOT_LABELS["kubernetes.io/role"]
+        )
         tolerations = []
         if spec.taints and (is_spot or rng.random() < 0.7):
             # pods already on tainted spot nodes must tolerate; 70% of
             # on-demand pods are spot-tolerant (the movable majority)
             tolerations = [SPOT_TOLERATION]
+        # sparse hard spread: every 13th app's pods carry the common
+        # hostname+zone constraint pair over their own app label (the
+        # round-4 modeled predicate; loose skews so drains stay possible)
+        spread_constraints = ()
+        if spec.spread and app % 13 == 0:
+            spread_constraints = (
+                ("kubernetes.io/hostname", 3, (("app", f"app-{app}"),)),
+                ("topology.kubernetes.io/zone", 4, (("app", f"app-{app}"),)),
+            )
         pod = PodSpec(
             name=f"pod-{p}",
             namespace=f"ns-{app % 16}",
@@ -178,6 +210,7 @@ def generate_cluster(
             anti_affinity_group=(
                 f"aff-{app}" if spec.anti_affinity and rng.random() < 0.1 else ""
             ),
+            spread_constraints=spread_constraints,
         )
         fc.add_pod(pod)
 
@@ -516,9 +549,16 @@ def generate_replay(
             events.append(ReplayEvent(at=t, kind="remove_spot", node_name=name))
         else:
             cpu, mem, cap, eph = SHAPES[rng.integers(0, len(SHAPES))]
+            name = f"spot-new-{extra}"
+            labels = dict(SPOT_LABELS)
+            if spec.spread:
+                # real kubelets label every node; churned-in capacity
+                # must be reachable by spread-constrained pods
+                labels["kubernetes.io/hostname"] = name
+                labels["topology.kubernetes.io/zone"] = f"z{extra % 4}"
             node = NodeSpec(
-                name=f"spot-new-{extra}",
-                labels=dict(SPOT_LABELS),
+                name=name,
+                labels=labels,
                 allocatable={CPU: cpu, MEMORY: mem, PODS: cap, EPHEMERAL: eph},
             )
             extra += 1

@@ -136,6 +136,18 @@ def update_conservatism(n_unplaceable: int, by_reason: dict) -> None:
         blocked_candidates.labels(reason).set(int(by_reason.get(reason, 0)))
 
 
+def conservatism_snapshot() -> dict:
+    """Current gauge values via the public collect() API (test/bench
+    readback — keeps prometheus_client internals out of callers)."""
+    unplaceable = 0.0
+    for sample in unplaceable_pods.collect()[0].samples:
+        unplaceable = sample.value
+    blocked = {}
+    for sample in blocked_candidates.collect()[0].samples:
+        blocked[sample.labels.get("reason", "")] = sample.value
+    return {"unplaceable_pods": unplaceable, "blocked": blocked}
+
+
 def serve(listen_address: str) -> None:
     """Start the metrics HTTP endpoint (reference rescheduler.go:126-130)."""
     host, _, port = listen_address.rpartition(":")

@@ -79,12 +79,15 @@ class PodSpec:
     # co-locate on one node (topologyKey=hostname requiredDuringScheduling).
     anti_affinity_group: str = ""
     # The standard k8s spread pattern, modeled exactly: required
-    # podAntiAffinity with topologyKey=hostname and a matchLabels selector
-    # (scoped to the pod's namespace). The pod refuses nodes hosting any
-    # pod matched by this selector, and — symmetrically, like the real
-    # scheduler — matched pods refuse nodes hosting this pod. Shapes
-    # beyond this (matchExpressions, other topology keys, multiple terms)
-    # fall back to ``unmodeled_constraints``.
+    # podAntiAffinity with topologyKey=hostname and a matchLabels-
+    # equivalent selector (scoped to the pod's namespace; round 4 also
+    # folds single-value In matchExpressions, accepts an own-namespace
+    # ``namespaces`` list, and allows this term to pair with one zone
+    # term below). The pod refuses nodes hosting any pod matched by
+    # this selector, and — symmetrically, like the real scheduler —
+    # matched pods refuse nodes hosting this pod. Shapes beyond this
+    # (other operators, multi-value In, other topology keys, two terms
+    # of one family) fall back to ``unmodeled_constraints``.
     anti_affinity_match: Dict[str, str] = dataclasses.field(default_factory=dict)
     # Required anti-affinity with topologyKey=topology.kubernetes.io/zone
     # (same canonical selector shape, own namespace): the pod refuses

@@ -502,74 +502,163 @@ bool py_truthy(const Val* v) {
   return false;
 }
 
-// The modeled affinity-term shape (mirrors io/kube.py
-// _decode_affinity_block, shared by podAffinity AND podAntiAffinity):
-// ONE required term with a modeled topologyKey (hostname always;
-// topology.kubernetes.io/zone additionally when allow_zone — the anti
-// block) and a matchLabels-only labelSelector. Returns the matchLabels
-// object, sets *is_zone for a zone term, and leaves *unmodeled false;
-// anything else required sets *unmodeled.
-const Val* extract_affinity_term(const Val* block, bool allow_zone,
-                                 bool* is_zone, bool* unmodeled) {
-  *is_zone = false;
-  if (!block || block->kind != Val::Obj) return nullptr;
-  const Val* req = block->get("requiredDuringSchedulingIgnoredDuringExecution");
-  if (!req) return nullptr;
-  if (req->kind != Val::Arr) {
-    // Python lockstep: a truthy non-list is unmodeled, a falsy value
-    // (null/false/0/""/{}) counts as absent.
-    if (py_truthy(req)) *unmodeled = true;
-    return nullptr;
+// --- widened pod-affinity term selector (round 4) ------------------------
+//
+// Exact lockstep with io/kube.py _decode_term_selector: namespaces may
+// name only the pod's own namespace; namespaceSelector presence stays
+// unmodeled; matchExpressions fold into the selector when every entry
+// is a single-value In; a key required to equal two different values
+// makes the selector match nothing.
+
+enum SelVerdict { SEL_OK = 0, SEL_NOTHING = 1, SEL_UNMODELED = 2 };
+
+bool has_sep_bytes(std::string_view s);  // defined with the naff blobs
+
+int term_selector_blob(const Val* term, std::string_view ns,
+                       std::string* blob) {
+  blob->clear();
+  const Val* ns_list = term->get("namespaces");
+  if (py_truthy(ns_list)) {
+    if (ns_list->kind != Val::Arr) return SEL_UNMODELED;
+    for (const Val* x : ns_list->arr) {
+      if (!x || x->kind != Val::Str || x->text != ns) return SEL_UNMODELED;
+    }
   }
-  if (req->arr.empty()) return nullptr;
-  if (req->arr.size() != 1) {
+  if (term->get("namespaceSelector") != nullptr) return SEL_UNMODELED;
+  const Val* sel = term->get("labelSelector");
+  if (!sel || sel->kind != Val::Obj) return SEL_UNMODELED;
+  // selector pairs: matchLabels entries then folded In-expressions;
+  // Python folds into a dict, so a later duplicate key with the SAME
+  // value is harmless (the parse-side dict dedups) and a DIFFERENT
+  // value means matches-nothing
+  std::vector<std::pair<std::string_view, std::string_view>> pairs;
+  const Val* ml = sel->get("matchLabels");
+  if (ml) {
+    if (ml->kind != Val::Obj) return SEL_UNMODELED;
+    for (const auto& m : ml->obj) {
+      if (!m.second || m.second->kind != Val::Str) return SEL_UNMODELED;
+      pairs.emplace_back(m.first, m.second->text);
+    }
+  }
+  const Val* me = sel->get("matchExpressions");
+  if (py_truthy(me)) {
+    if (me->kind != Val::Arr) return SEL_UNMODELED;
+    for (const Val* e : me->arr) {
+      if (!e || e->kind != Val::Obj) return SEL_UNMODELED;
+      const Val* op = e->get("operator");
+      if (!op || op->kind != Val::Str || op->text != "In")
+        return SEL_UNMODELED;
+      const Val* key = e->get("key");
+      const Val* values = e->get("values");
+      if (!key || key->kind != Val::Str || !values ||
+          values->kind != Val::Arr || values->arr.size() != 1)
+        return SEL_UNMODELED;
+      const Val* v = values->arr[0];
+      if (!v || v->kind != Val::Str) return SEL_UNMODELED;
+      bool conflict = false, dup = false;
+      for (const auto& p : pairs) {
+        if (p.first == key->text) {
+          if (p.second != v->text) conflict = true;
+          dup = true;
+        }
+      }
+      if (conflict) return SEL_NOTHING;
+      if (!dup) pairs.emplace_back(key->text, v->text);
+    }
+  }
+  if (pairs.empty()) return SEL_UNMODELED;
+  for (const auto& p : pairs) {
+    if (has_sep_bytes(p.first) || has_sep_bytes(p.second))
+      return SEL_UNMODELED;
+    blob->append(p.first.data(), p.first.size());
+    *blob += UNIT_SEP;
+    blob->append(p.second.data(), p.second.size());
+    *blob += REC_SEP;
+  }
+  return SEL_OK;
+}
+
+// podAntiAffinity: up to TWO required terms, at most one per topology
+// family (hostname + zone); a matches-nothing term is dropped exactly.
+// Lockstep: io/kube.py decode_anti_affinity.
+void extract_anti_affinity(const Val* block, std::string_view ns,
+                           std::string* host_blob, std::string* zone_blob,
+                           bool* unmodeled) {
+  host_blob->clear();
+  zone_blob->clear();
+  if (!block || block->kind != Val::Obj) return;
+  const Val* req = block->get("requiredDuringSchedulingIgnoredDuringExecution");
+  if (!req || !py_truthy(req)) return;
+  if (req->kind != Val::Arr || req->arr.size() > 2) {
     *unmodeled = true;
-    return nullptr;
+    return;
+  }
+  for (const Val* term : req->arr) {
+    if (!term || term->kind != Val::Obj) {
+      *unmodeled = true;
+      return;
+    }
+    const Val* topo = term->get("topologyKey");
+    bool zone;
+    if (topo && topo->kind == Val::Str &&
+        topo->text == "kubernetes.io/hostname") {
+      zone = false;
+    } else if (topo && topo->kind == Val::Str &&
+               topo->text == "topology.kubernetes.io/zone") {
+      zone = true;
+    } else {
+      *unmodeled = true;
+      return;
+    }
+    std::string blob;
+    int verdict = term_selector_blob(term, ns, &blob);
+    if (verdict == SEL_UNMODELED) {
+      *unmodeled = true;
+      host_blob->clear();
+      zone_blob->clear();
+      return;
+    }
+    if (verdict == SEL_NOTHING) continue;
+    std::string* slot = zone ? zone_blob : host_blob;
+    if (!slot->empty()) {
+      *unmodeled = true;  // two terms of one family: one slot only
+      host_blob->clear();
+      zone_blob->clear();
+      return;
+    }
+    *slot = blob;
+  }
+}
+
+// required POSITIVE podAffinity: ONE hostname term, widened selector; a
+// matches-nothing selector can never be satisfied -> unmodeled.
+// Lockstep: io/kube.py decode_pod_affinity.
+void extract_pod_affinity(const Val* block, std::string_view ns,
+                          std::string* blob, bool* unmodeled) {
+  blob->clear();
+  if (!block || block->kind != Val::Obj) return;
+  const Val* req = block->get("requiredDuringSchedulingIgnoredDuringExecution");
+  if (!req || !py_truthy(req)) return;
+  if (req->kind != Val::Arr || req->arr.size() != 1) {
+    *unmodeled = true;
+    return;
   }
   const Val* term = req->arr[0];
   if (!term || term->kind != Val::Obj) {
-    *unmodeled = true;  // malformed element — Python marks it unmodeled
-    return nullptr;
+    *unmodeled = true;
+    return;
   }
   const Val* topo = term->get("topologyKey");
-  if (!topo || topo->kind != Val::Str) {
+  if (!topo || topo->kind != Val::Str ||
+      topo->text != "kubernetes.io/hostname") {
     *unmodeled = true;
-    return nullptr;
+    return;
   }
-  if (allow_zone && topo->text == "topology.kubernetes.io/zone") {
-    *is_zone = true;
-  } else if (topo->text != "kubernetes.io/hostname") {
+  int verdict = term_selector_blob(term, ns, blob);
+  if (verdict != SEL_OK) {
+    blob->clear();
     *unmodeled = true;
-    return nullptr;
   }
-  if (py_truthy(term->get("namespaces"))) {
-    *unmodeled = true;  // cross-namespace terms are not modeled
-    return nullptr;
-  }
-  // namespaceSelector (k8s >=1.21) widens the term beyond the pod's own
-  // namespace; even {} means "all namespaces". Key presence at all is
-  // outside the modeled own-namespace shape (Python lockstep).
-  if (term->get("namespaceSelector") != nullptr) {
-    *unmodeled = true;
-    return nullptr;
-  }
-  const Val* sel = term->get("labelSelector");
-  if (!sel || sel->kind != Val::Obj) {
-    *unmodeled = true;
-    return nullptr;
-  }
-  if (const Val* me = sel->get("matchExpressions")) {
-    if (me->kind == Val::Arr && !me->arr.empty()) {
-      *unmodeled = true;
-      return nullptr;
-    }
-  }
-  const Val* ml = sel->get("matchLabels");
-  if (!ml || ml->kind != Val::Obj || ml->obj.empty()) {
-    *unmodeled = true;  // empty selector = matches everything; not modeled
-    return nullptr;
-  }
-  return ml;
 }
 
 // Required node-affinity, in lockstep with io/kube.py
@@ -960,9 +1049,12 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     }
     if (phase == "Succeeded" || phase == "Failed") flags |= F_TERMINAL;
     if (phase == "Pending") flags |= F_PENDING;
-    const Val* anti_affinity_labels = nullptr;
-    const Val* zone_anti_labels = nullptr;
-    const Val* pod_affinity_labels = nullptr;
+    std::string pod_ns;
+    field(&pod_ns, meta, "namespace");
+    if (pod_ns.empty()) pod_ns = "default";
+    std::string anti_host_blob;
+    std::string anti_zone_blob;
+    std::string paff_blob;
     std::string naff_blob;
     std::string pvc_blob;
     std::string spread_blob;
@@ -971,18 +1063,12 @@ Batch* ingest_pods_impl(const char* buf, long n) {
       const Val* affinity = spec->get("affinity");
       const Val* aff_obj =
           (affinity && affinity->kind == Val::Obj) ? affinity : nullptr;
-      bool anti_zone = false, paff_zone = false;
-      const Val* anti_labels = extract_affinity_term(
-          aff_obj ? aff_obj->get("podAntiAffinity") : nullptr,
-          /*allow_zone=*/true, &anti_zone, &unmodeled);
-      if (anti_zone) {
-        zone_anti_labels = anti_labels;
-      } else {
-        anti_affinity_labels = anti_labels;
-      }
-      pod_affinity_labels = extract_affinity_term(
-          aff_obj ? aff_obj->get("podAffinity") : nullptr,
-          /*allow_zone=*/false, &paff_zone, &unmodeled);
+      extract_anti_affinity(
+          aff_obj ? aff_obj->get("podAntiAffinity") : nullptr, pod_ns,
+          &anti_host_blob, &anti_zone_blob, &unmodeled);
+      extract_pod_affinity(
+          aff_obj ? aff_obj->get("podAffinity") : nullptr, pod_ns,
+          &paff_blob, &unmodeled);
       extract_node_affinity(
           aff_obj ? aff_obj->get("nodeAffinity") : nullptr,
           &unmodeled, &naff_blob);
@@ -1033,29 +1119,20 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     field(&tmp, meta, "uid");
     b->put_str(PS_UID, tmp);
 
-    tmp.clear();
-    field(&tmp, meta, "namespace");
-    if (tmp.empty()) tmp = "default";
-    i32row(P_NSID) = b->intern_str(TBL_NS, tmp);
-    tmp.clear();
-    field(&tmp, spec, "nodeName");
-    i32row(P_NODEID) = b->intern_str(TBL_NODE, tmp);
-    tmp.clear();
-    blob_kv_into(&tmp, meta ? meta->get("labels") : nullptr);
-    i32row(P_LABELSID) = b->intern_str(TBL_LABELS, tmp);
-    tmp.clear();
-    blob_kv_into(&tmp, spec ? spec->get("nodeSelector") : nullptr);
-    i32row(P_SELID) = b->intern_str(TBL_NODESEL, tmp);
-    tmp.clear();
-    blob_kv_into(&tmp, anti_affinity_labels);
-    i32row(P_AAFFID) = b->intern_str(TBL_AAFF, tmp);
+    i32row(P_NSID) = b->intern_str(TBL_NS, pod_ns);
+    std::string tmp2;
+    field(&tmp2, spec, "nodeName");
+    i32row(P_NODEID) = b->intern_str(TBL_NODE, tmp2);
+    tmp2.clear();
+    blob_kv_into(&tmp2, meta ? meta->get("labels") : nullptr);
+    i32row(P_LABELSID) = b->intern_str(TBL_LABELS, tmp2);
+    tmp2.clear();
+    blob_kv_into(&tmp2, spec ? spec->get("nodeSelector") : nullptr);
+    i32row(P_SELID) = b->intern_str(TBL_NODESEL, tmp2);
+    i32row(P_AAFFID) = b->intern_str(TBL_AAFF, anti_host_blob);
     i32row(P_NAFFID) = b->intern_str(TBL_NAFF, naff_blob);
-    tmp.clear();
-    blob_kv_into(&tmp, pod_affinity_labels);
-    i32row(P_PAFFID) = b->intern_str(TBL_PAFF, tmp);
-    tmp.clear();
-    blob_kv_into(&tmp, zone_anti_labels);
-    i32row(P_ZAFFID) = b->intern_str(TBL_ZAFF, tmp);
+    i32row(P_PAFFID) = b->intern_str(TBL_PAFF, paff_blob);
+    i32row(P_ZAFFID) = b->intern_str(TBL_ZAFF, anti_zone_blob);
     i32row(P_PVCID) = b->intern_str(TBL_PVC, pvc_blob);
     i32row(P_SPREADID) = b->intern_str(TBL_SPREAD, spread_blob);
 

@@ -228,6 +228,56 @@ def test_native_decode_of_anti_affinity_shapes():
         {"podAntiAffinity": {
             "preferredDuringSchedulingIgnoredDuringExecution": [
                 {"weight": 1}]}},
+        # --- round-4 widened shapes ---
+        # hostname + zone two-term pair -> BOTH families modeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"app": "db"}}},
+              {"topologyKey": "topology.kubernetes.io/zone",
+               "labelSelector": {"matchLabels": {"app": "db"}}}]),
+        # single-value In expressions fold into the selector
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {
+                   "matchLabels": {"tier": "be"},
+                   "matchExpressions": [
+                       {"key": "app", "operator": "In",
+                        "values": ["db"]}]}}]),
+        # namespaces naming only the pod's own namespace (default here)
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "namespaces": ["default"],
+               "labelSelector": {"matchLabels": {"app": "db"}}}]),
+        # conflicting folded key: term matches nothing -> dropped exactly
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {
+                   "matchLabels": {"app": "db"},
+                   "matchExpressions": [
+                       {"key": "app", "operator": "In",
+                        "values": ["web"]}]}}]),
+        # two terms of ONE family still unmodeled (one slot per family)
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"a": "1"}}},
+              {"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"b": "2"}}}]),
+        # three terms -> unmodeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"a": "1"}}},
+              {"topologyKey": "topology.kubernetes.io/zone",
+               "labelSelector": {"matchLabels": {"b": "2"}}},
+              {"topologyKey": "topology.kubernetes.io/zone",
+               "labelSelector": {"matchLabels": {"c": "3"}}}]),
+        # multi-value In stays unmodeled
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchExpressions": [
+                   {"key": "app", "operator": "In",
+                    "values": ["db", "cache"]}]}}]),
+        # non-string matchLabels value + key conflict: the TYPE error
+        # must win (unmodeled) on both paths — the native engine
+        # rejects it at collection time, before the conflict check
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {
+                   "matchLabels": {"app": 5},
+                   "matchExpressions": [
+                       {"key": "app", "operator": "In",
+                        "values": ["web"]}]}}]),
     ]
     objs = [
         {"metadata": {"name": f"p{i}", "uid": f"u{i}"},
@@ -240,6 +290,9 @@ def test_native_decode_of_anti_affinity_shapes():
         want = decode_pod(obj)
         got = batch.view(i)
         assert got.anti_affinity_match == want.anti_affinity_match, i
+        assert (
+            got.anti_affinity_zone_match == want.anti_affinity_zone_match
+        ), i
         assert got.unmodeled_constraints == want.unmodeled_constraints, i
     assert batch.view(0).anti_affinity_match == {"app": "db"}
     assert not batch.view(0).unmodeled_constraints
@@ -251,3 +304,59 @@ def test_native_decode_of_anti_affinity_shapes():
     assert batch.view(11).unmodeled_constraints  # ["x"] element
     assert batch.view(12).unmodeled_constraints  # namespaces: "other"
     assert not batch.view(13).unmodeled_constraints  # preferred only
+    # round-4 widened shapes
+    pair = batch.view(14)  # hostname + zone pair: both families
+    assert pair.anti_affinity_match == {"app": "db"}
+    assert pair.anti_affinity_zone_match == {"app": "db"}
+    assert not pair.unmodeled_constraints
+    fold = batch.view(15)  # expressions folded
+    assert fold.anti_affinity_match == {"tier": "be", "app": "db"}
+    assert not fold.unmodeled_constraints
+    ownns = batch.view(16)
+    assert ownns.anti_affinity_match == {"app": "db"}
+    assert not ownns.unmodeled_constraints
+    nothing = batch.view(17)  # conflicting key: dropped, no constraint
+    assert nothing.anti_affinity_match == {}
+    assert not nothing.unmodeled_constraints
+    assert batch.view(18).unmodeled_constraints  # 2x hostname terms
+    assert batch.view(19).unmodeled_constraints  # three terms
+    assert batch.view(20).unmodeled_constraints  # multi-value In
+    assert batch.view(21).unmodeled_constraints  # non-str value + conflict
+
+
+def test_null_namespace_own_ns_list_lockstep():
+    """A pod with namespace null/"" normalizes to "default" on BOTH
+    decode paths, so an own-namespace list naming "default" stays
+    modeled (round-4 review finding)."""
+    import json as _json
+
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+    from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+
+    if not native_ingest.available():
+        pytest.skip("native library unavailable")
+    objs = [
+        {"metadata": {"name": "p", "namespace": ns_val, "uid": "u"},
+         "spec": {"nodeName": "n1", "containers": [], "affinity": {
+             "podAntiAffinity": {
+                 "requiredDuringSchedulingIgnoredDuringExecution": [
+                     {"topologyKey": "kubernetes.io/hostname",
+                      "namespaces": ["default"],
+                      "labelSelector": {"matchLabels": {"app": "db"}}}]}}},
+         "status": {"phase": "Running"}}
+        for ns_val in (None, "", "default", "other")
+    ]
+    batch = native_ingest.parse_pod_list(
+        _json.dumps({"items": objs}).encode()
+    )
+    for i, obj in enumerate(objs):
+        want = decode_pod(obj)
+        got = batch.view(i)
+        assert got.namespace == want.namespace, i
+        assert got.anti_affinity_match == want.anti_affinity_match, i
+        assert got.unmodeled_constraints == want.unmodeled_constraints, i
+    # null/""/default namespaces: modeled; "other": the list names a
+    # foreign namespace -> unmodeled
+    for i in (0, 1, 2):
+        assert batch.view(i).anti_affinity_match == {"app": "db"}, i
+    assert batch.view(3).unmodeled_constraints

@@ -57,6 +57,7 @@ class StubApiserver:
     def __init__(self):
         self.nodes = {}
         self.pods = {}
+        self.pdbs = {}
         self.pvcs = {}
         self.pvs = {}
         self.patches = []
@@ -86,7 +87,7 @@ class StubApiserver:
                 if path == "/api/v1/pods":
                     return self._send({"items": list(stub.pods.values())})
                 if path == "/apis/policy/v1/poddisruptionbudgets":
-                    return self._send({"items": []})
+                    return self._send({"items": list(stub.pdbs.values())})
                 if path == "/api/v1/persistentvolumeclaims":
                     return self._send({"items": list(stub.pvcs.values())})
                 if path == "/api/v1/persistentvolumes":

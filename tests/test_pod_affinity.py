@@ -55,16 +55,51 @@ def test_decode_modeled_pod_affinity():
     assert not pod.unmodeled_constraints
 
 
+def test_decode_widened_selector_shapes_modeled():
+    """Round 4: single-value In matchExpressions are exactly equivalent
+    to matchLabels pairs and fold in; an explicit namespaces list naming
+    only the pod's OWN namespace keeps own-namespace semantics."""
+    # pure matchExpressions selector
+    pod = decode_pod(_pod_obj(_paff([{
+        "topologyKey": "kubernetes.io/hostname",
+        "labelSelector": {"matchExpressions": [
+            {"key": "app", "operator": "In", "values": ["db"]}]}}])))
+    assert pod.pod_affinity_match == {"app": "db"}
+    assert not pod.unmodeled_constraints
+    # mixed matchLabels + expressions
+    pod = decode_pod(_pod_obj(_paff([{
+        "topologyKey": "kubernetes.io/hostname",
+        "labelSelector": {
+            "matchLabels": {"tier": "be"},
+            "matchExpressions": [
+                {"key": "app", "operator": "In", "values": ["db"]}]}}])))
+    assert pod.pod_affinity_match == {"tier": "be", "app": "db"}
+    assert not pod.unmodeled_constraints
+    # own-namespace namespaces list (the pod's ns is ns1 in _pod_obj)
+    pod = decode_pod(_pod_obj(_paff([{
+        "topologyKey": "kubernetes.io/hostname",
+        "namespaces": ["ns1"],
+        "labelSelector": {"matchLabels": {"app": "db"}}}])))
+    assert pod.pod_affinity_match == {"app": "db"}
+    assert not pod.unmodeled_constraints
+
+
 def test_decode_unmodeled_pod_affinity_shapes():
     for term in (
         # zone topology
         [{"topologyKey": "topology.kubernetes.io/zone",
           "labelSelector": {"matchLabels": {"app": "db"}}}],
-        # matchExpressions selector
+        # multi-value In / non-In operators stay unmodeled
         [{"topologyKey": "kubernetes.io/hostname",
           "labelSelector": {"matchExpressions": [
-              {"key": "app", "operator": "In", "values": ["db"]}]}}],
-        # two terms
+              {"key": "app", "operator": "In", "values": ["db", "cache"]}]}}],
+        [{"topologyKey": "kubernetes.io/hostname",
+          "labelSelector": {"matchExpressions": [
+              {"key": "app", "operator": "Exists"}]}}],
+        [{"topologyKey": "kubernetes.io/hostname",
+          "labelSelector": {"matchExpressions": [
+              {"key": "app", "operator": "NotIn", "values": ["db"]}]}}],
+        # two terms (positive affinity models exactly one)
         [{"topologyKey": "kubernetes.io/hostname",
           "labelSelector": {"matchLabels": {"a": "1"}}},
          {"topologyKey": "kubernetes.io/hostname",
@@ -73,6 +108,16 @@ def test_decode_unmodeled_pod_affinity_shapes():
         [{"topologyKey": "kubernetes.io/hostname",
           "namespaces": ["other"],
           "labelSelector": {"matchLabels": {"app": "db"}}}],
+        # namespaceSelector, even {}
+        [{"topologyKey": "kubernetes.io/hostname",
+          "namespaceSelector": {},
+          "labelSelector": {"matchLabels": {"app": "db"}}}],
+        # conflicting folded key: selector can never be satisfied
+        [{"topologyKey": "kubernetes.io/hostname",
+          "labelSelector": {
+              "matchLabels": {"app": "db"},
+              "matchExpressions": [
+                  {"key": "app", "operator": "In", "values": ["web"]}]}}],
     ):
         pod = decode_pod(_pod_obj(_paff(term)))
         assert pod.pod_affinity_match == {}

@@ -73,6 +73,24 @@ def test_replay_small():
     assert stats["ticks"] > 0
     assert stats["interruptions"] + stats["events"] > 0
     assert stats["replan_ms_p50"] >= 0.0
+    assert stats["stranded_by_drain"] == 0
+
+
+def test_replay_constrained_never_strands():
+    """Config-5 churn with the full predicate surface (taints, affinity
+    groups, PDBs, sparse hard spread): every drain the planner approves
+    must land its pods — a drain-evicted pod pending at tick end would
+    be a stranding, the invariant the whole conservatism design exists
+    to uphold. The conservatism gauges ride along in the stats."""
+    stats = run_replay(
+        ReschedulerConfig(solver="numpy"), n_events=60, seed=0,
+        constrained=True,
+    )
+    assert stats["ticks"] > 0
+    assert stats["stranded_by_drain"] == 0
+    assert stats["drained_nodes"] > 0, "constrained replay never drained"
+    assert "unplaceable_pods_gauge" in stats
+    assert "blocked_unmodeled_gauge" in stats
 
 
 def test_generate_replay_events_ordered():

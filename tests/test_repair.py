@@ -151,6 +151,21 @@ def test_exact_ejection_respects_remaining_group_mate():
     np.testing.assert_array_equal(np.asarray(got.feasible), res.feasible)
 
 
+def test_repair_parity_at_config2_scale():
+    """Config-2-scale repair parity pin (VERDICT r3 weak #6): now that
+    repair participates in quality-critical paths, the device/oracle
+    lockstep is pinned at real columnar-packed scale (C=256 lanes), not
+    just randomized small shapes."""
+    from k8s_spot_rescheduler_tpu.bench.quality import pack_quality
+    from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS
+
+    packed = pack_quality(CONFIGS[2], 0)
+    want = plan_repair_oracle(packed)
+    got = plan_repair_jit(packed)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
 def test_repair_parity_on_affinity_quality_pack():
     """Device/oracle bit parity over the round-4 affinity quality config
     (real packed shapes with group bits, selectors, taints)."""

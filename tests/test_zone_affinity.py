@@ -349,6 +349,44 @@ def test_columnar_parity_tracks_zone_match_departure():
         )
 
 
+def test_two_term_pair_enforces_both_families():
+    """Round-4 widened decode: one pod carrying the hostname+zone
+    anti-affinity PAIR (two required terms) enforces both — it refuses
+    the zone hosting a match AND any node hosting one."""
+    pod = decode_pod({
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {"nodeName": "od-1", "containers": [], "affinity": {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"app": "db"}}},
+                    {"topologyKey": ZONE_LABEL,
+                     "labelSelector": {"matchLabels": {"app": "db"}}},
+                ]}}},
+        "status": {"phase": "Running"},
+    })
+    assert pod.anti_affinity_match == {"app": "db"}
+    assert pod.anti_affinity_zone_match == {"app": "db"}
+    assert not pod.unmodeled_constraints
+
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-a1", _zone_labels(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zone_labels(SPOT_LABELS, "b")))
+    fc.add_node(make_node("spot-nz", SPOT_LABELS))  # zoneless, hosts match
+    fc.add_pod(make_pod("db-a", 100, "spot-a1", labels={"app": "db"}))
+    fc.add_pod(make_pod("db-nz", 100, "spot-nz", labels={"app": "db"}))
+    fc.add_pod(make_pod(
+        "web", 300, "od-1",
+        anti_affinity_match={"app": "db"},
+        anti_affinity_zone_match={"app": "db"},
+    ))
+    # zone a refused by the zone term; spot-nz refused by the hostname
+    # term (hosts a match); only spot-b1 admits
+    assert _placement(fc, "web") == "spot-b1"
+    _parity(fc)
+
+
 # --- end to end ------------------------------------------------------------
 
 def test_drain_respects_zone_spread():
