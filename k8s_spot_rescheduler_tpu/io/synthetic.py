@@ -280,21 +280,30 @@ class AffinitySpec:
       impossible under monotone affinity accumulation — frees the node
       for I. The affinity-aware ILP drains the pool; so does repair
       with exact ejection (solver/repair.py round 4).
-    - **interlock** — the depth-1 boundary: the candidate holds A, B, C
-      (sizes a > b > c). Greedy lands A on u1 (exactly a slack) and B
-      on u2 (taint only A/B tolerate; b+ε slack, ε ≥ a-b); C fits only
-      u1 (z's taint only B tolerates). The only unlocker is A, and A
-      can re-place only on u2 — which needs B ejected first, a chained
-      depth-2 move no single eject-reinsert round can express. The ILP
-      (simultaneous) drains it: C→u1, A→u2, B→z. Shipped < 1.000 here
-      by construction — the honest boundary row.
+    - **interlock** — the depth-1 boundary, CLOSED in round 4 by the
+      depth-2 chain: the candidate holds A, B, C (sizes a > b > c).
+      Greedy lands A on u1 (exactly a slack) and B on u2 (taint only
+      A/B tolerate; b+ε slack, ε ≥ a-b); C fits only u1 (z's taint only
+      B tolerates). The only unlocker is A, and A can re-place only on
+      u2 — which needs B ejected first: the chained move
+      (C→u1, A→u2, B→z) that depth-1 eject-reinsert cannot express and
+      the round-4 depth-2 chain executes. Now part of the headline
+      quality metric (shipped 1.000).
+    - **chain3** — the NEW published boundary: a three-link chain
+      (c→u1, m1→u2, m2→u3, m3→z) with per-level taints so each mover
+      statically fits only its current and next node. The only unlocker
+      (m1) can re-place only on u2, whose occupant m2 can re-place only
+      on u3 — TWO chained ejections deep, beyond the depth-2 search.
+      The ILP (simultaneous) drains it; shipped < 1.000 by
+      construction.
     - **easy** — ample slack; any solver proves the drain.
     """
 
     name: str
     n_groups: int = 12
     aswap_frac: float = 0.5
-    interlock_frac: float = 0.0  # remainder of groups is easy
+    interlock_frac: float = 0.0
+    chain3_frac: float = 0.0  # remainder of groups is easy
     node_cpu: int = 4000
     resources: Tuple[str, ...] = (CPU, MEMORY)
 
@@ -311,16 +320,21 @@ QUALITY_CONFIGS = {
     # anti-affinity contention: drains only an affinity-driven
     # relocation recovers (VERDICT r3 #3)
     "affinity": AffinitySpec("quality-affinity-12g"),
+    # two-pod interlocks: depth-1's old boundary, closed by the round-4
+    # depth-2 chain — now a headline row
+    "interlock": AffinitySpec("quality-interlock-8g", n_groups=8,
+                              aswap_frac=0.0, interlock_frac=0.25),
 }
 
 # Published-boundary configs: NOT part of the headline worst-ratio metric
 # (the boundary is a documented limitation, not a regression) — run via
 # bench.py --quality-boundary and pinned by tests/test_quality_adversarial.
 BOUNDARY_CONFIGS = {
-    # depth-1 eject-reinsert cannot express the chained two-pod move;
-    # shipped < 1.000 here BY CONSTRUCTION (docs/RESULTS.md)
-    "interlock": AffinitySpec("quality-interlock-8g", n_groups=8,
-                              aswap_frac=0.0, interlock_frac=0.25),
+    # three-link chains need TWO chained ejections; the depth-2 search
+    # cannot express them — shipped < 1.000 BY CONSTRUCTION
+    # (docs/RESULTS.md)
+    "chain3": AffinitySpec("quality-chain3-8g", n_groups=8,
+                           aswap_frac=0.0, chain3_frac=0.25),
 }
 
 
@@ -412,6 +426,9 @@ def generate_contended_cluster(
 U2_TAINT = Taint("quality.test/reserved-u2", "1", "NoSchedule")
 U2_TOLERATION = Toleration("quality.test/reserved-u2", "1", "Equal",
                            "NoSchedule")
+U3_TAINT = Taint("quality.test/reserved-u3", "1", "NoSchedule")
+U3_TOLERATION = Toleration("quality.test/reserved-u3", "1", "Equal",
+                           "NoSchedule")
 
 
 def generate_affinity_cluster(
@@ -448,7 +465,8 @@ def generate_affinity_cluster(
         ))
 
     kinds = (["aswap"] * round(spec.n_groups * spec.aswap_frac)
-             + ["interlock"] * round(spec.n_groups * spec.interlock_frac))
+             + ["interlock"] * round(spec.n_groups * spec.interlock_frac)
+             + ["chain3"] * round(spec.n_groups * spec.chain3_frac))
     kinds += ["easy"] * (spec.n_groups - len(kinds))
     rng.shuffle(kinds)
 
@@ -501,6 +519,44 @@ def generate_affinity_cluster(
             add_pod(f"ilk-b-{g}", f"od-{g}", b, app=g, selector=pool,
                     tolerations=[U2_TOLERATION, SPOT_TOLERATION])
             add_pod(f"ilk-c-{g}", f"od-{g}", c, app=g, selector=pool)
+        elif kind == "chain3":
+            # three-link chain: c->u1, m1->u2 (eject m2), m2->u3 (eject
+            # m3), m3->z. Per-level taints pin each mover to its current
+            # and next node; slack ordering pins greedy's placements
+            # (u1 fullest, then u2, u3, z). See AffinitySpec.
+            m3 = int(rng.integers(280, 340))
+            d3 = int(rng.integers(15, 25))
+            m2 = m3 + d3
+            d2 = int(rng.integers(15, 25))
+            m1 = m2 + d2
+            e2 = d2 + int(rng.integers(3, 10))
+            e3 = d3 + e2 + int(rng.integers(3, 10))
+            c = int(rng.integers(150, 250))
+            slack_u1 = m1 + int(rng.integers(0, 5))
+            slack_z = m3 + e3 + int(rng.integers(10, 60))
+            add_node(f"spot-u1-{g}", spot_labels)
+            add_node(f"spot-u2-{g}", spot_labels, [U2_TAINT])
+            add_node(f"spot-u3-{g}", spot_labels, [U3_TAINT])
+            add_node(f"spot-z-{g}", spot_labels, [SPOT_TAINT])
+            add_pod(f"res-u1-{g}", f"spot-u1-{g}",
+                    spec.node_cpu - slack_u1, app=g,
+                    labels={"bg": f"bg-{g}"})
+            add_pod(f"res-u2-{g}", f"spot-u2-{g}",
+                    spec.node_cpu - (m2 + e2), app=g,
+                    labels={"bg": f"bg-{g}"}, tolerations=[U2_TOLERATION])
+            add_pod(f"res-u3-{g}", f"spot-u3-{g}",
+                    spec.node_cpu - (m3 + e3), app=g,
+                    labels={"bg": f"bg-{g}"}, tolerations=[U3_TOLERATION])
+            add_pod(f"res-z-{g}", f"spot-z-{g}",
+                    spec.node_cpu - slack_z, app=g,
+                    labels={"bg": f"bg-{g}"}, tolerations=[SPOT_TOLERATION])
+            add_pod(f"ch-m1-{g}", f"od-{g}", m1, app=g, selector=pool,
+                    tolerations=[U2_TOLERATION])
+            add_pod(f"ch-m2-{g}", f"od-{g}", m2, app=g, selector=pool,
+                    tolerations=[U2_TOLERATION, U3_TOLERATION])
+            add_pod(f"ch-m3-{g}", f"od-{g}", m3, app=g, selector=pool,
+                    tolerations=[U3_TOLERATION, SPOT_TOLERATION])
+            add_pod(f"ch-c-{g}", f"od-{g}", c, app=g, selector=pool)
         else:  # easy
             sizes = rng.integers(150, 320, 2)
             slack = int(sizes.sum() + rng.integers(120, 260))

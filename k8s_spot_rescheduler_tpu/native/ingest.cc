@@ -596,7 +596,9 @@ void extract_anti_affinity(const Val* block, std::string_view ns,
   for (const Val* term : req->arr) {
     if (!term || term->kind != Val::Obj) {
       *unmodeled = true;
-      return;
+      host_blob->clear();  // an earlier valid term must not leak: its
+      zone_blob->clear();  // symmetric presence would over-constrain
+      return;              // OTHER pods on this ingest path only
     }
     const Val* topo = term->get("topologyKey");
     bool zone;
@@ -608,6 +610,8 @@ void extract_anti_affinity(const Val* block, std::string_view ns,
       zone = true;
     } else {
       *unmodeled = true;
+      host_blob->clear();
+      zone_blob->clear();
       return;
     }
     std::string blob;

@@ -16,7 +16,12 @@ those lanes:
    searches the already-placed pods ``q`` whose ejection would let
    ``p`` take their node, rotates deterministically through those
    unlockers across rounds, and executes the relocation
-   ``q → elsewhere, p → q's node`` when ``q`` itself re-places.
+   ``q → elsewhere, p → q's node`` when ``q`` itself re-places. When
+   ``q`` CANNOT re-place directly, a depth-2 CHAIN (round 4) relocates
+   it onto a third pod ``r``'s node and re-places ``r`` elsewhere
+   (``p → s_q, q → s_r, r → s3``) — closing the two-pod interlock that
+   defeated depth-1 (the published boundary moves to three-link
+   chains, docs/RESULTS.md).
 3. **Validation** — the final assignment is re-proven from scratch
    (solver/validate.py) on device; only fully-placed, predicate-valid
    lanes report feasible. The search can therefore never approve an
@@ -158,24 +163,109 @@ def _repair_round(static, state: _RepairCarry, round_idx):
     ) | spot_aff_static[sq_star]  # [C, A]
     aff_ok_p = jnp.all((aff_p & aff_ej) == 0, axis=1)  # [C]
 
-    do = has_gap & any_q & can_move & aff_ok_p  # [C]
+    do_direct = has_gap & any_q & can_move & aff_ok_p  # [C]
 
+    # ---- depth-2 chain (round 4): when q cannot re-place DIRECTLY,
+    # relocate it onto a third pod r's node and re-place r elsewhere
+    # (p -> s_q, q -> s_r, r -> s3) — the two-pod interlock that
+    # defeated depth-1 (docs/RESULTS.md boundary). r is elected by the
+    # same rotation; its own re-placement and both exact affinity gates
+    # are verified post-election, with rotation retrying on failure.
+    word_ok_q = jnp.all(
+        (spot_taints_t & ~tol_q[:, :, None]) == 0, axis=1
+    )  # [C, S]
+    static_q = word_ok_q & spot_ok
+    static_q_at = jnp.take_along_axis(static_q, s_q, axis=1)  # [C, K]
+    res_ok_r = jnp.all(
+        free_at_q + req_t - req_q[:, :, None] >= 0, axis=1
+    )  # [C, K] — q fits r's node once r is ejected
+    eligible_r = (
+        placed & (s_q != sq_star[:, None]) & static_q_at & res_ok_r
+    )  # [C, K]
+    n_r = eligible_r.sum(axis=-1)
+    rank_r = jnp.cumsum(eligible_r, axis=-1) - 1
+    want_r = jnp.where(n_r > 0, round_idx % jnp.maximum(n_r, 1), -1)
+    is_r = eligible_r & (rank_r == want_r[:, None])
+    r = jnp.argmax(is_r, axis=-1)  # [C]
+    any_r = jnp.any(is_r, axis=-1)
+    sr_star = jnp.take_along_axis(s_q, r[:, None], axis=1)[:, 0]  # [C]
+    req_r = jnp.take_along_axis(slot_req, r[:, None, None], axis=1)[:, 0]
+    tol_r = jnp.take_along_axis(slot_tol, r[:, None, None], axis=1)[:, 0]
+    aff_r = jnp.take_along_axis(slot_aff, r[:, None, None], axis=1)[:, 0]
+
+    fits_r = fit_mask_t(
+        jnp,
+        free_t=state.free,
+        count=state.count,
+        max_pods=spot_max_pods,
+        node_taints_t=spot_taints_t,
+        node_ok=spot_ok,
+        node_aff_t=state.aff,
+        req=req_r,
+        tol=tol_r,
+        aff=aff_r,
+    )  # [C, S]
+    fits_r &= (jnp.arange(S)[None, :] != sr_star[:, None]) & (
+        jnp.arange(S)[None, :] != sq_star[:, None]
+    )
+    s3 = jnp.argmax(fits_r, axis=-1)  # [C]
+    r_can_move = jnp.any(fits_r, axis=-1)
+
+    # exact affinity of r's node after r leaves, for q's arrival
+    others_r = placed & (state.assign == sr_star[:, None]) & (
+        ks != r[:, None]
+    )
+    contrib_r = jnp.where(
+        others_r[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
+    )
+    aff_ej_r = jax.lax.reduce(
+        contrib_r, np.uint32(0), jax.lax.bitwise_or, (2,)
+    ) | spot_aff_static[sr_star]  # [C, A]
+    aff_ok_q = jnp.all((aff_q & aff_ej_r) == 0, axis=1)  # [C]
+
+    do_chain = (
+        has_gap & any_q & ~can_move & aff_ok_p
+        & any_r & r_can_move & aff_ok_q
+    )
+    do = do_direct | do_chain  # [C]
+
+    # q's destination: s2 (direct) or r's node (chain); the +1 pod count
+    # lands on s2 (direct) or s3 (chain) — every other count nets zero
+    q_dest = jnp.where(do_chain, sr_star, s2)
+    inc_node = jnp.where(do_chain, s3, s2)
     onehot_sq = jnp.arange(S)[None, :] == sq_star[:, None]  # [C, S]
-    onehot_s2 = jnp.arange(S)[None, :] == s2[:, None]
+    onehot_qd = jnp.arange(S)[None, :] == q_dest[:, None]
+    onehot_s3 = (jnp.arange(S)[None, :] == s3[:, None]) & do_chain[:, None]
+    onehot_inc = jnp.arange(S)[None, :] == inc_node[:, None]
     delta = (
         onehot_sq[:, None, :] * (req_q - req_p)[:, :, None]
-        - onehot_s2[:, None, :] * req_q[:, :, None]
+        - onehot_qd[:, None, :] * req_q[:, :, None]
+        + onehot_qd[:, None, :] * do_chain[:, None, None] * req_r[:, :, None]
+        - onehot_s3[:, None, :] * req_r[:, :, None]
     )
     free = jnp.where(do[:, None, None], state.free + delta, state.free)
     count = jnp.where(
-        do[:, None], state.count + onehot_s2.astype(state.count.dtype),
+        do[:, None], state.count + onehot_inc.astype(state.count.dtype),
         state.count,
     )
-    # s_q's column is REPLACED by the exact recompute (plus p's arrival);
-    # s2 (≠ s_q, fits_q excludes it) accumulates q's bits
+    # s_q's column is REPLACED by the exact recompute (plus p's
+    # arrival); q's destination is replaced on a chain (aff_ej_r | q's
+    # bits) or OR'd on a direct move; s3 accumulates r's bits
+    qd_col = jnp.where(
+        do_chain[:, None], aff_ej_r | aff_q, jnp.uint32(0)
+    )  # chain: exact replacement value for s_r
     aff_after = jnp.where(
         onehot_sq[:, None, :], (aff_ej | aff_p)[:, :, None], state.aff
-    ) | jnp.where(onehot_s2[:, None, :], aff_q[:, :, None], jnp.uint32(0))
+    )
+    aff_after = jnp.where(
+        (onehot_qd & do_chain[:, None])[:, None, :],
+        qd_col[:, :, None],
+        aff_after,
+    ) | jnp.where(
+        (onehot_qd & do_direct[:, None])[:, None, :],
+        aff_q[:, :, None],
+        jnp.uint32(0),
+    ) | jnp.where(onehot_s3[:, None, :], aff_r[:, :, None], jnp.uint32(0))
     aff = jnp.where(do[:, None, None], aff_after, state.aff)
     assign = jnp.where(
         do[:, None],
@@ -183,8 +273,12 @@ def _repair_round(static, state: _RepairCarry, round_idx):
             ks == p[:, None],
             sq_star[:, None].astype(state.assign.dtype),
             jnp.where(
-                ks == q[:, None], s2[:, None].astype(state.assign.dtype),
-                state.assign,
+                ks == q[:, None], q_dest[:, None].astype(state.assign.dtype),
+                jnp.where(
+                    (ks == r[:, None]) & do_chain[:, None],
+                    s3[:, None].astype(state.assign.dtype),
+                    state.assign,
+                ),
             ),
         ),
         state.assign,
@@ -328,6 +422,9 @@ def plan_repair_oracle(
                     aff_ej |= packed.slot_aff[c, k]
             if np.any(aff_p & aff_ej):
                 continue  # rotation tries a different unlocker next round
+            req_q = packed.slot_req[c, q]
+            tol_q = packed.slot_tol[c, q]
+            aff_q = packed.slot_aff[c, q]
             fits_q = fit_mask(
                 np,
                 free=frees[c],
@@ -336,21 +433,77 @@ def plan_repair_oracle(
                 node_taints=packed.spot_taints,
                 node_ok=packed.spot_ok,
                 node_aff=affs[c],
-                req=packed.slot_req[c, q],
-                tol=packed.slot_tol[c, q],
-                aff=packed.slot_aff[c, q],
+                req=req_q,
+                tol=tol_q,
+                aff=aff_q,
             )
             fits_q[sq] = False
-            if not fits_q.any():
+            if fits_q.any():
+                # depth-1 direct move: p -> s_q, q -> s2
+                s2 = int(np.argmax(fits_q))
+                assign[c, p] = sq
+                assign[c, q] = s2
+                frees[c, sq] += req_q - req_p
+                frees[c, s2] -= req_q
+                counts[c, s2] += 1
+                affs[c, s2] |= aff_q
+                affs[c, sq] = aff_ej | aff_p  # exact replacement, not OR
                 continue
-            s2 = int(np.argmax(fits_q))
+            # depth-2 chain (device lockstep): q cannot re-place
+            # directly; move it onto a third pod r's node and re-place
+            # r elsewhere (p -> s_q, q -> s_r, r -> s3)
+            static_q = (
+                np.all((packed.spot_taints & ~tol_q) == 0, axis=-1)
+                & packed.spot_ok
+            )
+            eligible = np.zeros(K, bool)
+            for k in range(K):
+                s = assign[c, k]
+                if s < 0 or s == sq:
+                    continue
+                if not static_q[s]:
+                    continue
+                if not np.all(frees[c, s] + packed.slot_req[c, k] - req_q >= 0):
+                    continue
+                eligible[k] = True
+            n_r = int(eligible.sum())
+            if not n_r:
+                continue
+            r = int(np.flatnonzero(eligible)[rnd % n_r])
+            sr = int(assign[c, r])
+            fits_r = fit_mask(
+                np,
+                free=frees[c],
+                count=counts[c],
+                max_pods=packed.spot_max_pods,
+                node_taints=packed.spot_taints,
+                node_ok=packed.spot_ok,
+                node_aff=affs[c],
+                req=packed.slot_req[c, r],
+                tol=packed.slot_tol[c, r],
+                aff=packed.slot_aff[c, r],
+            )
+            fits_r[sr] = False
+            fits_r[sq] = False
+            if not fits_r.any():
+                continue  # rotation elects a different r next round
+            s3 = int(np.argmax(fits_r))
+            aff_ej_r = np.asarray(packed.spot_aff[sr]).copy()
+            for k in range(K):
+                if k != r and assign[c, k] == sr:
+                    aff_ej_r |= packed.slot_aff[c, k]
+            if np.any(aff_q & aff_ej_r):
+                continue
             assign[c, p] = sq
-            assign[c, q] = s2
-            frees[c, sq] += packed.slot_req[c, q] - req_p
-            frees[c, s2] -= packed.slot_req[c, q]
-            counts[c, s2] += 1
-            affs[c, s2] |= packed.slot_aff[c, q]
-            affs[c, sq] = aff_ej | aff_p  # exact replacement, not OR
+            assign[c, q] = sr
+            assign[c, r] = s3
+            frees[c, sq] += req_q - req_p
+            frees[c, sr] += packed.slot_req[c, r] - req_q
+            frees[c, s3] -= packed.slot_req[c, r]
+            counts[c, s3] += 1
+            affs[c, sq] = aff_ej | aff_p
+            affs[c, sr] = aff_ej_r | aff_q
+            affs[c, s3] |= packed.slot_aff[c, r]
 
     feasible = np.asarray(validate_assignment(np, packed, assign))
     assignment = np.where(feasible[:, None], assign, -1).astype(np.int32)

@@ -278,6 +278,17 @@ def test_native_decode_of_anti_affinity_shapes():
                    "matchExpressions": [
                        {"key": "app", "operator": "In",
                         "values": ["web"]}]}}]),
+        # a VALID first term followed by an unmodeled one: the pod is
+        # unmodeled AND the valid term's selector must not leak (its
+        # symmetric presence would over-constrain other pods on one
+        # ingest path only — round-4 review finding)
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"app": "x"}}},
+              {"topologyKey": "topology.kubernetes.io/rack",
+               "labelSelector": {"matchLabels": {"app": "x"}}}]),
+        anti([{"topologyKey": "kubernetes.io/hostname",
+               "labelSelector": {"matchLabels": {"app": "x"}}},
+              None]),
     ]
     objs = [
         {"metadata": {"name": f"p{i}", "uid": f"u{i}"},
@@ -322,6 +333,9 @@ def test_native_decode_of_anti_affinity_shapes():
     assert batch.view(19).unmodeled_constraints  # three terms
     assert batch.view(20).unmodeled_constraints  # multi-value In
     assert batch.view(21).unmodeled_constraints  # non-str value + conflict
+    for i in (22, 23):  # valid term + unmodeled term: nothing leaks
+        assert batch.view(i).unmodeled_constraints, i
+        assert batch.view(i).anti_affinity_match == {}, i
 
 
 def test_null_namespace_own_ns_list_lockstep():

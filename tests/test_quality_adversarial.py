@@ -87,9 +87,9 @@ def test_lp_bound_scales_to_config2():
 
 
 def test_shipped_configs_registered():
-    assert {"balanced", "contended", "contended-zipf", "affinity"} <= set(
-        QUALITY_CONFIGS
-    )
+    assert {
+        "balanced", "contended", "contended-zipf", "affinity", "interlock"
+    } <= set(QUALITY_CONFIGS)
 
 
 # --- anti-affinity contention (round 4, VERDICT r3 #3) ---------------------
@@ -97,6 +97,8 @@ def test_shipped_configs_registered():
 AFF_SMALL = AffinitySpec("quality-affinity-test", n_groups=6)
 ILK_SMALL = AffinitySpec("quality-interlock-test", n_groups=6,
                          aswap_frac=0.0, interlock_frac=1 / 3)
+CH3_SMALL = AffinitySpec("quality-chain3-test", n_groups=6,
+                         aswap_frac=0.0, chain3_frac=1 / 3)
 
 
 @pytest.mark.parametrize("seed", [0, 1])
@@ -115,26 +117,41 @@ def test_affinity_discriminates_and_shipped_recovers(seed):
 
 
 @pytest.mark.parametrize("seed", [0, 1])
-def test_interlock_is_repairs_published_boundary(seed):
-    """The two-pod interlock: the only unlocker's re-placement itself
-    needs a second eject — a chained depth-2 move depth-1 eject-reinsert
-    cannot express at ANY round count. The ILP (simultaneous) drains it;
-    shipped < 1.000 here by construction. Published in docs/RESULTS.md;
-    closing it would need chained/pair moves, measured against the
-    latency budget first."""
+def test_interlock_closed_by_depth2_chain(seed):
+    """The two-pod interlock — depth-1's published boundary in early
+    round 4 (shipped 0.750) — is CLOSED by the depth-2 chained
+    relocation (p→s_q, q→s_r, r→s3): shipped now matches the ILP, and
+    the config graduated into the headline quality metric."""
     packed = pack_quality(ILK_SMALL, seed)
     ilp = ilp_max_drains(packed)
     assert ilp and ilp > 0
+    ffd = _exhaust(ILK_SMALL, seed, fallback_best_fit=False, repair_rounds=0)
     shipped = _exhaust(ILK_SMALL, seed)
-    more_rounds = _exhaust(ILK_SMALL, seed, repair_rounds=64)
-    assert shipped < ilp, "interlock no longer defeats depth-1 repair"
-    assert more_rounds == shipped, "extra rounds cannot close a depth-2 gap"
-    # every non-interlock pool still drains
-    n_interlock = sum(
-        1 for p in generate_quality_cluster(ILK_SMALL, seed).pods.values()
-        if p.name.startswith("ilk-c-")
+    assert ffd < ilp, "config no longer stresses greedy"
+    assert shipped == ilp, "depth-2 chain regressed on the interlock"
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_chain3_is_repairs_published_boundary(seed):
+    """Three-link chains: the only unlocker's re-placement needs TWO
+    chained ejections — beyond the depth-2 search at ANY round count.
+    The ILP (simultaneous) drains them; shipped < 1.000 by
+    construction. Published in docs/RESULTS.md; each added depth
+    multiplies the per-round election cost, and no organic config has
+    produced one — so the boundary is published, not chased."""
+    packed = pack_quality(CH3_SMALL, seed)
+    ilp = ilp_max_drains(packed)
+    assert ilp and ilp > 0
+    shipped = _exhaust(CH3_SMALL, seed)
+    more_rounds = _exhaust(CH3_SMALL, seed, repair_rounds=64)
+    assert shipped < ilp, "chain3 no longer defeats depth-2 repair"
+    assert more_rounds == shipped, "extra rounds cannot close a depth-3 gap"
+    # every non-chain pool still drains
+    n_chain = sum(
+        1 for p in generate_quality_cluster(CH3_SMALL, seed).pods.values()
+        if p.name.startswith("ch-c-")
     )
-    assert shipped == ilp - n_interlock
+    assert shipped == ilp - n_chain
 
 
 def test_ilp_pairwise_affinity_constraint():
