@@ -266,6 +266,23 @@ class FakeCluster:
             here, pod.namespace, tuple(pod.pod_affinity_match.items())
         ):
             return False
+        # zone-topology positive pod-affinity: the node's ZONE must
+        # already host a match (masks.ZonePodAffinityBit semantics)
+        if pod.pod_affinity_zone_match:
+            zone_val = node.labels.get(ZONE_LABEL)
+            if zone_val is None:
+                return False
+            zone_pods = [
+                q
+                for n2 in self.nodes.values()
+                if n2.labels.get(ZONE_LABEL) == zone_val
+                for q in self.list_pods_on_node(n2.name)
+            ]
+            if not hosts_affinity_match(
+                zone_pods, pod.namespace,
+                tuple(pod.pod_affinity_zone_match.items()),
+            ):
+                return False
         # zone-topology anti-affinity, both directions, across the whole
         # zone (nodes without the zone label never conflict)
         zone = node.labels.get(ZONE_LABEL)

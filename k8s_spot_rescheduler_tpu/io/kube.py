@@ -98,7 +98,7 @@ def decode_pod(obj: dict) -> PodSpec:
     anti_affinity_match, anti_zone_match, anti_unmodeled = decode_anti_affinity(
         affinity.get("podAntiAffinity") or {}, pod_ns
     )
-    pod_affinity_match, paff_unmodeled = decode_pod_affinity(
+    pod_affinity_match, pod_affinity_zone, paff_unmodeled = decode_pod_affinity(
         affinity.get("podAffinity") or {}, pod_ns
     )
     required_affinity = naff_unmodeled or anti_unmodeled or paff_unmodeled
@@ -146,6 +146,7 @@ def decode_pod(obj: dict) -> PodSpec:
         anti_affinity_match=anti_affinity_match,
         anti_affinity_zone_match=anti_zone_match,
         pod_affinity_match=pod_affinity_match,
+        pod_affinity_zone_match=pod_affinity_zone,
         node_affinity=node_affinity,
         spread_constraints=spread_constraints,
         pvc_names=tuple(pvc_names),
@@ -376,25 +377,31 @@ def decode_anti_affinity(anti: dict, namespace: str = "default") -> tuple:
 
 
 def decode_pod_affinity(paff: dict, namespace: str = "default") -> tuple:
-    """(matchLabels, unmodeled) for a required POSITIVE podAffinity
-    object — ONE hostname-topology term with the widened selector; the
-    planner admits the pod only on nodes already hosting a match
-    (predicates/masks.PodAffinityBit). A never-matching selector can
-    never be satisfied: unmodeled (= unplaceable, which is exact)."""
+    """(hostname matchLabels, zone matchLabels, unmodeled) for a
+    required POSITIVE podAffinity object — ONE term, hostname OR zone
+    topology, with the widened selector; at most one of the selectors
+    is non-empty. Hostname: the pod may only join a node already
+    hosting a match (masks.PodAffinityBit); zone (round 4): a ZONE
+    already hosting a match (masks.ZonePodAffinityBit). A
+    never-matching selector can never be satisfied: unmodeled
+    (= unplaceable, which is exact)."""
     req = paff.get("requiredDuringSchedulingIgnoredDuringExecution")
     if not req:
-        return {}, False
+        return {}, {}, False
     if not isinstance(req, list) or len(req) != 1:
-        return {}, True
+        return {}, {}, True
     term = req[0]
     if not isinstance(term, dict):
-        return {}, True
-    if term.get("topologyKey") != "kubernetes.io/hostname":
-        return {}, True
+        return {}, {}, True
+    topo = term.get("topologyKey")
+    if topo not in ("kubernetes.io/hostname", ZONE_TOPOLOGY_KEY):
+        return {}, {}, True
     sel, unmodeled = _decode_term_selector(term, namespace)
     if unmodeled or sel is _MATCHES_NOTHING:
-        return {}, True
-    return sel, False
+        return {}, {}, True
+    if topo == ZONE_TOPOLOGY_KEY:
+        return {}, sel, False
+    return sel, {}, False
 
 
 # Fields whose presence changes PodTopologySpread counting semantics in

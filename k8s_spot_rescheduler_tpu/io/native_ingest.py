@@ -50,11 +50,12 @@ F_PVC, F_REQAFF = 32, 64
 # pod column indices
 P_CPU, P_MEM, P_EPH = 0, 1, 2
 (P_PRIO, P_NODEID, P_NSID, P_TOLID, P_LABELSID, P_SELID,
- P_AAFFID, P_NAFFID, P_PAFFID, P_ZAFFID, P_PVCID, P_SPREADID) = range(12)
+ P_AAFFID, P_NAFFID, P_PAFFID, P_ZAFFID, P_PVCID, P_SPREADID,
+ P_PZAFFID) = range(13)
 PS_NAME, PS_UID = range(2)
 # interned-table families
 (TBL_NODE, TBL_NS, TBL_TOLS, TBL_LABELS, TBL_NODESEL, TBL_AAFF,
- TBL_NAFF, TBL_PAFF, TBL_ZAFF, TBL_PVC, TBL_SPREAD) = range(11)
+ TBL_NAFF, TBL_PAFF, TBL_ZAFF, TBL_PVC, TBL_SPREAD, TBL_PZAFF) = range(12)
 # node column indices
 N_CPU, N_MEM, N_EPH, N_PODS = range(4)
 N_READY, N_UNSCHED, N_HASPODS = range(3)
@@ -100,13 +101,13 @@ def _lib() -> Optional[ctypes.CDLL]:
     try:
         ok = (
             lib.pod_ncols_i64() == 3
-            and lib.pod_ncols_i32() == 12
+            and lib.pod_ncols_i32() == 13
             and lib.pod_ncols_u8() == 1
             and lib.pod_ncols_str() == 2
             and lib.node_ncols_i64() == 4
             and lib.node_ncols_u8() == 3
             and lib.node_ncols_str() == 4
-            and lib.table_count() == 11
+            and lib.table_count() == 12
         )
     except AttributeError:
         ok = False
@@ -281,6 +282,7 @@ class PodBatch:
         ]
         self.naff_sets = [_parse_node_affinity(b) for b in tables[TBL_NAFF]]
         self.spread_sets = [_parse_spread(b) for b in tables[TBL_SPREAD]]
+        self.pzaff_sets = [_parse_kv(b) for b in tables[TBL_PZAFF]]
 
     def match_set(self, set_id: int) -> Dict[str, str]:
         return self.match_sets[set_id]
@@ -458,6 +460,10 @@ class PodView:
         return self._b.spread_sets[int(self._b.i32[self._i, P_SPREADID])]
 
     @property
+    def pod_affinity_zone_match(self) -> Dict[str, str]:
+        return self._b.pzaff_sets[int(self._b.i32[self._i, P_PZAFFID])]
+
+    @property
     def node_selector(self) -> Dict[str, str]:
         return self._b.selector_set(int(self._b.i32[self._i, P_SELID]))
 
@@ -507,6 +513,7 @@ class PodView:
             pvc_names=self.pvc_names,
             pvc_resolvable=self.pvc_resolvable,
             pod_affinity_match=dict(self.pod_affinity_match),
+            pod_affinity_zone_match=dict(self.pod_affinity_zone_match),
             node_affinity=self.node_affinity,
             spread_constraints=self.spread_constraints,
             unmodeled_constraints=self.unmodeled_constraints,
@@ -618,7 +625,7 @@ def parse_pod_list(data: bytes) -> Optional[PodBatch]:
     handle = lib.ingest_pods(data, len(data))
     if not handle:
         return None
-    return PodBatch(*_copy_batch(lib, handle, 3, 12, 1, 2, tables=11))
+    return PodBatch(*_copy_batch(lib, handle, 3, 13, 1, 2, tables=12))
 
 
 def parse_node_list(data: bytes) -> Optional[NodeBatch]:

@@ -112,6 +112,16 @@ class PodSpec:
     # drain, never strand a pod). Shapes beyond this fall back to
     # ``unmodeled_constraints``.
     pod_affinity_match: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Required POSITIVE pod-affinity with ZONE topology (round 4): the
+    # pod may only schedule into a zone already hosting a match. Same
+    # canonical selector rules; per-carrier allowed-zone verdicts
+    # (masks.ZonePodAffinityBit) computed from pre-plan counted
+    # residents, excluding matches on the carrier's own candidate node
+    # (they leave in the same drain). At most one positive term total —
+    # hostname OR zone.
+    pod_affinity_zone_match: Dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )
     phase: str = "Running"
     # spec.nodeSelector: the pod only schedules onto nodes carrying every
     # one of these labels (the kube-scheduler's NodeSelector predicate,

@@ -63,6 +63,7 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     PodAffinityBit,
     SelectorBit,
     SpreadBit,
+    ZonePodAffinityBit,
     Taint,
     TaintTable,
     affinity_bits,
@@ -290,6 +291,7 @@ class ColumnarStore:
         self._naff_uses_name = False  # any FieldIn/FieldNotIn term active
         self._paff_section: tuple = (0, ())  # positive pod-affinity bits
         self._spread_section: tuple = (0, ())  # per-tick spread verdicts
+        self._zpaff_section: tuple = (0, ())  # per-tick zone-paff verdicts
         self._unplace_pos: int = 0
         self._real_tol_pos: Dict[tuple, tuple] = {}
         self._sel_tol_pos: Dict[tuple, tuple] = {}
@@ -513,6 +515,12 @@ class ColumnarStore:
                 if getattr(pod, "spread_constraints", ())
                 else ()
             ),
+            (
+                (pod.namespace,
+                 tuple(sorted(pod.pod_affinity_zone_match.items())))
+                if pod.pod_affinity_zone_match
+                else ()
+            ),
             bool(pod.unmodeled_constraints),
         )
         tid = self._tol_keys.get(key)
@@ -648,11 +656,17 @@ class ColumnarStore:
             bool,
             count=len(batch.spread_sets),
         )[spread_ids]
-        # paff and spread identities are namespace-scoped: the namespace
-        # joins the combo only when either is non-empty (keeping plain
-        # pods to one profile per shape)
+        pzaff_ids = batch.i32[keep, ni.P_PZAFFID]
+        pzaff_nonempty = np.fromiter(
+            (len(s) > 0 for s in batch.pzaff_sets),
+            bool,
+            count=len(batch.pzaff_sets),
+        )[pzaff_ids]
+        # paff/pzaff and spread identities are namespace-scoped: the
+        # namespace joins the combo only when any is non-empty (keeping
+        # plain pods to one profile per shape)
         ns_eff = np.where(
-            paff_nonempty | spread_nonempty,
+            paff_nonempty | spread_nonempty | pzaff_nonempty,
             batch.i32[keep, ni.P_NSID],
             np.int32(-1),
         )
@@ -663,6 +677,7 @@ class ColumnarStore:
                 batch.i32[keep, ni.P_NAFFID],
                 paff_ids,
                 spread_ids,
+                pzaff_ids,
                 ns_eff,
                 unmod.astype(np.int32),
             ],
@@ -670,11 +685,12 @@ class ColumnarStore:
         )
         uniq, inverse = np.unique(combos, axis=0, return_inverse=True)
         ids = np.empty(len(uniq), np.int32)
-        for i, (tol_id, sel_id, naff_id, paff_id, spread_id, ns_id, um) in (
-            enumerate(uniq)
-        ):
+        for i, (
+            tol_id, sel_id, naff_id, paff_id, spread_id, pzaff_id, ns_id, um
+        ) in enumerate(uniq):
             paff_set = batch.paff_set(int(paff_id))
             spread_set = batch.spread_sets[int(spread_id)]
+            pzaff_set = batch.pzaff_sets[int(pzaff_id)]
             key = (
                 tuple(batch.tol_sets[tol_id]),
                 tuple(sorted(batch.selector_set(int(sel_id)).items())),
@@ -688,6 +704,12 @@ class ColumnarStore:
                 (
                     (batch.namespaces[int(ns_id)], tuple(spread_set))
                     if spread_set
+                    else ()
+                ),
+                (
+                    (batch.namespaces[int(ns_id)],
+                     tuple(sorted(pzaff_set.items())))
+                    if pzaff_set
                     else ()
                 ),
                 bool(um),
@@ -803,6 +825,7 @@ class ColumnarStore:
         spot_order: np.ndarray,
         slot_rows: np.ndarray,
         spread_bits: Sequence = (),
+        zone_paff_bits: Sequence = (),
     ) -> TaintTable:
         """Intern the constraint table over ready spot nodes in probe
         order, with the slot pods' nodeSelector universe as the
@@ -828,6 +851,7 @@ class ColumnarStore:
             sorted(naffs),
             sorted(paffs),
             spread_bits,
+            zone_paff_bits,
         )
 
     def _spread_contexts(
@@ -935,6 +959,78 @@ class ColumnarStore:
             universe.update(bits)
         return out, sorted(universe, key=lambda b: (b.topology_key, b.refused))
 
+    def _zone_paff_contexts(
+        self,
+        slot_rows: np.ndarray,
+        p_node: np.ndarray,
+        counted: np.ndarray,
+    ) -> Tuple[Dict[int, object], list]:
+        """Per-carrier-slot ZonePodAffinityBit + the sorted universe —
+        the columnar mirror of tensors._build_zone_paff_bits
+        (bit-identical: counted residents only, lane's own candidate
+        excluded)."""
+        if not len(slot_rows):
+            return {}, []
+        prof_has = np.fromiter(
+            (bool(prof[5]) for prof in self._tol_lists),
+            bool,
+            count=len(self._tol_lists),
+        )
+        hasz = prof_has[self.p_tol_id[slot_rows]]
+        if not hasz.any():
+            return {}, []
+        hi = len(counted)
+        hits_cache: Dict = {}
+
+        def zone_hits(ns, items):
+            key = (ns, items)
+            cached = hits_cache.get(key)
+            if cached is not None:
+                return cached
+            sets = [self._label_index.get((ns, k, v), set()) for k, v in items]
+            rows = (
+                set.intersection(*sorted(sets, key=len)) if all(sets) else set()
+            )
+            per_zone: Dict[str, int] = {}
+            per_node: Dict[int, int] = {}
+            for r in rows:
+                if r >= hi or not counted[r]:
+                    continue
+                nr = int(p_node[r])
+                if nr < 0:
+                    continue
+                per_node[nr] = per_node.get(nr, 0) + 1
+                obj = self.node_objs[nr]
+                z = obj.labels.get(ZONE_LABEL) if obj else None
+                if z is not None:
+                    per_zone[z] = per_zone.get(z, 0) + 1
+            cached = hits_cache[key] = (per_zone, per_node)
+            return cached
+
+        out: Dict[int, object] = {}
+        universe: set = set()
+        for j in np.nonzero(hasz)[0]:
+            r = int(slot_rows[j])
+            pod = self.pod_objs[r]
+            items = tuple(sorted(pod.pod_affinity_zone_match.items()))
+            per_zone, per_node = zone_hits(pod.namespace, items)
+            cand_row = int(p_node[r])
+            obj = self.node_objs[cand_row]
+            own_zone = obj.labels.get(ZONE_LABEL) if obj else None
+            own_hits = per_node.get(cand_row, 0)
+            allowed = tuple(sorted(
+                z for z, n in per_zone.items()
+                if n - (own_hits if z == own_zone else 0) > 0
+            ))
+            bit = ZonePodAffinityBit(
+                namespace=pod.namespace, items=items, allowed_zones=allowed
+            )
+            out[int(j)] = bit
+            universe.add(bit)
+        return out, sorted(
+            universe, key=lambda b: (b.namespace, b.items, b.allowed_zones)
+        )
+
     def _refresh_sections(self, table: TaintTable) -> None:
         real = tuple(e for e in table.taints if isinstance(e, Taint))
         pairs = tuple(
@@ -996,7 +1092,14 @@ class ColumnarStore:
         )
         spread_off = paff_off + len(paffs)
         self._spread_section = (spread_off, spreads)
-        self._unplace_pos = spread_off + len(spreads)
+        # zone-positive-affinity section: per-carrier-context verdicts,
+        # same per-tick lifecycle as the spread section
+        zpaffs = tuple(
+            e for e in table.taints if isinstance(e, ZonePodAffinityBit)
+        )
+        zpaff_off = spread_off + len(spreads)
+        self._zpaff_section = (zpaff_off, zpaffs)
+        self._unplace_pos = zpaff_off + len(zpaffs)
 
     @staticmethod
     def _mk_mask(positions, words: int) -> np.ndarray:
@@ -1017,12 +1120,16 @@ class ColumnarStore:
             naff_off, naffs = self._naff_section
             paff_off, paffs = self._paff_section
             spread_off, spread_entries = self._spread_section
-            spread_pos = tuple(
+            zpaff_off, zpaff_entries = self._zpaff_section
+            # every profile tolerates all per-tick context bits (spread
+            # + zone-paff); carriers get their own cleared per slot in
+            # pack(), since the verdicts depend on the carrier's LANE
+            ctx_pos = tuple(
                 range(spread_off, spread_off + len(spread_entries))
-            )
-            for i, (tols, sel, naff, paff, _spread, unmodeled) in enumerate(
-                self._tol_lists
-            ):
+            ) + tuple(range(zpaff_off, zpaff_off + len(zpaff_entries)))
+            for i, (
+                tols, sel, naff, paff, _spread, _zpaff, unmodeled
+            ) in enumerate(self._tol_lists):
                 pos = self._real_tol_pos.get(tols)
                 if pos is None:
                     pos = self._real_tol_pos[tols] = tuple(
@@ -1051,7 +1158,7 @@ class ColumnarStore:
                     )
                 unplace = () if unmodeled else (self._unplace_pos,)
                 rows[i] = self._mk_mask(
-                    pos + spos + npos + ppos + spread_pos + unplace, W
+                    pos + spos + npos + ppos + ctx_pos + unplace, W
                 )
             self._tol_matrix = rows
         return self._tol_matrix
@@ -1409,11 +1516,16 @@ class ColumnarStore:
             slot_rows, p_node, zone_counted, presence_extra,
             od_rows, spot_rows,
         )
+        slot_zpaff_bits, zpaff_universe = self._zone_paff_contexts(
+            slot_rows, p_node, counted
+        )
 
         # constraint table: built AFTER the slot set is known — its
         # pseudo-taint tail is the slot pods' nodeSelector universe
         # (identical to the object packer's, masks.intern_constraints)
-        table = self._build_taint_table(spot_order, slot_rows, spread_universe)
+        table = self._build_taint_table(
+            spot_order, slot_rows, spread_universe, zpaff_universe
+        )
         tol_matrix = self._toleration_matrix(table)
         W = table.words
         aff_matrix = self._affinity_matrix(
@@ -1502,6 +1614,20 @@ class ColumnarStore:
                     pods = [self.pod_objs[int(r)] for r in rows]
                     for k in spread_lane_guard(pods):
                         packed.slot_tol[int(c), int(k), uw] &= ~ub
+            if slot_zpaff_bits:
+                # zone-positive-affinity carriers lose tolerance of
+                # their own context bits (per slot, lane-dependent)
+                zpaff_pos = {
+                    e: i
+                    for i, e in enumerate(table.taints)
+                    if isinstance(e, ZonePodAffinityBit)
+                }
+                for j, bit in slot_zpaff_bits.items():
+                    c, k = int(slot_cand[j]), int(slot_idx[j])
+                    pos = zpaff_pos[bit]
+                    packed.slot_tol[c, k, pos // 32] &= ~np.uint32(
+                        1 << (pos % 32)
+                    )
         if C_actual:
             packed.cand_valid[:C_actual] = cand_ok & (n_evict > 0)
 
@@ -1532,19 +1658,26 @@ class ColumnarStore:
             paff_bits = self._pod_affinity_node_bits(sp_rows, sp, S_actual, W)
             if paff_bits is not None:
                 packed.spot_taints[:S_actual] |= paff_bits
-            if spread_universe:
-                # spread node side: a spot node repels a carrier when it
-                # lacks the topology key or sits in a refused domain
+            if spread_universe or zpaff_universe:
+                # per-tick context node sides: a spot node repels a
+                # spread carrier when it lacks the topology key or sits
+                # in a refused domain, and a zone-paff carrier when its
+                # zone hosts no qualifying match
                 entries = [
                     (i, e)
                     for i, e in enumerate(table.taints)
-                    if isinstance(e, SpreadBit)
+                    if isinstance(e, (SpreadBit, ZonePodAffinityBit))
                 ]
                 for si, r in enumerate(spot_order):
                     labels = self.node_objs[int(r)].labels
                     for pos, e in entries:
-                        d = labels.get(e.topology_key)
-                        if d is None or d in e.refused:
+                        if isinstance(e, SpreadBit):
+                            d = labels.get(e.topology_key)
+                            bad = d is None or d in e.refused
+                        else:
+                            z = labels.get(ZONE_LABEL)
+                            bad = z is None or z not in e.allowed_zones
+                        if bad:
                             packed.spot_taints[si, pos // 32] |= np.uint32(
                                 1 << (pos % 32)
                             )

@@ -259,6 +259,64 @@ def _build_spread_bits(node_map, candidates, cand_pods) -> Dict:
     return out
 
 
+def _build_zone_paff_bits(candidates, spot, cand_pods) -> Dict:
+    """(lane, slot) -> ZonePodAffinityBit for zone-positive-affinity
+    carriers (masks.ZonePodAffinityBit). Allowed zones = zones of
+    COUNTED residents (both classes, post priority filter) matching the
+    selector, EXCLUDING residents of the lane's own candidate node —
+    those leave in the same drain, and a zone satisfied only by them
+    would strand the carrier at reschedule time. In-plan placements
+    could only add matches (ignoring them loses a drain, never
+    strands)."""
+    if not any(
+        p.pod_affinity_zone_match for pods in cand_pods for p in pods
+    ):
+        return {}
+    from k8s_spot_rescheduler_tpu.predicates.masks import ZonePodAffinityBit
+
+    infos = list(candidates) + list(spot)
+    hits_cache: Dict = {}
+
+    def zone_hits(ns, items):
+        key = (ns, items)
+        cached = hits_cache.get(key)
+        if cached is not None:
+            return cached
+        per_zone: Dict[str, int] = {}
+        per_info: Dict[int, int] = {}
+        for idx, info in enumerate(infos):
+            zone = info.node.labels.get(ZONE_LABEL)
+            n = sum(
+                1
+                for q in info.pods
+                if q.namespace == ns
+                and all(q.labels.get(k) == v for k, v in items)
+            )
+            per_info[idx] = n
+            if zone is not None and n:
+                per_zone[zone] = per_zone.get(zone, 0) + n
+        cached = hits_cache[key] = (per_zone, per_info)
+        return cached
+
+    out: Dict = {}
+    for c, (info, pods) in enumerate(zip(candidates, cand_pods)):
+        for k, p in enumerate(pods):
+            if not p.pod_affinity_zone_match:
+                continue
+            items = tuple(sorted(p.pod_affinity_zone_match.items()))
+            per_zone, per_info = zone_hits(p.namespace, items)
+            own_zone = info.node.labels.get(ZONE_LABEL)
+            own_hits = per_info.get(c, 0)
+            allowed = tuple(sorted(
+                z for z, n in per_zone.items()
+                if n - (own_hits if z == own_zone else 0) > 0
+            ))
+            out[(c, k)] = ZonePodAffinityBit(
+                namespace=p.namespace, items=items, allowed_zones=allowed
+            )
+    return out
+
+
 def pack_cluster(
     node_map: NodeMap,
     pdbs: Sequence[PDBSpec] = (),
@@ -300,12 +358,20 @@ def pack_cluster(
         {b for bits in spread_bits_by.values() for b in bits},
         key=lambda b: (b.topology_key, b.refused),
     )
+    zone_paff_by = _build_zone_paff_bits(
+        candidates, spot, cand_pods
+    )  # (lane, slot) -> ZonePodAffinityBit
+    zone_paff_universe = sorted(
+        set(zone_paff_by.values()),
+        key=lambda b: (b.namespace, b.items, b.allowed_zones),
+    )
     table = intern_constraints(
         [n.node for n in spot],
         selector_universe(slot_pods_flat),
         node_affinity_universe(slot_pods_flat),
         pod_affinity_universe(slot_pods_flat),
         spread_universe,
+        zone_paff_universe,
     )
     # anti-affinity selector universes span every counted pod (resident
     # pods repel incoming matches and vice versa; zone identities reach
@@ -378,17 +444,22 @@ def pack_cluster(
                 out[:, j] = -(-col // d) if d != 1 else col
         return out
 
-    def tol_row(pod: PodSpec, sbits: frozenset = frozenset()):
+    def tol_row(
+        pod: PodSpec,
+        sbits: frozenset = frozenset(),
+        zpbit=None,
+    ):
         paff = pod_affinity_key(pod)
-        # sbits joins the key: a carrier's verdict depends on its LANE's
-        # node (own domain), so identical pods on different candidates
-        # may carry different SpreadBits
+        # sbits/zpbit join the key: a carrier's verdict depends on its
+        # LANE's node, so identical pods on different candidates may
+        # carry different context bits
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
             pod.node_affinity,
             paff,
             sbits,
+            zpbit,
             pod.unmodeled_constraints,
         )
         row = tol_cache.get(key)
@@ -399,6 +470,7 @@ def pack_cluster(
                 node_affinity=pod.node_affinity,
                 pod_affinity=paff,
                 spread_bits=sbits,
+                zone_paff_bit=zpbit,
             )
         return row
 
@@ -482,7 +554,11 @@ def pack_cluster(
             packed.slot_req[c, :n] = req_matrix(pods)
             packed.slot_valid[c, :n] = True
             packed.slot_tol[c, :n] = [
-                tol_row(p, spread_bits_by.get((c, k), frozenset()))
+                tol_row(
+                    p,
+                    spread_bits_by.get((c, k), frozenset()),
+                    zone_paff_by.get((c, k)),
+                )
                 for k, p in enumerate(pods)
             ]
             packed.slot_aff[c, :n] = [aff_row(p) for p in pods]

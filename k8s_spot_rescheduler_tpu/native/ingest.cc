@@ -421,6 +421,7 @@ enum {
   TBL_ZAFF,  // zone-topology anti-affinity matchLabels blobs
   TBL_PVC,   // PVC claim-name lists (REC_SEP-joined)
   TBL_SPREAD,  // canonical hard topologySpreadConstraints blobs
+  TBL_PZAFF,   // required POSITIVE zone-topology pod-affinity blobs
   TBL_COUNT,
 };
 
@@ -470,6 +471,7 @@ enum {
   P_ZAFFID,
   P_PVCID,
   P_SPREADID,
+  P_PZAFFID,
   P_NI32,
 };
 enum { P_FLAGS = 0, P_NU8 };
@@ -634,12 +636,14 @@ void extract_anti_affinity(const Val* block, std::string_view ns,
   }
 }
 
-// required POSITIVE podAffinity: ONE hostname term, widened selector; a
-// matches-nothing selector can never be satisfied -> unmodeled.
-// Lockstep: io/kube.py decode_pod_affinity.
+// required POSITIVE podAffinity: ONE term, hostname OR zone topology,
+// widened selector; a matches-nothing selector can never be satisfied
+// -> unmodeled. Lockstep: io/kube.py decode_pod_affinity.
 void extract_pod_affinity(const Val* block, std::string_view ns,
-                          std::string* blob, bool* unmodeled) {
-  blob->clear();
+                          std::string* host_blob, std::string* zone_blob,
+                          bool* unmodeled) {
+  host_blob->clear();
+  zone_blob->clear();
   if (!block || block->kind != Val::Obj) return;
   const Val* req = block->get("requiredDuringSchedulingIgnoredDuringExecution");
   if (!req || !py_truthy(req)) return;
@@ -653,14 +657,22 @@ void extract_pod_affinity(const Val* block, std::string_view ns,
     return;
   }
   const Val* topo = term->get("topologyKey");
-  if (!topo || topo->kind != Val::Str ||
-      topo->text != "kubernetes.io/hostname") {
+  bool zone;
+  if (topo && topo->kind == Val::Str &&
+      topo->text == "kubernetes.io/hostname") {
+    zone = false;
+  } else if (topo && topo->kind == Val::Str &&
+             topo->text == "topology.kubernetes.io/zone") {
+    zone = true;
+  } else {
     *unmodeled = true;
     return;
   }
-  int verdict = term_selector_blob(term, ns, blob);
+  std::string* slot = zone ? zone_blob : host_blob;
+  int verdict = term_selector_blob(term, ns, slot);
   if (verdict != SEL_OK) {
-    blob->clear();
+    host_blob->clear();
+    zone_blob->clear();
     *unmodeled = true;
   }
 }
@@ -1059,6 +1071,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     std::string anti_host_blob;
     std::string anti_zone_blob;
     std::string paff_blob;
+    std::string pzaff_blob;
     std::string naff_blob;
     std::string pvc_blob;
     std::string spread_blob;
@@ -1072,7 +1085,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
           &anti_host_blob, &anti_zone_blob, &unmodeled);
       extract_pod_affinity(
           aff_obj ? aff_obj->get("podAffinity") : nullptr, pod_ns,
-          &paff_blob, &unmodeled);
+          &paff_blob, &pzaff_blob, &unmodeled);
       extract_node_affinity(
           aff_obj ? aff_obj->get("nodeAffinity") : nullptr,
           &unmodeled, &naff_blob);
@@ -1139,6 +1152,7 @@ Batch* ingest_pods_impl(const char* buf, long n) {
     i32row(P_ZAFFID) = b->intern_str(TBL_ZAFF, anti_zone_blob);
     i32row(P_PVCID) = b->intern_str(TBL_PVC, pvc_blob);
     i32row(P_SPREADID) = b->intern_str(TBL_SPREAD, spread_blob);
+    i32row(P_PZAFFID) = b->intern_str(TBL_PZAFF, pzaff_blob);
 
     // tolerations: key\x1fvalue\x1foperator\x1feffect\x1e...
     tmp.clear();

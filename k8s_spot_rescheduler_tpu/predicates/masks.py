@@ -156,6 +156,27 @@ class PodAffinityBit:
 
 
 @dataclasses.dataclass(frozen=True)
+class ZonePodAffinityBit:
+    """Pseudo-taint for one required POSITIVE pod-affinity with ZONE
+    topology, per CARRIER CONTEXT: the sorted zones hosting a
+    qualifying match this tick. Set on every spot node that lacks the
+    zone label or whose zone is not in ``allowed_zones``; only the
+    carrier fails to tolerate it.
+
+    Conservative in two deliberate ways: matches are counted from
+    pre-plan COUNTED residents only (in-plan placements could only add
+    matches — ignoring them loses a drain, never strands), and matches
+    residing on the carrier's own candidate node are EXCLUDED from its
+    context — they leave in the same drain, so a zone satisfied only by
+    them would strand the carrier at reschedule time (the packers pass
+    the exclusion; same per-carrier-context pattern as SpreadBit)."""
+
+    namespace: str
+    items: Tuple  # sorted matchLabels items
+    allowed_zones: Tuple  # sorted zone values hosting a qualifying match
+
+
+@dataclasses.dataclass(frozen=True)
 class SpreadBit:
     """Pseudo-taint for one hard topologySpreadConstraint CARRIER
     CONTEXT: the set of topology domains a specific moving pod may not
@@ -276,17 +297,19 @@ def intern_constraints(
     affinity_terms: Sequence[Tuple] = (),
     pod_affinity_keys: Sequence[Tuple] = (),
     spread_bits: Sequence["SpreadBit"] = (),
+    zone_paff_bits: Sequence["ZonePodAffinityBit"] = (),
 ) -> TaintTable:
     """``intern_taints`` plus the pseudo-taint tail: selector pairs (in
     the given sorted order), node-affinity requirement bits, positive
-    pod-affinity bits, spread-verdict bits, and the always-present
-    unplaceable bit."""
+    pod-affinity bits, spread-verdict bits, zone-pod-affinity verdict
+    bits, and the always-present unplaceable bit."""
     base = intern_taints(nodes)
     taints = list(base.taints)
     taints.extend(SelectorBit(k, v) for k, v in selector_pairs)
     taints.extend(NodeAffinityBit(t) for t in affinity_terms)
     taints.extend(PodAffinityBit(ns, items) for ns, items in pod_affinity_keys)
     taints.extend(spread_bits)
+    taints.extend(zone_paff_bits)
     taints.append(UnplaceableBit())
     words = max(1, -(-len(taints) // 32))
     return TaintTable(taints=taints, words=words)
@@ -317,6 +340,10 @@ def node_constraint_mask(
             domain = node.labels.get(entry.topology_key)
             if domain is None or domain in entry.refused:
                 mask[i // 32] |= np.uint32(1 << (i % 32))
+        elif isinstance(entry, ZonePodAffinityBit):
+            zone = node.labels.get(ZONE_LABEL)
+            if zone is None or zone not in entry.allowed_zones:
+                mask[i // 32] |= np.uint32(1 << (i % 32))
         else:  # UnplaceableBit
             mask[i // 32] |= np.uint32(1 << (i % 32))
     return mask | taint_mask(node.taints, table)
@@ -330,13 +357,15 @@ def constraint_mask(
     node_affinity: Tuple = (),
     pod_affinity: Tuple = (),
     spread_bits: frozenset = frozenset(),
+    zone_paff_bit=None,
 ) -> np.ndarray:
     """Pod-side bits: tolerated real taints + selector pairs the pod does
     NOT require + affinity requirements that are not the pod's own + the
     unplaceable bit unless the pod carries unmodeled constraints.
     ``pod_affinity`` is the pod's own PodAffinityBit identity
     (``pod_affinity_key``), or (); ``spread_bits`` the pod's own
-    SpreadBit contexts (every other pod tolerates them)."""
+    SpreadBit contexts and ``zone_paff_bit`` its own
+    ZonePodAffinityBit context (every other pod tolerates them)."""
     mask = np.zeros(table.words, dtype=np.uint32)
     for i, entry in enumerate(table.taints):
         if isinstance(entry, Taint):
@@ -349,6 +378,8 @@ def constraint_mask(
             ok = (entry.namespace, entry.items) != pod_affinity
         elif isinstance(entry, SpreadBit):
             ok = entry not in spread_bits
+        elif isinstance(entry, ZonePodAffinityBit):
+            ok = entry != zone_paff_bit
         else:  # UnplaceableBit
             ok = not unmodeled
         if ok:

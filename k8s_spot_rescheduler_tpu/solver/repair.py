@@ -184,7 +184,16 @@ def _repair_round(static, state: _RepairCarry, round_idx):
     )  # [C, K]
     n_r = eligible_r.sum(axis=-1)
     rank_r = jnp.cumsum(eligible_r, axis=-1) - 1
-    want_r = jnp.where(n_r > 0, round_idx % jnp.maximum(n_r, 1), -1)
+    # r rotates on an INDEPENDENT schedule (divided by the q-rotation
+    # period): keying both to round_idx would lock the pairings to
+    # q ≡ r (mod gcd(n_unlock, n_r)) and leave whole (q, r) pairs
+    # unreachable at any round count (round-4 review finding); this way
+    # n_unlock x n_r rounds sweep every pairing
+    want_r = jnp.where(
+        n_r > 0,
+        (round_idx // jnp.maximum(n_unlock, 1)) % jnp.maximum(n_r, 1),
+        -1,
+    )
     is_r = eligible_r & (rank_r == want_r[:, None])
     r = jnp.argmax(is_r, axis=-1)  # [C]
     any_r = jnp.any(is_r, axis=-1)
@@ -469,7 +478,8 @@ def plan_repair_oracle(
             n_r = int(eligible.sum())
             if not n_r:
                 continue
-            r = int(np.flatnonzero(eligible)[rnd % n_r])
+            # independent r rotation (device lockstep): see _repair_round
+            r = int(np.flatnonzero(eligible)[(rnd // max(n_unlock, 1)) % n_r])
             sr = int(assign[c, r])
             fits_r = fit_mask(
                 np,

@@ -125,6 +125,9 @@ def _assert_pod_parity(objs):
         assert got.pod_affinity_match == want.pod_affinity_match, (
             f"pod {i} pod-affinity"
         )
+        assert got.pod_affinity_zone_match == want.pod_affinity_zone_match, (
+            f"pod {i} zone-pod-affinity"
+        )
         assert got.anti_affinity_zone_match == want.anti_affinity_zone_match, (
             f"pod {i} zone-anti-affinity"
         )
@@ -359,18 +362,32 @@ def test_pod_affinity_shapes():
             "requiredDuringSchedulingIgnoredDuringExecution": [
                 {"topologyKey": "kubernetes.io/hostname",
                  "labelSelector": {"matchLabels": {"app": "db"}}}]}}),
-        # zone topology -> unmodeled
+        # zone topology -> modeled (round 4: ZonePodAffinityBit)
         _affinity_pod("paz", {"podAffinity": {
             "requiredDuringSchedulingIgnoredDuringExecution": [
                 {"topologyKey": "topology.kubernetes.io/zone",
                  "labelSelector": {"matchLabels": {"app": "db"}}}]}}),
-        # matchExpressions selector -> unmodeled
+        # single-value In expressions fold (round 4)
         _affinity_pod("pae", {"podAffinity": {
             "requiredDuringSchedulingIgnoredDuringExecution": [
                 {"topologyKey": "kubernetes.io/hostname",
                  "labelSelector": {"matchExpressions": [
                      {"key": "app", "operator": "In",
                       "values": ["db"]}]}}]}}),
+        # zone topology + folded expressions together
+        _affinity_pod("pazx", {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "topology.kubernetes.io/zone",
+                 "labelSelector": {
+                     "matchLabels": {"tier": "be"},
+                     "matchExpressions": [
+                         {"key": "app", "operator": "In",
+                          "values": ["db"]}]}}]}}),
+        # other topology key -> unmodeled
+        _affinity_pod("par", {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "example.com/rack",
+                 "labelSelector": {"matchLabels": {"app": "db"}}}]}}),
         # preferred only -> unconstrained
         _affinity_pod("pap", {"podAffinity": {
             "preferredDuringSchedulingIgnoredDuringExecution": [

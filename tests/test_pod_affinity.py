@@ -84,10 +84,22 @@ def test_decode_widened_selector_shapes_modeled():
     assert not pod.unmodeled_constraints
 
 
+def test_decode_zone_topology_pod_affinity_modeled():
+    """Round 4: required positive pod-affinity with ZONE topology is
+    modeled (ZonePodAffinityBit) — the pod may only join a zone already
+    hosting a match."""
+    pod = decode_pod(_pod_obj(_paff([{
+        "topologyKey": "topology.kubernetes.io/zone",
+        "labelSelector": {"matchLabels": {"app": "db"}}}])))
+    assert pod.pod_affinity_zone_match == {"app": "db"}
+    assert pod.pod_affinity_match == {}
+    assert not pod.unmodeled_constraints
+
+
 def test_decode_unmodeled_pod_affinity_shapes():
     for term in (
-        # zone topology
-        [{"topologyKey": "topology.kubernetes.io/zone",
+        # other topology keys
+        [{"topologyKey": "example.com/rack",
           "labelSelector": {"matchLabels": {"app": "db"}}}],
         # multi-value In / non-In operators stay unmodeled
         [{"topologyKey": "kubernetes.io/hostname",
@@ -199,6 +211,111 @@ def test_plain_pods_unaffected_by_universe():
     pods = meta.cand_pods[0]
     k = next(i for i, p in enumerate(pods) if p.name == "web")
     assert meta.spot[int(result.assignment[0, k])].node.name == "spot-with-db"
+
+
+# --- zone-topology positive affinity (round 4) -----------------------------
+
+def _zl(base, zone):
+    from k8s_spot_rescheduler_tpu.predicates.masks import ZONE_LABEL
+
+    return dict(base, **{ZONE_LABEL: zone})
+
+
+def _zone_cluster(db_on="spot-a2"):
+    """Zone a: spot-a1 (empty), spot-a2 (hosts app=db by default).
+    Zone b: spot-b1. Zoneless: spot-nz."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zl(ON_DEMAND_LABELS, "b")))
+    fc.add_node(make_node("spot-a1", _zl(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-a2", _zl(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zl(SPOT_LABELS, "b")))
+    fc.add_node(make_node("spot-nz", SPOT_LABELS))
+    if db_on:
+        fc.add_pod(make_pod("db-0", 100, db_on, labels={"app": "db"}))
+    return fc
+
+
+def _zone_placement(fc, name):
+    packed, meta = _pack(fc)
+    result = plan_oracle(packed)
+    for c, pods in enumerate(meta.cand_pods):
+        for k, p in enumerate(pods):
+            if p.name == name:
+                if not result.feasible[c]:
+                    return None
+                return meta.spot[int(result.assignment[c, k])].node.name
+    raise AssertionError(f"{name} not packed")
+
+
+def test_zone_affinity_pod_admitted_anywhere_in_matching_zone():
+    """The match sits on spot-a2; BOTH zone-a nodes admit the carrier
+    (zone topology, unlike hostname) — first-fit probe order picks the
+    fuller zone-a node. Zone b and the zoneless node refuse."""
+    fc = _zone_cluster()
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_zone_match={"app": "db"}))
+    assert _zone_placement(fc, "web") in ("spot-a1", "spot-a2")
+    _columnar_parity(fc)
+
+
+def test_zone_affinity_no_match_blocks_drain():
+    fc = _zone_cluster(db_on=None)
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_zone_match={"app": "db"}))
+    packed, _ = _pack(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+    _columnar_parity(fc)
+
+
+def test_zone_affinity_match_on_own_candidate_excluded():
+    """The stranding hazard the context exclusion exists for: the only
+    match lives on the DRAINING node (same zone as spot capacity) — it
+    leaves in the same drain, so the zone must not count as satisfied."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zl(ON_DEMAND_LABELS, "a")))
+    fc.add_node(make_node("spot-a1", _zl(SPOT_LABELS, "a")))
+    fc.add_pod(make_pod("db-0", 100, "od-1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_zone_match={"app": "db"}))
+    packed, _ = _pack(fc)
+    assert not plan_oracle(packed).feasible[:1].any()
+    _columnar_parity(fc)
+
+
+def test_zone_affinity_match_on_other_candidate_counts():
+    """A match on a DIFFERENT on-demand node stays this tick (one drain
+    per tick) — its zone satisfies the carrier."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", _zl(ON_DEMAND_LABELS, "b")))
+    fc.add_node(make_node("od-2", _zl(ON_DEMAND_LABELS, "a")))
+    fc.add_node(make_node("spot-a1", _zl(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zl(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-0", 100, "od-2", labels={"app": "db"}))
+    fc.add_pod(make_pod("filler", 600, "od-2"))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_zone_match={"app": "db"}))
+    assert _zone_placement(fc, "web") == "spot-a1"
+    _columnar_parity(fc)
+
+
+def test_zone_affinity_end_to_end_drain():
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    fc.add_node(make_node("od-1", _zl(ON_DEMAND_LABELS, "b")))
+    fc.add_node(make_node("spot-a1", _zl(SPOT_LABELS, "a")))
+    fc.add_node(make_node("spot-b1", _zl(SPOT_LABELS, "b")))
+    fc.add_pod(make_pod("db-0", 100, "spot-a1", labels={"app": "db"}))
+    fc.add_pod(make_pod("web", 300, "od-1",
+                        pod_affinity_zone_match={"app": "db"}))
+    from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    cfg = ReschedulerConfig(solver="numpy", node_drain_delay=0.0)
+    r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=fc.clock, recorder=fc)
+    result = r.tick()
+    assert result.drained == ["od-1"]
+    fc.clock.advance(10.0)
+    assert fc.pods["default/web"].node_name == "spot-a1"
 
 
 # --- columnar parity -------------------------------------------------------
