@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--repair-rounds", type=int, default=d.repair_rounds,
                    help="eject-and-reinsert local-search rounds for "
                         "candidates greedy packing can't prove (0=off)")
+    p.add_argument("--auto-shard", type=_bool, default=d.auto_shard,
+                   help="reroute the solve to the mesh-sharded backend "
+                        "automatically when the problem exceeds one "
+                        "chip's HBM and >1 device is visible")
+    p.add_argument("--solver-hbm-budget", type=int,
+                   default=d.solver_hbm_budget,
+                   help="per-device byte budget for the auto-shard "
+                        "decision (0 = auto-detect from the backend)")
     p.add_argument("--leader-elect", type=_bool, default=False,
                    help="Lease-based leader election so only one replica "
                         "acts (restores what reference rescheduler.go:139 "
@@ -113,6 +121,8 @@ def config_from_args(args) -> ReschedulerConfig:
         priority_threshold=args.priority_threshold,
         solver=args.solver,
         repair_rounds=args.repair_rounds,
+        auto_shard=args.auto_shard,
+        solver_hbm_budget=args.solver_hbm_budget,
         resources=tuple(r for r in args.resources.split(",") if r),
         mesh_shape=(
             tuple(int(x) for x in args.mesh_shape.lower().split("x"))

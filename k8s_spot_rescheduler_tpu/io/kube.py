@@ -465,6 +465,16 @@ def decode_topology_spread(spread) -> tuple:
     return tuple(sorted(set(out))), False
 
 
+def decode_volume_snapshots(pvc_items, pv_items) -> tuple:
+    """(pvc-by-uid, pv-by-name) maps from decoded LIST items — THE
+    keying convention ``models/volumes.resolve_volume_affinity`` reads;
+    shared by the polling client and the planner sidecar so the two
+    can never drift."""
+    pvcs = {(c := decode_pvc(o)).uid: c for o in pvc_items}
+    pvs = {(v := decode_pv(o)).name: v for o in pv_items}
+    return pvcs, pvs
+
+
 def decode_pvc(obj: dict) -> "PVCSpec":
     from k8s_spot_rescheduler_tpu.models.cluster import PVCSpec
 
@@ -708,19 +718,14 @@ class KubeClusterClient:
         shared by this client's polling path and the watch-mode client's
         per-tick retry. Raises on HTTP/decode failure; callers stay
         conservative."""
-        pvcs = {
-            (c := decode_pvc(o)).uid: c
-            for o in self._request(
+        return decode_volume_snapshots(
+            self._request(
                 "GET", "/api/v1/persistentvolumeclaims"
-            ).get("items", [])
-        }
-        pvs = {
-            (v := decode_pv(o)).name: v
-            for o in self._request(
+            ).get("items", []),
+            self._request(
                 "GET", "/api/v1/persistentvolumes"
-            ).get("items", [])
-        }
-        return pvcs, pvs
+            ).get("items", []),
+        )
 
     def _resolve_volumes(self, pods, pvc_hint=None):
         """Lift PVC-pod conservatism where provable: fetch same-tick

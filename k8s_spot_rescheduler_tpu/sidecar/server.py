@@ -10,10 +10,15 @@ decision.
 
     POST /v1/plan
       {"nodes": [<k8s Node>...], "pods": [<k8s Pod>...],
-       "pdbs": [<k8s PDB>...]}
+       "pdbs": [<k8s PDB>...],
+       "pvcs": [<k8s PVC>...], "pvs": [<k8s PV>...]}   # optional
     → {"found": true, "node": "od-17", "pods": [...],
        "assignments": {"ns/pod": "spot-3", ...},
        "nCandidates": 2500, "nFeasible": 856, "solveMs": 66.2}
+
+    PVC/PV sections are optional: with them, PVC-backed pods resolve
+    their volume topology (models/volumes.py) exactly as the in-process
+    loop does; without them such pods stay conservatively unplaceable.
 
     GET /healthz → {"ok": true, "solver": "pallas"}
 
@@ -184,6 +189,23 @@ class PlannerSidecar:
         nodes = [decode_node(o) for o in body.get("nodes", [])]
         pods = [decode_pod(o) for o in body.get("pods", [])]
         pdbs = [decode_pdb(o) for o in body.get("pdbs", [])]
+        pvc_objs = body.get("pvcs") or []
+        pv_objs = body.get("pvs") or []
+        if pvc_objs or pv_objs:
+            from k8s_spot_rescheduler_tpu.io.kube import (
+                decode_volume_snapshots,
+            )
+            from k8s_spot_rescheduler_tpu.models.volumes import (
+                resolve_volume_affinity,
+            )
+
+            pvcs, pvs = decode_volume_snapshots(pvc_objs, pv_objs)
+            pods = [
+                resolve_volume_affinity(p, pvcs, pvs)
+                if p.pvc_resolvable
+                else p
+                for p in pods
+            ]
         pods_by_node: dict = {}
         for pod in pods:
             pods_by_node.setdefault(pod.node_name, []).append(pod)
@@ -193,6 +215,10 @@ class PlannerSidecar:
             on_demand_label=self.config.on_demand_node_label,
             spot_label=self.config.spot_node_label,
             priority_threshold=self.config.priority_threshold,
+            # not-ready nodes are presence-only (zone/spread counts) —
+            # dropping them would overstate the spread domain-min, the
+            # permissive direction (same rule as the control loop)
+            unready_nodes=[n for n in nodes if not n.ready],
         )
         report = self.planner.plan(node_map, pdbs)
         out = {

@@ -217,6 +217,63 @@ def test_wire_full_tick_drains_the_worker(wire_stub):
     assert len(wire_stub.patches) == 2
 
 
+def test_wire_sidecar_plans_the_same_drain():
+    """The planner-sidecar boundary (SURVEY.md §2.3): POSTing the same
+    wire payloads to /v1/plan yields the same drain decision the
+    in-process loop makes — including the PV-zone steering of pg-0 via
+    the optional pvcs/pvs snapshot sections — and not-ready nodes ride
+    along as presence (the sidecar passes them into NodeMap.unready
+    like the control loop does). Without the volume sections, the
+    PVC-backed pod stays conservatively unplaceable and the drain is
+    refused rather than risked."""
+    import urllib.request
+
+    from k8s_spot_rescheduler_tpu.sidecar.server import PlannerSidecar
+
+    data = _fixture()
+    sidecar = PlannerSidecar(
+        ReschedulerConfig(solver="numpy", resources=("cpu", "memory")),
+        "127.0.0.1:0",
+    )
+    sidecar.start_background()
+
+    def post(body):
+        req = urllib.request.Request(
+            f"http://{sidecar.address}/v1/plan",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    try:
+        # full snapshot: same decision as the in-process tick
+        out = post({
+            "nodes": data["nodes"],
+            "pods": data["pods"],
+            "pdbs": data["pdbs"],
+            "pvcs": data["pvcs"],
+            "pvs": data["pvs"],
+        })
+        assert out["found"] is True
+        assert out["node"] == OD
+        assert out["assignments"]["shop/pg-0"] == SPOT_1A
+        for uid, target in out["assignments"].items():
+            assert target in (SPOT_1A, SPOT_1B), (uid, target)
+
+        # without the volume sections: pg-0 stays unplaceable, so the
+        # worker cannot be proven drainable — conservative, not risky
+        out = post({
+            "nodes": data["nodes"],
+            "pods": data["pods"],
+            "pdbs": data["pdbs"],
+        })
+        assert out["found"] is False
+    finally:
+        sidecar.close()
+
+
 def test_wire_native_full_tick_parity(wire_stub):
     """The same tick through the native-ingest client path must make
     the identical drain decision."""
