@@ -14,6 +14,11 @@ The reference publishes no benchmarks (BASELINE.md: "None exist"); the
 baseline is BASELINE.json's 200 ms-on-v5e target for this exact scale.
 
 Usage: python bench.py [--config N] [--repeats R] [--solver jax|sharded]
+       python bench.py --quality [--sweep K]     # vs the affinity-aware ILP
+       python bench.py --quality-scale --config 3|4   # LP/Hall bound at scale
+       python bench.py --quality-boundary        # published repair boundary
+       python bench.py --config 5 [--constrained]    # interruption replay
+       python bench.py --scale 8                 # past-one-chip (auto-shard)
 """
 
 from __future__ import annotations
