@@ -274,6 +274,38 @@ def test_wire_sidecar_plans_the_same_drain():
         sidecar.close()
 
 
+def test_wire_unready_lister_both_paths(wire_stub):
+    """list_unready_nodes (the presence-only view) returns the same
+    not-ready node over HTTP on the Python and native decode paths."""
+    import copy
+
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+
+    dead = copy.deepcopy(wire_stub.nodes[SPOT_1A])
+    dead["metadata"]["name"] = "ip-10-0-3-100.ec2.internal"
+    dead["status"]["conditions"] = [
+        {"type": "Ready", "status": "False",
+         "lastTransitionTime": "2026-07-30T06:00:00Z",
+         "reason": "KubeletStopped", "message": "node is shutting down"}
+    ]
+    wire_stub.nodes[dead["metadata"]["name"]] = dead
+
+    client = KubeClusterClient(wire_stub.url)
+    client.use_native_ingest = False
+    py_unready = [n.name for n in client.list_unready_nodes()]
+    assert py_unready == ["ip-10-0-3-100.ec2.internal"]
+    if native_ingest.available():
+        nclient = KubeClusterClient(wire_stub.url)
+        assert nclient.use_native_ingest
+        assert [
+            n.name for n in nclient.list_unready_nodes()
+        ] == py_unready
+    # the ready lister keeps excluding it
+    assert dead["metadata"]["name"] not in [
+        n.name for n in client.list_ready_nodes()
+    ]
+
+
 def test_wire_native_full_tick_parity(wire_stub):
     """The same tick through the native-ingest client path must make
     the identical drain decision."""
