@@ -28,6 +28,10 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     hosts_affinity_match,
     match_node_affinity,
 )
+from k8s_spot_rescheduler_tpu.predicates.selectors import (
+    selector_matches,
+    term_matches,
+)
 from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
@@ -251,23 +255,27 @@ class FakeCluster:
             return False
 
         # selector anti-affinity, both directions (the scheduler
-        # respects existing pods' required anti-affinity too)
+        # respects existing pods' required anti-affinity too) — round-5
+        # widened terms: any term of a's whose scope covers b and whose
+        # selector matches b repels
         def _repels(a: PodSpec, b: PodSpec) -> bool:
-            return bool(a.anti_affinity_match) and a.namespace == b.namespace and all(
-                b.labels.get(k) == v for k, v in a.anti_affinity_match.items()
+            return any(
+                term_matches(t, b.namespace, b.labels)
+                for t in a.anti_affinity_match
             )
 
         if any(_repels(pod, p) or _repels(p, pod) for p in here):
             return False
         # required positive pod-affinity: the node must already host a
-        # match (hostname topology, own namespace) — the same predicate
+        # match for EVERY term (hostname topology) — the same predicate
         # the packers' PodAffinityBit node side evaluates
-        if pod.pod_affinity_match and not hosts_affinity_match(
-            here, pod.namespace, tuple(pod.pod_affinity_match.items())
+        if pod.pod_affinity_match and not all(
+            hosts_affinity_match(here, nss, items)
+            for nss, items in pod.pod_affinity_match
         ):
             return False
         # zone-topology positive pod-affinity: the node's ZONE must
-        # already host a match (masks.ZonePodAffinityBit semantics)
+        # already host a match per term (masks.ZonePodAffinityBit)
         if pod.pod_affinity_zone_match:
             zone_val = node.labels.get(ZONE_LABEL)
             if zone_val is None:
@@ -278,9 +286,9 @@ class FakeCluster:
                 if n2.labels.get(ZONE_LABEL) == zone_val
                 for q in self.list_pods_on_node(n2.name)
             ]
-            if not hosts_affinity_match(
-                zone_pods, pod.namespace,
-                tuple(pod.pod_affinity_zone_match.items()),
+            if not all(
+                hosts_affinity_match(zone_pods, nss, items)
+                for nss, items in pod.pod_affinity_zone_match
             ):
                 return False
         # zone-topology anti-affinity, both directions, across the whole
@@ -292,17 +300,16 @@ class FakeCluster:
                     if n2.labels.get(ZONE_LABEL) == zone:
                         yield from self.list_pods_on_node(n2.name)
 
-            if pod.anti_affinity_zone_match and hosts_affinity_match(
-                list(_zone_pods()),
-                pod.namespace,
-                tuple(pod.anti_affinity_zone_match.items()),
+            if any(
+                term_matches(t, p.namespace, p.labels)
+                for p in _zone_pods()
+                for t in pod.anti_affinity_zone_match
             ):
                 return False
             for p in _zone_pods():
-                if p.anti_affinity_zone_match and hosts_affinity_match(
-                    [pod],
-                    p.namespace,
-                    tuple(p.anti_affinity_zone_match.items()),
+                if any(
+                    term_matches(t, pod.namespace, pod.labels)
+                    for t in p.anti_affinity_zone_match
                 ):
                     return False
         # hard topology-spread (canonical shapes): refuse placements
@@ -320,11 +327,11 @@ class FakeCluster:
                     continue
                 counts.setdefault(d2, 0)
                 for p in self.list_pods_on_node(n2.name):
-                    if p.namespace == pod.namespace and all(
-                        p.labels.get(k) == v for k, v in items
+                    if p.namespace == pod.namespace and selector_matches(
+                        items, p.labels
                     ):
                         counts[d2] += 1
-            self_m = all(pod.labels.get(k) == v for k, v in items)
+            self_m = selector_matches(items, pod.labels)
             if counts[d] + (1 if self_m else 0) - min(counts.values()) > skew:
                 return False
         return pod.requests.get(CPU, 0) <= free_cpu and (

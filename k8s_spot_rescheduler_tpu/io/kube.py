@@ -256,152 +256,160 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
 )
 
 
-# Sentinel for "this term's selector can never match any pod" (e.g. a
-# folded key required to equal two different values): an anti-affinity
-# term like that constrains nothing and is DROPPED exactly; a positive
-# term like that can never be satisfied — unmodeled (= unplaceable,
-# which is also exact).
-_MATCHES_NOTHING = object()
+from k8s_spot_rescheduler_tpu.predicates.selectors import (
+    SELECTOR_OPS as _SELECTOR_OPS,
+)
 
 
-def _decode_term_selector(term: dict, namespace: str):
-    """The selector of one required affinity term, canonicalized to a
-    matchLabels-equivalent dict (round-4 widened shape, exact native
-    lockstep):
+def _decode_term(term: dict, namespace: str):
+    """One required pod-affinity term, canonicalized to the round-5
+    widened shape (predicates/selectors.py): a ``(namespaces, selector)``
+    term with the full LabelSelector operator surface. Exact native
+    lockstep (native/ingest.cc ``term_selector_blob``):
 
-    - ``namespaces`` may be absent/empty OR name only the pod's own
-      namespace (still own-namespace semantics);
+    - ``namespaces`` absent/empty resolves to the pod's own namespace;
+      an explicit list of namespace names (cross-namespace included) is
+      modeled as the term's scope — k8s semantics: the list REPLACES
+      the own-namespace default, it does not extend it;
     - ``namespaceSelector`` presence at all stays unmodeled ({} means
       "all namespaces");
-    - ``matchExpressions`` entries fold into the dict when every one is
-      a single-value ``In`` (exactly equivalent to a matchLabels pair);
-      Exists/NotIn/DoesNotExist/multi-value stay unmodeled;
-    - a key required to equal two different values makes the selector
-      match nothing → ``_MATCHES_NOTHING``.
+    - ``matchLabels`` pairs become single-value In requirements;
+    - ``matchExpressions`` entries model In / NotIn / Exists /
+      DoesNotExist with multi-value lists; In/NotIn need >=1 value and
+      Exists/DoesNotExist must carry none (k8s validation);
+    - an empty selector stays unmodeled; separator bytes anywhere stay
+      unmodeled (native blob framing, has_sep_bytes lockstep).
 
-    Returns (dict | _MATCHES_NOTHING, unmodeled)."""
+    Returns (term | None, matches_nothing, unmodeled)."""
+    from k8s_spot_rescheduler_tpu.predicates.selectors import (
+        canon_selector,
+        selector_matches_nothing,
+    )
+
     ns_list = term.get("namespaces")
     if ns_list:
         if not isinstance(ns_list, list) or not all(
-            x == namespace for x in ns_list
+            isinstance(x, str) and x and not _has_sep_bytes(x)
+            for x in ns_list
         ):
-            return {}, True
+            return None, False, True
+        namespaces = tuple(sorted(set(ns_list)))
+    else:
+        namespaces = (namespace,)
     if "namespaceSelector" in term:
-        return {}, True
+        return None, False, True
     sel = term.get("labelSelector")
     if not isinstance(sel, dict):
-        return {}, True
+        return None, False, True
     match = sel.get("matchLabels")
     if match is None:
         match = {}
     if not isinstance(match, dict):
-        return {}, True
-    # value-type validation BEFORE expression folding — the native
-    # engine rejects non-string matchLabels values at collection time,
-    # so a type error must win over a later key conflict (lockstep)
+        return None, False, True
     if any(
         not isinstance(k, str) or not isinstance(v, str)
+        or _has_sep_bytes(k) or _has_sep_bytes(v)
         for k, v in match.items()
     ):
-        return {}, True
-    out = dict(match)
+        return None, False, True
+    reqs = [(k, "In", (v,)) for k, v in match.items()]
     exprs = sel.get("matchExpressions")
     if exprs:
         if not isinstance(exprs, list):
-            return {}, True
+            return None, False, True
         for e in exprs:
-            if not isinstance(e, dict) or e.get("operator") != "In":
-                return {}, True
-            key, values = e.get("key"), e.get("values")
+            if not isinstance(e, dict):
+                return None, False, True
+            key, op = e.get("key"), e.get("operator")
             if (
                 not isinstance(key, str)
-                or not isinstance(values, list)
-                or len(values) != 1
-                or not isinstance(values[0], str)
+                or _has_sep_bytes(key)
+                or op not in _SELECTOR_OPS
             ):
-                return {}, True
-            if key in out and out[key] != values[0]:
-                return _MATCHES_NOTHING, False
-            out[key] = values[0]
-    if not out:
-        return {}, True  # empty selector: not modeled
-    # separator-byte guard last, like the native emit loop (a conflict
-    # verdict wins over a sep-byte one on both paths)
-    if any(_has_sep_bytes(k) or _has_sep_bytes(v) for k, v in out.items()):
-        return {}, True
-    return out, False
+                return None, False, True
+            values = e.get("values")
+            if op in ("Exists", "DoesNotExist"):
+                if values:  # k8s validation: no values for these ops
+                    return None, False, True
+                reqs.append((key, op, ()))
+                continue
+            if not isinstance(values, list) or not values or not all(
+                isinstance(v, str) and not _has_sep_bytes(v) for v in values
+            ):
+                return None, False, True
+            reqs.append((key, op, tuple(sorted(set(values)))))
+    if not reqs:
+        return None, False, True  # empty selector: not modeled
+    selector = canon_selector(reqs)
+    return (namespaces, selector), selector_matches_nothing(selector), False
 
 
 def decode_anti_affinity(anti: dict, namespace: str = "default") -> tuple:
-    """(hostname matchLabels, zone matchLabels, unmodeled) for a
-    podAntiAffinity object — round-4 widened canonical shape, in exact
-    lockstep with native/ingest.cc ``extract_anti_affinity``:
-
-    up to TWO required terms, at most one per topology family
-    (hostname + zone — the common belt-and-suspenders Deployment pair),
-    each with the widened selector of ``_decode_term_selector``. Two
-    terms of the SAME family would need multiple selectors per family
-    and stay unmodeled; a term whose selector matches nothing
-    constrains nothing and is dropped exactly."""
+    """(hostname terms, zone terms, unmodeled) for a podAntiAffinity
+    object — round-5 widened canonical shape, in exact lockstep with
+    native/ingest.cc ``extract_anti_affinity``: ANY number of required
+    terms, each hostname or zone topology, each with the widened
+    ``_decode_term`` selector (full operator surface + cross-namespace
+    scopes). A term whose selector matches nothing constrains nothing
+    and is dropped exactly; any other topology key stays unmodeled."""
     req = anti.get("requiredDuringSchedulingIgnoredDuringExecution")
     if not req:
-        return {}, {}, False
-    if not isinstance(req, list) or len(req) > 2:
-        return {}, {}, True
-    host: dict = {}
-    zone: dict = {}
+        return (), (), False
+    if not isinstance(req, list):
+        return (), (), True
+    host: list = []
+    zone: list = []
     for term in req:
         if not isinstance(term, dict):
-            return {}, {}, True
+            return (), (), True
         topo = term.get("topologyKey")
         if topo == "kubernetes.io/hostname":
-            family = "host"
+            out = host
         elif topo == ZONE_TOPOLOGY_KEY:
-            family = "zone"
+            out = zone
         else:
-            return {}, {}, True
-        sel, unmodeled = _decode_term_selector(term, namespace)
+            return (), (), True
+        decoded, nothing, unmodeled = _decode_term(term, namespace)
         if unmodeled:
-            return {}, {}, True
-        if sel is _MATCHES_NOTHING:
+            return (), (), True
+        if nothing:
             continue  # constrains nothing — exact to drop
-        if family == "host":
-            if host:
-                return {}, {}, True  # two hostname terms: one slot only
-            host = sel
-        else:
-            if zone:
-                return {}, {}, True
-            zone = sel
-    return host, zone, False
+        out.append(decoded)
+    return tuple(sorted(set(host))), tuple(sorted(set(zone))), False
 
 
 def decode_pod_affinity(paff: dict, namespace: str = "default") -> tuple:
-    """(hostname matchLabels, zone matchLabels, unmodeled) for a
-    required POSITIVE podAffinity object — ONE term, hostname OR zone
-    topology, with the widened selector; at most one of the selectors
-    is non-empty. Hostname: the pod may only join a node already
-    hosting a match (masks.PodAffinityBit); zone (round 4): a ZONE
-    already hosting a match (masks.ZonePodAffinityBit). A
-    never-matching selector can never be satisfied: unmodeled
-    (= unplaceable, which is exact)."""
+    """(hostname terms, zone terms, unmodeled) for a required POSITIVE
+    podAffinity object — round 5: ANY number of required terms, each
+    hostname or zone topology, each with the widened selector; every
+    term must hold. Hostname: the pod may only join a node already
+    hosting a match (masks.PodAffinityBit); zone: a ZONE already
+    hosting a match (masks.ZonePodAffinityBit). A never-matching
+    selector is KEPT as a term: no resident can ever match it, so every
+    node refuses the carrier — exactly the scheduler's verdict for an
+    unsatisfiable positive requirement."""
     req = paff.get("requiredDuringSchedulingIgnoredDuringExecution")
     if not req:
-        return {}, {}, False
-    if not isinstance(req, list) or len(req) != 1:
-        return {}, {}, True
-    term = req[0]
-    if not isinstance(term, dict):
-        return {}, {}, True
-    topo = term.get("topologyKey")
-    if topo not in ("kubernetes.io/hostname", ZONE_TOPOLOGY_KEY):
-        return {}, {}, True
-    sel, unmodeled = _decode_term_selector(term, namespace)
-    if unmodeled or sel is _MATCHES_NOTHING:
-        return {}, {}, True
-    if topo == ZONE_TOPOLOGY_KEY:
-        return {}, sel, False
-    return sel, {}, False
+        return (), (), False
+    if not isinstance(req, list):
+        return (), (), True
+    host: list = []
+    zone: list = []
+    for term in req:
+        if not isinstance(term, dict):
+            return (), (), True
+        topo = term.get("topologyKey")
+        if topo == "kubernetes.io/hostname":
+            out = host
+        elif topo == ZONE_TOPOLOGY_KEY:
+            out = zone
+        else:
+            return (), (), True
+        decoded, _nothing, unmodeled = _decode_term(term, namespace)
+        if unmodeled:
+            return (), (), True
+        out.append(decoded)
+    return tuple(sorted(set(host))), tuple(sorted(set(zone))), False
 
 
 # Fields whose presence changes PodTopologySpread counting semantics in
@@ -424,13 +432,18 @@ def decode_topology_spread(spread) -> tuple:
     Modeled (in exact lockstep with native/ingest.cc): each HARD entry
     (whenUnsatisfiable absent or DoNotSchedule — the k8s default) with
     topologyKey hostname/zone, integer maxSkew >= 1, a non-empty
-    matchLabels-only labelSelector, and none of the counting-semantics
-    modifier fields (minDomains, matchLabelKeys, nodeAffinityPolicy,
-    nodeTaintsPolicy). Explicit ScheduleAnyway entries are soft —
-    advisory to the real scheduler — and dropped. Any hard entry beyond
-    the canonical shape marks the whole pod unmodeled (conservatively
-    unplaceable). Canonical form: (topology_key, max_skew, sorted
-    selector items), entry list sorted+deduped."""
+    selector in the round-5 widened operator form (matchLabels and/or
+    matchExpressions with In/NotIn/Exists/DoesNotExist; spread is
+    always own-namespace per the k8s API), and none of the
+    counting-semantics modifier fields (minDomains, matchLabelKeys,
+    nodeAffinityPolicy, nodeTaintsPolicy). Explicit ScheduleAnyway
+    entries are soft — advisory to the real scheduler — and dropped.
+    Any hard entry beyond the canonical shape marks the whole pod
+    unmodeled (conservatively unplaceable). Canonical form:
+    (topology_key, max_skew, selector requirements), entry list
+    sorted+deduped. A never-matching selector needs no special case:
+    its domain counts are all zero, so its verdict refuses nothing —
+    exactly the scheduler's behavior."""
     if not spread:
         return (), False
     if not isinstance(spread, list):
@@ -449,19 +462,12 @@ def decode_topology_spread(spread) -> tuple:
         skew = c.get("maxSkew")
         if not isinstance(skew, int) or isinstance(skew, bool) or skew < 1:
             return (), True
-        sel = c.get("labelSelector")
-        if not isinstance(sel, dict) or sel.get("matchExpressions"):
+        decoded, _nothing, unmodeled = _decode_term(
+            {"labelSelector": c.get("labelSelector")}, "default"
+        )
+        if unmodeled:
             return (), True
-        match = sel.get("matchLabels")
-        if not isinstance(match, dict) or not match:
-            return (), True
-        if any(
-            not isinstance(k, str) or not isinstance(v, str)
-            or _has_sep_bytes(k) or _has_sep_bytes(v)
-            for k, v in match.items()
-        ):
-            return (), True
-        out.append((topo, skew, tuple(sorted(match.items()))))
+        out.append((topo, skew, decoded[1]))
     return tuple(sorted(set(out))), False
 
 
@@ -593,6 +599,12 @@ class KubeClusterClient:
         self._ctx = ctx
         # one LIST of all pods per tick, partitioned client-side
         self._pods_cache: Optional[Dict[str, List[PodSpec]]] = None
+        # one LIST of all nodes per tick, split by readiness: the ready
+        # and unready views MUST come from one snapshot — two separate
+        # LISTs could miss a node flipping unready->ready between them,
+        # silently dropping its pods from spread/zone presence (the
+        # permissive direction; advisor r4)
+        self._nodes_cache: Optional[tuple] = None
         # native LIST decoding (io/native_ingest.py); the CLI clears this
         # when the configured resources exceed the native schema
         self.use_native_ingest = True
@@ -651,40 +663,47 @@ class KubeClusterClient:
     # --- read path ---
 
     def refresh(self) -> None:
-        """Invalidate the per-tick pod cache. The control loop's first
-        read each tick is ``list_unschedulable_pods`` (the safety gate),
-        which refreshes — so every tick sees one consistent pod LIST."""
+        """Invalidate the per-tick pod/node caches. The control loop's
+        first read each tick is ``list_unschedulable_pods`` (the safety
+        gate), which refreshes — so every tick sees one consistent pod
+        LIST and one consistent node LIST."""
         self._pods_cache = None
+        self._nodes_cache = None
+
+    def _all_nodes(self) -> tuple:
+        """(ready, unready) node views from ONE GET /api/v1/nodes per
+        tick — a single snapshot split by readiness, so a node flipping
+        between the two reads can never vanish from both views (and the
+        heaviest LIST is paid once, not twice)."""
+        if self._nodes_cache is None:
+            from k8s_spot_rescheduler_tpu.io import native_ingest
+
+            nodes = None
+            if self.use_native_ingest and native_ingest.available():
+                batch = native_ingest.parse_node_list(
+                    self._request_raw("GET", "/api/v1/nodes")
+                )
+                if batch is not None:
+                    nodes = batch.views()
+            if nodes is None:
+                items = self._request("GET", "/api/v1/nodes").get("items", [])
+                nodes = [decode_node(o) for o in items]
+            self._nodes_cache = (
+                [n for n in nodes if n.ready],
+                [n for n in nodes if not n.ready],
+            )
+        return self._nodes_cache
 
     def list_ready_nodes(self) -> List[NodeSpec]:
-        from k8s_spot_rescheduler_tpu.io import native_ingest
-
-        if self.use_native_ingest and native_ingest.available():
-            batch = native_ingest.parse_node_list(
-                self._request_raw("GET", "/api/v1/nodes")
-            )
-            if batch is not None:
-                return [n for n in batch.views() if n.ready]
-        items = self._request("GET", "/api/v1/nodes").get("items", [])
-        nodes = [decode_node(o) for o in items]
         # the reference's ReadyNodeLister surfaces only ready nodes
-        return [n for n in nodes if n.ready]
+        return list(self._all_nodes()[0])
 
     def list_unready_nodes(self) -> List[NodeSpec]:
         """Presence-only node view (NodeMap.unready): zone/spread counts
         must span not-ready nodes' pods (they still exist to the real
         scheduler; PodTopologySpread's default nodeTaintsPolicy=Ignore
         counts their domains)."""
-        from k8s_spot_rescheduler_tpu.io import native_ingest
-
-        if self.use_native_ingest and native_ingest.available():
-            batch = native_ingest.parse_node_list(
-                self._request_raw("GET", "/api/v1/nodes")
-            )
-            if batch is not None:
-                return [n for n in batch.views() if not n.ready]
-        items = self._request("GET", "/api/v1/nodes").get("items", [])
-        return [n for n in (decode_node(o) for o in items) if not n.ready]
+        return list(self._all_nodes()[1])
 
     def _all_pods(self) -> Dict[str, List[PodSpec]]:
         if self._pods_cache is None:

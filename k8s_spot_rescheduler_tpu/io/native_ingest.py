@@ -108,6 +108,9 @@ def _lib() -> Optional[ctypes.CDLL]:
             and lib.node_ncols_u8() == 3
             and lib.node_ncols_str() == 4
             and lib.table_count() == 12
+            # round-5 widened affinity/spread term blobs; an old .so
+            # with the matchLabels-kv format would be misparsed
+            and lib.blob_format_version() == 2
         )
     except AttributeError:
         ok = False
@@ -203,18 +206,74 @@ def _parse_kv(blob: bytes) -> Dict[str, str]:
 def _parse_spread(blob: bytes) -> Tuple:
     """Spread blob (ingest.cc extract_topology_spread) -> the exact
     canonical tuples io/kube.py ``decode_topology_spread`` produces:
-    (topology_key, max_skew, sorted selector items), entries
-    sorted+deduped. The engine emits source order; canonicalization
-    lives here (same contract as the node-affinity blob)."""
+    (topology_key, max_skew, selector requirements), entries
+    sorted+deduped. Round-5 format: requirements joined by TERM_SEP,
+    each ``key VAL_SEP op VAL_SEP v1 VAL_SEP v2 ...`` (no values for
+    Exists/DoesNotExist). The engine emits source order;
+    canonicalization lives here (same contract as the node-affinity
+    blob)."""
     if not blob:
         return ()
     out = []
     for rec in blob.decode().split(_REC):
-        topo, skew, pairs = rec.split(_UNIT)
-        items = tuple(
-            sorted(tuple(p.split(_VAL, 1)) for p in pairs.split(_TERM))
-        )
-        out.append((topo, int(skew), items))
+        topo, skew, reqs_field = rec.split(_UNIT)
+        reqs = []
+        for req in reqs_field.split(_TERM):
+            key, op, *values = req.split(_VAL)
+            if op in ("Exists", "DoesNotExist"):
+                vals: Tuple[str, ...] = ()
+            else:
+                vals = tuple(sorted(set(values)))
+            reqs.append((key, op, vals))
+        out.append((topo, int(skew), tuple(sorted(set(reqs)))))
+    return tuple(sorted(set(out)))
+
+
+@functools.lru_cache(maxsize=4096)
+def _parse_affinity_terms(blob: bytes) -> Tuple:
+    """Pod-affinity term blob (ingest.cc term_selector_blob) -> proto
+    terms ``((namespaces | None, selector), ...)`` in source order,
+    each selector canonicalized (sorted, deduped). ``None`` namespaces
+    mean the pod's own namespace — resolved per pod by
+    ``_resolve_terms`` (the blob is interned ACROSS pods of different
+    namespaces, so resolution cannot happen here). Format: terms joined
+    by TERM_SEP; term records joined by REC_SEP — record 0 is the
+    namespaces list joined by VAL_SEP (empty = own namespace), the rest
+    are ``key UNIT_SEP op UNIT_SEP values-joined-by-VAL_SEP``."""
+    if not blob:
+        return ()
+    out = []
+    for term_rec in blob.decode().split(_TERM):
+        recs = term_rec.split(_REC)
+        ns_rec = recs[0]
+        nss = tuple(sorted(set(ns_rec.split(_VAL)))) if ns_rec else None
+        reqs = []
+        for rec in recs[1:]:
+            key, op, values = rec.split(_UNIT)
+            if op in ("Exists", "DoesNotExist"):
+                vals: Tuple[str, ...] = ()
+            else:
+                vals = tuple(sorted(set(values.split(_VAL))))
+            reqs.append((key, op, vals))
+        out.append((nss, tuple(sorted(set(reqs)))))
+    return tuple(out)
+
+
+def _resolve_terms(proto: Tuple, ns: str, drop_nothing: bool) -> Tuple:
+    """Finalize proto terms for one pod namespace: own-namespace scopes
+    resolve to ``(ns,)``; anti-affinity families drop never-matching
+    selectors exactly (they constrain nothing — io/kube.py lockstep)
+    while positive families keep them (no resident can match -> the
+    carrier is exactly unplaceable)."""
+    from k8s_spot_rescheduler_tpu.predicates.selectors import (
+        selector_matches_nothing,
+    )
+
+    out = []
+    for nss, sel in proto:
+        if drop_nothing and selector_matches_nothing(sel):
+            continue
+        out.append((nss if nss is not None else (ns,), sel))
     return tuple(sorted(set(out)))
 
 
@@ -274,24 +333,42 @@ class PodBatch:
             self.label_blobs
         )
         self.selector_sets = [_parse_kv(b) for b in tables[TBL_NODESEL]]
-        self.match_sets = [_parse_kv(b) for b in tables[TBL_AAFF]]
-        self.paff_sets = [_parse_kv(b) for b in tables[TBL_PAFF]]
-        self.zaff_sets = [_parse_kv(b) for b in tables[TBL_ZAFF]]
+        # proto affinity terms (own-ns unresolved); resolved per
+        # (set_id, namespace) on demand below
+        self.match_protos = [_parse_affinity_terms(b) for b in tables[TBL_AAFF]]
+        self.paff_protos = [_parse_affinity_terms(b) for b in tables[TBL_PAFF]]
+        self.zaff_protos = [_parse_affinity_terms(b) for b in tables[TBL_ZAFF]]
+        self.pzaff_protos = [
+            _parse_affinity_terms(b) for b in tables[TBL_PZAFF]
+        ]
+        self._resolved: Dict[Tuple[int, int, str], Tuple] = {}
         self.pvc_lists = [
             tuple(b.decode().split(_REC)) if b else () for b in tables[TBL_PVC]
         ]
         self.naff_sets = [_parse_node_affinity(b) for b in tables[TBL_NAFF]]
         self.spread_sets = [_parse_spread(b) for b in tables[TBL_SPREAD]]
-        self.pzaff_sets = [_parse_kv(b) for b in tables[TBL_PZAFF]]
 
-    def match_set(self, set_id: int) -> Dict[str, str]:
-        return self.match_sets[set_id]
+    def _terms(self, family: int, protos, set_id: int, ns: str,
+               drop_nothing: bool) -> Tuple:
+        key = (family, set_id, ns)
+        cached = self._resolved.get(key)
+        if cached is None:
+            cached = self._resolved[key] = _resolve_terms(
+                protos[set_id], ns, drop_nothing
+            )
+        return cached
 
-    def paff_set(self, set_id: int) -> Dict[str, str]:
-        return self.paff_sets[set_id]
+    def match_terms(self, set_id: int, ns: str) -> Tuple:
+        return self._terms(0, self.match_protos, set_id, ns, True)
 
-    def zaff_set(self, set_id: int) -> Dict[str, str]:
-        return self.zaff_sets[set_id]
+    def zaff_terms(self, set_id: int, ns: str) -> Tuple:
+        return self._terms(1, self.zaff_protos, set_id, ns, True)
+
+    def paff_terms(self, set_id: int, ns: str) -> Tuple:
+        return self._terms(2, self.paff_protos, set_id, ns, False)
+
+    def pzaff_terms(self, set_id: int, ns: str) -> Tuple:
+        return self._terms(3, self.pzaff_protos, set_id, ns, False)
 
     def pvc_list(self, set_id: int) -> tuple:
         return self.pvc_lists[set_id]
@@ -428,16 +505,22 @@ class PodView:
         return ""  # the simplified group field is synthetic-only
 
     @property
-    def anti_affinity_match(self) -> Dict[str, str]:
-        return self._b.match_set(int(self._b.i32[self._i, P_AAFFID]))
+    def anti_affinity_match(self) -> Tuple:
+        return self._b.match_terms(
+            int(self._b.i32[self._i, P_AAFFID]), self.namespace
+        )
 
     @property
-    def pod_affinity_match(self) -> Dict[str, str]:
-        return self._b.paff_set(int(self._b.i32[self._i, P_PAFFID]))
+    def pod_affinity_match(self) -> Tuple:
+        return self._b.paff_terms(
+            int(self._b.i32[self._i, P_PAFFID]), self.namespace
+        )
 
     @property
-    def anti_affinity_zone_match(self) -> Dict[str, str]:
-        return self._b.zaff_set(int(self._b.i32[self._i, P_ZAFFID]))
+    def anti_affinity_zone_match(self) -> Tuple:
+        return self._b.zaff_terms(
+            int(self._b.i32[self._i, P_ZAFFID]), self.namespace
+        )
 
     @property
     def pvc_names(self) -> tuple:
@@ -460,8 +543,10 @@ class PodView:
         return self._b.spread_sets[int(self._b.i32[self._i, P_SPREADID])]
 
     @property
-    def pod_affinity_zone_match(self) -> Dict[str, str]:
-        return self._b.pzaff_sets[int(self._b.i32[self._i, P_PZAFFID])]
+    def pod_affinity_zone_match(self) -> Tuple:
+        return self._b.pzaff_terms(
+            int(self._b.i32[self._i, P_PZAFFID]), self.namespace
+        )
 
     @property
     def node_selector(self) -> Dict[str, str]:
@@ -508,12 +593,12 @@ class PodView:
             tolerations=list(self.tolerations),
             phase=self.phase,
             node_selector=dict(self.node_selector),
-            anti_affinity_match=dict(self.anti_affinity_match),
-            anti_affinity_zone_match=dict(self.anti_affinity_zone_match),
+            anti_affinity_match=self.anti_affinity_match,
+            anti_affinity_zone_match=self.anti_affinity_zone_match,
             pvc_names=self.pvc_names,
             pvc_resolvable=self.pvc_resolvable,
-            pod_affinity_match=dict(self.pod_affinity_match),
-            pod_affinity_zone_match=dict(self.pod_affinity_zone_match),
+            pod_affinity_match=self.pod_affinity_match,
+            pod_affinity_zone_match=self.pod_affinity_zone_match,
             node_affinity=self.node_affinity,
             spread_constraints=self.spread_constraints,
             unmodeled_constraints=self.unmodeled_constraints,

@@ -78,50 +78,51 @@ class PodSpec:
     # Simplified pod-anti-affinity: pods sharing a non-empty group refuse to
     # co-locate on one node (topologyKey=hostname requiredDuringScheduling).
     anti_affinity_group: str = ""
-    # The standard k8s spread pattern, modeled exactly: required
-    # podAntiAffinity with topologyKey=hostname and a matchLabels-
-    # equivalent selector (scoped to the pod's namespace; round 4 also
-    # folds single-value In matchExpressions, accepts an own-namespace
-    # ``namespaces`` list, and allows this term to pair with one zone
-    # term below). The pod refuses nodes hosting any pod matched by
-    # this selector, and — symmetrically, like the real scheduler —
-    # matched pods refuse nodes hosting this pod. Shapes beyond this
-    # (other operators, multi-value In, other topology keys, two terms
-    # of one family) fall back to ``unmodeled_constraints``.
-    anti_affinity_match: Dict[str, str] = dataclasses.field(default_factory=dict)
-    # Required anti-affinity with topologyKey=topology.kubernetes.io/zone
-    # (same canonical selector shape, own namespace): the pod refuses
-    # nodes in any ZONE hosting a matched pod, and — symmetrically —
-    # matched pods refuse zones hosting this pod. Zones come from the
-    # standard node label. Modeled statically per tick via zone-salted
-    # affinity-group bits (predicates/masks.zone_match_affinity_mask);
-    # when two zone-involved pods share one candidate lane the packers
+    # Required podAntiAffinity terms with topologyKey=hostname, in the
+    # round-5 canonical form (predicates/selectors.py): a tuple of
+    # ``(namespaces, selector)`` terms, any number of them, each
+    # selector the full LabelSelector operator surface (In / NotIn /
+    # Exists / DoesNotExist, multi-value In) and each namespaces tuple
+    # either the pod's own namespace (the implicit default) or an
+    # explicit cross-namespace list. The pod refuses nodes hosting any
+    # pod in a term's scope matched by its selector, and — symmetrically,
+    # like the real scheduler — matched pods refuse nodes hosting this
+    # pod. Construction accepts the matchLabels-dict shorthand (one
+    # own-namespace term); ``__post_init__`` canonicalizes. Shapes
+    # beyond this (namespaceSelector, other topology keys) fall back to
+    # ``unmodeled_constraints``.
+    anti_affinity_match: Tuple = ()
+    # Required anti-affinity terms with
+    # topologyKey=topology.kubernetes.io/zone (same canonical term
+    # shape): the pod refuses nodes in any ZONE hosting a matched pod,
+    # and — symmetrically — matched pods refuse zones hosting this pod.
+    # Zones come from the standard node label. Modeled statically per
+    # tick via zone-salted affinity-group bits
+    # (predicates/masks.zone_match_affinity_mask); when two
+    # zone-involved pods share one candidate lane the packers
     # conservatively mark them unplaceable (static bits cannot prove the
     # in-plan interaction safe). Legacy zone label keys and other
     # topology keys fall back to ``unmodeled_constraints``.
-    anti_affinity_zone_match: Dict[str, str] = dataclasses.field(
-        default_factory=dict
-    )
-    # Required POSITIVE pod-affinity, modeled in the same canonical shape
-    # (one required term, topologyKey=hostname, matchLabels selector,
-    # own namespace): the pod may only schedule onto a node already
-    # hosting a pod matched by this selector. The planner is conservative
-    # about the dynamics: only pods RESIDENT on a spot node before the
-    # plan count as matches (placements made by the plan itself could
-    # only create additional matches, so ignoring them can only lose a
-    # drain, never strand a pod). Shapes beyond this fall back to
-    # ``unmodeled_constraints``.
-    pod_affinity_match: Dict[str, str] = dataclasses.field(default_factory=dict)
-    # Required POSITIVE pod-affinity with ZONE topology (round 4): the
-    # pod may only schedule into a zone already hosting a match. Same
-    # canonical selector rules; per-carrier allowed-zone verdicts
+    anti_affinity_zone_match: Tuple = ()
+    # Required POSITIVE pod-affinity terms, topologyKey=hostname (same
+    # canonical term shape, any number of terms — every term must be
+    # satisfied): the pod may only schedule onto a node already hosting
+    # a pod matched by each selector in its scope. The planner is
+    # conservative about the dynamics: only pods RESIDENT on a spot node
+    # before the plan count as matches (placements made by the plan
+    # itself could only create additional matches, so ignoring them can
+    # only lose a drain, never strand a pod). A term whose selector can
+    # match no pod keeps the pod exactly unplaceable (no node can ever
+    # qualify — the scheduler's own verdict).
+    pod_affinity_match: Tuple = ()
+    # Required POSITIVE pod-affinity terms with ZONE topology: the pod
+    # may only schedule into a zone already hosting a match per term.
+    # Same canonical term rules; per-carrier allowed-zone verdicts
     # (masks.ZonePodAffinityBit) computed from pre-plan counted
     # residents, excluding matches on the carrier's own candidate node
-    # (they leave in the same drain). At most one positive term total —
-    # hostname OR zone.
-    pod_affinity_zone_match: Dict[str, str] = dataclasses.field(
-        default_factory=dict
-    )
+    # (they leave in the same drain). Hostname and zone positive terms
+    # may coexist in any number.
+    pod_affinity_zone_match: Tuple = ()
     phase: str = "Running"
     # spec.nodeSelector: the pod only schedules onto nodes carrying every
     # one of these labels (the kube-scheduler's NodeSelector predicate,
@@ -149,17 +150,21 @@ class PodSpec:
     pvc_resolvable: bool = False
     # Hard topologySpreadConstraints (whenUnsatisfiable=DoNotSchedule,
     # the k8s default), modeled in the canonical shape: topologyKey is
-    # hostname or the standard zone label, a non-empty matchLabels
-    # selector (own namespace), integer maxSkew >= 1, and none of the
-    # counting-semantics modifiers (minDomains, matchLabelKeys,
-    # nodeAffinityPolicy, nodeTaintsPolicy). Each entry is a canonical
-    # tuple (topology_key, max_skew, sorted selector items); any number
-    # of entries (the hostname+zone pair is the common Deployment
-    # shape). The packers turn each into a per-carrier SpreadBit
-    # pseudo-taint (predicates/masks.py) whose refused-domain set is
-    # computed from this tick's per-domain match counts; ScheduleAnyway
-    # entries are soft and ignored; shapes beyond the canonical form
-    # fall back to ``unmodeled_constraints``.
+    # hostname or the standard zone label, a non-empty selector in the
+    # round-5 widened operator form (matchLabels and/or matchExpressions
+    # with In/NotIn/Exists/DoesNotExist — always own-namespace, per the
+    # k8s API), integer maxSkew >= 1, and none of the counting-semantics
+    # modifiers (minDomains, matchLabelKeys, nodeAffinityPolicy,
+    # nodeTaintsPolicy). Each entry is a canonical tuple
+    # (topology_key, max_skew, selector requirements); any number of
+    # entries (the hostname+zone pair is the common Deployment shape).
+    # The packers turn each into a per-carrier SpreadBit pseudo-taint
+    # (predicates/masks.py) whose refused-domain set is computed from
+    # this tick's per-domain match counts; ScheduleAnyway entries are
+    # soft and ignored; shapes beyond the canonical form fall back to
+    # ``unmodeled_constraints``. Construction accepts legacy
+    # ((key, value), ...) selector items; ``__post_init__``
+    # canonicalizes.
     spread_constraints: Tuple = ()
     # Scheduling constraints this framework does not model (unresolved
     # volume topology, cross-namespace affinity, non-canonical spread
@@ -168,6 +173,29 @@ class PodSpec:
     # drainable — we may miss a drain the real scheduler would allow,
     # but never approve one that strands the pod.
     unmodeled_constraints: bool = False
+
+    def __post_init__(self) -> None:
+        # canonicalize the affinity/spread selector fields (the dict /
+        # legacy-items shorthands used by tests and synthetic generators
+        # become full canonical terms; decode output passes through)
+        from k8s_spot_rescheduler_tpu.predicates.selectors import (
+            canon_match_terms,
+            canon_spread_entries,
+        )
+
+        self.anti_affinity_match = canon_match_terms(
+            self.anti_affinity_match, self.namespace
+        )
+        self.anti_affinity_zone_match = canon_match_terms(
+            self.anti_affinity_zone_match, self.namespace
+        )
+        self.pod_affinity_match = canon_match_terms(
+            self.pod_affinity_match, self.namespace
+        )
+        self.pod_affinity_zone_match = canon_match_terms(
+            self.pod_affinity_zone_match, self.namespace
+        )
+        self.spread_constraints = canon_spread_entries(self.spread_constraints)
 
     @property
     def uid(self) -> str:

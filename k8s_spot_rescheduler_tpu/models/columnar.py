@@ -75,6 +75,10 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     zone_lane_guard,
     zone_match_affinity_mask,
 )
+from k8s_spot_rescheduler_tpu.predicates.selectors import (
+    selector_matches,
+    term_matches,
+)
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
 # pod flag bits
@@ -318,6 +322,8 @@ class ColumnarStore:
 
         # label index for PDB selection: (ns, key, value) -> live pod rows
         self._label_index: Dict[Tuple[str, str, str], Set[int]] = {}
+        # (ns, key) -> rows carrying the key at all (Exists requirements)
+        self._key_index: Dict[Tuple[str, str], Set[int]] = {}
         self._ns_index: Dict[str, Set[int]] = {}
 
         # pods whose node hasn't been observed yet (a watch can deliver a
@@ -499,28 +505,22 @@ class ColumnarStore:
                 flags |= _DAEMONSET
         self.p_flags[r] = flags
         # one interned id per distinct scheduling-constraint profile:
-        # (tolerations, nodeSelector, node-affinity, pod-affinity,
-        # spread constraints, unmodeled flag)
+        # (tolerations, nodeSelector, node-affinity, pod-affinity terms,
+        # spread constraints, zone-pod-affinity terms, unmodeled flag).
+        # The affinity fields are round-5 canonical terms that carry
+        # their namespace scope internally; spread stays ns-paired (the
+        # k8s API scopes spread to the pod's own namespace).
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
             pod.node_affinity,
-            (
-                (pod.namespace, tuple(sorted(pod.pod_affinity_match.items())))
-                if pod.pod_affinity_match
-                else ()
-            ),
+            pod.pod_affinity_match,
             (
                 (pod.namespace, tuple(pod.spread_constraints))
                 if getattr(pod, "spread_constraints", ())
                 else ()
             ),
-            (
-                (pod.namespace,
-                 tuple(sorted(pod.pod_affinity_zone_match.items())))
-                if pod.pod_affinity_zone_match
-                else ()
-            ),
+            pod.pod_affinity_zone_match,
             bool(pod.unmodeled_constraints),
         )
         tid = self._tol_keys.get(key)
@@ -529,13 +529,13 @@ class ColumnarStore:
             self._tol_lists.append(key)
             self._table_key = None  # force toleration matrix rebuild
         self.p_tol_id[r] = tid
-        # affinity profile: (group, ns, hostname selector, zone selector,
+        # affinity profile: (group, ns, hostname terms, zone terms,
         # labels) determines the pod's affinity mask for any universe
         akey = (
             pod.anti_affinity_group,
             pod.namespace,
-            tuple(sorted(pod.anti_affinity_match.items())),
-            tuple(sorted(pod.anti_affinity_zone_match.items())),
+            pod.anti_affinity_match,
+            pod.anti_affinity_zone_match,
             tuple(sorted(pod.labels.items())),
         )
         aid = self._aff_keys.get(akey)
@@ -554,10 +554,11 @@ class ColumnarStore:
             self._seq += 1
             self.p_seq[r] = self._seq
         self.p_live[r] = True
-        # PDB label index
+        # PDB / selector label index
         self._ns_index.setdefault(pod.namespace, set()).add(r)
         for k, v in pod.labels.items():
             self._label_index.setdefault((pod.namespace, k, v), set()).add(r)
+            self._key_index.setdefault((pod.namespace, k), set()).add(r)
 
     def remove_pod(self, uid: str) -> None:
         r = self._pod_row.pop(uid, None)
@@ -579,6 +580,9 @@ class ColumnarStore:
                 rows = self._label_index.get((pod.namespace, k, v))
                 if rows is not None:
                     rows.discard(r)
+                krows = self._key_index.get((pod.namespace, k))
+                if krows is not None:
+                    krows.discard(r)
 
     def bulk_add_pods(self, batch) -> bool:
         """Vectorized ingestion of a native ``PodBatch``
@@ -646,9 +650,9 @@ class ColumnarStore:
         unmod = (f & (ni.F_PVC | ni.F_REQAFF)) != 0
         paff_ids = batch.i32[keep, ni.P_PAFFID]
         paff_nonempty = np.fromiter(
-            (len(s) > 0 for s in batch.paff_sets),
+            (len(s) > 0 for s in batch.paff_protos),
             bool,
-            count=len(batch.paff_sets),
+            count=len(batch.paff_protos),
         )[paff_ids]
         spread_ids = batch.i32[keep, ni.P_SPREADID]
         spread_nonempty = np.fromiter(
@@ -658,9 +662,9 @@ class ColumnarStore:
         )[spread_ids]
         pzaff_ids = batch.i32[keep, ni.P_PZAFFID]
         pzaff_nonempty = np.fromiter(
-            (len(s) > 0 for s in batch.pzaff_sets),
+            (len(s) > 0 for s in batch.pzaff_protos),
             bool,
-            count=len(batch.pzaff_sets),
+            count=len(batch.pzaff_protos),
         )[pzaff_ids]
         # paff/pzaff and spread identities are namespace-scoped: the
         # namespace joins the combo only when any is non-empty (keeping
@@ -688,30 +692,17 @@ class ColumnarStore:
         for i, (
             tol_id, sel_id, naff_id, paff_id, spread_id, pzaff_id, ns_id, um
         ) in enumerate(uniq):
-            paff_set = batch.paff_set(int(paff_id))
+            # ns_id is -1 exactly when paff/spread/pzaff are all empty —
+            # then term resolution never reads the namespace
+            ns = batch.namespaces[int(ns_id)] if ns_id >= 0 else ""
             spread_set = batch.spread_sets[int(spread_id)]
-            pzaff_set = batch.pzaff_sets[int(pzaff_id)]
             key = (
                 tuple(batch.tol_sets[tol_id]),
                 tuple(sorted(batch.selector_set(int(sel_id)).items())),
                 batch.naff_sets[int(naff_id)],
-                (
-                    (batch.namespaces[int(ns_id)],
-                     tuple(sorted(paff_set.items())))
-                    if paff_set
-                    else ()
-                ),
-                (
-                    (batch.namespaces[int(ns_id)], tuple(spread_set))
-                    if spread_set
-                    else ()
-                ),
-                (
-                    (batch.namespaces[int(ns_id)],
-                     tuple(sorted(pzaff_set.items())))
-                    if pzaff_set
-                    else ()
-                ),
+                batch.paff_terms(int(paff_id), ns),
+                ((ns, tuple(spread_set)) if spread_set else ()),
+                batch.pzaff_terms(int(pzaff_id), ns),
                 bool(um),
             )
             tid = self._tol_keys.get(key)
@@ -721,8 +712,8 @@ class ColumnarStore:
                 self._table_key = None
             ids[i] = tid
         self.p_tol_id[:k] = ids[inverse]
-        # affinity-profile interning per distinct (ns, hostname selector,
-        # zone selector, labels)
+        # affinity-profile interning per distinct (ns, hostname terms,
+        # zone terms, labels)
         acombos = np.stack(
             [
                 batch.i32[keep, ni.P_NSID],
@@ -735,11 +726,12 @@ class ColumnarStore:
         auniq, ainv = np.unique(acombos, axis=0, return_inverse=True)
         aids = np.empty(len(auniq), np.int32)
         for i, (ns_id, aaff_id, zaff_id, l_id) in enumerate(auniq):
+            ns = batch.namespaces[ns_id]
             akey = (
                 "",  # kube pods carry no synthetic group
-                batch.namespaces[ns_id],
-                tuple(sorted(batch.match_set(int(aaff_id)).items())),
-                tuple(sorted(batch.zaff_set(int(zaff_id)).items())),
+                ns,
+                batch.match_terms(int(aaff_id), ns),
+                batch.zaff_terms(int(zaff_id), ns),
                 tuple(sorted(batch.label_set(int(l_id)).items())),
             )
             aid = self._aff_keys.get(akey)
@@ -775,6 +767,7 @@ class ColumnarStore:
             self._ns_index.setdefault(ns, set()).add(r)
             for key, v in batch.label_set(l_id).items():
                 self._label_index.setdefault((ns, key, v), set()).add(r)
+                self._key_index.setdefault((ns, key), set()).add(r)
 
         # pods on nodes the store hasn't seen yet park as orphans
         for i in np.nonzero((p_node < 0) & named)[0]:
@@ -820,6 +813,44 @@ class ColumnarStore:
                 self.n_ready[r] = obj.ready
                 self.n_unsched[r] = obj.unschedulable
 
+    def _selector_rows(self, ns: str, selector) -> Set[int]:
+        """Pod rows in namespace ``ns`` matched by a canonical
+        requirement selector (predicates/selectors.py; liveness
+        filtering is the caller's). Positive requirements (In / Exists)
+        narrow via the label/key indexes; any negative ones
+        (NotIn / DoesNotExist) filter the narrowed set per row — an
+        all-negative selector falls back to the namespace index."""
+        positive: List[Set[int]] = []
+        for key, op, values in selector:
+            if op == "In":
+                rows: Set[int] = set()
+                for v in values:
+                    rows |= self._label_index.get((ns, key, v), set())
+                positive.append(rows)
+            elif op == "Exists":
+                positive.append(self._key_index.get((ns, key), set()))
+        if positive:
+            cand = set.intersection(*sorted(positive, key=len))
+        else:
+            cand = set(self._ns_index.get(ns, set()))
+        if len(positive) == len(selector):
+            return cand
+        out: Set[int] = set()
+        for r in cand:
+            pod = self.pod_objs[r]
+            if pod is not None and selector_matches(selector, pod.labels):
+                out.add(r)
+        return out
+
+    def _term_rows(self, term) -> Set[int]:
+        """Rows matched by a full term — union of ``_selector_rows``
+        over the term's namespace scope."""
+        namespaces, selector = term
+        rows: Set[int] = set()
+        for ns in namespaces:
+            rows |= self._selector_rows(ns, selector)
+        return rows
+
     def _build_taint_table(
         self,
         spot_order: np.ndarray,
@@ -843,8 +874,7 @@ class ColumnarStore:
                 pairs.update(profile[1])
                 if profile[2]:
                     naffs.add(profile[2])
-                if profile[3]:
-                    paffs.add(profile[3])
+                paffs.update(profile[3])  # positive-affinity TERMS
         return intern_constraints(
             [self.node_objs[int(r)] for r in spot_order],
             sorted(pairs),
@@ -915,11 +945,7 @@ class ColumnarStore:
             if c is not None:
                 return c
             c = count_cache[key] = {}
-            sets = [self._label_index.get((ns, k, v), set()) for k, v in items]
-            rows = (
-                set.intersection(*sorted(sets, key=len)) if all(sets) else set()
-            )
-            for r in rows:
+            for r in self._selector_rows(ns, items):
                 if r >= hi or not visible[r]:
                     continue
                 nr = int(p_node[r])
@@ -964,11 +990,11 @@ class ColumnarStore:
         slot_rows: np.ndarray,
         p_node: np.ndarray,
         counted: np.ndarray,
-    ) -> Tuple[Dict[int, object], list]:
-        """Per-carrier-slot ZonePodAffinityBit + the sorted universe —
-        the columnar mirror of tensors._build_zone_paff_bits
-        (bit-identical: counted residents only, lane's own candidate
-        excluded)."""
+    ) -> Tuple[Dict[int, frozenset], list]:
+        """Per-carrier-slot frozenset of ZonePodAffinityBit (one bit per
+        carried TERM) + the sorted universe — the columnar mirror of
+        tensors._build_zone_paff_bits (bit-identical: counted residents
+        only, lane's own candidate excluded)."""
         if not len(slot_rows):
             return {}, []
         prof_has = np.fromiter(
@@ -982,18 +1008,13 @@ class ColumnarStore:
         hi = len(counted)
         hits_cache: Dict = {}
 
-        def zone_hits(ns, items):
-            key = (ns, items)
-            cached = hits_cache.get(key)
+        def zone_hits(term):
+            cached = hits_cache.get(term)
             if cached is not None:
                 return cached
-            sets = [self._label_index.get((ns, k, v), set()) for k, v in items]
-            rows = (
-                set.intersection(*sorted(sets, key=len)) if all(sets) else set()
-            )
             per_zone: Dict[str, int] = {}
             per_node: Dict[int, int] = {}
-            for r in rows:
+            for r in self._term_rows(term):
                 if r >= hi or not counted[r]:
                     continue
                 nr = int(p_node[r])
@@ -1004,31 +1025,32 @@ class ColumnarStore:
                 z = obj.labels.get(ZONE_LABEL) if obj else None
                 if z is not None:
                     per_zone[z] = per_zone.get(z, 0) + 1
-            cached = hits_cache[key] = (per_zone, per_node)
+            cached = hits_cache[term] = (per_zone, per_node)
             return cached
 
-        out: Dict[int, object] = {}
+        out: Dict[int, frozenset] = {}
         universe: set = set()
         for j in np.nonzero(hasz)[0]:
             r = int(slot_rows[j])
             pod = self.pod_objs[r]
-            items = tuple(sorted(pod.pod_affinity_zone_match.items()))
-            per_zone, per_node = zone_hits(pod.namespace, items)
             cand_row = int(p_node[r])
             obj = self.node_objs[cand_row]
             own_zone = obj.labels.get(ZONE_LABEL) if obj else None
-            own_hits = per_node.get(cand_row, 0)
-            allowed = tuple(sorted(
-                z for z, n in per_zone.items()
-                if n - (own_hits if z == own_zone else 0) > 0
-            ))
-            bit = ZonePodAffinityBit(
-                namespace=pod.namespace, items=items, allowed_zones=allowed
-            )
-            out[int(j)] = bit
-            universe.add(bit)
+            bits = []
+            for term in pod.pod_affinity_zone_match:
+                per_zone, per_node = zone_hits(term)
+                own_hits = per_node.get(cand_row, 0)
+                allowed = tuple(sorted(
+                    z for z, n in per_zone.items()
+                    if n - (own_hits if z == own_zone else 0) > 0
+                ))
+                bits.append(ZonePodAffinityBit(
+                    namespaces=term[0], items=term[1], allowed_zones=allowed
+                ))
+            out[int(j)] = frozenset(bits)
+            universe.update(bits)
         return out, sorted(
-            universe, key=lambda b: (b.namespace, b.items, b.allowed_zones)
+            universe, key=lambda b: (b.namespaces, b.items, b.allowed_zones)
         )
 
     def _refresh_sections(self, table: TaintTable) -> None:
@@ -1073,7 +1095,7 @@ class ColumnarStore:
                 for e in term
             )
         paffs = tuple(
-            (e.namespace, e.items)
+            (e.namespaces, e.items)
             for e in table.taints
             if isinstance(e, PodAffinityBit)
         )
@@ -1152,9 +1174,11 @@ class ColumnarStore:
                     )
                 ppos = self._paff_tol_pos.get(paff)
                 if ppos is None:
+                    # tolerate every positive-affinity bit except the
+                    # pod's OWN terms (all of which must hold)
                     ppos = self._paff_tol_pos[paff] = tuple(
                         paff_off + j for j, t in enumerate(paffs)
-                        if t != paff
+                        if t not in paff
                     )
                 unplace = () if unmodeled else (self._unplace_pos,)
                 rows[i] = self._mk_mask(
@@ -1182,10 +1206,8 @@ class ColumnarStore:
             m = np.zeros((len(self._aff_lists), len(paffs)), bool)
             for i, (_, ns, _, _, labels) in enumerate(self._aff_lists):
                 have = dict(labels)
-                for j, (pns, items) in enumerate(paffs):
-                    m[i, j] = ns == pns and all(
-                        have.get(k) == v for k, v in items
-                    )
+                for j, term in enumerate(paffs):
+                    m[i, j] = term_matches(term, ns, have)
             self._paff_match_matrix = m
         hosted = np.zeros((S_actual, len(paffs)), bool)
         if len(sp_rows):
@@ -1266,16 +1288,16 @@ class ColumnarStore:
         zids = np.unique(self.p_aff_id[zone_rows]) if len(zone_rows) else []
         universe = sorted(
             {
-                (self._aff_lists[int(i)][1], self._aff_lists[int(i)][2])
+                t
                 for i in ids
-                if self._aff_lists[int(i)][2]
+                for t in self._aff_lists[int(i)][2]
             }
         )
         zone_universe = sorted(
             {
-                (self._aff_lists[int(i)][1], self._aff_lists[int(i)][3])
+                t
                 for i in zids
-                if self._aff_lists[int(i)][3]
+                for t in self._aff_lists[int(i)][3]
             }
         )
         key = (tuple(universe), tuple(zone_universe), len(self._aff_lists))
@@ -1284,15 +1306,15 @@ class ColumnarStore:
             rows = np.zeros((len(self._aff_lists), AFFINITY_WORDS), np.uint32)
             hrows = np.zeros((len(self._aff_lists), AFFINITY_WORDS), np.uint32)
             zrows = np.zeros((len(self._aff_lists), AFFINITY_WORDS), np.uint32)
-            for i, (group, ns, match_items, zone_items, labels) in enumerate(
+            for i, (group, ns, match_terms, zone_terms, labels) in enumerate(
                 self._aff_lists
             ):
                 lbl = dict(labels)
-                m = match_affinity_mask(ns, match_items, lbl, universe)
+                m = match_affinity_mask(match_terms, ns, lbl, universe)
                 if group:
                     w, b = affinity_bits(group)
                     m[w] |= np.uint32(1 << b)
-                z = zone_match_affinity_mask(ns, zone_items, lbl, zone_universe)
+                z = zone_match_affinity_mask(zone_terms, ns, lbl, zone_universe)
                 hrows[i] = m
                 zrows[i] = z
                 rows[i] = m | z  # pod side (slot_aff)
@@ -1616,18 +1638,20 @@ class ColumnarStore:
                         packed.slot_tol[int(c), int(k), uw] &= ~ub
             if slot_zpaff_bits:
                 # zone-positive-affinity carriers lose tolerance of
-                # their own context bits (per slot, lane-dependent)
+                # their own context bits (per slot, lane-dependent; one
+                # bit per carried term — every term must hold)
                 zpaff_pos = {
                     e: i
                     for i, e in enumerate(table.taints)
                     if isinstance(e, ZonePodAffinityBit)
                 }
-                for j, bit in slot_zpaff_bits.items():
+                for j, bits in slot_zpaff_bits.items():
                     c, k = int(slot_cand[j]), int(slot_idx[j])
-                    pos = zpaff_pos[bit]
-                    packed.slot_tol[c, k, pos // 32] &= ~np.uint32(
-                        1 << (pos % 32)
-                    )
+                    for bit in bits:
+                        pos = zpaff_pos[bit]
+                        packed.slot_tol[c, k, pos // 32] &= ~np.uint32(
+                            1 << (pos % 32)
+                        )
         if C_actual:
             packed.cand_valid[:C_actual] = cand_ok & (n_evict > 0)
 

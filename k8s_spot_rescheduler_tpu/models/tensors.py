@@ -53,7 +53,6 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     match_affinity_mask,
     node_affinity_universe,
     node_constraint_mask,
-    pod_affinity_key,
     pod_affinity_mask,
     pod_affinity_universe,
     selector_universe,
@@ -63,6 +62,10 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     collect_zone_universe,
     zone_lane_guard,
     zone_match_affinity_mask,
+)
+from k8s_spot_rescheduler_tpu.predicates.selectors import (
+    selector_matches,
+    term_matches,
 )
 
 # Scale divisor per resource so packed values stay < 2**24 (float32-exact).
@@ -228,8 +231,8 @@ def _build_spread_bits(node_map, candidates, cand_pods) -> Dict:
                 if d is None:
                     continue
                 for p in info.pods:
-                    if p.namespace == ns and all(
-                        p.labels.get(k) == v for k, v in items
+                    if p.namespace == ns and selector_matches(
+                        items, p.labels
                     ):
                         c[d] = c.get(d, 0) + 1
         return c
@@ -260,9 +263,10 @@ def _build_spread_bits(node_map, candidates, cand_pods) -> Dict:
 
 
 def _build_zone_paff_bits(candidates, spot, cand_pods) -> Dict:
-    """(lane, slot) -> ZonePodAffinityBit for zone-positive-affinity
-    carriers (masks.ZonePodAffinityBit). Allowed zones = zones of
-    COUNTED residents (both classes, post priority filter) matching the
+    """(lane, slot) -> frozenset of ZonePodAffinityBit for
+    zone-positive-affinity carriers (one bit per carried TERM — every
+    term must hold). Allowed zones = zones of COUNTED residents (both
+    classes, post priority filter) in the term's scope matching its
     selector, EXCLUDING residents of the lane's own candidate node —
     those leave in the same drain, and a zone satisfied only by them
     would strand the carrier at reschedule time. In-plan placements
@@ -277,9 +281,8 @@ def _build_zone_paff_bits(candidates, spot, cand_pods) -> Dict:
     infos = list(candidates) + list(spot)
     hits_cache: Dict = {}
 
-    def zone_hits(ns, items):
-        key = (ns, items)
-        cached = hits_cache.get(key)
+    def zone_hits(term):
+        cached = hits_cache.get(term)
         if cached is not None:
             return cached
         per_zone: Dict[str, int] = {}
@@ -289,13 +292,12 @@ def _build_zone_paff_bits(candidates, spot, cand_pods) -> Dict:
             n = sum(
                 1
                 for q in info.pods
-                if q.namespace == ns
-                and all(q.labels.get(k) == v for k, v in items)
+                if term_matches(term, q.namespace, q.labels)
             )
             per_info[idx] = n
             if zone is not None and n:
                 per_zone[zone] = per_zone.get(zone, 0) + n
-        cached = hits_cache[key] = (per_zone, per_info)
+        cached = hits_cache[term] = (per_zone, per_info)
         return cached
 
     out: Dict = {}
@@ -303,17 +305,19 @@ def _build_zone_paff_bits(candidates, spot, cand_pods) -> Dict:
         for k, p in enumerate(pods):
             if not p.pod_affinity_zone_match:
                 continue
-            items = tuple(sorted(p.pod_affinity_zone_match.items()))
-            per_zone, per_info = zone_hits(p.namespace, items)
-            own_zone = info.node.labels.get(ZONE_LABEL)
-            own_hits = per_info.get(c, 0)
-            allowed = tuple(sorted(
-                z for z, n in per_zone.items()
-                if n - (own_hits if z == own_zone else 0) > 0
-            ))
-            out[(c, k)] = ZonePodAffinityBit(
-                namespace=p.namespace, items=items, allowed_zones=allowed
-            )
+            bits = []
+            for term in p.pod_affinity_zone_match:
+                per_zone, per_info = zone_hits(term)
+                own_zone = info.node.labels.get(ZONE_LABEL)
+                own_hits = per_info.get(c, 0)
+                allowed = tuple(sorted(
+                    z for z, n in per_zone.items()
+                    if n - (own_hits if z == own_zone else 0) > 0
+                ))
+                bits.append(ZonePodAffinityBit(
+                    namespaces=term[0], items=term[1], allowed_zones=allowed
+                ))
+            out[(c, k)] = frozenset(bits)
     return out
 
 
@@ -360,10 +364,10 @@ def pack_cluster(
     )
     zone_paff_by = _build_zone_paff_bits(
         candidates, spot, cand_pods
-    )  # (lane, slot) -> ZonePodAffinityBit
+    )  # (lane, slot) -> frozenset(ZonePodAffinityBit)
     zone_paff_universe = sorted(
-        set(zone_paff_by.values()),
-        key=lambda b: (b.namespace, b.items, b.allowed_zones),
+        {b for bits in zone_paff_by.values() for b in bits},
+        key=lambda b: (b.namespaces, b.items, b.allowed_zones),
     )
     table = intern_constraints(
         [n.node for n in spot],
@@ -447,19 +451,18 @@ def pack_cluster(
     def tol_row(
         pod: PodSpec,
         sbits: frozenset = frozenset(),
-        zpbit=None,
+        zpbits: frozenset = frozenset(),
     ):
-        paff = pod_affinity_key(pod)
-        # sbits/zpbit join the key: a carrier's verdict depends on its
+        # sbits/zpbits join the key: a carrier's verdict depends on its
         # LANE's node, so identical pods on different candidates may
         # carry different context bits
         key = (
             tuple(pod.tolerations),
             tuple(sorted(pod.node_selector.items())),
             pod.node_affinity,
-            paff,
+            pod.pod_affinity_match,
             sbits,
-            zpbit,
+            zpbits,
             pod.unmodeled_constraints,
         )
         row = tol_cache.get(key)
@@ -468,9 +471,9 @@ def pack_cluster(
                 pod.tolerations, pod.node_selector,
                 pod.unmodeled_constraints, table,
                 node_affinity=pod.node_affinity,
-                pod_affinity=paff,
+                pod_affinity=pod.pod_affinity_match,
                 spread_bits=sbits,
-                zone_paff_bit=zpbit,
+                zone_paff_bits=zpbits,
             )
         return row
 
@@ -480,13 +483,14 @@ def pack_cluster(
         """Zone-family bits only (aggregated zone-wide on the node side)."""
         key = (
             pod.namespace,
-            tuple(sorted(pod.anti_affinity_zone_match.items())),
+            pod.anti_affinity_zone_match,
             tuple(sorted(pod.labels.items())),
         )
         row = zone_cache.get(key)
         if row is None:
             row = zone_cache[key] = zone_match_affinity_mask(
-                pod.namespace, key[1], pod.labels, zone_universe
+                pod.anti_affinity_zone_match, pod.namespace, pod.labels,
+                zone_universe,
             )
         return row
 
@@ -500,13 +504,14 @@ def pack_cluster(
         key = (
             pod.anti_affinity_group,
             pod.namespace,
-            tuple(sorted(pod.anti_affinity_match.items())),
+            pod.anti_affinity_match,
             tuple(sorted(pod.labels.items())),
         )
         row = host_cache.get(key)
         if row is None:
             row = host_cache[key] = pod_affinity_mask(pod) | match_affinity_mask(
-                pod.namespace, key[2], pod.labels, match_universe
+                pod.anti_affinity_match, pod.namespace, pod.labels,
+                match_universe,
             )
         return row
 
@@ -515,8 +520,8 @@ def pack_cluster(
         key = (
             pod.anti_affinity_group,
             pod.namespace,
-            tuple(sorted(pod.anti_affinity_match.items())),
-            tuple(sorted(pod.anti_affinity_zone_match.items())),
+            pod.anti_affinity_match,
+            pod.anti_affinity_zone_match,
             tuple(sorted(pod.labels.items())),
         )
         row = aff_cache.get(key)
@@ -557,7 +562,7 @@ def pack_cluster(
                 tol_row(
                     p,
                     spread_bits_by.get((c, k), frozenset()),
-                    zone_paff_by.get((c, k)),
+                    zone_paff_by.get((c, k), frozenset()),
                 )
                 for k, p in enumerate(pods)
             ]

@@ -404,6 +404,8 @@ int64_t as_int(const Val* v) {
 
 constexpr char UNIT_SEP = '\x1f';
 constexpr char REC_SEP = '\x1e';
+constexpr char TERM_SEP = '\x1d';
+constexpr char VAL_SEP = '\x1c';
 
 // Interned-string tables: repeated values (node names, namespaces,
 // toleration sets, label sets, nodeSelector sets, anti-affinity
@@ -504,94 +506,149 @@ bool py_truthy(const Val* v) {
   return false;
 }
 
-// --- widened pod-affinity term selector (round 4) ------------------------
+// --- widened pod-affinity term selectors (round 5) -----------------------
 //
-// Exact lockstep with io/kube.py _decode_term_selector: namespaces may
-// name only the pod's own namespace; namespaceSelector presence stays
-// unmodeled; matchExpressions fold into the selector when every entry
-// is a single-value In; a key required to equal two different values
-// makes the selector match nothing.
+// Exact lockstep with io/kube.py _decode_term: explicit (cross-
+// namespace) `namespaces` lists are modeled; namespaceSelector presence
+// stays unmodeled; matchLabels pairs and matchExpressions with
+// In / NotIn / Exists / DoesNotExist (multi-value In/NotIn) all emit as
+// requirement records. The blob carries source order and own-namespace
+// scopes unresolved; canonicalization (sorting, dedup, own-ns
+// resolution, matches-nothing drops) happens on the Python side
+// (io/native_ingest.py _parse_affinity_terms / _resolve_terms), so no
+// cross-language sort contract is needed.
 
-enum SelVerdict { SEL_OK = 0, SEL_NOTHING = 1, SEL_UNMODELED = 2 };
+enum SelVerdict { SEL_OK = 0, SEL_UNMODELED = 2 };
 
 bool has_sep_bytes(std::string_view s);  // defined with the naff blobs
 
-int term_selector_blob(const Val* term, std::string_view ns,
-                       std::string* blob) {
-  blob->clear();
-  const Val* ns_list = term->get("namespaces");
-  if (py_truthy(ns_list)) {
-    if (ns_list->kind != Val::Arr) return SEL_UNMODELED;
-    for (const Val* x : ns_list->arr) {
-      if (!x || x->kind != Val::Str || x->text != ns) return SEL_UNMODELED;
-    }
-  }
-  if (term->get("namespaceSelector") != nullptr) return SEL_UNMODELED;
-  const Val* sel = term->get("labelSelector");
+// Emit one labelSelector's requirements into *out: requirements joined
+// by req_sep, fields key/op/values joined by field_sep, values joined
+// by val_sep. matchLabels entries become single-value In requirements
+// (duplicate keys keep the LAST value — Python dict semantics);
+// matchExpressions validate exactly like io/kube.py (In/NotIn need a
+// non-empty string list; Exists/DoesNotExist must carry no values).
+int selector_reqs_blob(const Val* sel, char req_sep, char field_sep,
+                       char val_sep, std::string* out) {
   if (!sel || sel->kind != Val::Obj) return SEL_UNMODELED;
-  // selector pairs: matchLabels entries then folded In-expressions;
-  // Python folds into a dict, so a later duplicate key with the SAME
-  // value is harmless (the parse-side dict dedups) and a DIFFERENT
-  // value means matches-nothing
+  std::string reqs;
+  bool any = false;
   std::vector<std::pair<std::string_view, std::string_view>> pairs;
   const Val* ml = sel->get("matchLabels");
   if (ml) {
     if (ml->kind != Val::Obj) return SEL_UNMODELED;
     for (const auto& m : ml->obj) {
       if (!m.second || m.second->kind != Val::Str) return SEL_UNMODELED;
-      pairs.emplace_back(m.first, m.second->text);
+      if (has_sep_bytes(m.first) || has_sep_bytes(m.second->text))
+        return SEL_UNMODELED;
+      bool dup = false;
+      for (auto& p : pairs) {
+        if (p.first == m.first) {
+          p.second = m.second->text;  // JSON duplicate key: last wins
+          dup = true;
+        }
+      }
+      if (!dup) pairs.emplace_back(m.first, m.second->text);
     }
+  }
+  for (const auto& p : pairs) {
+    if (any) reqs += req_sep;
+    any = true;
+    reqs.append(p.first.data(), p.first.size());
+    reqs += field_sep;
+    reqs += "In";
+    reqs += field_sep;
+    reqs.append(p.second.data(), p.second.size());
   }
   const Val* me = sel->get("matchExpressions");
   if (py_truthy(me)) {
     if (me->kind != Val::Arr) return SEL_UNMODELED;
     for (const Val* e : me->arr) {
       if (!e || e->kind != Val::Obj) return SEL_UNMODELED;
-      const Val* op = e->get("operator");
-      if (!op || op->kind != Val::Str || op->text != "In")
-        return SEL_UNMODELED;
       const Val* key = e->get("key");
-      const Val* values = e->get("values");
-      if (!key || key->kind != Val::Str || !values ||
-          values->kind != Val::Arr || values->arr.size() != 1)
+      const Val* op = e->get("operator");
+      if (!key || key->kind != Val::Str || has_sep_bytes(key->text) ||
+          !op || op->kind != Val::Str)
         return SEL_UNMODELED;
-      const Val* v = values->arr[0];
-      if (!v || v->kind != Val::Str) return SEL_UNMODELED;
-      bool conflict = false, dup = false;
-      for (const auto& p : pairs) {
-        if (p.first == key->text) {
-          if (p.second != v->text) conflict = true;
-          dup = true;
+      bool exists_like =
+          op->text == "Exists" || op->text == "DoesNotExist";
+      bool in_like = op->text == "In" || op->text == "NotIn";
+      if (!exists_like && !in_like) return SEL_UNMODELED;
+      const Val* values = e->get("values");
+      if (exists_like) {
+        // k8s validation: Exists/DoesNotExist carry no values
+        if (py_truthy(values)) return SEL_UNMODELED;
+      } else {
+        if (!values || values->kind != Val::Arr || values->arr.empty())
+          return SEL_UNMODELED;
+        for (const Val* v : values->arr) {
+          if (!v || v->kind != Val::Str || has_sep_bytes(v->text))
+            return SEL_UNMODELED;
         }
       }
-      if (conflict) return SEL_NOTHING;
-      if (!dup) pairs.emplace_back(key->text, v->text);
+      if (any) reqs += req_sep;
+      any = true;
+      reqs.append(key->text.data(), key->text.size());
+      reqs += field_sep;
+      reqs.append(op->text.data(), op->text.size());
+      reqs += field_sep;
+      if (!exists_like) {
+        for (size_t vi = 0; vi < values->arr.size(); ++vi) {
+          if (vi) reqs += val_sep;
+          const auto& t = values->arr[vi]->text;
+          reqs.append(t.data(), t.size());
+        }
+      }
     }
   }
-  if (pairs.empty()) return SEL_UNMODELED;
-  for (const auto& p : pairs) {
-    if (has_sep_bytes(p.first) || has_sep_bytes(p.second))
-      return SEL_UNMODELED;
-    blob->append(p.first.data(), p.first.size());
-    *blob += UNIT_SEP;
-    blob->append(p.second.data(), p.second.size());
-    *blob += REC_SEP;
-  }
+  if (!any) return SEL_UNMODELED;  // empty selector: not modeled
+  *out += reqs;
   return SEL_OK;
 }
 
-// podAntiAffinity: up to TWO required terms, at most one per topology
-// family (hostname + zone); a matches-nothing term is dropped exactly.
-// Lockstep: io/kube.py decode_anti_affinity.
-void extract_anti_affinity(const Val* block, std::string_view ns,
-                           std::string* host_blob, std::string* zone_blob,
-                           bool* unmodeled) {
+// One affinity term -> `ns_record REC_SEP requirement records`, the
+// round-5 term encoding (io/native_ingest.py _parse_affinity_terms).
+// The ns record is the explicit namespaces list joined by VAL_SEP, or
+// empty for own-namespace scope.
+int term_selector_blob(const Val* term, std::string* blob) {
+  blob->clear();
+  std::string ns_rec;
+  const Val* ns_list = term->get("namespaces");
+  if (py_truthy(ns_list)) {
+    if (ns_list->kind != Val::Arr) return SEL_UNMODELED;
+    bool first = true;
+    for (const Val* x : ns_list->arr) {
+      if (!x || x->kind != Val::Str || x->text.empty() ||
+          has_sep_bytes(x->text))
+        return SEL_UNMODELED;
+      if (!first) ns_rec += VAL_SEP;
+      first = false;
+      ns_rec.append(x->text.data(), x->text.size());
+    }
+  }
+  if (term->get("namespaceSelector") != nullptr) return SEL_UNMODELED;
+  std::string reqs;
+  int verdict = selector_reqs_blob(term->get("labelSelector"), REC_SEP,
+                                   UNIT_SEP, VAL_SEP, &reqs);
+  if (verdict != SEL_OK) return verdict;
+  *blob = ns_rec;
+  *blob += REC_SEP;
+  *blob += reqs;
+  return SEL_OK;
+}
+
+// podAntiAffinity: ANY number of required terms, hostname or zone
+// topology, widened selectors. Never-matching terms are dropped on the
+// Python parse side (io/native_ingest.py), in lockstep with io/kube.py
+// decode_anti_affinity.
+void extract_anti_affinity(const Val* block, std::string* host_blob,
+                           std::string* zone_blob, bool* unmodeled) {
   host_blob->clear();
   zone_blob->clear();
   if (!block || block->kind != Val::Obj) return;
   const Val* req = block->get("requiredDuringSchedulingIgnoredDuringExecution");
   if (!req || !py_truthy(req)) return;
-  if (req->kind != Val::Arr || req->arr.size() > 2) {
+  if (req->kind != Val::Arr) {
     *unmodeled = true;
     return;
   }
@@ -617,63 +674,64 @@ void extract_anti_affinity(const Val* block, std::string_view ns,
       return;
     }
     std::string blob;
-    int verdict = term_selector_blob(term, ns, &blob);
-    if (verdict == SEL_UNMODELED) {
+    if (term_selector_blob(term, &blob) != SEL_OK) {
       *unmodeled = true;
       host_blob->clear();
       zone_blob->clear();
       return;
     }
-    if (verdict == SEL_NOTHING) continue;
     std::string* slot = zone ? zone_blob : host_blob;
-    if (!slot->empty()) {
-      *unmodeled = true;  // two terms of one family: one slot only
-      host_blob->clear();
-      zone_blob->clear();
-      return;
-    }
-    *slot = blob;
+    if (!slot->empty()) *slot += TERM_SEP;
+    *slot += blob;
   }
 }
 
-// required POSITIVE podAffinity: ONE term, hostname OR zone topology,
-// widened selector; a matches-nothing selector can never be satisfied
-// -> unmodeled. Lockstep: io/kube.py decode_pod_affinity.
-void extract_pod_affinity(const Val* block, std::string_view ns,
-                          std::string* host_blob, std::string* zone_blob,
-                          bool* unmodeled) {
+// required POSITIVE podAffinity: ANY number of required terms, hostname
+// or zone topology, widened selectors; every term must hold.
+// Never-matching selectors are KEPT (the carrier is exactly
+// unplaceable). Lockstep: io/kube.py decode_pod_affinity.
+void extract_pod_affinity(const Val* block, std::string* host_blob,
+                          std::string* zone_blob, bool* unmodeled) {
   host_blob->clear();
   zone_blob->clear();
   if (!block || block->kind != Val::Obj) return;
   const Val* req = block->get("requiredDuringSchedulingIgnoredDuringExecution");
   if (!req || !py_truthy(req)) return;
-  if (req->kind != Val::Arr || req->arr.size() != 1) {
+  if (req->kind != Val::Arr) {
     *unmodeled = true;
     return;
   }
-  const Val* term = req->arr[0];
-  if (!term || term->kind != Val::Obj) {
-    *unmodeled = true;
-    return;
-  }
-  const Val* topo = term->get("topologyKey");
-  bool zone;
-  if (topo && topo->kind == Val::Str &&
-      topo->text == "kubernetes.io/hostname") {
-    zone = false;
-  } else if (topo && topo->kind == Val::Str &&
-             topo->text == "topology.kubernetes.io/zone") {
-    zone = true;
-  } else {
-    *unmodeled = true;
-    return;
-  }
-  std::string* slot = zone ? zone_blob : host_blob;
-  int verdict = term_selector_blob(term, ns, slot);
-  if (verdict != SEL_OK) {
-    host_blob->clear();
-    zone_blob->clear();
-    *unmodeled = true;
+  for (const Val* term : req->arr) {
+    if (!term || term->kind != Val::Obj) {
+      *unmodeled = true;
+      host_blob->clear();
+      zone_blob->clear();
+      return;
+    }
+    const Val* topo = term->get("topologyKey");
+    bool zone;
+    if (topo && topo->kind == Val::Str &&
+        topo->text == "kubernetes.io/hostname") {
+      zone = false;
+    } else if (topo && topo->kind == Val::Str &&
+               topo->text == "topology.kubernetes.io/zone") {
+      zone = true;
+    } else {
+      *unmodeled = true;
+      host_blob->clear();
+      zone_blob->clear();
+      return;
+    }
+    std::string blob;
+    if (term_selector_blob(term, &blob) != SEL_OK) {
+      *unmodeled = true;
+      host_blob->clear();
+      zone_blob->clear();
+      return;
+    }
+    std::string* slot = zone ? zone_blob : host_blob;
+    if (!slot->empty()) *slot += TERM_SEP;
+    *slot += blob;
   }
 }
 
@@ -682,11 +740,9 @@ void extract_pod_affinity(const Val* block, std::string_view ns,
 // the terms in source order — canonicalization (sorting, dedup) happens
 // once on the Python side when the blob is parsed, so no cross-language
 // sort-order contract is needed. Encoding (k8s label keys/values are
-// control-char-free): terms '\x1d', exprs within a term '\x1e' (REC_SEP),
-// expr fields key/op/values '\x1f' (UNIT_SEP), values '\x1c'. Empty blob
-// = no modeled requirement.
-constexpr char TERM_SEP = '\x1d';
-constexpr char VAL_SEP = '\x1c';
+// control-char-free): terms '\x1d' (TERM_SEP), exprs within a term
+// '\x1e' (REC_SEP), expr fields key/op/values '\x1f' (UNIT_SEP),
+// values '\x1c' (VAL_SEP). Empty blob = no modeled requirement.
 
 static const char* const kNaffOps[] = {"In",     "NotIn", "Exists",
                                        "DoesNotExist", "Gt", "Lt"};
@@ -705,12 +761,13 @@ bool has_sep_bytes(std::string_view s) {
 // Hard topologySpreadConstraints, in exact lockstep with io/kube.py
 // decode_topology_spread: each hard entry (whenUnsatisfiable absent or
 // anything but the literal "ScheduleAnyway") must have topologyKey
-// hostname/zone, an integer maxSkew >= 1, a non-empty matchLabels-only
-// labelSelector, and none of the counting-modifier fields — else the
-// whole pod is unmodeled. Soft entries are dropped. Blob: entries
-// joined by REC_SEP; entry = topo UNIT_SEP skew UNIT_SEP pairs, pairs
-// joined by TERM_SEP, pair = key VAL_SEP value. Source order; the
-// Python side canonicalizes (sort + dedup) on parse.
+// hostname/zone, an integer maxSkew >= 1, a non-empty widened selector
+// (matchLabels and/or matchExpressions with the four label operators —
+// round 5), and none of the counting-modifier fields — else the whole
+// pod is unmodeled. Soft entries are dropped. Blob: entries joined by
+// REC_SEP; entry = topo UNIT_SEP skew UNIT_SEP reqs, reqs joined by
+// TERM_SEP, req = key VAL_SEP op VAL_SEP values (VAL_SEP-joined).
+// Source order; the Python side canonicalizes (sort + dedup) on parse.
 static const char* const kSpreadModifierKeys[] = {
     "minDomains", "matchLabelKeys", "nodeAffinityPolicy",
     "nodeTaintsPolicy"};
@@ -762,34 +819,21 @@ void extract_topology_spread(const Val* spread, bool* unmodeled,
       *unmodeled = true;
       return;
     }
-    const Val* sel = c->get("labelSelector");
-    if (!sel || sel->kind != Val::Obj || py_truthy(sel->get("matchExpressions"))) {
+    // round-5 widened selector: requirements joined by TERM_SEP, each
+    // `key VAL_SEP op VAL_SEP v1 VAL_SEP v2 ...` (spread is always
+    // own-namespace; no ns record needed)
+    std::string reqs;
+    if (selector_reqs_blob(c->get("labelSelector"), TERM_SEP, VAL_SEP,
+                           VAL_SEP, &reqs) != SEL_OK) {
       *unmodeled = true;
       return;
-    }
-    const Val* ml = sel->get("matchLabels");
-    if (!ml || ml->kind != Val::Obj || ml->obj.empty()) {
-      *unmodeled = true;
-      return;
-    }
-    std::string pairs;
-    for (const auto& kv : ml->obj) {
-      if (!kv.second || kv.second->kind != Val::Str ||
-          has_sep_bytes(kv.first) || has_sep_bytes(kv.second->text)) {
-        *unmodeled = true;
-        return;
-      }
-      if (!pairs.empty()) pairs += TERM_SEP;
-      pairs.append(kv.first.data(), kv.first.size());
-      pairs += VAL_SEP;
-      pairs.append(kv.second->text.data(), kv.second->text.size());
     }
     if (!out.empty()) out += REC_SEP;
     out.append(topo->text.data(), topo->text.size());
     out += UNIT_SEP;
     out.append(skew->text.data(), skew->text.size());
     out += UNIT_SEP;
-    out += pairs;
+    out += reqs;
   }
   *blob = out;
 }
@@ -1081,10 +1125,10 @@ Batch* ingest_pods_impl(const char* buf, long n) {
       const Val* aff_obj =
           (affinity && affinity->kind == Val::Obj) ? affinity : nullptr;
       extract_anti_affinity(
-          aff_obj ? aff_obj->get("podAntiAffinity") : nullptr, pod_ns,
+          aff_obj ? aff_obj->get("podAntiAffinity") : nullptr,
           &anti_host_blob, &anti_zone_blob, &unmodeled);
       extract_pod_affinity(
-          aff_obj ? aff_obj->get("podAffinity") : nullptr, pod_ns,
+          aff_obj ? aff_obj->get("podAffinity") : nullptr,
           &paff_blob, &pzaff_blob, &unmodeled);
       extract_node_affinity(
           aff_obj ? aff_obj->get("nodeAffinity") : nullptr,
@@ -1333,5 +1377,9 @@ int node_ncols_i64() { return N_NI64; }
 int node_ncols_u8() { return N_NU8; }
 int node_ncols_str() { return NS_NSTR; }
 int table_count() { return TBL_COUNT; }
+// Interned-blob encoding version: 2 = round-5 widened affinity/spread
+// term format. A stale .so is refused by io/native_ingest.py's ABI
+// handshake (Python falls back to its own decoders).
+int blob_format_version() { return 2; }
 
 }  // extern "C"

@@ -39,6 +39,11 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
     Taint,
     TO_BE_DELETED_TAINT,
 )
+from k8s_spot_rescheduler_tpu.predicates.selectors import (
+    selector_matches,
+    term_key,
+    term_matches,
+)
 
 HARD_EFFECTS = ("NoSchedule", "NoExecute")
 
@@ -137,11 +142,13 @@ class NodeAffinityBit:
 @dataclasses.dataclass(frozen=True)
 class PodAffinityBit:
     """Pseudo-taint for one distinct required POSITIVE pod-affinity
-    selector (namespace-scoped hostname matchLabels — the canonical
-    shape io/kube.decode_pod_affinity models). Set on every spot node
-    that does NOT currently host a pod matched by the selector; only
-    pods carrying exactly this requirement fail to tolerate it — the
-    inverted-taint encoding of "may only join a node with a match".
+    TERM (round-5 canonical shape, predicates/selectors.py: a
+    namespaces scope + a full-operator selector; hostname topology).
+    Set on every spot node that does NOT currently host a pod in the
+    term's scope matched by its selector; only pods carrying this term
+    fail to tolerate it — the inverted-taint encoding of "may only join
+    a node with a match". A pod with several positive terms simply
+    fails to tolerate several bits (every term must hold).
 
     Unlike every other pseudo-taint, the node side depends on the pods
     RESIDENT on the node this tick, not on node properties — so it is
@@ -151,17 +158,18 @@ class PodAffinityBit:
     counting pre-plan residents only can lose a drain but never approve
     a stranding one."""
 
-    namespace: str
-    items: Tuple  # sorted (key, value) pairs of the matchLabels selector
+    namespaces: Tuple  # sorted namespace scope of the term
+    items: Tuple  # canonical selector requirements (key, op, values)
 
 
 @dataclasses.dataclass(frozen=True)
 class ZonePodAffinityBit:
-    """Pseudo-taint for one required POSITIVE pod-affinity with ZONE
-    topology, per CARRIER CONTEXT: the sorted zones hosting a
+    """Pseudo-taint for one required POSITIVE pod-affinity TERM with
+    ZONE topology, per CARRIER CONTEXT: the sorted zones hosting a
     qualifying match this tick. Set on every spot node that lacks the
     zone label or whose zone is not in ``allowed_zones``; only the
-    carrier fails to tolerate it.
+    carrier fails to tolerate it. A carrier with several zone terms
+    carries several context bits (every term must hold).
 
     Conservative in two deliberate ways: matches are counted from
     pre-plan COUNTED residents only (in-plan placements could only add
@@ -171,8 +179,8 @@ class ZonePodAffinityBit:
     them would strand the carrier at reschedule time (the packers pass
     the exclusion; same per-carrier-context pattern as SpreadBit)."""
 
-    namespace: str
-    items: Tuple  # sorted matchLabels items
+    namespaces: Tuple  # sorted namespace scope of the term
+    items: Tuple  # canonical selector requirements
     allowed_zones: Tuple  # sorted zone values hosting a qualifying match
 
 
@@ -217,29 +225,21 @@ def node_affinity_universe(pods: Sequence[PodSpec]) -> List[Tuple]:
     return sorted({p.node_affinity for p in pods if p.node_affinity})
 
 
-def pod_affinity_key(pod: PodSpec) -> Tuple:
-    """(namespace, sorted selector items) — the PodAffinityBit identity
-    for a pod's required positive affinity; () when it has none."""
-    if not pod.pod_affinity_match:
-        return ()
-    return (pod.namespace, tuple(sorted(pod.pod_affinity_match.items())))
-
-
 def pod_affinity_universe(pods: Sequence[PodSpec]) -> List[Tuple]:
-    """Sorted distinct (namespace, selector items) across the pods'
-    required positive affinities — the PodAffinityBit universe both
-    packers must share."""
-    return sorted({pod_affinity_key(p) for p in pods} - {()})
+    """Sorted distinct positive-affinity TERMS across the pods — the
+    PodAffinityBit universe both packers must share. A pod's own terms
+    live directly in ``pod.pod_affinity_match`` (round-5 canonical
+    form)."""
+    return sorted({t for p in pods for t in p.pod_affinity_match})
 
 
 def hosts_affinity_match(
-    residents: Sequence[PodSpec], namespace: str, items: Tuple
+    residents: Sequence[PodSpec], namespaces: Tuple, items: Tuple
 ) -> bool:
-    """Does any resident pod satisfy the (namespace, matchLabels)
-    selector? The node-side evaluation of PodAffinityBit."""
+    """Does any resident pod fall in the term's namespace scope and
+    match its selector? The node-side evaluation of PodAffinityBit."""
     return any(
-        p.namespace == namespace
-        and all(p.labels.get(k) == v for k, v in items)
+        term_matches((namespaces, items), p.namespace, p.labels)
         for p in residents
     )
 
@@ -334,7 +334,9 @@ def node_constraint_mask(
             if not match_node_affinity(entry.terms, node.labels, node.name):
                 mask[i // 32] |= np.uint32(1 << (i % 32))
         elif isinstance(entry, PodAffinityBit):
-            if not hosts_affinity_match(residents, entry.namespace, entry.items):
+            if not hosts_affinity_match(
+                residents, entry.namespaces, entry.items
+            ):
                 mask[i // 32] |= np.uint32(1 << (i % 32))
         elif isinstance(entry, SpreadBit):
             domain = node.labels.get(entry.topology_key)
@@ -357,15 +359,16 @@ def constraint_mask(
     node_affinity: Tuple = (),
     pod_affinity: Tuple = (),
     spread_bits: frozenset = frozenset(),
-    zone_paff_bit=None,
+    zone_paff_bits: frozenset = frozenset(),
 ) -> np.ndarray:
     """Pod-side bits: tolerated real taints + selector pairs the pod does
     NOT require + affinity requirements that are not the pod's own + the
     unplaceable bit unless the pod carries unmodeled constraints.
-    ``pod_affinity`` is the pod's own PodAffinityBit identity
-    (``pod_affinity_key``), or (); ``spread_bits`` the pod's own
-    SpreadBit contexts and ``zone_paff_bit`` its own
-    ZonePodAffinityBit context (every other pod tolerates them)."""
+    ``pod_affinity`` is the pod's own tuple of positive-affinity TERMS
+    (``pod.pod_affinity_match``; every term must hold, so the pod fails
+    to tolerate each of its terms' bits); ``spread_bits`` the pod's own
+    SpreadBit contexts and ``zone_paff_bits`` its own ZonePodAffinityBit
+    contexts (every other pod tolerates them)."""
     mask = np.zeros(table.words, dtype=np.uint32)
     for i, entry in enumerate(table.taints):
         if isinstance(entry, Taint):
@@ -375,11 +378,11 @@ def constraint_mask(
         elif isinstance(entry, NodeAffinityBit):
             ok = entry.terms != node_affinity
         elif isinstance(entry, PodAffinityBit):
-            ok = (entry.namespace, entry.items) != pod_affinity
+            ok = (entry.namespaces, entry.items) not in pod_affinity
         elif isinstance(entry, SpreadBit):
             ok = entry not in spread_bits
         elif isinstance(entry, ZonePodAffinityBit):
-            ok = entry != zone_paff_bit
+            ok = entry not in zone_paff_bits
         else:  # UnplaceableBit
             ok = not unmodeled
         if ok:
@@ -440,53 +443,49 @@ def node_affinity_mask(pods: Sequence[PodSpec]) -> np.ndarray:
 
 # --- selector-based hostname anti-affinity (the k8s spread pattern) ------
 #
-# A pod with ``anti_affinity_match`` S refuses nodes hosting pods matched
-# by S, and matched pods symmetrically refuse nodes hosting it (what the
-# real scheduler enforces for existing pods' required anti-affinity).
-# Encoding: hash each distinct (namespace, selector) to a bit; a pod's
-# affinity mask is its own selector's bit (requirement) OR'd with the bit
-# of every universe selector that MATCHES the pod (presence). Since the
+# A pod carrying anti-affinity TERMS refuses nodes hosting pods matched
+# by any term (within the term's namespace scope), and matched pods
+# symmetrically refuse nodes hosting it (what the real scheduler
+# enforces for existing pods' required anti-affinity). Encoding: hash
+# each distinct term (namespaces + canonical selector) to a bit; a pod's
+# affinity mask is its own terms' bits (requirements) OR'd with the bit
+# of every universe term that MATCHES the pod (presence). Since the
 # same mask is both the fit check and the placement contribution, any
 # requirement/presence overlap between two pods forbids co-location —
 # exactly the scheduler's symmetric check, over-restricting only in one
-# corner (two plain pods both merely *matched* by some third selector),
-# which is the safe direction: collisions can only lose a drain, never
-# strand a pod.
+# corner (two plain pods both merely *matched* by some third selector,
+# or two carriers of one term neither of which matches it), which is
+# the safe direction: collisions can only lose a drain, never strand a
+# pod.
 
 
-def match_selector_key(namespace: str, items: Tuple[Tuple[str, str], ...]) -> str:
-    return namespace + "\x1d" + "\x1e".join(
-        f"{k}\x1f{v}" for k, v in items
-    )
+def match_selector_key(term: Tuple) -> str:
+    """Deterministic hash key for a hostname-family term."""
+    return term_key(term)
 
 
-def collect_match_universe(pods) -> List[Tuple[str, Tuple[Tuple[str, str], ...]]]:
-    """Sorted distinct (namespace, selector items) across the pods —
+def collect_match_universe(pods) -> List[Tuple]:
+    """Sorted distinct hostname anti-affinity terms across the pods —
     deterministic, shared by both packers."""
-    return sorted(
-        {
-            (p.namespace, tuple(sorted(p.anti_affinity_match.items())))
-            for p in pods
-            if p.anti_affinity_match
-        }
-    )
+    return sorted({t for p in pods for t in p.anti_affinity_match})
 
 
 def match_affinity_mask(
+    own_terms: Tuple,
     namespace: str,
-    match_items: Tuple[Tuple[str, str], ...],
     labels,
-    universe: Sequence[Tuple[str, Tuple[Tuple[str, str], ...]]],
+    universe: Sequence[Tuple],
 ) -> np.ndarray:
-    """Requirement bit (own selector) | presence bits (universe selectors
-    matching this pod's labels, namespace-scoped)."""
+    """Requirement bits (own terms) | presence bits (universe terms
+    whose scope covers ``namespace`` and whose selector matches
+    ``labels``)."""
     mask = np.zeros(AFFINITY_WORDS, dtype=np.uint32)
-    if match_items:
-        w, b = affinity_bits(match_selector_key(namespace, match_items))
+    for term in own_terms:
+        w, b = affinity_bits(match_selector_key(term))
         mask[w] |= np.uint32(1 << b)
-    for ns, items in universe:
-        if ns == namespace and all(labels.get(k) == v for k, v in items):
-            w, b = affinity_bits(match_selector_key(ns, items))
+    for term in universe:
+        if term_matches(term, namespace, labels):
+            w, b = affinity_bits(match_selector_key(term))
             mask[w] |= np.uint32(1 << b)
     return mask
 
@@ -547,38 +546,35 @@ def merge_affinity_terms(*term_sets: Tuple):
 ZONE_LABEL = "topology.kubernetes.io/zone"
 
 
-def zone_selector_key(namespace: str, items: Tuple[Tuple[str, str], ...]) -> str:
-    return "zone\x1c" + match_selector_key(namespace, items)
+def zone_selector_key(term: Tuple) -> str:
+    """Hash key for a zone-family term. The \\x1d prefix keeps the zone
+    keyspace disjoint from hostname keys (a term_key always starts with
+    a namespace name, never a separator byte)."""
+    return "\x1dzone" + term_key(term)
 
 
-def collect_zone_universe(pods) -> List[Tuple[str, Tuple[Tuple[str, str], ...]]]:
-    """Sorted distinct (namespace, selector items) across the pods' zone
-    anti-affinities — deterministic, shared by both packers."""
-    return sorted(
-        {
-            (p.namespace, tuple(sorted(p.anti_affinity_zone_match.items())))
-            for p in pods
-            if p.anti_affinity_zone_match
-        }
-    )
+def collect_zone_universe(pods) -> List[Tuple]:
+    """Sorted distinct zone anti-affinity terms across the pods —
+    deterministic, shared by both packers."""
+    return sorted({t for p in pods for t in p.anti_affinity_zone_match})
 
 
 def zone_match_affinity_mask(
+    own_terms: Tuple,
     namespace: str,
-    zone_items: Tuple[Tuple[str, str], ...],
     labels,
-    universe: Sequence[Tuple[str, Tuple[Tuple[str, str], ...]]],
+    universe: Sequence[Tuple],
 ) -> np.ndarray:
-    """Requirement bit (own zone selector) | presence bits (universe zone
-    selectors matching this pod's labels, namespace-scoped) — the
-    zone-family analog of ``match_affinity_mask``."""
+    """Requirement bits (own zone terms) | presence bits (universe zone
+    terms matching this pod) — the zone-family analog of
+    ``match_affinity_mask``."""
     mask = np.zeros(AFFINITY_WORDS, dtype=np.uint32)
-    if zone_items:
-        w, b = affinity_bits(zone_selector_key(namespace, zone_items))
+    for term in own_terms:
+        w, b = affinity_bits(zone_selector_key(term))
         mask[w] |= np.uint32(1 << b)
-    for ns, items in universe:
-        if ns == namespace and all(labels.get(k) == v for k, v in items):
-            w, b = affinity_bits(zone_selector_key(ns, items))
+    for term in universe:
+        if term_matches(term, namespace, labels):
+            w, b = affinity_bits(zone_selector_key(term))
             mask[w] |= np.uint32(1 << b)
     return mask
 
@@ -586,25 +582,22 @@ def zone_match_affinity_mask(
 def zone_lane_guard(pods: Sequence[PodSpec]) -> set:
     """Slot indices (within one candidate lane) to mark unplaceable.
 
-    For each zone identity CARRIED by a lane pod: if two or more lane
-    pods are involved with it (carry it, or are matched by its
-    selector), their in-plan placements could collide zone-wide in ways
-    the static zone bits cannot see — mark every involved pod, which
-    conservatively fails the lane. A single involved pod per identity is
-    fully covered by the static bits. Shared by both packers so the
+    For each zone TERM carried by a lane pod: if two or more lane pods
+    are involved with it (carry it, or are in its scope and matched by
+    its selector), their in-plan placements could collide zone-wide in
+    ways the static zone bits cannot see — mark every involved pod,
+    which conservatively fails the lane. A single involved pod per term
+    is fully covered by the static bits. Shared by both packers so the
     decision is bit-identical."""
     carried: dict = {}
     for i, p in enumerate(pods):
-        if p.anti_affinity_zone_match:
-            key = (p.namespace, tuple(sorted(p.anti_affinity_zone_match.items())))
-            carried.setdefault(key, set()).add(i)
+        for term in p.anti_affinity_zone_match:
+            carried.setdefault(term, set()).add(i)
     out: set = set()
-    for (ns, items), involved in carried.items():
+    for term, involved in carried.items():
         involved = set(involved)
         for i, p in enumerate(pods):
-            if p.namespace == ns and all(
-                p.labels.get(k) == v for k, v in items
-            ):
+            if term_matches(term, p.namespace, p.labels):
                 involved.add(i)
         if len(involved) >= 2:
             out |= involved
@@ -648,8 +641,10 @@ def zone_lane_guard(pods: Sequence[PodSpec]) -> set:
 
 def spread_self_match(pod: PodSpec, items: Tuple) -> bool:
     """Does the carrier match its own selector (Deployment spread does)?
-    Only then does its move shift the counts its verdict depends on."""
-    return all(pod.labels.get(k) == v for k, v in items)
+    Only then does its move shift the counts its verdict depends on.
+    ``items`` is a canonical requirement selector (round 5 widened to
+    the full operator surface)."""
+    return selector_matches(items, pod.labels)
 
 
 def compute_spread_bit(
@@ -704,9 +699,7 @@ def spread_lane_guard(pods: Sequence[PodSpec]) -> set:
     for (ns, items), involved in carried.items():
         involved = set(involved)
         for i, p in enumerate(pods):
-            if p.namespace == ns and all(
-                p.labels.get(k) == v for k, v in items
-            ):
+            if p.namespace == ns and selector_matches(items, p.labels):
                 involved.add(i)
         if len(involved) >= 2:
             out |= involved

@@ -19,6 +19,14 @@ ON_DEMAND_LABEL = "kubernetes.io/role=worker"
 SPOT_LABEL = "kubernetes.io/role=spot-worker"
 
 
+def own_terms(match: dict, ns: str = "default"):
+    """The round-5 canonical term tuple for one own-namespace
+    matchLabels selector — what decode emits for the classic shape."""
+    from k8s_spot_rescheduler_tpu.predicates.selectors import canon_labels
+
+    return (((ns,), canon_labels(match)),)
+
+
 def make_pod(
     name: str,
     cpu_millis: int,

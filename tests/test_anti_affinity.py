@@ -31,6 +31,7 @@ from tests.fixtures import (
     SPOT_LABELS,
     make_node,
     make_pod,
+    own_terms,
 )
 
 
@@ -189,11 +190,11 @@ def test_native_decode_of_anti_affinity_shapes():
         # zone topology -> unmodeled
         anti([{"topologyKey": "topology.kubernetes.io/zone",
                "labelSelector": {"matchLabels": {"app": "db"}}}]),
-        # matchExpressions -> unmodeled
+        # matchExpressions -> modeled
         anti([{"topologyKey": "kubernetes.io/hostname",
                "labelSelector": {"matchExpressions": [
                    {"key": "app", "operator": "In", "values": ["db"]}]}}]),
-        # two terms -> unmodeled
+        # two hostname terms -> modeled (round 5: multi-term)
         anti([{"topologyKey": "kubernetes.io/hostname",
                "labelSelector": {"matchLabels": {"app": "a"}}},
               {"topologyKey": "kubernetes.io/hostname",
@@ -201,7 +202,7 @@ def test_native_decode_of_anti_affinity_shapes():
         # empty selector -> unmodeled
         anti([{"topologyKey": "kubernetes.io/hostname",
                "labelSelector": {"matchLabels": {}}}]),
-        # cross-namespace -> unmodeled
+        # cross-namespace -> modeled (round 5: explicit ns lists)
         anti([{"topologyKey": "kubernetes.io/hostname",
                "namespaces": ["other"],
                "labelSelector": {"matchLabels": {"app": "db"}}}]),
@@ -252,19 +253,19 @@ def test_native_decode_of_anti_affinity_shapes():
                    "matchExpressions": [
                        {"key": "app", "operator": "In",
                         "values": ["web"]}]}}]),
-        # two terms of ONE family still unmodeled (one slot per family)
+        # two terms of ONE family -> modeled (round 5: multi-term)
         anti([{"topologyKey": "kubernetes.io/hostname",
                "labelSelector": {"matchLabels": {"a": "1"}}},
               {"topologyKey": "kubernetes.io/hostname",
                "labelSelector": {"matchLabels": {"b": "2"}}}]),
-        # three terms -> unmodeled
+        # three terms -> modeled (round 5)
         anti([{"topologyKey": "kubernetes.io/hostname",
                "labelSelector": {"matchLabels": {"a": "1"}}},
               {"topologyKey": "topology.kubernetes.io/zone",
                "labelSelector": {"matchLabels": {"b": "2"}}},
               {"topologyKey": "topology.kubernetes.io/zone",
                "labelSelector": {"matchLabels": {"c": "3"}}}]),
-        # multi-value In stays unmodeled
+        # multi-value In -> modeled (round 5)
         anti([{"topologyKey": "kubernetes.io/hostname",
                "labelSelector": {"matchExpressions": [
                    {"key": "app", "operator": "In",
@@ -305,37 +306,61 @@ def test_native_decode_of_anti_affinity_shapes():
             got.anti_affinity_zone_match == want.anti_affinity_zone_match
         ), i
         assert got.unmodeled_constraints == want.unmodeled_constraints, i
-    assert batch.view(0).anti_affinity_match == {"app": "db"}
+    DB = own_terms({"app": "db"})
+    assert batch.view(0).anti_affinity_match == DB
     assert not batch.view(0).unmodeled_constraints
+    # round-5 widened: single-value In expression ≡ matchLabels
+    assert batch.view(2).anti_affinity_match == DB
+    # round-5 widened: two hostname terms both modeled
+    assert batch.view(3).anti_affinity_match == own_terms(
+        {"app": "a"}
+    ) + own_terms({"app": "b"})
+    # round-5 widened: explicit cross-namespace scope
+    assert batch.view(5).anti_affinity_match == (
+        (("other",), (("app", "In", ("db",)),)),
+    )
+    assert not batch.view(5).unmodeled_constraints
     assert batch.view(6).unmodeled_constraints  # namespaceSelector {}
     assert batch.view(7).unmodeled_constraints  # namespaceSelector set
     assert batch.view(8).unmodeled_constraints  # non-array required
     assert not batch.view(9).unmodeled_constraints  # falsy required
     assert batch.view(10).unmodeled_constraints  # [null] element
     assert batch.view(11).unmodeled_constraints  # ["x"] element
-    assert batch.view(12).unmodeled_constraints  # namespaces: "other"
+    assert batch.view(12).unmodeled_constraints  # namespaces: "other" str
     assert not batch.view(13).unmodeled_constraints  # preferred only
-    # round-4 widened shapes
     pair = batch.view(14)  # hostname + zone pair: both families
-    assert pair.anti_affinity_match == {"app": "db"}
-    assert pair.anti_affinity_zone_match == {"app": "db"}
+    assert pair.anti_affinity_match == DB
+    assert pair.anti_affinity_zone_match == DB
     assert not pair.unmodeled_constraints
-    fold = batch.view(15)  # expressions folded
-    assert fold.anti_affinity_match == {"tier": "be", "app": "db"}
+    fold = batch.view(15)  # matchLabels + expression in one selector
+    assert fold.anti_affinity_match == (
+        (("default",), (("app", "In", ("db",)), ("tier", "In", ("be",)))),
+    )
     assert not fold.unmodeled_constraints
     ownns = batch.view(16)
-    assert ownns.anti_affinity_match == {"app": "db"}
+    assert ownns.anti_affinity_match == DB
     assert not ownns.unmodeled_constraints
     nothing = batch.view(17)  # conflicting key: dropped, no constraint
-    assert nothing.anti_affinity_match == {}
+    assert nothing.anti_affinity_match == ()
     assert not nothing.unmodeled_constraints
-    assert batch.view(18).unmodeled_constraints  # 2x hostname terms
-    assert batch.view(19).unmodeled_constraints  # three terms
-    assert batch.view(20).unmodeled_constraints  # multi-value In
+    # round-5 widened: multi-term single family, three terms, multi-In
+    assert batch.view(18).anti_affinity_match == own_terms(
+        {"a": "1"}
+    ) + own_terms({"b": "2"})
+    assert not batch.view(18).unmodeled_constraints
+    assert batch.view(19).anti_affinity_match == own_terms({"a": "1"})
+    assert batch.view(19).anti_affinity_zone_match == own_terms(
+        {"b": "2"}
+    ) + own_terms({"c": "3"})
+    assert not batch.view(19).unmodeled_constraints
+    assert batch.view(20).anti_affinity_match == (
+        (("default",), (("app", "In", ("cache", "db")),)),
+    )
+    assert not batch.view(20).unmodeled_constraints
     assert batch.view(21).unmodeled_constraints  # non-str value + conflict
     for i in (22, 23):  # valid term + unmodeled term: nothing leaks
         assert batch.view(i).unmodeled_constraints, i
-        assert batch.view(i).anti_affinity_match == {}, i
+        assert batch.view(i).anti_affinity_match == (), i
 
 
 def test_null_namespace_own_ns_list_lockstep():
@@ -369,8 +394,12 @@ def test_null_namespace_own_ns_list_lockstep():
         assert got.namespace == want.namespace, i
         assert got.anti_affinity_match == want.anti_affinity_match, i
         assert got.unmodeled_constraints == want.unmodeled_constraints, i
-    # null/""/default namespaces: modeled; "other": the list names a
-    # foreign namespace -> unmodeled
+    # null/""/default namespaces normalize to the same own-ns scope;
+    # a pod in "other" naming ["default"] is a cross-namespace term
+    # (round 5: modeled) with the SAME identity as the own-ns form
     for i in (0, 1, 2):
-        assert batch.view(i).anti_affinity_match == {"app": "db"}, i
-    assert batch.view(3).unmodeled_constraints
+        assert batch.view(i).anti_affinity_match == own_terms(
+            {"app": "db"}
+        ), i
+    assert batch.view(3).anti_affinity_match == own_terms({"app": "db"})
+    assert not batch.view(3).unmodeled_constraints

@@ -64,6 +64,7 @@ class StubApiserver:
         self.evictions = []
         self.events = []
         self.auths = []
+        self.gets = []  # GET paths, for request-count regressions
 
         stub = self
 
@@ -82,6 +83,7 @@ class StubApiserver:
             def do_GET(self):
                 stub.auths.append(self.headers.get("Authorization", ""))
                 path = self.path.split("?")[0]
+                stub.gets.append(path)
                 if path == "/api/v1/nodes":
                     return self._send({"items": list(stub.nodes.values())})
                 if path == "/api/v1/pods":
@@ -229,8 +231,31 @@ def test_token_file_rotation(stub, tmp_path):
     client = KubeClusterClient(stub.url, token_file=str(tok))
     client.list_ready_nodes()
     tok.write_text("second")
+    client.refresh()  # next tick: the node LIST is cached per tick
     client.list_ready_nodes()
     assert stub.auths[-2:] == ["Bearer first", "Bearer second"]
+
+
+def test_single_node_list_per_tick(stub):
+    """Regression (advisor r4): the ready and unready node views must
+    come from ONE GET /api/v1/nodes snapshot per tick — two separate
+    LISTs could miss a node flipping unready->ready between them, and
+    the heaviest LIST would be paid twice on the 5k-node hot path."""
+    stub.nodes["od-1"] = _node("od-1", "worker")
+    dead = _node("dead-1", "spot-worker")
+    dead["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
+    stub.nodes["dead-1"] = dead
+    client = KubeClusterClient(stub.url)
+    before = len([g for g in stub.gets if g == "/api/v1/nodes"])
+    ready = [n.name for n in client.list_ready_nodes()]
+    unready = [n.name for n in client.list_unready_nodes()]
+    after = len([g for g in stub.gets if g == "/api/v1/nodes"])
+    assert ready == ["od-1"] and unready == ["dead-1"]
+    assert after - before == 1
+    # the next tick re-fetches
+    client.refresh()
+    client.list_ready_nodes()
+    assert len([g for g in stub.gets if g == "/api/v1/nodes"]) == after + 1
 
 
 def test_taint_patch_uses_merge_patch(stub):

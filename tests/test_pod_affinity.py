@@ -21,6 +21,7 @@ from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from tests.fixtures import (
+    own_terms,
     pack_fake,
     ON_DEMAND_LABEL,
     ON_DEMAND_LABELS,
@@ -51,20 +52,20 @@ def test_decode_modeled_pod_affinity():
         "topologyKey": "kubernetes.io/hostname",
         "labelSelector": {"matchLabels": {"app": "db"}},
     }])))
-    assert pod.pod_affinity_match == {"app": "db"}
+    assert pod.pod_affinity_match == own_terms({"app": "db"}, "ns1")
     assert not pod.unmodeled_constraints
 
 
 def test_decode_widened_selector_shapes_modeled():
-    """Round 4: single-value In matchExpressions are exactly equivalent
-    to matchLabels pairs and fold in; an explicit namespaces list naming
-    only the pod's OWN namespace keeps own-namespace semantics."""
+    """Round 5: the full LabelSelector operator surface, explicit
+    namespaces lists (cross-namespace included), and multiple required
+    terms are all modeled as canonical terms."""
     # pure matchExpressions selector
     pod = decode_pod(_pod_obj(_paff([{
         "topologyKey": "kubernetes.io/hostname",
         "labelSelector": {"matchExpressions": [
             {"key": "app", "operator": "In", "values": ["db"]}]}}])))
-    assert pod.pod_affinity_match == {"app": "db"}
+    assert pod.pod_affinity_match == own_terms({"app": "db"}, "ns1")
     assert not pod.unmodeled_constraints
     # mixed matchLabels + expressions
     pod = decode_pod(_pod_obj(_paff([{
@@ -73,14 +74,36 @@ def test_decode_widened_selector_shapes_modeled():
             "matchLabels": {"tier": "be"},
             "matchExpressions": [
                 {"key": "app", "operator": "In", "values": ["db"]}]}}])))
-    assert pod.pod_affinity_match == {"tier": "be", "app": "db"}
+    assert pod.pod_affinity_match == (
+        (("ns1",), (("app", "In", ("db",)), ("tier", "In", ("be",)))),
+    )
     assert not pod.unmodeled_constraints
     # own-namespace namespaces list (the pod's ns is ns1 in _pod_obj)
     pod = decode_pod(_pod_obj(_paff([{
         "topologyKey": "kubernetes.io/hostname",
         "namespaces": ["ns1"],
         "labelSelector": {"matchLabels": {"app": "db"}}}])))
-    assert pod.pod_affinity_match == {"app": "db"}
+    assert pod.pod_affinity_match == own_terms({"app": "db"}, "ns1")
+    assert not pod.unmodeled_constraints
+    # round 5: operators beyond In, multi-value In, cross-namespace
+    # scopes, multiple required terms
+    pod = decode_pod(_pod_obj(_paff([
+        {"topologyKey": "kubernetes.io/hostname",
+         "labelSelector": {"matchExpressions": [
+             {"key": "app", "operator": "In", "values": ["db", "cache"]},
+             {"key": "v", "operator": "NotIn", "values": ["old"]}]}},
+        {"topologyKey": "kubernetes.io/hostname",
+         "namespaces": ["other", "ns1"],
+         "labelSelector": {"matchExpressions": [
+             {"key": "tier", "operator": "Exists"},
+             {"key": "legacy", "operator": "DoesNotExist"}]}},
+    ])))
+    assert pod.pod_affinity_match == (
+        (("ns1",), (("app", "In", ("cache", "db")),
+                    ("v", "NotIn", ("old",)))),
+        (("ns1", "other"), (("legacy", "DoesNotExist", ()),
+                            ("tier", "Exists", ()))),
+    )
     assert not pod.unmodeled_constraints
 
 
@@ -91,8 +114,8 @@ def test_decode_zone_topology_pod_affinity_modeled():
     pod = decode_pod(_pod_obj(_paff([{
         "topologyKey": "topology.kubernetes.io/zone",
         "labelSelector": {"matchLabels": {"app": "db"}}}])))
-    assert pod.pod_affinity_zone_match == {"app": "db"}
-    assert pod.pod_affinity_match == {}
+    assert pod.pod_affinity_zone_match == own_terms({"app": "db"}, "ns1")
+    assert pod.pod_affinity_match == ()
     assert not pod.unmodeled_constraints
 
 
@@ -101,45 +124,48 @@ def test_decode_unmodeled_pod_affinity_shapes():
         # other topology keys
         [{"topologyKey": "example.com/rack",
           "labelSelector": {"matchLabels": {"app": "db"}}}],
-        # multi-value In / non-In operators stay unmodeled
-        [{"topologyKey": "kubernetes.io/hostname",
-          "labelSelector": {"matchExpressions": [
-              {"key": "app", "operator": "In", "values": ["db", "cache"]}]}}],
-        [{"topologyKey": "kubernetes.io/hostname",
-          "labelSelector": {"matchExpressions": [
-              {"key": "app", "operator": "Exists"}]}}],
-        [{"topologyKey": "kubernetes.io/hostname",
-          "labelSelector": {"matchExpressions": [
-              {"key": "app", "operator": "NotIn", "values": ["db"]}]}}],
-        # two terms (positive affinity models exactly one)
-        [{"topologyKey": "kubernetes.io/hostname",
-          "labelSelector": {"matchLabels": {"a": "1"}}},
-         {"topologyKey": "kubernetes.io/hostname",
-          "labelSelector": {"matchLabels": {"b": "2"}}}],
-        # cross-namespace
-        [{"topologyKey": "kubernetes.io/hostname",
-          "namespaces": ["other"],
-          "labelSelector": {"matchLabels": {"app": "db"}}}],
         # namespaceSelector, even {}
         [{"topologyKey": "kubernetes.io/hostname",
           "namespaceSelector": {},
           "labelSelector": {"matchLabels": {"app": "db"}}}],
-        # conflicting folded key: selector can never be satisfied
+        # malformed: Exists carrying values (k8s validation rejects)
         [{"topologyKey": "kubernetes.io/hostname",
-          "labelSelector": {
-              "matchLabels": {"app": "db"},
-              "matchExpressions": [
-                  {"key": "app", "operator": "In", "values": ["web"]}]}}],
+          "labelSelector": {"matchExpressions": [
+              {"key": "app", "operator": "Exists", "values": ["x"]}]}}],
+        # malformed: In with no values
+        [{"topologyKey": "kubernetes.io/hostname",
+          "labelSelector": {"matchExpressions": [
+              {"key": "app", "operator": "In", "values": []}]}}],
+        # unknown operator
+        [{"topologyKey": "kubernetes.io/hostname",
+          "labelSelector": {"matchExpressions": [
+              {"key": "app", "operator": "Gt", "values": ["1"]}]}}],
     ):
         pod = decode_pod(_pod_obj(_paff(term)))
-        assert pod.pod_affinity_match == {}
+        assert pod.pod_affinity_match == ()
         assert pod.unmodeled_constraints, term
+
+
+def test_decode_never_matching_positive_term_kept_exactly():
+    """Round 5: a positive term whose selector can never match any pod
+    is KEPT (not unmodeled) — no node can ever host a match, so the
+    carrier is exactly unplaceable through the affinity machinery."""
+    pod = decode_pod(_pod_obj(_paff([{
+        "topologyKey": "kubernetes.io/hostname",
+        "labelSelector": {
+            "matchLabels": {"app": "db"},
+            "matchExpressions": [
+                {"key": "app", "operator": "In", "values": ["web"]}]}}])))
+    assert pod.pod_affinity_match == (
+        (("ns1",), (("app", "In", ("db",)), ("app", "In", ("web",)))),
+    )
+    assert not pod.unmodeled_constraints
 
 
 def test_decode_preferred_only_is_unconstrained():
     pod = decode_pod(_pod_obj({"podAffinity": {
         "preferredDuringSchedulingIgnoredDuringExecution": [{"weight": 1}]}}))
-    assert pod.pod_affinity_match == {}
+    assert pod.pod_affinity_match == ()
     assert not pod.unmodeled_constraints
 
 

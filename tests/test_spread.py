@@ -71,7 +71,7 @@ _CANON = {
 def test_decode_canonical_hard_spread_modeled():
     pod = decode_pod(_spread_pod([_CANON]))
     assert pod.spread_constraints == (
-        (ZONE_LABEL, 1, (("app", "web"),)),
+        (ZONE_LABEL, 1, (("app", "In", ("web",)),)),
     )
     assert not pod.unmodeled_constraints
 
@@ -86,8 +86,8 @@ def test_decode_hostname_and_pair():
     host = dict(_CANON, topologyKey=HOSTNAME)
     pod = decode_pod(_spread_pod([host, _CANON]))
     assert pod.spread_constraints == (
-        (HOSTNAME, 1, (("app", "web"),)),
-        (ZONE_LABEL, 1, (("app", "web"),)),
+        (HOSTNAME, 1, (("app", "In", ("web",)),)),
+        (ZONE_LABEL, 1, (("app", "In", ("web",)),)),
     )
     assert not pod.unmodeled_constraints
 
@@ -107,7 +107,7 @@ def test_decode_soft_entries_ignored():
     {"labelSelector": {}},                        # no matchLabels
     {"labelSelector": {"matchLabels": {}}},       # empty selector
     {"labelSelector": {"matchLabels": {"a": "b"},
-                       "matchExpressions": [{}]}},  # expressions
+                       "matchExpressions": [{}]}},  # malformed expression
     {"minDomains": 2},                            # counting modifier
     {"matchLabelKeys": ["rev"]},
     {"nodeAffinityPolicy": "Honor"},              # even the default value

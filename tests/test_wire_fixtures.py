@@ -82,15 +82,34 @@ def test_wire_pod_decode_surface():
     web = pods["web-6d4b75cb6d-hx8vq"]
     # soft zone constraint dropped; hard hostname constraint modeled
     assert web.spread_constraints == (
-        ("kubernetes.io/hostname", 2, (("app", "web"),)),
+        ("kubernetes.io/hostname", 2, (("app", "In", ("web",)),)),
     )
     assert not web.unmodeled_constraints
     assert web.requests["cpu"] == 500
 
     api = pods["api-7f8d9c5b44-qm2zn"]
-    # matchExpressions single-value In folds into the selector (round 4)
-    assert api.anti_affinity_match == {"app": "api"}
+    # matchExpressions single-value In ≡ a matchLabels pair (round-5
+    # canonical terms)
+    assert api.anti_affinity_match == (
+        (("shop",), (("app", "In", ("api",)),)),
+    )
     assert not api.unmodeled_constraints
+
+    audit = pods["audit-7c9d0e1f2a-k8s2x"]
+    # round-5 widened shapes on the wire: multi-value In, a second
+    # hostname term with an Exists selector scoped cross-namespace,
+    # and a hard spread whose selector uses NotIn + Exists
+    assert audit.anti_affinity_match == (
+        (("payments", "shop"),
+         (("security.example.com/sensitive", "Exists", ()),)),
+        (("shop",), (("app", "In", ("audit", "audit-canary")),)),
+    )
+    assert audit.spread_constraints == (
+        ("kubernetes.io/hostname", 3,
+         (("app", "NotIn", ("api", "web")),
+          ("pod-template-hash", "Exists", ()))),
+    )
+    assert not audit.unmodeled_constraints
 
     fluent = pods["fluent-bit-x2lwp"]
     assert fluent.is_daemonset()
@@ -204,6 +223,7 @@ def test_wire_full_tick_drains_the_worker(wire_stub):
     assert result.drained == [OD]
     assert sorted(wire_stub.evictions) == [
         "api-7f8d9c5b44-qm2zn",
+        "audit-7c9d0e1f2a-k8s2x",
         "pg-0",
         "web-6d4b75cb6d-hx8vq",
     ]

@@ -22,6 +22,7 @@ from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from tests.fixtures import (
+    own_terms,
     pack_fake,
     ON_DEMAND_LABEL,
     ON_DEMAND_LABELS,
@@ -54,8 +55,8 @@ def test_decode_zone_topology_modeled():
         "topologyKey": "topology.kubernetes.io/zone",
         "labelSelector": {"matchLabels": {"app": "db"}},
     }]))
-    assert pod.anti_affinity_zone_match == {"app": "db"}
-    assert pod.anti_affinity_match == {}
+    assert pod.anti_affinity_zone_match == own_terms({"app": "db"}, "ns1")
+    assert pod.anti_affinity_match == ()
     assert not pod.unmodeled_constraints
 
 
@@ -64,7 +65,7 @@ def test_decode_legacy_zone_key_unmodeled():
         "topologyKey": "failure-domain.beta.kubernetes.io/zone",
         "labelSelector": {"matchLabels": {"app": "db"}},
     }]))
-    assert pod.anti_affinity_zone_match == {}
+    assert pod.anti_affinity_zone_match == ()
     assert pod.unmodeled_constraints
 
 
@@ -73,8 +74,8 @@ def test_decode_hostname_still_hostname():
         "topologyKey": "kubernetes.io/hostname",
         "labelSelector": {"matchLabels": {"app": "db"}},
     }]))
-    assert pod.anti_affinity_match == {"app": "db"}
-    assert pod.anti_affinity_zone_match == {}
+    assert pod.anti_affinity_match == own_terms({"app": "db"}, "ns1")
+    assert pod.anti_affinity_zone_match == ()
     assert not pod.unmodeled_constraints
 
 
@@ -365,8 +366,8 @@ def test_two_term_pair_enforces_both_families():
                 ]}}},
         "status": {"phase": "Running"},
     })
-    assert pod.anti_affinity_match == {"app": "db"}
-    assert pod.anti_affinity_zone_match == {"app": "db"}
+    assert pod.anti_affinity_match == own_terms({"app": "db"})
+    assert pod.anti_affinity_zone_match == own_terms({"app": "db"})
     assert not pod.unmodeled_constraints
 
     fc = FakeCluster(FakeClock())
