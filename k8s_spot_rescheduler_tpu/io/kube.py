@@ -258,6 +258,8 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
 
 from k8s_spot_rescheduler_tpu.predicates.selectors import (
     SELECTOR_OPS as _SELECTOR_OPS,
+    canon_selector,
+    selector_matches_nothing,
 )
 
 
@@ -281,11 +283,6 @@ def _decode_term(term: dict, namespace: str):
       unmodeled (native blob framing, has_sep_bytes lockstep).
 
     Returns (term | None, matches_nothing, unmodeled)."""
-    from k8s_spot_rescheduler_tpu.predicates.selectors import (
-        canon_selector,
-        selector_matches_nothing,
-    )
-
     ns_list = term.get("namespaces")
     if ns_list:
         if not isinstance(ns_list, list) or not all(

@@ -255,6 +255,21 @@ class SolverPlanner:
 
         self._report_conservatism(packed, meta, n_feasible)
 
+        # solver-mode observability: what actually ran, and whether the
+        # repair phase the config asked for was available on that path
+        # (the sharded program drops it past single-chip scale)
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+        # the reroute is exactly label != configured ('jax+sharded'); a
+        # solver CONFIGURED as 'sharded' keeps its repair wrapper
+        # (_make_fused) and must not raise the flag
+        wants_repair = cfg.fallback_best_fit and cfg.repair_rounds > 0
+        metrics.update_solver_mode(
+            cfg.solver,
+            solver_label,
+            wants_repair and solver_label != cfg.solver,
+        )
+
         self.last_solver = solver_label
         report = PlanReport(
             plan=plan,

@@ -98,20 +98,38 @@ class PlannerSidecar:
                     return self._send({"ok": True, "solver": sidecar.config.solver})
                 return self._send({"error": "not found"}, 404)
 
+            def _reject_unread(self, obj, code, headers=()):
+                """A response sent BEFORE the body was read must close
+                the connection: under keep-alive the unconsumed body
+                bytes would desync the next request on this socket
+                (advisor r4; harmless today with HTTP/1.0
+                close-per-request, load-bearing the day
+                protocol_version is raised). Applies to every pre-read
+                reject — 400/404/413/503 alike."""
+                self.close_connection = True
+                return self._send(
+                    obj, code,
+                    headers=tuple(headers) + (("Connection", "close"),),
+                )
+
             def do_POST(self):
                 if self.path != "/v1/plan":
-                    return self._send({"error": "not found"}, 404)
+                    return self._reject_unread({"error": "not found"}, 404)
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                 except ValueError:
-                    return self._send({"error": "bad Content-Length"}, 400)
+                    return self._reject_unread(
+                        {"error": "bad Content-Length"}, 400
+                    )
                 if length < 0:
                     # a negative length must not reach rfile.read(-1),
                     # which would buffer the stream until EOF — the exact
                     # exhaustion the size cap exists to prevent
-                    return self._send({"error": "bad Content-Length"}, 400)
+                    return self._reject_unread(
+                        {"error": "bad Content-Length"}, 400
+                    )
                 if length > sidecar.max_body_bytes:
-                    return self._send(
+                    return self._reject_unread(
                         {
                             "error": "snapshot exceeds %d-byte limit"
                             % sidecar.max_body_bytes
@@ -122,7 +140,7 @@ class PlannerSidecar:
                 # never buffers its payload, so a burst holds at most
                 # max_inflight parsed bodies regardless of its size
                 if not sidecar._admit():
-                    return self._send(
+                    return self._reject_unread(
                         {
                             "error": "planner overloaded (%d requests in "
                             "flight)" % sidecar.max_inflight

