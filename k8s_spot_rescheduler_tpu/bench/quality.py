@@ -44,8 +44,19 @@ def ilp_max_drains(
     oracle below the true optimum — keep quality clusters to the
     self-selecting shape. Zone-family bits get the same per-node pair
     rule, which is weaker than the real zone-wide constraint — weaker
-    only ever loosens the oracle, so the bound stays valid. Returns None
-    if the solver fails.
+    only ever loosens the oracle, so the bound stays valid.
+
+    Hard topologySpreadConstraints (round 5) enter through the SAME
+    static admissibility: the packers intern each carrier's
+    refused-domain verdict as SpreadBit words in ``slot_tol`` /
+    ``spot_taints``, so the taint check above enforces them with no
+    extra rows. The verdict is exact — and with it this oracle — when
+    one mover per spread identity is in flight and no other pod matched
+    by its selector moves (the quality-config scope, same contract as
+    the affinity rule; ``SpreadQualitySpec`` is built to it). A config
+    with interacting spread movers would have the lane guard TIGHTEN
+    the masks below the true optimum — keep quality clusters to the
+    single-carrier shape. Returns None if the solver fails.
     """
     C, K, R = packed.slot_req.shape
     S = packed.spot_free.shape[0]

@@ -308,6 +308,31 @@ class AffinitySpec:
     resources: Tuple[str, ...] = (CPU, MEMORY)
 
 
+@dataclasses.dataclass(frozen=True)
+class SpreadQualitySpec:
+    """Round-5 adversarial pools: greedy loses a drain *because of* a
+    hard topologySpreadConstraint, and repair recovers it.
+
+    Per pool ``g`` (own namespace, pool-selector isolated): zone
+    ``za-g`` holds spot-a with two selector-matched residents; zone
+    ``zb-g`` holds spot-b with heavy NON-matching residents (so probe
+    order ranks spot-b first). The candidate carries a big plain filler
+    and a smaller zone-spread CARRIER (maxSkew 2, self-matching): the
+    skew math refuses ``za-g`` (2 matched there, 0 in ``zb-g``), so the
+    carrier fits ONLY spot-b — but greedy places the filler first, and
+    both first-fit and best-fit (slack tie -> probe order) burn spot-b
+    on it. The repair phase ejects the filler to spot-a and seats the
+    carrier — a SPREAD-driven relocation. The ILP (which reads the same
+    static SpreadBit words in the packed masks) proves one drain per
+    pool; pure greedy proves zero. Static verdicts are EXACT here: one
+    carrier per spread identity, nothing else matching its selector
+    moves (the bench/quality.py exactness scope)."""
+
+    name: str
+    n_groups: int = 12
+    resources: Tuple[str, ...] = (CPU, MEMORY)
+
+
 QUALITY_CONFIGS = {
     # the round-1/2 balanced regime (greedy ties the oracle here — kept as
     # the regression guard that quality never drops below 1.0 on it)
@@ -324,6 +349,9 @@ QUALITY_CONFIGS = {
     # depth-2 chain — now a headline row
     "interlock": AffinitySpec("quality-interlock-8g", n_groups=8,
                               aswap_frac=0.0, interlock_frac=0.25),
+    # hard topologySpread contention: drains only a spread-driven
+    # relocation recovers (VERDICT r4 #3)
+    "spread": SpreadQualitySpec("quality-spread-12g"),
 }
 
 # Published-boundary configs: NOT part of the headline worst-ratio metric
@@ -569,13 +597,85 @@ def generate_affinity_cluster(
     return fc
 
 
+from k8s_spot_rescheduler_tpu.predicates.masks import ZONE_LABEL
+
+
+def generate_spread_quality_cluster(
+    spec: SpreadQualitySpec, seed: int = 0, **fake_kwargs
+) -> FakeCluster:
+    """See ``SpreadQualitySpec`` — one spread-contended pool per group."""
+    rng = np.random.default_rng(seed)
+    fc = FakeCluster(FakeClock(), **fake_kwargs)
+    mem = 16 * 1024**3
+
+    def add_node(name, labels, cpu):
+        fc.add_node(NodeSpec(
+            name=name,
+            labels=dict(labels),
+            allocatable={CPU: int(cpu), MEMORY: mem, PODS: 110,
+                         EPHEMERAL: 100 * 1024**3},
+        ))
+
+    for g in range(spec.n_groups):
+        ns = f"ns-{g}"
+        pool = {"pool": f"g{g}"}
+        carrier_cpu = int(rng.integers(450, 550))
+        filler_cpu = carrier_cpu + int(rng.integers(50, 150))
+        matched_cpu = int(rng.integers(40, 60))
+        heavy_total = int(rng.integers(850, 950))
+        add_node(f"od-{g}", ON_DEMAND_LABELS, 2000)
+        # spot-a (zone za-g): exactly filler-sized slack after its two
+        # matched residents; LOW requested -> probed second
+        add_node(
+            f"spot-a-{g}",
+            {**SPOT_LABELS, **pool, ZONE_LABEL: f"za-{g}"},
+            filler_cpu + 2 * matched_cpu,
+        )
+        # spot-b (zone zb-g): filler-sized slack after heavy plain
+        # residents; HIGH requested -> probed first, so greedy burns it
+        add_node(
+            f"spot-b-{g}",
+            {**SPOT_LABELS, **pool, ZONE_LABEL: f"zb-{g}"},
+            filler_cpu + heavy_total,
+        )
+
+        def add_pod(name, node, cpu, labels, spread=()):
+            fc.add_pod(PodSpec(
+                name=name,
+                namespace=ns,
+                node_name=node,
+                requests={CPU: int(cpu), MEMORY: _mem_for(cpu)},
+                labels=dict(labels),
+                owner_refs=[OwnerRef("ReplicaSet", f"{name}-rs")],
+                node_selector=dict(pool),
+                spread_constraints=spread,
+            ))
+
+        for j in range(2):  # selector-matched residents: za-g count = 2
+            add_pod(f"m{j}-{g}", f"spot-a-{g}", matched_cpu,
+                    {"app": f"app-{g}"})
+        add_pod(f"h0-{g}", f"spot-b-{g}", heavy_total,
+                {"bg": f"bg-{g}"})
+        # the movers: filler (bigger, sorts first) + the spread carrier
+        add_pod(f"filler-{g}", f"od-{g}", filler_cpu,
+                {"bg": f"fill-{g}"})
+        add_pod(
+            f"carrier-{g}", f"od-{g}", carrier_cpu,
+            {"app": f"app-{g}"},
+            spread=((ZONE_LABEL, 2, (("app", f"app-{g}"),)),),
+        )
+    return fc
+
+
 def generate_quality_cluster(spec, seed: int = 0, **fake_kwargs) -> FakeCluster:
-    """Dispatch: SyntheticSpec (balanced random fill), ContendedSpec, or
-    AffinitySpec."""
+    """Dispatch: SyntheticSpec (balanced random fill), ContendedSpec,
+    AffinitySpec, or SpreadQualitySpec."""
     if isinstance(spec, ContendedSpec):
         return generate_contended_cluster(spec, seed, **fake_kwargs)
     if isinstance(spec, AffinitySpec):
         return generate_affinity_cluster(spec, seed, **fake_kwargs)
+    if isinstance(spec, SpreadQualitySpec):
+        return generate_spread_quality_cluster(spec, seed, **fake_kwargs)
     return generate_cluster(spec, seed, **fake_kwargs)
 
 

@@ -42,6 +42,20 @@ def pick_mesh_shape(n_devices: int) -> Tuple[int, int]:
     return n_devices // spot, spot
 
 
+def make_cand_mesh(devices=None) -> Mesh:
+    """A 1-D all-device mesh over the candidate axis only — the
+    cand-only sharding layout (parallel/sharded_ffd.py
+    ``plan_union_cand_sharded``): every device holds a block of
+    candidate lanes with the FULL spot axis replicated, so the complete
+    single-chip union program (repair included) runs per block with no
+    collectives at all."""
+    devices = devices if devices is not None else jax.devices()
+    grid = mesh_utils.create_device_mesh(
+        (len(devices),), devices=np.asarray(devices)
+    )
+    return Mesh(grid, (CAND_AXIS,))
+
+
 def make_mesh(shape: Tuple[int, int] | None = None, devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     if shape is None:

@@ -128,16 +128,12 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
-def _pad_to_mesh(packed: PackedCluster, mesh: Mesh) -> PackedCluster:
-    """Pad the candidate/spot axes to mesh-divisible sizes with inert
-    entries (invalid lanes, never-fitting nodes). Padding spot nodes sit
-    at the *end* of the probe order so first-fit semantics are unchanged."""
-    n_cand = mesh.shape[CAND_AXIS]
-    n_spot = mesh.shape[SPOT_AXIS]
+def _pad_axes(packed: PackedCluster, Cp: int, Sp: int) -> PackedCluster:
+    """Pad the candidate/spot axes to the given sizes with inert entries
+    (invalid lanes, never-fitting nodes). Padding spot nodes sit at the
+    *end* of the probe order so first-fit semantics are unchanged."""
     C = packed.slot_req.shape[0]
     S = packed.spot_free.shape[0]
-    Cp = _round_up(C, n_cand)
-    Sp = _round_up(S, n_spot)
     if Cp == C and Sp == S:
         return packed
 
@@ -160,6 +156,16 @@ def _pad_to_mesh(packed: PackedCluster, mesh: Mesh) -> PackedCluster:
         spot_taints=pad(packed.spot_taints, Sp),
         spot_ok=pad(packed.spot_ok, Sp),  # padded nodes: spot_ok=False
         spot_aff=pad(packed.spot_aff, Sp),
+    )
+
+
+def _pad_to_mesh(packed: PackedCluster, mesh: Mesh) -> PackedCluster:
+    C = packed.slot_req.shape[0]
+    S = packed.spot_free.shape[0]
+    return _pad_axes(
+        packed,
+        _round_up(C, mesh.shape[CAND_AXIS]),
+        _round_up(S, mesh.shape[SPOT_AXIS]),
     )
 
 
@@ -187,6 +193,71 @@ def plan_ffd_sharded(
         functools.partial(_sharded_plan_local, best_fit),
         mesh=mesh,
         in_specs=(cand_sharded,),
+        out_specs=(P(CAND_AXIS), P(CAND_AXIS, None)),
+        check_vma=False,
+    )
+    feasible, assignment = fn(packed)
+    return SolveResult(feasible=feasible[:C], assignment=assignment[:C])
+
+
+def plan_union_cand_sharded(
+    mesh: Mesh,
+    packed: PackedCluster,
+    *,
+    rounds: int = 0,
+    best_fit_fallback: bool = True,
+) -> SolveResult:
+    """Candidate-ONLY sharding: each device holds a block of candidate
+    lanes with the FULL spot axis replicated, and runs the complete
+    single-chip union program — first-fit ∪ best-fit ∪ REPAIR — on its
+    block. Candidate lanes are the Fork/Revert forks (reference
+    rescheduler.go:269-275): they never interact, so the block program
+    needs no collectives, and repair's per-lane eject-reinsert search
+    state (solver/repair.py) exists unchanged — the quality phase the
+    2-D cand×spot layout must drop survives past single-chip scale
+    whenever one lane's full spot state still fits one device
+    (solver/memory.estimate_union_hbm_bytes at C/n). ``mesh`` is the
+    1-D all-device mesh of ``parallel/mesh.make_cand_mesh``."""
+    from k8s_spot_rescheduler_tpu.solver.fallback import (
+        with_best_fit_fallback,
+        with_repair,
+    )
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    if best_fit_fallback and rounds > 0:
+        solve = with_repair(plan_ffd, rounds)
+    elif best_fit_fallback:
+        solve = with_best_fit_fallback(plan_ffd)
+    else:
+        solve = plan_ffd
+    C = packed.slot_req.shape[0]
+    packed = _pad_axes(
+        packed,
+        _round_up(C, mesh.shape[CAND_AXIS]),
+        packed.spot_free.shape[0],
+    )
+    cand_only = PackedCluster(
+        slot_req=P(CAND_AXIS),
+        slot_valid=P(CAND_AXIS),
+        slot_tol=P(CAND_AXIS),
+        slot_aff=P(CAND_AXIS),
+        cand_valid=P(CAND_AXIS),
+        spot_free=P(),  # replicated: each lane block sees the whole pool
+        spot_count=P(),
+        spot_max_pods=P(),
+        spot_taints=P(),
+        spot_ok=P(),
+        spot_aff=P(),
+    )
+
+    def local(p):
+        res = solve(p)
+        return res.feasible, res.assignment
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(cand_only,),
         out_specs=(P(CAND_AXIS), P(CAND_AXIS, None)),
         check_vma=False,
     )

@@ -131,6 +131,51 @@ def test_interlock_closed_by_depth2_chain(seed):
     assert shipped == ilp, "depth-2 chain regressed on the interlock"
 
 
+def _spread_small():
+    from k8s_spot_rescheduler_tpu.io.synthetic import SpreadQualitySpec
+
+    return SpreadQualitySpec("quality-spread-test", n_groups=6)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_spread_discriminates_and_shipped_recovers(seed):
+    """Round 5 (VERDICT r4 #3): the spread pools — greedy loses a drain
+    BECAUSE of maxSkew (the filler burns the only skew-admissible node;
+    both first-fit and best-fit tie into it), and the repair phase
+    recovers every drain the spread-aware ILP finds via a spread-driven
+    relocation."""
+    spec = _spread_small()
+    packed = pack_quality(spec, seed)
+    ilp = ilp_max_drains(packed)
+    assert ilp and ilp > 0
+    ffd = _exhaust(spec, seed, fallback_best_fit=False, repair_rounds=0)
+    shipped = _exhaust(spec, seed)
+    assert ffd / ilp < 0.95, "config no longer stresses greedy via spread"
+    assert shipped / ilp >= 0.95, "spread contention regressed"
+
+
+def test_spread_loss_is_caused_by_the_constraint():
+    """Ablation: strip the carriers' spread constraints and pure greedy
+    drains the whole config — proving the quality loss above is caused
+    by maxSkew, not by capacity shapes."""
+    import dataclasses as _dc
+
+    from k8s_spot_rescheduler_tpu.bench.quality import drain_to_exhaustion
+
+    spec = _spread_small()
+    client = generate_quality_cluster(spec, 0, reschedule_evicted=True)
+    for pod in list(client.pods.values()):
+        if pod.spread_constraints:
+            # re-add through the public API (upsert keeps every index
+            # consistent)
+            client.add_pod(_dc.replace(pod, spread_constraints=()))
+    cfg = ReschedulerConfig(
+        solver="numpy", fallback_best_fit=False, repair_rounds=0,
+        resources=spec.resources,
+    )
+    assert drain_to_exhaustion(client, cfg) == 6
+
+
 @pytest.mark.parametrize("seed", [0, 1])
 def test_chain3_is_repairs_published_boundary(seed):
     """Three-link chains: the only unlocker's re-placement needs TWO

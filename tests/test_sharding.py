@@ -176,3 +176,128 @@ def test_planner_no_dispatch_under_budget():
     assert report.solver == "jax"
     assert _solver_mode_samples() == {("jax", "jax"): 1.0}
     assert _repair_unavailable() == 0.0
+
+
+# --- cand-only sharding: repair past single-chip (round 5) -----------------
+
+def test_cand_sharded_union_repairs_greedy_failure():
+    """The cand-only layout runs the COMPLETE union program per lane
+    block — a lane greedy cannot prove must be repaired exactly as on a
+    single chip (bit parity with the host union mirror)."""
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_cand_mesh
+    from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
+        plan_union_cand_sharded,
+    )
+    from k8s_spot_rescheduler_tpu.solver.repair import plan_repair_oracle
+    from tests.test_repair import _swap_case
+
+    packed = _swap_case()
+    assert not plan_oracle(packed).feasible[0]  # greedy fails
+    mesh = make_cand_mesh()
+    got = plan_union_cand_sharded(mesh, packed, rounds=8)
+    want = plan_repair_oracle(packed)
+    assert bool(np.asarray(got.feasible)[0])
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(
+        np.asarray(got.assignment), want.assignment
+    )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_cand_sharded_union_parity_randomized(seed):
+    """Randomized bit parity of the cand-sharded union against the host
+    union composition (ff ∪ bf ∪ repair with first-fit preference) —
+    lanes are independent forks, so sharding them must be invisible."""
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_cand_mesh
+    from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
+        plan_union_cand_sharded,
+    )
+    from k8s_spot_rescheduler_tpu.solver.repair import plan_repair_oracle
+
+    packed = _random_packed(np.random.default_rng(1000 + seed))
+    mesh = make_cand_mesh()
+    got = plan_union_cand_sharded(mesh, packed, rounds=8)
+    ff = plan_oracle(packed)
+    bf = plan_oracle(packed, best_fit=True)
+    rp = plan_repair_oracle(packed, rounds=8)
+    feasible = ff.feasible | bf.feasible | rp.feasible
+    assignment = np.where(
+        ff.feasible[:, None],
+        ff.assignment,
+        np.where(bf.feasible[:, None], bf.assignment, rp.assignment),
+    )
+    np.testing.assert_array_equal(np.asarray(got.feasible), feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), assignment)
+
+
+def _repair_demanding_fake():
+    """FakeCluster analog of test_repair._swap_case: greedy packs b onto
+    spot-1 and strands the selector-pinned c; ejecting b unlocks the
+    drain. Both greedy passes fail, repair proves it."""
+    from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from tests.fixtures import (
+        ON_DEMAND_LABEL,
+        ON_DEMAND_LABELS,
+        SPOT_LABEL,
+        SPOT_LABELS,
+        make_node,
+        make_pod,
+    )
+
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node(
+        "spot-1", dict(SPOT_LABELS, pin="1"), cpu_millis=1100
+    ))
+    fc.add_node(make_node("spot-2", SPOT_LABELS, cpu_millis=500))
+    fc.add_pod(make_pod("a", 600, "od-1"))
+    fc.add_pod(make_pod("b", 500, "od-1"))
+    fc.add_pod(make_pod("c", 500, "od-1", node_selector={"pin": "1"}))
+    nodes = fc.list_ready_nodes()
+    return build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+
+
+def test_planner_prefers_cand_sharded_when_lane_block_fits():
+    """Auto-dispatch (round 5): past the HBM budget, the planner must
+    prefer the cand-only layout — repair intact — whenever one lane
+    block's full spot state fits a device, and only fall back to the
+    2-D cand×spot layout (repair off) beyond that. Verified on a drain
+    only repair can prove: the rerouted planner must find it, with the
+    same placements as the host oracle stack, and repair_unavailable
+    must stay 0."""
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.solver import memory
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    node_map = _repair_demanding_fake()
+    want = SolverPlanner(ReschedulerConfig(solver="numpy")).plan(node_map, [])
+    assert want.plan is not None  # the host stack (with repair) proves it
+
+    # budget between the full estimate and a 1/8 lane block's estimate:
+    # the reroute must fire AND choose the cand-only layout
+    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+
+    packed, _ = pack_cluster(node_map, [], resources=("cpu", "memory"))
+    C, K, S, R, W, A = memory.packed_shapes(packed)
+    full = memory.estimate_union_hbm_bytes(C, K, S, R, W, A)
+    lane = memory.estimate_union_hbm_bytes(-(-C // 8), K, S, R, W, A)
+    assert lane < full
+    budget = (lane + full) // 2
+
+    planner = SolverPlanner(
+        ReschedulerConfig(solver="jax", solver_hbm_budget=int(budget))
+    )
+    report = planner.plan(node_map, [])
+    assert report.solver == "jax+cand-sharded"
+    assert report.plan is not None
+    assert report.plan.node.node.name == want.plan.node.node.name
+    assert report.plan.assignments == want.plan.assignments
+    assert _solver_mode_samples() == {("jax", "jax+cand-sharded"): 1.0}
+    assert _repair_unavailable() == 0.0  # repair survives this layout
