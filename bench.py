@@ -17,6 +17,9 @@ Usage: python bench.py [--config N] [--repeats R] [--solver jax|sharded]
        python bench.py --quality [--sweep K]     # vs the affinity-aware ILP
        python bench.py --quality-scale --config 3|4   # LP/Hall bound at scale
        python bench.py --quality-boundary        # published repair boundary
+       python bench.py --chain-depth             # chain-depth-demand table
+       python bench.py --replay-device-only      # constrained-replay tick,
+                                                 # device-only chain protocol
        python bench.py --config 5 [--constrained]    # interruption replay
        python bench.py --scale 8                 # past-one-chip (auto-shard)
 """
@@ -254,6 +257,199 @@ def run_quality(seed: int, sweep: int = 1, solver: str = "numpy") -> int:
     return 0
 
 
+def run_chain_depth(seed: int, sweep: int = 1, n_events: int = 300) -> int:
+    """Chain-depth-demand table (bench/chain_depth.py): for every tick
+    of every organic run — the quality configs drained to exhaustion,
+    plus the constrained interruption replay — classify each drainable
+    candidate lane by the MINIMUM mechanism that proves it (greedy /
+    depth-1 repair / depth-2 chain / deeper-than-shipped / infeasible).
+    The chain3 BOUNDARY config runs as the positive control: its lanes
+    must register 'deeper', proving the instrument detects depth-3
+    demand. The emitted metric is the ORGANIC 'deeper' count — zero
+    means the published chain3 boundary is evidence-backed."""
+    # host-side offline analysis: hundreds of tiny solves per run, each
+    # fetched — on the tunneled TPU every fetch pays the ~65 ms RTT, so
+    # the analyzer pins itself to CPU (same policy as the test suite)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from k8s_spot_rescheduler_tpu.bench.chain_depth import (
+        analyze_quality_runs,
+        analyze_replay,
+    )
+    from k8s_spot_rescheduler_tpu.io.synthetic import BOUNDARY_CONFIGS
+
+    seeds = range(seed, seed + max(1, sweep))
+    organic = analyze_quality_runs(seeds=seeds)
+    organic["constrained-replay"] = analyze_replay(
+        n_events=n_events, seed=seed, constrained=True
+    )
+    control = analyze_quality_runs(seeds=seeds, configs=BOUNDARY_CONFIGS)
+    keys = ("greedy", "depth1", "depth2", "deeper", "infeasible",
+            "ilp-failed")
+    print("chain-depth demand (lane-ticks by minimal proving mechanism):",
+          file=sys.stderr)
+    for name, counts in {**organic, **{
+        f"[control] {k}": v for k, v in control.items()
+    }}.items():
+        row = "  ".join(f"{k}={counts.get(k, 0)}" for k in keys)
+        print(f"  {name}: {row}", file=sys.stderr)
+    deeper_organic = sum(c.get("deeper", 0) for c in organic.values())
+    deeper_control = sum(c.get("deeper", 0) for c in control.values())
+    out = {
+        "metric": "chain_depth_demand_deeper_lanes_organic",
+        "value": int(deeper_organic),
+        "unit": "count",
+        "vs_baseline": 1.0 if deeper_organic == 0 else 0.0,
+        "control_deeper": int(deeper_control),
+    }
+    if deeper_control == 0:
+        # a dead positive control voids the organic zero — say so IN
+        # the metric line, not just on stderr
+        print("WARNING: chain3 control registered no depth-3 demand — "
+              "the instrument may be broken", file=sys.stderr)
+        out["vs_baseline"] = 0.0
+        out["error"] = "positive control (chain3) registered no depth-3 " \
+                       "demand; instrument suspect"
+    emit(out)
+    return 0 if deeper_control else 1
+
+
+def run_replay_device_only(args) -> int:
+    """Device-only cost of a CONSTRAINED-REPLAY tick (VERDICT r4 #8).
+
+    The constrained replay's p99 (docs/RESULTS.md) crosses the 200 ms
+    target on this host, attributed to two tunnel RTTs — but the claim
+    "a locally attached chip pays ~ms" was extrapolated from config-3/4
+    shapes, not measured on the ticks that actually fire best-fit +
+    repair. This mode measures it: replay the constrained stream with
+    the HOST oracle stack (pure numpy — jax stays uninitialized so the
+    real backend can still be acquired afterwards), harvest the tick
+    shape with the most greedy-unproven valid lanes (the regime where
+    the union program's best-fit and repair passes genuinely execute),
+    then run the pinned chain protocol (bench/protocol.py) on the real
+    device with the SHIPPED fused union program."""
+    from k8s_spot_rescheduler_tpu.bench.replay import run_replay
+    from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    host_cfg = ReschedulerConfig(solver="numpy")
+    harvest = {"packed": None, "unproven": -1, "bf_only": True,
+               "last_id": None}
+
+    def tap(packed):
+        if packed is None or id(packed) == harvest["last_id"]:
+            return  # skipped ticks repeat the previous object
+        harvest["last_id"] = id(packed)
+        ff = plan_oracle(packed)
+        valid = np.asarray(packed.cand_valid)
+        miss_ff = valid & ~np.asarray(ff.feasible)
+        if not miss_ff.any():
+            return  # greedy proves everything: neither pass fires
+        bf = plan_oracle(packed, best_fit=True)
+        miss_greedy = miss_ff & ~np.asarray(bf.feasible)
+        n = int(miss_greedy.sum())
+        bf_only = n == 0
+        # prefer repair-firing ticks over bf-only ticks, then max lanes
+        better = (
+            (harvest["bf_only"] and not bf_only)
+            or (harvest["bf_only"] == bf_only
+                and n + int(miss_ff.sum()) > harvest["unproven"])
+        )
+        if harvest["packed"] is None or better:
+            harvest.update(
+                packed=packed, unproven=n + int(miss_ff.sum()),
+                bf_only=bf_only,
+            )
+
+    stats = run_replay(
+        host_cfg, n_events=args.events, seed=args.seed,
+        constrained=True, on_packed=tap,
+    )
+    packed = harvest["packed"]
+    if packed is None:
+        emit({
+            "metric": "replay_constrained_device_only_ms",
+            "value": None, "unit": "ms", "vs_baseline": None,
+            "error": "no replay tick left a valid lane greedy-unproven "
+                     "(best-fit/repair never fired this seed)",
+        })
+        return 1
+    note = (
+        "best-fit fires, repair gated off (greedy union proves all)"
+        if harvest["bf_only"]
+        else "best-fit AND repair fire"
+    )
+    C, K, R = packed.slot_req.shape
+    print(
+        f"harvested constrained-replay tick: C={C} K={K} "
+        f"S={packed.spot_free.shape[0]} R={R}; "
+        f"{harvest['unproven']} greedy-unproven valid lanes ({note}); "
+        f"replay p50 {stats['replan_ms_p50']:.1f} ms "
+        f"p99 {stats['replan_ms_p99']:.1f} ms on this host",
+        file=sys.stderr,
+    )
+
+    platform, attempts, backend_note = acquire_backend(
+        budget_s=args.backend_budget
+    )
+    if backend_note:
+        # a device-only metric measured on the CPU fallback would be a
+        # misleading headline (and 50 chained union solves on host at
+        # this shape would blow the watchdog anyway) — report the
+        # failure honestly instead
+        emit({
+            "metric": "replay_constrained_device_only_ms",
+            "value": None, "unit": "ms", "vs_baseline": None,
+            "error": backend_note,
+        })
+        return 1
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_spot_rescheduler_tpu.bench import protocol as bench_protocol
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+    from k8s_spot_rescheduler_tpu.solver.select import (
+        decode_selection,
+        make_fused_planner,
+    )
+
+    shipped = ReschedulerConfig()
+    fused = make_fused_planner(with_repair(plan_ffd, shipped.repair_rounds))
+    device_packed = jax.tree.map(jnp.asarray, packed)
+    t0 = time.perf_counter()
+    sel = decode_selection(fused(device_packed))
+    compile_s = time.perf_counter() - t0
+    rec = bench_protocol.run_protocol(fused, device_packed)
+    device_ms = rec["device_only_ms"]
+    print(
+        f"compile {compile_s:.1f}s  device-only "
+        f"{device_ms:.2f} ms/solve on the harvested tick shape "
+        f"({note}); feasible {sel.n_feasible} lanes  "
+        f"device {jax.devices()[0].device_kind}",
+        file=sys.stderr,
+    )
+    out = {
+        "metric": "replay_constrained_device_only_ms",
+        "value": round(device_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / device_ms, 1) if device_ms else None,
+        "device": jax.devices()[0].device_kind,
+        "device_only": rec,
+        "tick_shape": {"C": int(C), "K": int(K),
+                       "S": int(packed.spot_free.shape[0]), "R": int(R)},
+        "note": note,
+        "replay_p50_ms_host": round(stats["replan_ms_p50"], 1),
+        "replay_p99_ms_host": round(stats["replan_ms_p99"], 1),
+    }
+    if backend_note:
+        out["backend_note"] = backend_note
+    emit(out)
+    return 0
+
+
 def run_quality_boundary(seed: int, sweep: int = 1) -> int:
     """The PUBLISHED repair boundary (docs/RESULTS.md): configs where
     shipped < ILP by construction — the three-link chain that needs two
@@ -402,6 +598,10 @@ def _metric_for(args) -> tuple:
         return "nodes_freed_vs_ilp_oracle_ratio", "ratio"
     if args.quality_boundary:
         return "repair_boundary_chain3_ratio", "ratio"
+    if args.chain_depth:
+        return "chain_depth_demand_deeper_lanes_organic", "count"
+    if args.replay_device_only:
+        return "replay_constrained_device_only_ms", "ms"
     if args.quality_scale:
         return (
             "nodes_freed_vs_lp_bound_ratio_config%d" % args.config,
@@ -439,6 +639,16 @@ def main() -> int:
                     help="quality at full scale: controller drains to "
                          "exhaustion vs the LP/Hall upper bound (the ILP "
                          "is intractable at config 3/4 scale)")
+    ap.add_argument("--replay-device-only", action="store_true",
+                    help="harvest a constrained-replay tick shape where "
+                         "best-fit + repair actually fire and run the "
+                         "pinned device-only chain protocol on it "
+                         "(VERDICT r4 #8)")
+    ap.add_argument("--chain-depth", action="store_true",
+                    help="chain-depth DEMAND analysis: per organic run, the "
+                         "minimum repair depth each drainable lane needed "
+                         "(VERDICT r4 #4; chain3 rides along as the "
+                         "positive control)")
     ap.add_argument("--quality-boundary", action="store_true",
                     help="document the published repair boundary (two-pod "
                          "interlock pools where shipped < ILP by "
@@ -483,6 +693,11 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         )
     if args.quality_boundary:
         return run_quality_boundary(args.seed, sweep=args.sweep)
+    if args.chain_depth:
+        return run_chain_depth(args.seed, sweep=args.sweep,
+                               n_events=args.events)
+    if args.replay_device_only:
+        return run_replay_device_only(args)
     if args.quality_scale:
         # host-side controller + solver at scale; the jax CPU/device solver
         # drives the multi-drain exhaustion run
@@ -673,19 +888,8 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
     device_ms = float("nan")
     protocol_rec = None
     if not backend_note:
-        chained_jit = bench_protocol.make_chained(fused)
-        rtt_jit = jax.jit(lambda p: p.cand_valid.sum())
-        np.asarray(chained_jit(device_packed)), np.asarray(rtt_jit(device_packed))
-        chain_t, rtt_t = [], []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            np.asarray(chained_jit(device_packed))
-            chain_t.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            np.asarray(rtt_jit(device_packed))
-            rtt_t.append(time.perf_counter() - t0)
-        device_ms = bench_protocol.device_only_ms(chain_t, rtt_t)
-        protocol_rec = bench_protocol.protocol_record(chain_t, rtt_t)
+        protocol_rec = bench_protocol.run_protocol(fused, device_packed)
+        device_ms = protocol_rec["device_only_ms"]
 
     value_ms = float(np.median(times) * 1e3)
     e2e_ms = float(np.median(e2e) * 1e3)

@@ -1,0 +1,166 @@
+"""Chain-depth DEMAND analysis (VERDICT r4 #4).
+
+The repair search executes relocation chains up to depth 2; three-link
+chains are the published quality boundary (docs/RESULTS.md, `chain3`
+pools: shipped 0.750 of the ILP by construction). The open question was
+empirical: how deep a chain does the optimum ACTUALLY need on organic
+problems? This module measures it. For every candidate lane of every
+tick of a run, classify the MINIMUM mechanism that proves the lane's
+drain:
+
+- ``greedy``  — first-fit or best-fit proves it (depth 0);
+- ``depth1``  — the depth-1-only repair variant proves it
+  (``plan_repair(..., chain=False)``) — one relocation, no chain;
+- ``depth2``  — the shipped depth-2 chained search proves it;
+- ``deeper``  — the single-lane ILP proves the drain possible but the
+  depth-2 search cannot find it: demand for depth ≥ 3 (or for a
+  different depth-≤2 move sequence outside the rotation schedule —
+  either way, the shipped stack loses this lane);
+- ``infeasible`` — the ILP proves no valid placement exists at all.
+
+The expensive ILP only runs on lanes the cheap passes left unresolved,
+so organic runs (where ``deeper`` is the rare case being hunted) stay
+fast. Results feed the RESULTS.md chain-depth-demand table: if
+``deeper`` is zero across every organic run, the published chain3
+boundary is evidence-backed; if it is real, the chain election needs a
+depth-k extension.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Optional
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+
+
+def _slice_lane(packed: PackedCluster, c: int) -> PackedCluster:
+    """A single-lane view (C=1) — lanes are independent fork copies, so
+    slicing is exact (same argument as the MULTICHIP oracle slices)."""
+    sl = slice(c, c + 1)
+    return packed._replace(
+        slot_req=packed.slot_req[sl],
+        slot_valid=packed.slot_valid[sl],
+        slot_tol=packed.slot_tol[sl],
+        slot_aff=packed.slot_aff[sl],
+        cand_valid=packed.cand_valid[sl],
+    )
+
+
+def classify_packed(
+    packed: PackedCluster,
+    *,
+    rounds: int = 8,
+    ilp_time_limit: float = 60.0,
+) -> Counter:
+    """Per-lane minimal-mechanism classification for one tick's problem.
+
+    Device passes run jitted (greedy, depth-1, depth-2) over all lanes
+    at once; the per-lane ILP (bench/quality.ilp_max_drains on a C=1
+    slice) runs only for lanes depth-2 left unproven."""
+    from k8s_spot_rescheduler_tpu.bench.quality import ilp_max_drains
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_jit
+    from k8s_spot_rescheduler_tpu.solver.repair import plan_repair_jit
+
+    valid = np.asarray(packed.cand_valid)
+    counts: Counter = Counter()
+    if not valid.any():
+        return counts
+    ff = np.asarray(plan_ffd_jit(packed).feasible)
+    bf = np.asarray(plan_ffd_jit(packed, best_fit=True).feasible)
+    greedy = ff | bf
+    d1 = np.asarray(
+        plan_repair_jit(packed, rounds=rounds, chain=False).feasible
+    )
+    d2 = np.asarray(plan_repair_jit(packed, rounds=rounds).feasible)
+    for c in np.flatnonzero(valid):
+        if greedy[c]:
+            counts["greedy"] += 1
+        elif d1[c]:
+            counts["depth1"] += 1
+        elif d2[c]:
+            counts["depth2"] += 1
+        else:
+            ilp = ilp_max_drains(
+                _slice_lane(packed, int(c)), time_limit=ilp_time_limit
+            )
+            if ilp is None:
+                counts["ilp-failed"] += 1
+            elif ilp > 0:
+                counts["deeper"] += 1
+            else:
+                counts["infeasible"] += 1
+    return counts
+
+
+class _PackedTap:
+    """Collects each planner tick's packed problem id-deduplicated, so a
+    drive loop can classify exactly the problems the controller solved."""
+
+    def __init__(self) -> None:
+        self.counts: Counter = Counter()
+        self.ticks = 0
+        self._last_id: Optional[int] = None
+
+    def __call__(self, packed: Optional[PackedCluster]) -> None:
+        if packed is None or id(packed) == self._last_id:
+            return
+        self._last_id = id(packed)
+        self.ticks += 1
+        self.counts += classify_packed(packed)
+
+
+def analyze_quality_runs(
+    seeds=range(3), configs: Optional[Dict] = None
+) -> Dict[str, Counter]:
+    """Chain-depth demand over the organic quality configs: every tick
+    of every drain-to-exhaustion run, every valid lane classified.
+    Returns {config name: Counter}. The chain3 BOUNDARY config is the
+    deliberate positive control (its lanes demand depth 3 by
+    construction); it is reported separately by the bench mode, never
+    mixed into the organic rows."""
+    from k8s_spot_rescheduler_tpu.bench.quality import drain_to_exhaustion
+    from k8s_spot_rescheduler_tpu.io.synthetic import (
+        QUALITY_CONFIGS,
+        generate_quality_cluster,
+    )
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    out: Dict[str, Counter] = {}
+    for name, spec in (configs or QUALITY_CONFIGS).items():
+        total: Counter = Counter()
+        for seed in seeds:
+            tap = _PackedTap()
+            client = generate_quality_cluster(
+                spec, seed, reschedule_evicted=True
+            )
+            drain_to_exhaustion(
+                client,
+                ReschedulerConfig(solver="numpy", resources=spec.resources),
+                on_packed=tap,
+            )
+            total += tap.counts
+        out[name] = total
+    return out
+
+
+def analyze_replay(
+    *, n_events: int = 300, seed: int = 0, constrained: bool = True
+) -> Counter:
+    """Chain-depth demand under churn: the constrained replay (spot
+    interruptions × the full predicate surface), every tick's lanes
+    classified."""
+    from k8s_spot_rescheduler_tpu.bench.replay import run_replay
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    tap = _PackedTap()
+    run_replay(
+        ReschedulerConfig(solver="numpy"),
+        n_events=n_events,
+        seed=seed,
+        constrained=constrained,
+        on_packed=tap,
+    )
+    return tap.counts

@@ -76,6 +76,31 @@ def device_only_ms(
     )
 
 
+def run_protocol(fused: Callable, device_packed, reps: int = 5) -> dict:
+    """Drive the full pinned protocol against a warmed device problem:
+    build the chained program and the RTT probe, warm both, time
+    ``reps`` alternating repetitions, and return ``protocol_record``.
+    The ONE driver both bench modes share — a protocol change edits
+    this function, never a call site."""
+    import time
+
+    import jax
+
+    chained_jit = make_chained(fused)
+    rtt_jit = jax.jit(lambda p: p.cand_valid.sum())
+    np.asarray(chained_jit(device_packed))
+    np.asarray(rtt_jit(device_packed))
+    chain_t, rtt_t = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(chained_jit(device_packed))
+        chain_t.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        np.asarray(rtt_jit(device_packed))
+        rtt_t.append(time.perf_counter() - t0)
+    return protocol_record(chain_t, rtt_t)
+
+
 def protocol_record(
     chain_times_s: Sequence[float],
     rtt_times_s: Sequence[float],

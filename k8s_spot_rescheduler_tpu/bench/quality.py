@@ -314,18 +314,24 @@ class _HintingPlanner:
         return report
 
 
-def drain_to_exhaustion(client, config, *, max_ticks: int = 10_000) -> int:
+def drain_to_exhaustion(
+    client, config, *, max_ticks: int = 10_000, on_packed=None
+) -> int:
     """Run the real control loop (zero cooldown) until no drain happens;
-    returns the number of nodes drained — the framework's quality number."""
+    returns the number of nodes drained — the framework's quality
+    number. ``on_packed`` (optional) receives each tick's packed problem
+    after planning — the chain-depth analyzer's tap
+    (bench/chain_depth.py; it id-deduplicates skipped ticks)."""
     import dataclasses
 
     from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
     from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
 
     config = dataclasses.replace(config, node_drain_delay=0.0)
+    inner = SolverPlanner(config)
     r = Rescheduler(
         client,
-        _HintingPlanner(SolverPlanner(config), client),
+        _HintingPlanner(inner, client),
         config,
         clock=client.clock,
         recorder=client,
@@ -335,6 +341,8 @@ def drain_to_exhaustion(client, config, *, max_ticks: int = 10_000) -> int:
     for _ in range(max_ticks):
         client.clock.advance(config.housekeeping_interval)
         result = r.tick()
+        if on_packed is not None:
+            on_packed(getattr(inner, "last_packed", None))
         if result.skipped == "unschedulable":
             # let evicted pods land; a permanently-pending pod ends the run
             stuck += 1

@@ -33,6 +33,7 @@ def run_replay(
     n_events: int = 1000,
     seed: int = 0,
     constrained: bool = False,
+    on_packed=None,
 ) -> Dict[str, float]:
     """Returns summary stats of a full replay run.
 
@@ -48,8 +49,9 @@ def run_replay(
     client, events = generate_replay(spec, n_events, seed)
     # drains every cooldown-free tick so churn keeps being consolidated
     config = dataclasses.replace(config, node_drain_delay=0.0)
+    planner = SolverPlanner(config)
     r = Rescheduler(
-        client, SolverPlanner(config), config, clock=client.clock, recorder=client
+        client, planner, config, clock=client.clock, recorder=client
     )
 
     plan_ms: List[float] = []
@@ -78,6 +80,9 @@ def run_replay(
         client.clock.advance(config.housekeeping_interval)
         evictions_before = len(client.evictions)
         result = r.tick()
+        if on_packed is not None:
+            # chain-depth analyzer tap (id-deduplicates skipped ticks)
+            on_packed(getattr(planner, "last_packed", None))
         if result.report is not None:
             plan_ms.append(result.report.solve_seconds * 1e3)
         drained += len(result.drained)

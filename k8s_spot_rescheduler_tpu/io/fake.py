@@ -359,7 +359,11 @@ class FakeCluster:
     def add_taint(self, node_name: str, taint: Taint) -> None:
         node = self.nodes[node_name]
         if taint not in node.taints:
-            node.taints.append(taint)
+            # REPLACE the list, never mutate in place: the columnar
+            # store's per-row mask cache keys on the taint list's
+            # identity (models/columnar._spot_taint_rows), exactly like
+            # the real kube/watch paths deliver fresh objects
+            node.taints = node.taints + [taint]
 
     def remove_taint(self, node_name: str, taint_key: str) -> None:
         node = self.nodes.get(node_name)

@@ -308,6 +308,16 @@ class ColumnarStore:
         self._real_node_pos: Dict[tuple, tuple] = {}
         self._sel_node_pos: Dict[tuple, tuple] = {}
         self._naff_node_pos: Dict[tuple, tuple] = {}
+        # per-ROW static mask cache (round 5, the pack hotspot): the
+        # content-keyed _node_mask_cache dedups masks, but BUILDING its
+        # key (taints/labels tuples) per spot row per tick was ~half of
+        # pack time at config 3. Rows re-validate by object identity —
+        # safe because every mutation path replaces objects (watch/kube
+        # deliver fresh NodeSpecs; update_node swaps node_objs;
+        # FakeCluster.add_taint replaces the taint list).
+        self._nmask_matrix = np.zeros((0, 0), np.uint32)
+        self._nmask_node: List[object] = []
+        self._nmask_taints: List[object] = []
 
         # affinity-profile interning: (group, ns, match sel, labels) -> id;
         # the per-profile mask matrix depends on the tick's selector
@@ -1136,6 +1146,7 @@ class ColumnarStore:
             self._refresh_sections(table)
             self._table_key = key
             self._node_mask_cache.clear()  # rebuilt from position caches
+            self._nmask_matrix = np.zeros((0, 0), np.uint32)  # row cache too
             W = table.words
             rows = np.zeros((len(self._tol_lists), W), np.uint32)
             off, pairs = self._sel_section
@@ -1221,6 +1232,34 @@ class ColumnarStore:
                 hosted[:, j], np.uint32(0), np.uint32(1 << (pos % 32))
             )
         return bits
+
+    def _spot_taint_rows(
+        self, spot_order: np.ndarray, table: TaintTable
+    ) -> np.ndarray:
+        """[S_actual, W] static node-side words for the probe-ordered
+        spot pool — ``_node_taint_mask`` behind a per-ROW identity
+        cache. A row recomputes only when its node object or its taint
+        list is a different OBJECT than last tick (all mutation paths
+        replace objects; see __init__ comment); the toleration-matrix
+        rebuild wipes the cache wholesale on any table change."""
+        n = len(self.node_objs)
+        if self._nmask_matrix.shape != (n, table.words):
+            self._nmask_matrix = np.zeros((n, table.words), np.uint32)
+            self._nmask_node = [None] * n
+            self._nmask_taints = [None] * n
+        objs = self.node_objs
+        nodes_c = self._nmask_node
+        taints_c = self._nmask_taints
+        matrix = self._nmask_matrix
+        for r in spot_order:
+            r = int(r)
+            node = objs[r]
+            taints = node.taints
+            if nodes_c[r] is not node or taints_c[r] is not taints:
+                matrix[r] = self._node_taint_mask(r, table)
+                nodes_c[r] = node
+                taints_c[r] = taints
+        return matrix[spot_order]
 
     def _node_taint_mask(self, row: int, table: TaintTable) -> np.ndarray:
         node = self.node_objs[row]
@@ -1677,8 +1716,9 @@ class ColumnarStore:
             ).astype(np.int32)
             packed.spot_max_pods[:S_actual] = self.n_max_pods[spot_order]
             packed.spot_ok[:S_actual] = ~self.n_unsched[spot_order]
-            for i, r in enumerate(spot_order):
-                packed.spot_taints[i] = self._node_taint_mask(int(r), table)
+            packed.spot_taints[:S_actual] = self._spot_taint_rows(
+                spot_order, table
+            )
             paff_bits = self._pod_affinity_node_bits(sp_rows, sp, S_actual, W)
             if paff_bits is not None:
                 packed.spot_taints[:S_actual] |= paff_bits
@@ -1686,25 +1726,45 @@ class ColumnarStore:
                 # per-tick context node sides: a spot node repels a
                 # spread carrier when it lacks the topology key or sits
                 # in a refused domain, and a zone-paff carrier when its
-                # zone hosts no qualifying match
+                # zone hosts no qualifying match. Vectorized per entry
+                # over the spot axis (advisor r4: the S×E Python loop
+                # was hot at scale): one per-topology-key domain column,
+                # then numpy membership tests per entry.
                 entries = [
                     (i, e)
                     for i, e in enumerate(table.taints)
                     if isinstance(e, (SpreadBit, ZonePodAffinityBit))
                 ]
-                for si, r in enumerate(spot_order):
-                    labels = self.node_objs[int(r)].labels
-                    for pos, e in entries:
-                        if isinstance(e, SpreadBit):
-                            d = labels.get(e.topology_key)
-                            bad = d is None or d in e.refused
-                        else:
-                            z = labels.get(ZONE_LABEL)
-                            bad = z is None or z not in e.allowed_zones
-                        if bad:
-                            packed.spot_taints[si, pos // 32] |= np.uint32(
-                                1 << (pos % 32)
-                            )
+                MISSING = "\x00"  # impossible as a k8s label value
+                topo_cols: Dict[str, np.ndarray] = {}
+
+                def col(topo):
+                    vals = topo_cols.get(topo)
+                    if vals is None:
+                        vals = topo_cols[topo] = np.array(
+                            [
+                                self.node_objs[int(r)].labels.get(
+                                    topo, MISSING
+                                )
+                                for r in spot_order
+                            ]
+                        )
+                    return vals
+
+                for pos, e in entries:
+                    if isinstance(e, SpreadBit):
+                        vals = col(e.topology_key)
+                        bad = (vals == MISSING) | np.isin(
+                            vals, list(e.refused)
+                        )
+                    else:
+                        vals = col(ZONE_LABEL)
+                        bad = (vals == MISSING) | ~np.isin(
+                            vals, list(e.allowed_zones)
+                        )
+                    packed.spot_taints[:S_actual][bad, pos // 32] |= (
+                        np.uint32(1 << (pos % 32))
+                    )
             aff = np.zeros((S_actual, AFFINITY_WORDS), np.uint32)
             np.bitwise_or.at(aff, sp, self._host_matrix[self.p_aff_id[sp_rows]])
             if self._zone_universe:

@@ -259,6 +259,9 @@ class SolverPlanner:
         self._pad_c = max(self._pad_c, packed.slot_req.shape[0])
         self._pad_k = max(self._pad_k, packed.slot_req.shape[1])
         self._pad_s = max(self._pad_s, packed.spot_free.shape[0])
+        # the tick's packed problem, for offline analyzers
+        # (bench/chain_depth.py) — a tuple of numpy refs, no copy
+        self.last_packed = packed
 
         for blocked in meta.blocking_pods():
             log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)

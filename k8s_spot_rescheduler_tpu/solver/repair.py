@@ -80,7 +80,11 @@ def _partial_scan_step(static, carry: _Carry, slot):
     return new_carry._replace(feasible=carry.feasible), chosen
 
 
-def _repair_round(static, state: _RepairCarry, round_idx):
+def _repair_round(static, chain, state: _RepairCarry, round_idx):
+    """``chain`` (compile-time bool) gates the depth-2 block — the
+    chain-depth-demand analyzer (bench/chain_depth.py) compiles a
+    depth-1-only variant to classify which lanes genuinely NEED the
+    chain; production always passes True."""
     (spot_max_pods, spot_taints_t, spot_ok, spot_aff_static,
      slot_req, slot_valid, slot_tol, slot_aff) = static
     C, K, R = slot_req.shape
@@ -165,77 +169,89 @@ def _repair_round(static, state: _RepairCarry, round_idx):
 
     do_direct = has_gap & any_q & can_move & aff_ok_p  # [C]
 
+    if not chain:
+        # depth-1-only variant: no chain block compiles at all; the
+        # masked arithmetic below folds to the direct move
+        do_chain = jnp.zeros_like(do_direct)
+        sr_star = s2
+        s3 = s2
+        req_r = req_q
+        aff_r = aff_q
+        aff_ej_r = aff_ej
+        r = q
+
     # ---- depth-2 chain (round 4): when q cannot re-place DIRECTLY,
     # relocate it onto a third pod r's node and re-place r elsewhere
     # (p -> s_q, q -> s_r, r -> s3) — the two-pod interlock that
     # defeated depth-1 (docs/RESULTS.md boundary). r is elected by the
     # same rotation; its own re-placement and both exact affinity gates
     # are verified post-election, with rotation retrying on failure.
-    word_ok_q = jnp.all(
-        (spot_taints_t & ~tol_q[:, :, None]) == 0, axis=1
-    )  # [C, S]
-    static_q = word_ok_q & spot_ok
-    static_q_at = jnp.take_along_axis(static_q, s_q, axis=1)  # [C, K]
-    res_ok_r = jnp.all(
-        free_at_q + req_t - req_q[:, :, None] >= 0, axis=1
-    )  # [C, K] — q fits r's node once r is ejected
-    eligible_r = (
-        placed & (s_q != sq_star[:, None]) & static_q_at & res_ok_r
-    )  # [C, K]
-    n_r = eligible_r.sum(axis=-1)
-    rank_r = jnp.cumsum(eligible_r, axis=-1) - 1
-    # r rotates on an INDEPENDENT schedule (divided by the q-rotation
-    # period): keying both to round_idx would lock the pairings to
-    # q ≡ r (mod gcd(n_unlock, n_r)) and leave whole (q, r) pairs
-    # unreachable at any round count (round-4 review finding); this way
-    # n_unlock x n_r rounds sweep every pairing
-    want_r = jnp.where(
-        n_r > 0,
-        (round_idx // jnp.maximum(n_unlock, 1)) % jnp.maximum(n_r, 1),
-        -1,
-    )
-    is_r = eligible_r & (rank_r == want_r[:, None])
-    r = jnp.argmax(is_r, axis=-1)  # [C]
-    any_r = jnp.any(is_r, axis=-1)
-    sr_star = jnp.take_along_axis(s_q, r[:, None], axis=1)[:, 0]  # [C]
-    req_r = jnp.take_along_axis(slot_req, r[:, None, None], axis=1)[:, 0]
-    tol_r = jnp.take_along_axis(slot_tol, r[:, None, None], axis=1)[:, 0]
-    aff_r = jnp.take_along_axis(slot_aff, r[:, None, None], axis=1)[:, 0]
+    if chain:
+        word_ok_q = jnp.all(
+            (spot_taints_t & ~tol_q[:, :, None]) == 0, axis=1
+        )  # [C, S]
+        static_q = word_ok_q & spot_ok
+        static_q_at = jnp.take_along_axis(static_q, s_q, axis=1)  # [C, K]
+        res_ok_r = jnp.all(
+            free_at_q + req_t - req_q[:, :, None] >= 0, axis=1
+        )  # [C, K] — q fits r's node once r is ejected
+        eligible_r = (
+            placed & (s_q != sq_star[:, None]) & static_q_at & res_ok_r
+        )  # [C, K]
+        n_r = eligible_r.sum(axis=-1)
+        rank_r = jnp.cumsum(eligible_r, axis=-1) - 1
+        # r rotates on an INDEPENDENT schedule (divided by the q-rotation
+        # period): keying both to round_idx would lock the pairings to
+        # q ≡ r (mod gcd(n_unlock, n_r)) and leave whole (q, r) pairs
+        # unreachable at any round count (round-4 review finding); this way
+        # n_unlock x n_r rounds sweep every pairing
+        want_r = jnp.where(
+            n_r > 0,
+            (round_idx // jnp.maximum(n_unlock, 1)) % jnp.maximum(n_r, 1),
+            -1,
+        )
+        is_r = eligible_r & (rank_r == want_r[:, None])
+        r = jnp.argmax(is_r, axis=-1)  # [C]
+        any_r = jnp.any(is_r, axis=-1)
+        sr_star = jnp.take_along_axis(s_q, r[:, None], axis=1)[:, 0]  # [C]
+        req_r = jnp.take_along_axis(slot_req, r[:, None, None], axis=1)[:, 0]
+        tol_r = jnp.take_along_axis(slot_tol, r[:, None, None], axis=1)[:, 0]
+        aff_r = jnp.take_along_axis(slot_aff, r[:, None, None], axis=1)[:, 0]
 
-    fits_r = fit_mask_t(
-        jnp,
-        free_t=state.free,
-        count=state.count,
-        max_pods=spot_max_pods,
-        node_taints_t=spot_taints_t,
-        node_ok=spot_ok,
-        node_aff_t=state.aff,
-        req=req_r,
-        tol=tol_r,
-        aff=aff_r,
-    )  # [C, S]
-    fits_r &= (jnp.arange(S)[None, :] != sr_star[:, None]) & (
-        jnp.arange(S)[None, :] != sq_star[:, None]
-    )
-    s3 = jnp.argmax(fits_r, axis=-1)  # [C]
-    r_can_move = jnp.any(fits_r, axis=-1)
+        fits_r = fit_mask_t(
+            jnp,
+            free_t=state.free,
+            count=state.count,
+            max_pods=spot_max_pods,
+            node_taints_t=spot_taints_t,
+            node_ok=spot_ok,
+            node_aff_t=state.aff,
+            req=req_r,
+            tol=tol_r,
+            aff=aff_r,
+        )  # [C, S]
+        fits_r &= (jnp.arange(S)[None, :] != sr_star[:, None]) & (
+            jnp.arange(S)[None, :] != sq_star[:, None]
+        )
+        s3 = jnp.argmax(fits_r, axis=-1)  # [C]
+        r_can_move = jnp.any(fits_r, axis=-1)
 
-    # exact affinity of r's node after r leaves, for q's arrival
-    others_r = placed & (state.assign == sr_star[:, None]) & (
-        ks != r[:, None]
-    )
-    contrib_r = jnp.where(
-        others_r[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
-    )
-    aff_ej_r = jax.lax.reduce(
-        contrib_r, np.uint32(0), jax.lax.bitwise_or, (2,)
-    ) | spot_aff_static[sr_star]  # [C, A]
-    aff_ok_q = jnp.all((aff_q & aff_ej_r) == 0, axis=1)  # [C]
+        # exact affinity of r's node after r leaves, for q's arrival
+        others_r = placed & (state.assign == sr_star[:, None]) & (
+            ks != r[:, None]
+        )
+        contrib_r = jnp.where(
+            others_r[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
+        )
+        aff_ej_r = jax.lax.reduce(
+            contrib_r, np.uint32(0), jax.lax.bitwise_or, (2,)
+        ) | spot_aff_static[sr_star]  # [C, A]
+        aff_ok_q = jnp.all((aff_q & aff_ej_r) == 0, axis=1)  # [C]
 
-    do_chain = (
-        has_gap & any_q & ~can_move & aff_ok_p
-        & any_r & r_can_move & aff_ok_q
-    )
+        do_chain = (
+            has_gap & any_q & ~can_move & aff_ok_p
+            & any_r & r_can_move & aff_ok_q
+        )
     do = do_direct | do_chain  # [C]
 
     # q's destination: s2 (direct) or r's node (chain); the +1 pod count
@@ -296,9 +312,11 @@ def _repair_round(static, state: _RepairCarry, round_idx):
 
 
 def plan_repair(
-    packed: PackedCluster, rounds: int = DEFAULT_ROUNDS
+    packed: PackedCluster, rounds: int = DEFAULT_ROUNDS, chain: bool = True
 ) -> SolveResult:
-    """Jittable partial-pack + bounded repair + from-scratch validation."""
+    """Jittable partial-pack + bounded repair + from-scratch validation.
+    ``chain=False`` compiles the depth-1-only search — used solely by
+    the chain-depth-demand analyzer (bench/chain_depth.py)."""
     C, K, R = packed.slot_req.shape
     S = packed.spot_free.shape[0]
 
@@ -338,7 +356,7 @@ def plan_repair(
         jnp.asarray(packed.slot_aff),
     )
     state, _ = jax.lax.scan(
-        functools.partial(_repair_round, repair_static),
+        functools.partial(_repair_round, repair_static, chain),
         state,
         jnp.arange(rounds),
     )
@@ -348,15 +366,16 @@ def plan_repair(
     return SolveResult(feasible=feasible, assignment=assignment)
 
 
-plan_repair_jit = jax.jit(plan_repair, static_argnames=("rounds",))
+plan_repair_jit = jax.jit(plan_repair, static_argnames=("rounds", "chain"))
 
 
 def plan_repair_oracle(
-    packed: PackedCluster, rounds: int = DEFAULT_ROUNDS
+    packed: PackedCluster, rounds: int = DEFAULT_ROUNDS, chain: bool = True
 ) -> SolveResult:
     """Serial NumPy mirror of ``plan_repair`` — identical partial pass,
     rotation, exact affinity ejection, and validation, for bit-parity
-    tests against the device solver."""
+    tests against the device solver. ``chain=False`` mirrors the
+    depth-1-only analyzer variant."""
     C, K, R = packed.slot_req.shape
     S = packed.spot_free.shape[0]
     assign = np.full((C, K), -1, np.int32)
@@ -458,6 +477,8 @@ def plan_repair_oracle(
                 affs[c, s2] |= aff_q
                 affs[c, sq] = aff_ej | aff_p  # exact replacement, not OR
                 continue
+            if not chain:
+                continue  # depth-1-only analyzer variant
             # depth-2 chain (device lockstep): q cannot re-place
             # directly; move it onto a third pod r's node and re-place
             # r elsewhere (p -> s_q, q -> s_r, r -> s3)

@@ -15,6 +15,7 @@ import sys
 from pathlib import Path
 
 import bench
+import pytest
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -99,10 +100,16 @@ def _run_bench(*args, timeout=600):
 
 def test_e2e_backend_available_emits_device_json():
     """With a healthy backend (CPU here; axon on the driver) the JSON line
-    carries the device and no error field — the TPU-capture path."""
+    carries the device and no error field — the TPU-capture path. The
+    tunneled axon backend goes through sick phases where initialization
+    hangs for minutes (memory: tpu-tunnel-quirks); when the probe
+    reports exactly that, the HEALTHY-path assertion has no backend to
+    run against — skip rather than fail on weather."""
     r = _run_bench("--config", "1", "--repeats", "1", "--watchdog", "500")
     assert r.returncode == 0, r.stderr[-800:]
     out = json.loads(r.stdout.strip().splitlines()[-1])
+    if "backend unavailable" in out.get("error", ""):
+        pytest.skip("axon tunnel currently unavailable: " + out["error"])
     assert out["value"] is not None
     assert out["vs_baseline"] is not None
     assert "device" in out
