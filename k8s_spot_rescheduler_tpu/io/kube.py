@@ -552,14 +552,37 @@ def decode_node(obj: dict) -> NodeSpec:
 
 
 def decode_pdb(obj: dict) -> PDBSpec:
+    """Round 5: the PDB selector parses the full
+    matchLabels/matchExpressions surface via the shared term decoder.
+    Shapes beyond it fall back to the EMPTY selector — which for a PDB
+    means "every pod in the namespace", the conservative direction (an
+    unparseable PDB must block drains, never under-protect; the
+    apiserver additionally enforces PDBs on the eviction subresource,
+    so this conservatism costs drains, not safety)."""
+    from k8s_spot_rescheduler_tpu.predicates.selectors import MATCH_NOTHING
+
     meta = obj.get("metadata", {})
+    sel = (obj.get("spec", {}) or {}).get("selector")
+    if sel is None:
+        # policy/v1: a NIL selector selects zero pods
+        # (labels.Nothing()) — distinct from {} which selects all
+        reqs: tuple = MATCH_NOTHING
+    else:
+        decoded, _nothing, unmodeled = _decode_term(
+            {"labelSelector": sel if isinstance(sel, dict) else {}},
+            "default",
+        )
+        if unmodeled:
+            # empty selector ({} -> select-all) is also routed here by
+            # the term decoder (it refuses empty selectors); both land
+            # on the conservative select-all shape a PDB defines for {}
+            reqs = ()
+        else:
+            reqs = decoded[1]
     return PDBSpec(
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
-        match_labels=(obj.get("spec", {}).get("selector", {}) or {}).get(
-            "matchLabels", {}
-        )
-        or {},
+        match_labels=reqs,
         disruptions_allowed=int(
             obj.get("status", {}).get("disruptionsAllowed", 0) or 0
         ),

@@ -12,7 +12,7 @@ least-requested-first, pods biggest-CPU-request-first
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Iterable, List, Mapping, Optional, Sequence
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
@@ -259,17 +259,41 @@ class PVSpec:
 @dataclasses.dataclass
 class PDBSpec:
     """PodDisruptionBudget, reduced to the evictability decision: which pods
-    it selects and how many more disruptions it currently allows."""
+    it selects and how many more disruptions it currently allows.
+
+    ``match_labels`` holds the canonical requirement selector
+    (predicates/selectors.py; round 5 widened to the full
+    matchLabels/matchExpressions operator surface — the reference gets
+    this free through cluster-autoscaler's drain filter,
+    rescheduler.go:231). Construction accepts the matchLabels-dict
+    shorthand. An EMPTY selector selects every pod in the namespace
+    (k8s PDB semantics — also the conservative decode fallback for
+    selector shapes beyond the modeled surface, so an unparseable PDB
+    blocks rather than under-protects)."""
 
     name: str
     namespace: str = "default"
-    match_labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    match_labels: Tuple = ()
     disruptions_allowed: int = 0
+
+    def __post_init__(self) -> None:
+        if isinstance(self.match_labels, dict):
+            from k8s_spot_rescheduler_tpu.predicates.selectors import (
+                canon_labels,
+            )
+
+            self.match_labels = canon_labels(self.match_labels)
+        else:
+            self.match_labels = tuple(sorted(set(self.match_labels)))
 
     def selects(self, pod: PodSpec) -> bool:
         if pod.namespace != self.namespace:
             return False
-        return all(pod.labels.get(k) == v for k, v in self.match_labels.items())
+        from k8s_spot_rescheduler_tpu.predicates.selectors import (
+            selector_matches,
+        )
+
+        return selector_matches(self.match_labels, pod.labels)
 
 
 def pod_cpu_requests(pod: PodSpec) -> int:

@@ -1386,12 +1386,11 @@ class ColumnarStore:
             if pdb.disruptions_allowed >= 1:
                 continue
             if pdb.match_labels:
-                sets = [
-                    self._label_index.get((pdb.namespace, k, v), set())
-                    for k, v in pdb.match_labels.items()
-                ]
-                rows = set.intersection(*sorted(sets, key=len)) if all(sets) else set()
+                # canonical requirement selector (round 5 widened):
+                # the shared index-backed matcher handles every operator
+                rows = self._selector_rows(pdb.namespace, pdb.match_labels)
             else:
+                # empty PDB selector: every pod in the namespace
                 rows = self._ns_index.get(pdb.namespace, set())
             for r in rows:
                 if r < hi and not blocked[r]:

@@ -70,10 +70,21 @@ def req_matches(req: Req, labels) -> bool:
     return v is None  # DoesNotExist
 
 
+# A canonical selector that can match NO pod (the "" key must both
+# exist and not exist): the exact encoding of k8s's nil-selector
+# semantics (labels.Nothing()) — decode_pdb uses it for PDBs created
+# without a spec.selector, which select zero pods.
+MATCH_NOTHING: Selector = (("", "DoesNotExist", ()), ("", "Exists", ()))
+
+
 def selector_matches(sel: Selector, labels) -> bool:
-    """AND over the selector's requirements. The empty selector matches
-    everything (k8s: an empty LabelSelector selects all objects) — but
-    decode never produces one (empty selectors stay unmodeled)."""
+    """AND over the selector's requirements. The EMPTY selector matches
+    everything (k8s: an empty LabelSelector selects all objects) — the
+    affinity decoders never produce one (empty selectors stay
+    unmodeled), but ``decode_pdb`` deliberately does: a PDB's ``{}``
+    selector selects every pod in its namespace, and the empty selector
+    is also its conservative fallback for unparseable shapes. A nil
+    PDB selector is ``MATCH_NOTHING`` instead."""
     return all(req_matches(r, labels) for r in sel)
 
 

@@ -108,3 +108,107 @@ def test_hard_topology_spread_decode():
     assert pod([beyond]).unmodeled_constraints
     assert not pod([beyond]).spread_constraints
     assert pod("garbage").unmodeled_constraints  # malformed: conservative
+
+
+def test_pdb_selector_operators_widened():
+    """Round 5: PDB selectors parse the full matchExpressions operator
+    surface; shapes beyond it select EVERY pod in the namespace (the
+    conservative direction — an unparseable PDB blocks, never
+    under-protects)."""
+    from k8s_spot_rescheduler_tpu.io.kube import decode_pdb
+    from k8s_spot_rescheduler_tpu.models.cluster import PodSpec
+
+    def pdb_obj(selector):
+        return {
+            "metadata": {"name": "pdb", "namespace": "shop"},
+            "spec": {"selector": selector},
+            "status": {"disruptionsAllowed": 0},
+        }
+
+    pdb = decode_pdb(pdb_obj({"matchExpressions": [
+        {"key": "app", "operator": "In", "values": ["web", "api"]},
+        {"key": "canary", "operator": "DoesNotExist"},
+    ]}))
+    web = PodSpec(name="w", namespace="shop", labels={"app": "web"})
+    canary = PodSpec(name="c", namespace="shop",
+                     labels={"app": "web", "canary": "1"})
+    other = PodSpec(name="o", namespace="shop", labels={"app": "db"})
+    foreign = PodSpec(name="f", namespace="other", labels={"app": "web"})
+    assert pdb.selects(web)
+    assert not pdb.selects(canary)
+    assert not pdb.selects(other)
+    assert not pdb.selects(foreign)
+
+    # beyond the surface (unknown operator): select-all in namespace
+    weird = decode_pdb(pdb_obj({"matchExpressions": [
+        {"key": "app", "operator": "Gt", "values": ["1"]}]}))
+    assert weird.match_labels == ()
+    assert weird.selects(other) and weird.selects(web)
+    assert not weird.selects(foreign)
+
+    # empty selector: k8s PDB semantics select every pod in namespace
+    empty = decode_pdb(pdb_obj({}))
+    assert empty.selects(other)
+
+    # NIL selector (field absent): policy/v1 selects ZERO pods
+    nil = decode_pdb({
+        "metadata": {"name": "pdb", "namespace": "shop"},
+        "spec": {},
+        "status": {"disruptionsAllowed": 0},
+    })
+    assert not nil.selects(other) and not nil.selects(web)
+
+
+def test_pdb_expression_selector_blocks_drain_end_to_end():
+    """An exhausted PDB whose selector is pure matchExpressions must
+    block its node's drain on BOTH pack paths (the round-4 model
+    ignored matchExpressions entirely — the under-protecting
+    direction)."""
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+    from k8s_spot_rescheduler_tpu.io.kube import decode_pdb
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from tests.fixtures import (
+        ON_DEMAND_LABEL,
+        ON_DEMAND_LABELS,
+        SPOT_LABEL,
+        SPOT_LABELS,
+        make_node,
+        make_pod,
+    )
+
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_pod(make_pod("mover", 300, "od-1", labels={"tier": "be"}))
+    fc.pdbs.append(decode_pdb({
+        "metadata": {"name": "be-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchExpressions": [
+            {"key": "tier", "operator": "Exists"}]}},
+        "status": {"disruptionsAllowed": 0},
+    }))
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    packed, meta = pack_cluster(node_map, fc.pdbs,
+                                resources=("cpu", "memory"))
+    assert not packed.cand_valid[:1].any()  # blocked, not drainable
+    assert meta.blocking_pods()[0].pod.name == "mover"
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    col, cmeta = store.pack(fc.pdbs)
+    for field in packed._fields:
+        np.testing.assert_array_equal(
+            getattr(packed, field), getattr(col, field), err_msg=field
+        )
+    assert cmeta.blocking_pods()[0].pod.name == "mover"

@@ -138,7 +138,7 @@ def test_wire_pod_decode_surface():
     assert bare.controller_ref() is None  # non-replicated
 
     pdb = decode_pdb(data["pdbs"][0])
-    assert pdb.match_labels == {"app": "web"}
+    assert pdb.match_labels == (("app", "In", ("web",)),)
     assert pdb.disruptions_allowed == 1
 
 
