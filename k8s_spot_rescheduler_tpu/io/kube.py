@@ -409,16 +409,45 @@ def decode_pod_affinity(paff: dict, namespace: str = "default") -> tuple:
     return tuple(sorted(set(host))), tuple(sorted(set(zone))), False
 
 
-# Fields whose presence changes PodTopologySpread counting semantics in
-# ways this model does not reproduce; a hard constraint carrying any of
-# them stays conservatively unmodeled (even an explicit default value —
-# mirroring the namespaceSelector treatment in _decode_affinity_block).
-_SPREAD_MODIFIER_KEYS = (
-    "minDomains",
-    "matchLabelKeys",
-    "nodeAffinityPolicy",
-    "nodeTaintsPolicy",
-)
+# Fields whose NON-DEFAULT values change PodTopologySpread counting
+# semantics in ways this model does not reproduce. Round 5: an explicit
+# DEFAULT value is semantically identical to the field being absent and
+# is accepted (common in manifests that spell out defaults) — the
+# model's existing conservatism analysis already covers the default
+# semantics: nodeTaintsPolicy=Ignore IS how the counts are computed
+# (dead/tainted nodes' domains and pods counted), and
+# nodeAffinityPolicy=Honor is deliberately over-approximated (ignoring
+# the affinity filter only ever lowers the domain min — stricter, the
+# safe direction). minDomains=null and matchLabelKeys=[] are the
+# absent-equivalent encodings of their fields. Anything else stays
+# conservatively unmodeled.
+def _spread_modifiers_default(c: dict) -> bool:
+    """True iff every present counting-modifier field carries its
+    default-equivalent value (exact lockstep with native/ingest.cc
+    ``spread_modifier_is_default``): minDomains null / integer 1 (nil
+    behaves as 1 per KEP-3022 — a non-int 1.0 is rejected, matching
+    the native text comparison), matchLabelKeys null / [],
+    nodeAffinityPolicy null / "Honor", nodeTaintsPolicy null /
+    "Ignore"."""
+    if "minDomains" in c:
+        v = c["minDomains"]
+        if v is not None and not (
+            isinstance(v, int) and not isinstance(v, bool) and v == 1
+        ):
+            return False
+    if "matchLabelKeys" in c:
+        v = c["matchLabelKeys"]
+        if v is not None and v != []:
+            return False
+    if "nodeAffinityPolicy" in c:
+        v = c["nodeAffinityPolicy"]
+        if v is not None and v != "Honor":
+            return False
+    if "nodeTaintsPolicy" in c:
+        v = c["nodeTaintsPolicy"]
+        if v is not None and v != "Ignore":
+            return False
+    return True
 _SPREAD_TOPOLOGY_KEYS = ("kubernetes.io/hostname", ZONE_TOPOLOGY_KEY)
 
 
@@ -431,9 +460,9 @@ def decode_topology_spread(spread) -> tuple:
     topologyKey hostname/zone, integer maxSkew >= 1, a non-empty
     selector in the round-5 widened operator form (matchLabels and/or
     matchExpressions with In/NotIn/Exists/DoesNotExist; spread is
-    always own-namespace per the k8s API), and none of the
-    counting-semantics modifier fields (minDomains, matchLabelKeys,
-    nodeAffinityPolicy, nodeTaintsPolicy). Explicit ScheduleAnyway
+    always own-namespace per the k8s API), and counting-semantics
+    modifier fields only at their default-equivalent values
+    (``_spread_modifiers_default``). Explicit ScheduleAnyway
     entries are soft — advisory to the real scheduler — and dropped.
     Any hard entry beyond the canonical shape marks the whole pod
     unmodeled (conservatively unplaceable). Canonical form:
@@ -451,7 +480,7 @@ def decode_topology_spread(spread) -> tuple:
             return (), True
         if c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway":
             continue  # soft: the scheduler only prefers, never refuses
-        if any(k in c for k in _SPREAD_MODIFIER_KEYS):
+        if not _spread_modifiers_default(c):
             return (), True
         topo = c.get("topologyKey")
         if topo not in _SPREAD_TOPOLOGY_KEYS:

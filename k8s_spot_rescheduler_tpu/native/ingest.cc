@@ -768,9 +768,31 @@ bool has_sep_bytes(std::string_view s) {
 // REC_SEP; entry = topo UNIT_SEP skew UNIT_SEP reqs, reqs joined by
 // TERM_SEP, req = key VAL_SEP op VAL_SEP values (VAL_SEP-joined).
 // Source order; the Python side canonicalizes (sort + dedup) on parse.
-static const char* const kSpreadModifierKeys[] = {
-    "minDomains", "matchLabelKeys", "nodeAffinityPolicy",
-    "nodeTaintsPolicy"};
+// Round 5: explicit DEFAULT values of the counting-modifier fields are
+// semantically identical to absence and accepted (lockstep with
+// io/kube.py _spread_modifiers_default): minDomains null/1 (nil
+// behaves as 1 per KEP-3022), matchLabelKeys null/[], nodeAffinityPolicy
+// null/"Honor", nodeTaintsPolicy null/"Ignore". Anything else keeps the
+// pod conservatively unmodeled.
+bool spread_modifier_is_default(const Val* c) {
+  if (const Val* v = c->get("minDomains")) {
+    if (v->kind != Val::Null && !(v->kind == Val::Num && v->text == "1"))
+      return false;
+  }
+  if (const Val* v = c->get("matchLabelKeys")) {
+    if (v->kind != Val::Null && !(v->kind == Val::Arr && v->arr.empty()))
+      return false;
+  }
+  if (const Val* v = c->get("nodeAffinityPolicy")) {
+    if (v->kind != Val::Null && !(v->kind == Val::Str && v->text == "Honor"))
+      return false;
+  }
+  if (const Val* v = c->get("nodeTaintsPolicy")) {
+    if (v->kind != Val::Null && !(v->kind == Val::Str && v->text == "Ignore"))
+      return false;
+  }
+  return true;
+}
 
 bool json_int_ge1(const Val* v) {
   // Python's json gives int only for digit literals (no '.', no
@@ -801,11 +823,9 @@ void extract_topology_spread(const Val* spread, bool* unmodeled,
     const Val* wu = c->get("whenUnsatisfiable");
     if (wu && wu->kind == Val::Str && wu->text == "ScheduleAnyway")
       continue;  // soft: advisory only
-    for (const char* key : kSpreadModifierKeys) {
-      if (c->get(key) != nullptr) {
-        *unmodeled = true;
-        return;
-      }
+    if (!spread_modifier_is_default(c)) {
+      *unmodeled = true;
+      return;
     }
     const Val* topo = c->get("topologyKey");
     if (!topo || topo->kind != Val::Str ||

@@ -110,8 +110,10 @@ def test_decode_soft_entries_ignored():
                        "matchExpressions": [{}]}},  # malformed expression
     {"minDomains": 2},                            # counting modifier
     {"matchLabelKeys": ["rev"]},
-    {"nodeAffinityPolicy": "Honor"},              # even the default value
-    {"nodeTaintsPolicy": "Ignore"},
+    # round 5: explicit DEFAULT modifier values are modeled; only
+    # non-default values stay conservative
+    {"nodeAffinityPolicy": "Ignore"},
+    {"nodeTaintsPolicy": "Honor"},
 ])
 def test_decode_beyond_canonical_unmodeled(mutate):
     entry = dict(_CANON)
@@ -530,3 +532,58 @@ def test_drain_respects_spread_end_to_end():
     assert result.drained == ["od-1"]
     fc.clock.advance(10.0)
     assert fc.pods["default/web-1"].node_name == "spot-b1"
+
+
+def test_decode_explicit_default_modifiers_modeled():
+    """Round 5: counting-modifier fields carrying their DEFAULT values
+    are semantically identical to absence and stay modeled — manifests
+    that spell out defaults must not collapse to unplaceable. Both
+    decode paths agree."""
+    import json
+
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+
+    entry = dict(
+        _CANON,
+        minDomains=None,
+        matchLabelKeys=[],
+        nodeAffinityPolicy="Honor",
+        nodeTaintsPolicy="Ignore",
+    )
+    pod = decode_pod(_spread_pod([entry]))
+    assert pod.spread_constraints == (
+        (ZONE_LABEL, 1, (("app", "In", ("web",)),)),
+    )
+    assert not pod.unmodeled_constraints
+    one = dict(_CANON, minDomains=1)  # nil behaves as 1 (KEP-3022)
+    pod = decode_pod(_spread_pod([one]))
+    assert pod.spread_constraints and not pod.unmodeled_constraints
+
+    # NON-default values still conservative
+    for mutate in (
+        {"minDomains": 2},
+        {"minDomains": True},
+        {"matchLabelKeys": ["rev"]},
+        {"nodeAffinityPolicy": "Ignore"},
+        {"nodeTaintsPolicy": "Honor"},
+    ):
+        bad = dict(_CANON, **mutate)
+        pod = decode_pod(_spread_pod([bad]))
+        assert pod.unmodeled_constraints, mutate
+
+    if not native_ingest.available():
+        pytest.skip("native library unavailable")
+    shapes = [entry, one,
+              dict(_CANON, minDomains=2),
+              dict(_CANON, nodeTaintsPolicy="Honor")]
+    objs = [_spread_pod([c]) for c in shapes]
+    for i, o in enumerate(objs):
+        o["metadata"] = dict(o["metadata"], name=f"p{i}", uid=f"u{i}")
+    batch = native_ingest.parse_pod_list(
+        json.dumps({"items": objs}).encode()
+    )
+    for i, o in enumerate(objs):
+        want = decode_pod(o)
+        got = batch.view(i)
+        assert got.spread_constraints == want.spread_constraints, i
+        assert got.unmodeled_constraints == want.unmodeled_constraints, i
