@@ -257,6 +257,7 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
 
 
 from k8s_spot_rescheduler_tpu.predicates.selectors import (
+    ALL_NAMESPACES,
     SELECTOR_OPS as _SELECTOR_OPS,
     canon_selector,
     selector_matches_nothing,
@@ -273,8 +274,12 @@ def _decode_term(term: dict, namespace: str):
       an explicit list of namespace names (cross-namespace included) is
       modeled as the term's scope — k8s semantics: the list REPLACES
       the own-namespace default, it does not extend it;
-    - ``namespaceSelector`` presence at all stays unmodeled ({} means
-      "all namespaces");
+    - ``namespaceSelector: {}`` selects EVERY namespace (k8s) and is
+      modeled as the wildcard scope (selectors.ALL_NAMESPACES — it
+      subsumes any ``namespaces`` list, whose union with all-namespaces
+      is all-namespaces); a NON-empty namespaceSelector matches
+      namespace LABELS, which this framework does not observe, and
+      stays unmodeled;
     - ``matchLabels`` pairs become single-value In requirements;
     - ``matchExpressions`` entries model In / NotIn / Exists /
       DoesNotExist with multi-value lists; In/NotIn need >=1 value and
@@ -285,8 +290,11 @@ def _decode_term(term: dict, namespace: str):
     Returns (term | None, matches_nothing, unmodeled)."""
     ns_list = term.get("namespaces")
     if ns_list:
+        # "*" is reserved as the all-namespaces sentinel (DNS labels
+        # cannot contain it); a literal "*" entry is malformed and must
+        # not silently widen the scope
         if not isinstance(ns_list, list) or not all(
-            isinstance(x, str) and x and not _has_sep_bytes(x)
+            isinstance(x, str) and x and x != "*" and not _has_sep_bytes(x)
             for x in ns_list
         ):
             return None, False, True
@@ -294,7 +302,16 @@ def _decode_term(term: dict, namespace: str):
     else:
         namespaces = (namespace,)
     if "namespaceSelector" in term:
-        return None, False, True
+        ns_sel = term["namespaceSelector"]
+        if ns_sel == {}:
+            # k8s: an empty namespaceSelector selects EVERY namespace;
+            # the union with any `namespaces` list is still everything
+            namespaces = ALL_NAMESPACES
+        elif ns_sel is not None:
+            # non-empty selectors match namespace LABELS, which this
+            # framework does not observe — conservatively unmodeled.
+            # null is the API's explicit "no selector" (≡ absent).
+            return None, False, True
     sel = term.get("labelSelector")
     if not isinstance(sel, dict):
         return None, False, True

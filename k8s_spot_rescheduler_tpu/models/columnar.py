@@ -76,6 +76,7 @@ from k8s_spot_rescheduler_tpu.predicates.masks import (
     zone_match_affinity_mask,
 )
 from k8s_spot_rescheduler_tpu.predicates.selectors import (
+    ALL_NAMESPACES,
     selector_matches,
     term_matches,
 )
@@ -854,8 +855,11 @@ class ColumnarStore:
 
     def _term_rows(self, term) -> Set[int]:
         """Rows matched by a full term — union of ``_selector_rows``
-        over the term's namespace scope."""
+        over the term's namespace scope (every live namespace for the
+        all-namespaces wildcard)."""
         namespaces, selector = term
+        if namespaces == ALL_NAMESPACES:
+            namespaces = list(self._ns_index)
         rows: Set[int] = set()
         for ns in namespaces:
             rows |= self._selector_rows(ns, selector)

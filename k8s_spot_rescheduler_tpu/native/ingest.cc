@@ -509,8 +509,10 @@ bool py_truthy(const Val* v) {
 // --- widened pod-affinity term selectors (round 5) -----------------------
 //
 // Exact lockstep with io/kube.py _decode_term: explicit (cross-
-// namespace) `namespaces` lists are modeled; namespaceSelector presence
-// stays unmodeled; matchLabels pairs and matchExpressions with
+// namespace) `namespaces` lists are modeled; `namespaceSelector: {}`
+// is the all-namespaces "*" wildcard scope and null means "no
+// selector", while label-matching namespaceSelectors stay unmodeled;
+// matchLabels pairs and matchExpressions with
 // In / NotIn / Exists / DoesNotExist (multi-value In/NotIn) all emit as
 // requirement records. The blob carries source order and own-namespace
 // scopes unresolved; canonicalization (sorting, dedup, own-ns
@@ -618,7 +620,9 @@ int term_selector_blob(const Val* term, std::string* blob) {
     if (ns_list->kind != Val::Arr) return SEL_UNMODELED;
     bool first = true;
     for (const Val* x : ns_list->arr) {
-      if (!x || x->kind != Val::Str || x->text.empty() ||
+      // "*" is reserved as the all-namespaces sentinel: a literal
+      // entry is malformed and must not silently widen the scope
+      if (!x || x->kind != Val::Str || x->text.empty() || x->text == "*" ||
           has_sep_bytes(x->text))
         return SEL_UNMODELED;
       if (!first) ns_rec += VAL_SEP;
@@ -626,7 +630,18 @@ int term_selector_blob(const Val* term, std::string* blob) {
       ns_rec.append(x->text.data(), x->text.size());
     }
   }
-  if (term->get("namespaceSelector") != nullptr) return SEL_UNMODELED;
+  if (const Val* ns_sel = term->get("namespaceSelector")) {
+    if (ns_sel->kind == Val::Obj && ns_sel->obj.empty()) {
+      // {} selects EVERY namespace (round 5): the "*" wildcard scope —
+      // namespace names are DNS labels, so "*" cannot collide. It
+      // subsumes any `namespaces` list.
+      ns_rec = "*";
+    } else if (ns_sel->kind != Val::Null) {
+      // non-empty selectors match namespace LABELS (unobserved):
+      // conservatively unmodeled; null is the API's "no selector"
+      return SEL_UNMODELED;
+    }
+  }
   std::string reqs;
   int verdict = selector_reqs_blob(term->get("labelSelector"), REC_SEP,
                                    UNIT_SEP, VAL_SEP, &reqs);

@@ -88,12 +88,21 @@ def selector_matches(sel: Selector, labels) -> bool:
     return all(req_matches(r, labels) for r in sel)
 
 
+# The all-namespaces scope (a term with ``namespaceSelector: {}``,
+# which k8s defines as selecting every namespace). Namespace names are
+# DNS-1123 labels, so a literal "*" namespace cannot exist — the
+# sentinel is collision-free.
+ALL_NAMESPACES = ("*",)
+
+
 def term_matches(term: Term, pod_namespace: str, labels) -> bool:
     """Does a pod (namespace + labels) fall in the term's scope and
     match its selector? This is both the presence direction (which pods
     set a universe term's bit) and the node-side resident check."""
     namespaces, sel = term
-    return pod_namespace in namespaces and selector_matches(sel, labels)
+    return (
+        namespaces == ALL_NAMESPACES or pod_namespace in namespaces
+    ) and selector_matches(sel, labels)
 
 
 def selector_matches_nothing(sel: Selector) -> bool:

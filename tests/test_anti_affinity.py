@@ -206,8 +206,8 @@ def test_native_decode_of_anti_affinity_shapes():
         anti([{"topologyKey": "kubernetes.io/hostname",
                "namespaces": ["other"],
                "labelSelector": {"matchLabels": {"app": "db"}}}]),
-        # namespaceSelector present (even {}) widens beyond the pod's own
-        # namespace -> unmodeled
+        # namespaceSelector {} selects EVERY namespace -> modeled as
+        # the "*" wildcard scope (round 5)
         anti([{"topologyKey": "kubernetes.io/hostname",
                "namespaceSelector": {},
                "labelSelector": {"matchLabels": {"app": "db"}}}]),
@@ -320,7 +320,11 @@ def test_native_decode_of_anti_affinity_shapes():
         (("other",), (("app", "In", ("db",)),)),
     )
     assert not batch.view(5).unmodeled_constraints
-    assert batch.view(6).unmodeled_constraints  # namespaceSelector {}
+    # round 5: {} namespaceSelector = all-namespaces wildcard scope
+    assert batch.view(6).anti_affinity_match == (
+        (("*",), (("app", "In", ("db",)),)),
+    )
+    assert not batch.view(6).unmodeled_constraints
     assert batch.view(7).unmodeled_constraints  # namespaceSelector set
     assert batch.view(8).unmodeled_constraints  # non-array required
     assert not batch.view(9).unmodeled_constraints  # falsy required

@@ -124,9 +124,9 @@ def test_decode_unmodeled_pod_affinity_shapes():
         # other topology keys
         [{"topologyKey": "example.com/rack",
           "labelSelector": {"matchLabels": {"app": "db"}}}],
-        # namespaceSelector, even {}
+        # namespaceSelector matching namespace LABELS (unobserved)
         [{"topologyKey": "kubernetes.io/hostname",
-          "namespaceSelector": {},
+          "namespaceSelector": {"matchLabels": {"team": "x"}},
           "labelSelector": {"matchLabels": {"app": "db"}}}],
         # malformed: Exists carrying values (k8s validation rejects)
         [{"topologyKey": "kubernetes.io/hostname",

@@ -384,3 +384,82 @@ def test_loop_drains_with_widened_operators():
     assert fc.pods["default/mover-a"].node_name == "spot-free"
     assert fc.pods["default/mover-b"].node_name == "spot-free"
     assert fc.pending == []
+
+
+def test_namespace_selector_empty_means_all_namespaces():
+    """Round 5: ``namespaceSelector: {}`` selects EVERY namespace (k8s)
+    and is modeled as the wildcard scope; non-empty selectors (matching
+    namespace labels we do not observe) stay conservative; null means
+    "no selector" and keeps the default scope."""
+    import json
+
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+
+    def obj(term_extra, ns="a"):
+        term = {"topologyKey": "kubernetes.io/hostname",
+                "labelSelector": {"matchLabels": {"app": "db"}}}
+        term.update(term_extra)
+        return {
+            "metadata": {"name": "p", "namespace": ns, "uid": "u1"},
+            "spec": {"nodeName": "n1", "containers": [], "affinity": {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution":
+                        [term]}}},
+            "status": {"phase": "Running"},
+        }
+
+    wild = decode_pod(obj({"namespaceSelector": {}}))
+    assert wild.anti_affinity_match == (
+        (("*",), (("app", "In", ("db",)),)),
+    )
+    assert not wild.unmodeled_constraints
+    # the wildcard subsumes any namespaces list
+    both = decode_pod(obj({"namespaceSelector": {},
+                           "namespaces": ["x", "y"]}))
+    assert both.anti_affinity_match == wild.anti_affinity_match
+    # null ≡ absent
+    nul = decode_pod(obj({"namespaceSelector": None}))
+    assert nul.anti_affinity_match == (
+        (("a",), (("app", "In", ("db",)),)),
+    )
+    # label-matching selectors stay conservative
+    lbl = decode_pod(obj({"namespaceSelector": {
+        "matchLabels": {"team": "x"}}}))
+    assert lbl.unmodeled_constraints
+
+    if native_ingest.available():
+        objs = [obj({"namespaceSelector": {}}),
+                obj({"namespaceSelector": None}),
+                obj({"namespaceSelector": {"matchLabels": {"team": "x"}}})]
+        for i, o in enumerate(objs):
+            o["metadata"] = dict(o["metadata"], name=f"p{i}", uid=f"u{i}")
+        batch = native_ingest.parse_pod_list(
+            json.dumps({"items": objs}).encode()
+        )
+        for i, o in enumerate(objs):
+            want = decode_pod(o)
+            got = batch.view(i)
+            assert got.anti_affinity_match == want.anti_affinity_match, i
+            assert (
+                got.unmodeled_constraints == want.unmodeled_constraints
+            ), i
+
+
+def test_all_namespaces_scope_repels_across_namespaces():
+    """A wildcard-scope anti-affinity term repels matches in ANY
+    namespace — and the symmetric presence direction reaches every
+    pod, on both pack paths."""
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-busy", SPOT_LABELS))
+    fc.add_node(make_node("spot-free", SPOT_LABELS))
+    fc.add_pod(make_pod("resident", 500, "spot-busy",
+                        namespace="payments", labels={"app": "db"}))
+    fc.add_pod(make_pod(
+        "mover", 300, "od-1",
+        anti_affinity_match=(
+            (("*",), (("app", "In", ("db",)),)),
+        ),
+    ))
+    assert _placement(fc, "mover") == "spot-free"
+    _parity(fc)
