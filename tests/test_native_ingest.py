@@ -755,3 +755,132 @@ def test_views_feed_columnar_store_identically():
             getattr(native_packed, field), getattr(py_packed, field),
             err_msg=field,
         )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_widened_affinity_differential_fuzz(seed, built_lib):
+    """Randomized differential lockstep over the ROUND-5 widened
+    surface: random selector operators (valid and invalid), value
+    lists (empty/multi/sep-bytes), namespaces lists (own, cross, "*",
+    malformed), namespaceSelector variants, topology keys, term counts,
+    and spread modifier values — Python decode and the native engine
+    must agree field-for-field on every generated pod."""
+    import random
+
+    from k8s_spot_rescheduler_tpu.io import native_ingest
+    from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+
+    rng = random.Random(3000 + seed)
+    ops = ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Weird", None]
+    topos = ["kubernetes.io/hostname", "topology.kubernetes.io/zone",
+             "example.com/rack"]
+
+    def rand_values():
+        roll = rng.random()
+        if roll < 0.15:
+            return []
+        if roll < 0.25:
+            return ["bad\x1dvalue"]
+        return rng.sample(["a", "b", "c", "d", ""], rng.randint(1, 3))
+
+    def rand_expr():
+        e = {}
+        op = rng.choice(ops)
+        if op is not None:
+            e["operator"] = op
+        if rng.random() < 0.9:
+            e["key"] = rng.choice(["app", "tier", "k\x1ey", "zone"])
+        if rng.random() < 0.8:
+            e["values"] = rand_values()
+        return e
+
+    def rand_selector():
+        sel = {}
+        if rng.random() < 0.6:
+            sel["matchLabels"] = {
+                rng.choice(["app", "tier"]): rng.choice(["db", "web", "x"])
+                for _ in range(rng.randint(0, 2))
+            }
+        if rng.random() < 0.6:
+            sel["matchExpressions"] = [
+                rand_expr() for _ in range(rng.randint(0, 3))
+            ]
+        return sel
+
+    def rand_term():
+        term = {"topologyKey": rng.choice(topos),
+                "labelSelector": rand_selector()}
+        roll = rng.random()
+        if roll < 0.2:
+            term["namespaces"] = rng.sample(
+                ["default", "other", "payments", "*", ""],
+                rng.randint(1, 2),
+            )
+        if roll > 0.85:
+            term["namespaceSelector"] = rng.choice(
+                [{}, None, {"matchLabels": {"team": "x"}}]
+            )
+        return term
+
+    def rand_spread():
+        c = {"topologyKey": rng.choice(topos),
+             "maxSkew": rng.choice([1, 2, 0, "1"]),
+             "labelSelector": rand_selector()}
+        if rng.random() < 0.3:
+            c["whenUnsatisfiable"] = rng.choice(
+                ["DoNotSchedule", "ScheduleAnyway"]
+            )
+        if rng.random() < 0.4:
+            c[rng.choice(["minDomains", "matchLabelKeys",
+                          "nodeAffinityPolicy", "nodeTaintsPolicy"])] = (
+                rng.choice([None, 1, 2, [], ["rev"], "Honor", "Ignore"])
+            )
+        return c
+
+    objs = []
+    for i in range(40):
+        spec = {"nodeName": "n1", "containers": []}
+        aff = {}
+        if rng.random() < 0.7:
+            aff["podAntiAffinity"] = {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    rand_term() for _ in range(rng.randint(1, 3))
+                ]
+            }
+        if rng.random() < 0.5:
+            aff["podAffinity"] = {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    rand_term() for _ in range(rng.randint(1, 2))
+                ]
+            }
+        if aff:
+            spec["affinity"] = aff
+        if rng.random() < 0.4:
+            spec["topologySpreadConstraints"] = [
+                rand_spread() for _ in range(rng.randint(1, 2))
+            ]
+        objs.append({
+            "metadata": {"name": f"p{i}", "uid": f"u{i}",
+                         "namespace": rng.choice(
+                             ["default", "payments", None
+                              ])},
+            "spec": spec,
+            "status": {"phase": "Running"},
+        })
+    batch = native_ingest.parse_pod_list(
+        json.dumps({"items": objs}).encode()
+    )
+    assert batch is not None
+    for i, obj in enumerate(objs):
+        want = decode_pod(obj)
+        got = batch.view(i)
+        assert got.anti_affinity_match == want.anti_affinity_match, i
+        assert (
+            got.anti_affinity_zone_match == want.anti_affinity_zone_match
+        ), i
+        assert got.pod_affinity_match == want.pod_affinity_match, i
+        assert (
+            got.pod_affinity_zone_match == want.pod_affinity_zone_match
+        ), i
+        assert got.spread_constraints == want.spread_constraints, i
+        assert got.unmodeled_constraints == want.unmodeled_constraints, i
