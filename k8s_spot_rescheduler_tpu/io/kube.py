@@ -465,7 +465,13 @@ def _spread_modifiers_default(c: dict) -> bool:
         if v is not None and v != "Ignore":
             return False
     return True
-_SPREAD_TOPOLOGY_KEYS = ("kubernetes.io/hostname", ZONE_TOPOLOGY_KEY)
+# Spread topology is generic: the verdict machinery keys counts and
+# domains by the constraint's OWN topology key (masks.SpreadBit /
+# compute_spread_bit read node.labels[topology_key] directly), so ANY
+# label key works — unlike zone anti-affinity, whose zone-salted
+# machinery is specific to the standard zone label. Round 5 lifts the
+# hostname/zone-only restriction; the key only needs to be a non-empty
+# sep-byte-free string (native blob framing).
 
 
 def decode_topology_spread(spread) -> tuple:
@@ -474,7 +480,8 @@ def decode_topology_spread(spread) -> tuple:
 
     Modeled (in exact lockstep with native/ingest.cc): each HARD entry
     (whenUnsatisfiable absent or DoNotSchedule — the k8s default) with
-    topologyKey hostname/zone, integer maxSkew >= 1, a non-empty
+    ANY non-empty sep-free topologyKey (round 5 — the SpreadBit
+    machinery is generic over the key), integer maxSkew >= 1, a non-empty
     selector in the round-5 widened operator form (matchLabels and/or
     matchExpressions with In/NotIn/Exists/DoesNotExist; spread is
     always own-namespace per the k8s API), and counting-semantics
@@ -500,7 +507,7 @@ def decode_topology_spread(spread) -> tuple:
         if not _spread_modifiers_default(c):
             return (), True
         topo = c.get("topologyKey")
-        if topo not in _SPREAD_TOPOLOGY_KEYS:
+        if not isinstance(topo, str) or not topo or _has_sep_bytes(topo):
             return (), True
         skew = c.get("maxSkew")
         if not isinstance(skew, int) or isinstance(skew, bool) or skew < 1:

@@ -108,9 +108,10 @@ def _lib() -> Optional[ctypes.CDLL]:
             and lib.node_ncols_u8() == 3
             and lib.node_ncols_str() == 4
             and lib.table_count() == 12
-            # round-5 widened affinity/spread term blobs; an old .so
-            # with the matchLabels-kv format would be misparsed
-            and lib.blob_format_version() == 2
+            # the acceptance version covers blob format AND the
+            # modeled/unmodeled decision surface: a stale .so would
+            # silently disagree with the Python reference decoder
+            and lib.blob_format_version() == 3
         )
     except AttributeError:
         ok = False

@@ -775,8 +775,9 @@ bool has_sep_bytes(std::string_view s) {
 
 // Hard topologySpreadConstraints, in exact lockstep with io/kube.py
 // decode_topology_spread: each hard entry (whenUnsatisfiable absent or
-// anything but the literal "ScheduleAnyway") must have topologyKey
-// hostname/zone, an integer maxSkew >= 1, a non-empty widened selector
+// anything but the literal "ScheduleAnyway") must have a non-empty
+// sep-free topologyKey (ANY label key — round 5), an integer
+// maxSkew >= 1, a non-empty widened selector
 // (matchLabels and/or matchExpressions with the four label operators —
 // round 5), and none of the counting-modifier fields — else the whole
 // pod is unmodeled. Soft entries are dropped. Blob: entries joined by
@@ -842,10 +843,12 @@ void extract_topology_spread(const Val* spread, bool* unmodeled,
       *unmodeled = true;
       return;
     }
+    // spread topology is generic (round 5): any non-empty sep-free
+    // label key — the SpreadBit verdict machinery keys counts/domains
+    // by the constraint's own topology key
     const Val* topo = c->get("topologyKey");
-    if (!topo || topo->kind != Val::Str ||
-        (topo->text != "kubernetes.io/hostname" &&
-         topo->text != "topology.kubernetes.io/zone")) {
+    if (!topo || topo->kind != Val::Str || topo->text.empty() ||
+        has_sep_bytes(topo->text)) {
       *unmodeled = true;
       return;
     }
@@ -1412,9 +1415,13 @@ int node_ncols_i64() { return N_NI64; }
 int node_ncols_u8() { return N_NU8; }
 int node_ncols_str() { return NS_NSTR; }
 int table_count() { return TBL_COUNT; }
-// Interned-blob encoding version: 2 = round-5 widened affinity/spread
-// term format. A stale .so is refused by io/native_ingest.py's ABI
-// handshake (Python falls back to its own decoders).
-int blob_format_version() { return 2; }
+// Interned-blob ACCEPTANCE version: bumped whenever either the blob
+// encoding OR the modeled/unmodeled decision surface changes, so a
+// stale .so can never silently disagree with the Python reference
+// decoder (io/native_ingest.py refuses it and falls back).
+// 2 = round-5 widened affinity/spread term format;
+// 3 = + namespaceSelector {} wildcard, explicit-default spread
+//     modifiers, arbitrary spread topology keys.
+int blob_format_version() { return 3; }
 
 }  // extern "C"

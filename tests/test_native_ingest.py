@@ -773,7 +773,7 @@ def test_widened_affinity_differential_fuzz(seed, built_lib):
     rng = random.Random(3000 + seed)
     ops = ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Weird", None]
     topos = ["kubernetes.io/hostname", "topology.kubernetes.io/zone",
-             "example.com/rack"]
+             "example.com/rack", "", "bad\x1dkey"]
 
     def rand_values():
         roll = rng.random()

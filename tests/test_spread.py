@@ -100,7 +100,7 @@ def test_decode_soft_entries_ignored():
 
 
 @pytest.mark.parametrize("mutate", [
-    {"topologyKey": "rack"},                      # unmodeled topology
+    {"topologyKey": ""},                          # empty topology key
     {"maxSkew": 0},                               # k8s-invalid skew
     {"maxSkew": "1"},                             # non-int skew
     {"maxSkew": True},                            # bool is not an int here
@@ -587,3 +587,66 @@ def test_decode_explicit_default_modifiers_modeled():
         got = batch.view(i)
         assert got.spread_constraints == want.spread_constraints, i
         assert got.unmodeled_constraints == want.unmodeled_constraints, i
+
+
+def test_arbitrary_topology_key_spread_modeled_end_to_end():
+    """Round 5: spread over ANY topology key (region here) — the
+    SpreadBit machinery is generic over the constraint's own key; only
+    the decoders used to restrict it. Verdict proven in the oracle with
+    packer parity and against the independent fake scheduler."""
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+    from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from tests.fixtures import (
+        ON_DEMAND_LABEL,
+        ON_DEMAND_LABELS,
+        SPOT_LABEL,
+        SPOT_LABELS,
+        make_node,
+        make_pod,
+    )
+
+    REGION = "topology.kubernetes.io/region"
+    pod = decode_pod(_spread_pod([dict(_CANON, topologyKey=REGION)]))
+    assert pod.spread_constraints == (
+        (REGION, 1, (("app", "In", ("web",)),)),
+    )
+    assert not pod.unmodeled_constraints
+
+    fc = FakeCluster(FakeClock())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-east", dict(SPOT_LABELS, **{REGION: "east"})))
+    fc.add_node(make_node("spot-west", dict(SPOT_LABELS, **{REGION: "west"})))
+    # two matches already in east; none in west -> maxSkew 1 refuses east
+    fc.add_pod(make_pod("m1", 400, "spot-east", labels={"app": "web"}))
+    fc.add_pod(make_pod("m2", 300, "spot-east", labels={"app": "web"}))
+    fc.add_pod(make_pod(
+        "mover", 200, "od-1", labels={"app": "web"},
+        spread_constraints=((REGION, 1, (("app", "web"),)),),
+    ))
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    packed, meta = pack_cluster(node_map, [], resources=("cpu", "memory"))
+    result = plan_oracle(packed)
+    assert bool(result.feasible[0])
+    target = meta.spot[int(result.assignment[0, 0])].node.name
+    assert target == "spot-west"
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    col, _ = store.pack([])
+    for field in packed._fields:
+        np.testing.assert_array_equal(
+            getattr(packed, field), getattr(col, field), err_msg=field
+        )
