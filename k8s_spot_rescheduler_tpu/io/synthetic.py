@@ -90,8 +90,10 @@ CONFIGS = {
 }
 
 # Config-5 churn with the full predicate surface loaded on (round 4):
-# taints + partial tolerations, anti-affinity groups, PDBs, and sparse
-# hostname/zone hard spread constraints — the constrained replay row of
+# taints + partial tolerations, anti-affinity groups, widened round-5
+# selector terms (operator-based spread selectors, NotIn'd anti-affinity
+# terms, cross-namespace scopes), PDBs, and sparse hostname/zone hard
+# spread constraints — the constrained replay row of
 # docs/RESULTS.md (bench.py --config 5 --constrained).
 REPLAY_CONSTRAINED = SyntheticSpec(
     "replay-constrained", 500, 500, 8_000,
@@ -192,16 +194,48 @@ def generate_cluster(
             tolerations = [SPOT_TOLERATION]
         # sparse hard spread: every 13th app's pods carry the common
         # hostname+zone constraint pair over their own app label (the
-        # round-4 modeled predicate; loose skews so drains stay possible)
+        # round-4 modeled predicate; loose skews so drains stay
+        # possible); every 26th uses the round-5 WIDENED selector form
+        # (In over the app pair + a canary DoesNotExist) so churn
+        # exercises operator-based spread counting too
+        ns = f"ns-{app % 16}"
         spread_constraints = ()
         if spec.spread and app % 13 == 0:
+            if app % 26 == 0:
+                sel = (
+                    ("app", "In", (f"app-{app}", f"app-{app}-canary")),
+                    ("canary", "DoesNotExist", ()),
+                )
+            else:
+                sel = (("app", f"app-{app}"),)
             spread_constraints = (
-                ("kubernetes.io/hostname", 3, (("app", f"app-{app}"),)),
-                ("topology.kubernetes.io/zone", 4, (("app", f"app-{app}"),)),
+                ("kubernetes.io/hostname", 3, sel),
+                ("topology.kubernetes.io/zone", 4, sel),
+            )
+        # sparse round-5 widened anti-affinity terms (on top of the
+        # group-based 10%): every 17th app's pods refuse co-location
+        # with SAME-APP pods via a NotIn-excluded sibling selector;
+        # every 19th carries a CROSS-NAMESPACE term against the
+        # neighboring namespace's copy of the app label. Loose by
+        # construction (each app is a small fraction of any node) so
+        # drains stay possible while the operators and ns scopes churn.
+        anti_terms = ()
+        if spec.anti_affinity and app % 17 == 0:
+            anti_terms += (
+                ((ns,), (
+                    ("app", "In", (f"app-{app}",)),
+                    ("decoy", "NotIn", ("1",)),
+                )),
+            )
+        if spec.anti_affinity and app % 19 == 0:
+            other_ns = f"ns-{(app + 1) % 16}"
+            anti_terms += (
+                (tuple(sorted({ns, other_ns})),
+                 (("app", "In", (f"app-{app}",)),)),
             )
         pod = PodSpec(
             name=f"pod-{p}",
-            namespace=f"ns-{app % 16}",
+            namespace=ns,
             node_name=node.name,
             requests={CPU: cpu, MEMORY: int(mems[p]), EPHEMERAL: int(ephs[p])},
             labels={"app": f"app-{app}"},
@@ -210,6 +244,7 @@ def generate_cluster(
             anti_affinity_group=(
                 f"aff-{app}" if spec.anti_affinity and rng.random() < 0.1 else ""
             ),
+            anti_affinity_match=anti_terms,
             spread_constraints=spread_constraints,
         )
         fc.add_pod(pod)

@@ -78,7 +78,9 @@ def test_replay_small():
 
 def test_replay_constrained_never_strands():
     """Config-5 churn with the full predicate surface (taints, affinity
-    groups, PDBs, sparse hard spread): every drain the planner approves
+    groups, round-5 widened selector terms — operator-based spread
+    selectors, NotIn anti-affinity, cross-namespace scopes — PDBs,
+    sparse hard spread): every drain the planner approves
     must land its pods — a drain-evicted pod pending at tick end would
     be a stranding, the invariant the whole conservatism design exists
     to uphold. The conservatism gauges ride along in the stats."""
