@@ -1,0 +1,160 @@
+"""Randomized end-to-end safety over the round-5 widened surface.
+
+The invariant everything rests on (SURVEY.md §7 hard part (e)): a drain
+the planner approves must never strand a pod. The fake scheduler
+(io/fake.py) independently enforces the full widened semantics — term
+scopes (own/cross-namespace/wildcard), the four selector operators,
+multi-term families, spread skew math — so on a randomized cluster any
+modeling unsoundness (the packers approving a placement the scheduler
+refuses) surfaces as a drain-evicted pod stuck pending. Each seed also
+pins object-vs-columnar packer bit-parity on its cluster.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.bench.quality import drain_to_exhaustion
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import (
+    ON_DEMAND_LABEL,
+    ON_DEMAND_LABELS,
+    SPOT_LABEL,
+    SPOT_LABELS,
+    make_node,
+    make_pod,
+)
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+KEYS = ("app", "tier", "track")
+VALS = ("db", "web", "cache", "be")
+NSS = ("default", "payments", "infra")
+
+
+def _rand_req(rng):
+    key = rng.choice(KEYS)
+    op = rng.choice(("In", "In", "NotIn", "Exists", "DoesNotExist"))
+    if op in ("Exists", "DoesNotExist"):
+        return (key, op, ())
+    values = tuple(sorted(set(
+        rng.sample(VALS, rng.randint(1, 2))
+    )))
+    return (key, op, values)
+
+
+def _rand_selector(rng):
+    return tuple(sorted({_rand_req(rng) for _ in range(rng.randint(1, 2))}))
+
+
+def _rand_scope(rng, own_ns):
+    roll = rng.random()
+    if roll < 0.6:
+        return (own_ns,)
+    if roll < 0.8:
+        return tuple(sorted({own_ns, rng.choice(NSS)}))
+    return ("*",)
+
+
+def _rand_labels(rng):
+    return {
+        k: rng.choice(VALS)
+        for k in rng.sample(KEYS, rng.randint(0, 2))
+    }
+
+
+def _random_widened_cluster(seed):
+    rng = random.Random(seed)
+    fc = FakeCluster(FakeClock(), reschedule_evicted=True)
+    zones = ("za", "zb")
+    for i in range(rng.randint(2, 3)):
+        fc.add_node(make_node(f"od-{i}", ON_DEMAND_LABELS))
+    for i in range(rng.randint(4, 7)):
+        labels = dict(SPOT_LABELS, **{HOST: f"spot-{i}"})
+        if rng.random() < 0.8:
+            labels[ZONE] = rng.choice(zones)
+        fc.add_node(make_node(f"spot-{i}", labels, cpu_millis=2000))
+        # some spot residents with random labels
+        for j in range(rng.randint(0, 2)):
+            fc.add_pod(make_pod(
+                f"res-{i}-{j}", rng.randint(100, 400), f"spot-{i}",
+                namespace=rng.choice(NSS), labels=_rand_labels(rng),
+            ))
+    pod_n = 0
+    for i in range(len([n for n in fc.nodes if n.startswith("od-")])):
+        for j in range(rng.randint(1, 3)):
+            ns = rng.choice(NSS)
+            kwargs = {}
+            r = rng.random()
+            if r < 0.45:
+                kwargs["anti_affinity_match"] = tuple(
+                    (_rand_scope(rng, ns), _rand_selector(rng))
+                    for _ in range(rng.randint(1, 2))
+                )
+            elif r < 0.6:
+                kwargs["anti_affinity_zone_match"] = (
+                    (_rand_scope(rng, ns), _rand_selector(rng)),
+                )
+            elif r < 0.7:
+                kwargs["pod_affinity_match"] = (
+                    (_rand_scope(rng, ns), _rand_selector(rng)),
+                )
+            elif r < 0.85:
+                kwargs["spread_constraints"] = (
+                    (rng.choice((HOST, ZONE, "example.com/rack")),
+                     rng.randint(1, 3), _rand_selector(rng)),
+                )
+            fc.add_pod(make_pod(
+                f"mover-{pod_n}", rng.randint(100, 500), f"od-{i}",
+                namespace=ns, labels=_rand_labels(rng), **kwargs,
+            ))
+            pod_n += 1
+    return fc
+
+
+@pytest.mark.parametrize("seed", range(16))
+def test_widened_surface_never_strands(seed):
+    """Drains proven against random widened constraints must land every
+    evicted pod in the independent fake scheduler — a drain-evicted pod
+    left pending is a stranding (modeling unsoundness)."""
+    fc = _random_widened_cluster(seed)
+    drain_to_exhaustion(
+        fc, ReschedulerConfig(solver="numpy", resources=("cpu", "memory"))
+    )
+    # let every graceful termination land
+    fc.clock.advance(120.0)
+    evicted = set(fc.evictions)
+    stranded = {p.uid for p in fc.pending} & evicted
+    assert not stranded, (seed, stranded)
+
+
+@pytest.mark.parametrize("seed", range(16))
+def test_widened_surface_packer_parity(seed):
+    """Object-vs-columnar tensors stay bit-identical on random widened
+    clusters."""
+    fc = _random_widened_cluster(seed)
+    nodes = fc.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: fc.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    obj, _ = pack_cluster(node_map, fc.pdbs, resources=("cpu", "memory"))
+    store = fc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    )
+    col, _ = store.pack(fc.pdbs)
+    for field in obj._fields:
+        np.testing.assert_array_equal(
+            getattr(obj, field), getattr(col, field), err_msg=field
+        )
