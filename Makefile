@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint test bench quality replay demo dryrun docker-build clean native
+.PHONY: all check lint test bench bench-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -13,11 +13,12 @@ all:
 	-$(MAKE) native
 	$(MAKE) check
 
-# The CI entry: lint+format gate, then tests — mirroring the reference's
-# fmt/golangci-lint/vet/test chain (reference Makefile:36-65). tools/
-# lint.py is the zero-dependency stand-in (this image ships no Python
-# linter and installs are forbidden).
-check: lint test
+# The CI entry: lint+format gate, then tests, then the incremental-tick
+# smoke — mirroring the reference's fmt/golangci-lint/vet/test chain
+# (reference Makefile:36-65). tools/lint.py is the zero-dependency
+# stand-in (this image ships no Python linter and installs are
+# forbidden).
+check: lint test bench-smoke
 
 lint:
 	python tools/lint.py
@@ -38,6 +39,12 @@ k8s_spot_rescheduler_tpu/native/_ingest.so: k8s_spot_rescheduler_tpu/native/inge
 
 bench:
 	python bench.py
+
+# Tiny CPU-only proof of the device-resident incremental tick path:
+# 5 ticks at C=S=64; fails unless the steady-state delta tick uploads
+# fewer bytes than the first full-pack tick.
+bench-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --smoke --watchdog 600
 
 quality:
 	python bench.py --quality

@@ -305,13 +305,22 @@ class _HintingPlanner:
         # columnar observe fast path for every quality benchmark)
         return getattr(self.inner, name)
 
-    def plan(self, node_map, pdbs):
-        report = self.inner.plan(node_map, pdbs)
+    def _record(self, report):
         hints = getattr(self.client, "placement_hints", None)
         if hints is not None and report.plan is not None:
             hints.clear()
             hints.update(report.plan.assignments)
         return report
+
+    def plan(self, node_map, pdbs):
+        return self._record(self.inner.plan(node_map, pdbs))
+
+    def plan_async(self, node_map, pdbs):
+        # the control loop prefers the pipelined entry point, and
+        # __getattr__ would hand it the INNER planner's — which skips the
+        # hint recording — so it must be wrapped explicitly
+        finish = self.inner.plan_async(node_map, pdbs)
+        return lambda: self._record(finish())
 
 
 def drain_to_exhaustion(

@@ -76,6 +76,21 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.solver_hbm_budget,
                    help="per-device byte budget for the auto-shard "
                         "decision (0 = auto-detect from the backend)")
+    p.add_argument("--incremental-device-cache", type=_bool,
+                   default=d.incremental_device_cache,
+                   help="keep the packed problem resident on device and "
+                        "ship only the per-tick churn delta (donated "
+                        "scatter update); off = full upload every tick")
+    p.add_argument("--staged-chunk-lanes", type=int,
+                   default=d.staged_chunk_lanes,
+                   help="solve candidate lanes in selection-order chunks "
+                        "of this size, skipping prefilter-eliminated "
+                        "chunks (0 = unstaged full solve)")
+    p.add_argument("--staged-early-exit", type=_bool,
+                   default=d.staged_early_exit,
+                   help="stop solving at the first chunk containing a "
+                        "feasible lane (selection is identical; the "
+                        "feasible count then covers the solved prefix)")
     p.add_argument("--leader-elect", type=_bool, default=False,
                    help="Lease-based leader election so only one replica "
                         "acts (restores what reference rescheduler.go:139 "
@@ -123,6 +138,9 @@ def config_from_args(args) -> ReschedulerConfig:
         repair_rounds=args.repair_rounds,
         auto_shard=args.auto_shard,
         solver_hbm_budget=args.solver_hbm_budget,
+        incremental_device_cache=args.incremental_device_cache,
+        staged_chunk_lanes=args.staged_chunk_lanes,
+        staged_early_exit=args.staged_early_exit,
         resources=tuple(r for r in args.resources.split(",") if r),
         mesh_shape=(
             tuple(int(x) for x in args.mesh_shape.lower().split("x"))

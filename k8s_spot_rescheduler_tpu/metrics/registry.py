@@ -117,10 +117,52 @@ repair_unavailable = Gauge(
 
 tick_phase_duration = Histogram(
     "tick_phase_duration_seconds",
-    "Wall time of each housekeeping-tick phase (observe/plan/actuate).",
+    "Wall time of each housekeeping-tick phase (observe / plan-dispatch "
+    "/ observe-metrics / plan-fetch / actuate, plus the aggregate plan "
+    "phase; observe-metrics overlaps the in-flight device solve).",
     ["phase"],
     namespace=NAMESPACE,
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0),
+)
+
+# Incremental-tick observability (device-resident pipeline): how much of
+# the per-tick host↔device traffic and solve compute the delta-pack and
+# staged early-exit paths actually saved — and how often the cache missed.
+
+solver_delta_pack_lanes = Gauge(
+    "solver_delta_pack_lanes",
+    "Changed candidate lanes the last tick's delta-pack applied to the "
+    "device-resident problem tensors (0 = nothing changed; the gauge is "
+    "untouched on full-repack ticks).",
+    namespace=NAMESPACE,
+)
+
+solver_full_repack = Counter(
+    "solver_full_repack",
+    "Ticks that re-uploaded the whole packed problem instead of a delta "
+    "(cold cache, shape growth past the high-water pads, or a failed "
+    "delta apply).",
+    namespace=NAMESPACE,
+)
+
+solver_delta_upload_bytes = Gauge(
+    "solver_delta_upload_bytes",
+    "Host-to-device bytes the last tick actually shipped (padded delta, "
+    "or the full problem on repack ticks).",
+    namespace=NAMESPACE,
+)
+
+solver_chunks_solved = Gauge(
+    "solver_chunks_solved",
+    "Candidate-lane chunks the staged solver actually solved last tick.",
+    namespace=NAMESPACE,
+)
+
+solver_chunks_skipped = Gauge(
+    "solver_chunks_skipped",
+    "Candidate-lane chunks skipped last tick (prefilter-eliminated or "
+    "beyond the first feasible chunk under early exit).",
+    namespace=NAMESPACE,
 )
 
 
@@ -167,6 +209,20 @@ def update_solver_mode(
     solver_mode.labels(configured, running).set(1)
     _last_solver_mode[0] = (configured, running)
     repair_unavailable.set(1 if repair_dropped else 0)
+
+
+def update_incremental_tick(report) -> None:
+    """Mirror one PlanReport's incremental-pipeline telemetry into the
+    gauges above (called by the control loop after each plan)."""
+    if report.full_repack:
+        solver_full_repack.inc()
+    elif report.delta_pack_lanes >= 0:
+        solver_delta_pack_lanes.set(report.delta_pack_lanes)
+    if report.upload_bytes >= 0:
+        solver_delta_upload_bytes.set(report.upload_bytes)
+    if report.chunks_solved >= 0:
+        solver_chunks_solved.set(report.chunks_solved)
+        solver_chunks_skipped.set(report.chunks_skipped)
 
 
 def update_conservatism(n_unplaceable: int, by_reason: dict) -> None:

@@ -38,7 +38,7 @@ the actuator and the cloud mutate them mid-drain.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -1857,3 +1857,132 @@ class ColumnarStore:
     @property
     def n_nodes(self) -> int:
         return len(self._node_row)
+
+
+# ----------------------------------------------------------------------
+# incremental device-resident tick pipeline: the delta emitter
+#
+# Ticks are overwhelmingly incremental (the watch/ColumnarFeed path feeds
+# this store a handful of events between packs), yet the planner used to
+# re-ship the whole (C×K×·) tensor set across the host↔device boundary
+# every tick. ``emit_packed_delta`` turns two consecutive packs into a
+# compact update at three granularities matching the tensor layout:
+#
+# - **changed candidate lanes** — a lane's [K, ·] slot slabs (req /
+#   valid / tol / aff) travel whole: any slot edit reorders the whole
+#   lane (slots are sorted biggest-request-first within the lane);
+# - **changed cand_valid entries** — 1 byte per flipped lane, kept
+#   separate so a feasibility flip without slot churn ships no slab;
+# - **changed spot rows** — a spot node's free/count/taints/aff row.
+#
+# The diff is exact (bitwise compare of the two host packs), so the
+# scatter-apply on the device cache reproduces the full re-pack
+# bit-identically BY CONSTRUCTION — ``tests/test_incremental.py`` pins
+# the whole machinery (padding, dtype, out-of-bounds drop) across
+# randomized churn. Shape growth past the high-water pads returns None:
+# the caller must fall back to a full re-upload (and count it).
+
+
+class PackedDelta(NamedTuple):
+    """Churn-proportional update between two same-shape PackedClusters."""
+
+    # changed candidate lanes (full [K, ·] slabs, lane-major)
+    lanes: np.ndarray  # i32 [L]
+    lane_slot_req: np.ndarray  # f32 [L, K, R]
+    lane_slot_valid: np.ndarray  # bool [L, K]
+    lane_slot_tol: np.ndarray  # u32 [L, K, W]
+    lane_slot_aff: np.ndarray  # u32 [L, K, A]
+    # changed per-lane validity bits
+    cand_rows: np.ndarray  # i32 [Lc]
+    cand_valid: np.ndarray  # bool [Lc]
+    # changed spot rows
+    spot_rows: np.ndarray  # i32 [M]
+    spot_free: np.ndarray  # f32 [M, R]
+    spot_count: np.ndarray  # i32 [M]
+    spot_max_pods: np.ndarray  # i32 [M]
+    spot_taints: np.ndarray  # u32 [M, W]
+    spot_ok: np.ndarray  # bool [M]
+    spot_aff: np.ndarray  # u32 [M, A]
+
+    @property
+    def nbytes(self) -> int:
+        """Bytes this delta ships host→device (unpadded)."""
+        return sum(np.asarray(f).nbytes for f in self)
+
+    @property
+    def n_lanes(self) -> int:
+        return len(self.lanes)
+
+
+def emit_packed_delta(prev: PackedCluster, new: PackedCluster):
+    """Diff two consecutive packs into a :class:`PackedDelta`.
+
+    Returns None when any tensor shape differs (the cluster outgrew the
+    high-water pad floors) — the caller must re-upload in full. An
+    identical pack yields an all-empty delta (zero upload).
+    """
+    for f in PackedCluster._fields:
+        if getattr(prev, f).shape != getattr(new, f).shape:
+            return None
+    lane_changed = (
+        np.any(prev.slot_req != new.slot_req, axis=(1, 2))
+        | np.any(prev.slot_valid != new.slot_valid, axis=1)
+        | np.any(prev.slot_tol != new.slot_tol, axis=(1, 2))
+        | np.any(prev.slot_aff != new.slot_aff, axis=(1, 2))
+    )
+    lanes = np.nonzero(lane_changed)[0].astype(np.int32)
+    cand_rows = np.nonzero(prev.cand_valid != new.cand_valid)[0].astype(
+        np.int32
+    )
+    spot_changed = (
+        np.any(prev.spot_free != new.spot_free, axis=1)
+        | (prev.spot_count != new.spot_count)
+        | (prev.spot_max_pods != new.spot_max_pods)
+        | np.any(prev.spot_taints != new.spot_taints, axis=1)
+        | (prev.spot_ok != new.spot_ok)
+        | np.any(prev.spot_aff != new.spot_aff, axis=1)
+    )
+    spot_rows = np.nonzero(spot_changed)[0].astype(np.int32)
+    return PackedDelta(
+        lanes=lanes,
+        lane_slot_req=np.ascontiguousarray(new.slot_req[lanes]),
+        lane_slot_valid=np.ascontiguousarray(new.slot_valid[lanes]),
+        lane_slot_tol=np.ascontiguousarray(new.slot_tol[lanes]),
+        lane_slot_aff=np.ascontiguousarray(new.slot_aff[lanes]),
+        cand_rows=cand_rows,
+        cand_valid=np.ascontiguousarray(new.cand_valid[cand_rows]),
+        spot_rows=spot_rows,
+        spot_free=np.ascontiguousarray(new.spot_free[spot_rows]),
+        spot_count=np.ascontiguousarray(new.spot_count[spot_rows]),
+        spot_max_pods=np.ascontiguousarray(new.spot_max_pods[spot_rows]),
+        spot_taints=np.ascontiguousarray(new.spot_taints[spot_rows]),
+        spot_ok=np.ascontiguousarray(new.spot_ok[spot_rows]),
+        spot_aff=np.ascontiguousarray(new.spot_aff[spot_rows]),
+    )
+
+
+def apply_packed_delta(packed: PackedCluster, delta: PackedDelta) -> PackedCluster:
+    """Host-side reference application of a delta (the device path in
+    ``planner/solver_planner.py`` mirrors this with a donated-buffer
+    scatter program; both must agree bit-for-bit with the full pack)."""
+
+    def upd(arr, idx, vals):
+        out = arr.copy()
+        out[idx] = vals
+        return out
+
+    return PackedCluster(
+        slot_req=upd(packed.slot_req, delta.lanes, delta.lane_slot_req),
+        slot_valid=upd(packed.slot_valid, delta.lanes, delta.lane_slot_valid),
+        slot_tol=upd(packed.slot_tol, delta.lanes, delta.lane_slot_tol),
+        slot_aff=upd(packed.slot_aff, delta.lanes, delta.lane_slot_aff),
+        cand_valid=upd(packed.cand_valid, delta.cand_rows, delta.cand_valid),
+        spot_free=upd(packed.spot_free, delta.spot_rows, delta.spot_free),
+        spot_count=upd(packed.spot_count, delta.spot_rows, delta.spot_count),
+        spot_max_pods=upd(
+            packed.spot_max_pods, delta.spot_rows, delta.spot_max_pods
+        ),
+        spot_taints=upd(packed.spot_taints, delta.spot_rows, delta.spot_taints),
+        spot_ok=upd(packed.spot_ok, delta.spot_rows, delta.spot_ok),
+        spot_aff=upd(packed.spot_aff, delta.spot_rows, delta.spot_aff),
+    )

@@ -42,6 +42,21 @@ class PlanReport:
     # all feasible candidates in drain-priority order (multi-drain planning
     # and the quality benchmarks read this; the faithful loop uses plan only)
     feasible_candidates: List[DrainPlan] = dataclasses.field(default_factory=list)
+    # --- incremental device-resident tick telemetry (solver planner;
+    # loop/controller.py mirrors these into metrics/registry.py) ---
+    # changed lanes the delta-pack applied; -1 = device cache not in play
+    delta_pack_lanes: int = -1
+    # this tick re-uploaded the whole problem (cold cache / shape growth)
+    full_repack: bool = False
+    # host→device bytes this tick actually shipped; -1 = unknown (the
+    # non-incremental device path uploads inside jit, untracked)
+    upload_bytes: int = -1
+    # staged-solve coverage; -1 chunks_solved = unstaged full solve
+    chunks_solved: int = -1
+    chunks_skipped: int = 0
+    # early exit truncated n_feasible to the solved prefix (a drain WAS
+    # found; the why-no-drain gauges read this tick as an upper bound)
+    count_truncated: bool = False
 
 
 class Planner(Protocol):

@@ -12,10 +12,23 @@ matrix. The numpy oracle backend returns everything on the host anyway.
 
 Shape discipline: pad floors persist across calls (high-water marks) so
 the jitted solver does not recompile every tick as the cluster breathes.
+
+Incremental device residency (the per-tick upload was ~60 ms of the
+1.2 s CPU-fallback tick, BENCH_r05): the previous tick's problem tensors
+stay resident in device memory; each tick the host pack is DIFFED against
+the previous one (models/columnar.emit_packed_delta) and only the changed
+candidate lanes / validity bits / spot rows ship across the boundary,
+applied by a donated-buffer scatter program so the update is in-place in
+HBM. Shape growth past the high-water pads falls back to a full
+re-upload, counted in ``solver_full_repack_total``. The solve itself is
+staged (solver/select.StagedPlanner): chunks of lanes in selection
+order, prefilter-eliminated chunks skipped, stop at the first feasible
+chunk — the selection the loop acts on is bit-identical either way.
 """
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Sequence
 
@@ -39,8 +52,15 @@ class SolverPlanner:
         self._pad_s = 0
         self._pad_k = config.max_pods_per_node_hint
         self._fused = None  # device path
+        self._union_fn = None  # the raw union program behind _fused
+        self._staged = None  # lazy chunked early-exit planner
         self._fused_sharded = None  # lazy 2-D auto-shard reroute
         self._fused_cand_sharded = None  # lazy cand-only reroute (repair on)
+        # incremental device cache: last tick's problem, resident in HBM,
+        # plus the host copy the next tick's delta is diffed against
+        self._device_packed = None
+        self._host_prev = None
+        self._apply_delta_jit = None
         self.last_solver = config.solver  # what the last plan actually ran
         if config.solver == "numpy":
             self._solve_host = plan_oracle
@@ -54,14 +74,161 @@ class SolverPlanner:
         if self.config.fallback_best_fit and self.config.repair_rounds > 0:
             from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
 
-            return make_fused_planner(
-                with_repair(base, self.config.repair_rounds)
-            )
-        if self.config.fallback_best_fit:
+            union = with_repair(base, self.config.repair_rounds)
+        elif self.config.fallback_best_fit:
             from k8s_spot_rescheduler_tpu.solver.fallback import with_best_fit_fallback
 
-            return make_fused_planner(with_best_fit_fallback(base))
-        return make_fused_planner(base)
+            union = with_best_fit_fallback(base)
+        else:
+            union = base
+        self._union_fn = union
+        return make_fused_planner(union)
+
+    def _staged_planner(self):
+        """The chunked early-exit wrapper around the SAME union program
+        ``_fused`` runs (selection-equivalent by tests/test_incremental)."""
+        if self._staged is None:
+            from k8s_spot_rescheduler_tpu.solver.select import (
+                make_staged_planner,
+            )
+
+            self._staged = make_staged_planner(
+                self._union_fn,
+                chunk_lanes=self.config.staged_chunk_lanes,
+                early_exit=self.config.staged_early_exit,
+            )
+        return self._staged
+
+    # ------------------------------------------------------------------
+    # incremental device cache (delta-pack + donated scatter update)
+
+    @staticmethod
+    def _pad_pow2(n: int) -> int:
+        """Pad delta sections to power-of-two lengths so the donated
+        scatter program compiles O(log(max churn)) times, not per tick."""
+        return 8 if n <= 8 else 1 << (n - 1).bit_length()
+
+    def _delta_apply_fn(self):
+        if self._apply_delta_jit is None:
+            import jax
+
+            from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+
+            # donate the 11 resident tensors: the scatter updates alias
+            # them in place in device memory — per-tick traffic is the
+            # (padded) delta alone, not the cluster
+            @functools.partial(jax.jit, donate_argnums=tuple(range(11)))
+            def apply(
+                slot_req, slot_valid, slot_tol, slot_aff, cand_valid,
+                spot_free, spot_count, spot_max_pods, spot_taints,
+                spot_ok, spot_aff, d,
+            ):
+                # pad entries carry an out-of-bounds index -> dropped
+                return PackedCluster(
+                    slot_req=slot_req.at[d.lanes].set(
+                        d.lane_slot_req, mode="drop"
+                    ),
+                    slot_valid=slot_valid.at[d.lanes].set(
+                        d.lane_slot_valid, mode="drop"
+                    ),
+                    slot_tol=slot_tol.at[d.lanes].set(
+                        d.lane_slot_tol, mode="drop"
+                    ),
+                    slot_aff=slot_aff.at[d.lanes].set(
+                        d.lane_slot_aff, mode="drop"
+                    ),
+                    cand_valid=cand_valid.at[d.cand_rows].set(
+                        d.cand_valid, mode="drop"
+                    ),
+                    spot_free=spot_free.at[d.spot_rows].set(
+                        d.spot_free, mode="drop"
+                    ),
+                    spot_count=spot_count.at[d.spot_rows].set(
+                        d.spot_count, mode="drop"
+                    ),
+                    spot_max_pods=spot_max_pods.at[d.spot_rows].set(
+                        d.spot_max_pods, mode="drop"
+                    ),
+                    spot_taints=spot_taints.at[d.spot_rows].set(
+                        d.spot_taints, mode="drop"
+                    ),
+                    spot_ok=spot_ok.at[d.spot_rows].set(
+                        d.spot_ok, mode="drop"
+                    ),
+                    spot_aff=spot_aff.at[d.spot_rows].set(
+                        d.spot_aff, mode="drop"
+                    ),
+                )
+
+            self._apply_delta_jit = apply
+        return self._apply_delta_jit
+
+    def _pad_delta(self, delta, C: int, S: int):
+        """Pad each delta section to a power-of-two length; index pads
+        point one past the axis end (dropped by the scatter)."""
+        from k8s_spot_rescheduler_tpu.models.columnar import PackedDelta
+
+        def idx(a, oob):
+            out = np.full(self._pad_pow2(len(a)), oob, np.int32)
+            out[: len(a)] = a
+            return out
+
+        def data(a):
+            out = np.zeros(
+                (self._pad_pow2(a.shape[0]),) + a.shape[1:], a.dtype
+            )
+            out[: a.shape[0]] = a
+            return out
+
+        return PackedDelta(
+            lanes=idx(delta.lanes, C),
+            lane_slot_req=data(delta.lane_slot_req),
+            lane_slot_valid=data(delta.lane_slot_valid),
+            lane_slot_tol=data(delta.lane_slot_tol),
+            lane_slot_aff=data(delta.lane_slot_aff),
+            cand_rows=idx(delta.cand_rows, C),
+            cand_valid=data(delta.cand_valid),
+            spot_rows=idx(delta.spot_rows, S),
+            spot_free=data(delta.spot_free),
+            spot_count=data(delta.spot_count),
+            spot_max_pods=data(delta.spot_max_pods),
+            spot_taints=data(delta.spot_taints),
+            spot_ok=data(delta.spot_ok),
+            spot_aff=data(delta.spot_aff),
+        )
+
+    def _upload_incremental(self, packed):
+        """Move this tick's problem to the device through the resident
+        cache. Returns (device_packed, delta_lanes, full_repack,
+        upload_bytes); ``delta_lanes`` is -1 on a full re-upload."""
+        import jax
+
+        from k8s_spot_rescheduler_tpu.models.columnar import emit_packed_delta
+
+        delta = None
+        if self._device_packed is not None and self._host_prev is not None:
+            delta = emit_packed_delta(self._host_prev, packed)
+        if delta is not None:
+            try:
+                padded = self._pad_delta(
+                    delta, packed.slot_req.shape[0], packed.spot_free.shape[0]
+                )
+                device_packed = self._delta_apply_fn()(
+                    *self._device_packed, padded
+                )
+                self._host_prev = packed
+                self._device_packed = device_packed
+                upload = sum(np.asarray(f).nbytes for f in padded)
+                return device_packed, delta.n_lanes, False, upload
+            except Exception as err:  # noqa: BLE001 — donation may have
+                # consumed the cache mid-failure: rebuild from scratch
+                log.error("delta apply failed (%s); full re-upload", err)
+                self._device_packed = None
+        device_packed = jax.device_put(packed)
+        self._host_prev = packed
+        self._device_packed = device_packed
+        upload = sum(getattr(packed, f).nbytes for f in packed._fields)
+        return device_packed, -1, True, upload
 
     def _base_solver(self, name: str):
         """A solve(packed, best_fit=False) callable for the backend."""
@@ -234,6 +401,15 @@ class SolverPlanner:
         """``observation`` is either a classified ``NodeMap`` (object
         path, reference-faithful) or a ``models/columnar.ColumnarStore``
         (vectorized fast path); both pack to the same tensors."""
+        return self.plan_async(observation, pdbs)()
+
+    def plan_async(self, observation, pdbs: Sequence[PDBSpec]):
+        """The pipelined half-tick: pack on host, ship the delta (or the
+        full problem) to the device, and async-dispatch the solve — JAX
+        returns control before the device finishes. The returned zero-arg
+        ``finish`` callable blocks on the tiny selection fetch and builds
+        the PlanReport; the control loop runs its host-side metrics pass
+        between the two so it overlaps the in-flight solve."""
         t0 = time.perf_counter()
         cfg = self.config
         if hasattr(observation, "pack"):  # ColumnarStore
@@ -268,76 +444,149 @@ class SolverPlanner:
 
         solver_label = cfg.solver
         repair_dropped = False
+        fetch = None
+        delta_lanes, full_repack, upload_bytes = -1, False, -1
         if self._fused is not None:
             from k8s_spot_rescheduler_tpu.solver.select import decode_selection
 
             fused, solver_label, repair_dropped = self._maybe_shard(packed)
-            sel = decode_selection(fused(packed))
-            plan = meta.build_plan(sel.index, sel.row) if sel.found else None
-            n_feasible = sel.n_feasible
-        else:
-            result = self._solve_host(packed)
-            if self.config.fallback_best_fit:
-                from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+            # the incremental cache and the staged solve apply only to the
+            # plain single-chip program: the mesh reroutes manage their own
+            # placement (shard_map shardings), and slicing a sharded axis
+            # would fight the mesh layout
+            single_chip = fused is self._fused and cfg.solver in (
+                "jax",
+                "pallas",
+            )
+            if not single_chip and self._device_packed is not None:
+                # a mesh reroute engaged (the problem outgrew one chip):
+                # holding the stale single-chip cache would pin a near-
+                # budget tensor set in device memory exactly when the
+                # sharded program needs the headroom
+                self._device_packed = None
+                self._host_prev = None
+            device_packed = packed
+            if cfg.incremental_device_cache and single_chip:
+                (
+                    device_packed,
+                    delta_lanes,
+                    full_repack,
+                    upload_bytes,
+                ) = self._upload_incremental(packed)
+            elif cfg.staged_chunk_lanes > 0 and single_chip:
+                # cache off but staging on: ship the problem ONCE — the
+                # per-chunk jit calls would otherwise each re-upload the
+                # host arrays
+                import jax
 
-                bf = self._solve_host(packed, best_fit=True)
-                result = SolveResult(
-                    feasible=result.feasible | bf.feasible,
-                    assignment=np.where(
-                        result.feasible[:, None], result.assignment, bf.assignment
-                    ),
+                device_packed = jax.device_put(packed)
+            if cfg.staged_chunk_lanes > 0 and single_chip:
+                staged = self._staged_planner()
+                # blocks on the tiny prefilter fetch, then the first
+                # chunk is already solving while the caller's host work
+                # (the controller's metrics pass) runs
+                run = staged.start(device_packed)
+
+                def fetch(r=run):
+                    return staged.finish_run(r)
+
+            else:
+                pending_vec = fused(device_packed)  # async dispatch
+
+                def fetch(pv=pending_vec):
+                    return decode_selection(pv), None
+
+        def finish() -> PlanReport:
+            staged_stats = None
+            if fetch is not None:
+                sel, staged_stats = fetch()
+                plan = (
+                    meta.build_plan(sel.index, sel.row) if sel.found else None
                 )
-                need_repair = bool(
-                    np.any(np.asarray(packed.cand_valid) & ~result.feasible)
-                )
-                if self.config.repair_rounds > 0 and need_repair:
-                    # mirror of the device path's lax.cond gate
-                    # (solver/fallback.with_repair): repair results are
-                    # only consumed for lanes greedy failed
-                    from k8s_spot_rescheduler_tpu.solver.repair import (
-                        plan_repair_oracle,
+                n_feasible = sel.n_feasible
+            else:
+                result = self._solve_host(packed)
+                if cfg.fallback_best_fit:
+                    from k8s_spot_rescheduler_tpu.solver.result import (
+                        SolveResult,
                     )
 
-                    rp = plan_repair_oracle(
-                        packed, rounds=self.config.repair_rounds
-                    )
+                    bf = self._solve_host(packed, best_fit=True)
                     result = SolveResult(
-                        feasible=result.feasible | rp.feasible,
+                        feasible=result.feasible | bf.feasible,
                         assignment=np.where(
                             result.feasible[:, None],
                             result.assignment,
-                            rp.assignment,
+                            bf.assignment,
                         ),
                     )
-            feasible = np.asarray(result.feasible)
-            n_feasible = int(feasible.sum())
-            plan = None
-            if n_feasible:
-                c = int(np.argmax(feasible))
-                plan = meta.build_plan(c, np.asarray(result.assignment[c]))
+                    need_repair = bool(
+                        np.any(
+                            np.asarray(packed.cand_valid) & ~result.feasible
+                        )
+                    )
+                    if cfg.repair_rounds > 0 and need_repair:
+                        # mirror of the device path's lax.cond gate
+                        # (solver/fallback.with_repair): repair results are
+                        # only consumed for lanes greedy failed
+                        from k8s_spot_rescheduler_tpu.solver.repair import (
+                            plan_repair_oracle,
+                        )
 
-        self._report_conservatism(packed, meta, n_feasible)
+                        rp = plan_repair_oracle(
+                            packed, rounds=cfg.repair_rounds
+                        )
+                        result = SolveResult(
+                            feasible=result.feasible | rp.feasible,
+                            assignment=np.where(
+                                result.feasible[:, None],
+                                result.assignment,
+                                rp.assignment,
+                            ),
+                        )
+                feasible = np.asarray(result.feasible)
+                n_feasible = int(feasible.sum())
+                plan = None
+                if n_feasible:
+                    c = int(np.argmax(feasible))
+                    plan = meta.build_plan(c, np.asarray(result.assignment[c]))
 
-        # solver-mode observability: what actually ran, and whether the
-        # repair phase the config asked for was available on that path
-        # (the sharded program drops it past single-chip scale)
-        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+            self._report_conservatism(packed, meta, n_feasible)
 
-        # repair_dropped comes from the dispatch decision itself: only
-        # the 2-D cand×spot reroute loses the repair phase (cand-only
-        # keeps it; a solver CONFIGURED as 'sharded' keeps its wrapper)
-        metrics.update_solver_mode(cfg.solver, solver_label, repair_dropped)
+            # solver-mode observability: what actually ran, and whether the
+            # repair phase the config asked for was available on that path
+            # (the sharded program drops it past single-chip scale)
+            from k8s_spot_rescheduler_tpu.metrics import registry as metrics
 
-        self.last_solver = solver_label
-        report = PlanReport(
-            plan=plan,
-            n_candidates=meta.n_candidates,
-            n_feasible=n_feasible,
-            solve_seconds=time.perf_counter() - t0,
-            solver=solver_label,
-            feasible_candidates=[plan] if plan else [],
-        )
-        return report
+            # repair_dropped comes from the dispatch decision itself: only
+            # the 2-D cand×spot reroute loses the repair phase (cand-only
+            # keeps it; a solver CONFIGURED as 'sharded' keeps its wrapper)
+            metrics.update_solver_mode(cfg.solver, solver_label, repair_dropped)
+
+            self.last_solver = solver_label
+            report = PlanReport(
+                plan=plan,
+                n_candidates=meta.n_candidates,
+                n_feasible=n_feasible,
+                solve_seconds=time.perf_counter() - t0,
+                solver=solver_label,
+                feasible_candidates=[plan] if plan else [],
+                delta_pack_lanes=delta_lanes,
+                full_repack=full_repack,
+                upload_bytes=upload_bytes,
+                chunks_solved=(
+                    staged_stats.chunks_solved if staged_stats else -1
+                ),
+                chunks_skipped=(
+                    staged_stats.chunks_skipped if staged_stats else 0
+                ),
+                count_truncated=(
+                    staged_stats.count_truncated if staged_stats else False
+                ),
+            )
+            return report
+
+        return finish
 
     def _report_conservatism(self, packed, meta, n_feasible: int) -> None:
         """Why-no-drain observability (metrics/registry.py conservatism

@@ -12,6 +12,7 @@ round trip*, so the result is packed into ONE int32 vector.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -58,4 +59,176 @@ def decode_selection(vec) -> Selection:
         found=bool(vec[1]),
         n_feasible=int(vec[2]),
         row=vec[3:],
+    )
+
+
+class StagedStats(NamedTuple):
+    """Staged-solve coverage bookkeeping for one tick."""
+
+    chunks_solved: int
+    chunks_skipped: int  # prefilter-eliminated + early-exit-bypassed
+    lanes_eliminated: int  # prefilter verdicts, lane granularity
+    count_truncated: bool  # early exit fired: n_feasible is a prefix count
+
+
+class StagedPlanner:
+    """Chunked, early-exiting selection over the candidate axis.
+
+    The unstaged fused planner solves all C lanes even though the loop
+    policy drains only the first feasible one. This planner walks the
+    lanes *in selection order* in chunks of ``chunk_lanes``:
+
+    - a chunk every lane of which the device prefilter
+      (solver/prefilter.py) proves infeasible is skipped outright —
+      exact, so its contribution to the feasible count is exactly 0;
+    - remaining chunks are solved with the SAME union program the
+      unstaged planner runs, sliced to the chunk's lanes (lanes are
+      independent by construction — each is its own fork of the spot
+      pool — so slicing cannot change any lane's verdict);
+    - with ``early_exit`` (the production default), solving stops at the
+      first chunk containing a feasible lane.
+
+    Selection equivalence: (index, found, assignment row) are
+    bit-identical to the unstaged fused planner always, and
+    ``n_feasible`` is identical whenever no feasible lane exists or
+    ``early_exit`` is off; when early exit fires, ``n_feasible`` is the
+    exact count over the solved prefix (a lower bound) and
+    ``StagedStats.count_truncated`` says so. ``tests/test_incremental.py``
+    pins all of this against the unstaged planner.
+    """
+
+    def __init__(self, solve_fn, *, chunk_lanes: int = 256,
+                 early_exit: bool = True):
+        from k8s_spot_rescheduler_tpu.solver.prefilter import (
+            lane_maybe_feasible,
+        )
+
+        self.chunk_lanes = int(chunk_lanes)
+        self.early_exit = early_exit
+        self._prefilter = jax.jit(lane_maybe_feasible)
+
+        @functools.partial(jax.jit, static_argnames=("size",))
+        def solve_chunk(packed, start, size):
+            sub = packed._replace(
+                slot_req=jax.lax.dynamic_slice_in_dim(
+                    packed.slot_req, start, size
+                ),
+                slot_valid=jax.lax.dynamic_slice_in_dim(
+                    packed.slot_valid, start, size
+                ),
+                slot_tol=jax.lax.dynamic_slice_in_dim(
+                    packed.slot_tol, start, size
+                ),
+                slot_aff=jax.lax.dynamic_slice_in_dim(
+                    packed.slot_aff, start, size
+                ),
+                cand_valid=jax.lax.dynamic_slice_in_dim(
+                    packed.cand_valid, start, size
+                ),
+            )
+            res = solve_fn(sub)
+            idx = jnp.argmax(res.feasible).astype(jnp.int32)
+            return jnp.concatenate(
+                [
+                    idx[None],
+                    jnp.any(res.feasible).astype(jnp.int32)[None],
+                    res.feasible.sum().astype(jnp.int32)[None],
+                    res.assignment[idx].astype(jnp.int32),
+                ]
+            )
+
+        self._solve_chunk = solve_chunk
+
+    def dispatch_prefilter(self, packed):
+        """Async-dispatch the per-lane bound; hand the result to
+        ``start``/``solve`` so host work overlaps the device prefilter."""
+        return self._prefilter(packed)
+
+    def start(self, packed, maybe=None) -> dict:
+        """Fetch the (tiny) prefilter verdict, decide the runnable chunk
+        list and async-dispatch the first chunk — the device is already
+        solving while the caller does host work before ``finish_run``."""
+        import collections
+
+        C = packed.slot_req.shape[0]
+        if maybe is None:
+            maybe = self.dispatch_prefilter(packed)
+        maybe = np.asarray(maybe)  # C bools: the tick's only big fetch
+        chunk = max(1, self.chunk_lanes)
+        starts = list(range(0, C, chunk))
+        run = {
+            "packed": packed,
+            "C": C,
+            "K": packed.slot_req.shape[1],
+            "runnable": [s for s in starts if maybe[s : s + chunk].any()],
+            "n_chunks": len(starts),
+            "eliminated": int((~maybe).sum()),
+            "pending": collections.deque(),  # dispatched, not yet fetched
+            "next": 0,
+        }
+        self._dispatch_next(run)
+        return run
+
+    def _dispatch_next(self, run) -> None:
+        i = run["next"]
+        if i < len(run["runnable"]):
+            start = run["runnable"][i]
+            size = min(max(1, self.chunk_lanes), run["C"] - start)
+            run["pending"].append(
+                (start, self._solve_chunk(run["packed"], start, size))
+            )
+            run["next"] = i + 1
+
+    def finish_run(self, run):
+        """Drain the chunk pipeline; returns (Selection, StagedStats).
+
+        Chunks are fetched in selection order with pipeline depth 2 —
+        chunk i+1 is dispatched before blocking on chunk i's fetch, so on
+        a latency-bound link the round trips hide behind the next chunk's
+        compute instead of serializing. Early exit costs at most the one
+        speculatively-dispatched chunk."""
+        fetched = 0
+        n_feasible = 0
+        found_idx = -1
+        row = np.full(run["K"], -1, np.int32)
+        while run["pending"]:
+            self._dispatch_next(run)
+            start, pending_vec = run["pending"].popleft()
+            vec = np.asarray(pending_vec)
+            fetched += 1
+            n_feasible += int(vec[2])
+            if found_idx < 0 and vec[1]:
+                found_idx = start + int(vec[0])
+                row = vec[3:]
+                if self.early_exit:
+                    break
+        sel = Selection(
+            index=found_idx if found_idx >= 0 else 0,
+            found=found_idx >= 0,
+            n_feasible=n_feasible,
+            row=row,
+        )
+        stats = StagedStats(
+            chunks_solved=fetched,
+            chunks_skipped=run["n_chunks"] - fetched,
+            lanes_eliminated=run["eliminated"],
+            count_truncated=found_idx >= 0 and fetched < len(run["runnable"]),
+        )
+        return sel, stats
+
+    def solve(self, packed, maybe=None):
+        """Run the staged solve start-to-finish; returns
+        (Selection, StagedStats)."""
+        return self.finish_run(self.start(packed, maybe))
+
+    __call__ = solve
+
+
+def make_staged_planner(
+    solve_fn, *, chunk_lanes: int = 256, early_exit: bool = True
+) -> StagedPlanner:
+    """Staged counterpart of ``make_fused_planner`` over the same
+    PackedCluster->SolveResult solver."""
+    return StagedPlanner(
+        solve_fn, chunk_lanes=chunk_lanes, early_exit=early_exit
     )

@@ -104,6 +104,22 @@ class ReschedulerConfig:
     # vectorized replacement for the per-tick object-model rebuild. Off →
     # always the reference-faithful object path.
     use_columnar: bool = True
+    # Incremental device-resident tick pipeline (single-chip jax/pallas
+    # paths; the mesh reroutes manage their own placement):
+    # - ``incremental_device_cache`` keeps the previous tick's packed
+    #   problem resident in device memory and ships only the churn delta
+    #   (models/columnar.emit_packed_delta) each tick, applied in place
+    #   via a donated-buffer scatter. Off → full upload every tick.
+    # - ``staged_chunk_lanes`` solves candidate lanes in selection-order
+    #   chunks of this size, skipping chunks the device prefilter
+    #   (solver/prefilter.py) proves infeasible; 0 → unstaged full solve.
+    # - ``staged_early_exit`` stops at the first chunk containing a
+    #   feasible lane (the loop drains only the first feasible candidate,
+    #   so the selection is identical); the reported feasible COUNT then
+    #   covers the solved prefix only on ticks that found a drain.
+    incremental_device_cache: bool = True
+    staged_chunk_lanes: int = 256
+    staged_early_exit: bool = True
 
     def __post_init__(self):
         from k8s_spot_rescheduler_tpu.utils.labels import validate_label
@@ -112,5 +128,7 @@ class ReschedulerConfig:
         validate_label(self.spot_node_label, "spot node label")
         if self.max_drains_per_tick < 1:
             raise ValueError("max_drains_per_tick must be >= 1")
+        if self.staged_chunk_lanes < 0:
+            raise ValueError("staged_chunk_lanes must be >= 0 (0 = unstaged)")
         if not self.resources:
             raise ValueError("resources must be non-empty")

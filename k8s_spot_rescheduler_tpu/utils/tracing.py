@@ -5,6 +5,15 @@ The reference's only observability into its hot path is glog verbosity
 histogram (metrics/registry.py ``tick_phase_duration``) and, when a trace
 directory is configured, device work runs under ``jax.profiler`` so the
 solver's XLA/Pallas execution shows up in TensorBoard/Perfetto.
+
+Phases of the pipelined tick (loop/controller.py): ``observe`` (cluster
+state + PDBs), ``plan-dispatch`` (host pack + delta-upload + async solve
+dispatch), ``observe-metrics`` (per-node metrics — host work that runs
+WHILE the device solve is in flight), ``plan-fetch`` (the blocking
+selection fetch + report build), ``actuate``. The aggregate ``plan``
+series (dispatch + fetch, excluding the overlapped window) is kept for
+dashboard continuity; ``plan-fetch`` minus the true device time is the
+residual the overlap did not hide.
 """
 
 from __future__ import annotations

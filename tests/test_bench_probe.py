@@ -140,3 +140,84 @@ def test_e2e_no_cpu_fallback_flag_fails_closed():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["value"] is None
     assert "no usable jax backend" in out["error"]
+
+
+def test_probe_attempt_cap(monkeypatch):
+    """Total probe spend is capped by max_attempts even with a generous
+    wall-clock budget (BENCH_r05 burned 4 x 90 s before every fallback)."""
+    calls = []
+
+    def run(*a, **k):
+        calls.append(1)
+        return _Result(1, "", "RuntimeError: backend not ready")
+
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    platform, attempts, err = bench.acquire_backend(
+        budget_s=10_000.0, probe_timeout_s=1.0, max_attempts=3
+    )
+    assert platform is None
+    assert attempts == 3 and len(calls) == 3
+
+
+def test_probe_failed_verdict_cached(monkeypatch):
+    """With cache=True a failed acquisition is remembered: the second
+    call within the same bench invocation must not probe again."""
+    calls = []
+
+    def run(*a, **k):
+        calls.append(1)
+        return _Result(1, "", "RuntimeError: no backend")
+
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_probe_verdict", {})
+    first = bench.acquire_backend(
+        budget_s=5.0, probe_timeout_s=1.0, max_attempts=2, cache=True
+    )
+    n_probes = len(calls)
+    second = bench.acquire_backend(
+        budget_s=5.0, probe_timeout_s=1.0, max_attempts=2, cache=True
+    )
+    assert first[0] is None and second == first
+    assert len(calls) == n_probes  # no new probe subprocesses
+
+
+def test_probe_cache_off_by_default(monkeypatch):
+    """Unit callers (these tests) must not leak verdicts between calls."""
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _Result(1, "", "RuntimeError: down"),
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_probe_verdict", {})
+    bench.acquire_backend(budget_s=1.0, probe_timeout_s=0.5, max_attempts=1)
+    assert bench._probe_verdict == {}
+
+
+def test_emit_drops_non_finite_fields():
+    """NaN/inf never reach the JSON line: dict fields are omitted (a
+    strict parser must accept every line bench prints)."""
+    scrubbed = bench.drop_non_finite(
+        {
+            "value": 1.5,
+            "device_only_ms": float("nan"),
+            "nested": {"ok": 2, "bad": float("inf")},
+            "list": [1.0, float("nan")],
+        }
+    )
+    assert scrubbed == {"value": 1.5, "nested": {"ok": 2}, "list": [1.0, None]}
+    json.loads(json.dumps(scrubbed))  # round-trips as strict JSON
+
+
+def test_smoke_mode_emits_delta_fields():
+    """`bench.py --smoke` (the make bench-smoke target): delta tick must
+    upload fewer bytes than the first full pack, and the JSON line must
+    carry the staged/delta fields with no NaN anywhere."""
+    r = _run_bench("--smoke", "--watchdog", "500")
+    assert r.returncode == 0, r.stderr[-800:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True
+    assert out["delta_upload_bytes"] < out["first_full_pack_bytes"]
+    assert out["chunks_solved"] >= 1
+    assert "nan" not in r.stdout.lower()

@@ -1,0 +1,374 @@
+"""Incremental device-resident tick pipeline: parity pins.
+
+Three guarantees the pipeline rests on, each enforced here:
+
+1. **Delta-pack parity** — ``emit_packed_delta`` + scatter application
+   reproduce a from-scratch pack bit-identically across randomized churn
+   sequences, both through the host reference (``apply_packed_delta``)
+   and through the production device path (SolverPlanner's
+   donated-buffer scatter, including pow-2 padding and out-of-bounds
+   index drops).
+
+2. **Staged-solve selection equivalence** — the chunked early-exit
+   planner (solver/select.StagedPlanner) returns the identical
+   (index, found, count, assignment-row) tuple as the unstaged fused
+   planner, across the property-test cluster generator
+   (tests/test_solver._random_packed) and the union-program variants
+   production ships; with early exit, the count over the solved prefix
+   plus the exactness flag is pinned instead.
+
+3. **Prefilter soundness** — a lane the device prefilter eliminates is
+   infeasible under the strongest host oracle union (a single false
+   elimination would silently change the drain selection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
+from k8s_spot_rescheduler_tpu.models.columnar import (
+    apply_packed_delta,
+    emit_packed_delta,
+)
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import make_pod
+from tests.test_solver import _random_packed
+
+RESOURCES = ("cpu", "memory", "ephemeral-storage", "pods")
+
+
+def _columnar(fc, resources):
+    cfg = ReschedulerConfig(resources=resources)
+    return fc.columnar_store(
+        resources,
+        on_demand_label=cfg.on_demand_node_label,
+        spot_label=cfg.spot_node_label,
+    )
+
+
+def _churn(fc, rng, step: int) -> None:
+    """One randomized churn beat: evict-like removals, reschedules onto
+    random nodes (sized so lanes, spot rows and validity bits all move),
+    taint flips."""
+    action = step % 3
+    if action == 0:
+        uids = list(fc.pods)
+        for uid in rng.choice(
+            uids, size=min(8, len(uids)), replace=False
+        ):
+            fc._remove_pod(str(uid))
+    elif action == 1:
+        nodes = list(fc.nodes)
+        for i in range(6):
+            node = str(rng.choice(nodes))
+            fc.add_pod(
+                make_pod(
+                    f"churn-{step}-{i}",
+                    int(rng.integers(50, 400)),
+                    node,
+                    memory=int(rng.integers(1, 64)) << 20,
+                )
+            )
+    else:
+        from k8s_spot_rescheduler_tpu.models.cluster import Taint
+
+        node = str(rng.choice(list(fc.nodes)))
+        if step % 2:
+            fc.add_taint(node, Taint("churn", "t", "NoSchedule"))
+        else:
+            fc.remove_taint(node, "churn")
+
+
+def _assert_packed_equal(got, want, context=""):
+    for field in want._fields:
+        x, y = np.asarray(getattr(got, field)), getattr(want, field)
+        np.testing.assert_array_equal(x, y, err_msg=f"{context} {field}")
+        assert x.dtype == y.dtype, field
+
+
+@pytest.mark.parametrize("seed", range(16))
+def test_delta_pack_host_parity_random_churn(seed):
+    """≥16 randomized churn sequences: delta-applied tensors must be
+    bit-identical to a from-scratch pack every step (host reference)."""
+    spec = dataclasses.replace(
+        CONFIGS[3], n_on_demand=12, n_spot=12, n_pods=140
+    )
+    fc = generate_cluster(spec, seed=seed)
+    store = _columnar(fc, spec.resources)
+    rng = np.random.default_rng(seed + 1000)
+    # generous fixed pads so shapes survive the churn (the shape-growth
+    # fallback has its own test below)
+    pads = dict(pad_candidates=16, pad_spot=16, pad_slots=48)
+    prev, _ = store.pack(fc.pdbs, **pads)
+    for step in range(4):
+        _churn(fc, rng, step + seed)
+        fresh, _ = store.pack(fc.pdbs, **pads)
+        delta = emit_packed_delta(prev, fresh)
+        assert delta is not None, "same-shape churn must emit a delta"
+        applied = apply_packed_delta(prev, delta)
+        _assert_packed_equal(applied, fresh, f"seed {seed} step {step}")
+        prev = fresh
+
+
+def test_delta_emit_none_on_shape_growth():
+    """Pads breaching the high-water mark change shapes: the emitter must
+    refuse (the planner then counts a full repack)."""
+    spec = dataclasses.replace(CONFIGS[1], n_pods=16)
+    fc = generate_cluster(spec, seed=0)
+    store = _columnar(fc, spec.resources)
+    a, _ = store.pack(fc.pdbs, pad_candidates=8)
+    b, _ = store.pack(fc.pdbs, pad_candidates=64)
+    assert emit_packed_delta(a, b) is None
+    # and an unchanged cluster emits an EMPTY delta, not None
+    c, _ = store.pack(fc.pdbs, pad_candidates=8)
+    d = emit_packed_delta(a, c)
+    assert d is not None and d.n_lanes == 0 and len(d.spot_rows) == 0
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_device_cache_matches_host_pack_under_churn(seed):
+    """The production path: donated scatter updates of the device-resident
+    cache must equal the tick's host pack bit-for-bit, every tick."""
+    spec = dataclasses.replace(
+        CONFIGS[3], n_on_demand=10, n_spot=10, n_pods=120
+    )
+    fc = generate_cluster(spec, seed=seed)
+    cfg = ReschedulerConfig(
+        solver="jax", resources=spec.resources, staged_chunk_lanes=8
+    )
+    planner = SolverPlanner(cfg)
+    store = _columnar(fc, spec.resources)
+    rng = np.random.default_rng(seed)
+    saw_delta_tick = False
+    for step in range(5):
+        if step:
+            _churn(fc, rng, step)
+        report = planner.plan(store, fc.pdbs)
+        _assert_packed_equal(
+            planner._device_packed, planner.last_packed, f"tick {step}"
+        )
+        if step:
+            assert not report.full_repack or report.upload_bytes > 0
+            saw_delta_tick |= not report.full_repack
+        else:
+            assert report.full_repack  # cold cache
+    assert saw_delta_tick, "no tick exercised the delta path"
+
+
+def test_full_repack_on_shape_growth_through_planner():
+    """A pod burst past the slot-pad high-water mark must fall back to a
+    counted full re-upload, then resume delta ticks."""
+    spec = dataclasses.replace(
+        CONFIGS[1], n_on_demand=4, n_spot=4, n_pods=24
+    )
+    fc = generate_cluster(spec, seed=2)
+    cfg = ReschedulerConfig(
+        solver="jax",
+        resources=spec.resources,
+        max_pods_per_node_hint=8,
+    )
+    planner = SolverPlanner(cfg)
+    store = _columnar(fc, spec.resources)
+    assert planner.plan(store, fc.pdbs).full_repack  # cold
+    assert not planner.plan(store, fc.pdbs).full_repack  # warm delta
+    # burst: blow out the K axis on one on-demand node
+    node = next(n for n in fc.nodes if "od" in n)
+    for i in range(12):
+        fc.add_pod(make_pod(f"burst-{i}", 10, node))
+    report = planner.plan(store, fc.pdbs)
+    assert report.full_repack
+    _assert_packed_equal(planner._device_packed, planner.last_packed)
+    assert not planner.plan(store, fc.pdbs).full_repack  # warm again
+
+
+# ----------------------------------------------------------------------
+# staged early-exit solve
+
+
+def _selection_pair(packed, solve_fn, chunk, early_exit):
+    from k8s_spot_rescheduler_tpu.solver.select import (
+        decode_selection,
+        make_fused_planner,
+        make_staged_planner,
+    )
+
+    fused = make_fused_planner(solve_fn)
+    staged = make_staged_planner(
+        solve_fn, chunk_lanes=chunk, early_exit=early_exit
+    )
+    want = decode_selection(fused(packed))
+    got, stats = staged.solve(packed)
+    return want, got, stats
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_staged_parity_exhaustive(seed):
+    """early_exit off: the full (index, found, count, row) tuple must be
+    identical to the unstaged fused planner on the property generator."""
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    packed = _random_packed(np.random.default_rng(seed))
+    want, got, stats = _selection_pair(
+        packed, plan_ffd, chunk=2, early_exit=False
+    )
+    assert (got.index, got.found, got.n_feasible) == (
+        want.index,
+        want.found,
+        want.n_feasible,
+    )
+    np.testing.assert_array_equal(got.row, want.row)
+    assert not stats.count_truncated
+
+
+@pytest.mark.parametrize("seed", range(25, 50))
+def test_staged_parity_early_exit(seed):
+    """early_exit on (production): selection bit-identical; the count is
+    identical unless the exit truncated it, and then it is an exact
+    lower bound with the flag raised."""
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    packed = _random_packed(np.random.default_rng(seed))
+    want, got, stats = _selection_pair(
+        packed, plan_ffd, chunk=2, early_exit=True
+    )
+    assert (got.index, got.found) == (want.index, want.found)
+    np.testing.assert_array_equal(got.row, want.row)
+    if stats.count_truncated:
+        assert got.found and got.n_feasible <= want.n_feasible
+    else:
+        assert got.n_feasible == want.n_feasible
+
+
+@pytest.mark.parametrize("seed", range(50, 58))
+def test_staged_parity_union_program(seed):
+    """The staged planner wraps the SAME union program production ships
+    (first-fit ∪ best-fit ∪ repair): parity must survive the lax.cond
+    improvement passes inside each chunk."""
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    union = with_repair(plan_ffd, 2)
+    packed = _random_packed(np.random.default_rng(seed))
+    want, got, stats = _selection_pair(
+        packed, union, chunk=2, early_exit=False
+    )
+    assert (got.index, got.found, got.n_feasible) == (
+        want.index,
+        want.found,
+        want.n_feasible,
+    )
+    np.testing.assert_array_equal(got.row, want.row)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_prefilter_sound(seed):
+    """A prefilter-eliminated lane must be infeasible under the host
+    oracle union — the bound may only ever discard provably dead lanes."""
+    from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+    from k8s_spot_rescheduler_tpu.solver.prefilter import lane_maybe_feasible
+
+    packed = _random_packed(np.random.default_rng(seed + 500))
+    maybe = np.asarray(lane_maybe_feasible(packed))
+    union_feasible = np.asarray(
+        plan_oracle(packed).feasible
+    ) | np.asarray(plan_oracle(packed, best_fit=True).feasible)
+    assert not np.any(union_feasible & ~maybe), (
+        "prefilter eliminated a feasible lane"
+    )
+
+
+def test_staged_planner_matches_solver_planner_selection():
+    """End to end: the staged+incremental planner and a plain unstaged,
+    cache-off planner must pick the same drain on the same cluster."""
+    spec = dataclasses.replace(
+        CONFIGS[3], n_on_demand=12, n_spot=12, n_pods=150
+    )
+    fc = generate_cluster(spec, seed=5)
+    store = _columnar(fc, spec.resources)
+    fast = SolverPlanner(
+        ReschedulerConfig(
+            solver="jax", resources=spec.resources, staged_chunk_lanes=8
+        )
+    )
+    plain = SolverPlanner(
+        ReschedulerConfig(
+            solver="jax",
+            resources=spec.resources,
+            staged_chunk_lanes=0,
+            incremental_device_cache=False,
+        )
+    )
+    a = fast.plan(store, fc.pdbs)
+    b = plain.plan(store, fc.pdbs)
+    assert (a.plan is None) == (b.plan is None)
+    if a.plan is not None:
+        assert a.plan.node.node.name == b.plan.node.node.name
+        assert a.plan.assignments == b.plan.assignments
+
+
+def test_incremental_metrics_wiring():
+    """The control loop mirrors PlanReport telemetry into the registry
+    gauges (solver_delta_pack_lanes / solver_full_repack_total /
+    solver_chunks_*)."""
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+    from k8s_spot_rescheduler_tpu.planner.base import PlanReport
+
+    def gauge(g):
+        return g.collect()[0].samples[0].value
+
+    before = gauge(metrics.solver_full_repack)
+    metrics.update_incremental_tick(
+        PlanReport(
+            plan=None, n_candidates=4, n_feasible=0, solve_seconds=0.0,
+            full_repack=True, upload_bytes=1234, chunks_solved=2,
+            chunks_skipped=3,
+        )
+    )
+    assert gauge(metrics.solver_full_repack) == before + 1
+    assert gauge(metrics.solver_delta_upload_bytes) == 1234
+    assert gauge(metrics.solver_chunks_solved) == 2
+    assert gauge(metrics.solver_chunks_skipped) == 3
+    metrics.update_incremental_tick(
+        PlanReport(
+            plan=None, n_candidates=4, n_feasible=1, solve_seconds=0.0,
+            delta_pack_lanes=7, upload_bytes=99,
+        )
+    )
+    assert gauge(metrics.solver_delta_pack_lanes) == 7
+    assert gauge(metrics.solver_full_repack) == before + 1  # unchanged
+
+
+def test_pipelined_tick_records_split_phases():
+    """One real tick through the controller must time the pipelined
+    phases (plan-dispatch / observe-metrics / plan-fetch) AND the
+    aggregate plan series, and update the incremental gauges."""
+    from prometheus_client import REGISTRY
+
+    from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+
+    spec = dataclasses.replace(CONFIGS[1], n_pods=12)
+    fc = generate_cluster(spec, seed=3)
+    cfg = ReschedulerConfig(
+        solver="jax", resources=spec.resources, node_drain_delay=0.0
+    )
+    r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=FakeClock())
+
+    def phase_count(phase):
+        return REGISTRY.get_sample_value(
+            "spot_rescheduler_tick_phase_duration_seconds_count",
+            {"phase": phase},
+        ) or 0.0
+
+    before = {
+        p: phase_count(p)
+        for p in ("plan", "plan-dispatch", "plan-fetch", "observe-metrics")
+    }
+    r.tick()
+    for p in ("plan", "plan-dispatch", "plan-fetch", "observe-metrics"):
+        assert phase_count(p) == before[p] + 1, p

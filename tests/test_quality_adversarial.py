@@ -80,7 +80,7 @@ def test_lp_bound_dominates_ilp_small_scale(spec, seed):
 def test_lp_bound_scales_to_config2():
     from bench import build_problem
 
-    packed, _, _ = build_problem(2, 0)
+    packed = build_problem(2, 0)[0]
     lp = lp_upper_bound(packed)
     assert lp is not None
     assert 0 <= lp <= int(np.asarray(packed.cand_valid).sum())
