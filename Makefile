@@ -18,7 +18,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the zero-dependency
 # stand-in (this image ships no Python linter and installs are
 # forbidden).
-check: lint test bench-smoke
+check: lint test bench-smoke repair-smoke
 
 lint:
 	python tools/lint.py
@@ -45,6 +45,16 @@ bench:
 # fewer bytes than the first full-pack tick.
 bench-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --smoke --watchdog 600
+
+# 8-virtual-device spot-chunked repair smoke: a drain only repair can
+# prove, at a budget that previously forced the repair-less 2-D tier —
+# must dispatch to the cand tier with chunked repair, bit-identical to
+# plan_repair_oracle, solver_repair_chunks > 1, repair_unavailable 0
+# (and still 1 past the new fully-chunked ceiling).
+repair-smoke:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python __graft_entry__.py 8 chunked-repair-only
 
 quality:
 	python bench.py --quality

@@ -201,14 +201,16 @@ def _scaled_spec(base, scale: float):
     )
 
 
-def build_problem(config_id: int, seed: int = 0, spec=None):
+def build_problem(config_id: int, seed: int = 0, spec=None, pack_repeats=1):
     """Generate the synthetic cluster and pack it via the production
     observe path: the incrementally-maintained columnar mirror
     (models/columnar.py). The returned pack seconds are the steady-state
     per-tick observe+pack cost (the mirror is already attached, as it is
-    in the control loop). Returns (packed, meta, pack_seconds, client,
-    store, pdbs) — the live cluster rides along so the incremental-tick
-    measurement can churn it between ticks."""
+    in the control loop) — the MEDIAN over ``pack_repeats`` packs, so
+    the parsed ``pack_ms`` isn't a one-shot cold-cache sample. Returns
+    (packed, meta, pack_seconds, client, store, pdbs) — the live
+    cluster rides along so the incremental-tick measurement can churn
+    it between ticks."""
     from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
@@ -224,18 +226,23 @@ def build_problem(config_id: int, seed: int = 0, spec=None):
     )
     pdbs = client.list_pdbs()
     t2 = time.perf_counter()
-    packed, meta = store.pack(
-        pdbs, priority_threshold=cfg.priority_threshold
-    )
-    t3 = time.perf_counter()
+    pack_times = []
+    for _ in range(max(1, pack_repeats)):
+        t_p = time.perf_counter()
+        packed, meta = store.pack(
+            pdbs, priority_threshold=cfg.priority_threshold
+        )
+        pack_times.append(time.perf_counter() - t_p)
+    pack_s = float(np.median(pack_times))
     print(
         f"generate {t1-t0:.1f}s  ingest(once) {t2-t1:.2f}s  "
-        f"columnar observe+pack {(t3-t2)*1e3:.1f} ms  "
+        f"columnar observe+pack {pack_s*1e3:.1f} ms "
+        f"(median of {len(pack_times)})  "
         f"shapes C={packed.slot_req.shape[0]} K={packed.slot_req.shape[1]} "
         f"S={packed.spot_free.shape[0]} R={packed.slot_req.shape[2]}",
         file=sys.stderr,
     )
-    return packed, meta, (t3 - t2), client, store, pdbs
+    return packed, meta, pack_s, client, store, pdbs
 
 
 def run_incremental_ticks(
@@ -999,8 +1006,10 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
     spec = CONFIGS[args.config]
     if args.scale != 1.0:
         spec = _scaled_spec(spec, args.scale)
+    # pack_repeats: the parsed pack_ms is the observe+pack MEDIAN
+    # (VERDICT item 7) — a single sample rides cold caches
     packed, _, pack_s, client, store, pdbs = build_problem(
-        args.config, args.seed, spec=spec
+        args.config, args.seed, spec=spec, pack_repeats=5
     )
 
     # single-chip HBM guard — the same dispatch the production planner
@@ -1159,12 +1168,19 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
         "vs_baseline": round(TARGET_MS / value_ms, 3),
         "device": jax.devices()[0].device_kind,
         "steady_tick_ms": round(steady_ms, 3),
+        # the columnar observe+pack median, driver-visible (VERDICT
+        # next-round item 7): the host half of every tick
+        "pack_ms": round(pack_s * 1e3, 3),
     }
     if incremental_active:
         out["delta_upload_bytes"] = int(tick_report.upload_bytes)
         out["delta_pack_lanes"] = int(tick_report.delta_pack_lanes)
         out["chunks_solved"] = int(tick_report.chunks_solved)
         out["chunks_skipped"] = int(tick_report.chunks_skipped)
+    if tick_report.repair_chunks > 1:
+        # spot-chunked repair engaged (per-lane repair state exceeded
+        # one device at these shapes)
+        out["repair_chunks"] = int(tick_report.repair_chunks)
     if scale_note is not None:
         out["scale_note"] = scale_note
         out["solver"] = args.solver

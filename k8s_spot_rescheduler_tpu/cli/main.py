@@ -91,6 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop solving at the first chunk containing a "
                         "feasible lane (selection is identical; the "
                         "feasible count then covers the solved prefix)")
+    p.add_argument("--jax-cache-dir", default=d.jax_cache_dir,
+                   help="persistent XLA compilation cache directory; the "
+                        "~seconds cold compile of the solver programs is "
+                        "then paid once per image instead of per process "
+                        "restart (empty = no persistent cache)")
     p.add_argument("--leader-elect", type=_bool, default=False,
                    help="Lease-based leader election so only one replica "
                         "acts (restores what reference rescheduler.go:139 "
@@ -141,6 +146,7 @@ def config_from_args(args) -> ReschedulerConfig:
         incremental_device_cache=args.incremental_device_cache,
         staged_chunk_lanes=args.staged_chunk_lanes,
         staged_early_exit=args.staged_early_exit,
+        jax_cache_dir=args.jax_cache_dir,
         resources=tuple(r for r in args.resources.split(",") if r),
         mesh_shape=(
             tuple(int(x) for x in args.mesh_shape.lower().split("x"))

@@ -108,10 +108,22 @@ solver_mode = Gauge(
 repair_unavailable = Gauge(
     "repair_unavailable",
     "1 while the last solve ran WITHOUT the repair phase the config "
-    "asked for (the mesh-sharded program drops it past single-chip "
-    "scale when lane-local spot state no longer fits one device) — "
-    "drains in the contended regimes repair exists for may be missed; "
-    "alarm on this to catch degraded-quality mode.",
+    "asked for (only the 2-D cand×spot tier drops it, past even the "
+    "spot-CHUNKED repair ceiling — the cand-only tier keeps repair, "
+    "chunked when one lane block's unchunked state no longer fits a "
+    "device) — drains in the contended regimes repair exists for may "
+    "be missed; alarm on this to catch degraded-quality mode.",
+    namespace=NAMESPACE,
+)
+
+solver_repair_chunks = Gauge(
+    "solver_repair_chunks",
+    "Spot chunks the repair phase of the last solve ran with: 1 = the "
+    "unchunked single-sweep search, >1 = the elect-then-commit "
+    "spot-chunked search (per-lane repair state exceeded one device's "
+    "budget; solver/repair.plan_repair_chunked), 0 = repair did not "
+    "run (disabled by config, or dropped on the 2-D tier past the "
+    "chunked ceiling — repair_unavailable distinguishes the two).",
     namespace=NAMESPACE,
 )
 
@@ -198,17 +210,24 @@ _last_solver_mode = [None]  # (configured, running) of the previous solve
 
 
 def update_solver_mode(
-    configured: str, running: str, repair_dropped: bool
+    configured: str,
+    running: str,
+    repair_dropped: bool,
+    repair_chunks: int | None = None,
 ) -> None:
     """Expose what the last solve actually ran. The previous label pair
     is zeroed (not removed) so dashboards see a clean 1-of-N encoding
-    and the flip to/from the reroute is a visible edge."""
+    and the flip to/from the reroute is a visible edge.
+    ``repair_chunks`` mirrors the dispatch decision's spot-chunk count
+    into ``solver_repair_chunks`` (None leaves the gauge untouched)."""
     prev = _last_solver_mode[0]
     if prev is not None and prev != (configured, running):
         solver_mode.labels(*prev).set(0)
     solver_mode.labels(configured, running).set(1)
     _last_solver_mode[0] = (configured, running)
     repair_unavailable.set(1 if repair_dropped else 0)
+    if repair_chunks is not None:
+        solver_repair_chunks.set(repair_chunks)
 
 
 def update_incremental_tick(report) -> None:

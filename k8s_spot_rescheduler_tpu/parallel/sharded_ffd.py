@@ -25,7 +25,23 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.6: top-level export, replication check named check_vma
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+except ImportError:  # older jax: experimental module, kwarg check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.parallel.mesh import CAND_AXIS, SPOT_AXIS, make_mesh
@@ -206,6 +222,7 @@ def plan_union_cand_sharded(
     *,
     rounds: int = 0,
     best_fit_fallback: bool = True,
+    repair_spot_chunks: int = 1,
 ) -> SolveResult:
     """Candidate-ONLY sharding: each device holds a block of candidate
     lanes with the FULL spot axis replicated, and runs the complete
@@ -216,8 +233,14 @@ def plan_union_cand_sharded(
     state (solver/repair.py) exists unchanged — the quality phase the
     2-D cand×spot layout must drop survives past single-chip scale
     whenever one lane's full spot state still fits one device
-    (solver/memory.estimate_union_hbm_bytes at C/n). ``mesh`` is the
-    1-D all-device mesh of ``parallel/mesh.make_cand_mesh``."""
+    (solver/memory.estimate_union_hbm_bytes at C/n). Past THAT,
+    ``repair_spot_chunks`` > 1 runs the elect-then-commit spot-chunked
+    repair inside each device (solver/repair.plan_repair_chunked,
+    bit-identical), shrinking the per-round working set to
+    O(S / chunks) and carrying repair further still — only when even
+    the fully-chunked block exceeds the budget does the dispatch fall
+    to the repair-less 2-D layout. ``mesh`` is the 1-D all-device mesh
+    of ``parallel/mesh.make_cand_mesh``."""
     from k8s_spot_rescheduler_tpu.solver.fallback import (
         with_best_fit_fallback,
         with_repair,
@@ -225,7 +248,7 @@ def plan_union_cand_sharded(
     from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
 
     if best_fit_fallback and rounds > 0:
-        solve = with_repair(plan_ffd, rounds)
+        solve = with_repair(plan_ffd, rounds, spot_chunks=repair_spot_chunks)
     elif best_fit_fallback:
         solve = with_best_fit_fallback(plan_ffd)
     else:

@@ -57,6 +57,10 @@ class PlanReport:
     # early exit truncated n_feasible to the solved prefix (a drain WAS
     # found; the why-no-drain gauges read this tick as an upper bound)
     count_truncated: bool = False
+    # spot chunks the repair phase ran with: 1 = unchunked, >1 = the
+    # elect-then-commit spot-chunked search engaged (per-lane repair
+    # state exceeded one device), 0 = repair off/unavailable this solve
+    repair_chunks: int = 1
 
 
 class Planner(Protocol):

@@ -55,9 +55,14 @@ def with_best_fit_fallback(solve_fn):
     return solve
 
 
-def with_repair(solve_fn, rounds: int):
+def with_repair(solve_fn, rounds: int, spot_chunks: int = 1):
     """First-fit ∪ best-fit ∪ bounded local-search repair
     (solver/repair.py), still one fused device program.
+    ``spot_chunks`` > 1 swaps in the elect-then-commit spot-chunked
+    search (``plan_repair_chunked``, bit-identical results) whose
+    per-round working set is O(S / spot_chunks) — how the cand-only
+    sharding tier keeps repair past its unchunked ceiling
+    (solver/memory.pick_repair_chunks decides the count).
 
     Preference order keeps the drain decision identical to the
     reference whenever the reference could have made one: a lane's
@@ -73,7 +78,19 @@ def with_repair(solve_fn, rounds: int):
     where first-fit proves every valid lane — the common, uncontended
     case — skips both entirely at runtime. Identical results either
     way."""
-    from k8s_spot_rescheduler_tpu.solver.repair import plan_repair
+    from k8s_spot_rescheduler_tpu.solver.repair import (
+        plan_repair,
+        plan_repair_chunked,
+    )
+
+    if spot_chunks > 1:
+        def repair_thunk(packed):
+            return plan_repair_chunked(
+                packed, rounds=rounds, spot_chunks=spot_chunks
+            )
+    else:
+        def repair_thunk(packed):
+            return plan_repair(packed, rounds=rounds)
 
     def solve(packed) -> SolveResult:
         cand_valid = jnp.asarray(packed.cand_valid)
@@ -82,9 +99,7 @@ def with_repair(solve_fn, rounds: int):
         bf = _cond_solve(need_bf, lambda: solve_fn(packed, best_fit=True), ff)
         greedy_feasible = ff.feasible | bf.feasible
         need_repair = jnp.any(cand_valid & ~greedy_feasible)
-        rp = _cond_solve(
-            need_repair, lambda: plan_repair(packed, rounds=rounds), ff
-        )
+        rp = _cond_solve(need_repair, lambda: repair_thunk(packed), ff)
         feasible = greedy_feasible | rp.feasible
         assignment = jnp.where(
             ff.feasible[:, None],

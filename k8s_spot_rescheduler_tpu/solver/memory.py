@@ -33,26 +33,81 @@ from typing import Optional
 DEFAULT_HBM_BYTES = 16 * 1024**3
 BUDGET_FRACTION = 0.85
 
+# A repair spot chunk narrower than the TPU lane width stops paying:
+# every [C, Sc] temporary pads back up to 128 lanes in VMEM/HBM tiles.
+MIN_REPAIR_CHUNK = 128
+
 
 def estimate_union_hbm_bytes(
-    C: int, K: int, S: int, R: int, W: int, A: int
+    C: int, K: int, S: int, R: int, W: int, A: int,
+    repair_spot_chunks: int = 1,
 ) -> int:
     """Estimated peak HBM of the fused union solver at these shapes.
 
     Dominant terms: the scan carries — one [C, S] plane per resource
     (free), per affinity word (aff), plus one (count) — double-buffered
     by the scan (x2), plus ~3 per-step temporary planes (fit mask,
-    slack, onehot live ranges); then the scan slot inputs and the
+    slack, onehot live ranges); then the repair rounds' working set —
+    the unlocker probe, the two first-fit re-placement sweeps, the
+    [C, R, S] commit delta and the affinity rewrite intermediates, about
+    (R + 2A + 7) live [C, S] planes; then the scan slot inputs and the
     assignment outputs. Spot-static rows are O(S) and negligible but
     included for completeness.
+
+    ``repair_spot_chunks`` > 1 models the elect-then-commit chunked
+    repair (solver/repair.plan_repair_chunked): only one spot chunk's
+    round temporaries are live at a time, so that term divides by the
+    chunk count — the carries (which every greedy pass needs too) do
+    not, which is what sets the NEW, fully-chunked ceiling.
+    ``repair_spot_chunks=0`` models a program with NO repair phase at
+    all (``fallback_best_fit`` off or ``repair_rounds=0``): the repair
+    working set is never allocated, so charging it would reroute such
+    configs off one chip for memory they never use.
     """
     plane = C * S * 4  # one f32/i32/u32 [C, S] plane
     carries = 2 * (R + A + 1) * plane  # double-buffered scan state
     temporaries = 3 * plane
+    repair_temp = (
+        0
+        if repair_spot_chunks == 0
+        else (R + 2 * A + 7) * plane // repair_spot_chunks
+    )
     slots = K * C * (R * 4 + 1 + W * 4 + A * 4)
     outputs = 2 * C * K * 4  # chosen [K, C] + assignment [C, K]
     spot_static = S * (R * 4 + 4 + 4 + W * 4 + 1 + A * 4)
-    return carries + temporaries + slots + outputs + spot_static
+    return (
+        carries + temporaries + repair_temp + slots + outputs + spot_static
+    )
+
+
+def pick_repair_chunks(
+    C: int, K: int, S: int, R: int, W: int, A: int, budget_bytes: int
+) -> int:
+    """Spot-chunk count for the repair phase at these shapes.
+
+    1 = the unchunked union program already fits ``budget_bytes``;
+    >1 = the smallest power-of-two chunking (each chunk kept at least
+    MIN_REPAIR_CHUNK spots wide) whose per-round working set fits;
+    0 = even fully chunked the residual scan carries exceed the budget
+    — the regime of the 2-D cand×spot tier, where the repair phase is
+    genuinely unavailable and ``repair_unavailable`` must fire.
+
+    Chunk counts are powers of two only (one compiled program per
+    count, O(log S) of them at most — the same recompile-bounding
+    discipline as the delta pads), and each chunk must come out at
+    least MIN_REPAIR_CHUNK spots wide (``ceil(S / n)``, matching the
+    padding ``plan_repair_chunked`` itself applies).
+    """
+    n = 1
+    while True:
+        est = estimate_union_hbm_bytes(
+            C, K, S, R, W, A, repair_spot_chunks=n
+        )
+        if est <= budget_bytes:
+            return n
+        n *= 2
+        if -(-S // n) < MIN_REPAIR_CHUNK:
+            return 0
 
 
 def packed_shapes(packed) -> tuple:
@@ -85,11 +140,17 @@ def should_shard(
     n_devices: int,
     *,
     budget_bytes: Optional[int] = None,
+    repair_spot_chunks: int = 1,
 ) -> bool:
     """True when the union program won't fit one chip AND a mesh exists
     to shard it over. With one device this is always False — the caller
-    keeps the single-chip path and its honest OOM."""
+    keeps the single-chip path and its honest OOM.
+    ``repair_spot_chunks=0`` = the configured program has no repair
+    phase (its working set must not count against the chip)."""
     if n_devices <= 1:
         return False
     budget = budget_bytes if budget_bytes else device_hbm_budget()
-    return estimate_union_hbm_bytes(*packed_shapes(packed)) > budget
+    est = estimate_union_hbm_bytes(
+        *packed_shapes(packed), repair_spot_chunks=repair_spot_chunks
+    )
+    return est > budget

@@ -369,6 +369,465 @@ def plan_repair(
 plan_repair_jit = jax.jit(plan_repair, static_argnames=("rounds", "chain"))
 
 
+# --- spot-chunked repair (elect-then-commit) -------------------------------
+#
+# Past the cand-only sharding tier's unchunked ceiling, one lane block's
+# repair program no longer fits a device: the round's working set — the
+# unlocker probe, the two first-fit re-placement sweeps, the [C, R, S]
+# commit delta and the affinity rewrites — is O(S) wide. First-fit
+# already decomposes exactly over an ordered spot partition
+# (ops/pallas_ffd._plan_ffd_chunked); the functions below extend that
+# decomposition to the eject-and-reinsert search in a two-phase
+# *elect-then-commit* form:
+#
+# 1. ELECT — each spot chunk computes its local unlocker candidates and
+#    first-fit re-placement targets; cheap elections combine them:
+#    unlockers are a disjoint union over chunks (each placed pod lives
+#    in exactly one chunk), and "first fitting node" is the minimum of
+#    the chunk-local winners' GLOBAL indices — chunks are ordered, so
+#    the minimum reproduces the unchunked argmax-of-bool probe order
+#    bit for bit. The q/r rotation then runs on the combined masks in
+#    global slot order, unchanged.
+# 2. COMMIT — the exact affinity-recompute gate (O(K·A), chunk-free)
+#    vets the elected move, and only the chunks holding the (at most
+#    three) touched nodes change state.
+#
+# Per-round temporaries are therefore O(C × S/chunks), never O(C × S);
+# the carried state is the same free/count/aff set every greedy pass
+# already holds. The final from-scratch validation
+# (solver/validate.py) is unchanged, so chunked repair can still never
+# approve an invalid drain. Bit parity with ``plan_repair_oracle`` is
+# pinned by tests/test_repair_chunked.py and the dryrun harness.
+
+_BIG_IDX = 2**30  # > any global spot index; int so jnp weak-types it
+
+
+def _chunk_minor(arr, n: int, Sc: int):
+    """[..., n*Sc] -> [n, ..., Sc]: split the minor spot axis into n
+    ordered chunk-major blocks (block j holds global spots
+    [j*Sc, (j+1)*Sc))."""
+    parts = jnp.reshape(arr, (*arr.shape[:-1], n, Sc))
+    return jnp.moveaxis(parts, -2, 0)
+
+
+def _chunked_partial_step(chunk_xs, Sc, carry, slot):
+    """Best-fit-with-gaps placement of one pod slot over spot chunks.
+    Each chunk elects its local tightest fit; a lexicographic
+    (slack, chunk-order) election picks the global winner — identical
+    to the unchunked argmin (ties resolve to the earlier probe index) —
+    and only the winning chunk's state is committed."""
+    taints_c, ok_c, maxp_c, offs = chunk_xs
+    free_c, count_c, aff_c = carry
+    req, valid, tol, aff = slot  # [C,R], [C], [C,W], [C,A]
+    C = req.shape[0]
+
+    def elect(best, xs):
+        best_slack, best_g = best
+        free_j, count_j, aff_j, taints_j, ok_j, maxp_j, off = xs
+        fits = fit_mask_t(
+            jnp,
+            free_t=free_j,
+            count=count_j,
+            max_pods=maxp_j,
+            node_taints_t=taints_j,
+            node_ok=ok_j,
+            node_aff_t=aff_j,
+            req=req,
+            tol=tol,
+            aff=aff,
+        )  # [C, Sc]
+        slack = jnp.where(fits, free_j[:, 0, :] - req[:, None, 0], jnp.inf)
+        m = jnp.min(slack, axis=-1)
+        i = jnp.argmin(slack, axis=-1).astype(jnp.int32)
+        better = m < best_slack  # strict: ties keep the earlier chunk
+        return (
+            jnp.where(better, m, best_slack),
+            jnp.where(better, off + i, best_g),
+        ), None
+
+    (best_slack, best_g), _ = jax.lax.scan(
+        elect,
+        (
+            jnp.full((C,), jnp.inf, free_c.dtype),
+            jnp.zeros((C,), jnp.int32),
+        ),
+        (free_c, count_c, aff_c, taints_c, ok_c, maxp_c, offs),
+    )
+    place = valid & jnp.isfinite(best_slack)
+
+    def commit(xs):
+        free_j, count_j, aff_j, off = xs
+        loc = best_g - off
+        onehot = (
+            jnp.arange(Sc)[None, :] == loc[:, None]
+        ) & place[:, None]  # [C, Sc]
+        return (
+            free_j - onehot[:, None, :] * req[:, :, None],
+            count_j + onehot.astype(count_j.dtype),
+            aff_j | jnp.where(onehot[:, None, :], aff[:, :, None], 0),
+        )
+
+    free_c, count_c, aff_c = jax.lax.map(
+        commit, (free_c, count_c, aff_c, offs)
+    )
+    chosen = jnp.where(place, best_g, jnp.int32(-1))
+    return (free_c, count_c, aff_c), chosen
+
+
+def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
+    """One elect-then-commit repair round (bit-identical to
+    ``_repair_round``): chunk-local sweeps build the unlocker set and
+    re-placement targets, elections pick the move in global index
+    order, the exact affinity gate vets it, and only the winning
+    chunks' state commits."""
+    spot_aff_static, slot_req, slot_valid, slot_tol, slot_aff = small
+    taints_c, ok_c, maxp_c, offs = chunk_xs
+    free_c, count_c, aff_c, assign = state
+    C, K, R = slot_req.shape
+    Sp = free_c.shape[0] * Sc
+    ks = jnp.arange(K)[None, :]
+    gsc = jnp.arange(Sc)[None, :]
+
+    unplaced = slot_valid & (assign < 0)  # [C, K]
+    has_gap = jnp.any(unplaced, axis=-1)
+    p = jnp.argmax(unplaced, axis=-1)
+
+    req_p = jnp.take_along_axis(slot_req, p[:, None, None], axis=1)[:, 0]
+    tol_p = jnp.take_along_axis(slot_tol, p[:, None, None], axis=1)[:, 0]
+    aff_p = jnp.take_along_axis(slot_aff, p[:, None, None], axis=1)[:, 0]
+
+    placed = assign >= 0  # [C, K]
+    s_q = jnp.clip(assign, 0, Sp - 1)  # [C, K] global node per pod
+    req_t = jnp.swapaxes(slot_req, 1, 2)  # [C, R, K]
+
+    # ---- sweep A (elect): chunk-local unlocker candidates. Each placed
+    # pod lives in exactly one chunk, so the union over chunks is the
+    # unchunked unlock mask exactly.
+    def sweep_unlock(unlock, xs):
+        free_j, taints_j, ok_j, off = xs
+        word_ok = jnp.all(
+            (taints_j & ~tol_p[:, :, None]) == 0, axis=1
+        )  # [C, Sc]
+        static_p = word_ok & ok_j
+        in_j = (s_q >= off) & (s_q < off + Sc)  # [C, K]
+        loc = jnp.clip(s_q - off, 0, Sc - 1)
+        free_at_q = jnp.take_along_axis(
+            free_j, loc[:, None, :], axis=2
+        )  # [C, R, K]
+        res_ok = jnp.all(free_at_q + req_t - req_p[:, :, None] >= 0, axis=1)
+        static_at_q = jnp.take_along_axis(static_p, loc, axis=1)
+        return unlock | (placed & in_j & res_ok & static_at_q), None
+
+    unlock, _ = jax.lax.scan(
+        sweep_unlock,
+        jnp.zeros((C, K), bool),
+        (free_c, taints_c, ok_c, offs),
+    )
+
+    # q election: deterministic rotation in global slot order, unchanged
+    n_unlock = unlock.sum(axis=-1)
+    rank = jnp.cumsum(unlock, axis=-1) - 1
+    want = jnp.where(
+        n_unlock > 0, round_idx % jnp.maximum(n_unlock, 1), -1
+    )
+    is_q = unlock & (rank == want[:, None])
+    q = jnp.argmax(is_q, axis=-1)
+    any_q = jnp.any(is_q, axis=-1)
+
+    req_q = jnp.take_along_axis(slot_req, q[:, None, None], axis=1)[:, 0]
+    tol_q = jnp.take_along_axis(slot_tol, q[:, None, None], axis=1)[:, 0]
+    aff_q = jnp.take_along_axis(slot_aff, q[:, None, None], axis=1)[:, 0]
+    sq_star = jnp.take_along_axis(s_q, q[:, None], axis=1)[:, 0]
+
+    # ---- sweep B (elect): q's first-fit re-placement target — the
+    # minimum over chunk-local winners' global indices IS the global
+    # first fit — plus (chain) the chunk-local r candidates.
+    def sweep_q(carry_b, xs):
+        s2g, eligible_r = carry_b
+        free_j, count_j, aff_j, taints_j, ok_j, maxp_j, off = xs
+        fits_q = fit_mask_t(
+            jnp,
+            free_t=free_j,
+            count=count_j,
+            max_pods=maxp_j,
+            node_taints_t=taints_j,
+            node_ok=ok_j,
+            node_aff_t=aff_j,
+            req=req_q,
+            tol=tol_q,
+            aff=aff_q,
+        )  # [C, Sc]
+        gid = off + gsc
+        fits_q &= gid != sq_star[:, None]
+        first = jnp.argmax(fits_q, axis=-1).astype(jnp.int32)
+        cand = jnp.where(jnp.any(fits_q, axis=-1), off + first, _BIG_IDX)
+        s2g = jnp.minimum(s2g, cand)
+        if chain:
+            word_ok_q = jnp.all(
+                (taints_j & ~tol_q[:, :, None]) == 0, axis=1
+            )
+            static_q = word_ok_q & ok_j
+            in_j = (s_q >= off) & (s_q < off + Sc)
+            loc = jnp.clip(s_q - off, 0, Sc - 1)
+            free_at_q = jnp.take_along_axis(free_j, loc[:, None, :], axis=2)
+            res_ok_r = jnp.all(
+                free_at_q + req_t - req_q[:, :, None] >= 0, axis=1
+            )
+            static_q_at = jnp.take_along_axis(static_q, loc, axis=1)
+            eligible_r = eligible_r | (
+                placed
+                & in_j
+                & (s_q != sq_star[:, None])
+                & static_q_at
+                & res_ok_r
+            )
+        return (s2g, eligible_r), None
+
+    (s2g, eligible_r), _ = jax.lax.scan(
+        sweep_q,
+        (
+            jnp.full((C,), _BIG_IDX, jnp.int32),
+            jnp.zeros((C, K), bool),
+        ),
+        (free_c, count_c, aff_c, taints_c, ok_c, maxp_c, offs),
+    )
+    can_move = s2g < _BIG_IDX
+
+    if chain:
+        # r election: independent rotation schedule (see _repair_round)
+        n_r = eligible_r.sum(axis=-1)
+        rank_r = jnp.cumsum(eligible_r, axis=-1) - 1
+        want_r = jnp.where(
+            n_r > 0,
+            (round_idx // jnp.maximum(n_unlock, 1)) % jnp.maximum(n_r, 1),
+            -1,
+        )
+        is_r = eligible_r & (rank_r == want_r[:, None])
+        r = jnp.argmax(is_r, axis=-1)
+        any_r = jnp.any(is_r, axis=-1)
+        sr_star = jnp.take_along_axis(s_q, r[:, None], axis=1)[:, 0]
+        req_r = jnp.take_along_axis(slot_req, r[:, None, None], axis=1)[:, 0]
+        tol_r = jnp.take_along_axis(slot_tol, r[:, None, None], axis=1)[:, 0]
+        aff_r = jnp.take_along_axis(slot_aff, r[:, None, None], axis=1)[:, 0]
+
+        # ---- sweep C (elect): r's re-placement target
+        def sweep_r(s3g, xs):
+            free_j, count_j, aff_j, taints_j, ok_j, maxp_j, off = xs
+            fits_r = fit_mask_t(
+                jnp,
+                free_t=free_j,
+                count=count_j,
+                max_pods=maxp_j,
+                node_taints_t=taints_j,
+                node_ok=ok_j,
+                node_aff_t=aff_j,
+                req=req_r,
+                tol=tol_r,
+                aff=aff_r,
+            )
+            gid = off + gsc
+            fits_r &= (gid != sr_star[:, None]) & (gid != sq_star[:, None])
+            first = jnp.argmax(fits_r, axis=-1).astype(jnp.int32)
+            cand = jnp.where(
+                jnp.any(fits_r, axis=-1), off + first, _BIG_IDX
+            )
+            return jnp.minimum(s3g, cand), None
+
+        s3g, _ = jax.lax.scan(
+            sweep_r,
+            jnp.full((C,), _BIG_IDX, jnp.int32),
+            (free_c, count_c, aff_c, taints_c, ok_c, maxp_c, offs),
+        )
+        r_can_move = s3g < _BIG_IDX
+
+    # ---- exact affinity gates: O(K·A), no spot-wide work
+    others = placed & (assign == sq_star[:, None]) & (ks != q[:, None])
+    contrib = jnp.where(
+        others[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
+    )
+    aff_ej = jax.lax.reduce(
+        contrib, np.uint32(0), jax.lax.bitwise_or, (2,)
+    ) | spot_aff_static[sq_star]
+    aff_ok_p = jnp.all((aff_p & aff_ej) == 0, axis=1)
+    do_direct = has_gap & any_q & can_move & aff_ok_p
+
+    if not chain:
+        do_chain = jnp.zeros_like(do_direct)
+        sr_star = s2g
+        s3g = s2g
+        req_r = req_q
+        aff_r = aff_q
+        aff_ej_r = aff_ej
+        r = q
+    else:
+        others_r = placed & (assign == sr_star[:, None]) & (ks != r[:, None])
+        contrib_r = jnp.where(
+            others_r[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
+        )
+        aff_ej_r = jax.lax.reduce(
+            contrib_r, np.uint32(0), jax.lax.bitwise_or, (2,)
+        ) | spot_aff_static[sr_star]
+        aff_ok_q = jnp.all((aff_q & aff_ej_r) == 0, axis=1)
+        do_chain = (
+            has_gap & any_q & ~can_move & aff_ok_p
+            & any_r & r_can_move & aff_ok_q
+        )
+    do = do_direct | do_chain
+
+    q_dest = jnp.where(do_chain, sr_star, s2g)
+    inc_node = jnp.where(do_chain, s3g, s2g)
+    qd_col = jnp.where(do_chain[:, None], aff_ej_r | aff_q, jnp.uint32(0))
+
+    # ---- COMMIT: only chunks holding a touched node change state
+    def commit(xs):
+        free_j, count_j, aff_j, off = xs
+        gid = off + gsc
+        onehot_sq = gid == sq_star[:, None]  # [C, Sc]
+        onehot_qd = gid == q_dest[:, None]
+        onehot_s3 = (gid == s3g[:, None]) & do_chain[:, None]
+        onehot_inc = gid == inc_node[:, None]
+        delta = (
+            onehot_sq[:, None, :] * (req_q - req_p)[:, :, None]
+            - onehot_qd[:, None, :] * req_q[:, :, None]
+            + onehot_qd[:, None, :]
+            * do_chain[:, None, None]
+            * req_r[:, :, None]
+            - onehot_s3[:, None, :] * req_r[:, :, None]
+        )
+        free_j = jnp.where(do[:, None, None], free_j + delta, free_j)
+        count_j = jnp.where(
+            do[:, None],
+            count_j + onehot_inc.astype(count_j.dtype),
+            count_j,
+        )
+        aff_after = jnp.where(
+            onehot_sq[:, None, :], (aff_ej | aff_p)[:, :, None], aff_j
+        )
+        aff_after = jnp.where(
+            (onehot_qd & do_chain[:, None])[:, None, :],
+            qd_col[:, :, None],
+            aff_after,
+        ) | jnp.where(
+            (onehot_qd & do_direct[:, None])[:, None, :],
+            aff_q[:, :, None],
+            jnp.uint32(0),
+        ) | jnp.where(
+            onehot_s3[:, None, :], aff_r[:, :, None], jnp.uint32(0)
+        )
+        aff_j = jnp.where(do[:, None, None], aff_after, aff_j)
+        return free_j, count_j, aff_j
+
+    free_c, count_c, aff_c = jax.lax.map(
+        commit, (free_c, count_c, aff_c, offs)
+    )
+    assign = jnp.where(
+        do[:, None],
+        jnp.where(
+            ks == p[:, None],
+            sq_star[:, None].astype(assign.dtype),
+            jnp.where(
+                ks == q[:, None],
+                q_dest[:, None].astype(assign.dtype),
+                jnp.where(
+                    (ks == r[:, None]) & do_chain[:, None],
+                    s3g[:, None].astype(assign.dtype),
+                    assign,
+                ),
+            ),
+        ),
+        assign,
+    )
+    return (free_c, count_c, aff_c, assign), ()
+
+
+def plan_repair_chunked(
+    packed: PackedCluster,
+    rounds: int = DEFAULT_ROUNDS,
+    chain: bool = True,
+    spot_chunks: int = 2,
+) -> SolveResult:
+    """``plan_repair`` restructured over ``spot_chunks`` ordered spot
+    chunks (elect-then-commit; see the module section above) —
+    bit-identical results, per-round temporaries O(S / spot_chunks).
+    The spot axis is padded to a chunk multiple with inert nodes
+    (``spot_ok``=False, at the end of the probe order), so placements
+    and assignment indices are unchanged; validation runs against the
+    ORIGINAL packed problem."""
+    if spot_chunks <= 1:
+        return plan_repair(packed, rounds=rounds, chain=chain)
+    C, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    n = int(spot_chunks)
+    Sc = -(-S // n)
+    pad = n * Sc - S
+
+    def pad_s(arr):
+        arr = jnp.asarray(arr)
+        if pad == 0:
+            return arr
+        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        return jnp.pad(arr, widths)
+
+    spot_free = pad_s(packed.spot_free)  # [Sp, R]
+    spot_aff = pad_s(packed.spot_aff)  # [Sp, A]
+    free_t = spot_free.T
+    aff_t = spot_aff.T
+    free_c = _chunk_minor(
+        jnp.broadcast_to(free_t, (C, *free_t.shape)), n, Sc
+    )  # [n, C, R, Sc]
+    count_c = _chunk_minor(
+        jnp.broadcast_to(pad_s(packed.spot_count), (C, n * Sc)).astype(
+            jnp.int32
+        ),
+        n,
+        Sc,
+    )
+    aff_c = _chunk_minor(jnp.broadcast_to(aff_t, (C, *aff_t.shape)), n, Sc)
+    chunk_xs = (
+        _chunk_minor(pad_s(packed.spot_taints).T, n, Sc),  # [n, W, Sc]
+        _chunk_minor(pad_s(packed.spot_ok), n, Sc),  # [n, Sc]
+        _chunk_minor(pad_s(packed.spot_max_pods), n, Sc),  # [n, Sc]
+        jnp.arange(n, dtype=jnp.int32) * Sc,  # chunk offsets
+    )
+
+    slots = (
+        jnp.moveaxis(jnp.asarray(packed.slot_req), 1, 0),
+        jnp.moveaxis(jnp.asarray(packed.slot_valid), 1, 0),
+        jnp.moveaxis(jnp.asarray(packed.slot_tol), 1, 0),
+        jnp.moveaxis(jnp.asarray(packed.slot_aff), 1, 0),
+    )
+    (free_c, count_c, aff_c), chosen = jax.lax.scan(
+        functools.partial(_chunked_partial_step, chunk_xs, Sc),
+        (free_c, count_c, aff_c),
+        slots,
+    )
+    assign0 = jnp.swapaxes(chosen, 0, 1).astype(jnp.int32)  # [C, K]
+
+    small = (
+        spot_aff,  # static resident bits, [Sp, A]
+        jnp.asarray(packed.slot_req),
+        jnp.asarray(packed.slot_valid),
+        jnp.asarray(packed.slot_tol),
+        jnp.asarray(packed.slot_aff),
+    )
+    state = (free_c, count_c, aff_c, assign0)
+    state, _ = jax.lax.scan(
+        functools.partial(_chunked_repair_round, small, chunk_xs, chain, Sc),
+        state,
+        jnp.arange(rounds),
+    )
+    assign = state[3]
+
+    feasible = validate_assignment(jnp, packed, assign)
+    assignment = jnp.where(feasible[:, None], assign, -1)
+    return SolveResult(feasible=feasible, assignment=assignment)
+
+
+plan_repair_chunked_jit = jax.jit(
+    plan_repair_chunked, static_argnames=("rounds", "chain", "spot_chunks")
+)
+
+
 def plan_repair_oracle(
     packed: PackedCluster, rounds: int = DEFAULT_ROUNDS, chain: bool = True
 ) -> SolveResult:

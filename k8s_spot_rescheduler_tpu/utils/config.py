@@ -66,11 +66,17 @@ class ReschedulerConfig:
     - ``auto_shard`` — when the packed problem's estimated footprint
       exceeds one chip's HBM (solver/memory.py) and more than one device
       is visible, the planner automatically reroutes the solve to the
-      mesh-sharded backend (first-fit ∪ best-fit over the device mesh;
-      the repair phase — whose search state is single-chip — is skipped
-      there, a conservative tradeoff: fewer proven drains, never an
-      invalid one). Off → the configured solver runs unconditionally
-      and a past-HBM problem fails with the backend's own OOM.
+      mesh-sharded backends, three rungs deep: cand-only sharding with
+      the full union program per lane block (repair intact); the same
+      tier with the repair rounds spot-CHUNKED (elect-then-commit,
+      solver/repair.plan_repair_chunked — bit-identical results) once a
+      block's unchunked repair state exceeds a device; and only past
+      even the fully-chunked estimate the 2-D cand×spot layout
+      (first-fit ∪ best-fit; repair genuinely unavailable — a
+      conservative tradeoff: fewer proven drains, never an invalid
+      one, alarmed by ``repair_unavailable``). Off → the configured
+      solver runs unconditionally and a past-HBM problem fails with
+      the backend's own OOM.
     - ``solver_hbm_budget`` — per-device byte budget for that decision;
       0 = auto-detect from the backend (v5e default 16 GB x 0.85).
     """
@@ -120,6 +126,13 @@ class ReschedulerConfig:
     incremental_device_cache: bool = True
     staged_chunk_lanes: int = 256
     staged_early_exit: bool = True
+    # Persistent XLA compilation cache directory (``--jax-cache-dir``):
+    # the solver programs cost seconds of cold compile per process
+    # (~3.7 s at config-3 shapes, BENCH_r05); pointing this at a
+    # volume-backed path pays that once per image, not per restart —
+    # jax.config "jax_compilation_cache_dir", wired by SolverPlanner
+    # before any program is built. Empty = no persistent cache.
+    jax_cache_dir: str = ""
 
     def __post_init__(self):
         from k8s_spot_rescheduler_tpu.utils.labels import validate_label

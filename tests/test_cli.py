@@ -50,3 +50,28 @@ def test_synthetic_demo_run():
          "--node-drain-delay", "1s"]
     )
     assert rc == 0
+
+
+def test_jax_cache_dir_flag(tmp_path):
+    """--jax-cache-dir flows into the config, and building a device
+    planner points XLA's persistent compilation cache at it (paid once
+    per image, not per process restart)."""
+    import jax
+
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+
+    cache = str(tmp_path / "xla-cache")
+    args = build_parser().parse_args(["--jax-cache-dir", cache])
+    cfg = config_from_args(args)
+    assert cfg.jax_cache_dir == cache
+    assert config_from_args(build_parser().parse_args([])).jax_cache_dir == ""
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        SolverPlanner(cfg)
+        assert jax.config.jax_compilation_cache_dir == cache
+        import os
+
+        assert os.path.isdir(cache)  # created if absent
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)

@@ -46,6 +46,40 @@ def test_plan_duration_histogram():
     )
 
 
+def test_solver_repair_chunks_gauge():
+    """solver_repair_chunks mirrors the dispatch decision, and
+    repair_unavailable fires ONLY on the repair-dropping 2-D tier (past
+    the chunked ceiling) — the cand tier with chunked repair keeps it
+    clear."""
+    metrics.update_solver_mode(
+        "jax", "jax+cand-sharded", False, repair_chunks=4
+    )
+    assert _value("spot_rescheduler_solver_repair_chunks") == 4
+    assert _value("spot_rescheduler_repair_unavailable") == 0
+    metrics.update_solver_mode("jax", "jax+sharded", True, repair_chunks=0)
+    assert _value("spot_rescheduler_solver_repair_chunks") == 0
+    assert _value("spot_rescheduler_repair_unavailable") == 1
+    # back on a repair-capable path: both recover
+    metrics.update_solver_mode("jax", "jax", False, repair_chunks=1)
+    assert _value("spot_rescheduler_solver_repair_chunks") == 1
+    assert _value("spot_rescheduler_repair_unavailable") == 0
+
+
+def test_repair_ceiling_thresholds_feed_the_gauge():
+    """The dispatch math behind the gauge: chunked estimates fall
+    monotonically, and pick_repair_chunks returns 0 (the only
+    repair_unavailable regime) solely when even full chunking cannot
+    fit the budget."""
+    from k8s_spot_rescheduler_tpu.solver import memory
+
+    shapes = (20480, 32, 20480, 4, 2, 2)  # 8x north star
+    e1 = memory.estimate_union_hbm_bytes(*shapes)
+    e8 = memory.estimate_union_hbm_bytes(*shapes, repair_spot_chunks=8)
+    assert e8 < e1
+    assert memory.pick_repair_chunks(*shapes, budget_bytes=(e1 + e8) // 2) > 1
+    assert memory.pick_repair_chunks(*shapes, budget_bytes=1) == 0
+
+
 def test_tick_phase_histogram():
     """Tick phases (observe/plan/actuate) land in the phase histogram."""
     from k8s_spot_rescheduler_tpu.io.fake import FakeCluster

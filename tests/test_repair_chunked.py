@@ -1,0 +1,361 @@
+"""Spot-chunked repair tests (solver/repair.plan_repair_chunked).
+
+The elect-then-commit chunked search must be BIT-identical to the
+unchunked repair solver and its serial oracle — same partial pass,
+rotation, chain election, exact affinity gates, validation — while its
+per-round working set is O(S / chunks). That identity is what lets the
+cand-only sharding tier carry repair past the unchunked per-device
+ceiling (parallel/sharded_ffd.plan_union_cand_sharded
+``repair_spot_chunks``; dispatch in planner/solver_planner._maybe_shard,
+sized by solver/memory.pick_repair_chunks).
+
+Fixtures are self-contained rather than imported from tests/test_repair:
+that module's import chain needs hypothesis, which not every build image
+ships.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.solver import memory
+from k8s_spot_rescheduler_tpu.solver.repair import (
+    plan_repair_chunked_jit,
+    plan_repair_jit,
+    plan_repair_oracle,
+)
+from tests.test_solver import _random_packed
+
+
+def _swap_case() -> PackedCluster:
+    """tests/test_repair._swap_case: greedy fails, one depth-1
+    relocation (eject b, b -> n1, c -> n0) fixes the lane."""
+    A = 2
+    return PackedCluster(
+        slot_req=np.array([[[6.0], [5.0], [5.0]]], np.float32),
+        slot_valid=np.ones((1, 3), bool),
+        slot_tol=np.array([[[1], [1], [0]]], np.uint32),
+        slot_aff=np.zeros((1, 3, A), np.uint32),
+        cand_valid=np.ones((1,), bool),
+        spot_free=np.array([[11.0], [5.0]], np.float32),
+        spot_count=np.zeros((2,), np.int32),
+        spot_max_pods=np.full((2,), 10, np.int32),
+        spot_taints=np.array([[0], [1]], np.uint32),
+        spot_ok=np.ones((2,), bool),
+        spot_aff=np.zeros((2, A), np.uint32),
+    )
+
+
+def _affinity_swap_case() -> PackedCluster:
+    """tests/test_repair._affinity_swap_case: only the exact affinity
+    ejection (clearing T's group bit from n0) unlocks the lane."""
+    A = 2
+    group = np.array([2, 0], np.uint32)
+    return PackedCluster(
+        slot_req=np.array([[[8.0], [7.0]]], np.float32),
+        slot_valid=np.ones((1, 2), bool),
+        slot_tol=np.array([[[1], [0]]], np.uint32),
+        slot_aff=np.array([[group, group]], np.uint32),
+        cand_valid=np.ones((1,), bool),
+        spot_free=np.array([[9.0], [10.0]], np.float32),
+        spot_count=np.zeros((2,), np.int32),
+        spot_max_pods=np.full((2,), 10, np.int32),
+        spot_taints=np.array([[0], [1]], np.uint32),
+        spot_ok=np.ones((2,), bool),
+        spot_aff=np.zeros((2, A), np.uint32),
+    )
+
+
+def _chain2_interlock_case() -> PackedCluster:
+    """tests/test_repair._rotation_coverage_case: the two-pod interlock
+    only the depth-2 CHAIN with the off-diagonal (q0, r1) pairing
+    solves (p -> n0, q0 -> n3, r1 -> n4)."""
+    A = 2
+    TA, TB, TC = 1, 2, 4
+    return PackedCluster(
+        slot_req=np.array(
+            [[[10.0], [10.0], [10.0], [10.0], [6.0]]], np.float32
+        ),
+        slot_valid=np.ones((1, 5), bool),
+        slot_tol=np.array(
+            [[[TA], [TC], [TA], [TA | TB], [TC]]], np.uint32
+        ),
+        slot_aff=np.zeros((1, 5, A), np.uint32),
+        cand_valid=np.ones((1,), bool),
+        spot_free=np.array(
+            [[10.0], [10.0], [10.0], [10.0], [20.0]], np.float32
+        ),
+        spot_count=np.zeros((5,), np.int32),
+        spot_max_pods=np.full((5,), 10, np.int32),
+        spot_taints=np.array([[0], [TC], [TA], [TA], [TB]], np.uint32),
+        spot_ok=np.ones((5,), bool),
+        spot_aff=np.zeros((5, A), np.uint32),
+    )
+
+
+@pytest.mark.parametrize("chunks", [2, 3, 5])
+@pytest.mark.parametrize(
+    "case", [_swap_case, _affinity_swap_case, _chain2_interlock_case]
+)
+def test_chunked_fixture_parity(case, chunks):
+    """Depth-1 swap, affinity-ejection and chain-2 interlock fixtures:
+    chunked repair proves and places them bit-identically to the serial
+    oracle at every chunking (including chunks > S: all-padding chunks
+    are inert)."""
+    packed = case()
+    want = plan_repair_oracle(packed)
+    assert bool(want.feasible[0])
+    got = plan_repair_chunked_jit(packed, spot_chunks=chunks)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_chunked_oracle_parity_randomized(seed):
+    """Randomized clusters at >= 3 spot chunks: bit parity with the
+    serial oracle (feasibility AND placements)."""
+    packed = _random_packed(np.random.default_rng(4000 + seed))
+    chunks = 3 + seed % 3
+    want = plan_repair_oracle(packed)
+    got = plan_repair_chunked_jit(packed, spot_chunks=chunks)
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+def test_chunked_matches_unchunked_at_scale_with_poisoned_lane():
+    """Config-2-scale columnar pack (real shapes: selectors, taints,
+    groups), with one lane POISONED infeasible (a pod no spot node can
+    hold): chunked and unchunked device repair must agree bit for bit,
+    and the poisoned lane proves the verdict still discriminates."""
+    from k8s_spot_rescheduler_tpu.bench.quality import pack_quality
+    from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS
+
+    packed = pack_quality(CONFIGS[2], 0)
+    cv = np.asarray(packed.cand_valid)
+    sv = np.asarray(packed.slot_valid)
+    c = int(np.flatnonzero(cv)[0])
+    slot_req = np.array(packed.slot_req)
+    slot_req[c, int(np.argmax(sv[c])), :] = 1e9
+    packed = packed._replace(slot_req=slot_req)
+
+    want = plan_repair_jit(packed)
+    got = plan_repair_chunked_jit(packed, spot_chunks=4)
+    w_f = np.asarray(want.feasible)
+    assert not w_f[c]  # poisoned lane infeasible by construction
+    assert w_f.any()  # ...while others remain feasible: discriminating
+    np.testing.assert_array_equal(np.asarray(got.feasible), w_f)
+    np.testing.assert_array_equal(
+        np.asarray(got.assignment), np.asarray(want.assignment)
+    )
+
+
+def test_poisoned_lane_oracle_parity():
+    """The poisoned-infeasible verdict also matches the serial oracle
+    (small fixture, full-depth check): a monster pod's lane reports
+    infeasible under every chunking while the clean lane repairs."""
+    one = _swap_case()
+    packed = PackedCluster(
+        slot_req=np.concatenate(
+            [one.slot_req, np.full((1, 3, 1), 1e9, np.float32)]
+        ),
+        slot_valid=np.concatenate([one.slot_valid, one.slot_valid]),
+        slot_tol=np.concatenate([one.slot_tol, one.slot_tol]),
+        slot_aff=np.concatenate([one.slot_aff, one.slot_aff]),
+        cand_valid=np.ones((2,), bool),
+        spot_free=one.spot_free,
+        spot_count=one.spot_count,
+        spot_max_pods=one.spot_max_pods,
+        spot_taints=one.spot_taints,
+        spot_ok=one.spot_ok,
+        spot_aff=one.spot_aff,
+    )
+    want = plan_repair_oracle(packed)
+    assert bool(want.feasible[0]) and not bool(want.feasible[1])
+    for chunks in (2, 3):
+        got = plan_repair_chunked_jit(packed, spot_chunks=chunks)
+        np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), want.assignment
+        )
+
+
+def test_chunks_of_one_delegates_to_unchunked():
+    packed = _swap_case()
+    got = plan_repair_chunked_jit(packed, spot_chunks=1)
+    want = plan_repair_jit(packed)
+    np.testing.assert_array_equal(
+        np.asarray(got.feasible), np.asarray(want.feasible)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.assignment), np.asarray(want.assignment)
+    )
+
+
+# --- cand-sharded union with chunked repair --------------------------------
+
+
+def test_cand_sharded_union_chunked_repair_parity():
+    """The cand-only layout with ``repair_spot_chunks`` > 1 still runs
+    the COMPLETE union program per lane block: a greedy-unprovable lane
+    must repair bit-identically to the host oracle."""
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_cand_mesh
+    from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
+        plan_union_cand_sharded,
+    )
+    from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+
+    packed = _swap_case()
+    assert not plan_oracle(packed).feasible[0]
+    mesh = make_cand_mesh()
+    got = plan_union_cand_sharded(
+        mesh, packed, rounds=8, repair_spot_chunks=3
+    )
+    want = plan_repair_oracle(packed)
+    assert bool(np.asarray(got.feasible)[0])
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(np.asarray(got.assignment), want.assignment)
+
+
+# --- chunk sizing + dispatch ----------------------------------------------
+
+
+def test_pick_repair_chunks_thresholds():
+    """1 below the unchunked estimate, the smallest sufficient power of
+    two between the chunked estimates, 0 when even full chunking cannot
+    fit — the regime repair_unavailable alarms on."""
+    shapes = (2560, 32, 2560, 4, 2, 2)
+    e1 = memory.estimate_union_hbm_bytes(*shapes)
+    e2 = memory.estimate_union_hbm_bytes(*shapes, repair_spot_chunks=2)
+    e4 = memory.estimate_union_hbm_bytes(*shapes, repair_spot_chunks=4)
+    assert e4 < e2 < e1
+    assert memory.pick_repair_chunks(*shapes, budget_bytes=e1) == 1
+    assert memory.pick_repair_chunks(*shapes, budget_bytes=(e1 + e2) // 2) == 2
+    assert memory.pick_repair_chunks(*shapes, budget_bytes=(e2 + e4) // 2) == 4
+    assert memory.pick_repair_chunks(*shapes, budget_bytes=1) == 0
+    # tiny spot axes cannot chunk below the lane width: unchunked or bust
+    assert memory.pick_repair_chunks(4, 4, 64, 2, 1, 2, budget_bytes=1) == 0
+    # boundary: S=255 CAN chunk to 2 — Sc = ceil(255/2) = 128, exactly
+    # the minimum width (a floor(S/128) cap would wrongly return 0 here
+    # and drop repair)
+    s255 = (2560, 32, 255, 4, 2, 2)
+    b255 = (
+        memory.estimate_union_hbm_bytes(*s255)
+        + memory.estimate_union_hbm_bytes(*s255, repair_spot_chunks=2)
+    ) // 2
+    assert memory.pick_repair_chunks(*s255, budget_bytes=b255) == 2
+    # repair_spot_chunks=0 models a repair-LESS program: its estimate
+    # sits strictly below any chunking (the working set never allocates)
+    assert memory.estimate_union_hbm_bytes(
+        *shapes, repair_spot_chunks=0
+    ) < memory.estimate_union_hbm_bytes(*shapes, repair_spot_chunks=1024)
+
+
+def _chunk_scale_cluster():
+    """A synthetic cluster whose packed spot axis is wide enough
+    (>= 2 x MIN_REPAIR_CHUNK) for the picker to chunk."""
+    from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    spec = dataclasses.replace(
+        CONFIGS[2],
+        name="chunk-dispatch",
+        n_on_demand=48,
+        n_spot=280,
+        n_pods=1200,
+    )
+    cfg = ReschedulerConfig(resources=spec.resources)
+    client = generate_cluster(spec, 0)
+    store = client.columnar_store(
+        cfg.resources,
+        on_demand_label=cfg.on_demand_node_label,
+        spot_label=cfg.spot_node_label,
+    )
+    return spec, store, client.list_pdbs()
+
+
+def _solver_mode_samples():
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+    return {
+        (s.labels["configured"], s.labels["running"]): s.value
+        for s in metrics.solver_mode.collect()[0].samples
+        if s.value
+    }
+
+
+def _gauge(g):
+    return g.collect()[0].samples[0].value
+
+
+def test_planner_dispatches_chunked_repair_between_ceilings():
+    """Budget between the unchunked and 2-chunk lane estimates: the
+    planner must land on the cand tier WITH chunked repair —
+    repair_unavailable stays 0, solver_repair_chunks reads the count,
+    and the drain verdicts match the host oracle stack exactly."""
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    spec, store, pdbs = _chunk_scale_cluster()
+    cfg0 = ReschedulerConfig(resources=spec.resources)
+    packed, _ = store.pack(
+        pdbs,
+        priority_threshold=cfg0.priority_threshold,
+        pad_slots=cfg0.max_pods_per_node_hint,
+    )
+    C, K, S, R, W, A = memory.packed_shapes(packed)
+    assert S >= 2 * memory.MIN_REPAIR_CHUNK
+    lane = -(-C // 8)
+    e1 = memory.estimate_union_hbm_bytes(lane, K, S, R, W, A)
+    e2 = memory.estimate_union_hbm_bytes(
+        lane, K, S, R, W, A, repair_spot_chunks=2
+    )
+    assert e2 < e1
+    budget = (e1 + e2) // 2
+
+    planner = SolverPlanner(
+        ReschedulerConfig(
+            solver="jax",
+            resources=spec.resources,
+            solver_hbm_budget=int(budget),
+        )
+    )
+    report = planner.plan(store, pdbs)
+    assert report.solver == "jax+cand-sharded"
+    assert report.repair_chunks == 2
+    assert _solver_mode_samples() == {("jax", "jax+cand-sharded"): 1.0}
+    assert _gauge(metrics.repair_unavailable) == 0.0
+    assert _gauge(metrics.solver_repair_chunks) == 2.0
+
+    want = SolverPlanner(
+        ReschedulerConfig(solver="numpy", resources=spec.resources)
+    ).plan(store, pdbs)
+    assert report.n_feasible == want.n_feasible
+    if want.plan is not None:
+        assert report.plan is not None
+        assert report.plan.node.node.name == want.plan.node.node.name
+        assert report.plan.assignments == want.plan.assignments
+
+
+def test_planner_drops_repair_only_past_chunked_ceiling():
+    """A budget below even the fully-chunked lane estimate is the ONLY
+    regime that reaches the 2-D tier: repair_unavailable fires there
+    (and nowhere earlier), solver_repair_chunks reads 0."""
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    spec, store, pdbs = _chunk_scale_cluster()
+    planner = SolverPlanner(
+        ReschedulerConfig(
+            solver="jax", resources=spec.resources, solver_hbm_budget=1
+        )
+    )
+    report = planner.plan(store, pdbs)
+    assert report.solver == "jax+sharded"
+    assert report.repair_chunks == 0
+    assert _solver_mode_samples() == {("jax", "jax+sharded"): 1.0}
+    assert _gauge(metrics.repair_unavailable) == 1.0
+    assert _gauge(metrics.solver_repair_chunks) == 0.0

@@ -189,7 +189,10 @@ def test_cand_sharded_union_repairs_greedy_failure():
         plan_union_cand_sharded,
     )
     from k8s_spot_rescheduler_tpu.solver.repair import plan_repair_oracle
-    from tests.test_repair import _swap_case
+
+    # the self-contained copy: tests/test_repair's import chain needs
+    # hypothesis, which not every build image ships
+    from tests.test_repair_chunked import _swap_case
 
     packed = _swap_case()
     assert not plan_oracle(packed).feasible[0]  # greedy fails
