@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint test bench bench-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint test bench bench-smoke chaos-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -18,7 +18,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the zero-dependency
 # stand-in (this image ships no Python linter and installs are
 # forbidden).
-check: lint test bench-smoke repair-smoke
+check: lint test bench-smoke repair-smoke chaos-smoke
 
 lint:
 	python tools/lint.py
@@ -55,6 +55,13 @@ repair-smoke:
 	env JAX_PLATFORMS=cpu \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python __graft_entry__.py 8 chunked-repair-only
+
+# Seeded chaos soak of the control plane (CPU-only, seconds of wall):
+# 300 ticks under the heavy fault profile + scripted 429s + one
+# mid-drain interrupt; fails unless the loop never crashes, no orphaned
+# ToBeDeleted taint survives, and drains resume once faults clear.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --chaos --chaos-ticks 300 --watchdog 300
 
 quality:
 	python bench.py --quality

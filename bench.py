@@ -785,9 +785,175 @@ def run_smoke(args, metric: str, unit: str) -> int:
     return 0 if ok else 1
 
 
+def run_chaos(args, metric: str, unit: str) -> int:
+    """Chaos soak (``make chaos-smoke``): N control-loop ticks over a
+    fixture-scale fake cluster behind the seeded fault-injection client
+    (io/chaos.py heavy profile + scripted 429s + one mid-drain
+    interrupt). CPU-only by construction (numpy planner — the soak
+    proves the CONTROL PLANE, which is solver-independent). Fails unless
+    every robustness invariant holds: the loop never crashes, no
+    orphaned ToBeDeleted taint survives at end-state, no node is drained
+    twice without re-observation, and at least one drain lands after the
+    faults clear."""
+    import dataclasses as _dc
+
+    from k8s_spot_rescheduler_tpu.io.chaos import (
+        ChaosClusterClient,
+        ChaosInterrupt,
+        FaultPlan,
+    )
+    from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+    from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.models.cluster import (
+        CPU,
+        MEMORY,
+        PODS,
+        NodeSpec,
+        OwnerRef,
+        PodSpec,
+        TO_BE_DELETED_TAINT,
+    )
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    od_labels = {"kubernetes.io/role": "worker"}
+    spot_labels = {"kubernetes.io/role": "spot-worker"}
+
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=True)
+
+    def add_node(name, labels, cpu=4000):
+        fc.add_node(NodeSpec(
+            name=name, labels=dict(labels),
+            allocatable={CPU: cpu, MEMORY: 8 * 1024**3, PODS: 110},
+        ))
+
+    def add_pod(name, node, cpu=100):
+        fc.add_pod(PodSpec(
+            name=name, namespace="default", node_name=node,
+            requests={CPU: cpu, MEMORY: 64 * 1024**2},
+            labels={"app": name},
+            owner_refs=[OwnerRef("ReplicaSet", f"{name}-rs")],
+        ))
+
+    for i in range(6):
+        add_node(f"od-{i}", od_labels)
+        add_node(f"spot-{i}", spot_labels)
+    for i in range(6):
+        for j in range(3):
+            add_pod(f"p{i}-{j}", f"od-{i}")
+
+    base = FaultPlan.profile("heavy", args.seed)
+    plan = _dc.replace(
+        base,
+        evict_429={"default/p0-0": 2, "default/churn-1": 1},
+        interrupt_on_taint=3,
+    )
+    chaos = ChaosClusterClient(fc, plan, clock=clock)
+    config = ReschedulerConfig(
+        solver="numpy",
+        housekeeping_interval=10.0,
+        node_drain_delay=30.0,
+        pod_eviction_timeout=60.0,
+        eviction_retry_time=5.0,
+    )
+    planner = SolverPlanner(config)
+
+    def make_controller():
+        return Rescheduler(chaos, planner, config, clock=clock, recorder=chaos)
+
+    n_ticks = int(args.chaos_ticks)
+    quiesce_at = (n_ticks * 7) // 8
+    r = make_controller()
+    t0 = time.perf_counter()
+    interrupts = completed = churn = 0
+    drains = []
+    fallbacks = 0
+    violations = []
+    for i in range(n_ticks):
+        clock.sleep(config.housekeeping_interval)
+        if i == quiesce_at:
+            # before the tick (NOT after): the tick may raise the
+            # scripted ChaosInterrupt, whose handler continues the loop
+            # and would skip a post-tick quiesce landing on this index
+            chaos.enabled = False
+        if i % 15 == 0:
+            add_pod(f"churn-{churn}", f"od-{churn % 6}")
+            churn += 1
+        occupied = {
+            name for name in fc.nodes
+            if name.startswith("od-") and fc.list_pods_on_node(name)
+        }
+        try:
+            result = r.tick()
+        except ChaosInterrupt:
+            interrupts += 1
+            r = make_controller()
+            continue
+        except BaseException as err:  # noqa: BLE001 — the invariant itself
+            violations.append(f"tick {i} crashed the loop: {err!r}")
+            break
+        completed += 1
+        # the no-double-drain-without-re-observation invariant: every
+        # drained node was observed WITH PODS at this tick's start (a
+        # node drained on a stale/duplicated view would be empty here)
+        if not set(result.drained) <= occupied:
+            violations.append(
+                f"tick {i} drained unobserved/empty node(s): "
+                f"{sorted(set(result.drained) - occupied)}"
+            )
+        if result.planner_fallback:
+            fallbacks += 1
+        drains.extend((i, n) for n in result.drained)
+    orphans = [
+        node.name
+        for node in fc.nodes.values()
+        if any(t.key == TO_BE_DELETED_TAINT for t in node.taints)
+    ]
+    if orphans:
+        violations.append(f"orphaned ToBeDeleted taints at end: {orphans}")
+    if interrupts != 1:
+        violations.append(f"expected 1 mid-drain interrupt, saw {interrupts}")
+    if not any(i >= quiesce_at for i, _ in drains):
+        violations.append("no drain landed after faults cleared")
+    wall = time.perf_counter() - t0
+    ok = not violations
+    print(
+        f"chaos-soak: {completed} ticks ({interrupts} restart) "
+        f"{len(drains)} drains ({sum(1 for i, _ in drains if i >= quiesce_at)} "
+        f"after quiesce)  faults={sum(chaos.stats.values())} "
+        f"wall={wall:.1f}s  -> {'OK' if ok else 'FAIL: ' + '; '.join(violations)}",
+        file=sys.stderr,
+    )
+    emit(
+        {
+            "metric": metric,
+            "value": int(completed),
+            "unit": unit,
+            "vs_baseline": None,
+            "ticks": int(n_ticks),
+            "drains": len(drains),
+            "drains_after_quiesce": sum(
+                1 for i, _ in drains if i >= quiesce_at
+            ),
+            "mid_drain_interrupts": int(interrupts),
+            "injected_faults": int(sum(chaos.stats.values())),
+            "planner_fallback_ticks": int(fallbacks),
+            "orphaned_taints_end": len(orphans),
+            "wall_s": round(wall, 2),
+            "ok": ok,
+            **({"violations": violations} if violations else {}),
+        }
+    )
+    return 0 if ok else 1
+
+
 def _metric_for(args) -> tuple:
     """(metric name, unit) this invocation will report — known up front so
     failure paths can emit a well-formed JSON line."""
+    if args.chaos:
+        return "chaos_soak_completed_ticks", "count"
     if args.smoke:
         return "bench_smoke_delta_upload_bytes", "bytes"
     if args.quality:
@@ -874,6 +1040,16 @@ def main() -> int:
                          "total probe spend is capped by both this x 4 "
                          "attempts and --backend-budget, and a failed "
                          "verdict is cached for the rest of the run")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos soak (make chaos-smoke): run the control "
+                         "loop under the seeded fault-injection client "
+                         "(io/chaos.py) and fail unless the robustness "
+                         "invariants hold — no loop crash, no orphaned "
+                         "ToBeDeleted taint at end-state, drains resume "
+                         "once faults clear")
+    ap.add_argument("--chaos-ticks", type=int, default=300,
+                    help="ticks of the --chaos soak (>=300 for the "
+                         "acceptance run)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke (make bench-smoke): tiny CPU-only "
                          "cluster, 5 ticks through the production "
@@ -898,6 +1074,8 @@ def main() -> int:
 
 
 def _dispatch(ap, args, metric: str, unit: str) -> int:
+    if args.chaos:
+        return run_chaos(args, metric, unit)
     if args.smoke:
         return run_smoke(args, metric, unit)
     if args.quality:

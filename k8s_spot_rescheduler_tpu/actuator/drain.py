@@ -142,25 +142,30 @@ def drain_node(
                 clock.sleep(eviction_retry_time)
 
         # Verification poll (scaler.go:119-144): all pods must be off the
-        # node before the deadline.
+        # node before the deadline. A pod confirmed gone stays gone (it
+        # was evicted), so each round re-checks only the rest — and a
+        # flaky GET marks only ITS pod as not-confirmed while the
+        # remaining pods are still checked this round, instead of one
+        # transient error burning the whole 5 s poll interval for all.
+        gone: set = set()
         while clock.now() < retry_until + VERIFY_POLL_INTERVAL:
-            all_gone = True
             for pod in pods:
+                if pod.uid in gone:
+                    continue
                 try:
                     returned = client.get_pod(pod.namespace, pod.name)
                 except Exception as err:  # noqa: BLE001 — scaler.go:129-133
                     log.error("Failed to check pod %s: %s", pod.uid, err)
-                    all_gone = False
-                    break
-                if returned is not None and returned.node_name == node.name:
+                    continue  # only this pod counts as not-yet-gone
+                if returned is None or returned.node_name != node.name:
+                    gone.add(pod.uid)
+                else:
                     # expected while evictions propagate — the reference
                     # logs it at plain glog info (scaler/scaler.go:131-135),
                     # not error; vlog-gated here so proof artifacts and
                     # quiet production logs don't carry per-poll noise
                     log.vlog(2, "Not deleted yet %s", pod.name)
-                    all_gone = False
-                    break
-            if all_gone:
+            if len(gone) == len(pods):
                 log.vlog(4, "All pods removed from %s", node.name)
                 drain_successful = True
                 recorder.event(

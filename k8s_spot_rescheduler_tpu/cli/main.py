@@ -91,6 +91,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop solving at the first chunk containing a "
                         "feasible lane (selection is identical; the "
                         "feasible count then covers the solved prefix)")
+    p.add_argument("--kube-retry-max", type=int, default=d.kube_retry_max,
+                   help="max transient-retry attempts per kube API read "
+                        "(429/5xx/connection errors, jittered exponential "
+                        "backoff honoring Retry-After; writes are "
+                        "single-attempt — the actuator owns their cadence)")
+    p.add_argument("--kube-retry-base", type=float, default=d.kube_retry_base,
+                   help="base seconds of the kube read retry backoff")
+    p.add_argument("--breaker-threshold", type=int, default=d.breaker_threshold,
+                   help="consecutive error-skipped ticks before the "
+                        "circuit breaker widens the housekeeping interval "
+                        "(0 = off)")
+    p.add_argument("--breaker-max-interval",
+                   default=f"{d.breaker_max_interval:g}s",
+                   help="cap of the breaker-widened interval (Go duration)")
+    p.add_argument("--reconcile-orphaned-taints", type=_bool,
+                   default=d.reconcile_orphaned_taints,
+                   help="on startup and each tick, remove ToBeDeleted "
+                        "taints no active drain owns (crash-safe drain "
+                        "recovery; the reference leaves them for CA)")
+    p.add_argument("--chaos-profile", default=d.chaos_profile,
+                   choices=["", "light", "heavy"],
+                   help="wrap the cluster client in the seeded "
+                        "fault-injection layer (io/chaos.py) — "
+                        "testing/demo only, never production")
+    p.add_argument("--chaos-seed", type=int, default=d.chaos_seed,
+                   help="seed of the chaos fault stream (deterministic)")
     p.add_argument("--jax-cache-dir", default=d.jax_cache_dir,
                    help="persistent XLA compilation cache directory; the "
                         "~seconds cold compile of the solver programs is "
@@ -147,6 +173,13 @@ def config_from_args(args) -> ReschedulerConfig:
         staged_chunk_lanes=args.staged_chunk_lanes,
         staged_early_exit=args.staged_early_exit,
         jax_cache_dir=args.jax_cache_dir,
+        kube_retry_max=args.kube_retry_max,
+        kube_retry_base=args.kube_retry_base,
+        breaker_threshold=args.breaker_threshold,
+        breaker_max_interval=parse_duration(args.breaker_max_interval),
+        reconcile_orphaned_taints=args.reconcile_orphaned_taints,
+        chaos_profile=args.chaos_profile,
+        chaos_seed=args.chaos_seed,
         resources=tuple(r for r in args.resources.split(",") if r),
         mesh_shape=(
             tuple(int(x) for x in args.mesh_shape.lower().split("x"))
@@ -183,6 +216,23 @@ def main(argv=None) -> int:
     from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
     from k8s_spot_rescheduler_tpu.utils.clock import RealClock
 
+    def chaos_wrap(c, clk):
+        from k8s_spot_rescheduler_tpu.io.chaos import (
+            ChaosClusterClient,
+            FaultPlan,
+        )
+
+        log.info(
+            "CHAOS: fault injection enabled (profile=%s seed=%d) — "
+            "testing mode, not production",
+            config.chaos_profile, config.chaos_seed,
+        )
+        return ChaosClusterClient(
+            c,
+            FaultPlan.profile(config.chaos_profile, config.chaos_seed),
+            clock=clk,
+        )
+
     elector = None
     if args.cluster.startswith("synthetic:"):
         from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
@@ -203,6 +253,8 @@ def main(argv=None) -> int:
         # the demo always runs on the fake cluster's virtual clock — pod
         # termination timers live on it
         clock = client.clock
+        if config.chaos_profile:
+            client = chaos_wrap(client, clock)
         recorder = client
     elif args.cluster == "kube" or args.cluster.startswith("kube:"):
         from k8s_spot_rescheduler_tpu.io.kube import (
@@ -221,11 +273,21 @@ def main(argv=None) -> int:
         except Exception as err:  # noqa: BLE001
             print(f"Error: failed to create kube client: {err}", file=sys.stderr)
             return 1
+        # transient-read retry policy (io/kube.py backoff loop)
+        client.retry_max = config.kube_retry_max
+        client.retry_base = config.kube_retry_base
         from k8s_spot_rescheduler_tpu.io import native_ingest
 
         # the native LIST decoder only carries the standard resources;
         # exotic --resources must flow through the Python decoders
         client.use_native_ingest = native_ingest.supports(config.resources)
+        clock = RealClock()
+        if config.chaos_profile:
+            # wrapped UNDER the watch cache (below), so the watch
+            # threads' streams traverse the chaos _stream hook (drop
+            # injection) and writes/get_pod are faulted; the lease
+            # elector's _request plumbing passes through untouched
+            client = chaos_wrap(client, clock)
         if args.leader_elect:
             from k8s_spot_rescheduler_tpu.io.lease import LeaseElector
 
@@ -251,7 +313,6 @@ def main(argv=None) -> int:
                 print(f"Error: watch caches failed to sync: {err}",
                       file=sys.stderr)
                 return 1
-        clock = RealClock()
         recorder = client
     else:
         print(f"Error: unknown --cluster {args.cluster!r}", file=sys.stderr)
@@ -262,10 +323,17 @@ def main(argv=None) -> int:
     except ValueError as err:
         print(f"Error: {err}", file=sys.stderr)
         return 1
-    r = Rescheduler(client, planner, config, clock=clock, recorder=recorder)
+    r = Rescheduler(
+        client, planner, config, clock=clock, recorder=recorder,
+        # HA: a follower must not perform the startup taint sweep — it
+        # could untaint the LEADER's in-flight drain; the per-tick sweep
+        # runs once this replica is leader-gated into ticking
+        startup_sweep=(elector is None or elector.is_leader),
+    )
     ticks = 0
     while args.ticks == 0 or ticks < args.ticks:
-        clock.sleep(config.housekeeping_interval)
+        # breaker-widened while consecutive observe errors persist
+        clock.sleep(r.effective_interval())
         # a follower's skipped interval still counts toward --ticks so
         # bounded runs terminate whoever holds the lease
         ticks += 1

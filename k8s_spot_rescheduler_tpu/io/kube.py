@@ -23,8 +23,10 @@ from __future__ import annotations
 import base64
 import json
 import os
+import random
 import ssl
 import tempfile
+import time as _time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
@@ -42,6 +44,32 @@ from k8s_spot_rescheduler_tpu.utils.quantity import parse_cpu_millis, parse_quan
 from k8s_spot_rescheduler_tpu.utils import logging as log
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def transient_http_error(err: Exception):
+    """(retryable, retry_after_s) classification of a request failure.
+
+    Transient — worth a backed-off retry: HTTP 429 (apiserver flow
+    control; carries Retry-After) and any 5xx, plus every
+    connection-level failure (reset, refused, timeout, TLS hiccup —
+    ``URLError`` and the rest of the ``OSError`` family). Everything
+    else (401/403/404/409, malformed JSON, ...) is a real answer, not a
+    flake, and surfaces immediately — retrying a 404 would only delay
+    the caller's own handling of it."""
+    if isinstance(err, urllib.error.HTTPError):
+        if err.code == 429 or 500 <= err.code < 600:
+            retry_after = None
+            try:
+                value = err.headers.get("Retry-After") if err.headers else None
+                if value is not None:
+                    retry_after = float(value)
+            except (TypeError, ValueError):
+                retry_after = None
+            return True, retry_after
+        return False, None
+    if isinstance(err, (urllib.error.URLError, OSError)):
+        return True, None
+    return False, None
 
 
 def _decode_quantity(name: str, value) -> int:
@@ -655,9 +683,25 @@ class KubeClusterClient:
         client_cert: str = "",
         client_key: str = "",
         insecure: bool = False,
+        retry_max: int = 4,
+        retry_base: float = 0.25,
+        retry_sleep=None,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
+        # Transient-failure retry policy for READ verbs (GET): up to
+        # retry_max additional attempts with jittered exponential backoff
+        # from retry_base seconds, honoring Retry-After. Writes (evict /
+        # taint / events) stay single-attempt: the actuator owns their
+        # retry cadence (scaler.go:47-62), and a blind HTTP-level re-send
+        # could double-apply a non-idempotent mutation.
+        self.retry_max = int(retry_max)
+        self.retry_base = float(retry_base)
+        self._retry_sleep = retry_sleep or _time.sleep
+        # private urandom-seeded instance: jitter must decorrelate
+        # replicas/restarts (a fixed seed would synchronize the herd it
+        # exists to spread) without perturbing global random state
+        self._retry_rng = random.Random()
         # projected SA tokens rotate on disk (~1h TTL); when reading from a
         # file, re-read per request like client-go does
         self.token_file = token_file
@@ -709,16 +753,67 @@ class KubeClusterClient:
         ctx = self._ctx if url.startswith("https") else None
         return urllib.request.urlopen(req, context=ctx, timeout=timeout)
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None):
-        with self._open(method, path, body, timeout=30) as resp:
-            payload = resp.read()
+    def _read_retrying(self, method: str, path: str, timeout: float) -> bytes:
+        """One read request (open + body), retried with jittered
+        exponential backoff on transient failures (429/5xx/connection —
+        ``transient_http_error``). Honors Retry-After when the server
+        sends one (the backoff never undercuts it). Each retry bumps
+        ``kube_request_retries_total``; exhausting the budget bumps
+        ``kube_request_failures_total`` and re-raises, at which point the
+        control loop's observe-error policy skips the tick."""
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+        attempt = 0
+        while True:
+            try:
+                with self._open(method, path, None, timeout=timeout) as resp:
+                    return resp.read()
+            except Exception as err:  # noqa: BLE001 — classified below
+                retryable, retry_after = transient_http_error(err)
+                if not retryable:
+                    raise
+                if attempt >= self.retry_max:
+                    metrics.update_kube_request_failure()
+                    raise
+                # full jitter around the exponential midpoint: delay in
+                # [0.5, 1.5) x base x 2^attempt, floored by Retry-After
+                delay = self.retry_base * (2.0 ** attempt)
+                delay *= 0.5 + self._retry_rng.random()
+                if retry_after is not None and retry_after > delay:
+                    delay = retry_after
+                metrics.update_kube_request_retry()
+                log.vlog(
+                    2,
+                    "kube %s %s failed transiently (%s); retry %d/%d in %.2fs",
+                    method, path, err, attempt + 1, self.retry_max, delay,
+                )
+                self._retry_sleep(delay)
+                attempt += 1
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        *,
+        retries: bool = True,
+    ):
+        """``retries=False`` opts a READ out of the backoff loop —
+        deadline-bound callers (the lease elector, whose renew cadence
+        IS its retry policy and whose lease must not absorb backoff
+        sleeps) handle transient failures themselves."""
+        if retries and method == "GET" and body is None:
+            payload = self._read_retrying("GET", path, timeout=30)
+        else:
+            # write verbs: single attempt (see __init__ on retry policy)
+            with self._open(method, path, body, timeout=30) as resp:
+                payload = resp.read()
         return json.loads(payload) if payload else {}
 
     def _request_raw(self, method: str, path: str) -> bytes:
         """Raw response bytes — the native ingest engine parses LIST
         bodies itself (io/native_ingest.py)."""
-        with self._open(method, path, None, timeout=60) as resp:
-            return resp.read()
+        return self._read_retrying(method, path, timeout=60)
 
     def _stream(self, path: str, read_timeout: float = 330.0):
         """Yield newline-delimited JSON objects from a watch endpoint.
@@ -876,9 +971,15 @@ class KubeClusterClient:
         return [decode_pdb(o) for o in items]
 
     def get_pod(self, namespace: str, name: str) -> Optional[PodSpec]:
+        # single-attempt: the only production caller is the drain verify
+        # poll (actuator/drain.py), which already re-polls every 5 s per
+        # pod until its own deadline — stacking the transport retry
+        # budget under it would let one poll round overshoot
+        # pod_eviction_timeout by pods x backoff
         try:
             obj = self._request(
-                "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"
+                "GET", f"/api/v1/namespaces/{namespace}/pods/{name}",
+                retries=False,
             )
         except urllib.error.HTTPError as err:
             if err.code == 404:

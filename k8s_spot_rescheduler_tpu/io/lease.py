@@ -107,7 +107,11 @@ class LeaseElector:
 
     def _get(self) -> Optional[dict]:
         try:
-            return self.client._request("GET", self._path)
+            # no transport-level retries: the elector's own renew cadence
+            # IS its retry policy (ensure() demotes on error and recovers
+            # next tick, like client-go leaderelection), and backoff
+            # sleeps inside a renew would eat into the lease deadline
+            return self.client._request("GET", self._path, retries=False)
         except urllib.error.HTTPError as err:
             if err.code == 404:
                 return None

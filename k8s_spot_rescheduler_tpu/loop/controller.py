@@ -18,6 +18,21 @@ per tick:
 6. any observation error skips the tick (`continue`), never crashes the
    loop — the recovery story is "recompute everything next tick"
    (SURVEY.md §5.3).
+
+Chaos hardening beyond the reference (docs/ROBUSTNESS.md):
+
+- a planner exception degrades the tick to the CPU numpy-oracle fallback
+  planner instead of killing ``run_forever`` (``planner_fallback_total``;
+  /healthz reports ``degraded: true`` until a clean primary tick);
+- consecutive error-skipped ticks past ``breaker_threshold`` engage a
+  circuit breaker that doubles the effective housekeeping interval per
+  further failure, capped at ``breaker_max_interval``, resetting on the
+  next completed tick;
+- on startup and once per tick, orphaned ``ToBeDeleted`` taints that no
+  active drain owns are removed (``ReschedulerRecovered`` event) — a
+  drain interrupted between taint and cleanup must not permanently
+  unschedule an on-demand node (the reference leaves that residue for
+  the cluster autoscaler to collect).
 """
 
 from __future__ import annotations
@@ -28,8 +43,13 @@ from typing import List, Optional
 
 from k8s_spot_rescheduler_tpu.actuator.drain import DrainError, drain_node
 from k8s_spot_rescheduler_tpu.io.cluster import ClusterClient, EventSink
+from k8s_spot_rescheduler_tpu.loop import health
 from k8s_spot_rescheduler_tpu.metrics import registry as metrics
-from k8s_spot_rescheduler_tpu.models.cluster import NodeMap, build_node_map
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    NodeMap,
+    TO_BE_DELETED_TAINT,
+    build_node_map,
+)
 from k8s_spot_rescheduler_tpu.models.evictability import get_pods_for_deletion
 from k8s_spot_rescheduler_tpu.planner.base import Planner, PlanReport
 from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
@@ -46,6 +66,11 @@ class TickResult:
     drained: List[str] = dataclasses.field(default_factory=list)
     drain_failed: List[str] = dataclasses.field(default_factory=list)
     report: Optional[PlanReport] = None
+    # this tick's plan came from the CPU fallback planner (the configured
+    # planner raised and was contained)
+    planner_fallback: bool = False
+    # orphaned ToBeDeleted taints the pre-tick sweep removed
+    recovered_taints: List[str] = dataclasses.field(default_factory=list)
 
 
 class _NullRecorder:
@@ -62,6 +87,7 @@ class Rescheduler:
         *,
         clock: Optional[Clock] = None,
         recorder: Optional[EventSink] = None,
+        startup_sweep: bool = True,
     ):
         self.client = client
         self.planner = planner
@@ -70,6 +96,24 @@ class Rescheduler:
         self.recorder = recorder or _NullRecorder()
         # start processing straight away (rescheduler.go:158-159)
         self.next_drain_time = self.clock.now()
+        # --- chaos hardening state ---
+        # error-skipped ticks in a row (feeds the circuit breaker)
+        self._consecutive_errors = 0
+        # lazily-built CPU fallback planner (planner crash containment)
+        self._fallback_planner = None
+        # nodes a drain is actively running on: the orphaned-taint sweep
+        # must never untaint a drain in progress (single-threaded today,
+        # so empty at every sweep — load-bearing if actuation ever forks)
+        self._active_drains: set = set()
+        health.STATE.set_clock(self.clock.now)
+        if config.reconcile_orphaned_taints and startup_sweep:
+            # startup sweep: a previous process may have died mid-drain,
+            # leaving a ToBeDeleted taint nobody owns. ``startup_sweep``
+            # is passed False by HA deployments for non-leader replicas
+            # (a follower must not write — the per-tick sweep runs once
+            # it is leader-gated into ticking); single-replica callers
+            # keep the default and heal immediately on restart.
+            self.reconcile_orphaned_taints()
 
     # --- observation ---
 
@@ -188,9 +232,207 @@ class Rescheduler:
         for name, count in spot:
             metrics.update_node_pods_count(cfg.spot_node_label, name, count)
 
+    # --- planner crash containment ---
+
+    def _dispatch_plan(self, observation, pdbs, run_metrics: bool):
+        """Run the (possibly pipelined) plan on the configured planner;
+        raises whatever the planner raises — ``_plan_guarded`` owns the
+        degradation policy."""
+        plan_async = getattr(self.planner, "plan_async", None)
+        if plan_async is not None:
+            # Pipelined tick: pack + delta-upload + async solve dispatch
+            # first, then the host-side metrics pass runs while the
+            # device solve is in flight (JAX async dispatch); only the
+            # tiny selection fetch blocks. The phase split makes the
+            # overlap measurable: observe-metrics wall time is hidden
+            # behind the solve, so plan-dispatch + plan-fetch < the old
+            # monolithic plan phase whenever the solve outlasts it.
+            t0 = time.perf_counter()
+            with tracing.phase("plan-dispatch"):
+                finish = plan_async(observation, pdbs)
+            t1 = time.perf_counter()
+            if run_metrics:
+                with tracing.phase("observe-metrics"):
+                    self._tick_metrics(observation, pdbs)
+            t2 = time.perf_counter()
+            with tracing.phase("plan-fetch"):
+                report = finish()
+            # aggregate plan phase (dashboard continuity): the host time
+            # actually spent planning, excluding the overlapped window
+            metrics.observe_tick_phase(
+                "plan", (t1 - t0) + (time.perf_counter() - t2)
+            )
+        else:
+            if run_metrics:
+                with tracing.phase("observe-metrics"):
+                    self._tick_metrics(observation, pdbs)
+            with tracing.phase("plan"):
+                report = self.planner.plan(observation, pdbs)
+        return report
+
+    def _fallback(self):
+        """The CPU numpy-oracle planner a crashing configured planner
+        degrades to — same Planner surface, no device dependency, built
+        once on first use."""
+        if self._fallback_planner is None:
+            from k8s_spot_rescheduler_tpu.planner.solver_planner import (
+                SolverPlanner,
+            )
+
+            self._fallback_planner = SolverPlanner(
+                dataclasses.replace(self.config, solver="numpy")
+            )
+        return self._fallback_planner
+
+    def _plan_guarded(self, observation, pdbs, *, run_metrics: bool = True):
+        """(report | None, used_fallback): any planner exception degrades
+        the tick to the CPU fallback planner instead of crashing the
+        loop. None only when the fallback failed too (the tick then
+        skips under the observe-error policy)."""
+        try:
+            return self._dispatch_plan(observation, pdbs, run_metrics), False
+        except Exception as err:  # noqa: BLE001 — contain ANY solver crash
+            log.error(
+                "Planner %r failed: %s; degrading tick to the numpy-oracle "
+                "fallback", self.config.solver, err,
+            )
+            metrics.update_planner_fallback()
+        try:
+            if run_metrics:
+                # the primary may have died before its metrics pass ran;
+                # gauge updates are idempotent, so re-running is safe
+                with tracing.phase("observe-metrics"):
+                    self._tick_metrics(observation, pdbs)
+            with tracing.phase("plan"):
+                return self._fallback().plan(observation, pdbs), True
+        except Exception as err:  # noqa: BLE001
+            log.error("Fallback planner failed too: %s", err)
+            return None, True
+
+    # --- crash-safe drain recovery ---
+
+    def reconcile_orphaned_taints(self) -> List[str]:
+        """Remove ``ToBeDeleted`` taints no active drain owns.
+
+        A drain interrupted between ``add_taint`` and its deferred
+        cleanup (process crash, failed un-taint) leaves the node
+        permanently unschedulable; the reference relies on the cluster
+        autoscaler to collect such nodes, but a spot RESCHEDULER's
+        on-demand nodes are exactly the ones CA should keep. Runs on
+        startup and once per tick; list/un-taint failures are logged and
+        retried next tick (the sweep is idempotent). Returns the
+        recovered node names.
+
+        Scope: ON-DEMAND nodes only — the drain path only ever taints
+        drain candidates, which are on-demand by construction, so a
+        ``ToBeDeleted`` taint on any other node (e.g. a spot node CA is
+        scaling down) belongs to the autoscaler and is left alone.
+
+        Cost: the in-tree clients serve these listers from their
+        per-tick cache (polling) or watch cache, so the pre-gate sweep
+        reads the PREVIOUS tick's node view and issues no extra LIST —
+        one tick of staleness just means an orphan heals a tick later."""
+        try:
+            nodes = list(self.client.list_ready_nodes())
+            lister = getattr(self.client, "list_unready_nodes", None)
+            if lister is not None:
+                nodes += list(lister())
+        except Exception as err:  # noqa: BLE001 — sweep retries next tick
+            log.error("Orphaned-taint sweep skipped (list failed): %s", err)
+            return []
+        from k8s_spot_rescheduler_tpu.utils.labels import matches_label
+
+        recovered: List[str] = []
+        for node in nodes:
+            if not matches_label(node.labels, self.config.on_demand_node_label):
+                continue  # not ours: only on-demand nodes are ever drained
+            if node.name in self._active_drains:
+                continue
+            if not any(t.key == TO_BE_DELETED_TAINT for t in node.taints):
+                continue
+            try:
+                self.client.remove_taint(node.name, TO_BE_DELETED_TAINT)
+            except Exception as err:  # noqa: BLE001
+                log.error(
+                    "Failed to remove orphaned taint on %s: %s "
+                    "(will retry next tick)", node.name, err,
+                )
+                continue
+            recovered.append(node.name)
+            metrics.update_taint_recovered()
+            health.STATE.note_taint_recovered()
+            log.info("Recovered orphaned %s taint on %s",
+                     TO_BE_DELETED_TAINT, node.name)
+            self.recorder.event(
+                "Node", node.name, "Normal", "ReschedulerRecovered",
+                "removed orphaned ToBeDeleted taint left by an "
+                "interrupted drain",
+            )
+        return recovered
+
+    # --- circuit breaker ---
+
+    @property
+    def breaker_engaged(self) -> bool:
+        threshold = self.config.breaker_threshold
+        return threshold > 0 and self._consecutive_errors >= threshold
+
+    def effective_interval(self) -> float:
+        """The housekeeping interval ``run_forever`` actually sleeps:
+        the configured one, doubled per consecutive error-skipped tick
+        past ``breaker_threshold`` and capped at ``breaker_max_interval``
+        — persistent observe errors must not hammer a struggling
+        apiserver at full cadence. Resets with the error count on the
+        next completed tick."""
+        base = self.config.housekeeping_interval
+        if not self.breaker_engaged:
+            return base
+        doublings = min(
+            self._consecutive_errors - self.config.breaker_threshold + 1, 16
+        )
+        cap = max(self.config.breaker_max_interval, base)
+        return min(base * (2.0 ** doublings), cap)
+
     # --- the tick ---
 
     def tick(self) -> TickResult:
+        recovered: List[str] = []
+        if self.config.reconcile_orphaned_taints:
+            # before the gates: an orphaned taint must not wait out a
+            # 10-minute drain cooldown to be healed. Guarded — a
+            # recorder/sink that raises must not escape tick()
+            try:
+                recovered = self.reconcile_orphaned_taints()
+            except Exception as err:  # noqa: BLE001
+                log.error("Orphaned-taint sweep failed: %s", err)
+        try:
+            result = self._tick_inner()
+        except Exception as err:  # noqa: BLE001 — the loop must not die
+            log.error("Tick aborted by unexpected error: %s", err)
+            result = TickResult(skipped="error")
+        result.recovered_taints = recovered
+        if result.skipped == "error":
+            self._consecutive_errors += 1
+            health.STATE.note_error(
+                self._consecutive_errors,
+                self.effective_interval() if self.breaker_engaged else None,
+            )
+        elif result.skipped == "":
+            self._consecutive_errors = 0
+            health.STATE.note_success(fallback=result.planner_fallback)
+        elif result.skipped == "unschedulable":
+            # the observation behind this verdict SUCCEEDED — the
+            # apiserver is provably healthy, so the observe-error
+            # breaker resets even though the gate (correctly) held the
+            # tick; fallback-planner degradation stands until a tick
+            # completes
+            self._consecutive_errors = 0
+            health.STATE.note_observe_ok()
+        # cooldown skips observe nothing: they neither trip nor reset
+        # the breaker
+        return result
+
+    def _tick_inner(self) -> TickResult:
         now = self.clock.now()
         if now < self.next_drain_time:
             log.vlog(2, "Waiting %.0fs for drain delay timer.",
@@ -200,8 +442,12 @@ class Rescheduler:
         try:
             unschedulable = self.client.list_unschedulable_pods()
         except Exception as err:  # noqa: BLE001
+            # skip the tick, matching the observe-error policy: treating
+            # an unknown state as "zero unschedulable pods" would defeat
+            # the don't-make-things-worse gate exactly when the
+            # apiserver is flaky
             log.error("Failed to get unschedulable pods: %s", err)
-            unschedulable = []
+            return TickResult(skipped="error")
         if unschedulable:
             log.vlog(2, "Waiting for unschedulable pods to be scheduled.")
             return TickResult(skipped="unschedulable")
@@ -225,40 +471,15 @@ class Rescheduler:
                 # metrics update and the planner's pack
                 observation = self._wrap_columnar(observation, pdbs)
 
-        plan_async = getattr(self.planner, "plan_async", None)
-        if plan_async is not None:
-            # Pipelined tick: pack + delta-upload + async solve dispatch
-            # first, then the host-side metrics pass runs while the
-            # device solve is in flight (JAX async dispatch); only the
-            # tiny selection fetch blocks. The phase split makes the
-            # overlap measurable: observe-metrics wall time is hidden
-            # behind the solve, so plan-dispatch + plan-fetch < the old
-            # monolithic plan phase whenever the solve outlasts it.
-            t0 = time.perf_counter()
-            with tracing.phase("plan-dispatch"):
-                finish = plan_async(observation, pdbs)
-            t1 = time.perf_counter()
-            with tracing.phase("observe-metrics"):
-                self._tick_metrics(observation, pdbs)
-            t2 = time.perf_counter()
-            with tracing.phase("plan-fetch"):
-                report = finish()
-            # aggregate plan phase (dashboard continuity): the host time
-            # actually spent planning, excluding the overlapped window
-            metrics.observe_tick_phase(
-                "plan", (t1 - t0) + (time.perf_counter() - t2)
-            )
-        else:
-            with tracing.phase("observe-metrics"):
-                self._tick_metrics(observation, pdbs)
-            with tracing.phase("plan"):
-                report = self.planner.plan(observation, pdbs)
+        report, used_fallback = self._plan_guarded(observation, pdbs)
+        if report is None:
+            return TickResult(skipped="error", planner_fallback=True)
         metrics.observe_plan_duration(
             report.solver, report.solve_seconds, report.n_candidates
         )
         metrics.update_incremental_tick(report)
 
-        result = TickResult(report=report)
+        result = TickResult(report=report, planner_fallback=used_fallback)
         with tracing.phase("actuate"):
             self._actuate(result, report)
         log.vlog(3, "Finished processing nodes.")
@@ -289,12 +510,19 @@ class Rescheduler:
                 except Exception as err:  # noqa: BLE001
                     log.error("Failed to list PDBs: %s", err)
                     break
-                report = self.planner.plan(observation, pdbs)
+                report, used_fallback = self._plan_guarded(
+                    observation, pdbs, run_metrics=False
+                )
+                if report is None:
+                    break
+                if used_fallback:
+                    result.planner_fallback = True
             plan = report.plan
             if plan is None:
                 break
             log.vlog(2, "All pods on %s can be moved. Will drain node.",
                      plan.node.node.name)
+            self._active_drains.add(plan.node.node.name)
             try:
                 drain_node(
                     self.client,
@@ -314,13 +542,28 @@ class Rescheduler:
                 log.error("Failed to drain node: %s", err)
                 metrics.update_node_drain_count("Failure", plan.node.node.name)
                 result.drain_failed.append(plan.node.node.name)
+            finally:
+                self._active_drains.discard(plan.node.node.name)
             # cooldown arms after a drain attempt, success or not
             # (rescheduler.go:280-286)
             self.next_drain_time = self.clock.now() + self.config.node_drain_delay
             drains += 1
 
     def run_forever(self) -> None:
-        """reference rescheduler.go:161-164: act every housekeeping_interval."""
+        """reference rescheduler.go:161-164: act every housekeeping_interval
+        (widened by the circuit breaker while observe errors persist)."""
         while True:
-            self.clock.sleep(self.config.housekeeping_interval)
-            self.tick()
+            self.clock.sleep(self.effective_interval())
+            try:
+                self.tick()
+            except Exception as err:  # noqa: BLE001 — belt over tick's guard
+                self._consecutive_errors += 1
+                log.error("Tick crashed: %s", err)
+                # keep /healthz and the breaker state coherent even on
+                # this escape path — an operator must see the throttling
+                health.STATE.note_error(
+                    self._consecutive_errors,
+                    self.effective_interval()
+                    if self.breaker_engaged
+                    else None,
+                )

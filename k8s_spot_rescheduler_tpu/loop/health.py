@@ -1,0 +1,137 @@
+"""Controller health state, shared process-wide.
+
+The control loop's degradation machinery (planner fallback, observe-error
+circuit breaker, taint recovery — loop/controller.py) needs a surface an
+operator's probe can read without scraping Prometheus: the sidecar's
+``GET /healthz`` (sidecar/server.py) merges ``snapshot()`` into its
+response, so a kubelet liveness/readiness probe sees ``degraded`` and
+the last-successful-tick age directly.
+
+One module-level ``STATE`` because one controller runs per process
+(leader election guarantees one actor per cluster); tests reset it via
+``STATE.reset()``. Timestamps come from the controller's injected clock
+(``set_clock``) so virtual-clock tests read coherent ages.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class HealthState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._now: Optional[Callable[[], float]] = None
+        # degraded = _fallback_degraded OR _breaker_degraded — tracked by
+        # cause, so a recovering breaker clears its half without masking
+        # a still-fallback planner (and vice versa)
+        self._fallback_degraded = False
+        self._breaker_degraded = False
+        self.degraded = False
+        self.last_success: Optional[float] = None
+        self.planner_fallback_total = 0
+        self.consecutive_errors = 0
+        self.breaker_interval: Optional[float] = None
+        self.taints_recovered_total = 0
+
+    def reset(self) -> None:
+        """Back to process-start state (test isolation)."""
+        with self._lock:
+            self._now = None
+            self._fallback_degraded = False
+            self._breaker_degraded = False
+            self.degraded = False
+            self.last_success = None
+            self.planner_fallback_total = 0
+            self.consecutive_errors = 0
+            self.breaker_interval = None
+            self.taints_recovered_total = 0
+        self._mirror_gauge(False)
+
+    def set_clock(self, now_fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._now = now_fn
+
+    def _clock(self) -> float:
+        return (self._now or time.monotonic)()
+
+    @staticmethod
+    def _mirror_gauge(degraded: bool) -> None:
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+        metrics.update_degraded(degraded)
+
+    def note_success(self, *, fallback: bool = False) -> None:
+        """A tick completed (observe + plan + actuate all ran).
+        ``fallback``: the plan came from the CPU fallback planner — the
+        tick counts as degraded until a clean primary tick follows."""
+        with self._lock:
+            self.last_success = self._clock()
+            self.consecutive_errors = 0
+            self.breaker_interval = None
+            self._breaker_degraded = False
+            self._fallback_degraded = bool(fallback)
+            if fallback:
+                self.planner_fallback_total += 1
+            self.degraded = self._fallback_degraded
+            degraded = self.degraded
+        self._mirror_gauge(degraded)
+
+    def note_observe_ok(self) -> None:
+        """Observation succeeded but a healthy gate skipped the tick
+        (unschedulable pods pending): the apiserver is provably fine, so
+        the observe-error breaker resets — while any fallback-planner
+        degradation stands until a tick actually completes."""
+        with self._lock:
+            self.consecutive_errors = 0
+            self.breaker_interval = None
+            self._breaker_degraded = False
+            self.degraded = self._fallback_degraded
+            degraded = self.degraded
+        self._mirror_gauge(degraded)
+
+    def note_error(
+        self, consecutive: int, breaker_interval: Optional[float] = None
+    ) -> None:
+        """A tick was skipped on an observe/plan error. ``breaker_interval``
+        is the widened housekeeping interval when the circuit breaker is
+        engaged (None below threshold)."""
+        with self._lock:
+            self.consecutive_errors = int(consecutive)
+            self.breaker_interval = breaker_interval
+            self._breaker_degraded = breaker_interval is not None
+            self.degraded = self._fallback_degraded or self._breaker_degraded
+            degraded = self.degraded
+        self._mirror_gauge(degraded)
+
+    def note_taint_recovered(self) -> None:
+        with self._lock:
+            self.taints_recovered_total += 1
+
+    def snapshot(self) -> dict:
+        """JSON-ready view for /healthz."""
+        with self._lock:
+            age = (
+                None
+                if self.last_success is None
+                else max(0.0, self._clock() - self.last_success)
+            )
+            return {
+                "degraded": self.degraded,
+                "last_successful_tick_age_s": (
+                    None if age is None else round(age, 3)
+                ),
+                "planner_fallback_total": self.planner_fallback_total,
+                "consecutive_tick_errors": self.consecutive_errors,
+                "breaker_interval_s": self.breaker_interval,
+                "taints_recovered_total": self.taints_recovered_total,
+            }
+
+
+STATE = HealthState()
+
+
+def snapshot() -> dict:
+    return STATE.snapshot()

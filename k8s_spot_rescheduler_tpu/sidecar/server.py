@@ -95,7 +95,16 @@ class PlannerSidecar:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    return self._send({"ok": True, "solver": sidecar.config.solver})
+                    # merge the control loop's degradation state
+                    # (loop/health.py): when a controller shares this
+                    # process, a liveness probe here sees planner
+                    # fallback / breaker status and the age of the last
+                    # completed tick without scraping Prometheus
+                    from k8s_spot_rescheduler_tpu.loop import health
+
+                    out = {"ok": True, "solver": sidecar.config.solver}
+                    out.update(health.snapshot())
+                    return self._send(out)
                 return self._send({"error": "not found"}, 404)
 
             def _reject_unread(self, obj, code, headers=()):

@@ -133,6 +133,27 @@ class ReschedulerConfig:
     # jax.config "jax_compilation_cache_dir", wired by SolverPlanner
     # before any program is built. Empty = no persistent cache.
     jax_cache_dir: str = ""
+    # --- chaos hardening (docs/ROBUSTNESS.md) ---
+    # Transient-failure retry policy for kube API READS (io/kube.py):
+    # up to kube_retry_max additional attempts with jittered exponential
+    # backoff from kube_retry_base seconds (Retry-After honored). Writes
+    # stay single-attempt — the actuator owns eviction/taint cadence.
+    kube_retry_max: int = 4
+    kube_retry_base: float = 0.25
+    # Observe-error circuit breaker (loop/controller.py): after this many
+    # consecutive error-skipped ticks the effective housekeeping interval
+    # doubles per further failure, capped at breaker_max_interval;
+    # 0 disables the breaker.
+    breaker_threshold: int = 3
+    breaker_max_interval: float = 300.0
+    # Crash-safe drain recovery: on startup and once per tick, remove
+    # ToBeDeleted taints no active drain owns (an interrupted drain's
+    # residue would otherwise permanently unschedule an on-demand node).
+    reconcile_orphaned_taints: bool = True
+    # Fault injection (io/chaos.py): wrap the cluster client in the
+    # seeded chaos layer. Empty profile = off (production default).
+    chaos_profile: str = ""
+    chaos_seed: int = 0
 
     def __post_init__(self):
         from k8s_spot_rescheduler_tpu.utils.labels import validate_label
@@ -145,3 +166,9 @@ class ReschedulerConfig:
             raise ValueError("staged_chunk_lanes must be >= 0 (0 = unstaged)")
         if not self.resources:
             raise ValueError("resources must be non-empty")
+        if self.kube_retry_max < 0:
+            raise ValueError("kube_retry_max must be >= 0 (0 = no retries)")
+        if self.kube_retry_base <= 0:
+            raise ValueError("kube_retry_base must be > 0")
+        if self.breaker_threshold < 0:
+            raise ValueError("breaker_threshold must be >= 0 (0 = off)")

@@ -1,0 +1,724 @@
+"""Chaos-hardening tests (docs/ROBUSTNESS.md): the seeded fault-injection
+client, the retrying kube read path, planner crash containment, crash-safe
+drain recovery, the observe-error circuit breaker — and the headline
+seeded soak: hundreds of ticks under a FaultPlan with zero loop crashes,
+zero orphaned ToBeDeleted taints at end-state, and drains resuming once
+faults clear."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.io.chaos import (
+    ChaosClusterClient,
+    ChaosError,
+    ChaosInterrupt,
+    FaultPlan,
+)
+from k8s_spot_rescheduler_tpu.io.cluster import EvictionError
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.io.kube import (
+    KubeClusterClient,
+    transient_http_error,
+)
+from k8s_spot_rescheduler_tpu.loop import health
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.metrics.registry import robustness_snapshot
+from k8s_spot_rescheduler_tpu.models.cluster import TO_BE_DELETED_TAINT, Taint
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import ON_DEMAND_LABELS, SPOT_LABELS, make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _reset_health():
+    health.STATE.reset()
+    yield
+    health.STATE.reset()
+
+
+def _setup(plan=None, solver="numpy", reschedule=True, **cfg_overrides):
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=reschedule)
+    client = fc if plan is None else ChaosClusterClient(fc, plan, clock=clock)
+    config = ReschedulerConfig(solver=solver, **cfg_overrides)
+    planner = SolverPlanner(config)
+    r = Rescheduler(client, planner, config, clock=clock, recorder=client)
+    return fc, client, clock, r
+
+
+def _drainable_cluster(fc):
+    fc.add_node(make_node("od-small", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    for i, cpu in enumerate([300, 200, 100]):
+        fc.add_pod(make_pod(f"small-{i}", cpu, "od-small"))
+
+
+def _has_orphan_taint(fc, name="od-small"):
+    return any(t.key == TO_BE_DELETED_TAINT for t in fc.nodes[name].taints)
+
+
+# --- the fault-injection client itself ---
+
+
+def test_fault_plan_deterministic():
+    """Same seed + same call sequence => identical injected faults."""
+
+    def run(seed):
+        fc = FakeCluster(FakeClock())
+        fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+        chaos = ChaosClusterClient(
+            fc, FaultPlan(seed=seed, error_rates={"list_ready_nodes": 0.3})
+        )
+        outcomes = []
+        for _ in range(60):
+            try:
+                chaos.list_ready_nodes()
+                outcomes.append("ok")
+            except ChaosError:
+                outcomes.append("err")
+        return outcomes, dict(chaos.stats)
+
+    a_out, a_stats = run(11)
+    b_out, b_stats = run(11)
+    c_out, _ = run(12)
+    assert a_out == b_out and a_stats == b_stats
+    assert "err" in a_out and "ok" in a_out  # both branches exercised
+    assert a_out != c_out  # different seed, different stream
+
+
+def test_scripted_fail_n_then_succeed():
+    fc = FakeCluster(FakeClock())
+    chaos = ChaosClusterClient(
+        fc, FaultPlan(fail_n={"list_unschedulable_pods": 2})
+    )
+    for _ in range(2):
+        with pytest.raises(ChaosError):
+            chaos.list_unschedulable_pods()
+    assert chaos.list_unschedulable_pods() == []
+
+
+def test_scripted_429_evictions_then_success():
+    clock = FakeClock()
+    fc = FakeCluster(clock)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    pod = make_pod("p", 100, "od-1")
+    fc.add_pod(pod)
+    chaos = ChaosClusterClient(
+        fc, FaultPlan(evict_429={pod.uid: 2}), clock=clock
+    )
+    for _ in range(2):
+        with pytest.raises(EvictionError, match="429"):
+            chaos.evict_pod(pod, 30)
+    chaos.evict_pod(pod, 30)
+    assert fc.evictions == [pod.uid]
+
+
+def test_quiesce_disables_faults():
+    fc = FakeCluster(FakeClock())
+    chaos = ChaosClusterClient(
+        fc, FaultPlan(error_rates={"list_pdbs": 1.0})
+    )
+    with pytest.raises(ChaosError):
+        chaos.list_pdbs()
+    chaos.enabled = False
+    assert chaos.list_pdbs() == []
+
+
+def test_chaos_blocks_columnar_shortcut():
+    """The wrapper must force the object observe path — the columnar
+    store reads cluster state directly, bypassing every faulted verb."""
+    fc = FakeCluster(FakeClock())
+    chaos = ChaosClusterClient(fc, FaultPlan())
+    assert getattr(chaos, "columnar_store", None) is None
+    assert chaos.clock is None or True  # other attrs still delegate
+    assert chaos.list_ready_nodes() == []
+
+
+def test_watch_stream_drop_injection():
+    """The _stream hook (wired under the watch cache by cli/main.py)
+    drops a healthy stream mid-flight with a connection reset."""
+
+    class StreamStub:
+        def _stream(self, path, read_timeout=330.0):
+            for i in range(10_000):
+                yield {"n": i}
+
+    chaos = ChaosClusterClient(
+        StreamStub(), FaultPlan(seed=1, watch_drop_rate=0.2)
+    )
+    seen = 0
+    with pytest.raises(ConnectionResetError):
+        for _ in chaos._stream("/api/v1/pods?watch=1"):
+            seen += 1
+    assert 0 < seen < 10_000  # some events delivered, then the drop
+    assert chaos.stats["watch_drop"] == 1
+    # quiesced stream runs clean
+    chaos.enabled = False
+    assert sum(1 for _ in chaos._stream("/x")) == 10_000
+
+
+# --- retrying kube reads ---
+
+
+class _RetryStub:
+    """Stub apiserver whose LIST fails a scripted number of times."""
+
+    def __init__(self, fail_codes, retry_after="1"):
+        self.fail_codes = list(fail_codes)  # consumed per GET
+        self.calls = 0
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                stub.calls += 1
+                if stub.fail_codes:
+                    code = stub.fail_codes.pop(0)
+                    body = b"{}"
+                    self.send_response(code)
+                    if retry_after is not None:
+                        self.send_header("Retry-After", retry_after)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                body = json.dumps({"items": []}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def close(self):
+        self.server.shutdown()
+
+
+def test_read_retry_two_429s_then_success():
+    """Acceptance: two 429s then 200 => exactly one successful LIST,
+    kube_request_retries_total == 2, and each backoff sleep observes the
+    server's Retry-After."""
+    stub = _RetryStub([429, 429], retry_after="1")
+    sleeps = []
+    try:
+        client = KubeClusterClient(
+            stub.url, retry_base=0.001, retry_sleep=sleeps.append
+        )
+        before = robustness_snapshot()
+        assert client.list_pdbs() == []
+        diff = {
+            k: robustness_snapshot()[k] - before[k]
+            for k in ("kube_request_retries", "kube_request_failures")
+        }
+        assert stub.calls == 3  # 2 rejected + 1 served
+        assert diff == {"kube_request_retries": 2, "kube_request_failures": 0}
+        # tiny base backoff (1ms) must be floored by Retry-After: 1
+        assert len(sleeps) == 2 and all(s >= 1.0 for s in sleeps)
+    finally:
+        stub.close()
+
+
+def test_read_retry_5xx_and_exhaustion():
+    stub = _RetryStub([503, 503, 503, 503], retry_after=None)
+    sleeps = []
+    try:
+        client = KubeClusterClient(
+            stub.url, retry_max=2, retry_base=0.001,
+            retry_sleep=sleeps.append,
+        )
+        before = robustness_snapshot()
+        with pytest.raises(urllib.error.HTTPError):
+            client.list_pdbs()
+        after = robustness_snapshot()
+        assert after["kube_request_retries"] - before["kube_request_retries"] == 2
+        assert (
+            after["kube_request_failures"] - before["kube_request_failures"]
+            == 1
+        )
+        assert stub.calls == 3  # initial + retry_max attempts
+    finally:
+        stub.close()
+
+
+def test_read_404_not_retried():
+    stub = _RetryStub([404, 404, 404], retry_after=None)
+    try:
+        client = KubeClusterClient(stub.url, retry_base=0.001)
+        before = robustness_snapshot()
+        assert client.get_pod("default", "ghost") is None
+        assert stub.calls == 1  # a real answer, not a flake
+        after = robustness_snapshot()
+        assert after["kube_request_retries"] == before["kube_request_retries"]
+    finally:
+        stub.close()
+
+
+def test_write_verbs_single_attempt():
+    """Evictions stay single-attempt even on 429 — the actuator owns
+    their retry cadence (scaler.go:47-62)."""
+    stub = _RetryStub([429, 429, 429], retry_after="1")
+    try:
+        client = KubeClusterClient(stub.url, retry_base=0.001)
+        before = robustness_snapshot()
+        with pytest.raises(EvictionError):
+            client.evict_pod(make_pod("p", 100, "od-1"), 30)
+        # the stub rejects the POST's GET-agnostic handler? no GETs ran:
+        assert robustness_snapshot()["kube_request_retries"] == (
+            before["kube_request_retries"]
+        )
+    finally:
+        stub.close()
+
+
+def test_transient_classification():
+    err_429 = urllib.error.HTTPError("u", 429, "Too Many", {}, None)
+    assert transient_http_error(err_429)[0] is True
+    assert transient_http_error(
+        urllib.error.HTTPError("u", 500, "ISE", {}, None)
+    ) == (True, None)
+    assert transient_http_error(
+        urllib.error.HTTPError("u", 404, "NF", {}, None)
+    ) == (False, None)
+    assert transient_http_error(ConnectionResetError("rst")) == (True, None)
+    assert transient_http_error(TimeoutError()) == (True, None)
+    assert transient_http_error(ValueError("bad json")) == (False, None)
+
+
+# --- skip-tick-on-error policy ---
+
+
+def test_unschedulable_list_failure_skips_tick():
+    """An unknown unschedulable-pods state must SKIP the tick, not be
+    treated as 'zero pods' — that would defeat the don't-make-things-
+    worse gate exactly when the apiserver is flaky."""
+    fc, chaos, clock, r = _setup(
+        plan=FaultPlan(fail_n={"list_unschedulable_pods": 1})
+    )
+    _drainable_cluster(fc)
+    result = r.tick()
+    assert result.skipped == "error"
+    assert fc.evictions == []
+    # fault consumed: the next tick proceeds and drains
+    assert r.tick().drained == ["od-small"]
+
+
+# --- planner crash containment ---
+
+
+class _PoisonedPlanner:
+    """Raises from every dispatch shape the controller knows."""
+
+    accepts_columnar = False
+
+    def __init__(self, async_mode=None):
+        self.async_mode = async_mode  # None | "dispatch" | "fetch"
+        if async_mode is not None:
+            self.plan_async = self._plan_async
+
+    def plan(self, observation, pdbs):
+        raise RuntimeError("solver exploded (poisoned)")
+
+    def _plan_async(self, observation, pdbs):
+        if self.async_mode == "dispatch":
+            raise RuntimeError("solver exploded at dispatch (poisoned)")
+
+        def finish():
+            raise RuntimeError("solver exploded at fetch (poisoned)")
+
+        return finish
+
+
+@pytest.mark.parametrize("async_mode", [None, "dispatch", "fetch"])
+def test_planner_exception_degrades_to_fallback(async_mode):
+    fc, _, clock, r = _setup()
+    _drainable_cluster(fc)
+    r.planner = _PoisonedPlanner(async_mode)
+    before = robustness_snapshot()
+    result = r.tick()
+    # the tick completed on the numpy-oracle fallback — and still drained
+    assert result.skipped == ""
+    assert result.planner_fallback is True
+    assert result.drained == ["od-small"]
+    after = robustness_snapshot()
+    assert after["planner_fallback"] - before["planner_fallback"] == 1
+    snap = health.snapshot()
+    assert snap["degraded"] is True
+    assert snap["planner_fallback_total"] == 1
+    assert snap["last_successful_tick_age_s"] is not None
+
+
+def test_degraded_clears_on_clean_primary_tick():
+    fc, _, clock, r = _setup()
+    _drainable_cluster(fc)
+    r.planner = _PoisonedPlanner()
+    assert r.tick().planner_fallback is True
+    assert health.snapshot()["degraded"] is True
+    # planner healed (e.g. device back); next completed tick clears it
+    r.planner = SolverPlanner(r.config)
+    clock.advance(700.0)
+    result = r.tick()
+    assert result.skipped == "" and result.planner_fallback is False
+    assert health.snapshot()["degraded"] is False
+
+
+def test_both_planners_failing_skips_tick():
+    fc, _, clock, r = _setup()
+    _drainable_cluster(fc)
+    r.planner = _PoisonedPlanner()
+    r._fallback_planner = _PoisonedPlanner()
+    result = r.tick()
+    assert result.skipped == "error"
+    assert fc.evictions == []
+
+
+# --- circuit breaker ---
+
+
+def test_breaker_widens_interval_and_resets():
+    fc, chaos, clock, r = _setup(
+        plan=FaultPlan(fail_n={"list_unschedulable_pods": 5}),
+        breaker_threshold=2,
+        housekeeping_interval=10.0,
+        breaker_max_interval=80.0,
+    )
+    assert r.effective_interval() == 10.0
+    expected = [10.0, 20.0, 40.0, 80.0, 80.0]  # after error #1..#5 (capped)
+    for want in expected:
+        assert r.tick().skipped == "error"
+        assert r.effective_interval() == want
+    assert health.snapshot()["breaker_interval_s"] == 80.0
+    assert health.snapshot()["degraded"] is True
+    # faults exhausted: the next tick completes, breaker + degraded reset
+    assert r.tick().skipped == ""
+    assert r.effective_interval() == 10.0
+    assert health.snapshot()["degraded"] is False
+    assert health.snapshot()["breaker_interval_s"] is None
+
+
+def test_breaker_resets_on_healthy_unschedulable_skip():
+    """An unschedulable-gate skip PROVES the observe path is healthy —
+    it must reset the breaker even though the tick never completes."""
+    fc, chaos, clock, r = _setup(
+        plan=FaultPlan(fail_n={"list_unschedulable_pods": 4}),
+        breaker_threshold=2,
+        housekeeping_interval=10.0,
+        breaker_max_interval=80.0,
+    )
+    for _ in range(4):
+        assert r.tick().skipped == "error"
+    assert r.effective_interval() == 80.0  # breaker engaged (capped)
+    assert health.snapshot()["degraded"] is True
+    # apiserver heals, but a perpetually-Pending pod holds the gate
+    fc.pending.append(make_pod("homeless", 100))
+    assert r.tick().skipped == "unschedulable"
+    assert r.effective_interval() == 10.0  # breaker reset
+    assert health.snapshot()["degraded"] is False
+    assert health.snapshot()["breaker_interval_s"] is None
+
+
+def test_unschedulable_skip_keeps_fallback_degradation():
+    """The same gate skip must NOT clear fallback-planner degradation —
+    only a completed tick proves the planner healthy again."""
+    fc, _, clock, r = _setup(node_drain_delay=0.0)
+    _drainable_cluster(fc)
+    r.planner = _PoisonedPlanner()
+    assert r.tick().planner_fallback is True
+    assert health.snapshot()["degraded"] is True
+    fc.pending.append(make_pod("homeless", 100))
+    assert r.tick().skipped == "unschedulable"
+    assert health.snapshot()["degraded"] is True  # planner still suspect
+
+
+def test_sweep_leaves_foreign_nodes_alone():
+    """ToBeDeleted taints on non-on-demand nodes belong to the cluster
+    autoscaler's own scale-downs — the sweep must not fight CA."""
+    fc, _, clock, r = _setup()
+    _drainable_cluster(fc)
+    fc.add_taint("spot-1", Taint(TO_BE_DELETED_TAINT, "", "NoSchedule"))
+    result = r.tick()
+    assert result.recovered_taints == []
+    assert any(
+        t.key == TO_BE_DELETED_TAINT for t in fc.nodes["spot-1"].taints
+    )
+
+
+# --- crash-safe drain recovery ---
+
+
+def test_mid_drain_crash_recovers_on_restart():
+    """Satellite: interrupt a drain right after add_taint (simulated
+    process death), restart the controller against the same cluster —
+    the startup sweep untaints, emits ReschedulerRecovered, and the node
+    drains cleanly on a later tick."""
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=True)
+    _drainable_cluster(fc)
+    config = ReschedulerConfig(solver="numpy")
+    chaos = ChaosClusterClient(
+        fc, FaultPlan(interrupt_on_taint=1), clock=clock
+    )
+    r = Rescheduler(
+        chaos, SolverPlanner(config), config, clock=clock, recorder=chaos
+    )
+    with pytest.raises(ChaosInterrupt):
+        r.tick()
+    # the crash left the ToBeDeleted residue and evicted nothing
+    assert _has_orphan_taint(fc)
+    assert fc.evictions == []
+    assert r._active_drains == set()
+
+    before = robustness_snapshot()
+    # "restart": a fresh controller against the same cluster
+    r2 = Rescheduler(
+        fc, SolverPlanner(config), config, clock=clock, recorder=fc
+    )
+    assert not _has_orphan_taint(fc)  # startup sweep healed it
+    assert any(e.reason == "ReschedulerRecovered" for e in fc.events)
+    after = robustness_snapshot()
+    assert (
+        after["orphaned_taints_recovered"]
+        - before["orphaned_taints_recovered"]
+        == 1
+    )
+    # and the interrupted drain completes on a later tick
+    result = r2.tick()
+    assert result.drained == ["od-small"]
+    assert not _has_orphan_taint(fc)
+
+
+def test_per_tick_sweep_heals_even_during_cooldown():
+    fc, _, clock, r = _setup()
+    _drainable_cluster(fc)
+    fc.add_taint("od-small", Taint(TO_BE_DELETED_TAINT, "", "NoSchedule"))
+    r.next_drain_time = clock.now() + 600.0  # cooldown armed
+    result = r.tick()
+    assert result.skipped == "cooldown"
+    assert result.recovered_taints == ["od-small"]
+    assert not _has_orphan_taint(fc)
+
+
+def test_sweep_disabled_by_config():
+    clock = FakeClock()
+    fc = FakeCluster(clock)
+    # no spot capacity: the node cannot drain, so only the sweep could
+    # ever remove the orphaned taint — and it is configured off
+    fc.add_node(make_node("od-small", ON_DEMAND_LABELS))
+    fc.add_pod(make_pod("stuck", 100, "od-small"))
+    fc.add_taint("od-small", Taint(TO_BE_DELETED_TAINT, "", "NoSchedule"))
+    config = ReschedulerConfig(
+        solver="numpy", reconcile_orphaned_taints=False
+    )
+    r = Rescheduler(fc, SolverPlanner(config), config, clock=clock)
+    assert _has_orphan_taint(fc)  # startup sweep did not run
+    r.tick()
+    assert _has_orphan_taint(fc)  # nor the per-tick sweep
+
+
+# --- drain verify-poll resilience ---
+
+
+def test_verify_poll_survives_flaky_get():
+    """Satellite: one flaky GET must not burn the round for all pods —
+    the remaining pods are still checked and the drain succeeds."""
+    from k8s_spot_rescheduler_tpu.actuator.drain import drain_node
+
+    clock = FakeClock()
+    fc = FakeCluster(clock)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    pods = [make_pod(f"p{i}", 100, "od-1") for i in range(3)]
+    for p in pods:
+        fc.add_pod(p)
+    checked = []
+    original = fc.get_pod
+
+    def spy(ns, name):
+        checked.append(name)
+        return original(ns, name)
+
+    fc.get_pod = spy
+    chaos = ChaosClusterClient(
+        fc, FaultPlan(fail_n={"get_pod": 1}), clock=clock
+    )
+    drain_node(
+        chaos, fc, fc.nodes["od-1"], pods,
+        clock=clock, max_graceful_termination=30,
+        pod_eviction_timeout=120.0, eviction_retry_time=10.0,
+    )
+    assert fc.list_pods_on_node("od-1") == []
+    # round 1: p0's GET was chaos-failed BEFORE reaching the cluster, yet
+    # p1 and p2 were still checked that same round
+    assert checked[:2] == ["p1", "p2"]
+
+
+def test_verify_poll_memoizes_confirmed_gone_pods():
+    """A pod confirmed off the node is not re-GET-ed in later rounds —
+    only the stragglers are."""
+    from k8s_spot_rescheduler_tpu.actuator.drain import drain_node
+
+    clock = FakeClock()
+    fc = FakeCluster(clock)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    pods = [make_pod(f"p{i}", 100, "od-1") for i in range(3)]
+    for p in pods:
+        fc.add_pod(p)
+    # p0's eviction fails once -> evicted one retry round (10 s) later
+    # than p1/p2, so the first verify round sees p1/p2 gone, p0 present
+    fc.eviction_failures[pods[0].uid] = 1
+    counts = {}
+    original = fc.get_pod
+
+    def spy(ns, name):
+        counts[name] = counts.get(name, 0) + 1
+        return original(ns, name)
+
+    fc.get_pod = spy
+    drain_node(
+        fc, fc, fc.nodes["od-1"], pods,
+        clock=clock, max_graceful_termination=30,
+        pod_eviction_timeout=120.0, eviction_retry_time=10.0,
+    )
+    assert counts["p0"] == 2  # present in round 1, gone in round 2
+    assert counts["p1"] == 1 and counts["p2"] == 1  # memoized after round 1
+
+
+# --- /healthz surface ---
+
+
+def test_sidecar_healthz_reports_degraded():
+    from k8s_spot_rescheduler_tpu.sidecar.server import PlannerSidecar
+
+    fc, _, clock, r = _setup()
+    _drainable_cluster(fc)
+    r.planner = _PoisonedPlanner()
+    assert r.tick().planner_fallback is True
+
+    sidecar = PlannerSidecar(ReschedulerConfig(solver="numpy"), "127.0.0.1:0")
+    sidecar.start_background()
+    try:
+        with urllib.request.urlopen(
+            f"http://{sidecar.address}/healthz", timeout=10
+        ) as resp:
+            payload = json.loads(resp.read())
+    finally:
+        sidecar.close()
+    assert payload["ok"] is True
+    assert payload["degraded"] is True
+    assert payload["planner_fallback_total"] == 1
+    assert payload["last_successful_tick_age_s"] is not None
+
+
+# --- the headline chaos soak ---
+
+
+def test_chaos_soak_300_ticks():
+    """>=300 ticks under a seeded FaultPlan (>=10% error rates on
+    list/get, scripted eviction 429s, one mid-drain interrupt): the loop
+    never crashes, no ToBeDeleted taint survives at end-state, no node
+    is drained twice without re-observation, and drains resume after the
+    faults clear."""
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=True)
+    for i in range(4):
+        fc.add_node(make_node(f"od-{i}", ON_DEMAND_LABELS))
+        fc.add_node(make_node(f"spot-{i}", SPOT_LABELS, cpu_millis=4000))
+    seeds = []
+    for i in range(4):
+        for j in range(3):
+            pod = make_pod(f"p{i}-{j}", 100, f"od-{i}")
+            fc.add_pod(pod)
+            seeds.append(pod.uid)
+    plan = FaultPlan(
+        seed=7,
+        error_rates={
+            "list_ready_nodes": 0.12,
+            "list_unready_nodes": 0.05,
+            "list_pods_on_node": 0.10,
+            "list_unschedulable_pods": 0.12,
+            "list_pdbs": 0.10,
+            "get_pod": 0.10,
+            "evict_pod": 0.05,
+            "add_taint": 0.03,
+            "remove_taint": 0.03,
+        },
+        evict_429={seeds[0]: 2, seeds[5]: 1, "default/churn-1": 2},
+        stale_read_rate=0.05,
+        interrupt_on_taint=3,  # the third drain attempt dies mid-taint
+    )
+    chaos = ChaosClusterClient(fc, plan, clock=clock)
+    config = ReschedulerConfig(
+        solver="numpy",
+        housekeeping_interval=10.0,
+        node_drain_delay=30.0,
+        pod_eviction_timeout=60.0,
+        eviction_retry_time=5.0,
+    )
+    planner = SolverPlanner(config)
+
+    def make_controller():
+        return Rescheduler(
+            chaos, planner, config, clock=clock, recorder=chaos
+        )
+
+    r = make_controller()
+    n_ticks, quiesce_at = 380, 330
+    interrupts, completed = 0, 0
+    drains = []  # (tick index, node)
+    churn = 0
+    for i in range(n_ticks):
+        clock.sleep(config.housekeeping_interval)
+        if i == quiesce_at:
+            # pre-tick, so a ChaosInterrupt on this very tick cannot
+            # `continue` past the quiesce and leave faults on forever
+            chaos.enabled = False  # faults clear
+        if i % 15 == 0:
+            # cluster churn: new work lands on an on-demand node, so
+            # there is always eventually something to drain
+            target = f"od-{churn % 4}"
+            fc.add_pod(make_pod(f"churn-{churn}", 100, target))
+            churn += 1
+        occupied = {
+            name
+            for name in fc.nodes
+            if name.startswith("od-") and fc.list_pods_on_node(name)
+        }
+        try:
+            result = r.tick()
+        except ChaosInterrupt:
+            interrupts += 1
+            r = make_controller()  # process "restart" against same cluster
+            continue
+        completed += 1
+        # the no-double-drain-without-re-observation invariant: every
+        # drained node was observed WITH PODS at this tick's start (a
+        # node drained off a stale/duplicated view would be empty here)
+        assert set(result.drained) <= occupied
+        drains.extend((i, n) for n in result.drained)
+    assert completed >= 300
+    assert interrupts == 1  # the scripted mid-drain crash fired exactly once
+    assert chaos.stats["evict_429"] >= 1  # scripted 429s were exercised
+    # drains resumed after the faults cleared
+    assert any(i >= quiesce_at for i, _ in drains)
+    assert len(drains) >= 3
+    # end-state: zero orphaned ToBeDeleted taints anywhere
+    for node in fc.nodes.values():
+        assert not any(t.key == TO_BE_DELETED_TAINT for t in node.taints), (
+            f"orphaned taint survived on {node.name}"
+        )
+    # nothing stranded: the closed loop kept re-placing evicted pods
+    assert fc.pending == []

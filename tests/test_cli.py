@@ -21,6 +21,45 @@ def test_defaults_match_reference():
     assert cfg.delete_non_replicated_pods is False
 
 
+def test_robustness_defaults():
+    cfg = config_from_args(build_parser().parse_args([]))
+    assert cfg.kube_retry_max == 4
+    assert cfg.kube_retry_base == 0.25
+    assert cfg.breaker_threshold == 3
+    assert cfg.breaker_max_interval == 300.0  # "5m"
+    assert cfg.reconcile_orphaned_taints is True
+    assert cfg.chaos_profile == ""  # chaos is strictly opt-in
+    assert cfg.chaos_seed == 0
+
+
+def test_robustness_flags_flow_into_config():
+    args = build_parser().parse_args(
+        ["--kube-retry-max", "2", "--kube-retry-base", "0.1",
+         "--breaker-threshold", "5", "--breaker-max-interval", "2m",
+         "--reconcile-orphaned-taints", "false",
+         "--chaos-profile", "heavy", "--chaos-seed", "9"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.kube_retry_max == 2
+    assert cfg.kube_retry_base == 0.1
+    assert cfg.breaker_threshold == 5
+    assert cfg.breaker_max_interval == 120.0
+    assert cfg.reconcile_orphaned_taints is False
+    assert cfg.chaos_profile == "heavy"
+    assert cfg.chaos_seed == 9
+
+
+def test_chaos_demo_run():
+    """Full binary path under fault injection: the seeded chaos wrapper
+    engages and the bounded run still exits cleanly."""
+    rc = main(
+        ["--cluster", "synthetic:1", "--ticks", "3", "--no-metrics-server",
+         "--node-drain-delay", "1s", "--solver", "numpy",
+         "--chaos-profile", "light", "--chaos-seed", "3"]
+    )
+    assert rc == 0
+
+
 def test_version_flag(capsys):
     assert main(["--version"]) == 0
     assert "k8s-spot-rescheduler-tpu" in capsys.readouterr().out
