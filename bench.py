@@ -891,7 +891,10 @@ def run_chaos(args, metric: str, unit: str) -> int:
             interrupts += 1
             r = make_controller()
             continue
-        except BaseException as err:  # noqa: BLE001 — the invariant itself
+        except Exception as err:  # noqa: BLE001 — the invariant itself
+            # Exception, not BaseException: ChaosInterrupt (the only
+            # BaseException the soak expects) is handled above, and a
+            # Ctrl-C/SystemExit must propagate, not print a bogus FAIL
             violations.append(f"tick {i} crashed the loop: {err!r}")
             break
         completed += 1

@@ -33,6 +33,7 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
     PodSpec,
     Taint,
     TO_BE_DELETED_TAINT,
+    rescheduler_taint_value,
 )
 from k8s_spot_rescheduler_tpu.utils.clock import Clock
 from k8s_spot_rescheduler_tpu.utils import logging as log
@@ -89,10 +90,26 @@ def drain_node(
     max_graceful_termination: int,
     pod_eviction_timeout: float,
     eviction_retry_time: float,
+    identity: str = "",
 ) -> None:
     """Drain ``node`` of ``pods``; raises DrainError on failure
-    (reference scaler.go:68-146 ``DrainNode``)."""
-    taint = Taint(TO_BE_DELETED_TAINT, "", "NoSchedule")
+    (reference scaler.go:68-146 ``DrainNode``).
+
+    The taint is stamped with an ownership value (``identity`` — the
+    replica's stable holder id — plus a wall timestamp): the cluster
+    autoscaler applies the SAME taint key during its own scale-downs, so
+    the controller's orphaned-taint sweep only ever removes taints
+    carrying this marker (models/cluster.py ``rescheduler_taint_value``).
+    """
+    # clock.wall() on purpose (no monotonic fallback): the stamp is
+    # compared across processes/replicas, and silently writing
+    # seconds-since-boot would make another sweeper misjudge the
+    # taint's age — a non-conforming Clock must fail loudly here
+    taint = Taint(
+        TO_BE_DELETED_TAINT,
+        rescheduler_taint_value(identity, clock.wall()),
+        "NoSchedule",
+    )
     try:
         client.add_taint(node.name, taint)
     except Exception as err:  # noqa: BLE001 — any apiserver failure aborts
@@ -142,13 +159,20 @@ def drain_node(
                 clock.sleep(eviction_retry_time)
 
         # Verification poll (scaler.go:119-144): all pods must be off the
-        # node before the deadline. A pod confirmed gone stays gone (it
+        # node before the deadline. A pod observed gone is memoized (it
         # was evicted), so each round re-checks only the rest — and a
         # flaky GET marks only ITS pod as not-confirmed while the
         # remaining pods are still checked this round, instead of one
         # transient error burning the whole 5 s poll interval for all.
+        # Success requires every gone verdict on the FINAL round: verdicts
+        # memoized in earlier rounds get one fresh confirming read, so a
+        # single anomalous observation (e.g. a stale-serving client
+        # layer) cannot declare a still-running pod evicted and the node
+        # drained. The common case — everything gone in one round — pays
+        # no extra reads.
         gone: set = set()
         while clock.now() < retry_until + VERIFY_POLL_INTERVAL:
+            fresh: set = set()  # gone verdicts observed THIS round
             for pod in pods:
                 if pod.uid in gone:
                     continue
@@ -158,14 +182,39 @@ def drain_node(
                     log.error("Failed to check pod %s: %s", pod.uid, err)
                     continue  # only this pod counts as not-yet-gone
                 if returned is None or returned.node_name != node.name:
-                    gone.add(pod.uid)
+                    fresh.add(pod.uid)
                 else:
                     # expected while evictions propagate — the reference
                     # logs it at plain glog info (scaler/scaler.go:131-135),
                     # not error; vlog-gated here so proof artifacts and
                     # quiet production logs don't carry per-poll noise
                     log.vlog(2, "Not deleted yet %s", pod.name)
-            if len(gone) == len(pods):
+            confirmed = len(gone) + len(fresh) == len(pods)
+            if confirmed:
+                # re-confirm earlier rounds' memoized verdicts with one
+                # fresh read each; a pod found back demotes to not-gone
+                # and the poll continues
+                for pod in pods:
+                    if pod.uid in fresh or pod.uid not in gone:
+                        continue
+                    try:
+                        returned = client.get_pod(pod.namespace, pod.name)
+                    except Exception as err:  # noqa: BLE001
+                        log.error(
+                            "Failed to re-confirm pod %s: %s", pod.uid, err
+                        )
+                        gone.discard(pod.uid)
+                        confirmed = False
+                        continue
+                    if returned is not None and returned.node_name == node.name:
+                        log.error(
+                            "Pod %s reappeared on %s after being observed "
+                            "gone; resuming verification", pod.name, node.name,
+                        )
+                        gone.discard(pod.uid)
+                        confirmed = False
+            gone |= fresh
+            if confirmed:
                 log.vlog(4, "All pods removed from %s", node.name)
                 drain_successful = True
                 recorder.event(

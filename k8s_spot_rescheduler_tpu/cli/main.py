@@ -110,8 +110,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on startup and each tick, remove ToBeDeleted "
                         "taints no active drain owns (crash-safe drain "
                         "recovery; the reference leaves them for CA)")
+    from k8s_spot_rescheduler_tpu.io.chaos import FaultPlan as _FaultPlan
+
     p.add_argument("--chaos-profile", default=d.chaos_profile,
-                   choices=["", "light", "heavy"],
+                   choices=list(_FaultPlan.PROFILES),
                    help="wrap the cluster client in the seeded "
                         "fault-injection layer (io/chaos.py) — "
                         "testing/demo only, never production")
@@ -329,6 +331,10 @@ def main(argv=None) -> int:
         # could untaint the LEADER's in-flight drain; the per-tick sweep
         # runs once this replica is leader-gated into ticking
         startup_sweep=(elector is None or elector.is_leader),
+        # taint-ownership holder id (defaults to the hostname — stable
+        # across a restart of the same replica, distinct between HA
+        # replicas); an explicit lease identity overrides it
+        identity=args.leader_elect_identity or None,
     )
     ticks = 0
     while args.ticks == 0 or ticks < args.ticks:

@@ -96,7 +96,9 @@ class FaultPlan:
     watch_drop_rate: float = 0.0
     interrupt_on_taint: int = 0
 
-    PROFILES = ("", "off", "light", "heavy")
+    # the single source for profile names: profile() accepts exactly
+    # these, and cli/main.py builds its --chaos-profile choices from it
+    PROFILES = ("", "off", "none", "light", "heavy")
 
     @classmethod
     def profile(cls, name: str, seed: int = 0) -> "FaultPlan":
@@ -207,6 +209,17 @@ class ChaosClusterClient:
     def get_pod(self, namespace: str, name: str) -> Optional[PodSpec]:
         return self._read("get_pod", namespace, name)
 
+    def _invalidate(self, *keys: tuple) -> None:
+        """Read-your-own-writes floor: the apiserver never serves THIS
+        client a read older than its own acknowledged write (stale reads
+        model cache/replication lag, not time travel past the caller's
+        writes). A successful write drops the stale-serving cache for
+        the queries it changes — without this, a stale pod LIST can
+        resurrect pods the controller itself already evicted and induce
+        a phantom double-drain no real apiserver would permit."""
+        for key in keys:
+            self._last_read.pop(key, None)
+
     # --- write path ---
 
     def evict_pod(self, pod: PodSpec, grace_seconds: int) -> None:
@@ -222,11 +235,17 @@ class ChaosClusterClient:
                 )
         self._maybe_fault("evict_pod")
         self.inner.evict_pod(pod, grace_seconds)
+        self._invalidate(
+            ("list_pods_on_node", pod.node_name),
+            ("list_unschedulable_pods",),
+            ("get_pod", pod.namespace, pod.name),
+        )
 
     def add_taint(self, node_name: str, taint: Taint) -> None:
         self._latency("add_taint")
         self._maybe_fault("add_taint")
         self.inner.add_taint(node_name, taint)
+        self._invalidate(("list_ready_nodes",), ("list_unready_nodes",))
         self._taint_calls += 1
         if (
             self.enabled
@@ -244,6 +263,7 @@ class ChaosClusterClient:
         self._latency("remove_taint")
         self._maybe_fault("remove_taint")
         self.inner.remove_taint(node_name, taint_key)
+        self._invalidate(("list_ready_nodes",), ("list_unready_nodes",))
 
     # --- event sink (never faulted: events are best-effort already) ---
 

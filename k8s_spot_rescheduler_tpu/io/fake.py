@@ -357,13 +357,28 @@ class FakeCluster:
         self.pending.append(pod)
 
     def add_taint(self, node_name: str, taint: Taint) -> None:
+        from k8s_spot_rescheduler_tpu.models.cluster import (
+            parse_rescheduler_taint_value,
+        )
+
         node = self.nodes[node_name]
-        if taint not in node.taints:
-            # REPLACE the list, never mutate in place: the columnar
-            # store's per-row mask cache keys on the taint list's
-            # identity (models/columnar._spot_taint_rows), exactly like
-            # the real kube/watch paths deliver fresh objects
-            node.taints = node.taints + [taint]
+        if taint in node.taints:
+            return
+        for t in node.taints:
+            # mirror KubeClusterClient.add_taint: a same-key entry we
+            # own is replaced (re-drains refresh the ownership stamp),
+            # a FOREIGN same-key entry (CA's scale-down marker) is kept
+            # untouched — taint keys are unique per node, and stealing
+            # CA's would let the orphan sweep later strip it
+            if t.key == taint.key and t.value and (
+                parse_rescheduler_taint_value(t.value) is None
+            ):
+                return
+        # REPLACE the list, never mutate in place: the columnar store's
+        # per-row mask cache keys on the taint list's identity
+        # (models/columnar._spot_taint_rows), exactly like the real
+        # kube/watch paths deliver fresh objects
+        node.taints = [t for t in node.taints if t.key != taint.key] + [taint]
 
     def remove_taint(self, node_name: str, taint_key: str) -> None:
         node = self.nodes.get(node_name)

@@ -45,17 +45,26 @@ from k8s_spot_rescheduler_tpu.utils import logging as log
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# Longest server-sent Retry-After the read-retry loop will honor: flow
+# control deserves deference, but a single read must never absorb an
+# hour-long header — the control loop's skip-tick/breaker path owns
+# outages longer than this.
+RETRY_AFTER_CAP = 30.0
+
 
 def transient_http_error(err: Exception):
     """(retryable, retry_after_s) classification of a request failure.
 
     Transient — worth a backed-off retry: HTTP 429 (apiserver flow
     control; carries Retry-After) and any 5xx, plus every
-    connection-level failure (reset, refused, timeout, TLS hiccup —
-    ``URLError`` and the rest of the ``OSError`` family). Everything
-    else (401/403/404/409, malformed JSON, ...) is a real answer, not a
-    flake, and surfaces immediately — retrying a 404 would only delay
-    the caller's own handling of it."""
+    connection-level failure (reset, refused, timeout, TLS handshake
+    flake — ``URLError`` and the rest of the ``OSError`` family).
+    EXCEPT certificate-verification failures: a misconfigured CA bundle
+    or hostname can never succeed on retry, so it surfaces immediately
+    instead of burning the full backoff budget on every read.
+    Everything else (401/403/404/409, malformed JSON, ...) is a real
+    answer, not a flake, and surfaces immediately — retrying a 404
+    would only delay the caller's own handling of it."""
     if isinstance(err, urllib.error.HTTPError):
         if err.code == 429 or 500 <= err.code < 600:
             retry_after = None
@@ -66,6 +75,12 @@ def transient_http_error(err: Exception):
             except (TypeError, ValueError):
                 retry_after = None
             return True, retry_after
+        return False, None
+    if isinstance(err, ssl.SSLCertVerificationError):
+        return False, None
+    if isinstance(err, urllib.error.URLError) and isinstance(
+        getattr(err, "reason", None), ssl.SSLCertVerificationError
+    ):
         return False, None
     if isinstance(err, (urllib.error.URLError, OSError)):
         return True, None
@@ -776,11 +791,15 @@ class KubeClusterClient:
                     metrics.update_kube_request_failure()
                     raise
                 # full jitter around the exponential midpoint: delay in
-                # [0.5, 1.5) x base x 2^attempt, floored by Retry-After
+                # [0.5, 1.5) x base x 2^attempt, floored by Retry-After —
+                # capped: one bad header (a degraded LB answering
+                # "Retry-After: 3600") must not stall the tick for hours
+                # inside a single read; past the cap the error surfaces
+                # through the observe-skip/breaker machinery instead
                 delay = self.retry_base * (2.0 ** attempt)
                 delay *= 0.5 + self._retry_rng.random()
-                if retry_after is not None and retry_after > delay:
-                    delay = retry_after
+                if retry_after is not None:
+                    delay = max(delay, min(retry_after, RETRY_AFTER_CAP))
                 metrics.update_kube_request_retry()
                 log.vlog(
                     2,
@@ -1017,11 +1036,27 @@ class KubeClusterClient:
         )
 
     def add_taint(self, node_name: str, taint: Taint) -> None:
+        from k8s_spot_rescheduler_tpu.models.cluster import (
+            parse_rescheduler_taint_value,
+        )
+
         def mutate(taints):
             entry = {"key": taint.key, "value": taint.value, "effect": taint.effect}
-            if not any(t.get("key") == taint.key for t in taints):
-                taints = taints + [entry]
-            return taints
+            # Same-key entry we own (or an empty value): REPLACE it — a
+            # re-drain must refresh the ownership stamp, or the stale
+            # one ages past the sweep's grace horizon under a live
+            # drain. Same-key entry held by a FOREIGN writer (the
+            # cluster autoscaler's bare-timestamp scale-down marker):
+            # keep THEIRS untouched — overwriting would convert CA's
+            # taint into one our orphan sweep may later remove,
+            # aborting CA's node deletion.
+            for t in taints:
+                if t.get("key") != taint.key:
+                    continue
+                value = t.get("value") or ""
+                if value and parse_rescheduler_taint_value(value) is None:
+                    return taints  # foreign holder: leave their entry
+            return [t for t in taints if t.get("key") != taint.key] + [entry]
 
         self._patch_taints(node_name, mutate)
 

@@ -28,16 +28,25 @@ Chaos hardening beyond the reference (docs/ROBUSTNESS.md):
   circuit breaker that doubles the effective housekeeping interval per
   further failure, capped at ``breaker_max_interval``, resetting on the
   next completed tick;
-- on startup and once per tick, orphaned ``ToBeDeleted`` taints that no
-  active drain owns are removed (``ReschedulerRecovered`` event) — a
-  drain interrupted between taint and cleanup must not permanently
-  unschedule an on-demand node (the reference leaves that residue for
-  the cluster autoscaler to collect).
+- on startup and once per tick, orphaned ``ToBeDeleted`` taints are
+  removed (``ReschedulerRecovered`` event) — a drain interrupted between
+  taint and cleanup must not permanently unschedule an on-demand node
+  (the reference leaves that residue for the cluster autoscaler to
+  collect). Ownership is explicit: the drain stamps the taint value
+  with a rescheduler marker + holder identity + wall timestamp, and the
+  sweep only ever removes taints carrying that marker — the cluster
+  autoscaler applies the SAME taint key during its own scale-downs
+  (on-demand nodes included: a drained-empty node is exactly what CA is
+  expected to delete), and stripping CA's taint would abort the
+  scale-down that is the product's end goal. Another replica's marked
+  taint (HA: a demoted leader may still be mid-drain) is only swept once
+  older than any drain could run.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import socket
 import time
 from typing import List, Optional
 
@@ -49,6 +58,8 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
     NodeMap,
     TO_BE_DELETED_TAINT,
     build_node_map,
+    parse_rescheduler_taint_value,
+    rescheduler_taint_identity,
 )
 from k8s_spot_rescheduler_tpu.models.evictability import get_pods_for_deletion
 from k8s_spot_rescheduler_tpu.planner.base import Planner, PlanReport
@@ -88,12 +99,19 @@ class Rescheduler:
         clock: Optional[Clock] = None,
         recorder: Optional[EventSink] = None,
         startup_sweep: bool = True,
+        identity: Optional[str] = None,
     ):
         self.client = client
         self.planner = planner
         self.config = config
         self.clock = clock or RealClock()
         self.recorder = recorder or _NullRecorder()
+        # stable holder id stamped into drain taints (ownership for the
+        # orphan sweep). Must survive a restart of the same replica —
+        # the startup sweep heals OUR orphans immediately — and differ
+        # between HA replicas, so the hostname (pod name), overridable
+        # via --leader-elect-identity.
+        self.identity = identity or socket.gethostname()
         # start processing straight away (rescheduler.go:158-159)
         self.next_drain_time = self.clock.now()
         # --- chaos hardening state ---
@@ -296,7 +314,12 @@ class Rescheduler:
                 "Planner %r failed: %s; degrading tick to the numpy-oracle "
                 "fallback", self.config.solver, err,
             )
+            # one event, two surfaces: the Prometheus counter and the
+            # /healthz field increment together, per contained planner
+            # exception (re-plans inside a multi-drain tick included),
+            # so the two never diverge
             metrics.update_planner_fallback()
+            health.STATE.note_planner_fallback()
         try:
             if run_metrics:
                 # the primary may have died before its metrics pass ran;
@@ -311,8 +334,25 @@ class Rescheduler:
 
     # --- crash-safe drain recovery ---
 
+    def taint_sweep_grace(self) -> float:
+        """How long a rescheduler-marked taint written by ANOTHER holder
+        can still belong to a live drain. A drain's SCHEDULED lifetime
+        is bounded by ``pod_eviction_timeout``, but its final
+        eviction/verify rounds start before that deadline and then run
+        in real time (sequential apiserver calls, each with its own
+        socket timeout, against a possibly slow apiserver) — so the
+        horizon doubles the timeout and adds flat slack rather than
+        cutting it close; undercutting a live drain uncordons a node
+        mid-eviction, while an over-long grace merely delays healing a
+        FOREIGN orphan (own-identity orphans heal immediately). Assumes
+        HA replicas run the same ``pod_eviction_timeout`` — a rolling
+        config change that shrinks it should finish rolling out before
+        the old leader's drains are considered sweepable."""
+        return 2.0 * self.config.pod_eviction_timeout + 600.0
+
     def reconcile_orphaned_taints(self) -> List[str]:
-        """Remove ``ToBeDeleted`` taints no active drain owns.
+        """Remove rescheduler-owned ``ToBeDeleted`` taints no active
+        drain owns.
 
         A drain interrupted between ``add_taint`` and its deferred
         cleanup (process crash, failed un-taint) leaves the node
@@ -323,10 +363,19 @@ class Rescheduler:
         retried next tick (the sweep is idempotent). Returns the
         recovered node names.
 
-        Scope: ON-DEMAND nodes only — the drain path only ever taints
-        drain candidates, which are on-demand by construction, so a
-        ``ToBeDeleted`` taint on any other node (e.g. a spot node CA is
-        scaling down) belongs to the autoscaler and is left alone.
+        Ownership: only taints whose VALUE carries the rescheduler
+        marker (written by ``drain_node``) are candidates. The cluster
+        autoscaler applies the same taint key during its own
+        scale-downs — on spot nodes AND on the drained-empty on-demand
+        nodes this rescheduler produces for it — with a bare-timestamp
+        value; those are never touched. A marked taint held by a
+        DIFFERENT identity (HA: a demoted leader may still be mid-drain
+        after losing the lease) is only swept once older than
+        ``taint_sweep_grace()`` — no drain can outlive that horizon, so
+        a live drain's taint is never removed from under it. Our own
+        identity's taints sweep immediately: within this process
+        ``_active_drains`` covers live drains, and across a restart the
+        previous same-named incarnation is dead by definition.
 
         Cost: the in-tree clients serve these listers from their
         per-tick cache (polling) or watch cache, so the pre-gate sweep
@@ -342,14 +391,36 @@ class Rescheduler:
             return []
         from k8s_spot_rescheduler_tpu.utils.labels import matches_label
 
+        own = rescheduler_taint_identity(self.identity)
+        # wall(), not now(): taint stamps are epoch seconds shared
+        # across processes; a clock without wall() must fail loudly
+        # rather than compare monotonic seconds against them
+        now_wall = self.clock.wall()
         recovered: List[str] = []
         for node in nodes:
             if not matches_label(node.labels, self.config.on_demand_node_label):
                 continue  # not ours: only on-demand nodes are ever drained
             if node.name in self._active_drains:
                 continue
-            if not any(t.key == TO_BE_DELETED_TAINT for t in node.taints):
+            taint = next(
+                (t for t in node.taints if t.key == TO_BE_DELETED_TAINT), None
+            )
+            if taint is None:
                 continue
+            parsed = parse_rescheduler_taint_value(taint.value)
+            if parsed is None:
+                continue  # CA's (or another component's) taint: not ours
+            holder, stamped = parsed
+            if (
+                holder != own
+                and stamped is not None
+                and now_wall - stamped < self.taint_sweep_grace()
+            ):
+                continue  # possibly another replica's LIVE drain
+            # an unparsable stamp on a MARKED taint is treated as
+            # infinitely old (mangled value, other version's layout):
+            # skipping it forever would leave exactly the permanent
+            # NoSchedule residue this sweep exists to remove
             try:
                 self.client.remove_taint(node.name, TO_BE_DELETED_TAINT)
             except Exception as err:  # noqa: BLE001
@@ -368,6 +439,21 @@ class Rescheduler:
                 "removed orphaned ToBeDeleted taint left by an "
                 "interrupted drain",
             )
+        if recovered:
+            # a polling client's node cache still shows the taints just
+            # removed (the pre-gate sweep deliberately reads the
+            # previous tick's view); drop it so cooldown-skipped ticks
+            # — which never reach the gate's per-tick refresh — don't
+            # re-"recover" the same orphan every sweep (duplicate
+            # events, inflated counter, needless PATCHes)
+            refresh = getattr(self.client, "refresh", None)
+            if refresh is not None:
+                try:
+                    refresh()
+                except Exception as err:  # noqa: BLE001
+                    log.error(
+                        "Cache refresh after taint recovery failed: %s", err
+                    )
         return recovered
 
     # --- circuit breaker ---
@@ -535,6 +621,7 @@ class Rescheduler:
                     ),
                     pod_eviction_timeout=self.config.pod_eviction_timeout,
                     eviction_retry_time=self.config.eviction_retry_time,
+                    identity=self.identity,
                 )
                 metrics.update_node_drain_count("Success", plan.node.node.name)
                 result.drained.append(plan.node.node.name)

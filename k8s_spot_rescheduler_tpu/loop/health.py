@@ -66,18 +66,25 @@ class HealthState:
     def note_success(self, *, fallback: bool = False) -> None:
         """A tick completed (observe + plan + actuate all ran).
         ``fallback``: the plan came from the CPU fallback planner — the
-        tick counts as degraded until a clean primary tick follows."""
+        tick counts as degraded until a clean primary tick follows.
+        (``planner_fallback_total`` is driven by ``note_planner_fallback``
+        per contained exception, not here.)"""
         with self._lock:
             self.last_success = self._clock()
             self.consecutive_errors = 0
             self.breaker_interval = None
             self._breaker_degraded = False
             self._fallback_degraded = bool(fallback)
-            if fallback:
-                self.planner_fallback_total += 1
             self.degraded = self._fallback_degraded
             degraded = self.degraded
         self._mirror_gauge(degraded)
+
+    def note_planner_fallback(self) -> None:
+        """One contained planner exception — called alongside
+        ``metrics.update_planner_fallback()`` from the same event, so
+        /healthz and the Prometheus counter of the same name agree."""
+        with self._lock:
+            self.planner_fallback_total += 1
 
     def note_observe_ok(self) -> None:
         """Observation succeeded but a healthy gate skipped the tick

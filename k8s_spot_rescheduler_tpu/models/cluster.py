@@ -12,6 +12,7 @@ least-requested-first, pods biggest-CPU-request-first
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 from k8s_spot_rescheduler_tpu.utils.labels import matches_label
@@ -30,6 +31,65 @@ MIRROR_POD_ANNOTATION = "kubernetes.io/config.mirror"
 # autoscaler ToBeDeleted taint applied via deletetaint.MarkToBeDeleted
 # (reference scaler/scaler.go:77).
 TO_BE_DELETED_TAINT = "ToBeDeletedByClusterAutoscaler"
+
+# Value the actuator writes into its ToBeDeleted taint: an explicit
+# ownership marker. The REAL cluster autoscaler applies the same taint
+# key during its own scale-downs (with a bare unix timestamp as the
+# value) — including on the drained-empty on-demand nodes this
+# rescheduler produces, whose deletion is the product's end goal. The
+# orphaned-taint sweep must therefore be able to tell "mine, left by a
+# crashed drain" apart from "CA's, mid scale-down"; only values carrying
+# this marker are ever swept. Format:
+# ``spot-rescheduler_<unix-wall-ts>_<holder-identity>``, capped at the
+# 63 characters a taint value allows.
+RESCHEDULER_TAINT_MARKER = "spot-rescheduler"
+_TAINT_VALUE_MAX = 63
+# marker + two "_" separators + an up-to-11-digit timestamp
+_TAINT_IDENTITY_MAX = _TAINT_VALUE_MAX - len(RESCHEDULER_TAINT_MARKER) - 2 - 11
+
+
+def rescheduler_taint_identity(identity: str) -> str:
+    """Holder identity exactly as embedded in (and parsed back out of) a
+    rescheduler taint value: sanitized to legal taint-value characters,
+    shortened so the full value fits in 63 chars, and guaranteed to end
+    alphanumeric (k8s validates taint values as label values — a
+    trailing '_'/'-'/'.' would make every add_taint 422). Over-long
+    identities keep a prefix PLUS a hash of the whole string — pod
+    names carry their distinguishing hash at the END, and two replicas
+    must never truncate to the same embedded identity (a shared "own"
+    identity would let one sweep the other's live drain with no grace
+    wait). Sweepers must compare against THIS, not the raw identity."""
+    cleaned = re.sub(r"[^A-Za-z0-9._-]", "-", identity or "")
+    if len(cleaned) > _TAINT_IDENTITY_MAX:
+        import hashlib
+
+        digest = hashlib.sha1(cleaned.encode()).hexdigest()[:8]
+        cleaned = cleaned[: _TAINT_IDENTITY_MAX - 9] + "-" + digest
+    cleaned = cleaned.rstrip("_.-")
+    return cleaned or "unknown"
+
+
+def rescheduler_taint_value(identity: str, wall_ts: float) -> str:
+    return (
+        f"{RESCHEDULER_TAINT_MARKER}_{int(wall_ts)}_"
+        f"{rescheduler_taint_identity(identity)}"
+    )
+
+
+def parse_rescheduler_taint_value(
+    value: str,
+) -> Optional[Tuple[str, Optional[float]]]:
+    """``(holder-identity, wall-ts | None)`` when ``value`` carries the
+    rescheduler marker, else None — not our taint, leave it alone."""
+    prefix = RESCHEDULER_TAINT_MARKER + "_"
+    if not value or not value.startswith(prefix):
+        return None
+    ts_str, _, identity = value[len(prefix):].partition("_")
+    try:
+        ts: Optional[float] = float(ts_str)
+    except ValueError:
+        ts = None
+    return identity, ts
 
 
 @dataclasses.dataclass(frozen=True)

@@ -18,11 +18,18 @@ from typing import Protocol
 class Clock(Protocol):
     def now(self) -> float: ...
     def sleep(self, seconds: float) -> None: ...
+    # wall-clock epoch seconds: unlike ``now`` (monotonic — resets with
+    # the process), comparable across restarts and replicas; used for
+    # durable timestamps written into the cluster (taint ownership)
+    def wall(self) -> float: ...
 
 
 class RealClock:
     def now(self) -> float:
         return _time.monotonic()
+
+    def wall(self) -> float:
+        return _time.time()
 
     def sleep(self, seconds: float) -> None:
         if seconds > 0:
@@ -43,6 +50,10 @@ class FakeClock:
         self._lock = threading.Lock()
 
     def now(self) -> float:
+        return self._now
+
+    def wall(self) -> float:
+        # the virtual timeline IS the wall clock in tests
         return self._now
 
     def call_at(self, when: float, fn) -> None:

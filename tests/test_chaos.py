@@ -28,7 +28,13 @@ from k8s_spot_rescheduler_tpu.io.kube import (
 from k8s_spot_rescheduler_tpu.loop import health
 from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
 from k8s_spot_rescheduler_tpu.metrics.registry import robustness_snapshot
-from k8s_spot_rescheduler_tpu.models.cluster import TO_BE_DELETED_TAINT, Taint
+from k8s_spot_rescheduler_tpu.models.cluster import (
+    TO_BE_DELETED_TAINT,
+    Taint,
+    parse_rescheduler_taint_value,
+    rescheduler_taint_identity,
+    rescheduler_taint_value,
+)
 from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
 from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
@@ -62,6 +68,16 @@ def _drainable_cluster(fc):
 
 def _has_orphan_taint(fc, name="od-small"):
     return any(t.key == TO_BE_DELETED_TAINT for t in fc.nodes[name].taints)
+
+
+def _owned_taint(r, clock):
+    """A ToBeDeleted taint exactly as ``r``'s own drain path writes it —
+    the residue an interrupted drain of this replica leaves behind."""
+    return Taint(
+        TO_BE_DELETED_TAINT,
+        rescheduler_taint_value(r.identity, clock.wall()),
+        "NoSchedule",
+    )
 
 
 # --- the fault-injection client itself ---
@@ -234,6 +250,22 @@ def test_read_retry_two_429s_then_success():
         stub.close()
 
 
+def test_read_retry_honors_retry_after_but_caps_it():
+    """Flow control is deferred to, but one bad header (a degraded LB
+    saying 'Retry-After: 3600') must not stall the tick for an hour
+    inside a single read."""
+    stub = _RetryStub([503], retry_after="3600")
+    sleeps = []
+    try:
+        client = KubeClusterClient(
+            stub.url, retry_base=0.001, retry_sleep=sleeps.append
+        )
+        assert client.list_pdbs() == []
+        assert len(sleeps) == 1 and sleeps[0] <= 30.0
+    finally:
+        stub.close()
+
+
 def test_read_retry_5xx_and_exhaustion():
     stub = _RetryStub([503, 503, 503, 503], retry_after=None)
     sleeps = []
@@ -298,6 +330,23 @@ def test_transient_classification():
     assert transient_http_error(ConnectionResetError("rst")) == (True, None)
     assert transient_http_error(TimeoutError()) == (True, None)
     assert transient_http_error(ValueError("bad json")) == (False, None)
+    # certificate verification can never succeed on retry — a
+    # misconfigured CA bundle/hostname must surface immediately, not
+    # burn the backoff budget on every read
+    import ssl
+
+    cert_err = ssl.SSLCertVerificationError(
+        "certificate verify failed: unable to get local issuer certificate"
+    )
+    assert transient_http_error(cert_err) == (False, None)
+    assert transient_http_error(urllib.error.URLError(cert_err)) == (
+        False,
+        None,
+    )
+    # a non-cert TLS hiccup (handshake reset) stays retryable
+    assert transient_http_error(
+        urllib.error.URLError(ConnectionResetError("tls reset"))
+    ) == (True, None)
 
 
 # --- skip-tick-on-error policy ---
@@ -387,6 +436,24 @@ def test_both_planners_failing_skips_tick():
     assert fc.evictions == []
 
 
+def test_planner_fallback_counters_agree():
+    """/healthz's planner_fallback_total and the Prometheus counter are
+    driven by the same event (one per contained planner exception) —
+    including ticks where the fallback failed too — so the two surfaces
+    never diverge."""
+    fc, _, clock, r = _setup()
+    _drainable_cluster(fc)
+    r.planner = _PoisonedPlanner()
+    before = robustness_snapshot()["planner_fallback"]
+    r._fallback_planner = _PoisonedPlanner()
+    assert r.tick().skipped == "error"  # primary raised AND fallback died
+    r._fallback_planner = None  # lazily rebuilt as the real numpy oracle
+    assert r.tick().planner_fallback is True  # primary raised, fallback ran
+    prom = robustness_snapshot()["planner_fallback"] - before
+    assert prom == 2
+    assert health.snapshot()["planner_fallback_total"] == prom
+
+
 # --- circuit breaker ---
 
 
@@ -443,6 +510,74 @@ def test_unschedulable_skip_keeps_fallback_degradation():
     fc.pending.append(make_pod("homeless", 100))
     assert r.tick().skipped == "unschedulable"
     assert health.snapshot()["degraded"] is True  # planner still suspect
+
+
+def test_taint_ownership_value_is_legal_and_collision_free():
+    """The ownership value must be valid k8s label-value syntax (<=63
+    chars, ends alphanumeric — an illegal value would 422 every
+    add_taint), and two replicas whose pod names differ only in the
+    TRAILING hash must never embed the same identity (a shared 'own'
+    identity would let one sweep the other's live drain)."""
+    import re as _re
+
+    label_value = _re.compile(r"^[A-Za-z0-9]([A-Za-z0-9_.\-]*[A-Za-z0-9])?$")
+    long_a = "k8s-spot-rescheduler-tpu-controller-7d9f8b6c4-xk2lp"
+    long_b = "k8s-spot-rescheduler-tpu-controller-7d9f8b6c4-ab9qz"
+    assert rescheduler_taint_identity(long_a) != rescheduler_taint_identity(
+        long_b
+    )
+    for ident in (long_a, long_b, "", "host_", "a" * 33 + "-" + "b" * 30,
+                  "plain-host"):
+        value = rescheduler_taint_value(ident, 1722772800.0)
+        assert len(value) <= 63
+        assert label_value.match(value), value
+        holder, ts = parse_rescheduler_taint_value(value)
+        assert holder == rescheduler_taint_identity(ident)
+        assert ts == 1722772800.0
+    # non-marker values (CA's bare timestamp) never parse as ours
+    assert parse_rescheduler_taint_value("1722772800") is None
+    assert parse_rescheduler_taint_value("") is None
+
+
+def test_retaint_replaces_own_value_keeps_foreign_heals_unparsable():
+    """Re-tainting refreshes OUR ownership stamp (a kept stale stamp
+    would age past the grace horizon under a live drain) but never
+    steals a FOREIGN same-key entry (CA's scale-down marker — stealing
+    it would let the sweep later strip it and abort CA's deletion);
+    and a marked taint whose stamp doesn't parse sweeps as infinitely
+    old rather than surviving forever."""
+
+    def tbd_values(fc, name):
+        return [t.value for t in fc.nodes[name].taints
+                if t.key == TO_BE_DELETED_TAINT]
+
+    fc, _, clock, r = _setup()
+    fc.add_node(make_node("od-small", ON_DEMAND_LABELS))
+    # own marker, re-tainted: REPLACED (one entry, newest stamp)
+    fc.add_taint("od-small", Taint(
+        TO_BE_DELETED_TAINT, rescheduler_taint_value("me", 100.0),
+        "NoSchedule"))
+    fc.add_taint("od-small", Taint(
+        TO_BE_DELETED_TAINT, rescheduler_taint_value("me", 200.0),
+        "NoSchedule"))
+    assert tbd_values(fc, "od-small") == [
+        rescheduler_taint_value("me", 200.0)
+    ]
+    # foreign bare-timestamp value already present: OUR add keeps theirs
+    fc.remove_taint("od-small", TO_BE_DELETED_TAINT)
+    fc.add_taint("od-small", Taint(
+        TO_BE_DELETED_TAINT, "1722772800", "NoSchedule"))
+    fc.add_taint("od-small", Taint(
+        TO_BE_DELETED_TAINT, rescheduler_taint_value("me", 300.0),
+        "NoSchedule"))
+    assert tbd_values(fc, "od-small") == ["1722772800"]
+    # marked value with a mangled timestamp segment: swept immediately
+    fc.remove_taint("od-small", TO_BE_DELETED_TAINT)
+    fc.add_taint("od-small", Taint(
+        TO_BE_DELETED_TAINT, "spot-rescheduler_mangled_other",
+        "NoSchedule"))
+    assert r.tick().recovered_taints == ["od-small"]
+    assert not _has_orphan_taint(fc)
 
 
 def test_sweep_leaves_foreign_nodes_alone():
@@ -505,11 +640,56 @@ def test_mid_drain_crash_recovers_on_restart():
 def test_per_tick_sweep_heals_even_during_cooldown():
     fc, _, clock, r = _setup()
     _drainable_cluster(fc)
-    fc.add_taint("od-small", Taint(TO_BE_DELETED_TAINT, "", "NoSchedule"))
+    fc.add_taint("od-small", _owned_taint(r, clock))
     r.next_drain_time = clock.now() + 600.0  # cooldown armed
+    refreshes = []
+    fc.refresh = lambda: refreshes.append(1)
     result = r.tick()
     assert result.skipped == "cooldown"
     assert result.recovered_taints == ["od-small"]
+    assert not _has_orphan_taint(fc)
+    # a recovery drops the client's cached node view, so a polling
+    # client's later cooldown sweeps (which never reach the gate's
+    # per-tick refresh) don't re-recover the same orphan
+    assert refreshes == [1]
+    assert r.tick().recovered_taints == []
+
+
+def test_sweep_leaves_ca_taint_on_on_demand_node():
+    """The REAL cluster autoscaler taints on-demand nodes too — its value
+    is a bare unix timestamp, not the rescheduler marker. A drained-empty
+    on-demand node mid CA scale-down must keep CA's taint, or the sweep
+    would re-mark it schedulable and abort the very scale-down the
+    rescheduler exists to cause."""
+    fc, _, clock, r = _setup()
+    _drainable_cluster(fc)
+    # od-empty: drained earlier, now empty, tainted by CA (bare timestamp)
+    fc.add_node(make_node("od-empty", ON_DEMAND_LABELS))
+    fc.add_taint("od-empty", Taint(TO_BE_DELETED_TAINT, "1722772800", "NoSchedule"))
+    for _ in range(3):
+        result = r.tick()
+        assert result.recovered_taints == []
+    assert _has_orphan_taint(fc, "od-empty")  # CA's scale-down unobstructed
+
+
+def test_sweep_foreign_replica_taint_waits_out_drain_horizon():
+    """HA: a marked taint held by ANOTHER identity may be a demoted
+    leader's still-running drain — swept only once older than any drain
+    could run (taint_sweep_grace), never from under a live drain."""
+    fc, _, clock, r = _setup()
+    fc.add_node(make_node("od-small", ON_DEMAND_LABELS))
+    fc.add_taint(
+        "od-small",
+        Taint(
+            TO_BE_DELETED_TAINT,
+            rescheduler_taint_value("other-replica", clock.wall()),
+            "NoSchedule",
+        ),
+    )
+    assert r.tick().recovered_taints == []  # fresh: possibly a live drain
+    assert _has_orphan_taint(fc)
+    clock.advance(r.taint_sweep_grace() + 1.0)
+    assert r.tick().recovered_taints == ["od-small"]  # stale: orphan
     assert not _has_orphan_taint(fc)
 
 
@@ -518,9 +698,19 @@ def test_sweep_disabled_by_config():
     fc = FakeCluster(clock)
     # no spot capacity: the node cannot drain, so only the sweep could
     # ever remove the orphaned taint — and it is configured off
+    import socket
+
     fc.add_node(make_node("od-small", ON_DEMAND_LABELS))
     fc.add_pod(make_pod("stuck", 100, "od-small"))
-    fc.add_taint("od-small", Taint(TO_BE_DELETED_TAINT, "", "NoSchedule"))
+    # an orphan THIS replica's own drain path left (default identity)
+    fc.add_taint(
+        "od-small",
+        Taint(
+            TO_BE_DELETED_TAINT,
+            rescheduler_taint_value(socket.gethostname(), clock.wall()),
+            "NoSchedule",
+        ),
+    )
     config = ReschedulerConfig(
         solver="numpy", reconcile_orphaned_taints=False
     )
@@ -594,7 +784,47 @@ def test_verify_poll_memoizes_confirmed_gone_pods():
         pod_eviction_timeout=120.0, eviction_retry_time=10.0,
     )
     assert counts["p0"] == 2  # present in round 1, gone in round 2
-    assert counts["p1"] == 1 and counts["p2"] == 1  # memoized after round 1
+    # p1/p2: observed gone in round 1, memoized (not re-polled per
+    # round), then ONE fresh confirming read in the success round —
+    # never 3+ however many rounds the stragglers take
+    assert counts["p1"] == 2 and counts["p2"] == 2
+
+
+def test_verify_confirm_round_rejects_anomalous_gone_verdict():
+    """A single anomalous GET (e.g. a stale-serving client layer
+    returning None for a live pod) must not let the drain declare the
+    node empty: the success round re-confirms memoized verdicts, finds
+    the pod back, and the drain keeps polling (failing honestly at the
+    deadline here, since the pod never leaves)."""
+    from k8s_spot_rescheduler_tpu.actuator.drain import DrainError, drain_node
+
+    clock = FakeClock()
+    fc = FakeCluster(clock)
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    pods = [make_pod(f"p{i}", 100, "od-1") for i in range(2)]
+    for p in pods:
+        fc.add_pod(p)
+    original = fc.get_pod
+    calls = {"p1": 0}
+
+    def lying(ns, name):
+        if name == "p1":
+            calls["p1"] += 1
+            if calls["p1"] == 1:
+                return None  # the one anomalous "gone" observation
+            # thereafter: honestly still on the node, forever
+            return pods[1]
+        return original(ns, name)
+
+    fc.get_pod = lying
+    with pytest.raises(DrainError, match="pods remaining"):
+        drain_node(
+            fc, fc, fc.nodes["od-1"], pods,
+            clock=clock, max_graceful_termination=30,
+            pod_eviction_timeout=30.0, eviction_retry_time=10.0,
+        )
+    # deferred cleanup still untainted the node
+    assert fc.nodes["od-1"].taints == []
 
 
 # --- /healthz surface ---
