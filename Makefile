@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint test bench bench-smoke chaos-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze test bench bench-smoke chaos-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -13,15 +13,23 @@ all:
 	-$(MAKE) native
 	$(MAKE) check
 
-# The CI entry: lint+format gate, then tests, then the incremental-tick
-# smoke — mirroring the reference's fmt/golangci-lint/vet/test chain
-# (reference Makefile:36-65). tools/lint.py is the zero-dependency
-# stand-in (this image ships no Python linter and installs are
+# The CI entry: lint+format gate, then the project-wide analysis suite,
+# then tests, then the smokes — mirroring the reference's
+# fmt/golangci-lint/vet/test chain (reference Makefile:36-65).
+# tools/lint.py is the fmt+golangci-lint stand-in and tools/analysis is
+# the go-vet analog (this image ships no Python linter and installs are
 # forbidden).
-check: lint test bench-smoke repair-smoke chaos-smoke
+check: lint analyze test bench-smoke repair-smoke chaos-smoke
 
 lint:
 	python tools/lint.py
+
+# Project-wide static analysis (docs/ANALYSIS.md): JAX hot-path vets
+# (host-sync, donation, recompile triggers), cross-module contracts
+# (metrics / config+CLI+docs / kube write-retry), lock discipline.
+# The watchdog keeps `make check` fast: the run must finish in 10 s.
+analyze:
+	python -m tools.analysis --max-seconds 10
 
 # best-effort native build first: the native differential suite fails
 # (not skips) when a toolchain exists but the library won't load

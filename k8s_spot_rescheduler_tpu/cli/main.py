@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--on-demand-node-label", default=d.on_demand_node_label)
     p.add_argument("--spot-node-label", default=d.spot_node_label)
     p.add_argument("--priority-threshold", type=int, default=d.priority_threshold)
+    p.add_argument("--eviction-retry-time", default=f"{d.eviction_retry_time:g}s",
+                   help="pause between eviction retry rounds while a "
+                        "drain waits pods out (a const in the reference, "
+                        "scaler/scaler.go:37-38; Go duration)")
     p.add_argument("--version", action="store_true", help="show version and exit")
     p.add_argument("-v", "--verbosity", type=int, default=0, help="glog-style -v")
     # --- TPU-native knobs ---
@@ -68,6 +72,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--repair-rounds", type=int, default=d.repair_rounds,
                    help="eject-and-reinsert local-search rounds for "
                         "candidates greedy packing can't prove (0=off)")
+    p.add_argument("--fallback-best-fit", type=_bool,
+                   default=d.fallback_best_fit,
+                   help="second feasibility pass under best-fit-"
+                        "decreasing packing for candidates first-fit "
+                        "can't prove (only ever adds drainable nodes; "
+                        "false = bit-faithful reference selection)")
+    p.add_argument("--max-drains-per-tick", type=int,
+                   default=d.max_drains_per_tick,
+                   help="drains per housekeeping tick (the reference "
+                        "hard-codes 1, rescheduler.go:286; >1 re-plans "
+                        "between drains)")
+    p.add_argument("--max-pods-per-node-hint", type=int,
+                   default=d.max_pods_per_node_hint,
+                   help="static padding bound for the solver's pod-slot "
+                        "axis (grown automatically when a node exceeds "
+                        "it, at the cost of a recompile)")
+    p.add_argument("--use-columnar", type=_bool, default=d.use_columnar,
+                   help="observe via the incrementally-maintained "
+                        "columnar mirror when the cluster source "
+                        "provides one; false = the reference-faithful "
+                        "per-tick object rebuild")
     p.add_argument("--auto-shard", type=_bool, default=d.auto_shard,
                    help="reroute the solve to the mesh-sharded backend "
                         "automatically when the problem exceeds one "
@@ -167,6 +192,11 @@ def config_from_args(args) -> ReschedulerConfig:
         on_demand_node_label=args.on_demand_node_label,
         spot_node_label=args.spot_node_label,
         priority_threshold=args.priority_threshold,
+        eviction_retry_time=parse_duration(args.eviction_retry_time),
+        max_pods_per_node_hint=args.max_pods_per_node_hint,
+        max_drains_per_tick=args.max_drains_per_tick,
+        fallback_best_fit=args.fallback_best_fit,
+        use_columnar=args.use_columnar,
         solver=args.solver,
         repair_rounds=args.repair_rounds,
         auto_shard=args.auto_shard,

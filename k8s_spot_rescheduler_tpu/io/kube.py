@@ -831,8 +831,15 @@ class KubeClusterClient:
 
     def _request_raw(self, method: str, path: str) -> bytes:
         """Raw response bytes — the native ingest engine parses LIST
-        bodies itself (io/native_ingest.py)."""
-        return self._read_retrying(method, path, timeout=60)
+        bodies itself (io/native_ingest.py). Reads only: the retrying
+        path must never carry a write verb (a retried write double-fires
+        its side effect on a timeout whose request actually landed)."""
+        if method != "GET":
+            raise ValueError(
+                f"_request_raw is read-only; {method} must go through "
+                "_request"
+            )
+        return self._read_retrying("GET", path, timeout=60)
 
     def _stream(self, path: str, read_timeout: float = 330.0):
         """Yield newline-delimited JSON objects from a watch endpoint.

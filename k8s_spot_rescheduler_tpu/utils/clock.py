@@ -73,4 +73,5 @@ class FakeClock:
                 when, _, fn = heapq.heappop(self._timers)
                 self._now = max(self._now, when)
             fn()  # outside the lock: fn may schedule follow-up timers
-        self._now = deadline
+        with self._lock:  # call_at readers see a coherent (_now, heap)
+            self._now = deadline

@@ -1,0 +1,627 @@
+"""The static-analysis suite (tools/analysis) must actually gate.
+
+Mirror of tests/test_lint.py for the vet half of the chain: every pass
+is proven by a seeded violation (a fixture tree the pass must fail), the
+real tree must be clean (`make analyze` then enforces that forever), the
+shared typed-suppression grammar is pinned, and the watchdog keeps the
+run inside the `make check` latency budget.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analysis", *map(str, args)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+
+
+def _seed(tmp_path, rel, source):
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def _analyze_tree(tmp_path, *extra):
+    # fixture runs: no baseline, and the doc check reads the fixture's
+    # parity file (or skips when the fixture ships none)
+    parity = tmp_path / "PARITY.md"
+    if not parity.exists():
+        parity.write_text("")
+    return _run(tmp_path, "--no-baseline", "--parity", parity, *extra)
+
+
+# --- the gate itself ------------------------------------------------------
+
+
+def test_tree_is_clean():
+    r = _run()
+    assert r.returncode == 0, f"analysis gate is red:\n{r.stdout}{r.stderr}"
+
+
+def test_tree_is_clean_within_watchdog():
+    """The full run must stay under 10 s so `make check` stays fast."""
+    r = _run("--max-seconds", "10")
+    assert r.returncode == 0, f"watchdog tripped:\n{r.stdout}{r.stderr}"
+
+
+def test_noqa_trailing_prose_still_suppresses(tmp_path):
+    """Prose after a code must not merge into the code token."""
+    _seed(tmp_path, "solver/prose.py", """\
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x.item()  # noqa: jax-host-sync - fetched once, on purpose
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "jax-host-sync" not in r.stdout
+    assert "unknown-suppression" not in r.stdout
+
+
+def test_donation_unresolvable_spec_skipped(tmp_path):
+    """A statically-unresolvable donate_argnums spec must cost recall,
+    never produce a false error; tuple(range(N)) IS resolvable."""
+    _seed(tmp_path, "planner/spec_donate.py", """\
+        import jax
+
+        _SPEC = (0,)
+
+        def f(a, b):
+            return a + b
+
+        g = jax.jit(f, donate_argnums=_SPEC)  # unresolvable: skip
+        h = jax.jit(f, donate_argnums=tuple(range(1)))  # resolves to {0}
+
+        def use_g(a, b):
+            out = g(a, b)
+            return b + out  # b not provably donated: no finding
+
+        def use_h(a, b):
+            out = h(a, b)
+            return a + out  # a donated at position 0: finding
+    """)
+    r = _analyze_tree(tmp_path)
+    hits = [
+        l for l in r.stdout.splitlines() if "donation-discipline" in l
+    ]
+    assert len(hits) == 1, r.stdout
+    assert "use_h" in hits[0]
+
+
+def test_subset_roots_do_not_report_stale_baseline(tmp_path):
+    _seed(tmp_path, "solver/bad.py", """\
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x.item()
+    """)
+    parity = tmp_path / "PARITY.md"
+    parity.write_text("")
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "some/other/file.py::lock-discipline::Foo.bar.attr  # elsewhere\n"
+    )
+    r = _run(tmp_path, "--baseline", baseline, "--parity", parity)
+    # the seeded host-sync finding fires, but the unrelated entry is NOT
+    # called stale — this is a subset-roots run
+    assert "jax-host-sync" in r.stdout
+    assert "stale-baseline" not in r.stdout
+
+
+def test_unknown_pass_name_errors():
+    """A --pass typo must error, not report a vacuously clean tree."""
+    r = _run("--pass", "jax-hostsync-typo")
+    assert r.returncode != 0
+    assert "invalid choice" in r.stderr
+
+
+def test_watchdog_fires_on_tiny_budget():
+    r = _run("--max-seconds", "0.000001")
+    assert r.returncode == 2
+    assert "watchdog" in r.stderr
+
+
+# --- jax-host-sync --------------------------------------------------------
+
+
+def test_seeded_host_sync_direct(tmp_path):
+    _seed(tmp_path, "solver/bad.py", """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def solve(x):
+            print(x)
+            y = np.asarray(x)
+            return y.item()
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert r.stdout.count("jax-host-sync") >= 3
+    for needle in ("print()", "np.asarray()", ".item()"):
+        assert needle in r.stdout
+
+
+def test_seeded_host_sync_via_call_graph(tmp_path):
+    """A sync inside a helper only *reachable* from a jitted root must
+    fire — this is what a per-file linter cannot see."""
+    _seed(tmp_path, "solver/indirect.py", """\
+        import jax
+
+        @jax.jit
+        def root(x):
+            return _helper(x)
+
+        def _helper(x):
+            return x.item()
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "jax-host-sync" in r.stdout and "_helper" in r.stdout
+
+
+def test_host_sync_not_flagged_outside_jit(tmp_path):
+    _seed(tmp_path, "solver/hostside.py", """\
+        import numpy as np
+
+        def decode(vec):
+            return np.asarray(vec).item()
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "jax-host-sync" not in r.stdout
+
+
+def test_host_sync_nested_branch_fires(tmp_path):
+    """A sync inside a nested def (the lax.cond branch shape) fires
+    exactly once — nested bodies are each their own entry, and visiting
+    the parent must neither skip nor double-report them."""
+    _seed(tmp_path, "solver/nested.py", """\
+        import jax
+
+        @jax.jit
+        def outer(pred, x):
+            def branch(y):
+                return y.item()
+
+            def other(y):
+                return y
+
+            return jax.lax.cond(pred, branch, other, x)
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1, r.stdout
+    hits = [l for l in r.stdout.splitlines() if ".item()" in l]
+    assert len(hits) == 1, r.stdout
+
+
+def test_host_sync_static_argnames_direct_decorator(tmp_path):
+    """The @jax.jit(static_argnames=...) decorator form exempts its
+    static params just like the jax.jit(f, ...) call form."""
+    _seed(tmp_path, "solver/dec_static.py", """\
+        import jax
+
+        @jax.jit(static_argnames=("n",))
+        def scale(x, n=2):
+            return x * int(n)
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "jax-host-sync" not in r.stdout
+
+
+def test_host_sync_static_argnames_exempt(tmp_path):
+    """int() on a static_argnames parameter is trace-time Python, not a
+    device sync (solver/repair.py's spot_chunks pattern)."""
+    _seed(tmp_path, "solver/static_ok.py", """\
+        import jax
+
+        def solve(x, chunks=2):
+            n = int(chunks)
+            return x * n
+
+        solve_jit = jax.jit(solve, static_argnames=("chunks",))
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "jax-host-sync" not in r.stdout
+
+
+# --- donation-discipline --------------------------------------------------
+
+
+def test_seeded_donation_read_after_donate(tmp_path):
+    _seed(tmp_path, "planner/bad_donate.py", """\
+        import jax
+
+        def f(a, b):
+            return a + b
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def use(a, b):
+            out = g(a, b)
+            return a + out
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "donation-discipline" in r.stdout
+
+
+def test_donation_multiline_call_is_clean(tmp_path):
+    """The donated argument's own Load inside a reflowed multi-line call
+    must not count as a read-after-donate."""
+    _seed(tmp_path, "planner/wrapped_donate.py", """\
+        import jax
+
+        def f(a, b):
+            return a + b
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def use(a, b):
+            out = g(
+                a,
+                b,
+            )
+            return out
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "donation-discipline" not in r.stdout
+
+
+def test_donation_rebind_is_clean(tmp_path):
+    _seed(tmp_path, "planner/good_donate.py", """\
+        import jax
+
+        def f(a, b):
+            return a + b
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def use(a, b):
+            a = g(a, b)
+            return a + b
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "donation-discipline" not in r.stdout
+
+
+def test_donation_shadowed_nested_param_is_clean(tmp_path):
+    """A donating call on a nested function's OWN parameter must not be
+    attributed to the enclosing function's same-named binding."""
+    _seed(tmp_path, "planner/shadow_donate.py", """\
+        import jax
+
+        def f(a):
+            return a
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def outer(a):
+            def inner(a):
+                return step(a)
+
+            y = inner(a)
+            return a + y
+    """)
+    r = _analyze_tree(tmp_path)
+    hits = [
+        l for l in r.stdout.splitlines() if "donation-discipline" in l
+    ]
+    assert not any("'outer'" in h for h in hits), r.stdout
+
+
+# --- recompile-trigger ----------------------------------------------------
+
+
+def test_seeded_recompile_triggers(tmp_path):
+    _seed(tmp_path, "ops/bad_jit.py", """\
+        import jax
+        import time
+
+        def tick(x):
+            return jax.jit(lambda y: y + 1)(x)
+
+        def build(fns):
+            out = []
+            for f in fns:
+                out.append(jax.jit(f))
+            return out
+
+        def g(x):
+            return x
+
+        g_jit = jax.jit(g)
+
+        def stamp(x):
+            return g_jit(x * time.time())
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "recompiles" in r.stdout  # jit-per-call
+    assert "inside a loop" in r.stdout
+    assert "per-call-varying" in r.stdout
+
+
+def test_recompile_no_double_report_in_loop(tmp_path):
+    """jax.jit(f)(x) inside a loop is ONE finding (per-call), not also
+    an in-loop construction finding for the same call."""
+    _seed(tmp_path, "ops/loop_jit.py", """\
+        import jax
+
+        def drain(xs):
+            out = []
+            for x in xs:
+                out.append(jax.jit(lambda a: a + 1)(x))
+            return out
+    """)
+    r = _analyze_tree(tmp_path)
+    hits = [l for l in r.stdout.splitlines() if "recompile-trigger" in l]
+    assert len(hits) == 1, r.stdout
+    assert "recompiles" in hits[0]
+
+
+# --- metrics-contract -----------------------------------------------------
+
+
+def test_seeded_metrics_contract(tmp_path):
+    _seed(tmp_path, "pkg/metrics/registry.py", """\
+        from prometheus_client import Counter, Gauge
+
+        dead_gauge = Gauge("dead", "declared but never mutated")
+        live = Counter("live", "mutated below")
+
+        def bump():
+            live.inc()
+    """)
+    _seed(tmp_path, "pkg/loop/ctrl.py", """\
+        from pkg.metrics import registry as metrics
+
+        def f():
+            metrics.ghost.inc()
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "dead_gauge" in r.stdout  # declared, never mutated
+    assert "ghost" in r.stdout  # mutated, never declared
+    assert "live" not in r.stdout.replace("live.inc", "")
+
+
+# --- config-contract ------------------------------------------------------
+
+
+def test_seeded_config_contract(tmp_path):
+    _seed(tmp_path, "pkg/utils/config.py", """\
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class ReschedulerConfig:
+            knob_without_flag: int = 3
+            unwired: bool = True
+            wired: bool = True
+    """)
+    _seed(tmp_path, "pkg/cli/main.py", """\
+        import argparse
+
+        def build_parser():
+            p = argparse.ArgumentParser()
+            p.add_argument("--wired", default=True)
+            p.add_argument("--unwired", default=True)
+            p.add_argument("--mystery-flag", default=1)
+            return p
+
+        def config_from_args(args):
+            from pkg.utils.config import ReschedulerConfig
+
+            return ReschedulerConfig(wired=args.wired)
+    """)
+    (tmp_path / "PARITY.md").write_text(
+        "`wired`, `unwired`, and `knob_without_flag` are documented.\n"
+    )
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "knob_without_flag" in r.stdout  # field without flag
+    assert "silently does nothing" in r.stdout  # parsed but unwired
+    assert "--mystery-flag" in r.stdout  # flag without field (warn)
+
+
+def test_config_doc_mention_required(tmp_path):
+    _seed(tmp_path, "pkg/utils/config.py", """\
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class ReschedulerConfig:
+            documented: int = 1
+            undocumented: int = 2
+    """)
+    _seed(tmp_path, "pkg/cli/main.py", """\
+        import argparse
+
+        def build_parser():
+            p = argparse.ArgumentParser()
+            p.add_argument("--documented", default=1)
+            p.add_argument("--undocumented", default=2)
+            return p
+
+        def config_from_args(args):
+            return ReschedulerConfig(
+                documented=args.documented,
+                undocumented=args.undocumented,
+            )
+
+        def ReschedulerConfig(**kw):
+            return kw
+    """)
+    (tmp_path / "PARITY.md").write_text("only `documented` is here\n")
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "undocumented" in r.stdout and "PARITY.md" in r.stdout
+
+
+# --- kube-write-retry -----------------------------------------------------
+
+
+def test_seeded_kube_write_retry(tmp_path):
+    _seed(tmp_path, "io/kube.py", """\
+        class Client:
+            def _read_retrying(self, method, path, timeout=30.0):
+                return b""
+
+            def _request(self, method, path):
+                return self._read_retrying("GET", path, timeout=30)
+
+            def evict_pod(self, path):
+                # a retried write double-fires its side effect
+                return self._read_retrying("POST", path, timeout=30)
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "kube-write-retry" in r.stdout
+    assert "non-'GET'" in r.stdout
+    assert "evict_pod" in r.stdout
+
+
+# --- lock-discipline ------------------------------------------------------
+
+
+def test_seeded_lock_discipline(tmp_path):
+    _seed(tmp_path, "state/shared.py", """\
+        import threading
+
+        class Shared:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def good(self):
+                with self._lock:
+                    self.count += 1
+
+            def outer(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):  # every call site holds the lock
+                self.count += 2
+
+            def apply_locked(self):  # caller-holds-lock convention
+                self.count += 3
+
+            def bad(self):
+                self.count = 5
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    hits = [l for l in r.stdout.splitlines() if "lock-discipline" in l]
+    assert len(hits) == 1, r.stdout
+    assert "Shared.bad" in hits[0]
+
+
+# --- suppressions / noqa grammar ------------------------------------------
+
+
+def test_bare_noqa_is_a_finding(tmp_path):
+    _seed(tmp_path, "mod.py", "x = 1  # noqa\n")
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "bare-noqa" in r.stdout
+
+
+def test_typed_noqa_suppresses_only_named_code(tmp_path):
+    _seed(tmp_path, "solver/suppressed.py", """\
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x.item()  # noqa: jax-host-sync
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "jax-host-sync" not in r.stdout
+    _seed(tmp_path, "solver/wrong_code.py", """\
+        import jax
+
+        @jax.jit
+        def solve2(x):
+            return x.item()  # noqa: lock-discipline
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "jax-host-sync" in r.stdout  # wrong code suppresses nothing
+
+
+def test_unknown_suppression_code_warns(tmp_path):
+    _seed(tmp_path, "mod.py", "x = 1  # noqa: TOTALLY-MADE-UP\n")
+    r = _analyze_tree(tmp_path)
+    assert "unknown-suppression" in r.stdout
+    assert r.returncode == 0  # warn tier
+    assert _analyze_tree(tmp_path, "--strict").returncode == 1
+
+
+def test_no_bare_noqa_in_tree():
+    """Satellite guarantee: every suppression in the repo names a code."""
+    r = _run()
+    assert "bare-noqa" not in r.stdout
+
+
+# --- baseline -------------------------------------------------------------
+
+
+def test_baseline_grandfathers_and_goes_stale(tmp_path):
+    _seed(tmp_path, "solver/bad.py", """\
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x.item()
+    """)
+    parity = tmp_path / "PARITY.md"
+    parity.write_text("")
+    # find the finding's key via --json, grandfather it, rerun
+    r = _run(tmp_path, "--no-baseline", "--parity", parity, "--json")
+    found = json.loads(r.stdout)["findings"]
+    assert found, r.stdout
+    key = (
+        f"{found[0]['path']}::{found[0]['code']}::{found[0]['anchor']}"
+    )
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(f"{key}  # grandfathered for the test\n")
+    r = _run(tmp_path, "--baseline", baseline, "--parity", parity)
+    assert r.returncode == 0, r.stdout
+    assert "1 baselined" in r.stderr
+    # paid debt: entry no longer matches -> stale-baseline warning
+    (tmp_path / "solver" / "bad.py").write_text("x = 1\n")
+    r = _run(tmp_path, "--baseline", baseline, "--parity", parity)
+    assert "stale-baseline" in r.stdout
+    assert r.returncode == 0  # warn tier
+
+
+# --- --json schema --------------------------------------------------------
+
+
+def test_json_output_schema(tmp_path):
+    _seed(tmp_path, "solver/bad.py", """\
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x.item()
+    """)
+    r = _analyze_tree(tmp_path, "--json")
+    out = json.loads(r.stdout)
+    assert out["version"] == 1
+    assert set(out["counts"]) == {"error", "warn", "baselined"}
+    f = out["findings"][0]
+    assert set(f) == {
+        "path", "line", "code", "severity", "message", "anchor",
+    }
+    assert f["code"] == "jax-host-sync"
+    assert f["severity"] == "error"

@@ -1,0 +1,2 @@
+"""Repo tooling: the lint gate (tools/lint.py) and the static-analysis
+suite (tools/analysis/) behind ``make lint`` / ``make analyze``."""

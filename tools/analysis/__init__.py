@@ -1,0 +1,10 @@
+"""Project-wide static analysis suite (the go-vet analog).
+
+``python -m tools.analysis`` from the repo root, or ``make analyze``.
+Passes: JAX hot-path vets (jax-host-sync, donation-discipline,
+recompile-trigger), cross-module contracts (metrics-contract,
+config-contract, kube-write-retry), and the lock-discipline audit.
+Catalogue + policy: docs/ANALYSIS.md.
+"""
+
+from tools.analysis.engine import analyze, main  # noqa: F401

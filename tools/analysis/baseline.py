@@ -1,0 +1,78 @@
+"""Checked-in baseline of grandfathered findings.
+
+Format (tools/analysis/baseline.txt): one entry per line,
+
+    <path>::<code>::<anchor>  # <one-line justification>
+
+The key matches :attr:`Finding.key` — path + code + a stable anchor
+(function/attribute/field name), so entries survive line drift. Blank
+lines and ``#`` comment lines are skipped. Every entry MUST carry a
+justification comment; an entry that no longer matches any finding is
+reported as ``stale-baseline`` (warn) so the file shrinks as debt is
+paid instead of rotting.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from tools.analysis.common import WARN, Finding
+
+
+def load(path) -> Dict[str, Tuple[int, str]]:
+    """key -> (line in baseline file, justification)."""
+    entries: Dict[str, Tuple[int, str]] = {}
+    p = Path(path)
+    if not p.exists():
+        return entries
+    for i, raw in enumerate(p.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, comment = line.partition("#")
+        entries[key.strip()] = (i, comment.strip())
+    return entries
+
+
+def apply(
+    findings: List[Finding],
+    baseline_path,
+    *,
+    analyzed_paths=None,
+    only_pass=None,
+) -> Tuple[List[Finding], List[Finding], List[Finding]]:
+    """Split into (active, baselined, stale-baseline findings).
+
+    An unmatched entry is STALE only when this run could have matched
+    it: its file was among the analyzed paths and its code was among
+    the passes run — a subset-roots or single-pass invocation must not
+    call un-exercised debt 'paid'."""
+    entries = load(baseline_path)
+    active: List[Finding] = []
+    baselined: List[Finding] = []
+    used = set()
+    for f in findings:
+        if f.key in entries:
+            used.add(f.key)
+            baselined.append(f)
+        else:
+            active.append(f)
+    stale: List[Finding] = []
+    for key, (line, _) in sorted(entries.items(), key=lambda kv: kv[1][0]):
+        if key in used:
+            continue
+        parts = key.split("::")
+        entry_path = parts[0] if parts else ""
+        entry_code = parts[1] if len(parts) > 2 else ""
+        if analyzed_paths is not None and entry_path not in analyzed_paths:
+            continue
+        if only_pass is not None and entry_code != only_pass:
+            continue
+        stale.append(Finding(
+            str(baseline_path), line, "stale-baseline",
+            f"baseline entry '{key}' matches no current finding — "
+            "remove it (the debt was paid or the key drifted)",
+            severity=WARN, anchor=key,
+        ))
+    return active, baselined, stale

@@ -1,0 +1,209 @@
+"""Shared machinery of the lint gate and the analysis suite.
+
+One file walker and ONE suppression grammar for both tools, so a
+``# noqa`` comment means the same thing to ``tools/lint.py`` (the
+per-file style gate) and ``tools/analysis`` (the cross-module vet):
+
+- ``# noqa: <code>[, <code>...]`` suppresses exactly the named codes on
+  that line. Codes must be known (a registered lint/analysis code, one
+  of the conventional external aliases below, or an ``F401``-style alias
+  that maps onto a local code) — an unrecognized code is itself a
+  ``unknown-suppression`` finding, because it suppresses nothing and
+  reads as if it did.
+- a bare ``# noqa`` suppresses NOTHING and is an error finding
+  (``bare-noqa``): the bare form would silence every current and future
+  check on the line, which is how grandfathered lines rot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+
+SKIP_DIRS = {".git", "__pycache__", ".claude", "node_modules"}
+
+# The ONE root list both gates walk (tools/lint.py and tools/analysis).
+# Load-bearing: analysis owns the suppression-hygiene findings
+# (bare-noqa / unknown-suppression) for every file lint walks — a root
+# added to one tool and not the other would break that one-defect-
+# one-finding split.
+DEFAULT_ROOTS = [
+    "k8s_spot_rescheduler_tpu", "tests", "tools",
+    "bench.py", "__graft_entry__.py",
+]
+
+# --- severity tiers -------------------------------------------------------
+
+ERROR = "error"  # fails the gate
+WARN = "warn"  # reported; fails only under --strict (or when un-baselined
+#                entries should be triaged — see docs/ANALYSIS.md)
+
+# --- code registry --------------------------------------------------------
+
+LINT_CODES = {
+    "unused-import",
+    "redefinition",
+    "bare-except",
+    "none-compare",
+    "empty-fstring",
+    "mutable-default",
+    "syntax-error",
+    "trailing-space",
+    "tab-indent",
+    "no-final-newline",
+    "crlf",
+}
+
+ANALYSIS_CODES = {
+    "jax-host-sync",
+    "donation-discipline",
+    "recompile-trigger",
+    "metrics-contract",
+    "config-contract",
+    "kube-write-retry",
+    "lock-discipline",
+    "bare-noqa",
+    "unknown-suppression",
+    "stale-baseline",
+}
+
+# Conventional flake8-family codes used as machine-readable annotations in
+# this tree (e.g. ``except Exception:  # noqa: BLE001`` documents a
+# deliberate blind except). They are inert for our own passes unless
+# aliased below, but recognized so they don't read as typos.
+EXTERNAL_CODES = {"BLE001", "E402", "E731"}
+
+# External codes that map onto one of OUR codes: suppressing the alias
+# suppresses the local code too (``# noqa: F401`` keeps working on
+# re-export imports).
+ALIASES = {"F401": "unused-import"}
+
+KNOWN_CODES = LINT_CODES | ANALYSIS_CODES | EXTERNAL_CODES | set(ALIASES)
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    code: str
+    message: str
+    severity: str = ERROR
+    # stable identity for the baseline file: function/attr/field name the
+    # finding anchors to, so entries survive line drift
+    anchor: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.code}::{self.anchor or self.line}"
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "anchor": self.anchor,
+        }
+
+
+# --- file walking ---------------------------------------------------------
+
+
+def iter_py_files(roots):
+    for root in roots:
+        p = Path(root)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+            continue
+        for f in sorted(p.rglob("*.py")):
+            if not any(part in SKIP_DIRS for part in f.parts):
+                yield f
+
+
+# --- suppressions ---------------------------------------------------------
+
+# codes are comma-separated tokens; a space inside a token ends the
+# list, so trailing prose ("# noqa: BLE001 — classified below") cannot
+# merge into a code and silently kill the suppression
+_NOQA_RE = re.compile(
+    r"#\s*noqa"
+    r"(?::\s*(?P<codes>[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*))?",
+    re.I,
+)
+
+
+class Suppressions:
+    """Typed per-line suppressions for one source file.
+
+    Tokenized, not regex-over-lines: only real COMMENT tokens count, so
+    a docstring *talking about* noqa is not a suppression (and not a
+    bare-noqa finding)."""
+
+    def __init__(self, source: str):
+        self.codes_by_line: dict[int, set] = {}
+        self.bare_lines: list[int] = []
+        try:
+            tokens = list(
+                tokenize.generate_tokens(io.StringIO(source).readline)
+            )
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # the lint gate owns syntax errors
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _NOQA_RE.search(tok.string)
+            if not m:
+                continue
+            i = tok.start[0]
+            raw = m.group("codes")
+            if raw is None or not raw.strip():
+                self.bare_lines.append(i)
+                continue
+            codes = {c.strip() for c in raw.split(",") if c.strip()}
+            self.codes_by_line.setdefault(i, set()).update(codes)
+
+    def suppresses(self, line: int, code: str) -> bool:
+        codes = self.codes_by_line.get(line)
+        if not codes:
+            return False
+        if code in codes:
+            return True
+        return any(ALIASES.get(c) == code for c in codes)
+
+    def findings(self, path: str):
+        """bare-noqa / unknown-suppression findings for this file."""
+        out = []
+        for line in self.bare_lines:
+            out.append(Finding(
+                path, line, "bare-noqa",
+                "bare '# noqa' suppresses every current and future check "
+                "on this line; name the code: '# noqa: <code>'",
+                severity=ERROR,
+                anchor=f"L{line}",
+            ))
+        for line, codes in sorted(self.codes_by_line.items()):
+            for code in sorted(codes):
+                if code not in KNOWN_CODES:
+                    out.append(Finding(
+                        path, line, "unknown-suppression",
+                        f"'# noqa: {code}' names no known check "
+                        "(see tools/analysis/common.py KNOWN_CODES); it "
+                        "suppresses nothing",
+                        severity=WARN,
+                        anchor=code,
+                    ))
+        return out
+
+
+def relpath(path, root=None) -> str:
+    """Repo-relative string path for stable report/baseline keys."""
+    p = Path(path)
+    base = Path(root) if root else Path.cwd()
+    try:
+        return p.resolve().relative_to(base.resolve()).as_posix()
+    except ValueError:
+        return p.as_posix()

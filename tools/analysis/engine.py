@@ -1,0 +1,176 @@
+"""Analysis driver: walk → parse once → passes → suppressions → baseline.
+
+The reference gates merges on a fmt + golangci-lint + go vet chain
+(reference Makefile:36-65); ``tools/lint.py`` is the fmt/lint half and
+this engine is the vet half — project-wide passes over one shared parse
+of the package. ``make analyze`` runs it inside ``make check``.
+
+Exit codes: 0 clean (warnings allowed unless --strict), 1 error-tier
+findings, 2 watchdog exceeded (--max-seconds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from tools.analysis import baseline as baseline_mod
+from tools.analysis.common import (
+    DEFAULT_ROOTS,
+    ERROR,
+    Suppressions,
+    iter_py_files,
+    relpath,
+)
+from tools.analysis.passes import ALL_PASSES
+from tools.analysis.symbols import Project
+
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.txt"
+DEFAULT_PARITY = "docs/PARITY.md"
+
+
+def analyze(
+    roots,
+    *,
+    parity_path=DEFAULT_PARITY,
+    baseline_path=DEFAULT_BASELINE,
+    use_baseline=True,
+    only_pass=None,
+):
+    """Run all passes; returns (active, baselined, per-file suppressions
+    findings folded in). Pure — no printing, no exit."""
+    project = Project(Path.cwd())
+    files = {}
+    suppressions = {}
+    for path in iter_py_files(roots):
+        try:
+            source = path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            continue
+        files[str(path)] = source
+        suppressions[str(path)] = Suppressions(source)
+        project.add_file(path, source)
+
+    parity = Path(parity_path)
+    if parity.exists():
+        files["__parity__"] = parity.read_text(
+            encoding="utf-8", errors="replace"
+        )
+
+    findings = []
+    for name, run in ALL_PASSES:
+        if only_pass and name != only_pass:
+            continue
+        findings.extend(run(project, files))
+
+    # suppression hygiene findings (bare-noqa / unknown-suppression)
+    if only_pass in (None, "suppressions"):
+        for path, supp in suppressions.items():
+            findings.extend(supp.findings(relpath(path)))
+
+    # apply typed per-line suppressions
+    kept = []
+    for f in findings:
+        supp = None
+        for path, s in suppressions.items():
+            if relpath(path) == f.path or path == f.path:
+                supp = s
+                break
+        if supp is not None and supp.suppresses(f.line, f.code):
+            continue
+        kept.append(f)
+
+    if use_baseline:
+        active, baselined, stale = baseline_mod.apply(
+            kept, baseline_path,
+            # staleness is judged per entry, only against what this run
+            # exercised (files analyzed, passes run) — a subset-roots or
+            # --pass invocation must not call un-exercised debt 'paid'
+            analyzed_paths={
+                relpath(p) for p in files if p != "__parity__"
+            },
+            only_pass=only_pass,
+        )
+        active.extend(stale)
+    else:
+        active, baselined = kept, []
+    active.sort(key=lambda f: (f.path, f.line, f.code))
+    return active, baselined
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.analysis",
+        description="project-wide static analysis (vet analog)",
+    )
+    p.add_argument("roots", nargs="*", default=None,
+                   help=f"files/dirs to analyze (default: {DEFAULT_ROOTS})")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings (schema in "
+                        "docs/ANALYSIS.md)")
+    p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                   help="baseline file of grandfathered findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything)")
+    p.add_argument("--parity", default=DEFAULT_PARITY,
+                   help="PARITY.md path for the config-contract doc check")
+    p.add_argument("--strict", action="store_true",
+                   help="warn-tier findings also fail the gate")
+    p.add_argument("--pass", dest="only_pass", default=None,
+                   choices=[name for name, _ in ALL_PASSES]
+                   + ["suppressions"],
+                   help="run a single pass by code name (a typo must "
+                        "error, not report a vacuously clean tree)")
+    p.add_argument("--max-seconds", type=float, default=0.0,
+                   help="watchdog: exit 2 if the run exceeds this "
+                        "(keeps 'make check' fast)")
+    args = p.parse_args(argv)
+
+    t0 = time.perf_counter()
+    active, baselined = analyze(
+        args.roots or DEFAULT_ROOTS,
+        parity_path=args.parity,
+        baseline_path=args.baseline,
+        use_baseline=not args.no_baseline,
+        only_pass=args.only_pass,
+    )
+    elapsed = time.perf_counter() - t0
+
+    errors = [f for f in active if f.severity == ERROR]
+    warns = [f for f in active if f.severity != ERROR]
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "elapsed_seconds": round(elapsed, 3),
+            "findings": [f.as_dict() for f in active],
+            "counts": {
+                "error": len(errors),
+                "warn": len(warns),
+                "baselined": len(baselined),
+            },
+        }, indent=2))
+    else:
+        for f in active:
+            print(f"{f.path}:{f.line}: [{f.severity}] {f.code} {f.message}")
+        if active or baselined:
+            print(
+                f"{len(errors)} error(s), {len(warns)} warning(s), "
+                f"{len(baselined)} baselined",
+                file=sys.stderr,
+            )
+
+    if args.max_seconds and elapsed > args.max_seconds:
+        print(
+            f"analysis watchdog: {elapsed:.1f}s exceeds the "
+            f"{args.max_seconds:.0f}s budget — 'make check' must stay "
+            "fast; profile or split the slow pass",
+            file=sys.stderr,
+        )
+        return 2
+    if errors or (args.strict and warns):
+        return 1
+    return 0

@@ -1,0 +1,18 @@
+"""Analysis passes. Each exposes ``run(project, files) -> [Finding]``.
+
+``project`` is the package-wide symbol table / call graph
+(tools/analysis/symbols.Project); ``files`` maps path -> source text for
+every analyzed file. Pass registration lives in tools/analysis/engine.py.
+"""
+
+from tools.analysis.passes import contracts, hotpath, locks  # noqa: F401
+
+ALL_PASSES = (
+    ("jax-host-sync", hotpath.run_host_sync),
+    ("donation-discipline", hotpath.run_donation),
+    ("recompile-trigger", hotpath.run_recompile),
+    ("metrics-contract", contracts.run_metrics),
+    ("config-contract", contracts.run_config),
+    ("kube-write-retry", contracts.run_kube_writes),
+    ("lock-discipline", locks.run),
+)

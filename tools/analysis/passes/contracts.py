@@ -1,0 +1,344 @@
+"""Cross-module contract passes.
+
+These encode invariants that no per-file linter can see — the quartets
+and pairs of modules that must stay in lockstep:
+
+``metrics-contract``
+    Every Prometheus series mutated anywhere in the package is declared
+    in metrics/registry.py, and every declared series is mutated
+    somewhere (a declared-but-dead gauge is a dashboard lying in wait).
+
+``config-contract``
+    Every ``ReschedulerConfig`` field has a matching ``--kebab-case``
+    flag in cli/main.py, that flag is actually wired through
+    ``config_from_args`` into the dataclass, and the field is mentioned
+    in docs/PARITY.md. Flags with no config field must be declared
+    runtime-only (RUNTIME_ONLY_FLAGS below) or they warn.
+
+``kube-write-retry``
+    Write verbs in io/kube.py stay single-attempt: only the designated
+    wrappers may call the retrying ``_read_retrying`` path, and always
+    with a literal "GET" (the actuator owns eviction/taint cadence;
+    a retried write would double-fire side effects).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.analysis.common import ERROR, WARN, Finding, relpath
+from tools.analysis.symbols import Project, dotted
+
+# ---------------------------------------------------------------------------
+# metrics-contract
+
+_METRIC_TYPES = {"Counter", "Gauge", "Histogram", "Summary"}
+_MUTATORS = {"inc", "dec", "set", "observe"}
+
+
+def _find_module(project: Project, suffix: str):
+    for mod in project.modules.values():
+        if relpath(mod.path).endswith(suffix):
+            return mod
+    return None
+
+
+def _registry_aliases(mod) -> Set[str]:
+    """Local names this module binds the metrics registry module to."""
+    out = set()
+    for bound, imp in mod.imports.items():
+        target = imp[1] if imp[0] == "module" else f"{imp[1]}.{imp[2]}"
+        if target.endswith("metrics.registry") or target.endswith(
+            ".registry"
+        ):
+            out.add(bound)
+    return out
+
+
+def _mutation_base(node: ast.Call) -> Optional[ast.AST]:
+    """For ``X[.labels(...)].inc/.set/.observe(...)`` return X, else None."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    if node.func.attr not in _MUTATORS:
+        return None
+    base = node.func.value
+    if (
+        isinstance(base, ast.Call)
+        and isinstance(base.func, ast.Attribute)
+        and base.func.attr == "labels"
+    ):
+        base = base.func.value
+    return base
+
+
+def run_metrics(project: Project, files) -> List[Finding]:
+    registry = _find_module(project, "metrics/registry.py")
+    if registry is None:
+        return []
+    findings: List[Finding] = []
+    reg_path = relpath(registry.path)
+
+    declared: Dict[str, int] = {}  # metric var -> decl line
+    locals_in_reg: Set[str] = set()
+    for node in registry.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor and ctor.split(".")[-1] in _METRIC_TYPES:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        declared[tgt.id] = node.lineno
+    # names bound locally inside registry functions (params, locals) are
+    # not metrics even if .set() is called on them
+    for info in registry.functions.values():
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                locals_in_reg.add(n.id)
+            if isinstance(n, ast.arg):
+                locals_in_reg.add(n.arg)
+
+    mutated: Set[str] = set()
+
+    # inside registry.py: bare-name mutations
+    for node in ast.walk(registry.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        base = _mutation_base(node)
+        if isinstance(base, ast.Name):
+            if base.id in declared:
+                mutated.add(base.id)
+            elif base.id not in locals_in_reg:
+                findings.append(Finding(
+                    reg_path, node.lineno, "metrics-contract",
+                    f"'{base.id}' is mutated like a metric but never "
+                    "declared in metrics/registry.py",
+                    severity=ERROR, anchor=base.id,
+                ))
+
+    # everywhere else: alias.X mutations
+    for mod in project.modules.values():
+        if mod is registry:
+            continue
+        aliases = _registry_aliases(mod)
+        if not aliases:
+            continue
+        path = relpath(mod.path)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base = _mutation_base(node)
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id in aliases
+            ):
+                if base.attr in declared:
+                    mutated.add(base.attr)
+                else:
+                    findings.append(Finding(
+                        path, node.lineno, "metrics-contract",
+                        f"'{base.attr}' is mutated through the metrics "
+                        "registry but not declared in "
+                        "metrics/registry.py",
+                        severity=ERROR, anchor=base.attr,
+                    ))
+
+    for name, line in sorted(declared.items()):
+        if name not in mutated:
+            findings.append(Finding(
+                reg_path, line, "metrics-contract",
+                f"metric '{name}' is declared but never mutated anywhere "
+                "in the package — dead series (or the updater was lost "
+                "in a refactor)",
+                severity=ERROR, anchor=name,
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# config-contract
+
+# Flags that deliberately have no ReschedulerConfig field: process-level
+# runtime selectors, not rescheduler policy (each justified in
+# docs/ANALYSIS.md).
+RUNTIME_ONLY_FLAGS = {
+    "--version",
+    "--verbosity",
+    "--cluster",
+    "--ticks",
+    "--no-metrics-server",
+    "--trace-dir",
+    "--leader-elect",
+    "--leader-elect-namespace",
+    "--leader-elect-identity",
+    "--leader-elect-lease-duration",
+    "--watch-cache",
+}
+
+
+def _config_fields(mod) -> Dict[str, int]:
+    for cls in mod.classes.values():
+        if cls.name != "ReschedulerConfig":
+            continue
+        out = {}
+        for node in cls.body:
+            if isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                if not node.target.id.startswith("_"):
+                    out[node.target.id] = node.lineno
+        return out
+    return {}
+
+
+def _cli_surface(mod):
+    """(flags {'--x': line}, wired field kwargs in config_from_args)."""
+    flags: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+        ):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    if arg.value.startswith("--"):
+                        flags[arg.value] = node.lineno
+    wired: Set[str] = set()
+    fn = mod.functions.get("config_from_args")
+    if fn is not None:
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call) and dotted(node.func) in (
+                "ReschedulerConfig",
+            ):
+                wired = {kw.arg for kw in node.keywords if kw.arg}
+    return flags, wired
+
+
+def run_config(project: Project, files) -> List[Finding]:
+    cfg_mod = _find_module(project, "utils/config.py")
+    cli_mod = _find_module(project, "cli/main.py")
+    if cfg_mod is None or cli_mod is None:
+        return []
+    findings: List[Finding] = []
+    fields = _config_fields(cfg_mod)
+    if not fields:
+        return []
+    flags, wired = _cli_surface(cli_mod)
+    cfg_path, cli_path = relpath(cfg_mod.path), relpath(cli_mod.path)
+
+    parity_text = ""
+    parity = files.get("__parity__")
+    if parity is not None:
+        parity_text = parity
+
+    for field, line in sorted(fields.items()):
+        flag = "--" + field.replace("_", "-")
+        if flag not in flags:
+            findings.append(Finding(
+                cfg_path, line, "config-contract",
+                f"ReschedulerConfig.{field} has no matching '{flag}' "
+                "flag in cli/main.py — the knob exists but an operator "
+                "cannot turn it",
+                severity=ERROR, anchor=field,
+            ))
+        elif field not in wired:
+            findings.append(Finding(
+                cli_path, flags[flag], "config-contract",
+                f"flag '{flag}' is parsed but config_from_args never "
+                f"passes '{field}' into ReschedulerConfig — the flag "
+                "silently does nothing",
+                severity=ERROR, anchor=field,
+            ))
+        if parity is not None and (
+            field not in parity_text and flag not in parity_text
+        ):
+            findings.append(Finding(
+                cfg_path, line, "config-contract",
+                f"ReschedulerConfig.{field} is not mentioned in "
+                "docs/PARITY.md (config-surface section)",
+                severity=ERROR, anchor=f"doc.{field}",
+            ))
+
+    field_flags = {
+        "--" + f.replace("_", "-") for f in fields
+    }
+    for flag, line in sorted(flags.items()):
+        if flag in field_flags or flag in RUNTIME_ONLY_FLAGS:
+            continue
+        findings.append(Finding(
+            cli_path, line, "config-contract",
+            f"flag '{flag}' maps to no ReschedulerConfig field and is "
+            "not declared runtime-only (RUNTIME_ONLY_FLAGS)",
+            severity=WARN, anchor=flag,
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# kube-write-retry
+
+# functions in io/kube.py allowed to call the retrying read path
+_RETRY_WRAPPERS = {"_request", "_request_raw"}
+
+
+def run_kube_writes(project: Project, files) -> List[Finding]:
+    kube = _find_module(project, "io/kube.py")
+    if kube is None:
+        return []
+    findings: List[Finding] = []
+    path = relpath(kube.path)
+    for info in kube.functions.values():
+        fname = info.name
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func)
+            if callee and callee.endswith("._read_retrying"):
+                if fname not in _RETRY_WRAPPERS:
+                    findings.append(Finding(
+                        path, node.lineno, "kube-write-retry",
+                        f"'{fname}' calls _read_retrying directly; only "
+                        f"{sorted(_RETRY_WRAPPERS)} may route through "
+                        "the retry loop (write verbs are single-attempt "
+                        "by design)",
+                        severity=ERROR, anchor=fname,
+                    ))
+                if node.args:
+                    m = node.args[0]
+                    if not (
+                        isinstance(m, ast.Constant) and m.value == "GET"
+                    ):
+                        findings.append(Finding(
+                            path, node.lineno, "kube-write-retry",
+                            "_read_retrying called with a non-'GET' "
+                            "method — a retried write double-fires its "
+                            "side effect (evict/taint) on a timeout "
+                            "whose request actually landed",
+                            severity=ERROR, anchor=f"{fname}.method",
+                        ))
+            # explicit retries=True on a write verb through _request
+            if callee and callee.endswith("._request") and node.args:
+                m = node.args[0]
+                if (
+                    isinstance(m, ast.Constant)
+                    and isinstance(m.value, str)
+                    and m.value != "GET"
+                ):
+                    for kw in node.keywords:
+                        if (
+                            kw.arg == "retries"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is True
+                        ):
+                            findings.append(Finding(
+                                path, node.lineno, "kube-write-retry",
+                                f"_request('{m.value}', ...) asks for "
+                                "retries on a write verb — writes are "
+                                "single-attempt (the actuator owns "
+                                "their cadence)",
+                                severity=ERROR, anchor=f"{fname}.retries",
+                            ))
+    return findings

@@ -1,0 +1,544 @@
+"""JAX hot-path vet passes (the go-vet analog for the solver).
+
+Scope: files under solver/, ops/, parallel/, planner/ — the modules whose
+code runs (or builds code that runs) inside ``jax.jit`` / ``pjit`` /
+``shard_map`` programs. Three passes share the jit-reachability analysis:
+
+``jax-host-sync``
+    A host synchronization inside traced code re-serializes the whole
+    tick (the device pipeline drains, the host blocks on the transfer).
+    Flags ``.item()``, ``.block_until_ready()``, ``np.asarray``/
+    ``np.array``, and ``print`` in any function reachable from a jitted
+    root (error), plus ``float()``/``int()`` on non-literals (warn — the
+    AST cannot prove the operand is a traced array, but on the hot path
+    they usually are).
+
+``donation-discipline``
+    An argument donated via ``donate_argnums`` is dead after the call —
+    its buffer was aliased into the output. Reading it afterwards in the
+    caller returns garbage (or raises, backend-dependent). Flags reads of
+    a donated name/attribute after the donating call, before any rebind.
+
+``recompile-trigger``
+    Work that silently retraces per call: ``jax.jit(...)(...)`` built and
+    invoked in one expression, jit/shard_map construction inside a loop,
+    and per-call-varying scalars (``time.time()`` etc.) flowing into a
+    jitted call's arguments.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.analysis.common import ERROR, WARN, Finding, relpath
+from tools.analysis.symbols import (
+    FunctionInfo,
+    Project,
+    dotted,
+    parent_map,
+)
+
+SCOPE_DIRS = ("solver", "ops", "parallel", "planner")
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_SHARD_NAMES = {"shard_map", "jax.shard_map"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_VARYING_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "random.random", "random.randint", "random.uniform",
+    "uuid.uuid4", "datetime.datetime.now", "datetime.now",
+}
+
+
+def in_scope(path: str) -> bool:
+    parts = relpath(path).split("/")
+    return any(d in parts for d in SCOPE_DIRS)
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``pjit(...)`` / ``shard_map(...)`` call node."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    return name in _JIT_NAMES or name in _SHARD_NAMES
+
+
+def _partial_jit_decorator(dec: ast.AST) -> bool:
+    """``@functools.partial(jax.jit, ...)`` shape."""
+    if not isinstance(dec, ast.Call):
+        return False
+    if dotted(dec.func) not in _PARTIAL_NAMES:
+        return False
+    return bool(dec.args) and dotted(dec.args[0]) in _JIT_NAMES
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        if dotted(dec) in _JIT_NAMES | _SHARD_NAMES:
+            return True
+        if isinstance(dec, ast.Call) and dotted(dec.func) in (
+            _JIT_NAMES | _SHARD_NAMES
+        ):
+            return True
+        if _partial_jit_decorator(dec):
+            return True
+    return False
+
+
+def _first_function_ref(project: Project, mod, arg, scope):
+    """The analyzed function an argument expression refers to, unwrapping
+    ``functools.partial(f, ...)``."""
+    if isinstance(arg, ast.Call) and dotted(arg.func) in _PARTIAL_NAMES:
+        if arg.args:
+            return _first_function_ref(project, mod, arg.args[0], scope)
+        return None
+    if isinstance(arg, (ast.Name, ast.Attribute)):
+        return project.resolve_call(mod, arg, scope)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# reachability
+
+
+def jit_reachable(project: Project) -> Set[FunctionInfo]:
+    """Functions reachable from any jit/pjit/shard_map root."""
+    roots: List[FunctionInfo] = []
+    edges: Dict[FunctionInfo, Set[FunctionInfo]] = {}
+
+    for mod in project.modules.values():
+        parents = parent_map(mod.tree)
+        # decorated roots
+        for info in mod.functions.values():
+            if _jit_decorated(info.node):
+                roots.append(info)
+        # jax.jit(f, ...) / shard_map(f, ...) reference roots
+        for node in ast.walk(mod.tree):
+            if _is_jit_call(node):
+                from tools.analysis.symbols import function_scope_of
+
+                scope = function_scope_of(mod, node, parents)
+                for arg in node.args[:1]:
+                    target = _first_function_ref(project, mod, arg, scope)
+                    if target is not None:
+                        roots.append(target)
+        # call edges + function-reference-argument edges + nesting edges
+        for info in mod.functions.values():
+            out = edges.setdefault(info, set())
+            if info.parent is not None:
+                edges.setdefault(info.parent, set()).add(info)
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = project.resolve_call(mod, node.func, info)
+                if callee is not None:
+                    out.add(callee)
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    ref = _first_function_ref(project, mod, arg, info)
+                    if ref is not None:
+                        out.add(ref)
+
+    seen: Set[FunctionInfo] = set()
+    stack = list(roots)
+    while stack:
+        fn = stack.pop()
+        if fn in seen:
+            continue
+        seen.add(fn)
+        stack.extend(edges.get(fn, ()))
+    return seen
+
+
+def _static_param_names(project: Project) -> Dict[FunctionInfo, Set[str]]:
+    """Param names marked static at a function's jit site
+    (static_argnames / static_argnums): plain Python values at trace
+    time, so host conversions on them are legal."""
+
+    def names_from(call: ast.Call, target: FunctionInfo) -> Set[str]:
+        out: Set[str] = set()
+        a = target.node.args
+        params = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        for kw in call.keywords:
+            vals = []
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                vals = [
+                    e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)
+                ]
+            elif isinstance(kw.value, ast.Constant):
+                vals = [kw.value.value]
+            if kw.arg == "static_argnames":
+                out.update(v for v in vals if isinstance(v, str))
+            elif kw.arg == "static_argnums":
+                for v in vals:
+                    if isinstance(v, int) and v < len(params):
+                        out.add(params[v])
+        return out
+
+    statics: Dict[FunctionInfo, Set[str]] = {}
+    for mod in project.modules.values():
+        parents = parent_map(mod.tree)
+        for node in ast.walk(mod.tree):
+            if _is_jit_call(node) and node.args:
+                from tools.analysis.symbols import function_scope_of
+
+                scope = function_scope_of(mod, node, parents)
+                target = _first_function_ref(
+                    project, mod, node.args[0], scope
+                )
+                if target is not None:
+                    statics.setdefault(target, set()).update(
+                        names_from(node, target)
+                    )
+        for info in mod.functions.values():
+            for dec in info.node.decorator_list:
+                # @functools.partial(jax.jit, static_argnames=...) and
+                # the direct @jax.jit(static_argnames=...) form alike
+                if _partial_jit_decorator(dec) or (
+                    isinstance(dec, ast.Call)
+                    and dotted(dec.func) in _JIT_NAMES | _SHARD_NAMES
+                ):
+                    statics.setdefault(info, set()).update(
+                        names_from(dec, info)
+                    )
+    return statics
+
+
+def _numpy_aliases(mod) -> Set[str]:
+    out = set()
+    for bound, imp in mod.imports.items():
+        if imp[0] == "module" and imp[1] == "numpy":
+            out.add(bound)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass: jax-host-sync
+
+
+def _walk_own(fn_node):
+    """Walk a function's body WITHOUT descending into nested defs — each
+    reachable nested def is its own host-sync entry, so visiting it here
+    would double-report (and pruning must not mutate the shared AST)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def run_host_sync(project: Project, files) -> List[Finding]:
+    findings: List[Finding] = []
+    reachable = jit_reachable(project)
+    statics = _static_param_names(project)
+    for info in reachable:
+        if not in_scope(info.path):
+            continue
+        mod = info.module
+        np_names = _numpy_aliases(mod)
+        path = relpath(info.path)
+        for node in _walk_own(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "item" and not node.args:
+                    findings.append(Finding(
+                        path, node.lineno, "jax-host-sync",
+                        f".item() inside jit-reachable '{info.name}' "
+                        "blocks on a device->host transfer; keep the "
+                        "value traced (or fetch once, outside jit)",
+                        severity=ERROR, anchor=f"{info.name}.item.L{node.lineno}",
+                    ))
+                elif node.func.attr == "block_until_ready":
+                    findings.append(Finding(
+                        path, node.lineno, "jax-host-sync",
+                        f".block_until_ready() inside jit-reachable "
+                        f"'{info.name}' serializes the device pipeline",
+                        severity=ERROR, anchor=f"{info.name}.block.L{node.lineno}",
+                    ))
+                elif name and name.split(".", 1)[0] in np_names and (
+                    name.endswith(".asarray") or name.endswith(".array")
+                ):
+                    findings.append(Finding(
+                        path, node.lineno, "jax-host-sync",
+                        f"numpy {name}() inside jit-reachable "
+                        f"'{info.name}' forces a host round trip; use "
+                        "jnp equivalents in traced code",
+                        severity=ERROR, anchor=f"{info.name}.np.L{node.lineno}",
+                    ))
+            elif isinstance(node.func, ast.Name):
+                if node.func.id == "print":
+                    findings.append(Finding(
+                        path, node.lineno, "jax-host-sync",
+                        f"print() inside jit-reachable '{info.name}' "
+                        "syncs its operands to host per call; use "
+                        "jax.debug.print for traced values",
+                        severity=ERROR, anchor=f"{info.name}.print.L{node.lineno}",
+                    ))
+                elif node.func.id in ("float", "int") and node.args:
+                    arg = node.args[0]
+                    is_static = isinstance(arg, ast.Name) and arg.id in (
+                        statics.get(info, ())
+                    )
+                    if not isinstance(arg, ast.Constant) and not is_static:
+                        findings.append(Finding(
+                            path, node.lineno, "jax-host-sync",
+                            f"{node.func.id}() on a non-literal inside "
+                            f"jit-reachable '{info.name}' concretizes "
+                            "(host sync) if the operand is traced",
+                            severity=WARN,
+                            anchor=f"{info.name}.{node.func.id}.L{node.lineno}",
+                        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass: donation-discipline
+
+
+def _donate_positions(call: ast.Call) -> Optional[Set[int]]:
+    """The donated positional indices of a jax.jit call, or None if the
+    call has no donate_argnums. An unresolvable spec donates everything
+    (empty set sentinel is avoided; None means 'not donating')."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = set()
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, int
+                ):
+                    out.add(elt.value)
+            return out
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        # tuple(range(N)) — the donated-scatter pattern in
+        # planner/solver_planner.py
+        if (
+            isinstance(v, ast.Call)
+            and dotted(v.func) == "tuple"
+            and len(v.args) == 1
+            and isinstance(v.args[0], ast.Call)
+            and dotted(v.args[0].func) == "range"
+            and len(v.args[0].args) == 1
+            and isinstance(v.args[0].args[0], ast.Constant)
+            and isinstance(v.args[0].args[0].value, int)
+        ):
+            return set(range(v.args[0].args[0].value))
+        # any other spec is unresolvable statically: skip the call site
+        # (costs recall, never a false error-tier finding)
+        return None
+    return None
+
+
+class _DonatedDef:
+    def __init__(self, name: str, positions: Set[int]):
+        self.name = name
+        self.positions = positions
+
+
+def _collect_donating(mod) -> Dict[str, _DonatedDef]:
+    """name -> donated positions, for names bound to donating jits in this
+    module (module-level or self attributes), plus factory methods whose
+    return value is a donating jit."""
+    out: Dict[str, _DonatedDef] = {}
+    for node in ast.walk(mod.tree):
+        # name = jax.jit(f, donate_argnums=...)
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            pos = _donate_positions(node.value)
+            if pos is None:
+                continue
+            for tgt in node.targets:
+                name = dotted(tgt)
+                if name:
+                    out[name] = _DonatedDef(name, pos)
+        # @functools.partial(jax.jit, donate_argnums=...) def f(...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _partial_jit_decorator(dec):
+                    pos = _donate_positions(dec)
+                    if pos is not None:
+                        out[node.name] = _DonatedDef(node.name, pos)
+    # factories: def m(self): ... return <donated local>
+    for info in mod.functions.values():
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                rname = dotted(node.value)
+                if rname in out and info.cls:
+                    out[f"self.{info.name}()"] = out[rname]
+    return out
+
+
+def _donated_exprs(call: ast.Call, positions: Set[int]) -> List[str]:
+    """Dotted names of the donated argument expressions at a call site."""
+    names = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            # *xs covers this position onward: donated if any donated
+            # position is >= i
+            if any(p >= i for p in positions):
+                n = dotted(arg.value)
+                if n:
+                    names.append(n)
+            continue
+        if i in positions:
+            n = dotted(arg)
+            if n:
+                names.append(n)
+    return names
+
+
+def run_donation(project: Project, files) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        if not in_scope(mod.path):
+            continue
+        donating = _collect_donating(mod)
+        if not donating:
+            continue
+        path = relpath(mod.path)
+        for info in mod.functions.values():
+            # own body only: a nested def is its own entry, and walking
+            # it under the parent would misscope _read_after to the
+            # parent's (possibly shadowed) bindings and double-report
+            for node in _walk_own(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted(node.func)
+                ddef = donating.get(fname) if fname else None
+                if ddef is None and isinstance(node.func, ast.Call):
+                    inner = dotted(node.func.func)
+                    if inner and f"{inner}()" in donating:
+                        ddef = donating[f"{inner}()"]
+                if ddef is None:
+                    continue
+                for donated in _donated_exprs(node, ddef.positions):
+                    viol = _read_after(
+                        info.node, donated, node.lineno,
+                        node.end_lineno or node.lineno,
+                    )
+                    if viol is not None:
+                        findings.append(Finding(
+                            path, viol, "donation-discipline",
+                            f"'{donated}' was donated to the jit call at "
+                            f"line {node.lineno} (donate_argnums) and is "
+                            "read afterwards in "
+                            f"'{info.name}' — the buffer was consumed; "
+                            "rebind before reuse",
+                            severity=ERROR,
+                            anchor=f"{info.name}.{donated}.L{viol}",
+                        ))
+    return findings
+
+
+def _read_after(
+    fn_node, name: str, call_start: int, call_end: int
+) -> Optional[int]:
+    """First line past the (possibly multi-line) donating call where
+    ``name`` is read before any rebind. The donated argument's own Load
+    sits inside [call_start, call_end] and must not count as a read."""
+    stores: List[int] = []
+    loads: List[int] = []
+    for node in ast.walk(fn_node):
+        n = dotted(node) if isinstance(node, (ast.Name, ast.Attribute)) else None
+        if n != name:
+            continue
+        ctx = getattr(node, "ctx", None)
+        if isinstance(ctx, (ast.Store, ast.Del)):
+            stores.append(node.lineno)
+        elif isinstance(ctx, ast.Load) and node.lineno > call_end:
+            loads.append(node.lineno)
+    for load in sorted(loads):
+        # a store anywhere in the call statement is the result
+        # assignment (``a = g(a)``): it rebinds the name after donation
+        if not any(call_start <= s <= load for s in stores):
+            return load
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pass: recompile-trigger
+
+
+def run_recompile(project: Project, files) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        if not in_scope(mod.path):
+            continue
+        path = relpath(mod.path)
+        donating = _collect_donating(mod)
+        jitted_names = set(donating)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+                for tgt in node.targets:
+                    n = dotted(tgt)
+                    if n:
+                        jitted_names.add(n)
+        # jit calls that are immediately invoked: reported once by the
+        # per-call check, so the in-loop check must not re-report them
+        invoked_jits = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_jit_call(node.func):
+                invoked_jits.add(node.func)
+        for node in ast.walk(mod.tree):
+            # jax.jit(f)(x): traced, compiled, and thrown away per call
+            if isinstance(node, ast.Call) and _is_jit_call(node.func):
+                findings.append(Finding(
+                    path, node.lineno, "recompile-trigger",
+                    "jit program built and invoked in one expression — "
+                    "it recompiles (or at best re-hashes) every call; "
+                    "bind the jitted callable once and reuse it",
+                    severity=ERROR, anchor=f"L{node.lineno}.per-call",
+                ))
+            # jit/shard_map constructed inside a loop
+            if isinstance(node, (ast.For, ast.While)):
+                for sub in ast.walk(node):
+                    if sub is node or sub in invoked_jits:
+                        continue
+                    if _is_jit_call(sub):
+                        findings.append(Finding(
+                            path, sub.lineno, "recompile-trigger",
+                            "jit/shard_map constructed inside a loop — "
+                            "each iteration builds a fresh program and "
+                            "its own compile-cache entry",
+                            severity=ERROR, anchor=f"L{sub.lineno}.in-loop",
+                        ))
+            # per-call-varying scalars into a jitted call
+            if isinstance(node, ast.Call):
+                fname = dotted(node.func)
+                if fname in jitted_names:
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        for sub in ast.walk(arg):
+                            if (
+                                isinstance(sub, ast.Call)
+                                and dotted(sub.func) in _VARYING_CALLS
+                            ):
+                                findings.append(Finding(
+                                    path, node.lineno, "recompile-trigger",
+                                    f"per-call-varying scalar "
+                                    f"({dotted(sub.func)}()) flows into "
+                                    f"jitted '{fname}' — every distinct "
+                                    "value retraces; pass it as a traced "
+                                    "array or hoist it out",
+                                    severity=ERROR,
+                                    anchor=f"{fname}.varying.L{node.lineno}",
+                                ))
+    # dedupe in-loop findings that also matched per-call
+    seen = set()
+    out = []
+    for f in findings:
+        k = (f.path, f.line, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

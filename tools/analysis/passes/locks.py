@@ -1,0 +1,209 @@
+"""Lock-discipline audit.
+
+For every class that owns a ``threading.Lock``/``RLock``/``Condition``
+(assigned to ``self.<x>`` anywhere in the class), the attributes that
+class protects must only be MUTATED under that protection. "Protected"
+is inferred, not annotated: any attribute written inside a
+``with self.<lock>:`` block (outside ``__init__``) is treated as
+lock-guarded, and every other write to it must then also hold a lock.
+
+A write counts as lock-held when it is
+
+- lexically inside a ``with self.<lock>:`` body,
+- in a function that called ``self.<lock>.acquire(...)`` earlier
+  (the try/finally acquire-release idiom), or
+- in a method whose *every* intra-class call site is lock-held
+  (computed to fixpoint), or whose name ends in ``_locked``/
+  ``_unlocked`` — the caller-holds-the-lock convention.
+
+``__init__`` is exempt: construction happens-before publication.
+Reads are deliberately out of scope (the codebase's stores use
+copy-on-read snapshots; racing reads are a different, weaker contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analysis.common import ERROR, Finding, relpath
+from tools.analysis.symbols import Project, dotted
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+_HELD_SUFFIXES = ("_locked", "_unlocked")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _MethodFacts:
+    def __init__(self, name: str, node: ast.AST):
+        self.name = name
+        self.node = node
+        # (attr, line) writes partitioned by lock context
+        self.locked_writes: List[Tuple[str, int]] = []
+        self.unlocked_writes: List[Tuple[str, int]] = []
+        # intra-class calls: (callee method name, in_lock_context)
+        self.calls: List[Tuple[str, bool]] = []
+        self.acquires_lock = False
+
+
+def _with_holds_lock(item: ast.withitem, lock_attrs: Set[str]) -> bool:
+    expr = item.context_expr
+    attr = _self_attr(expr)
+    if attr in lock_attrs:
+        return True
+    # with self._lock.acquire_timeout(...) style / cond variables
+    if isinstance(expr, ast.Call):
+        base = _self_attr(expr.func.value) if isinstance(
+            expr.func, ast.Attribute
+        ) else None
+        if base in lock_attrs:
+            return True
+    return False
+
+
+def _collect_method(
+    method: ast.AST, lock_attrs: Set[str]
+) -> _MethodFacts:
+    facts = _MethodFacts(method.name, method)
+
+    def walk(node: ast.AST, in_lock: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs audited separately if methods
+            child_lock = in_lock
+            if isinstance(child, ast.With):
+                if any(
+                    _with_holds_lock(i, lock_attrs) for i in child.items
+                ):
+                    child_lock = True
+            if isinstance(child, ast.Call):
+                cal = dotted(child.func)
+                if cal and cal.startswith("self."):
+                    parts = cal.split(".")
+                    if len(parts) == 3 and parts[1] in lock_attrs:
+                        if parts[2] == "acquire":
+                            facts.acquires_lock = True
+                    elif len(parts) == 2:
+                        facts.calls.append((parts[1], in_lock))
+            if isinstance(child, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    child.targets
+                    if isinstance(child, ast.Assign)
+                    else [child.target]
+                )
+                for tgt in targets:
+                    for sub in ast.walk(tgt):
+                        attr = _self_attr(sub)
+                        if attr is None or attr in lock_attrs:
+                            continue
+                        if not isinstance(
+                            getattr(sub, "ctx", None), ast.Store
+                        ):
+                            continue
+                        bucket = (
+                            facts.locked_writes
+                            if child_lock or facts.acquires_lock
+                            else facts.unlocked_writes
+                        )
+                        bucket.append((attr, sub.lineno))
+            walk(child, child_lock)
+
+    walk(method, False)
+    return facts
+
+
+def run(project: Project, files) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        path = relpath(mod.path)
+        for cls in mod.classes.values():
+            # lock attributes of this class
+            lock_attrs: Set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    if dotted(node.value.func) in _LOCK_CTORS:
+                        for tgt in node.targets:
+                            attr = _self_attr(tgt)
+                            if attr:
+                                lock_attrs.add(attr)
+            if not lock_attrs:
+                continue
+
+            methods: Dict[str, _MethodFacts] = {}
+            for node in cls.body:
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    methods[node.name] = _collect_method(node, lock_attrs)
+
+            # guarded attributes: written under a lock anywhere (not
+            # __init__)
+            guarded: Set[str] = set()
+            for m in methods.values():
+                if m.name == "__init__":
+                    continue
+                guarded.update(a for a, _ in m.locked_writes)
+            if not guarded:
+                continue
+
+            # lock-held methods, to fixpoint: every intra-class call
+            # site is inside a lock context or a lock-held method
+            held: Set[str] = {
+                m for m in methods if m.endswith(_HELD_SUFFIXES)
+            }
+            callers: Dict[str, List[Tuple[str, bool]]] = {}
+            for m in methods.values():
+                for callee, in_lock in m.calls:
+                    callers.setdefault(callee, []).append(
+                        (m.name, in_lock)
+                    )
+            changed = True
+            while changed:
+                changed = False
+                for name, m in methods.items():
+                    if name in held or not name.startswith("_"):
+                        continue
+                    sites = callers.get(name)
+                    if not sites:
+                        continue
+                    if all(
+                        in_lock
+                        or caller in held
+                        or methods[caller].acquires_lock
+                        for caller, in_lock in sites
+                        if caller in methods
+                    ):
+                        held.add(name)
+                        changed = True
+
+            for name, m in methods.items():
+                if name == "__init__" or name in held:
+                    continue
+                for attr, line in m.unlocked_writes:
+                    if attr in guarded:
+                        lock_list = "/".join(sorted(lock_attrs))
+                        findings.append(Finding(
+                            path, line, "lock-discipline",
+                            f"{cls.name}.{name} writes 'self.{attr}' "
+                            f"without holding {cls.name}'s lock "
+                            f"({lock_list}); the same attribute is "
+                            "written under the lock elsewhere — this "
+                            "write races with those",
+                            severity=ERROR,
+                            anchor=f"{cls.name}.{name}.{attr}",
+                        ))
+    return findings

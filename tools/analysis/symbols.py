@@ -1,0 +1,277 @@
+"""Package-wide symbol table + approximate call graph.
+
+Every pass that reasons across function boundaries (the JAX hot-path
+vets, the lock-discipline audit) starts from this model. It is an
+*approximation* built purely from the AST — no imports are executed:
+
+- every ``def`` (module-level, method, nested) becomes a
+  :class:`FunctionInfo` with a dotted qualname;
+- calls are resolved by name through (a) enclosing nested scopes,
+  (b) the module's own functions, (c) ``from x import y`` / ``import x``
+  bindings into other analyzed modules, (d) ``self.method`` within a
+  class;
+- a *function reference passed as an argument* (``lax.scan(step, ...)``,
+  ``lax.cond(p, on_true, on_false)``) counts as a call edge from the
+  caller — that is how tracing reaches those bodies, so that is how
+  reachability must flow;
+- a function's nested ``def``s are treated as reachable from it (the
+  branches handed to ``lax.cond``/``lax.switch`` are defined inline in
+  exactly this shape).
+
+Unresolvable calls (parameters called as functions, attributes of
+non-module objects) are silently dropped: the passes built on top are
+tuned so that missing edges cost recall, never false findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(eq=False)  # identity-hashed: graph node
+class FunctionInfo:
+    qual: str  # "module_id:Outer.inner" dotted within the module
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    module: "ModuleInfo"
+    cls: Optional[str]  # enclosing class name, if a method
+    parent: Optional["FunctionInfo"]  # enclosing function, if nested
+    _locals: Optional[set] = None
+
+    @property
+    def local_names(self) -> set:
+        """Parameters + locally-assigned names: these SHADOW module
+        functions/imports when resolving a bare name in this scope."""
+        if self._locals is None:
+            names = set()
+            a = self.node.args
+            for arg in (
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            ):
+                names.add(arg.arg)
+            if a.vararg:
+                names.add(a.vararg.arg)
+            if a.kwarg:
+                names.add(a.kwarg.arg)
+            for sub in ast.walk(self.node):
+                if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)
+                ):
+                    names.add(sub.id)
+            self._locals = names
+        return self._locals
+
+    @property
+    def path(self) -> str:
+        return self.module.path
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+class ModuleInfo:
+    def __init__(self, path: str, module_id: str, tree: ast.Module):
+        self.path = path
+        self.module_id = module_id  # dotted, derived from the file path
+        self.tree = tree
+        self.functions: Dict[str, FunctionInfo] = {}  # by in-module qual
+        # local binding name -> ("module", dotted) | ("attr", dotted, name)
+        self.imports: Dict[str, Tuple] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+
+
+def _module_id(path: Path, root: Path) -> str:
+    try:
+        rel = path.resolve().relative_to(root.resolve())
+    except ValueError:
+        rel = Path(path.name)
+    return ".".join(rel.with_suffix("").parts)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Project:
+    """All analyzed modules, indexed for cross-module name resolution."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.modules: Dict[str, ModuleInfo] = {}  # by module_id
+        self.by_path: Dict[str, ModuleInfo] = {}
+
+    # -- construction --
+
+    def add_file(self, path: Path, source: str) -> Optional[ModuleInfo]:
+        try:
+            tree = ast.parse(source, filename=str(path))
+        except SyntaxError:
+            return None  # the lint gate owns syntax errors
+        mod = ModuleInfo(str(path), _module_id(path, self.root), tree)
+        self._index(mod)
+        self.modules[mod.module_id] = mod
+        self.by_path[str(path)] = mod
+        return mod
+
+    def _index(self, mod: ModuleInfo) -> None:
+        project = self
+
+        class Indexer(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: List[Tuple[str, object]] = []  # (kind, name/fn)
+
+            def _qual(self, name: str) -> str:
+                parts = [n for _, n in self.stack] + [name]
+                return ".".join(
+                    p.name if isinstance(p, FunctionInfo) else p
+                    for p in parts
+                )
+
+            def visit_Import(self, node):
+                for alias in node.names:
+                    bound = (alias.asname or alias.name).split(".")[0]
+                    mod.imports[bound] = ("module", alias.name)
+
+            def visit_ImportFrom(self, node):
+                if not node.module or node.level:
+                    return
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    mod.imports[alias.asname or alias.name] = (
+                        "attr", node.module, alias.name
+                    )
+
+            def visit_ClassDef(self, node):
+                mod.classes[node.name] = node
+                self.stack.append(("class", node.name))
+                self.generic_visit(node)
+                self.stack.pop()
+
+            def _def(self, node):
+                qual = self._qual(node.name)
+                cls = None
+                parent = None
+                for kind, val in reversed(self.stack):
+                    if kind == "class" and cls is None:
+                        cls = val
+                        break
+                    if kind == "func" and parent is None:
+                        parent = val
+                for kind, val in reversed(self.stack):
+                    if kind == "func":
+                        parent = val
+                        break
+                info = FunctionInfo(
+                    qual=f"{mod.module_id}:{qual}",
+                    name=node.name, node=node, module=mod,
+                    cls=cls, parent=parent,
+                )
+                mod.functions[qual] = info
+                self.stack.append(("func", info))
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_FunctionDef = _def
+            visit_AsyncFunctionDef = _def
+
+        Indexer().visit(mod.tree)
+
+    # -- resolution --
+
+    def _module_by_dotted(self, dotted_name: str) -> Optional[ModuleInfo]:
+        if dotted_name in self.modules:
+            return self.modules[dotted_name]
+        # lenient suffix match: analyzed ids are path-derived, imports may
+        # carry a different package prefix (fixture trees, src layouts)
+        for mid, m in self.modules.items():
+            if mid.endswith("." + dotted_name) or dotted_name.endswith(
+                "." + mid
+            ):
+                return m
+        return None
+
+    def resolve_in_module(
+        self, mod: ModuleInfo, name: str, scope: Optional[FunctionInfo]
+    ) -> Optional[FunctionInfo]:
+        """A bare name referenced inside ``scope`` (or at module level)."""
+        # nested defs of enclosing functions, innermost first
+        fn = scope
+        while fn is not None:
+            prefix = fn.qual.split(":", 1)[1]
+            cand = mod.functions.get(f"{prefix}.{name}")
+            if cand is not None:
+                return cand
+            fn = fn.parent
+        # parameters/locals of any enclosing scope shadow module names
+        # (a bare name never resolves to a method — that needs ``self.``)
+        fn = scope
+        while fn is not None:
+            if name in fn.local_names:
+                return None
+            fn = fn.parent
+        if name in mod.functions:
+            return mod.functions[name]
+        imp = mod.imports.get(name)
+        if imp and imp[0] == "attr":
+            target = self._module_by_dotted(imp[1])
+            if target is not None:
+                return target.functions.get(imp[2])
+        return None
+
+    def resolve_call(
+        self, mod: ModuleInfo, func: ast.AST, scope: Optional[FunctionInfo]
+    ) -> Optional[FunctionInfo]:
+        """Resolve a Call's func expression to an analyzed function."""
+        if isinstance(func, ast.Name):
+            return self.resolve_in_module(mod, func.id, scope)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and scope is not None and scope.cls:
+                    return mod.functions.get(f"{scope.cls}.{func.attr}")
+                imp = mod.imports.get(base.id)
+                if imp and imp[0] == "module":
+                    target = self._module_by_dotted(imp[1])
+                    if target is not None:
+                        return target.functions.get(func.attr)
+                if imp and imp[0] == "attr":
+                    # "from pkg import module as alias" style
+                    target = self._module_by_dotted(f"{imp[1]}.{imp[2]}")
+                    if target is not None:
+                        return target.functions.get(func.attr)
+        return None
+
+
+def function_scope_of(
+    mod: ModuleInfo, node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[FunctionInfo]:
+    """The innermost FunctionInfo lexically containing ``node``."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for info in mod.functions.values():
+                if info.node is cur:
+                    return info
+        cur = parents.get(cur)
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents

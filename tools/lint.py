@@ -6,7 +6,7 @@ tests (reference Makefile:36-65, configure:1-115). This environment ships
 no Python linter and forbids installing one, so this is the stdlib
 equivalent: an AST/token pass enforcing the high-signal subset —
 
-  lint (vet analog)
+  lint (golangci-lint analog; the vet analog is ``tools/analysis``)
     unused-import      import never referenced (skipped in __init__.py
                        re-export shims; ``as _x`` aliases exempt)
     redefinition       same top-level def/class bound twice
@@ -21,8 +21,12 @@ equivalent: an AST/token pass enforcing the high-signal subset —
     no-final-newline   file does not end with exactly one newline
     crlf               carriage returns
 
-``# noqa`` on the offending line suppresses lint findings for that line.
-Exit status 0 = clean, 1 = findings (printed as path:line: code message).
+Suppressions are TYPED and shared with ``tools/analysis``
+(tools/analysis/common.py): ``# noqa: <code>`` suppresses exactly that
+code on that line; a bare ``# noqa`` suppresses nothing (and is reported
+as a ``bare-noqa`` finding by ``make analyze``, which walks the same
+roots). Exit status 0 = clean, 1 = findings (printed as
+path:line: code message).
 """
 
 from __future__ import annotations
@@ -31,39 +35,26 @@ import ast
 import sys
 from pathlib import Path
 
-SKIP_DIRS = {".git", "__pycache__", ".claude", "node_modules"}
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-
-def iter_py_files(roots):
-    for root in roots:
-        p = Path(root)
-        if p.is_file() and p.suffix == ".py":
-            yield p
-            continue
-        for f in sorted(p.rglob("*.py")):
-            if not any(part in SKIP_DIRS for part in f.parts):
-                yield f
-
-
-def _noqa_lines(source: str):
-    return {
-        i
-        for i, line in enumerate(source.splitlines(), 1)
-        if "# noqa" in line
-    }
+from tools.analysis.common import (  # noqa: E402
+    DEFAULT_ROOTS,
+    Suppressions,
+    iter_py_files,
+)
 
 
 class _Lint(ast.NodeVisitor):
     def __init__(self, path: Path, source: str):
         self.path = path
         self.is_init = path.name == "__init__.py"
-        self.noqa = _noqa_lines(source)
+        self.noqa = Suppressions(source)
         self.findings = []
         self.imports = []  # (lineno, alias bound name)
         self.used = set()
 
     def add(self, lineno: int, code: str, msg: str) -> None:
-        if lineno not in self.noqa:
+        if not self.noqa.suppresses(lineno, code):
             self.findings.append((self.path, lineno, code, msg))
 
     # --- usage collection ---
@@ -159,6 +150,9 @@ class _Lint(ast.NodeVisitor):
                 if bound not in self.used:
                     self.add(lineno, "unused-import",
                              f"'{bound}' imported but unused")
+        # suppression hygiene (bare-noqa / unknown-suppression) is
+        # reported by tools/analysis over the same roots — one finding
+        # per defect, not one per gate
 
 
 def check_format(path: Path, raw: bytes, text: str):
@@ -205,8 +199,5 @@ def run(roots) -> int:
 
 
 if __name__ == "__main__":
-    roots = sys.argv[1:] or [
-        "k8s_spot_rescheduler_tpu", "tests", "tools",
-        "bench.py", "__graft_entry__.py",
-    ]
-    sys.exit(run(roots))
+    # shared with tools/analysis: both gates walk the same roots
+    sys.exit(run(sys.argv[1:] or DEFAULT_ROOTS))
