@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze test bench bench-smoke chaos-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze test bench bench-smoke chaos-smoke watch-soak quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -19,7 +19,7 @@ all:
 # tools/lint.py is the fmt+golangci-lint stand-in and tools/analysis is
 # the go-vet analog (this image ships no Python linter and installs are
 # forbidden).
-check: lint analyze test bench-smoke repair-smoke chaos-smoke
+check: lint analyze test bench-smoke repair-smoke chaos-smoke watch-soak
 
 lint:
 	python tools/lint.py
@@ -70,6 +70,16 @@ repair-smoke:
 # ToBeDeleted taint survives, and drains resume once faults clear.
 chaos-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --chaos --chaos-ticks 300 --watchdog 300
+
+# Seeded freshness soak of the watch observe plane (CPU-only, ~1 s of
+# wall on a virtual clock): 300 ticks with open-but-silent stalls,
+# stream drops, scripted 410s and one injected mirror corruption; fails
+# unless stalls are detected, drift heals within one resync interval,
+# zero ticks plan from an over-budget mirror, every full LIST is
+# accounted to a relist or audit, and the mirror packs bit-identically
+# to a fresh LIST at end-state.
+watch-soak:
+	env JAX_PLATFORMS=cpu python bench.py --watch-soak --watch-soak-ticks 300 --watchdog 300
 
 quality:
 	python bench.py --quality

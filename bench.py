@@ -259,8 +259,11 @@ def run_incremental_ticks(
     against the previous tick, churn-proportional delta shipped into the
     device-resident cache (donated scatter), staged early-exit solve, one
     tiny selection fetch. Returns (per-tick ms list, per-tick PlanReport
-    list); tick 0 is the cold full pack + compile and is excluded from
-    steady-state medians by callers."""
+    list, per-tick mirror-sync ms list); tick 0 is the cold full pack +
+    compile and is excluded from steady-state medians by callers. The
+    sync list times the churn's application to the columnar mirror —
+    the delta-shaped half of observe (the pack half is measured by
+    ``build_problem``), so BENCH_*.json can show the observe split."""
     import dataclasses
 
     from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
@@ -274,19 +277,22 @@ def run_incremental_ticks(
         cfg = dataclasses.replace(cfg, staged_chunk_lanes=staged_chunk_lanes)
     planner = SolverPlanner(cfg)
     uids = iter(list(client.pods))
-    tick_ms, reports = [], []
+    tick_ms, reports, sync_ms = [], [], []
     for i in range(n_ticks):
         if i:
             # light churn, the steady-state regime: a few evictions'
-            # worth of pod removals between ticks
+            # worth of pod removals between ticks — applied to the
+            # incrementally-maintained mirror (O(churn), not O(cluster))
+            t_s = time.perf_counter()
             for _ in range(churn):
                 uid = next(uids, None)
                 if uid is not None:
                     client._remove_pod(uid)
+            sync_ms.append((time.perf_counter() - t_s) * 1e3)
         t0 = time.perf_counter()
         reports.append(planner.plan(store, pdbs))
         tick_ms.append((time.perf_counter() - t0) * 1e3)
-    return tick_ms, reports
+    return tick_ms, reports, sync_ms
 
 
 def run_quality(seed: int, sweep: int = 1, solver: str = "numpy") -> int:
@@ -747,8 +753,8 @@ def run_smoke(args, metric: str, unit: str) -> int:
     spec = dataclasses.replace(
         CONFIGS[2], name="bench-smoke", n_on_demand=64, n_spot=64, n_pods=600
     )
-    _, _, _, client, store, pdbs = build_problem(2, args.seed, spec=spec)
-    tick_ms, reports = run_incremental_ticks(
+    _, _, pack_s, client, store, pdbs = build_problem(2, args.seed, spec=spec)
+    tick_ms, reports, sync_ms = run_incremental_ticks(
         client, store, pdbs, spec, "jax",
         n_ticks=5, churn=3, staged_chunk_lanes=16,
     )
@@ -779,6 +785,9 @@ def run_smoke(args, metric: str, unit: str) -> int:
             "chunks_solved": int(report.chunks_solved),
             "chunks_skipped": int(report.chunks_skipped),
             "steady_tick_ms": round(float(np.median(tick_ms[1:])), 2),
+            # observe split: mirror sync (O(churn)) vs full pack
+            "sync_ms": round(float(np.median(sync_ms)), 3),
+            "pack_ms": round(pack_s * 1e3, 3),
             "ok": ok,
         }
     )
@@ -952,11 +961,324 @@ def run_chaos(args, metric: str, unit: str) -> int:
     return 0 if ok else 1
 
 
+def watch_soak(
+    n_ticks: int = 300,
+    seed: int = 0,
+    *,
+    stall_rate: float = 0.06,
+    drop_rate: float = 0.04,
+    progress_deadline: float = 120.0,
+    staleness_budget: float = 60.0,
+    resync_interval: float = 300.0,
+):
+    """Deterministic freshness-soak core (shared by ``--watch-soak`` and
+    tests/test_freshness.py): N control-loop ticks against the scripted
+    watch apiserver (io/fakewatch.py) behind the chaos layer, with the
+    watchers driven SYNCHRONOUSLY on a virtual clock — open-but-silent
+    stalls, random stream drops, two scripted 410-after-resume streams,
+    and one injected mirror corruption. Returns (stats, violations):
+    ``stats`` carries the metric deltas the acceptance criteria are
+    asserted on, ``violations`` the invariant breaches (empty = pass).
+    """
+    import dataclasses as _dc
+    import random as _random
+
+    from k8s_spot_rescheduler_tpu.io.chaos import ChaosClusterClient, FaultPlan
+    from k8s_spot_rescheduler_tpu.io.fakewatch import (
+        ScriptedWatchSource,
+        raw_node,
+        raw_pod,
+    )
+    from k8s_spot_rescheduler_tpu.io.watch import WatchingKubeClusterClient
+    from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    clock = FakeClock(start=1_000_000.0)
+    src = ScriptedWatchSource()
+    for i in range(4):
+        src.objects["nodes"][f"uid-od-{i}"] = raw_node(f"od-{i}", "worker")
+    for i in range(8):
+        src.objects["nodes"][f"uid-spot-{i}"] = raw_node(
+            f"spot-{i}", "spot-worker"
+        )
+    for i in range(4):
+        for j in range(3):
+            name = f"p{i}-{j}"
+            src.objects["pods"][f"uid-{name}"] = raw_pod(
+                name, f"od-{i}", cpu_millis=100 + 50 * j
+            )
+
+    plan = FaultPlan(
+        seed=seed,
+        watch_stall_rate=stall_rate,
+        watch_drop_rate=drop_rate,
+        watch_410_streams=(9, 57),
+    )
+    chaos = ChaosClusterClient(src, plan, clock=clock)
+    # snapshot BEFORE the seeding relists so the delta accounting below
+    # covers every LIST of the run (metrics are process-cumulative)
+    before = metrics.freshness_snapshot()
+    wc = WatchingKubeClusterClient(
+        chaos, clock=clock, progress_deadline=progress_deadline,
+        wait_fn=clock.sleep,
+    )
+    wc.start(background=False)
+
+    config = ReschedulerConfig(
+        solver="numpy",
+        housekeeping_interval=10.0,
+        node_drain_delay=120.0,
+        pod_eviction_timeout=60.0,
+        eviction_retry_time=5.0,
+        mirror_staleness_budget=staleness_budget,
+        watch_progress_deadline=progress_deadline,
+        resync_interval=resync_interval,
+    )
+    r = Rescheduler(
+        wc, SolverPlanner(config), config, clock=clock, recorder=wc
+    )
+    rng = _random.Random(seed + 1)
+    churn_uid = 0
+
+    def churn_once():
+        nonlocal churn_uid
+        k = rng.random()
+        pods = list(src.objects["pods"].values())
+        if k < 0.45 or not pods:
+            name = f"churn-{churn_uid}"
+            churn_uid += 1
+            src.push("pods", "ADDED", raw_pod(
+                name, f"od-{rng.randrange(4)}",
+                cpu_millis=rng.choice((50, 100, 150, 200)),
+            ))
+        elif k < 0.8:
+            src.push("pods", "DELETED", rng.choice(pods))
+        else:
+            obj = rng.choice(pods)
+            node = obj["spec"].get("nodeName", "")
+            if node:
+                src.push("pods", "MODIFIED", raw_pod(
+                    obj["metadata"]["name"], node,
+                    cpu_millis=rng.choice((75, 125, 250)),
+                ))
+
+    _CORRUPT_CPU = 3333  # impossible allocatable: unambiguous marker
+    corrupt_key = "uid-spot-7"  # a spot node: never drained or deleted,
+    # so only a store replace (audit heal or protocol relist) can fix it
+
+    def corrupt_mirror() -> bool:
+        # poke the mirror BEHIND the watch stream's back: the object
+        # store and (via its delta listener) the columnar feed now
+        # coherently disagree with the cluster — exactly the failure
+        # only the anti-entropy audit can see
+        node = dict(wc.nodes.snapshot_items()).get(corrupt_key)
+        if node is None:
+            return False
+        wc.nodes.upsert(corrupt_key, _dc.replace(
+            node, allocatable={**node.allocatable, "cpu": _CORRUPT_CPU}
+        ))
+        return True
+
+    def mirror_corrupted() -> bool:
+        node = dict(wc.nodes.snapshot_items()).get(corrupt_key)
+        return (
+            node is not None
+            and node.allocatable.get("cpu") == _CORRUPT_CPU
+        )
+
+    corrupt_at = n_ticks // 2
+    quiesce_at = (n_ticks * 7) // 8
+    corrupt_wall = heal_wall = None
+    completed = 0
+    drains = []
+    violations = []
+    for i in range(n_ticks):
+        clock.sleep(config.housekeeping_interval)
+        if i == quiesce_at:
+            chaos.enabled = False
+        for _ in range(rng.randrange(0, 3)):
+            churn_once()
+        if i % 7 == 0:
+            src.bookmark("pods")
+            src.bookmark("nodes")
+        if i == corrupt_at and corrupt_mirror():
+            corrupt_wall = clock.wall()
+        for w in wc._watchers:
+            w.step()
+        try:
+            result = r.tick()
+        except Exception as err:  # noqa: BLE001 — the invariant itself
+            violations.append(f"tick {i} crashed the loop: {err!r}")
+            break
+        completed += 1
+        drains.extend((i, n) for n in result.drained)
+        if corrupt_wall is not None and heal_wall is None \
+                and not mirror_corrupted():
+            heal_wall = clock.wall()
+
+    # let the streams drain fully, then check end-state invariants
+    for w in wc._watchers:
+        w.step()
+    snap = metrics.freshness_snapshot()
+    d = {k: snap[k] - before[k] for k in snap if k in before}
+
+    if completed != n_ticks:
+        violations.append(f"only {completed}/{n_ticks} ticks completed")
+    if d["watch_stalls"] < 1:
+        violations.append("no open-but-silent stall was ever detected")
+    if chaos.stats.get("watch_410", 0) != 2:
+        violations.append(
+            f"expected 2 scripted 410 streams, saw "
+            f"{chaos.stats.get('watch_410', 0)}"
+        )
+    if d["freshness_bypass"] < 1:
+        violations.append(
+            "the freshness gate never engaged the direct-LIST bypass"
+        )
+    if d["mirror_stale_planned"] != 0:
+        violations.append(
+            f"{d['mirror_stale_planned']} tick(s) reached the planner "
+            "with an over-budget mirror"
+        )
+    # heal bound: one resync interval, plus one tick's worst-case wall
+    # jump — the audit fires at the first TICK past its due time, and a
+    # stalled-stream tick advances the virtual clock by a whole read
+    # timeout (progress deadline + stall slack) in one jump
+    heal_bound = (
+        resync_interval + progress_deadline + 30.0
+        + config.housekeeping_interval
+    )
+    if corrupt_wall is None:
+        violations.append("mirror corruption was never injected")
+    elif heal_wall is None:
+        violations.append("injected mirror corruption was never healed")
+    elif heal_wall - corrupt_wall > heal_bound:
+        violations.append(
+            f"corruption healed after {heal_wall - corrupt_wall:.0f}s "
+            f"(> one resync interval of {resync_interval:.0f}s plus one "
+            "tick's worst-case wall jump)"
+        )
+    if d["watch_drift"] < 1:
+        violations.append(
+            "the anti-entropy audit never counted any drift "
+            "(watch_drift_total stayed 0 despite the injected corruption)"
+        )
+    # every full LIST is accounted for: protocol relists (seed / 410 /
+    # error recovery) + exactly 3 per anti-entropy audit — a steady-state
+    # tick between audits issues NONE (the delta-shaped observe path)
+    total_lists = sum(src.list_count.values())
+    expected_lists = int(d["watch_relists"] + 3 * d["resync_audits"])
+    if total_lists != expected_lists:
+        violations.append(
+            f"{total_lists} full LISTs issued but only {expected_lists} "
+            "accounted to relists/audits — the steady-state tick is not "
+            "delta-shaped"
+        )
+    if d["resync_audits"] < 1:
+        violations.append("no anti-entropy audit ever ran")
+
+    # parity: the incremental mirror packs bit-identically to a fresh
+    # LIST of the same end state (a plan is a pure function of the pack)
+    wc.refresh()
+    wc.list_unschedulable_pods()
+    store = wc.columnar_store(
+        ("cpu", "memory"),
+        on_demand_label=config.on_demand_node_label,
+        spot_label=config.spot_node_label,
+    )
+    pdbs = wc.list_pdbs()
+    col, _ = store.pack(pdbs)
+    nodes = src.list_ready_nodes()
+    node_map = build_node_map(
+        nodes,
+        {n.name: src.list_pods_on_node(n.name) for n in nodes},
+        on_demand_label=config.on_demand_node_label,
+        spot_label=config.spot_node_label,
+    )
+    obj, _ = pack_cluster(node_map, src.list_pdbs(), resources=("cpu", "memory"))
+    mismatch = [
+        f for f in obj._fields
+        if not np.array_equal(getattr(obj, f), getattr(col, f))
+    ]
+    if mismatch:
+        violations.append(
+            f"mirror pack diverges from a fresh LIST on {mismatch}"
+        )
+
+    stats = {
+        "ticks": completed,
+        "drains": len(drains),
+        "stalls_detected": int(d["watch_stalls"]),
+        "stream_errors": int(d["watch_stream_errors"]),
+        "scripted_410s": int(chaos.stats.get("watch_410", 0)),
+        "relists": int(d["watch_relists"]),
+        "resync_audits": int(d["resync_audits"]),
+        "drift_objects_healed": int(d["watch_drift"]),
+        "presence_heals": int(d["watch_presence_heals"]),
+        "drift_heal_seconds": (
+            None if heal_wall is None or corrupt_wall is None
+            else round(heal_wall - corrupt_wall, 1)
+        ),
+        "freshness_bypass_ticks": int(d["freshness_bypass"]),
+        "mirror_stale_planned": int(d["mirror_stale_planned"]),
+        "full_lists": int(total_lists),
+        "direct_bypass_reads": int(src.direct_reads),
+        "watch_events_applied": int(d["watch_events"]),
+        "mirror_parity": not mismatch,
+    }
+    return stats, violations
+
+
+def run_watch_soak(args, metric: str, unit: str) -> int:
+    """Freshness soak (``make watch-soak``): seconds of wall clock, no
+    devices (numpy planner — the soak proves the OBSERVE plane). Fails
+    unless every freshness invariant holds: stalls detected within one
+    progress deadline, injected drift healed within one resync
+    interval, zero ticks planned from an over-budget mirror, every full
+    LIST accounted to a relist or an audit, and end-state mirror/LIST
+    pack parity."""
+    t0 = time.perf_counter()
+    stats, violations = watch_soak(int(args.watch_soak_ticks), args.seed)
+    wall = time.perf_counter() - t0
+    ok = not violations
+    print(
+        f"watch-soak: {stats['ticks']} ticks  "
+        f"{stats['stalls_detected']} stalls  "
+        f"{stats['drift_objects_healed']} drift healed "
+        f"({stats['drift_heal_seconds']}s)  "
+        f"{stats['freshness_bypass_ticks']} bypassed  "
+        f"{stats['full_lists']} LISTs ({stats['resync_audits']} audits)  "
+        f"wall={wall:.1f}s  "
+        f"-> {'OK' if ok else 'FAIL: ' + '; '.join(violations)}",
+        file=sys.stderr,
+    )
+    emit(
+        {
+            "metric": metric,
+            "value": int(stats["ticks"]),
+            "unit": unit,
+            "vs_baseline": None,
+            "wall_s": round(wall, 2),
+            "ok": ok,
+            **stats,
+            **({"violations": violations} if violations else {}),
+        }
+    )
+    return 0 if ok else 1
+
+
 def _metric_for(args) -> tuple:
     """(metric name, unit) this invocation will report — known up front so
     failure paths can emit a well-formed JSON line."""
     if args.chaos:
         return "chaos_soak_completed_ticks", "count"
+    if args.watch_soak:
+        return "watch_soak_completed_ticks", "count"
     if args.smoke:
         return "bench_smoke_delta_upload_bytes", "bytes"
     if args.quality:
@@ -1053,6 +1375,18 @@ def main() -> int:
     ap.add_argument("--chaos-ticks", type=int, default=300,
                     help="ticks of the --chaos soak (>=300 for the "
                          "acceptance run)")
+    ap.add_argument("--watch-soak", action="store_true",
+                    help="freshness soak (make watch-soak): drive the "
+                         "watch protocol synchronously on a virtual "
+                         "clock under stalls, drops, scripted 410s and "
+                         "one mirror corruption; fail unless the "
+                         "freshness invariants hold (stall detected, "
+                         "drift healed within one resync interval, zero "
+                         "stale-planned ticks, delta-shaped steady "
+                         "state, mirror/LIST pack parity)")
+    ap.add_argument("--watch-soak-ticks", type=int, default=300,
+                    help="ticks of the --watch-soak run (>=300 for the "
+                         "acceptance run)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke (make bench-smoke): tiny CPU-only "
                          "cluster, 5 ticks through the production "
@@ -1079,6 +1413,8 @@ def main() -> int:
 def _dispatch(ap, args, metric: str, unit: str) -> int:
     if args.chaos:
         return run_chaos(args, metric, unit)
+    if args.watch_soak:
+        return run_watch_soak(args, metric, unit)
     if args.smoke:
         return run_smoke(args, metric, unit)
     if args.quality:
@@ -1306,7 +1642,7 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
     # (delta-pack into the device-resident cache + staged early-exit
     # solve). Tick 0 is the cold full upload + compiles; the steady
     # number is the median of the post-first-tick full ticks.
-    tick_ms, tick_reports = run_incremental_ticks(
+    tick_ms, tick_reports, sync_ms_list = run_incremental_ticks(
         client, store, pdbs, spec, args.solver,
         n_ticks=max(4, min(8, args.repeats)),
     )
@@ -1350,8 +1686,14 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
         "device": jax.devices()[0].device_kind,
         "steady_tick_ms": round(steady_ms, 3),
         # the columnar observe+pack median, driver-visible (VERDICT
-        # next-round item 7): the host half of every tick
+        # next-round item 7): the host half of every tick — split so the
+        # delta-shaped steady state is visible: sync_ms is the O(churn)
+        # mirror update between ticks, pack_ms the vectorized pack
         "pack_ms": round(pack_s * 1e3, 3),
+        "sync_ms": round(float(np.median(sync_ms_list)), 3),
+        "observe_ms": round(
+            pack_s * 1e3 + float(np.median(sync_ms_list)), 3
+        ),
     }
     if incremental_active:
         out["delta_upload_bytes"] = int(tick_report.upload_bytes)

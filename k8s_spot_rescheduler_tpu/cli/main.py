@@ -144,6 +144,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "testing/demo only, never production")
     p.add_argument("--chaos-seed", type=int, default=d.chaos_seed,
                    help="seed of the chaos fault stream (deterministic)")
+    p.add_argument("--chaos-watch-stall-rate", type=float,
+                   default=d.chaos_watch_stall_rate,
+                   help="per-stream-open probability an injected chaos "
+                        "watch stream is open but silent until the read "
+                        "timeout (the wedged-stream failure mode the "
+                        "progress deadline catches); mixed into the "
+                        "selected --chaos-profile")
+    p.add_argument("--watch-progress-deadline", default="2m",
+                   help="kill and reconnect a watch stream that delivers "
+                        "no event, bookmark, or clean close for this "
+                        "long — open-but-silent streams must not serve "
+                        "the mirror forever (Go duration; 0 = server "
+                        "timeouts only)")
+    p.add_argument("--mirror-staleness-budget", default="1m",
+                   help="refuse to plan a tick from a watch mirror older "
+                        "than this: the tick degrades to a direct LIST, "
+                        "or skips into the circuit breaker (Go duration; "
+                        "0 disables the freshness gate)")
+    p.add_argument("--resync-interval", default="5m",
+                   help="anti-entropy audit period: a background LIST is "
+                        "diffed field-by-field against the watch mirror; "
+                        "drift is counted, evented, and healed by a "
+                        "store replace (Go duration; 0 disables)")
     p.add_argument("--jax-cache-dir", default=d.jax_cache_dir,
                    help="persistent XLA compilation cache directory; the "
                         "~seconds cold compile of the solver programs is "
@@ -176,6 +199,38 @@ def build_parser() -> argparse.ArgumentParser:
 
 def _bool(s: str) -> bool:
     return str(s).lower() in ("1", "true", "yes")
+
+
+def start_watch_client(client, config: "ReschedulerConfig", clock):
+    """Wrap ``client`` in the watch-backed cache layer and sync it.
+
+    Graceful startup degradation: if the caches fail to sync (apiserver
+    flaky at boot, watch endpoints unreachable), the process does NOT
+    die — it logs a warning, marks the loop degraded (sticky on
+    /healthz and the ``rescheduler_degraded`` gauge), and falls back to
+    the polling client, whose per-tick LISTs need no warm-up. A
+    rescheduler that cannot watch can still reschedule; it just pays
+    the LIST cost the watch path exists to avoid."""
+    from k8s_spot_rescheduler_tpu.io.watch import WatchingKubeClusterClient
+    from k8s_spot_rescheduler_tpu.loop import health
+
+    wc = WatchingKubeClusterClient(
+        client,
+        clock=clock,
+        progress_deadline=config.watch_progress_deadline,
+    )
+    try:
+        wc.start()
+        return wc
+    except Exception as err:  # noqa: BLE001 — degrade, don't die
+        log.error(
+            "Watch caches failed to sync (%s); falling back to the "
+            "polling client — degraded (per-tick LISTs) until restart",
+            err,
+        )
+        wc.stop()
+        health.STATE.note_startup_degraded()
+        return client
 
 
 def config_from_args(args) -> ReschedulerConfig:
@@ -212,6 +267,10 @@ def config_from_args(args) -> ReschedulerConfig:
         reconcile_orphaned_taints=args.reconcile_orphaned_taints,
         chaos_profile=args.chaos_profile,
         chaos_seed=args.chaos_seed,
+        chaos_watch_stall_rate=args.chaos_watch_stall_rate,
+        watch_progress_deadline=parse_duration(args.watch_progress_deadline),
+        mirror_staleness_budget=parse_duration(args.mirror_staleness_budget),
+        resync_interval=parse_duration(args.resync_interval),
         resources=tuple(r for r in args.resources.split(",") if r),
         mesh_shape=(
             tuple(int(x) for x in args.mesh_shape.lower().split("x"))
@@ -249,6 +308,8 @@ def main(argv=None) -> int:
     from k8s_spot_rescheduler_tpu.utils.clock import RealClock
 
     def chaos_wrap(c, clk):
+        import dataclasses
+
         from k8s_spot_rescheduler_tpu.io.chaos import (
             ChaosClusterClient,
             FaultPlan,
@@ -259,11 +320,12 @@ def main(argv=None) -> int:
             "testing mode, not production",
             config.chaos_profile, config.chaos_seed,
         )
-        return ChaosClusterClient(
-            c,
-            FaultPlan.profile(config.chaos_profile, config.chaos_seed),
-            clock=clk,
-        )
+        plan = FaultPlan.profile(config.chaos_profile, config.chaos_seed)
+        if config.chaos_watch_stall_rate > 0:
+            plan = dataclasses.replace(
+                plan, watch_stall_rate=config.chaos_watch_stall_rate
+            )
+        return ChaosClusterClient(c, plan, clock=clk)
 
     elector = None
     if args.cluster.startswith("synthetic:"):
@@ -334,17 +396,7 @@ def main(argv=None) -> int:
             # renew off-loop so a long drain never lets the lease lapse
             elector.start_background()
         if args.watch_cache:
-            from k8s_spot_rescheduler_tpu.io.watch import (
-                WatchingKubeClusterClient,
-            )
-
-            client = WatchingKubeClusterClient(client)
-            try:
-                client.start()
-            except Exception as err:  # noqa: BLE001
-                print(f"Error: watch caches failed to sync: {err}",
-                      file=sys.stderr)
-                return 1
+            client = start_watch_client(client, config, clock)
         recorder = client
     else:
         print(f"Error: unknown --cluster {args.cluster!r}", file=sys.stderr)

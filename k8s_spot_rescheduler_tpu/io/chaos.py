@@ -82,9 +82,24 @@ class FaultPlan:
       successful result for the same query instead of a fresh one.
     - ``watch_drop_rate``: per-event probability a watch stream dies
       with a connection reset (clients with a ``_stream`` hook only).
+    - ``watch_stall_rate``: per-stream-open probability the stream is
+      OPEN BUT SILENT — it yields nothing until the caller's read
+      timeout elapses (slept on the wrapper's clock), then raises the
+      same ``TimeoutError`` the wedged socket would. The failure mode
+      the client-side watch progress deadline exists to catch: no
+      error, no close, no data.
+    - ``watch_410_streams``: 1-based stream-open indices that
+      immediately deliver a 410-Expired ERROR event and end — the
+      scripted "410 right after a resume" that must trigger exactly
+      one throttled re-LIST.
     - ``interrupt_on_taint``: 1-based index of the ``add_taint`` call
       that raises ``ChaosInterrupt`` AFTER the taint is applied — the
       canonical mid-drain crash leaving an orphaned taint. 0 = never.
+
+    Mirror corruption (the audit's third chaos scenario) needs no knob
+    here: the wrapper sits below the watch stores, so the soak harness
+    corrupts a ``ResourceStore`` entry directly and the anti-entropy
+    audit must detect and heal it.
     """
 
     seed: int = 0
@@ -94,6 +109,8 @@ class FaultPlan:
     evict_429: Mapping[str, int] = dataclasses.field(default_factory=dict)
     stale_read_rate: float = 0.0
     watch_drop_rate: float = 0.0
+    watch_stall_rate: float = 0.0
+    watch_410_streams: tuple = ()
     interrupt_on_taint: int = 0
 
     # the single source for profile names: profile() accepts exactly
@@ -148,6 +165,7 @@ class ChaosClusterClient:
         self._fail_n: Dict[str, int] = dict(plan.fail_n)
         self._evict_429: Dict[str, int] = dict(plan.evict_429)
         self._taint_calls = 0
+        self._watch_streams = 0
         self._last_read: Dict[tuple, object] = {}
 
     # --- fault primitives ---
@@ -276,7 +294,38 @@ class ChaosClusterClient:
 
     def _stream(self, path: str, read_timeout: float = 330.0):
         inner_stream = getattr(self.inner, "_stream")
+        self._watch_streams += 1
+        stream_no = self._watch_streams
         self._maybe_fault("watch")
+        if self.enabled and stream_no in self.plan.watch_410_streams:
+            # scripted 410-after-resume: the stream opens fine and
+            # immediately reports the resourceVersion expired — the
+            # watcher must fall back to exactly one throttled re-LIST
+            self.stats["watch_410"] += 1
+            yield {
+                "type": "ERROR",
+                "object": {
+                    "kind": "Status", "code": 410, "reason": "Expired",
+                    "message": "chaos: scripted resourceVersion expiry",
+                },
+            }
+            return
+        if (
+            self.enabled
+            and self.plan.watch_stall_rate
+            and self.rng.random() < self.plan.watch_stall_rate
+        ):
+            # open-but-silent: no event, no error, no close — exactly
+            # what a wedged transport looks like. Sleep out the
+            # caller's read timeout on the injected clock (instant on
+            # a virtual clock), then raise what the socket would.
+            self.stats["watch_stall"] += 1
+            if self.clock is not None:
+                self.clock.sleep(read_timeout)
+            raise TimeoutError(
+                "chaos: watch stream open but silent (stalled past the "
+                "read timeout)"
+            )
         for obj in inner_stream(path, read_timeout):
             yield obj
             if (

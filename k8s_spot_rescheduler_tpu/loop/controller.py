@@ -123,6 +123,15 @@ class Rescheduler:
         # must never untaint a drain in progress (single-threaded today,
         # so empty at every sweep — load-bearing if actuation ever forks)
         self._active_drains: set = set()
+        # --- freshness gate state (docs/ROBUSTNESS.md) ---
+        # the client this tick's READS go to: the configured client, or
+        # its direct (cache-bypassing) twin while the watch mirror is
+        # staler than mirror_staleness_budget; writes always go to
+        # self.client
+        self._observe_client = client
+        # next anti-entropy audit, wall clock; armed on the first tick
+        # (the startup LIST is itself fresh)
+        self._next_resync_wall: Optional[float] = None
         health.STATE.set_clock(self.clock.now)
         if config.reconcile_orphaned_taints and startup_sweep:
             # startup sweep: a previous process may have died mid-drain,
@@ -141,6 +150,10 @@ class Rescheduler:
         and the config hasn't forced the object path."""
         if not self.config.use_columnar:
             return None
+        if self._observe_client is not self.client:
+            # freshness bypass in effect: the mirror is the thing being
+            # bypassed — this tick observes via direct LISTs only
+            return None
         if not getattr(self.planner, "accepts_columnar", False):
             return None
         factory = getattr(self.client, "columnar_store", None)
@@ -157,17 +170,18 @@ class Rescheduler:
             return None
 
     def observe(self) -> Optional[NodeMap]:
+        client = self._observe_client
         try:
-            nodes = self.client.list_ready_nodes()
+            nodes = client.list_ready_nodes()
             # not-ready nodes are presence-only (zone/spread counts —
             # their pods still exist to the real scheduler). All in-tree
             # clients implement the lister; the fallback exists for
             # third-party clients, whose spread/zone verdicts then rest
             # on ready-node visibility alone.
-            lister = getattr(self.client, "list_unready_nodes", None)
+            lister = getattr(client, "list_unready_nodes", None)
             unready = lister() if lister is not None else []
             pods_by_node = {
-                n.name: self.client.list_pods_on_node(n.name)
+                n.name: client.list_pods_on_node(n.name)
                 for n in list(nodes) + list(unready)
             }
         except Exception as err:  # noqa: BLE001 — skip tick on any API error
@@ -456,6 +470,100 @@ class Rescheduler:
                     )
         return recovered
 
+    # --- freshness gate + anti-entropy audit (docs/ROBUSTNESS.md) ---
+
+    def _maybe_resync_audit(self) -> None:
+        """Run the client's anti-entropy resync audit when due (every
+        ``resync_interval`` of wall time). Pre-gate like the taint
+        sweep: the mirror must stay verified even while cooldown or the
+        unschedulable gate holds ticks back. Drift is logged, evented,
+        and already healed by the client when this returns."""
+        audit = getattr(self.client, "resync_audit", None)
+        if audit is None or self.config.resync_interval <= 0:
+            return
+        now = self.clock.wall()
+        if self._next_resync_wall is None:
+            # first tick: the startup LIST just seeded the mirror
+            self._next_resync_wall = now + self.config.resync_interval
+            return
+        if now < self._next_resync_wall:
+            return
+        # advance the schedule before running: a failing audit retries
+        # at the NEXT interval, not every tick (a down apiserver must
+        # not be hammered with the very LISTs the watch path avoids)
+        self._next_resync_wall = now + self.config.resync_interval
+        try:
+            drift = audit()
+        except Exception as err:  # noqa: BLE001 — audit is advisory
+            log.error(
+                "Anti-entropy resync audit failed (next attempt in "
+                "%.0fs): %s", self.config.resync_interval, err,
+            )
+            return
+        total = sum(drift.values())
+        if total:
+            detail = ", ".join(
+                f"{res}={n}" for res, n in sorted(drift.items()) if n
+            )
+            log.error(
+                "Anti-entropy audit healed %d drifted mirror object(s) "
+                "(%s)", total, detail,
+            )
+            self.recorder.event(
+                "Node", "", "Warning", "WatchDriftHealed",
+                f"anti-entropy resync found {total} drifted object(s) "
+                f"in the watch mirror ({detail}); stores replaced from "
+                "a fresh LIST",
+            )
+
+    def _freshness_gate(self) -> Optional[TickResult]:
+        """Refuse to observe through a watch mirror staler than
+        ``mirror_staleness_budget``. Degradation ladder: (1) bypass the
+        sick cache with the client's direct-LIST twin for this tick;
+        (2) no direct path → skip the tick, which feeds the circuit
+        breaker. Returns the skip result, or None to proceed (with
+        ``self._observe_client`` pointing at this tick's read path)."""
+        self._observe_client = self.client
+        budget = self.config.mirror_staleness_budget
+        stale_fn = getattr(self.client, "mirror_staleness", None)
+        if stale_fn is None or budget <= 0:
+            return None
+        staleness = float(stale_fn())
+        metrics.update_mirror_staleness(staleness)
+        health.STATE.note_mirror_staleness(staleness, budget)
+        if staleness <= budget:
+            return None
+        direct = getattr(self.client, "direct_client", None)
+        bypass = direct() if direct is not None else None
+        if bypass is None:
+            log.error(
+                "Watch mirror is %.1fs stale (budget %.1fs) and no "
+                "direct observe path exists; skipping the tick",
+                staleness, budget,
+            )
+            return TickResult(skipped="error")
+        log.error(
+            "Watch mirror is %.1fs stale (budget %.1fs); observing via "
+            "direct LIST this tick (cache bypassed)", staleness, budget,
+        )
+        metrics.update_freshness_bypass()
+        self._observe_client = bypass
+        return None
+
+    def _planned_from_stale_mirror(self) -> bool:
+        """Last-line freshness check at the plan boundary: True if this
+        tick's observation came from the mirror and the mirror aged
+        past the budget while the tick observed. Structurally never —
+        the gate just measured it — but enforced, so no eviction can
+        ever be planned from over-budget data."""
+        budget = self.config.mirror_staleness_budget
+        if budget <= 0 or self._observe_client is not self.client:
+            return False
+        stale_fn = getattr(self.client, "mirror_staleness", None)
+        if stale_fn is None:
+            return False
+        return float(stale_fn()) > budget
+
     # --- circuit breaker ---
 
     @property
@@ -492,6 +600,12 @@ class Rescheduler:
             except Exception as err:  # noqa: BLE001
                 log.error("Orphaned-taint sweep failed: %s", err)
         try:
+            # also pre-gate: the mirror stays audited while cooldown or
+            # the unschedulable gate holds ticks back
+            self._maybe_resync_audit()
+        except Exception as err:  # noqa: BLE001
+            log.error("Anti-entropy resync audit crashed: %s", err)
+        try:
             result = self._tick_inner()
         except Exception as err:  # noqa: BLE001 — the loop must not die
             log.error("Tick aborted by unexpected error: %s", err)
@@ -525,8 +639,12 @@ class Rescheduler:
                      self.next_drain_time - now)
             return TickResult(skipped="cooldown")
 
+        skip = self._freshness_gate()
+        if skip is not None:
+            return skip
+
         try:
-            unschedulable = self.client.list_unschedulable_pods()
+            unschedulable = self._observe_client.list_unschedulable_pods()
         except Exception as err:  # noqa: BLE001
             # skip the tick, matching the observe-error policy: treating
             # an unknown state as "zero unschedulable pods" would defeat
@@ -547,7 +665,7 @@ class Rescheduler:
                 return TickResult(skipped="error")
 
             try:
-                pdbs = self.client.list_pdbs()
+                pdbs = self._observe_client.list_pdbs()
             except Exception as err:  # noqa: BLE001
                 log.error("Failed to list PDBs: %s", err)
                 return TickResult(skipped="error")
@@ -556,6 +674,16 @@ class Rescheduler:
                 # one evictability pass per tick, shared between the
                 # metrics update and the planner's pack
                 observation = self._wrap_columnar(observation, pdbs)
+
+        if self._planned_from_stale_mirror():
+            # the mirror aged past the budget while this tick observed
+            # — refuse to plan from it (the skip feeds the breaker)
+            metrics.update_mirror_stale_planned()
+            log.error(
+                "Watch mirror aged past the staleness budget between "
+                "the gate and the plan; skipping the tick"
+            )
+            return TickResult(skipped="error")
 
         report, used_fallback = self._plan_guarded(observation, pdbs)
         if report is None:
@@ -583,7 +711,7 @@ class Rescheduler:
                 # Clients with a per-tick cache (polling pod LIST, watch
                 # snapshot) must drop it or the re-observe reads the same
                 # pre-drain view the first plan used.
-                refresh = getattr(self.client, "refresh", None)
+                refresh = getattr(self._observe_client, "refresh", None)
                 if refresh is not None:
                     refresh()
                 observation = self._columnar_store()
@@ -592,7 +720,7 @@ class Rescheduler:
                 if observation is None:
                     break
                 try:
-                    pdbs = self.client.list_pdbs()
+                    pdbs = self._observe_client.list_pdbs()
                 except Exception as err:  # noqa: BLE001
                     log.error("Failed to list PDBs: %s", err)
                     break

@@ -24,17 +24,22 @@ class HealthState:
     def __init__(self):
         self._lock = threading.Lock()
         self._now: Optional[Callable[[], float]] = None
-        # degraded = _fallback_degraded OR _breaker_degraded — tracked by
-        # cause, so a recovering breaker clears its half without masking
-        # a still-fallback planner (and vice versa)
+        # degraded = OR over independent causes — tracked per cause, so
+        # a recovering breaker clears its half without masking a
+        # still-fallback planner (and vice versa): planner fallback,
+        # breaker engaged, watch mirror past its freshness budget, and
+        # the sticky startup watch-sync fallback.
         self._fallback_degraded = False
         self._breaker_degraded = False
+        self._freshness_degraded = False
+        self._startup_degraded = False
         self.degraded = False
         self.last_success: Optional[float] = None
         self.planner_fallback_total = 0
         self.consecutive_errors = 0
         self.breaker_interval: Optional[float] = None
         self.taints_recovered_total = 0
+        self.mirror_staleness_s: Optional[float] = None
 
     def reset(self) -> None:
         """Back to process-start state (test isolation)."""
@@ -42,12 +47,15 @@ class HealthState:
             self._now = None
             self._fallback_degraded = False
             self._breaker_degraded = False
+            self._freshness_degraded = False
+            self._startup_degraded = False
             self.degraded = False
             self.last_success = None
             self.planner_fallback_total = 0
             self.consecutive_errors = 0
             self.breaker_interval = None
             self.taints_recovered_total = 0
+            self.mirror_staleness_s = None
         self._mirror_gauge(False)
 
     def set_clock(self, now_fn: Callable[[], float]) -> None:
@@ -63,6 +71,16 @@ class HealthState:
 
         metrics.update_degraded(degraded)
 
+    def _degraded_locked(self) -> bool:
+        """Recompute the OR over causes; caller holds the lock."""
+        self.degraded = (
+            self._fallback_degraded
+            or self._breaker_degraded
+            or self._freshness_degraded
+            or self._startup_degraded
+        )
+        return self.degraded
+
     def note_success(self, *, fallback: bool = False) -> None:
         """A tick completed (observe + plan + actuate all ran).
         ``fallback``: the plan came from the CPU fallback planner — the
@@ -75,8 +93,7 @@ class HealthState:
             self.breaker_interval = None
             self._breaker_degraded = False
             self._fallback_degraded = bool(fallback)
-            self.degraded = self._fallback_degraded
-            degraded = self.degraded
+            degraded = self._degraded_locked()
         self._mirror_gauge(degraded)
 
     def note_planner_fallback(self) -> None:
@@ -95,8 +112,7 @@ class HealthState:
             self.consecutive_errors = 0
             self.breaker_interval = None
             self._breaker_degraded = False
-            self.degraded = self._fallback_degraded
-            degraded = self.degraded
+            degraded = self._degraded_locked()
         self._mirror_gauge(degraded)
 
     def note_error(
@@ -109,8 +125,29 @@ class HealthState:
             self.consecutive_errors = int(consecutive)
             self.breaker_interval = breaker_interval
             self._breaker_degraded = breaker_interval is not None
-            self.degraded = self._fallback_degraded or self._breaker_degraded
-            degraded = self.degraded
+            degraded = self._degraded_locked()
+        self._mirror_gauge(degraded)
+
+    def note_mirror_staleness(self, staleness: float, budget: float) -> None:
+        """The freshness gate's per-tick verdict: the watch mirror's age
+        versus its budget. Over-budget marks the loop degraded until a
+        later gate finds the mirror fresh again — the bypassed ticks
+        still complete, so ``note_success`` alone must not clear it."""
+        with self._lock:
+            self.mirror_staleness_s = (
+                None if staleness == float("inf") else round(staleness, 3)
+            )
+            self._freshness_degraded = budget > 0 and staleness > budget
+            degraded = self._degraded_locked()
+        self._mirror_gauge(degraded)
+
+    def note_startup_degraded(self) -> None:
+        """The watch caches failed to sync at startup and the loop fell
+        back to the polling client — sticky for the process lifetime
+        (the cache path never re-engages without a restart)."""
+        with self._lock:
+            self._startup_degraded = True
+            degraded = self._degraded_locked()
         self._mirror_gauge(degraded)
 
     def note_taint_recovered(self) -> None:
@@ -134,6 +171,7 @@ class HealthState:
                 "consecutive_tick_errors": self.consecutive_errors,
                 "breaker_interval_s": self.breaker_interval,
                 "taints_recovered_total": self.taints_recovered_total,
+                "mirror_staleness_s": self.mirror_staleness_s,
             }
 
 

@@ -218,9 +218,130 @@ orphaned_taints_recovered = Counter(
 rescheduler_degraded = Gauge(
     "rescheduler_degraded",
     "1 while the control loop is degraded: the last completed tick ran "
-    "on the fallback planner, or the observe-error circuit breaker is "
+    "on the fallback planner, the observe-error circuit breaker is "
     "engaged (consecutive failed ticks past the threshold widened the "
-    "housekeeping interval).",
+    "housekeeping interval), the watch mirror is staler than the "
+    "freshness budget, or the watch caches failed to sync at startup "
+    "and the loop fell back to polling LISTs.",
+    namespace=NAMESPACE,
+)
+
+
+# Watch-liveness / freshness observability (freshness-gated observe path,
+# docs/ROBUSTNESS.md): the watch mirror is only trustworthy because these
+# series prove it — a wedged-open stream, a drifted mirror, or a tick
+# planned from stale data must each be visible, not inferred from logs.
+
+watch_events = Counter(
+    "watch_events",
+    "Object events (ADDED/MODIFIED/DELETED) applied to a watch cache "
+    "(io/watch.py; BOOKMARKs advance the resourceVersion without "
+    "counting here).",
+    ["resource"],
+    namespace=NAMESPACE,
+)
+
+watch_relists = Counter(
+    "watch_relists",
+    "Full re-LISTs a watcher performed: the seeding LIST, 410-Gone "
+    "recovery, and post-error reconciliation (the anti-entropy audit's "
+    "LIST counts under resync_audits instead).",
+    ["resource"],
+    namespace=NAMESPACE,
+)
+
+watch_stream_errors = Counter(
+    "watch_stream_errors",
+    "Watch streams that died with a transport/protocol error and were "
+    "reconnected after a backed-off re-LIST (progress-deadline stalls "
+    "count under watch_stalls instead).",
+    ["resource"],
+    namespace=NAMESPACE,
+)
+
+watch_stalls = Counter(
+    "watch_stalls",
+    "Watch streams killed by the client-side progress deadline: open "
+    "but silent past watch_progress_deadline (no event, no bookmark, "
+    "no server close). The stream reconnects from its last "
+    "resourceVersion without a re-LIST — the version is still valid; "
+    "nothing was missed, the transport was just wedged.",
+    ["resource"],
+    namespace=NAMESPACE,
+)
+
+watch_drift = Counter(
+    "watch_drift",
+    "Objects the anti-entropy resync audit found FIELD-LEVEL diverged "
+    "between a fresh LIST and the incremental watch mirror: present on "
+    "both sides, untouched by the stream across the audit window, yet "
+    "carrying different content. Any increment forces a store replace "
+    "+ full repack and emits a WatchDriftHealed event — drift is never "
+    "silent. Alarm on a sustained rate: it means the watch protocol or "
+    "the mirror is corrupting or dropping updates (a lone increment "
+    "can be a MODIFIED still in flight at the LIST instant).",
+    ["resource"],
+    namespace=NAMESPACE,
+)
+
+watch_presence_heals = Counter(
+    "watch_presence_heals",
+    "Objects the audit added or removed to re-sync mirror PRESENCE "
+    "with a fresh LIST (missing or phantom entries). Usually an "
+    "ADDED/DELETED event still in flight when the LIST was issued — "
+    "ordinary lag, healed by the same store replace but kept apart "
+    "from the alarm-grade watch_drift series so routine churn does "
+    "not page anyone.",
+    ["resource"],
+    namespace=NAMESPACE,
+)
+
+resync_audits = Counter(
+    "resync_audits",
+    "Completed anti-entropy audits: one background LIST per resource "
+    "diffed field-by-field against the watch mirror, every "
+    "resync_interval. A clean audit also re-proves mirror freshness "
+    "(the mirror equals a fresh LIST by construction).",
+    namespace=NAMESPACE,
+)
+
+mirror_staleness = Gauge(
+    "mirror_staleness_seconds",
+    "Age of the watch mirror at the last tick's freshness gate: wall "
+    "seconds since every watch stream last proved progress (event, "
+    "bookmark, clean server close, successful re-LIST, or clean "
+    "audit). Past mirror_staleness_budget the tick refuses to plan "
+    "from the mirror.",
+    namespace=NAMESPACE,
+)
+
+freshness_bypass = Counter(
+    "freshness_bypass",
+    "Ticks whose freshness gate found the watch mirror staler than "
+    "mirror_staleness_budget and degraded the observe path to a "
+    "direct apiserver LIST, bypassing the sick cache (first rung of "
+    "the degradation ladder; the second is skip-tick + the circuit "
+    "breaker when no direct path exists or it too fails).",
+    namespace=NAMESPACE,
+)
+
+mirror_stale_planned = Counter(
+    "mirror_stale_planned",
+    "Ticks the last-line freshness guard caught about to PLAN from a "
+    "mirror that aged past mirror_staleness_budget between the gate "
+    "and the plan dispatch — the tick is skipped instead, so no "
+    "eviction is ever planned from over-budget data. Structurally "
+    "zero in healthy operation; any nonzero value means the gate was "
+    "outrun and must be alarmed on.",
+    namespace=NAMESPACE,
+)
+
+observe_delta_events = Gauge(
+    "observe_delta_events",
+    "Watch deltas drained into the columnar mirror at the last tick's "
+    "freeze (0 on a quiet cluster — the observe+pack path is then a "
+    "cache hit; the full LIST survives only as the anti-entropy "
+    "audit).",
     namespace=NAMESPACE,
 )
 
@@ -311,6 +432,50 @@ def update_degraded(degraded: bool) -> None:
     rescheduler_degraded.set(1 if degraded else 0)
 
 
+def update_watch_event(resource: str) -> None:
+    watch_events.labels(resource).inc()
+
+
+def update_watch_relist(resource: str) -> None:
+    watch_relists.labels(resource).inc()
+
+
+def update_watch_stream_error(resource: str) -> None:
+    watch_stream_errors.labels(resource).inc()
+
+
+def update_watch_stall(resource: str) -> None:
+    watch_stalls.labels(resource).inc()
+
+
+def update_watch_drift(resource: str, n: int) -> None:
+    watch_drift.labels(resource).inc(n)
+
+
+def update_watch_presence_heal(resource: str, n: int) -> None:
+    watch_presence_heals.labels(resource).inc(n)
+
+
+def update_resync_audit() -> None:
+    resync_audits.inc()
+
+
+def update_mirror_staleness(seconds: float) -> None:
+    mirror_staleness.set(seconds)
+
+
+def update_freshness_bypass() -> None:
+    freshness_bypass.inc()
+
+
+def update_mirror_stale_planned() -> None:
+    mirror_stale_planned.inc()
+
+
+def update_observe_delta_events(n: int) -> None:
+    observe_delta_events.set(n)
+
+
 def _counter_value(counter) -> float:
     for sample in counter.collect()[0].samples:
         if sample.name.endswith("_total"):
@@ -330,6 +495,39 @@ def robustness_snapshot() -> dict:
         "planner_fallback": _counter_value(planner_fallback),
         "orphaned_taints_recovered": _counter_value(orphaned_taints_recovered),
         "degraded": degraded,
+    }
+
+
+def _labeled_counter_total(counter) -> float:
+    total = 0.0
+    for sample in counter.collect()[0].samples:
+        if sample.name.endswith("_total"):
+            total += sample.value
+    return total
+
+
+def freshness_snapshot() -> dict:
+    """Current watch-liveness/freshness counters via the public
+    collect() API (tests and the soak harness diff before/after;
+    labeled counters are summed across resources)."""
+    staleness = 0.0
+    for sample in mirror_staleness.collect()[0].samples:
+        staleness = sample.value
+    delta_events = 0.0
+    for sample in observe_delta_events.collect()[0].samples:
+        delta_events = sample.value
+    return {
+        "watch_events": _labeled_counter_total(watch_events),
+        "watch_relists": _labeled_counter_total(watch_relists),
+        "watch_stream_errors": _labeled_counter_total(watch_stream_errors),
+        "watch_stalls": _labeled_counter_total(watch_stalls),
+        "watch_drift": _labeled_counter_total(watch_drift),
+        "watch_presence_heals": _labeled_counter_total(watch_presence_heals),
+        "resync_audits": _counter_value(resync_audits),
+        "freshness_bypass": _counter_value(freshness_bypass),
+        "mirror_stale_planned": _counter_value(mirror_stale_planned),
+        "mirror_staleness_seconds": staleness,
+        "observe_delta_events": delta_events,
     }
 
 

@@ -154,6 +154,33 @@ class ReschedulerConfig:
     # seeded chaos layer. Empty profile = off (production default).
     chaos_profile: str = ""
     chaos_seed: int = 0
+    # Per-stream-open probability that an injected chaos watch stream is
+    # open but SILENT until the client's read timeout (the wedged-stream
+    # failure mode the progress deadline exists to catch). Mixed into
+    # whatever --chaos-profile selects; 0 with chaos off is inert.
+    chaos_watch_stall_rate: float = 0.0
+    # --- freshness-gated observe path (docs/ROBUSTNESS.md) ---
+    # Client-side watch progress deadline (io/watch.py): a stream that
+    # delivers no event, bookmark, or clean server close for this long
+    # is killed and reconnected from its last resourceVersion
+    # (client-go's WatchProgressRequester/UnwedgeTimeout analog — the
+    # server-side timeoutSeconds alone cannot catch a wedged transport).
+    # 0 disables (server timeouts only).
+    watch_progress_deadline: float = 120.0
+    # Freshness gate (loop/controller.py): a tick whose watch mirror is
+    # older than this budget refuses to plan from it — it degrades to a
+    # direct apiserver LIST, or skips the tick (feeding the circuit
+    # breaker) when no direct path exists. 0 disables the gate.
+    mirror_staleness_budget: float = 60.0
+    # Anti-entropy resync audit (io/watch.py): every interval, one
+    # LIST per watched resource is diffed field-by-field against the
+    # incremental mirror; drift forces a store replace + full repack
+    # and is counted, evented, and never silent (client-go informers'
+    # resyncPeriod analog, upgraded from blind replay to a verified
+    # diff). Runs inline on the tick thread — one tick per interval
+    # pays the LIST cost; background in cadence, not threading.
+    # 0 disables.
+    resync_interval: float = 300.0
 
     def __post_init__(self):
         from k8s_spot_rescheduler_tpu.utils.labels import validate_label
@@ -172,3 +199,17 @@ class ReschedulerConfig:
             raise ValueError("kube_retry_base must be > 0")
         if self.breaker_threshold < 0:
             raise ValueError("breaker_threshold must be >= 0 (0 = off)")
+        if self.watch_progress_deadline < 0:
+            raise ValueError(
+                "watch_progress_deadline must be >= 0 (0 = off)"
+            )
+        if self.mirror_staleness_budget < 0:
+            raise ValueError(
+                "mirror_staleness_budget must be >= 0 (0 = off)"
+            )
+        if self.resync_interval < 0:
+            raise ValueError("resync_interval must be >= 0 (0 = off)")
+        if not 0.0 <= self.chaos_watch_stall_rate <= 1.0:
+            raise ValueError(
+                "chaos_watch_stall_rate must be a probability in [0, 1]"
+            )

@@ -49,6 +49,39 @@ def test_robustness_flags_flow_into_config():
     assert cfg.chaos_seed == 9
 
 
+def test_freshness_defaults():
+    cfg = config_from_args(build_parser().parse_args([]))
+    assert cfg.watch_progress_deadline == 120.0  # "2m"
+    assert cfg.mirror_staleness_budget == 60.0  # "1m"
+    assert cfg.resync_interval == 300.0  # "5m"
+    assert cfg.chaos_watch_stall_rate == 0.0  # chaos stays opt-in
+
+
+def test_freshness_flags_flow_into_config():
+    args = build_parser().parse_args(
+        ["--watch-progress-deadline", "30s",
+         "--mirror-staleness-budget", "45s",
+         "--resync-interval", "10m",
+         "--chaos-watch-stall-rate", "0.25"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.watch_progress_deadline == 30.0
+    assert cfg.mirror_staleness_budget == 45.0
+    assert cfg.resync_interval == 600.0
+    assert cfg.chaos_watch_stall_rate == 0.25
+
+
+def test_freshness_zero_disables():
+    cfg = config_from_args(build_parser().parse_args(
+        ["--watch-progress-deadline", "0",
+         "--mirror-staleness-budget", "0",
+         "--resync-interval", "0"]
+    ))
+    assert cfg.watch_progress_deadline == 0.0
+    assert cfg.mirror_staleness_budget == 0.0
+    assert cfg.resync_interval == 0.0
+
+
 def test_chaos_demo_run():
     """Full binary path under fault injection: the seeded chaos wrapper
     engages and the bounded run still exits cleanly."""

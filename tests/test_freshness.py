@@ -1,0 +1,555 @@
+"""Freshness-gated observe path (docs/ROBUSTNESS.md): watch liveness
+deadlines, the controller's mirror-staleness gate and its degradation
+ladder, the anti-entropy resync audit, the startup watch-sync fallback,
+the zero-churn pack memo — and the headline seeded soak (≥300 virtual
+ticks with open-but-silent stalls, scripted 410s and one injected mirror
+corruption; all invariants asserted via the new metrics)."""
+
+import dataclasses
+
+import pytest
+
+import bench
+from k8s_spot_rescheduler_tpu.io.chaos import ChaosClusterClient, FaultPlan
+from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+from k8s_spot_rescheduler_tpu.io.fakewatch import (
+    ScriptedWatchSource,
+    raw_node,
+    raw_pod,
+)
+from k8s_spot_rescheduler_tpu.io.kube import decode_pod
+from k8s_spot_rescheduler_tpu.io.watch import (
+    ResourceStore,
+    Watcher,
+    WatchingKubeClusterClient,
+)
+from k8s_spot_rescheduler_tpu.loop import health
+from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+from k8s_spot_rescheduler_tpu.metrics.registry import freshness_snapshot
+from k8s_spot_rescheduler_tpu.models.columnar import ColumnarStore
+from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock, RealClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.fixtures import ON_DEMAND_LABELS, SPOT_LABELS, make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _reset_health():
+    health.STATE.reset()
+    yield
+    health.STATE.reset()
+
+
+def _meta_key(obj):
+    return WatchingKubeClusterClient._meta_key(obj)
+
+
+def _pod_watcher(src, **kw):
+    store = ResourceStore()
+    return Watcher(
+        src, "/api/v1/pods", decode_pod, _meta_key, store, name="pods", **kw
+    ), store
+
+
+# --- watch liveness: stalls, bookmarks, 410 throttling, prompt stop ---
+
+
+def test_stall_detected_and_reconnects_without_relist():
+    """An open-but-silent stream past the progress deadline is killed,
+    counted as a stall, and reconnected from the SAME resourceVersion
+    with no re-LIST (a wedge loses no events)."""
+    clock = FakeClock(1000.0)
+    src = ScriptedWatchSource()
+    src.objects["pods"]["uid-a"] = raw_pod("a", "od-1")
+    chaos = ChaosClusterClient(
+        src, FaultPlan(watch_stall_rate=1.0), clock=clock
+    )
+    w, store = _pod_watcher(
+        chaos, clock=clock, progress_deadline=60.0, wait_fn=clock.sleep
+    )
+    w.step()  # LIST seeds the store; the stream then stalls
+    assert len(store.snapshot()) == 1
+    assert w.relist_count == 1 and w.stall_count == 1
+    assert w.stream_error_count == 0
+    # the stall consumed exactly one client read timeout of virtual time
+    assert clock.wall() == 1000.0 + 60.0 + 30.0
+    # the deadline-killed stream did NOT count as progress
+    assert w.staleness() == pytest.approx(90.0)
+    # recovery: faults off, a queued event arrives on the reconnect —
+    # served from the same rv without another LIST
+    chaos.enabled = False
+    src.push("pods", "ADDED", raw_pod("b", "od-1"))
+    w.step()
+    assert len(store.snapshot()) == 2
+    assert w.relist_count == 1  # never re-listed
+    assert w.staleness() == 0.0
+
+
+def test_bookmark_advances_rv_without_touching_store():
+    clock = FakeClock(0.0)
+    src = ScriptedWatchSource()
+    src.objects["pods"]["uid-a"] = raw_pod("a", "od-1")
+    w, store = _pod_watcher(src, clock=clock, wait_fn=clock.sleep)
+    w.step()
+    snap_before = store.snapshot_items()
+    events_before = w.event_count
+    src.bookmark("pods")
+    bookmark_rv = src.rv["pods"]
+    w.step()  # consumes only the BOOKMARK
+    assert store.snapshot_items() == snap_before  # store untouched
+    assert w.event_count == events_before  # bookmarks are not events
+    # the NEXT stream resumes from the bookmark's version, not the LIST's
+    w.step()
+    resource, rv = src.watch_params[-1]
+    assert resource == "pods" and rv is not None
+    assert int(rv) >= bookmark_rv
+
+
+def test_410_mid_stream_triggers_exactly_one_throttled_relist():
+    clock = FakeClock(0.0)
+    src = ScriptedWatchSource()
+    src.objects["pods"]["uid-a"] = raw_pod("a", "od-1")
+    waits = []
+    w, store = _pod_watcher(src, clock=clock, wait_fn=waits.append)
+    w.step()
+    assert w.relist_count == 1
+    # an event lands, then the version expires mid-stream
+    src.push("pods", "ADDED", raw_pod("b", "od-1"))
+    src.queues["pods"].append({
+        "type": "ERROR",
+        "object": {"kind": "Status", "code": 410, "reason": "Expired"},
+    })
+    w.step()  # applies ADDED, hits the 410, backs off — NO list yet
+    assert len(store.snapshot()) == 2  # the pre-410 event was applied
+    assert w.relist_count == 1
+    assert waits == [1.0]  # one throttled backoff pause
+    w.step()  # exactly one recovery re-LIST
+    assert w.relist_count == 2
+    assert len(store.snapshot()) == 2
+    w.step()  # healthy again: stream resumes, no further lists
+    assert w.relist_count == 2
+
+
+def test_stop_during_reconnect_backoff_returns_promptly():
+    class _Down:
+        """A source whose LIST always fails: the watcher sits in its
+        reconnect backoff forever."""
+
+        use_native_ingest = False
+
+        def _request(self, method, path, body=None, **kw):
+            raise ConnectionResetError("apiserver down")
+
+        def _stream(self, path, read_timeout=330.0):
+            raise ConnectionResetError("apiserver down")
+            yield  # pragma: no cover
+
+    import time
+
+    w, _ = _pod_watcher(_Down())
+    w._backoff = 30.0  # as if several failures already backed off
+    w.start()
+    time.sleep(0.1)  # let it enter the backoff wait
+    t0 = time.monotonic()
+    w.stop()
+    w.join(timeout=5.0)
+    assert not w.is_alive()
+    assert time.monotonic() - t0 < 2.0  # stop() cut the 30 s wait short
+
+
+def test_list_timeout_is_a_stream_error_not_a_stall():
+    """A timing-out LIST must keep the exponential relist backoff —
+    classifying it as a stall would retry the LIST in a tight loop
+    against an already-struggling apiserver."""
+
+    class _TimeoutList:
+        use_native_ingest = False
+
+        def _request(self, method, path, body=None, **kw):
+            raise TimeoutError("LIST timed out")
+
+        def _stream(self, path, read_timeout=330.0):
+            raise AssertionError("never reached: the LIST failed first")
+            yield  # pragma: no cover
+
+    waits = []
+    w, _ = _pod_watcher(
+        _TimeoutList(), clock=FakeClock(0.0), progress_deadline=60.0,
+        wait_fn=waits.append,
+    )
+    w.step()
+    assert w.stall_count == 0
+    assert w.stream_error_count == 1
+    assert waits == [1.0]  # backed off, did not spin
+    assert w._need_list  # and will re-LIST (with backoff), not re-watch
+
+
+def test_restart_mid_stream_discards_undelivered_stale_events():
+    """When an audit heal lands while the old stream still has queued
+    events, the watcher must abandon the stream BEFORE applying them —
+    a stale event applied on top of the healed store would never be
+    redelivered by the resumed (past-it) stream."""
+    src = ScriptedWatchSource()
+    src.objects["pods"]["uid-a"] = raw_pod("a", "od-1", cpu_millis=500)
+    w, store = _pod_watcher(src, clock=FakeClock(0.0))
+    w.step()  # seed
+
+    # two queued events: applying the FIRST triggers the "audit heal"
+    # (as the audit thread would, concurrently); the SECOND is the
+    # stale one that must now be discarded
+    src.push("pods", "ADDED", raw_pod("b", "od-1"))
+    src.queues["pods"].append(
+        {"type": "MODIFIED", "object": raw_pod("a", "od-1", cpu_millis=1)}
+    )
+    healed = dict(store.snapshot_items())
+
+    def on_mutation(action, key, obj):
+        w.restart_from("999")
+
+    store._listener = on_mutation
+    w.step()
+    store._listener = None
+    pods = {p.name: p for p in store.snapshot()}
+    assert "b" in pods  # the pre-heal event was applied...
+    assert pods["a"].requests["cpu"] == 500  # ...the stale one was NOT
+    w.step()  # resumes from the audit's rv without a re-LIST
+    assert w.relist_count == 1
+    assert src.watch_params[-1] == ("pods", "999")
+
+
+# --- the anti-entropy resync audit ---
+
+
+def _synced_watch_client(clock=None):
+    clock = clock or FakeClock(1_000.0)
+    src = ScriptedWatchSource()
+    for i in range(2):
+        src.objects["nodes"][f"uid-od-{i}"] = raw_node(f"od-{i}", "worker")
+    src.objects["nodes"]["uid-spot-0"] = raw_node("spot-0", "spot-worker")
+    for i in range(3):
+        src.objects["pods"][f"uid-p{i}"] = raw_pod(
+            f"p{i}", "od-0", cpu_millis=100 + 100 * i
+        )
+    wc = WatchingKubeClusterClient(
+        src, clock=clock, progress_deadline=120.0, wait_fn=clock.sleep
+    )
+    wc.start(background=False)
+    return src, wc, clock
+
+
+def test_audit_clean_mirror_counts_no_drift():
+    src, wc, clock = _synced_watch_client()
+    before = freshness_snapshot()
+    items_before = wc.pods.snapshot_items()
+    drift = wc.resync_audit()
+    assert drift == {"nodes": 0, "pods": 0, "pdbs": 0}
+    after = freshness_snapshot()
+    assert after["watch_drift"] == before["watch_drift"]
+    assert after["resync_audits"] == before["resync_audits"] + 1
+    # a clean audit does NOT replace the store (same objects, no churn
+    # into the columnar feed)
+    assert wc.pods.snapshot_items() == items_before
+    assert all(
+        a is b
+        for (_, a), (_, b) in zip(items_before, wc.pods.snapshot_items())
+    )
+
+
+def test_audit_detects_and_heals_corruption_and_missed_events():
+    src, wc, clock = _synced_watch_client()
+    before = freshness_snapshot()
+    # field-level corruption in the mirror
+    key, pod = wc.pods.snapshot_items()[0]
+    wc.pods.upsert(key, dataclasses.replace(pod, priority=777))
+    # plus an event the (dead) stream never delivered: a phantom delete
+    src.objects["pods"].pop("uid-p2")
+    drift = wc.resync_audit()
+    assert drift["pods"] == 2  # one corrupted field, one phantom object
+    after = freshness_snapshot()
+    # split series: field-level corruption is alarm-grade drift, the
+    # phantom (a delete the stream never delivered) is a presence heal
+    assert after["watch_drift"] == before["watch_drift"] + 1
+    assert (
+        after["watch_presence_heals"]
+        == before["watch_presence_heals"] + 1
+    )
+    # healed: the mirror now equals the truth exactly
+    mirror = {k: p for k, p in wc.pods.snapshot_items()}
+    assert set(mirror) == set(src.objects["pods"])
+    assert all(p.priority == 0 for p in mirror.values())
+
+
+def test_audit_tolerates_churn_landing_during_the_fetch(monkeypatch):
+    """An event applied while the audit's LIST is in flight makes the
+    mirror legitimately differ from the LIST — that is churn, not
+    drift, and must not be counted or healed backwards."""
+    src, wc, clock = _synced_watch_client()
+    orig_fetch = Watcher._fetch
+
+    def racy_fetch(self, *, native=True):
+        items, rv = orig_fetch(self, native=native)
+        if self.resource == "pods":
+            # a watch event lands between the LIST response and the
+            # diff (what the watcher thread does in production)
+            key, pod = self.store.snapshot_items()[0]
+            self.store.upsert(key, dataclasses.replace(pod, priority=5))
+        return items, rv
+
+    monkeypatch.setattr(Watcher, "_fetch", racy_fetch)
+    before = freshness_snapshot()
+    drift = wc.resync_audit()
+    assert drift["pods"] == 0
+    assert freshness_snapshot()["watch_drift"] == before["watch_drift"]
+    # and the mid-audit event survived (no backwards heal)
+    assert any(p.priority == 5 for p in wc.pods.snapshot())
+
+
+def test_audit_clean_audit_restamps_liveness():
+    src, wc, clock = _synced_watch_client()
+    clock.advance(500.0)  # streams silent: mirror looks ancient
+    assert wc.mirror_staleness() == pytest.approx(500.0)
+    wc.resync_audit()
+    # mirror == fresh LIST was just proven; staleness resets
+    assert wc.mirror_staleness() == 0.0
+
+
+def test_controller_runs_audit_on_schedule_and_events_drift():
+    clock = FakeClock(1_000.0)
+    src, wc, _ = _synced_watch_client(clock)
+    config = ReschedulerConfig(
+        solver="numpy", resync_interval=50.0, node_drain_delay=1e6,
+        mirror_staleness_budget=0.0,  # isolate the audit from the gate
+    )
+    r = Rescheduler(wc, SolverPlanner(config), config, clock=clock,
+                    recorder=wc)
+
+    def advance_tick(seconds):
+        clock.advance(seconds)
+        for w in wc._watchers:
+            w.step()
+        return r.tick()
+
+    advance_tick(0.0)  # first tick arms the schedule, no audit
+    before = freshness_snapshot()
+    advance_tick(10.0)  # not due yet
+    assert freshness_snapshot()["resync_audits"] == before["resync_audits"]
+    # corrupt the mirror (a node: drains never delete those), then
+    # advance past the interval
+    node = dict(wc.nodes.snapshot_items())["uid-spot-0"]
+    wc.nodes.upsert("uid-spot-0", dataclasses.replace(
+        node, allocatable={**node.allocatable, "cpu": 1}
+    ))
+    advance_tick(60.0)
+    snap = freshness_snapshot()
+    assert snap["resync_audits"] == before["resync_audits"] + 1
+    assert snap["watch_drift"] == before["watch_drift"] + 1
+    assert any(
+        e[2:4] == ("Warning", "WatchDriftHealed") for e in src.events
+    ), src.events
+
+
+# --- the freshness gate ---
+
+
+class _MirrorFacade:
+    """FakeCluster behind a controllable mirror_staleness(); the
+    controller sees the watch-client surface without real watchers."""
+
+    def __init__(self, inner, staleness_values, with_direct=True):
+        self.inner = inner
+        self._staleness = list(staleness_values)
+        self.direct_calls = 0
+        if not with_direct:
+            # hide the bypass path entirely
+            self.direct_client = None
+
+    def mirror_staleness(self):
+        # last value repeats (the gate may sample more than once)
+        if len(self._staleness) > 1:
+            return self._staleness.pop(0)
+        return self._staleness[0]
+
+    def direct_client(self):
+        self.direct_calls += 1
+        return self.inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _drainable_fake():
+    clock = FakeClock()
+    fc = FakeCluster(clock, reschedule_evicted=True)
+    fc.add_node(make_node("od-small", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    for i, cpu in enumerate([300, 200, 100]):
+        fc.add_pod(make_pod(f"small-{i}", cpu, "od-small"))
+    return fc, clock
+
+
+def test_gate_fresh_mirror_plans_normally():
+    fc, clock = _drainable_fake()
+    facade = _MirrorFacade(fc, [5.0])
+    config = ReschedulerConfig(solver="numpy", mirror_staleness_budget=60.0)
+    r = Rescheduler(facade, SolverPlanner(config), config, clock=clock,
+                    recorder=fc)
+    result = r.tick()
+    assert result.skipped == ""
+    assert facade.direct_calls == 0
+    assert health.STATE.snapshot()["degraded"] is False
+    assert health.STATE.snapshot()["mirror_staleness_s"] == 5.0
+
+
+def test_gate_stale_mirror_bypasses_to_direct_list():
+    fc, clock = _drainable_fake()
+    facade = _MirrorFacade(fc, [500.0])
+    config = ReschedulerConfig(solver="numpy", mirror_staleness_budget=60.0)
+    r = Rescheduler(facade, SolverPlanner(config), config, clock=clock,
+                    recorder=fc)
+    before = freshness_snapshot()
+    result = r.tick()
+    # the tick COMPLETED — on direct LISTs, not the sick mirror
+    assert result.skipped == ""
+    assert result.drained == ["od-small"]
+    assert facade.direct_calls == 1
+    snap = freshness_snapshot()
+    assert snap["freshness_bypass"] == before["freshness_bypass"] + 1
+    assert snap["mirror_stale_planned"] == before["mirror_stale_planned"]
+    assert health.STATE.snapshot()["degraded"] is True
+    # mirror recovers → gate passes → degradation clears
+    facade._staleness = [1.0]
+    r.next_drain_time = clock.now()  # disarm the post-drain cooldown
+    assert r.tick().skipped == ""
+    assert health.STATE.snapshot()["degraded"] is False
+
+
+def test_gate_stale_mirror_without_direct_path_skips_into_breaker():
+    fc, clock = _drainable_fake()
+    facade = _MirrorFacade(fc, [500.0], with_direct=False)
+    config = ReschedulerConfig(
+        solver="numpy", mirror_staleness_budget=60.0, breaker_threshold=2
+    )
+    r = Rescheduler(facade, SolverPlanner(config), config, clock=clock,
+                    recorder=fc)
+    for _ in range(3):
+        assert r.tick().skipped == "error"
+    assert r.breaker_engaged
+    assert r.effective_interval() > config.housekeeping_interval
+
+
+def test_gate_last_line_guard_refuses_plan_from_aged_mirror():
+    """If the mirror ages past the budget BETWEEN the gate and the plan
+    dispatch, the tick is refused and the (alarm) counter increments —
+    no eviction is ever planned from over-budget data."""
+    fc, clock = _drainable_fake()
+    facade = _MirrorFacade(fc, [5.0, 500.0])  # gate sees 5 s, plan 500 s
+    config = ReschedulerConfig(solver="numpy", mirror_staleness_budget=60.0)
+    r = Rescheduler(facade, SolverPlanner(config), config, clock=clock,
+                    recorder=fc)
+    before = freshness_snapshot()
+    result = r.tick()
+    assert result.skipped == "error"
+    assert result.drained == []
+    snap = freshness_snapshot()
+    assert snap["mirror_stale_planned"] == before["mirror_stale_planned"] + 1
+
+
+def test_gate_disabled_budget_zero_is_inert():
+    fc, clock = _drainable_fake()
+    facade = _MirrorFacade(fc, [1e9])
+    config = ReschedulerConfig(solver="numpy", mirror_staleness_budget=0.0)
+    r = Rescheduler(facade, SolverPlanner(config), config, clock=clock,
+                    recorder=fc)
+    assert r.tick().skipped == ""
+    assert facade.direct_calls == 0
+
+
+# --- startup graceful degradation (cli/main.py satellite) ---
+
+
+def test_watch_sync_failure_falls_back_to_polling_client(monkeypatch):
+    from k8s_spot_rescheduler_tpu.cli.main import start_watch_client
+
+    def boom(self, *a, **k):
+        raise TimeoutError("watch cache for pods failed to sync")
+
+    monkeypatch.setattr(WatchingKubeClusterClient, "start", boom)
+    src = ScriptedWatchSource()
+    out = start_watch_client(src, ReschedulerConfig(), RealClock())
+    assert out is src  # the polling client, not the dead watch wrapper
+    assert health.STATE.snapshot()["degraded"] is True
+    # sticky: a later successful tick does not clear the startup cause
+    health.STATE.note_success()
+    assert health.STATE.snapshot()["degraded"] is True
+
+
+def test_watch_sync_success_returns_watch_client(monkeypatch):
+    monkeypatch.setattr(
+        WatchingKubeClusterClient, "start", lambda self, *a, **k: None
+    )
+    from k8s_spot_rescheduler_tpu.cli.main import start_watch_client
+
+    src = ScriptedWatchSource()
+    out = start_watch_client(src, ReschedulerConfig(), RealClock())
+    assert isinstance(out, WatchingKubeClusterClient)
+    assert health.STATE.snapshot()["degraded"] is False
+
+
+# --- zero-churn pack memo (the O(churn) observe+pack tail) ---
+
+
+def test_pack_memo_hits_on_quiet_tick_and_invalidates_on_churn():
+    store = ColumnarStore(
+        ("cpu", "memory"),
+        on_demand_label="kubernetes.io/role=worker",
+        spot_label="kubernetes.io/role=spot-worker",
+    )
+    store.pack_memo_enabled = True
+    store.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    store.add_node(make_node("spot-1", SPOT_LABELS))
+    store.add_pod(make_pod("a", 300, "od-1"))
+    p1, m1 = store.pack([])
+    p2, m2 = store.pack([])
+    assert p1 is p2 and m1 is m2  # quiet tick: O(1) observe+pack
+    store.add_pod(make_pod("b", 200, "od-1"))
+    p3, _ = store.pack([])
+    assert p3 is not p1
+    assert bool(p3.slot_valid[:, 1].any())  # the new pod is packed
+    # parameter changes must also miss
+    p4, _ = store.pack([], priority_threshold=5)
+    assert p4 is not p3
+
+
+def test_pack_memo_off_by_default():
+    store = ColumnarStore(
+        ("cpu", "memory"),
+        on_demand_label="kubernetes.io/role=worker",
+        spot_label="kubernetes.io/role=spot-worker",
+    )
+    store.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    p1, _ = store.pack([])
+    p2, _ = store.pack([])
+    assert p1 is not p2
+
+
+# --- the headline seeded soak (acceptance criteria) ---
+
+
+def test_watch_soak_300_ticks():
+    """≥300 virtual ticks under watch stalls, stream drops, two scripted
+    410s, and one injected mirror corruption: zero crashes, zero ticks
+    planned from an over-budget mirror, drift healed within one resync
+    interval, stalls detected, every full LIST accounted to a relist or
+    an audit, and end-state mirror/LIST pack parity — all asserted via
+    the new metrics inside bench.watch_soak."""
+    stats, violations = bench.watch_soak(300, seed=0)
+    assert violations == []
+    assert stats["ticks"] == 300
+    assert stats["stalls_detected"] >= 1
+    assert stats["scripted_410s"] == 2
+    assert stats["drift_objects_healed"] >= 1
+    assert stats["mirror_stale_planned"] == 0
+    assert stats["freshness_bypass_ticks"] >= 1
+    assert stats["resync_audits"] >= 1
+    assert stats["mirror_parity"] is True

@@ -30,38 +30,19 @@ from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 WATCH_SLICE_SECONDS = 0.25
 
 
+# raw API-object builders shared with the scripted watch double — one
+# source of truth for the shapes the decoders are exercised against
+from k8s_spot_rescheduler_tpu.io.fakewatch import raw_node, raw_pod
+
+
 def _node(name, role, ready=True):
-    return {
-        "metadata": {"name": name, "uid": f"uid-{name}",
-                     "labels": {"kubernetes.io/role": role},
-                     "resourceVersion": "1"},
-        "spec": {},
-        "status": {
-            "allocatable": {"cpu": "2", "memory": "4Gi", "pods": "110"},
-            "conditions": [
-                {"type": "Ready", "status": "True" if ready else "False"}
-            ],
-        },
-    }
+    return raw_node(name, role, cpu_millis=2000, ready=ready)
 
 
 def _pod(name, node, cpu="100m", phase="Running"):
-    return {
-        "metadata": {
-            "name": name, "namespace": "default", "uid": f"uid-{name}",
-            "labels": {"app": name}, "resourceVersion": "1",
-            "ownerReferences": [
-                {"kind": "ReplicaSet", "name": f"{name}-rs", "controller": True}
-            ],
-        },
-        "spec": {
-            "nodeName": node,
-            "containers": [
-                {"resources": {"requests": {"cpu": cpu, "memory": "64Mi"}}}
-            ],
-        },
-        "status": {"phase": phase},
-    }
+    return raw_pod(
+        name, node, cpu_millis=int(cpu.rstrip("m")), phase=phase
+    )
 
 
 class StreamingStub:
@@ -324,6 +305,61 @@ def test_gone_triggers_relist(watching):
     }
     assert _wait(lambda: stub.list_count["pods"] >= 2)
     assert _wait(lambda: len(wc.pods.snapshot()) == 2)
+    # EXACTLY one throttled re-LIST per expiry: the watcher backs off,
+    # lists once, and resumes watching — it must not LIST again while
+    # the stream stays healthy
+    time.sleep(3 * WATCH_SLICE_SECONDS)
+    assert stub.list_count["pods"] == 2
+
+
+def test_bookmark_leaves_store_untouched(watching):
+    """A BOOKMARK advances the watcher's resourceVersion (proven by the
+    reconnect params) without applying anything to the store."""
+    stub, wc = watching
+    stub.objects["nodes"]["uid-od-1"] = _node("od-1", "worker")
+    wc.start(timeout=10)
+    snap_before = wc.nodes.snapshot_items()
+    stub.push("nodes", "BOOKMARK", _node("od-1", "worker"))
+    bookmark_rv = int(
+        stub.objects["nodes"]["uid-od-1"]["metadata"]["resourceVersion"]
+    )
+    n = len(stub.watch_params)
+    assert _wait(lambda: any(
+        res == "nodes" and rv and int(rv) >= bookmark_rv
+        for res, rv in stub.watch_params[n:]
+    ), timeout=10)
+    # the bookmark applied no object: identical store, same objects
+    assert wc.nodes.snapshot_items() == snap_before
+    [w] = [w for w in wc._watchers if w.resource == "nodes"]
+    assert w.event_count == 0
+    assert stub.list_count["nodes"] == 1  # and certainly no re-LIST
+
+
+def test_stop_during_reconnect_backoff_returns_promptly():
+    """stop() must cut a reconnect-backoff wait short, not sit it out —
+    here every connection fails (closed port), so without the prompt
+    stop the thread would sleep its full backoff between attempts."""
+    from k8s_spot_rescheduler_tpu.io.watch import RECONNECT_BACKOFF_MAX
+
+    # a port with no listener: instant connection-refused failures
+    probe = ThreadingHTTPServer(("127.0.0.1", 0), BaseHTTPRequestHandler)
+    host, port = probe.server_address
+    probe.server_close()  # free the port; nothing listens now
+    # retry_max=0: the kube read-retry layer has its own (bounded)
+    # sleeps — this test isolates the WATCHER's reconnect backoff
+    wc = WatchingKubeClusterClient(
+        KubeClusterClient(f"http://{host}:{port}", retry_max=0)
+    )
+    for w in wc._watchers:
+        w._backoff = RECONNECT_BACKOFF_MAX  # deep in backoff territory
+        w.start()
+    time.sleep(0.3)  # let every watcher fail and enter its backoff wait
+    t0 = time.monotonic()
+    wc.stop()
+    for w in wc._watchers:
+        w.join(timeout=5.0)
+        assert not w.is_alive()
+    assert time.monotonic() - t0 < 3.0  # far below the 30 s backoff
 
 
 def test_reconnect_resumes_from_last_rv(watching):
