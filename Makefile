@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze test bench bench-smoke chaos-smoke watch-soak quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke chaos-smoke watch-soak quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -13,23 +13,32 @@ all:
 	-$(MAKE) native
 	$(MAKE) check
 
-# The CI entry: lint+format gate, then the project-wide analysis suite,
-# then tests, then the smokes — mirroring the reference's
-# fmt/golangci-lint/vet/test chain (reference Makefile:36-65).
-# tools/lint.py is the fmt+golangci-lint stand-in and tools/analysis is
-# the go-vet analog (this image ships no Python linter and installs are
-# forbidden).
-check: lint analyze test bench-smoke repair-smoke chaos-smoke watch-soak
+# The CI entry: lint+format gate, then the project-wide analysis suite
+# (ast tier), then the jaxpr-tier program audit, then tests, then the
+# smokes — mirroring the reference's fmt/golangci-lint/vet/test chain
+# (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
+# stand-in and tools/analysis is the go-vet analog, two tiers deep
+# (this image ships no Python linter and installs are forbidden).
+check: lint analyze audit-jaxpr test bench-smoke repair-smoke chaos-smoke watch-soak
 
 lint:
 	python tools/lint.py
 
-# Project-wide static analysis (docs/ANALYSIS.md): JAX hot-path vets
-# (host-sync, donation, recompile triggers), cross-module contracts
-# (metrics / config+CLI+docs / kube write-retry), lock discipline.
+# Project-wide static analysis, ast tier (docs/ANALYSIS.md): JAX
+# hot-path vets (host-sync, donation, recompile triggers), cross-module
+# contracts (metrics / config+CLI+docs / kube write-retry /
+# jit-root<->HOT_PROGRAMS manifest lockstep), lock discipline.
 # The watchdog keeps `make check` fast: the run must finish in 10 s.
 analyze:
-	python -m tools.analysis --max-seconds 10
+	python -m tools.analysis --tier ast --max-seconds 10
+
+# Jaxpr-tier program audit (docs/ANALYSIS.md "Jaxpr tier"): every
+# HOT_PROGRAMS entry traced shape-only on CPU and vetted for dtype
+# promotions, index widths at the declared 1M-pod/100k-node max shapes,
+# host transfers / donation aliasing, and HBM-estimator reconciliation.
+# Pure abstract eval — no device, no execution; must finish in 30 s.
+audit-jaxpr:
+	env JAX_PLATFORMS=cpu python -m tools.analysis --tier jaxpr --max-seconds 30
 
 # best-effort native build first: the native differential suite fails
 # (not skips) when a toolchain exists but the library won't load

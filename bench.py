@@ -766,6 +766,26 @@ def run_smoke(args, metric: str, unit: str) -> int:
         and report.delta_pack_lanes >= 0
         and report.chunks_solved >= 0
     )
+    # jaxpr-tier audit cost (make audit-jaxpr): a fresh subprocess so
+    # the measurement includes the jax import + every manifest trace —
+    # the number the trajectory watches for tracing-cost regressions.
+    # The audit must also pass: a red audit fails the smoke.
+    t_audit = time.perf_counter()
+    audit = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--tier", "jaxpr"],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    audit_jaxpr_ms = (time.perf_counter() - t_audit) * 1e3
+    audit_ok = audit.returncode == 0
+    if not audit_ok:
+        print(
+            f"bench-smoke: jaxpr audit RED (rc={audit.returncode}):\n"
+            f"{audit.stdout[-2000:]}\n{audit.stderr[-2000:]}",
+            file=sys.stderr,
+        )
+    ok = ok and audit_ok
     print(
         f"bench-smoke: uploads per tick {uploads} B  "
         f"tick ms {[round(t, 1) for t in tick_ms]}  "
@@ -788,6 +808,9 @@ def run_smoke(args, metric: str, unit: str) -> int:
             # observe split: mirror sync (O(churn)) vs full pack
             "sync_ms": round(float(np.median(sync_ms)), 3),
             "pack_ms": round(pack_s * 1e3, 3),
+            # full jaxpr-tier audit wall (subprocess incl. jax import):
+            # the tracing-cost trajectory for `make audit-jaxpr`
+            "audit_jaxpr_ms": round(audit_jaxpr_ms, 1),
             "ok": ok,
         }
     )

@@ -1,0 +1,189 @@
+"""HOT_PROGRAMS manifest infrastructure: the traced-program registry.
+
+The jaxpr-tier auditor (tools/analysis/jaxpr, ``make audit-jaxpr``)
+proves dtype, index-width, transfer, and memory properties on the
+programs XLA actually traces — not on the source the AST tier vets. For
+that it needs a declared list of hot programs and a way to trace each
+one shape-only on CPU (``jax.make_jaxpr`` over ``ShapeDtypeStruct``
+args: no device buffers, no execution, cost independent of the probe
+shape). This module owns the shared pieces:
+
+- :class:`ProbeShapes` — the parameterized packed dims ``(C, K, S, R,
+  W, A)`` a program is traced at, with the declared scale points
+  (:data:`MAX_SHAPES` = the 20x ROADMAP-5 target, 1M pods / 100k
+  nodes; :data:`RECONCILE_SHAPES` = the measured single-chip boundary
+  pins of tests/test_sharding.py);
+- :func:`packed_struct` / :func:`delta_struct` — ShapeDtypeStruct
+  pytrees mirroring models/tensors.PackedCluster and
+  models/columnar.PackedDelta at a ProbeShapes point;
+- :class:`HotProgram` — one manifest entry: a lazy ``build`` closure
+  returning ``(fn, args)`` to trace, the ``covers`` list of jit-root
+  qualnames the trace exercises (checked by the AST-tier
+  ``manifest-contract`` pass against the roots the PR-5 call graph
+  discovers), the declared ``donate_argnums`` (audited for true
+  aliasing), and the optional ``reconcile`` spec tying the trace to
+  solver/memory's HBM estimate;
+- :func:`collect` — import the manifest-bearing solver modules and
+  merge their ``HOT_PROGRAMS`` dicts (lazy: importing this module pulls
+  in no solver code).
+
+Every ``jax.jit`` / ``pjit`` / ``shard_map`` root under solver/, ops/,
+parallel/, planner/ must be covered by some entry here or listed in
+:data:`EXEMPT_JIT_ROOTS` with a justification — ``manifest-contract``
+(tools/analysis/passes/contracts.py) turns ``make check`` red
+otherwise, so the jaxpr tier's coverage can never silently shrink.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+
+class ProbeShapes(NamedTuple):
+    """Packed problem dims a hot program is traced at. C candidate
+    lanes, K pod slots per lane, S spot nodes, R resources, W taint
+    words, A affinity words (models/tensors.PackedCluster)."""
+
+    C: int
+    K: int
+    S: int
+    R: int = 4
+    W: int = 2
+    A: int = 2
+
+
+# The declared maximum scale for the index-width pass: ROADMAP item 5's
+# 20x target, 1M pods / 100k nodes. C+S = 102_400 nodes and C*K = 1.64M
+# pod slots cover the target with headroom; these are 20x the measured
+# config-3 packed dims (C=S=2560, K=32) that tests/test_sharding.py pins
+# the HBM boundary at. Every index the traced programs compute must fit
+# its carrying dtype AT THESE SHAPES — the precondition for the
+# narrow-int carry packing ROADMAP 5 plans.
+MAX_SHAPES = ProbeShapes(C=51_200, K=32, S=51_200, R=4, W=2, A=2)
+
+# memory-reconcile probe points: the 1x and 4x config-3 shapes whose
+# estimate tests/test_sharding.py pins against the measured single-chip
+# envelope (4x fits a 16 GB v5e, 8x does not). Two scales so the pass
+# can also prove the estimator's ASYMPTOTICS match the traced program.
+RECONCILE_SHAPES = (
+    ProbeShapes(C=2_560, K=32, S=2_560, R=4, W=2, A=2),
+    ProbeShapes(C=10_240, K=32, S=10_240, R=4, W=2, A=2),
+)
+
+# How many scatter rows the delta-scatter probe carries (any power of
+# two works; the real pad ladder is solver_planner._pad_pow2).
+DELTA_PROBE_ROWS = 256
+
+
+class HotProgram(NamedTuple):
+    """One manifest entry (see module docstring).
+
+    ``build(shapes)`` returns ``(fn, args)`` or ``(fn, args,
+    static_argnums)`` — args are ShapeDtypeStruct pytrees, so building
+    is allocation-free. ``covers`` strings are matched as
+    dot/colon-bounded suffixes of discovered jit-root qualnames
+    (``<module>:<qualname>``). ``reconcile`` is either
+    ``{"repair_spot_chunks": n}`` (diff the trace against
+    solver/memory.estimate_union_hbm_breakdown at that chunking) or
+    ``{"estimator": fn}`` (fixture/test hook: ``fn(shapes) -> {component
+    -> bytes}``). ``index_width=False`` skips the max-shape probe for
+    programs whose trace is only meaningful at bounded shapes."""
+
+    build: Callable
+    covers: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    reconcile: Optional[dict] = None
+    index_width: bool = True
+
+
+# jit roots deliberately NOT in any HOT_PROGRAMS manifest, pattern ->
+# justification. Matched like ``covers``. Currently empty: every root
+# in the tree is traced. The mechanism exists so a future hardware-only
+# program can opt out loudly instead of silently shrinking coverage.
+EXEMPT_JIT_ROOTS: dict = {}
+
+# Modules owning a HOT_PROGRAMS dict, registered beside their jit
+# roots. manifest-contract proves this list and the discovered roots
+# stay in lockstep.
+MANIFEST_MODULES = (
+    "k8s_spot_rescheduler_tpu.solver.ffd",
+    "k8s_spot_rescheduler_tpu.solver.repair",
+    "k8s_spot_rescheduler_tpu.solver.select",
+    "k8s_spot_rescheduler_tpu.solver.prefilter",
+    "k8s_spot_rescheduler_tpu.solver.fallback",
+    "k8s_spot_rescheduler_tpu.ops.pallas_ffd",
+    "k8s_spot_rescheduler_tpu.parallel.sharded_ffd",
+    "k8s_spot_rescheduler_tpu.planner.solver_planner",
+)
+
+
+def _sds(shape, dtype):
+    import jax
+    import numpy as np
+
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+def packed_struct(s: ProbeShapes):
+    """A models/tensors.PackedCluster of ShapeDtypeStructs at ``s`` —
+    the canonical shape-only probe argument (dtypes are the pack
+    contract pinned in the PackedCluster docstring)."""
+    from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+
+    return PackedCluster(
+        slot_req=_sds((s.C, s.K, s.R), "float32"),
+        slot_valid=_sds((s.C, s.K), "bool"),
+        slot_tol=_sds((s.C, s.K, s.W), "uint32"),
+        slot_aff=_sds((s.C, s.K, s.A), "uint32"),
+        cand_valid=_sds((s.C,), "bool"),
+        spot_free=_sds((s.S, s.R), "float32"),
+        spot_count=_sds((s.S,), "int32"),
+        spot_max_pods=_sds((s.S,), "int32"),
+        spot_taints=_sds((s.S, s.W), "uint32"),
+        spot_ok=_sds((s.S,), "bool"),
+        spot_aff=_sds((s.S, s.A), "uint32"),
+    )
+
+
+def delta_struct(s: ProbeShapes, rows: int = DELTA_PROBE_ROWS):
+    """A models/columnar.PackedDelta of ShapeDtypeStructs: ``rows``
+    changed lanes / cand rows / spot rows (the padded sections the
+    donated scatter consumes)."""
+    from k8s_spot_rescheduler_tpu.models.columnar import PackedDelta
+
+    return PackedDelta(
+        lanes=_sds((rows,), "int32"),
+        lane_slot_req=_sds((rows, s.K, s.R), "float32"),
+        lane_slot_valid=_sds((rows, s.K), "bool"),
+        lane_slot_tol=_sds((rows, s.K, s.W), "uint32"),
+        lane_slot_aff=_sds((rows, s.K, s.A), "uint32"),
+        cand_rows=_sds((rows,), "int32"),
+        cand_valid=_sds((rows,), "bool"),
+        spot_rows=_sds((rows,), "int32"),
+        spot_free=_sds((rows, s.R), "float32"),
+        spot_count=_sds((rows,), "int32"),
+        spot_max_pods=_sds((rows,), "int32"),
+        spot_taints=_sds((rows, s.W), "uint32"),
+        spot_ok=_sds((rows,), "bool"),
+        spot_aff=_sds((rows, s.A), "uint32"),
+    )
+
+
+def collect():
+    """Import every manifest module and merge the entries. Returns
+    ``{name: (HotProgram, module_file_path)}``; duplicate names raise
+    (two modules claiming one program name is a manifest bug)."""
+    import importlib
+
+    out = {}
+    for mod_name in MANIFEST_MODULES:
+        mod = importlib.import_module(mod_name)
+        programs = getattr(mod, "HOT_PROGRAMS", {})
+        for name, hp in programs.items():
+            if name in out:
+                raise ValueError(
+                    f"duplicate HOT_PROGRAMS entry {name!r} "
+                    f"(in {mod.__file__} and {out[name][1]})"
+                )
+            out[name] = (hp, mod.__file__)
+    return out

@@ -321,3 +321,22 @@ def _invoke_kernel(
 plan_ffd_pallas_jit = jax.jit(
     plan_ffd_pallas, static_argnames=("interpret", "best_fit")
 )
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr). pallas_call traces abstractly on CPU — the
+# kernel body's dtype/width properties are proven without a TPU.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+HOT_PROGRAMS = {
+    "pallas.first_fit": HotProgram(
+        build=lambda s: (
+            functools.partial(plan_ffd_pallas, interpret=True),
+            (packed_struct(s),),
+        ),
+        covers=("ops.pallas_ffd:plan_ffd_pallas",),
+    ),
+}

@@ -293,3 +293,51 @@ def make_sharded_planner(mesh_shape: Tuple[int, int] | None = None):
     devices (the SolverPlanner 'sharded' backend)."""
     mesh = make_mesh(mesh_shape)
     return jax.jit(functools.partial(plan_ffd_sharded, mesh))
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr): both mesh layouts, traced over meshes built
+# from the visible devices (the audit runs on >=8 virtual CPU devices;
+# tracing is shape-only, so the mesh is just a layout declaration).
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+
+def _sharded_2d_build(s):
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_mesh
+
+    return (
+        functools.partial(plan_ffd_sharded, make_mesh(None)),
+        (packed_struct(s),),
+    )
+
+
+def _cand_sharded_build(s):
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_cand_mesh
+
+    return (
+        functools.partial(
+            plan_union_cand_sharded,
+            make_cand_mesh(),
+            rounds=8,
+            repair_spot_chunks=4,
+        ),
+        (packed_struct(s),),
+    )
+
+
+HOT_PROGRAMS = {
+    "sharded.ffd_2d": HotProgram(
+        build=_sharded_2d_build,
+        covers=(
+            "parallel.sharded_ffd:_sharded_plan_local",
+            "parallel.sharded_ffd:plan_ffd_sharded",
+        ),
+    ),
+    "sharded.union_cand": HotProgram(
+        build=_cand_sharded_build,
+        covers=("parallel.sharded_ffd:plan_union_cand_sharded.local",),
+    ),
+}

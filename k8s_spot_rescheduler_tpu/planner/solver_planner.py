@@ -705,3 +705,33 @@ class SolverPlanner:
                 ", ".join(f"{k}={v}" for k, v in sorted(by_reason.items()) if v),
                 n_unplaceable,
             )
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr): the donated-buffer scatter. The transfer-audit
+# pass proves every donate_argnums position actually aliases an output
+# (shape/dtype match) — a donated-but-copied resident tensor would
+# silently double the steady-state footprint.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    delta_struct,
+    packed_struct,
+)
+
+
+def _delta_scatter_build(s):
+    planner = SolverPlanner.__new__(SolverPlanner)  # no config/compile
+    planner._apply_delta_jit = None
+    return (
+        planner._delta_apply_fn(),
+        (*packed_struct(s), delta_struct(s)),
+    )
+
+
+HOT_PROGRAMS = {
+    "planner.delta_scatter": HotProgram(
+        build=_delta_scatter_build,
+        covers=("planner.solver_planner:SolverPlanner._delta_apply_fn.apply",),
+        donate_argnums=tuple(range(11)),
+    ),
+}

@@ -109,3 +109,50 @@ def with_repair(solve_fn, rounds: int, spot_chunks: int = 1):
         return SolveResult(feasible=feasible, assignment=assignment)
 
     return solve
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr): the fused union compositions the planner
+# actually runs. The ``reconcile`` specs tie each composition to
+# solver/memory.estimate_union_hbm_breakdown at the matching
+# repair_spot_chunks mode — the memory-reconcile pass diffs the traced
+# program's live-buffer model against the estimate so the HBM dispatch
+# (pick_repair_chunks / should_shard) can't rot as kernels change.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+
+def _union_greedy_build(s):
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    return with_best_fit_fallback(plan_ffd), (packed_struct(s),)
+
+
+def _union_repair_build(s, spot_chunks=1):
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    return (
+        with_repair(plan_ffd, rounds=8, spot_chunks=spot_chunks),
+        (packed_struct(s),),
+    )
+
+
+HOT_PROGRAMS = {
+    "union.greedy": HotProgram(
+        build=_union_greedy_build,
+        covers=("solver.ffd:plan_ffd",),
+        reconcile={"repair_spot_chunks": 0},
+    ),
+    "union.repair": HotProgram(
+        build=_union_repair_build,
+        covers=("solver.repair:plan_repair",),
+        reconcile={"repair_spot_chunks": 1},
+    ),
+    "union.repair_chunked": HotProgram(
+        build=lambda s: _union_repair_build(s, spot_chunks=4),
+        covers=("solver.repair:plan_repair_chunked",),
+        reconcile={"repair_spot_chunks": 4},
+    ),
+}

@@ -123,3 +123,26 @@ def plan_ffd(packed: PackedCluster, best_fit: bool = False) -> SolveResult:
 
 
 plan_ffd_jit = jax.jit(plan_ffd, static_argnames=("best_fit",))
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr): the traced shapes of this module's jit root.
+# manifest-contract (make analyze) fails if the root loses coverage.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+HOT_PROGRAMS = {
+    "ffd.first_fit": HotProgram(
+        build=lambda s: (plan_ffd, (packed_struct(s),)),
+        covers=("solver.ffd:plan_ffd",),
+    ),
+    "ffd.best_fit": HotProgram(
+        build=lambda s: (
+            functools.partial(plan_ffd, best_fit=True),
+            (packed_struct(s),),
+        ),
+        covers=("solver.ffd:plan_ffd",),
+    ),
+}

@@ -38,21 +38,27 @@ BUDGET_FRACTION = 0.85
 MIN_REPAIR_CHUNK = 128
 
 
-def estimate_union_hbm_bytes(
+def estimate_union_hbm_breakdown(
     C: int, K: int, S: int, R: int, W: int, A: int,
     repair_spot_chunks: int = 1,
-) -> int:
-    """Estimated peak HBM of the fused union solver at these shapes.
+) -> dict:
+    """Per-component HBM estimate of the fused union solver: named
+    buffer family -> bytes. ``estimate_union_hbm_bytes`` is the sum.
 
-    Dominant terms: the scan carries — one [C, S] plane per resource
+    Dominant terms: the scan ``carries`` — one [C, S] plane per resource
     (free), per affinity word (aff), plus one (count) — double-buffered
-    by the scan (x2), plus ~3 per-step temporary planes (fit mask,
-    slack, onehot live ranges); then the repair rounds' working set —
+    by the scan (x2), plus ~3 per-step ``temporaries`` planes (fit mask,
+    slack, onehot live ranges); then the ``repair`` rounds' working set —
     the unlocker probe, the two first-fit re-placement sweeps, the
     [C, R, S] commit delta and the affinity rewrite intermediates, about
-    (R + 2A + 7) live [C, S] planes; then the scan slot inputs and the
-    assignment outputs. Spot-static rows are O(S) and negligible but
-    included for completeness.
+    (R + 2A + 7) live [C, S] planes; then the scan ``slots`` inputs and
+    the assignment ``outputs``. ``spot_static`` rows are O(S) and
+    negligible but included for completeness.
+
+    The named split exists for the jaxpr-tier ``memory-reconcile`` pass
+    (tools/analysis/jaxpr): when the estimate drifts from the traced
+    program, the finding names WHICH component drifted, not just the
+    sum.
 
     ``repair_spot_chunks`` > 1 models the elect-then-commit chunked
     repair (solver/repair.plan_repair_chunked): only one spot chunk's
@@ -65,18 +71,31 @@ def estimate_union_hbm_bytes(
     configs off one chip for memory they never use.
     """
     plane = C * S * 4  # one f32/i32/u32 [C, S] plane
-    carries = 2 * (R + A + 1) * plane  # double-buffered scan state
-    temporaries = 3 * plane
-    repair_temp = (
-        0
-        if repair_spot_chunks == 0
-        else (R + 2 * A + 7) * plane // repair_spot_chunks
-    )
-    slots = K * C * (R * 4 + 1 + W * 4 + A * 4)
-    outputs = 2 * C * K * 4  # chosen [K, C] + assignment [C, K]
-    spot_static = S * (R * 4 + 4 + 4 + W * 4 + 1 + A * 4)
-    return (
-        carries + temporaries + repair_temp + slots + outputs + spot_static
+    return {
+        "carries": 2 * (R + A + 1) * plane,  # double-buffered scan state
+        "temporaries": 3 * plane,
+        "repair": (
+            0
+            if repair_spot_chunks == 0
+            else (R + 2 * A + 7) * plane // repair_spot_chunks
+        ),
+        "slots": K * C * (R * 4 + 1 + W * 4 + A * 4),
+        "outputs": 2 * C * K * 4,  # chosen [K, C] + assignment [C, K]
+        "spot_static": S * (R * 4 + 4 + 4 + W * 4 + 1 + A * 4),
+    }
+
+
+def estimate_union_hbm_bytes(
+    C: int, K: int, S: int, R: int, W: int, A: int,
+    repair_spot_chunks: int = 1,
+) -> int:
+    """Estimated peak HBM of the fused union solver at these shapes
+    (sum of ``estimate_union_hbm_breakdown`` — see there for the
+    component model)."""
+    return sum(
+        estimate_union_hbm_breakdown(
+            C, K, S, R, W, A, repair_spot_chunks=repair_spot_chunks
+        ).values()
     )
 
 

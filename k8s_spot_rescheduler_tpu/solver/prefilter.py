@@ -69,3 +69,19 @@ def lane_maybe_feasible(packed):
     )
     over_slots = n_slots > free_slots  # integer math: exact
     return jnp.asarray(packed.cand_valid) & ~(over_capacity | over_slots)
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr). The jit site lives in solver/select.py
+# (StagedPlanner wraps this fn); the root resolves here.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+HOT_PROGRAMS = {
+    "prefilter.lane_bound": HotProgram(
+        build=lambda s: (lane_maybe_feasible, (packed_struct(s),)),
+        covers=("solver.prefilter:lane_maybe_feasible",),
+    ),
+}

@@ -998,3 +998,30 @@ def plan_repair_oracle(
     feasible = np.asarray(validate_assignment(np, packed, assign))
     assignment = np.where(feasible[:, None], assign, -1).astype(np.int32)
     return SolveResult(feasible=feasible, assignment=assignment)
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr): both repair variants traced at audit shapes —
+# the chunked carry restructure is exactly where ROADMAP-5's narrow-int
+# packing will land, so its dtype/width properties are gated here.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+HOT_PROGRAMS = {
+    "repair.rounds": HotProgram(
+        build=lambda s: (
+            functools.partial(plan_repair, rounds=4),
+            (packed_struct(s),),
+        ),
+        covers=("solver.repair:plan_repair",),
+    ),
+    "repair.chunked": HotProgram(
+        build=lambda s: (
+            functools.partial(plan_repair_chunked, rounds=4, spot_chunks=4),
+            (packed_struct(s),),
+        ),
+        covers=("solver.repair:plan_repair_chunked",),
+    ),
+}

@@ -232,3 +232,41 @@ def make_staged_planner(
     return StagedPlanner(
         solve_fn, chunk_lanes=chunk_lanes, early_exit=early_exit
     )
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr): the fused selection program and the staged
+# chunk solver — the two jit roots the planner fetches from.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+
+def _fused_union_build(s):
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    return make_fused_planner(with_repair(plan_ffd, 8)), (packed_struct(s),)
+
+
+def _staged_chunk_build(s):
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    staged = StagedPlanner(with_repair(plan_ffd, 8), chunk_lanes=256)
+    # start=0 traced; size is a static arg (the chunk ladder's compile
+    # key) — make_jaxpr gets it via static_argnums
+    return staged._solve_chunk, (packed_struct(s), 0, 256), (2,)
+
+
+HOT_PROGRAMS = {
+    "select.fused_union": HotProgram(
+        build=_fused_union_build,
+        covers=("solver.select:make_fused_planner.fused",),
+    ),
+    "select.staged_chunk": HotProgram(
+        build=_staged_chunk_build,
+        covers=("solver.select:StagedPlanner.__init__.solve_chunk",),
+    ),
+}

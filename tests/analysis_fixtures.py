@@ -1,0 +1,79 @@
+"""Shared fixture helpers for the two static-analysis gates.
+
+tests/test_lint.py (the fmt/lint half) and tests/test_analysis.py (the
+vet half, both tiers) seed violation trees and drive the tools as
+subprocesses the same way ``make check`` does; this module is the ONE
+copy of that machinery so the two gates stop carrying parallel
+implementations.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_lint(*roots):
+    """tools/lint.py over the given roots (default: the whole repo)."""
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), *map(str, roots)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+
+
+def run_analysis(*args):
+    """python -m tools.analysis with the given CLI args, from the repo
+    root (the module path and default roots depend on the cwd)."""
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analysis", *map(str, args)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+
+
+def seed_tree(tmp_path, rel, source):
+    """Write a dedented fixture file at ``tmp_path/rel``."""
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def lint_file(tmp_path, source: str, name="seeded.py"):
+    """Seed one file (verbatim — format tests need exact bytes) and
+    lint it."""
+    f = tmp_path / name
+    f.write_text(source)
+    return run_lint(f)
+
+
+def analyze_tree(tmp_path, *extra, tier="ast"):
+    """Analyze a fixture tree: no baseline, the fixture's parity file
+    (created empty when the fixture ships none), ast tier unless the
+    test says otherwise (fixture trees exercise one tier at a time;
+    the real-tree gate runs both)."""
+    parity = tmp_path / "PARITY.md"
+    if not parity.exists():
+        parity.write_text("")
+    return run_analysis(
+        tmp_path, "--no-baseline", "--parity", parity, "--tier", tier,
+        *extra,
+    )
+
+
+def seed_jaxpr_manifest(tmp_path, source, *extra, name="manifest.py"):
+    """Seed a HOT_PROGRAMS manifest module and run the jaxpr tier over
+    it (the fixture tree is also the walked root, so ``# noqa`` on
+    manifest lines participates exactly as in-tree)."""
+    f = seed_tree(tmp_path, name, source)
+    return f, run_analysis(
+        tmp_path, "--tier", "jaxpr", "--manifest", f, "--no-baseline",
+        *extra,
+    )

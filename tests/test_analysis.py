@@ -1,58 +1,47 @@
 """The static-analysis suite (tools/analysis) must actually gate.
 
-Mirror of tests/test_lint.py for the vet half of the chain: every pass
-is proven by a seeded violation (a fixture tree the pass must fail), the
-real tree must be clean (`make analyze` then enforces that forever), the
-shared typed-suppression grammar is pinned, and the watchdog keeps the
-run inside the `make check` latency budget.
+Mirror of tests/test_lint.py for the vet half of the chain, both tiers:
+every AST pass is proven by a seeded violation (a fixture tree the pass
+must fail), every jaxpr pass by a seeded manifest (a planted violation
+in a traced program), the real tree must be clean on BOTH tiers (`make
+analyze` + `make audit-jaxpr` then enforce that forever), the shared
+typed-suppression grammar is pinned for both tiers, and the watchdogs
+keep each stage inside the `make check` latency budget (10 s ast, 30 s
+jaxpr). Fixture machinery lives in tests/analysis_fixtures.py, shared
+with the lint gate.
 """
 
 import json
-import subprocess
-import sys
-import textwrap
-from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
-
-
-def _run(*args):
-    return subprocess.run(
-        [sys.executable, "-m", "tools.analysis", *map(str, args)],
-        capture_output=True,
-        text=True,
-        cwd=REPO,
-    )
-
-
-def _seed(tmp_path, rel, source):
-    f = tmp_path / rel
-    f.parent.mkdir(parents=True, exist_ok=True)
-    f.write_text(textwrap.dedent(source))
-    return f
-
-
-def _analyze_tree(tmp_path, *extra):
-    # fixture runs: no baseline, and the doc check reads the fixture's
-    # parity file (or skips when the fixture ships none)
-    parity = tmp_path / "PARITY.md"
-    if not parity.exists():
-        parity.write_text("")
-    return _run(tmp_path, "--no-baseline", "--parity", parity, *extra)
-
+from tests.analysis_fixtures import (
+    analyze_tree as _analyze_tree,
+    run_analysis as _run,
+    seed_jaxpr_manifest,
+    seed_tree as _seed,
+)
 
 # --- the gate itself ------------------------------------------------------
 
 
 def test_tree_is_clean():
+    """The unified default (--tier all): both tiers, one invocation."""
     r = _run()
     assert r.returncode == 0, f"analysis gate is red:\n{r.stdout}{r.stderr}"
 
 
 def test_tree_is_clean_within_watchdog():
-    """The full run must stay under 10 s so `make check` stays fast."""
-    r = _run("--max-seconds", "10")
+    """The ast stage (`make analyze`) must stay under 10 s."""
+    r = _run("--tier", "ast", "--max-seconds", "10")
     assert r.returncode == 0, f"watchdog tripped:\n{r.stdout}{r.stderr}"
+
+
+def test_jaxpr_tier_clean_within_watchdog():
+    """`make audit-jaxpr` acceptance: the full jaxpr tier — every
+    HOT_PROGRAMS entry traced (index-width at the declared 1M-pod /
+    100k-node max shapes included) — runs CLEAN on an empty baseline
+    and inside the 30 s CPU budget."""
+    r = _run("--tier", "jaxpr", "--max-seconds", "30")
+    assert r.returncode == 0, f"jaxpr gate is red:\n{r.stdout}{r.stderr}"
 
 
 def test_noqa_trailing_prose_still_suppresses(tmp_path):
@@ -113,10 +102,36 @@ def test_subset_roots_do_not_report_stale_baseline(tmp_path):
     baseline.write_text(
         "some/other/file.py::lock-discipline::Foo.bar.attr  # elsewhere\n"
     )
-    r = _run(tmp_path, "--baseline", baseline, "--parity", parity)
+    r = _run(
+        tmp_path, "--tier", "ast", "--baseline", baseline,
+        "--parity", parity,
+    )
     # the seeded host-sync finding fires, but the unrelated entry is NOT
     # called stale — this is a subset-roots run
     assert "jax-host-sync" in r.stdout
+    assert "stale-baseline" not in r.stdout
+
+
+def test_single_tier_does_not_stale_other_tiers_baseline(tmp_path):
+    """An ast-only run must not call a jaxpr-tier baseline entry stale
+    (and vice versa): the entry's pass never ran."""
+    _seed(tmp_path, "solver/bad.py", """\
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x.item()
+    """)
+    parity = tmp_path / "PARITY.md"
+    parity.write_text("")
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "solver/bad.py::index-width::prog.check  # jaxpr-tier debt\n"
+    )
+    r = _run(
+        tmp_path, "--tier", "ast", "--baseline", baseline,
+        "--parity", parity,
+    )
     assert "stale-baseline" not in r.stdout
 
 
@@ -127,8 +142,19 @@ def test_unknown_pass_name_errors():
     assert "invalid choice" in r.stderr
 
 
+def test_pass_tier_mismatch_errors():
+    """Naming a jaxpr pass under --tier ast (or vice versa) must error,
+    not silently run nothing."""
+    r = _run("--tier", "ast", "--pass", "index-width")
+    assert r.returncode != 0
+    assert "jaxpr-tier pass" in r.stderr
+    r = _run("--tier", "jaxpr", "--pass", "lock-discipline")
+    assert r.returncode != 0
+    assert "ast-tier pass" in r.stderr
+
+
 def test_watchdog_fires_on_tiny_budget():
-    r = _run("--max-seconds", "0.000001")
+    r = _run("--tier", "ast", "--max-seconds", "0.000001")
     assert r.returncode == 2
     assert "watchdog" in r.stderr
 
@@ -491,6 +517,109 @@ def test_seeded_kube_write_retry(tmp_path):
     assert "evict_pod" in r.stdout
 
 
+# --- manifest-contract ----------------------------------------------------
+
+
+def test_seeded_manifest_uncovered_root(tmp_path):
+    """Adding a jit root without registering it in HOT_PROGRAMS turns
+    the gate red (acceptance: coverage cannot silently shrink)."""
+    _seed(tmp_path, "solver/prog.py", """\
+        import jax
+
+
+        @jax.jit
+        def covered(x):
+            return x + 1
+
+
+        @jax.jit
+        def uncovered(x):
+            return x - 1
+
+
+        def hot_program(**kw):
+            return kw
+
+
+        HOT_PROGRAMS = {
+            "prog.covered": hot_program(
+                covers=("solver.prog:covered",),
+            ),
+        }
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    hits = [l for l in r.stdout.splitlines() if "manifest-contract" in l]
+    assert len(hits) == 1, r.stdout
+    assert "uncovered" in hits[0]
+
+
+def test_seeded_manifest_deleted_entry(tmp_path):
+    """Deleting the manifest entry that covered a root turns the gate
+    red from the OTHER side: the root is now uncovered. A covers string
+    naming a removed root is equally red."""
+    _seed(tmp_path, "solver/prog.py", """\
+        import jax
+
+
+        @jax.jit
+        def orphaned(x):
+            return x + 1
+
+
+        def hot_program(**kw):
+            return kw
+
+
+        HOT_PROGRAMS = {
+            "prog.stale": hot_program(
+                covers=("solver.prog:deleted_root",),
+            ),
+        }
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "orphaned" in r.stdout  # the root lost its coverage
+    assert "no such jit root" in r.stdout  # the dangling covers entry
+
+
+def test_manifest_exemption_honored_and_staleness_warned(tmp_path):
+    _seed(tmp_path, "solver/prog.py", """\
+        import jax
+
+
+        @jax.jit
+        def hardware_only(x):
+            return x + 1
+
+
+        EXEMPT_JIT_ROOTS = {
+            "solver.prog:hardware_only": "needs a TPU lowering",
+            "solver.prog:long_gone": "stale pattern",
+        }
+    """)
+    r = _analyze_tree(tmp_path)
+    hits = [l for l in r.stdout.splitlines() if "manifest-contract" in l]
+    assert len(hits) == 1, r.stdout  # only the stale exemption, warn tier
+    assert "long_gone" in hits[0] and "[warn]" in hits[0]
+
+
+def test_manifest_contract_inert_without_manifest_infra(tmp_path):
+    """Fixture trees with jit roots but NO manifest infrastructure stay
+    silent — the contract gates trees that opted into the jaxpr tier
+    (the real package always has hot_programs.py in the walk)."""
+    _seed(tmp_path, "solver/plain.py", """\
+        import jax
+
+
+        @jax.jit
+        def solve(x):
+            return x + 1
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "manifest-contract" not in r.stdout
+
+
 # --- lock-discipline ------------------------------------------------------
 
 
@@ -525,6 +654,299 @@ def test_seeded_lock_discipline(tmp_path):
     hits = [l for l in r.stdout.splitlines() if "lock-discipline" in l]
     assert len(hits) == 1, r.stdout
     assert "Shared.bad" in hits[0]
+
+
+# --- jaxpr tier: dtype-promotion ------------------------------------------
+
+_MANIFEST_PRELUDE = """\
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_spot_rescheduler_tpu.hot_programs import (
+        HotProgram,
+        packed_struct,
+    )
+
+"""
+
+
+def test_jaxpr_seeded_float64_literal(tmp_path):
+    """A planted float64 literal in a traced fn leaves no jaxpr residue
+    under x64-off (JAX truncates it) — the pass must catch it from the
+    trace-time warning."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        scale = jnp.array(1.5, dtype=jnp.float64)
+        return (jnp.asarray(packed.spot_free) * scale).sum()
+
+
+    HOT_PROGRAMS = {
+        "fix.f64": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "dtype-promotion" in r.stdout
+    assert "64-bit" in r.stdout
+
+
+def test_jaxpr_seeded_carry_mismatch(tmp_path):
+    """A scan whose carry changes dtype mid-loop (the exact bug class of
+    the ROADMAP-5 narrow-int carry refactor) fails at trace time; the
+    pass owns the resulting finding."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        def step(c, _):
+            return c.astype(jnp.int32), None
+
+        out, _ = jax.lax.scan(
+            step, jnp.asarray(packed.spot_free), None, length=3
+        )
+        return out
+
+
+    HOT_PROGRAMS = {
+        "fix.carry": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "dtype-promotion" in r.stdout
+    assert "carry" in r.stdout
+
+
+# --- jaxpr tier: index-width ----------------------------------------------
+
+
+def test_jaxpr_seeded_index_overflow_at_max_shapes(tmp_path):
+    """An int32 flattened C*S offset overflows at the declared 20x max
+    shapes (1M pods / 100k nodes: C*S = 2.6e9 > 2^31) — the gate that
+    makes narrow-int packing safe to attempt."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        C = packed.slot_req.shape[0]
+        S = packed.spot_free.shape[0]
+        lane = jnp.arange(C, dtype=jnp.int32)
+        spot = jnp.arange(S, dtype=jnp.int32)
+        flat = lane[:, None] * jnp.int32(S) + spot[None, :]
+        return flat
+
+
+    HOT_PROGRAMS = {
+        "fix.overflow": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "index-width" in r.stdout
+    assert "int32" in r.stdout and "wraparound" in r.stdout
+
+
+def test_jaxpr_clean_index_math_stays_clean(tmp_path):
+    """Negative fixture: per-axis int32 index math (the real kernels'
+    shape) is in range at max shapes — no finding."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        S = packed.spot_free.shape[0]
+        fits = jnp.asarray(packed.spot_ok)
+        first = jnp.argmax(fits)  # [0, S-1]: fits i32 at any S here
+        onehot = jnp.arange(S) == first
+        return onehot.sum()
+
+
+    HOT_PROGRAMS = {
+        "fix.clean": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "index-width" not in r.stdout
+
+
+# --- jaxpr tier: transfer-audit -------------------------------------------
+
+
+def test_jaxpr_seeded_donation_without_alias(tmp_path):
+    """A donated arg with no aliasable output silently copies — the
+    declaration must be proven, not trusted."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(a, b):
+        return (a + b).sum()  # scalar out: 'a' cannot alias
+
+
+    HOT_PROGRAMS = {
+        "fix.donate": HotProgram(
+            build=lambda s: (
+                _solve,
+                (
+                    jax.ShapeDtypeStruct((64, 64), "float32"),
+                    jax.ShapeDtypeStruct((64, 64), "float32"),
+                ),
+            ),
+            donate_argnums=(0,),
+        ),
+    }
+    """)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "transfer-audit" in r.stdout
+    assert "NO output matches" in r.stdout
+
+
+def test_jaxpr_seeded_const_capture_and_device_put(tmp_path):
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    _TABLE = jnp.zeros((512, 512), jnp.float32)  # 1 MiB by value
+
+
+    def _solve(packed):
+        x = jax.device_put(jnp.asarray(packed.spot_free))
+        return x.sum() + _TABLE.sum()
+
+
+    HOT_PROGRAMS = {
+        "fix.transfer": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "device_put" in r.stdout
+    assert "captures a" in r.stdout and "constant by value" in r.stdout
+
+
+# --- jaxpr tier: memory-reconcile -----------------------------------------
+
+
+def test_jaxpr_seeded_estimator_drift_names_component(tmp_path):
+    """A drifted estimator fails memory-reconcile and the finding names
+    WHICH component drifted (per-component reporting acceptance)."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        def step(c, _):
+            return c + 1.0, None
+
+        out, _ = jax.lax.scan(
+            step, jnp.asarray(packed.spot_free), None, length=4
+        )
+        return out
+
+
+    def _estimator(shapes):
+        # carries claimed 100x what the traced scan holds
+        plane = shapes.S * shapes.R * 4
+        return {
+            "carries": 200 * plane,
+            "slots": 1,
+            "spot_static": 1,
+            "outputs": 1,
+            "temporaries": 1,
+            "repair": 1,
+        }
+
+
+    HOT_PROGRAMS = {
+        "fix.memdrift": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+            reconcile={"estimator": _estimator},
+        ),
+    }
+    """)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "memory-reconcile" in r.stdout
+    assert "'carries' drifted" in r.stdout
+    # the per-component table rides the finding
+    assert "estimator[" in r.stdout and "traced[" in r.stdout
+
+
+# --- jaxpr tier: trace failures, suppression, baseline --------------------
+
+
+def test_jaxpr_trace_failure_is_red(tmp_path):
+    """A manifest entry that cannot trace is lost audit coverage — an
+    error, never a silent skip."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        raise RuntimeError("builder broke")
+
+
+    HOT_PROGRAMS = {
+        "fix.broken": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "trace-failure" in r.stdout
+
+
+def test_jaxpr_noqa_suppresses_on_manifest_line(tmp_path):
+    """Jaxpr findings anchor to the manifest entry line, so the shared
+    typed-noqa grammar applies to them unchanged."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        C = packed.slot_req.shape[0]
+        S = packed.spot_free.shape[0]
+        lane = jnp.arange(C, dtype=jnp.int32)
+        return lane[:, None] * jnp.int32(S)
+
+
+    HOT_PROGRAMS = {
+        "fix.overflow": HotProgram(  # noqa: index-width
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert "index-width" not in r.stdout, r.stdout
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_jaxpr_baseline_grandfathers(tmp_path):
+    """Jaxpr-tier findings flow through the same baseline file."""
+    manifest, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        C = packed.slot_req.shape[0]
+        S = packed.spot_free.shape[0]
+        lane = jnp.arange(C, dtype=jnp.int32)
+        return lane[:, None] * jnp.int32(S)
+
+
+    HOT_PROGRAMS = {
+        "fix.overflow": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert r.returncode == 1
+    r = _run(
+        tmp_path, "--tier", "jaxpr", "--manifest", manifest,
+        "--no-baseline", "--json",
+    )
+    found = json.loads(r.stdout)["findings"]
+    assert found and all(f["tier"] == "jaxpr" for f in found)
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text("".join(
+        f"{f['path']}::{f['code']}::{f['anchor']}  # grandfathered\n"
+        for f in found
+    ))
+    r = _run(
+        tmp_path, "--tier", "jaxpr", "--manifest", manifest,
+        "--baseline", baseline,
+    )
+    assert r.returncode == 0, r.stdout
+    assert "baselined" in r.stderr
 
 
 # --- suppressions / noqa grammar ------------------------------------------
@@ -568,7 +990,7 @@ def test_unknown_suppression_code_warns(tmp_path):
 
 def test_no_bare_noqa_in_tree():
     """Satellite guarantee: every suppression in the repo names a code."""
-    r = _run()
+    r = _run("--tier", "ast")
     assert "bare-noqa" not in r.stdout
 
 
@@ -586,7 +1008,10 @@ def test_baseline_grandfathers_and_goes_stale(tmp_path):
     parity = tmp_path / "PARITY.md"
     parity.write_text("")
     # find the finding's key via --json, grandfather it, rerun
-    r = _run(tmp_path, "--no-baseline", "--parity", parity, "--json")
+    r = _run(
+        tmp_path, "--tier", "ast", "--no-baseline", "--parity", parity,
+        "--json",
+    )
     found = json.loads(r.stdout)["findings"]
     assert found, r.stdout
     key = (
@@ -594,12 +1019,18 @@ def test_baseline_grandfathers_and_goes_stale(tmp_path):
     )
     baseline = tmp_path / "baseline.txt"
     baseline.write_text(f"{key}  # grandfathered for the test\n")
-    r = _run(tmp_path, "--baseline", baseline, "--parity", parity)
+    r = _run(
+        tmp_path, "--tier", "ast", "--baseline", baseline,
+        "--parity", parity,
+    )
     assert r.returncode == 0, r.stdout
     assert "1 baselined" in r.stderr
     # paid debt: entry no longer matches -> stale-baseline warning
     (tmp_path / "solver" / "bad.py").write_text("x = 1\n")
-    r = _run(tmp_path, "--baseline", baseline, "--parity", parity)
+    r = _run(
+        tmp_path, "--tier", "ast", "--baseline", baseline,
+        "--parity", parity,
+    )
     assert "stale-baseline" in r.stdout
     assert r.returncode == 0  # warn tier
 
@@ -618,10 +1049,12 @@ def test_json_output_schema(tmp_path):
     r = _analyze_tree(tmp_path, "--json")
     out = json.loads(r.stdout)
     assert out["version"] == 1
+    assert out["tier"] == "ast"
     assert set(out["counts"]) == {"error", "warn", "baselined"}
     f = out["findings"][0]
     assert set(f) == {
-        "path", "line", "code", "severity", "message", "anchor",
+        "path", "line", "code", "severity", "message", "anchor", "tier",
     }
     assert f["code"] == "jax-host-sync"
     assert f["severity"] == "error"
+    assert f["tier"] == "ast"

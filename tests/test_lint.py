@@ -4,48 +4,32 @@
 reference's fmt + golangci-lint + vet chain (reference Makefile:36-65).
 These tests prove the gate fails on seeded errors of every class and
 passes on the real tree (which `make check` then enforces forever).
+Fixture machinery is shared with tests/test_analysis.py
+(tests/analysis_fixtures.py) — one copy for both gates.
 """
 
-import subprocess
-import sys
-from pathlib import Path
-
-REPO = Path(__file__).resolve().parent.parent
-
-
-def _run(*roots):
-    return subprocess.run(
-        [sys.executable, str(REPO / "tools" / "lint.py"), *map(str, roots)],
-        capture_output=True,
-        text=True,
-    )
-
-
-def _lint_file(tmp_path, source: str, name="seeded.py"):
-    f = tmp_path / name
-    f.write_text(source)
-    return _run(f)
+from tests.analysis_fixtures import lint_file, run_lint
 
 
 def test_tree_is_clean():
-    r = _run()  # default roots = the whole repo
+    r = run_lint()  # default roots = the whole repo
     assert r.returncode == 0, f"lint gate is red:\n{r.stdout}"
 
 
 def test_seeded_unused_import_fails(tmp_path):
-    r = _lint_file(tmp_path, "import os\nprint('hi')\n")
+    r = lint_file(tmp_path, "import os\nprint('hi')\n")
     assert r.returncode == 1
     assert "unused-import" in r.stdout
 
 
 def test_seeded_syntax_error_fails(tmp_path):
-    r = _lint_file(tmp_path, "def broken(:\n")
+    r = lint_file(tmp_path, "def broken(:\n")
     assert r.returncode == 1
     assert "syntax-error" in r.stdout
 
 
 def test_seeded_format_errors_fail(tmp_path):
-    r = _lint_file(tmp_path, "x = 1 \n\ty = 2")
+    r = lint_file(tmp_path, "x = 1 \n\ty = 2")
     assert r.returncode == 1
     assert "trailing-space" in r.stdout
     assert "tab-indent" in r.stdout
@@ -61,12 +45,12 @@ def test_seeded_vet_errors_fail(tmp_path):
         "        pass\n"
         "    return a == None\n"
     )
-    r = _lint_file(tmp_path, src)
+    r = lint_file(tmp_path, src)
     assert r.returncode == 1
     for code in ("mutable-default", "bare-except", "none-compare"):
         assert code in r.stdout
 
 
 def test_noqa_suppresses(tmp_path):
-    r = _lint_file(tmp_path, "import os  # noqa: F401\n")
+    r = lint_file(tmp_path, "import os  # noqa: F401\n")
     assert r.returncode == 0

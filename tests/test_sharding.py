@@ -68,6 +68,45 @@ def test_hbm_estimate_pins_measured_boundary():
     assert estimate_union_hbm_bytes(20480, 32, 20480, 4, 2, 2) > budget
 
 
+def test_hbm_breakdown_components_sum_to_total():
+    """The per-component breakdown IS the estimate (the jaxpr-tier
+    memory-reconcile pass names drifted components from it), at every
+    chunking mode, and the boundary-pin shapes keep the expected
+    dominance order: carries > repair working set > everything else."""
+    from k8s_spot_rescheduler_tpu.solver.memory import (
+        estimate_union_hbm_breakdown,
+        estimate_union_hbm_bytes,
+    )
+
+    for chunks in (0, 1, 4, 16):
+        bd = estimate_union_hbm_breakdown(
+            2560, 32, 2560, 4, 2, 2, repair_spot_chunks=chunks
+        )
+        assert set(bd) == {
+            "carries", "temporaries", "repair", "slots", "outputs",
+            "spot_static",
+        }
+        assert sum(bd.values()) == estimate_union_hbm_bytes(
+            2560, 32, 2560, 4, 2, 2, repair_spot_chunks=chunks
+        )
+        assert all(v >= 0 for v in bd.values())
+    # the O(C*S)-plane components dominate the O(C*K)/O(S) linear ones
+    unchunked = estimate_union_hbm_breakdown(2560, 32, 2560, 4, 2, 2)
+    assert unchunked["carries"] > unchunked["slots"]
+    assert unchunked["repair"] > unchunked["slots"]
+    # chunking shrinks ONLY the repair working set
+    chunked = estimate_union_hbm_breakdown(
+        2560, 32, 2560, 4, 2, 2, repair_spot_chunks=4
+    )
+    assert chunked["repair"] < unchunked["repair"]
+    for k in ("carries", "temporaries", "slots", "outputs", "spot_static"):
+        assert chunked[k] == unchunked[k], k
+    norepair = estimate_union_hbm_breakdown(
+        2560, 32, 2560, 4, 2, 2, repair_spot_chunks=0
+    )
+    assert norepair["repair"] == 0
+
+
 def test_should_shard_requires_mesh_and_pressure():
     from k8s_spot_rescheduler_tpu.solver.memory import should_shard
 

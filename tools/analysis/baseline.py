@@ -40,14 +40,14 @@ def apply(
     baseline_path,
     *,
     analyzed_paths=None,
-    only_pass=None,
+    exercised_codes=None,
 ) -> Tuple[List[Finding], List[Finding], List[Finding]]:
     """Split into (active, baselined, stale-baseline findings).
 
     An unmatched entry is STALE only when this run could have matched
     it: its file was among the analyzed paths and its code was among
-    the passes run — a subset-roots or single-pass invocation must not
-    call un-exercised debt 'paid'."""
+    the exercised pass codes — a subset-roots, single-pass, or
+    single-tier invocation must not call un-exercised debt 'paid'."""
     entries = load(baseline_path)
     active: List[Finding] = []
     baselined: List[Finding] = []
@@ -67,7 +67,7 @@ def apply(
         entry_code = parts[1] if len(parts) > 2 else ""
         if analyzed_paths is not None and entry_path not in analyzed_paths:
             continue
-        if only_pass is not None and entry_code != only_pass:
+        if exercised_codes is not None and entry_code not in exercised_codes:
             continue
         stale.append(Finding(
             str(baseline_path), line, "stale-baseline",

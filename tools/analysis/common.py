@@ -17,6 +17,7 @@ per-file style gate) and ``tools/analysis`` (the cross-module vet):
 
 from __future__ import annotations
 
+import ast
 import dataclasses
 import io
 import re
@@ -65,9 +66,16 @@ ANALYSIS_CODES = {
     "config-contract",
     "kube-write-retry",
     "lock-discipline",
+    "manifest-contract",
     "bare-noqa",
     "unknown-suppression",
     "stale-baseline",
+    # jaxpr tier (tools/analysis/jaxpr — traced-program passes)
+    "dtype-promotion",
+    "index-width",
+    "transfer-audit",
+    "memory-reconcile",
+    "trace-failure",
 }
 
 # Conventional flake8-family codes used as machine-readable annotations in
@@ -94,6 +102,9 @@ class Finding:
     # stable identity for the baseline file: function/attr/field name the
     # finding anchors to, so entries survive line drift
     anchor: str = ""
+    # which analysis tier produced it: "ast" (source passes) or "jaxpr"
+    # (traced-program passes); baseline keys are tier-agnostic
+    tier: str = "ast"
 
     @property
     def key(self) -> str:
@@ -107,6 +118,7 @@ class Finding:
             "severity": self.severity,
             "message": self.message,
             "anchor": self.anchor,
+            "tier": self.tier,
         }
 
 
@@ -197,6 +209,46 @@ class Suppressions:
                         anchor=code,
                     ))
         return out
+
+
+def manifest_dict_literals(tree, target: str):
+    """``(entries, assigned)`` for every literal dict bound to ``target``
+    in a module AST — plain ``X = {...}`` and annotated ``X: dict =
+    {...}`` alike. ``entries`` is ``[(key, key_lineno, value_node)]``
+    for the string keys; ``assigned`` is True when any (possibly empty)
+    dict literal was bound at all.
+
+    The ONE parser of the HOT_PROGRAMS / EXEMPT_JIT_ROOTS surface: the
+    manifest-contract pass (tools/analysis/passes/contracts.py) and the
+    jaxpr tracer's line anchoring (tools/analysis/jaxpr/trace.py) must
+    see the same dicts, or findings anchor to lines the contract never
+    checked."""
+    entries = []
+    assigned = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            names = {
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            }
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):
+            names = (
+                {node.target.id}
+                if isinstance(node.target, ast.Name)
+                else set()
+            )
+            value = node.value
+        else:
+            continue
+        if target not in names or not isinstance(value, ast.Dict):
+            continue
+        assigned = True
+        for key, val in zip(value.keys, value.values):
+            if isinstance(key, ast.Constant) and isinstance(
+                key.value, str
+            ):
+                entries.append((key.value, key.lineno, val))
+    return entries, assigned
 
 
 def relpath(path, root=None) -> str:

@@ -3,7 +3,19 @@
 The reference gates merges on a fmt + golangci-lint + go vet chain
 (reference Makefile:36-65); ``tools/lint.py`` is the fmt/lint half and
 this engine is the vet half — project-wide passes over one shared parse
-of the package. ``make analyze`` runs it inside ``make check``.
+of the package, in two tiers:
+
+- ``--tier ast`` — the source passes (symbol table + call graph;
+  tools/analysis/passes). ``make analyze`` runs exactly this.
+- ``--tier jaxpr`` — the traced-program passes (tools/analysis/jaxpr):
+  the HOT_PROGRAMS manifest traced shape-only on CPU, audited for
+  dtype, index-width, transfer, and memory properties. ``make
+  audit-jaxpr`` runs exactly this.
+- ``--tier all`` (default) — both.
+
+Either tier's findings flow through the SAME suppression grammar and
+baseline; suppression-hygiene findings (bare-noqa etc.) belong to the
+ast tier so the two ``make check`` stages report each defect once.
 
 Exit codes: 0 clean (warnings allowed unless --strict), 1 error-tier
 findings, 2 watchdog exceeded (--max-seconds).
@@ -19,17 +31,38 @@ from pathlib import Path
 
 from tools.analysis import baseline as baseline_mod
 from tools.analysis.common import (
+    ANALYSIS_CODES,
     DEFAULT_ROOTS,
     ERROR,
     Suppressions,
     iter_py_files,
     relpath,
 )
+from tools.analysis.jaxpr import JAXPR_PASS_NAMES
 from tools.analysis.passes import ALL_PASSES
 from tools.analysis.symbols import Project
 
 DEFAULT_BASELINE = Path(__file__).parent / "baseline.txt"
 DEFAULT_PARITY = "docs/PARITY.md"
+
+AST_PASS_NAMES = tuple(name for name, _ in ALL_PASSES)
+
+
+def _exercised_codes(tier: str, only_pass) -> set:
+    """The finding codes this run could have produced — what baseline
+    staleness may be judged against."""
+    if only_pass == "suppressions":
+        return {"bare-noqa", "unknown-suppression"}
+    if only_pass is not None:
+        return {only_pass}
+    codes = set()
+    if tier in ("ast", "all"):
+        codes.update(AST_PASS_NAMES)
+        codes.update({"bare-noqa", "unknown-suppression"})
+    if tier in ("jaxpr", "all"):
+        codes.update(JAXPR_PASS_NAMES)
+        codes.add("trace-failure")
+    return codes & ANALYSIS_CODES
 
 
 def analyze(
@@ -39,9 +72,11 @@ def analyze(
     baseline_path=DEFAULT_BASELINE,
     use_baseline=True,
     only_pass=None,
+    tier="all",
+    manifest=None,
 ):
-    """Run all passes; returns (active, baselined, per-file suppressions
-    findings folded in). Pure — no printing, no exit."""
+    """Run the selected tiers' passes; returns (active, baselined) with
+    per-file suppressions folded in. Pure — no printing, no exit."""
     project = Project(Path.cwd())
     files = {}
     suppressions = {}
@@ -61,15 +96,26 @@ def analyze(
         )
 
     findings = []
-    for name, run in ALL_PASSES:
-        if only_pass and name != only_pass:
-            continue
-        findings.extend(run(project, files))
+    if tier in ("ast", "all"):
+        for name, run in ALL_PASSES:
+            if only_pass and name != only_pass:
+                continue
+            findings.extend(run(project, files))
 
-    # suppression hygiene findings (bare-noqa / unknown-suppression)
-    if only_pass in (None, "suppressions"):
-        for path, supp in suppressions.items():
-            findings.extend(supp.findings(relpath(path)))
+        # suppression hygiene findings (bare-noqa / unknown-suppression):
+        # ast tier only, so an all-tier `make check` reports each once
+        if only_pass in (None, "suppressions"):
+            for path, supp in suppressions.items():
+                findings.extend(supp.findings(relpath(path)))
+
+    if tier in ("jaxpr", "all") and (
+        only_pass is None or only_pass in JAXPR_PASS_NAMES
+    ):
+        from tools.analysis.jaxpr import run_tier
+
+        findings.extend(
+            run_tier(manifest_path=manifest, only_pass=only_pass)
+        )
 
     # apply typed per-line suppressions
     kept = []
@@ -87,12 +133,13 @@ def analyze(
         active, baselined, stale = baseline_mod.apply(
             kept, baseline_path,
             # staleness is judged per entry, only against what this run
-            # exercised (files analyzed, passes run) — a subset-roots or
-            # --pass invocation must not call un-exercised debt 'paid'
+            # exercised (files analyzed, tiers/passes run) — a
+            # subset-roots, --pass, or single-tier invocation must not
+            # call un-exercised debt 'paid'
             analyzed_paths={
                 relpath(p) for p in files if p != "__parity__"
             },
-            only_pass=only_pass,
+            exercised_codes=_exercised_codes(tier, only_pass),
         )
         active.extend(stale)
     else:
@@ -104,10 +151,16 @@ def analyze(
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tools.analysis",
-        description="project-wide static analysis (vet analog)",
+        description="project-wide static analysis (vet analog), two "
+                    "tiers: ast (source) + jaxpr (traced programs)",
     )
     p.add_argument("roots", nargs="*", default=None,
                    help=f"files/dirs to analyze (default: {DEFAULT_ROOTS})")
+    p.add_argument("--tier", choices=("ast", "jaxpr", "all"),
+                   default="all",
+                   help="which analysis tier(s) to run (default: all; "
+                        "'make analyze' pins ast, 'make audit-jaxpr' "
+                        "pins jaxpr)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable findings (schema in "
                         "docs/ANALYSIS.md)")
@@ -117,10 +170,15 @@ def main(argv=None) -> int:
                    help="ignore the baseline (report everything)")
     p.add_argument("--parity", default=DEFAULT_PARITY,
                    help="PARITY.md path for the config-contract doc check")
+    p.add_argument("--manifest", default=None,
+                   help="alternate HOT_PROGRAMS manifest module for the "
+                        "jaxpr tier (default: the package's "
+                        "hot_programs.collect(); fixture/test hook)")
     p.add_argument("--strict", action="store_true",
                    help="warn-tier findings also fail the gate")
     p.add_argument("--pass", dest="only_pass", default=None,
-                   choices=[name for name, _ in ALL_PASSES]
+                   choices=list(AST_PASS_NAMES)
+                   + list(JAXPR_PASS_NAMES)
                    + ["suppressions"],
                    help="run a single pass by code name (a typo must "
                         "error, not report a vacuously clean tree)")
@@ -129,6 +187,20 @@ def main(argv=None) -> int:
                         "(keeps 'make check' fast)")
     args = p.parse_args(argv)
 
+    if args.only_pass in JAXPR_PASS_NAMES and args.tier == "ast":
+        p.error(
+            f"--pass {args.only_pass} is a jaxpr-tier pass; "
+            "drop --tier ast (or use --tier jaxpr)"
+        )
+    if (
+        args.only_pass in AST_PASS_NAMES
+        or args.only_pass == "suppressions"
+    ) and args.tier == "jaxpr":
+        p.error(
+            f"--pass {args.only_pass} is an ast-tier pass; "
+            "drop --tier jaxpr (or use --tier ast)"
+        )
+
     t0 = time.perf_counter()
     active, baselined = analyze(
         args.roots or DEFAULT_ROOTS,
@@ -136,6 +208,8 @@ def main(argv=None) -> int:
         baseline_path=args.baseline,
         use_baseline=not args.no_baseline,
         only_pass=args.only_pass,
+        tier=args.tier,
+        manifest=args.manifest,
     )
     elapsed = time.perf_counter() - t0
 
@@ -145,6 +219,7 @@ def main(argv=None) -> int:
     if args.as_json:
         print(json.dumps({
             "version": 1,
+            "tier": args.tier,
             "elapsed_seconds": round(elapsed, 3),
             "findings": [f.as_dict() for f in active],
             "counts": {

@@ -1,0 +1,95 @@
+"""Jaxpr-tier program auditor (``make audit-jaxpr``, docs/ANALYSIS.md).
+
+The second analysis tier: where ``tools/analysis/passes`` vets the
+SOURCE (AST + call graph), this package vets the PROGRAMS — each
+``HOT_PROGRAMS`` manifest entry
+(k8s_spot_rescheduler_tpu/hot_programs.py) is traced shape-only on CPU
+(``jax.make_jaxpr`` over ``ShapeDtypeStruct``s; no device, no
+execution) and four pass families run over the jaxprs:
+
+- ``dtype-promotion`` — 64-bit upcasts, explicit 64-bit literals,
+  scan/while carry dtype mismatches (tools/analysis/jaxpr/dtypes.py);
+- ``index-width`` — interval analysis proving every derived index fits
+  its dtype at the declared 20x max shapes (widths.py);
+- ``transfer-audit`` — device_put/callback round-trips, by-value
+  constant captures, donate_argnums aliasing (transfer.py);
+- ``memory-reconcile`` — the traced program's buffer model vs
+  solver/memory's HBM estimate at the boundary-pin shapes
+  (memcheck.py).
+
+Findings anchor to the manifest entry's line in its defining module,
+so the shared ``# noqa`` grammar and baseline
+(tools/analysis/common.py) apply unchanged. A failed trace is itself
+an error (``trace-failure``): coverage can shrink loudly, never
+silently. The AST-tier ``manifest-contract`` pass closes the loop from
+the other side (every jit root must be in the manifest).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+JAXPR_PASS_NAMES = (
+    "dtype-promotion",
+    "index-width",
+    "transfer-audit",
+    "memory-reconcile",
+)
+
+
+def run_tier(
+    manifest_path: Optional[str] = None, only_pass: Optional[str] = None
+) -> List:
+    """Trace the manifest and run the jaxpr passes; returns Findings.
+    Imports jax — callers on the AST-only path never pay for this."""
+    from tools.analysis.common import ERROR, Finding
+    from tools.analysis.jaxpr import dtypes, memcheck, transfer, widths
+    from tools.analysis.jaxpr.trace import (
+        TraceCache,
+        ensure_cpu_tracing_env,
+        load_manifest,
+    )
+
+    ensure_cpu_tracing_env()  # must precede the first jax import
+    from k8s_spot_rescheduler_tpu.hot_programs import (
+        MAX_SHAPES,
+        RECONCILE_SHAPES,
+    )
+
+    manifest = load_manifest(manifest_path)
+    cache = TraceCache(manifest)
+    findings: List[Finding] = []
+
+    def want(name: str) -> bool:
+        return only_pass is None or only_pass == name
+
+    for name in sorted(manifest):
+        hp, _, line = manifest[name]
+        probe = MAX_SHAPES if hp.index_width else RECONCILE_SHAPES[0]
+        t = cache.get(name, probe)
+        if t.error is not None and t.error_kind != "carry-mismatch":
+            findings.append(Finding(
+                t.path, line, "trace-failure",
+                f"hot program '{name}' failed to trace at "
+                f"C={probe.C},S={probe.S}: {t.error[:300]} — a manifest "
+                "entry that cannot trace is audit coverage silently "
+                "lost; fix the builder or the program",
+                severity=ERROR, anchor=f"{name}.trace", tier="jaxpr",
+            ))
+            continue
+        if want("dtype-promotion"):
+            findings.extend(dtypes.run(t))
+        if t.closed_jaxpr is None:
+            continue  # carry-mismatch: no jaxpr for the other passes
+        if want("index-width") and hp.index_width:
+            findings.extend(widths.run(t))
+        if want("transfer-audit"):
+            findings.extend(transfer.run(t))
+        if want("memory-reconcile") and hp.reconcile is not None:
+            traced_by_shape = [
+                (s, cache.get(name, s)) for s in RECONCILE_SHAPES
+            ]
+            findings.extend(
+                memcheck.reconcile(traced_by_shape, name, hp, t.path, line)
+            )
+    return findings

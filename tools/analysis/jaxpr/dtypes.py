@@ -1,0 +1,112 @@
+"""dtype-promotion: no 64-bit or silently-promoted values in hot jaxprs.
+
+The solver's numeric contract (solver/ffd.py layout notes): capacities
+are float32 integers < 2**24, masks are uint32, indices int32. A single
+accidental float64/int64 doubles the HBM of every buffer it touches and
+halves TPU throughput; a carry-dtype mismatch across a
+``lax.scan``/``while_loop`` silently re-promotes per step — exactly the
+bug class the ROADMAP-5 int8/bit-packed carry refactor will create.
+Three checks per traced program:
+
+- **explicit 64-bit requests**: with x64 off, a planted
+  ``jnp.float64``/``int64`` literal leaves NO trace in the jaxpr (JAX
+  downcasts it) — its only residue is the "Explicitly requested dtype
+  ... float64" warning, which the tracer records and this pass turns
+  into an error;
+- **64-bit avals**: any f64/i64/u64/c128 var anywhere in the traced
+  program (belt for configs that enable x64);
+- **carry mismatches**: a scan/while whose carry-in and carry-out types
+  differ fails AT TRACE TIME — the tracer classifies that TypeError as
+  ``carry-mismatch`` and this pass owns the finding.
+
+Int->float converts of non-bool integer operands are reported at warn
+tier: in this codebase's programs every intended int->float move is a
+bool mask widening (``onehot * req``), so an i32->f32 convert usually
+means an integer count leaked into float arithmetic (precision cliff at
+2**24).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.analysis.common import ERROR, WARN, Finding
+from tools.analysis.jaxpr.jaxpr_utils import eqn_source, iter_avals, iter_eqns
+
+_WIDE = {"float64", "int64", "uint64", "complex128"}
+
+_REQUEST_MARKERS = ("float64", "int64", "uint64", "complex128")
+
+
+def run(traced) -> List[Finding]:
+    """``traced``: TracedPrograms of one entry (the engine calls per
+    entry, max-shape probe)."""
+    findings: List[Finding] = []
+    t = traced
+    if t.error_kind == "carry-mismatch":
+        findings.append(Finding(
+            t.path, t.line, "dtype-promotion",
+            f"hot program '{t.name}' fails to trace: scan/while carry "
+            f"dtype mismatch — {t.error.splitlines()[0][:200]}",
+            severity=ERROR, anchor=f"{t.name}.carry", tier="jaxpr",
+        ))
+        return findings
+    if t.closed_jaxpr is None:
+        return findings  # trace-failure reported by the engine
+
+    for w in t.warnings:
+        if "Explicitly requested dtype" in w and any(
+            m in w for m in _REQUEST_MARKERS
+        ):
+            findings.append(Finding(
+                t.path, t.line, "dtype-promotion",
+                f"hot program '{t.name}' explicitly requests a 64-bit "
+                f"dtype while tracing (JAX downcasts it silently with "
+                f"x64 off, doubles HBM with it on): {w[:160]}",
+                severity=ERROR, anchor=f"{t.name}.request64",
+                tier="jaxpr",
+            ))
+            break  # one finding per entry: the warning repeats per op
+
+    wide_seen = set()
+    for _, aval in iter_avals(t.closed_jaxpr.jaxpr):
+        name = getattr(getattr(aval, "dtype", None), "name", "")
+        if name in _WIDE and name not in wide_seen:
+            wide_seen.add(name)
+            findings.append(Finding(
+                t.path, t.line, "dtype-promotion",
+                f"hot program '{t.name}' traces with a {name} value — "
+                "the solver contract is 32-bit (f32 capacities, u32 "
+                "masks, i32 indices); a 64-bit buffer doubles HBM and "
+                "halves TPU throughput",
+                severity=ERROR, anchor=f"{t.name}.{name}", tier="jaxpr",
+            ))
+
+    seen_msgs = set()
+    for eqn in iter_eqns(t.closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = getattr(eqn.invars[0], "aval", None)
+        dst = eqn.outvars[0].aval
+        s_dt = getattr(getattr(src, "dtype", None), "name", "")
+        d_dt = getattr(dst.dtype, "name", "")
+        if (
+            s_dt.startswith(("int", "uint"))
+            and s_dt not in ("", "bool")
+            and d_dt.startswith("float")
+            and getattr(src.dtype, "itemsize", 0) >= 2
+        ):
+            msg = (
+                f"hot program '{t.name}': {s_dt}->{d_dt} promotion"
+                f"{eqn_source(eqn)} — an integer value entered float "
+                "arithmetic (exact only below 2**24); widen deliberately "
+                "or keep it integral"
+            )
+            if msg not in seen_msgs:
+                seen_msgs.add(msg)
+                findings.append(Finding(
+                    t.path, t.line, "dtype-promotion", msg,
+                    severity=WARN,
+                    anchor=f"{t.name}.{s_dt}-{d_dt}", tier="jaxpr",
+                ))
+    return findings

@@ -1,0 +1,146 @@
+"""Shared jaxpr-walking helpers for the jaxpr-tier passes."""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+
+def aval_bytes(aval) -> int:
+    """Buffer size of a shaped aval (0 for abstract tokens etc.)."""
+    try:
+        n = 1
+        for d in aval.shape:
+            n *= int(d)
+        return n * aval.dtype.itemsize
+    except Exception:  # noqa: BLE001 — non-array avals carry no bytes
+        return 0
+
+
+def subjaxprs(eqn) -> Iterator:
+    """Inner (open) jaxprs of a higher-order eqn, unwrapped."""
+    import jax.core as jcore
+
+    for v in eqn.params.values():
+        for x in v if isinstance(v, (tuple, list)) else [v]:
+            if isinstance(x, jcore.ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, jcore.Jaxpr):
+                yield x
+
+
+def iter_jaxprs(jaxpr) -> Iterator:
+    """The jaxpr and every nested jaxpr, depth-first."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for sub in subjaxprs(eqn):
+            yield from iter_jaxprs(sub)
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    for j in iter_jaxprs(jaxpr):
+        yield from j.eqns
+
+
+def iter_avals(jaxpr) -> Iterator[Tuple[object, object]]:
+    """(var, aval) over every var of the program, nested included."""
+    import jax.core as jcore
+
+    for j in iter_jaxprs(jaxpr):
+        for v in list(j.invars) + list(j.constvars):
+            yield v, v.aval
+        for eqn in j.eqns:
+            for v in eqn.outvars:
+                yield v, v.aval
+            for v in eqn.invars:
+                if isinstance(v, jcore.Literal):
+                    yield v, v.aval
+
+
+def eqn_src(eqn):
+    """Best-effort (file, line) of the user code an eqn traced from,
+    or None (internal jax API; degrades to no hint, never an error)."""
+    try:
+        import jax._src.source_info_util as siu
+
+        frame = siu.user_frame(eqn.source_info)
+        if frame is not None:
+            return frame.file_name, frame.start_line
+    except Exception:  # noqa: BLE001 — internal API: degrade to no hint
+        pass
+    return None
+
+
+def eqn_source(eqn) -> str:
+    """Human-readable location suffix for finding messages ('' when
+    unavailable — message quality only, never correctness)."""
+    src = eqn_src(eqn)
+    return f" (traced at {src[0]}:{src[1]})" if src else ""
+
+
+def live_model(jaxpr) -> dict:
+    """Linear-scan peak-live-bytes model of a jaxpr.
+
+    Returns ``{"peak", "carries", "inputs", "outputs"}``:
+
+    - ``inputs``/``outputs``: summed invar(+const) / outvar aval bytes;
+    - ``carries``: the largest double-buffered scan carry anywhere in
+      the program (2x the carry avals — the scan's in-flight pair), the
+      sharp term the HBM estimator must track;
+    - ``peak``: last-use liveness scan over the eqn list. A
+      higher-order eqn contributes its body's peak MINUS its body's
+      input bytes (inner invars alias outer live buffers — counting
+      both would double-charge), and a scan additionally keeps one
+      extra carry copy live (the double buffer).
+
+    This deliberately models buffer *liveness*, not XLA's fused
+    allocation (fusion materializes fewer temporaries than liveness
+    implies); memory-reconcile therefore compares RATIOS against the
+    estimator, with the bands calibrated in docs/ANALYSIS.md.
+    """
+    import jax.core as jcore
+
+    last_use: dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if isinstance(v, jcore.Var):
+                last_use[id(v)] = i
+    n = len(jaxpr.eqns)
+    for v in jaxpr.outvars:
+        if isinstance(v, jcore.Var):
+            last_use[id(v)] = n
+
+    inputs = sum(
+        aval_bytes(v.aval)
+        for v in list(jaxpr.invars) + list(jaxpr.constvars)
+    )
+    outputs = sum(aval_bytes(v.aval) for v in jaxpr.outvars)
+    live = inputs
+    peak = live
+    max_carry = 0
+    for i, eqn in enumerate(jaxpr.eqns):
+        transient = 0
+        for sub in subjaxprs(eqn):
+            inner = live_model(sub)
+            transient = max(transient, max(0, inner["peak"] - inner["inputs"]))
+            max_carry = max(max_carry, inner["carries"])
+        if eqn.primitive.name == "scan":
+            nc = eqn.params.get("num_carry", 0)
+            carry_bytes = sum(
+                aval_bytes(v.aval) for v in eqn.outvars[:nc]
+            )
+            max_carry = max(max_carry, 2 * carry_bytes)
+            transient += carry_bytes  # the second buffer of the pair
+        live += sum(aval_bytes(v.aval) for v in eqn.outvars)
+        peak = max(peak, live + transient)
+        seen = set()
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if isinstance(v, jcore.Var) and id(v) not in seen:
+                seen.add(id(v))
+                if last_use.get(id(v)) == i:
+                    live -= aval_bytes(v.aval)
+    return {
+        "peak": peak,
+        "carries": max_carry,
+        "inputs": inputs,
+        "outputs": outputs,
+    }

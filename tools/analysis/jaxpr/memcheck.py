@@ -1,0 +1,158 @@
+"""memory-reconcile: the HBM estimator must track the traced program.
+
+``solver/memory.estimate_union_hbm_bytes`` sizes every dispatch
+decision — single-chip vs cand-sharded vs 2-D, and
+``pick_repair_chunks``'s chunk count. It is hand-derived from the union
+program's buffer structure, so nothing stops it rotting as kernels
+change — until the drift strands a config on the wrong tier (phantom
+reroute) or OOMs a chip the estimate said was fine. This pass re-derives
+a buffer model FROM THE TRACED JAXPR at the measured boundary-pin
+shapes (hot_programs.RECONCILE_SHAPES, the same points
+tests/test_sharding.py pins against hardware reality) and fails on
+drift beyond tolerance.
+
+The jaxpr model (jaxpr_utils.live_model) tracks buffer liveness, which
+over-counts XLA's fused reality by a program-dependent but
+SCALE-STABLE factor — so the checks are ratio bands, calibrated at
+introduction (values in docs/ANALYSIS.md):
+
+- ``carries``: estimator carries vs 2x the largest scan carry — the
+  one exact correspondence (measured ratio 1.00 across every variant
+  and scale); band :data:`CARRY_BAND`. This is the check ROADMAP-5's
+  narrow-int carry packing must keep green: repack the carry without
+  resizing the estimator and the ratio jumps 4x.
+- ``inputs``: estimator slots+spot_static vs summed invar avals
+  (measured ~1.0); band :data:`INPUT_BAND`.
+- ``total``: estimator total vs modeled peak (measured 0.31-0.55 by
+  variant — liveness over-counts fusion); band :data:`TOTAL_BAND`. The
+  upper bound also catches the reverse rot: kernels shrink, estimator
+  doesn't, and configs get rerouted off chips they fit.
+- ``scale``: the est/peak ratio at 4x vs 1x must agree within
+  :data:`SCALE_DRIFT_MAX` — the estimator's asymptotics match the
+  program's.
+
+On any failure the finding carries the per-component table
+(solver/memory.estimate_union_hbm_breakdown vs the jaxpr model), so
+the report names WHICH buffer family drifted, not just the sum.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.analysis.common import ERROR, Finding
+from tools.analysis.jaxpr.jaxpr_utils import live_model
+
+CARRY_BAND = (0.7, 1.4)
+INPUT_BAND = (0.7, 1.4)
+TOTAL_BAND = (0.25, 0.9)
+SCALE_DRIFT_MAX = 0.15
+
+
+def _breakdown(hp, shapes) -> dict:
+    spec = hp.reconcile or {}
+    if "estimator" in spec:
+        return dict(spec["estimator"](shapes))
+    from k8s_spot_rescheduler_tpu.solver.memory import (
+        estimate_union_hbm_breakdown,
+    )
+
+    return estimate_union_hbm_breakdown(
+        shapes.C, shapes.K, shapes.S, shapes.R, shapes.W, shapes.A,
+        repair_spot_chunks=spec.get("repair_spot_chunks", 1),
+    )
+
+
+def _component_table(est: dict, model: dict) -> str:
+    est_lines = ", ".join(
+        f"{k}={v / 1e6:.1f}MB" for k, v in sorted(est.items())
+    )
+    model_lines = ", ".join(
+        f"{k}={v / 1e6:.1f}MB" for k, v in sorted(model.items())
+    )
+    return f"estimator[{est_lines}] vs traced[{model_lines}]"
+
+
+def reconcile(traced_by_shape, name, hp, path, line) -> List[Finding]:
+    """``traced_by_shape``: [(shapes, TracedProgram)] at the reconcile
+    probe points, smallest first."""
+    findings: List[Finding] = []
+
+    def fail(check: str, message: str) -> None:
+        findings.append(Finding(
+            path, line, "memory-reconcile",
+            f"hot program '{name}': {message}",
+            severity=ERROR, anchor=f"{name}.{check}", tier="jaxpr",
+        ))
+
+    ratios = []
+    for shapes, t in traced_by_shape:
+        if t.closed_jaxpr is None:
+            # the engine's trace-failure check covers only the max-shape
+            # probe; a reconcile probe that cannot trace must be loud
+            # too, or the HBM-drift gate goes silently green
+            findings.append(Finding(
+                path, line, "trace-failure",
+                f"hot program '{name}' failed to trace at the "
+                f"memory-reconcile probe C={shapes.C},S={shapes.S}: "
+                f"{(t.error or 'no jaxpr')[:300]} — the HBM estimator "
+                "cannot be reconciled against a program that does not "
+                "trace",
+                severity=ERROR, anchor=f"{name}.trace.C{shapes.C}",
+                tier="jaxpr",
+            ))
+            continue
+        model = live_model(t.closed_jaxpr.jaxpr)
+        est = _breakdown(hp, shapes)
+        est_total = sum(est.values())
+        table = _component_table(est, model)
+
+        carry_est = est.get("carries", 0)
+        if model["carries"] and not (
+            CARRY_BAND[0]
+            <= carry_est / model["carries"]
+            <= CARRY_BAND[1]
+        ):
+            fail(
+                "carries",
+                f"'carries' drifted: estimator {carry_est / 1e6:.1f}MB vs "
+                f"2x traced scan carry {model['carries'] / 1e6:.1f}MB "
+                f"(ratio {carry_est / model['carries']:.2f}, band "
+                f"{CARRY_BAND}) at C={shapes.C},S={shapes.S} — the scan "
+                f"state changed shape/dtype without the estimator; "
+                f"{table}",
+            )
+        in_est = est.get("slots", 0) + est.get("spot_static", 0)
+        if model["inputs"] and not (
+            INPUT_BAND[0] <= in_est / model["inputs"] <= INPUT_BAND[1]
+        ):
+            fail(
+                "inputs",
+                f"'slots+spot_static' drifted: estimator "
+                f"{in_est / 1e6:.1f}MB vs traced program inputs "
+                f"{model['inputs'] / 1e6:.1f}MB (ratio "
+                f"{in_est / model['inputs']:.2f}, band {INPUT_BAND}) at "
+                f"C={shapes.C},S={shapes.S}; {table}",
+            )
+        if model["peak"]:
+            r = est_total / model["peak"]
+            ratios.append((shapes, r))
+            if not (TOTAL_BAND[0] <= r <= TOTAL_BAND[1]):
+                fail(
+                    "total",
+                    f"total drifted: estimator {est_total / 1e6:.1f}MB vs "
+                    f"modeled peak {model['peak'] / 1e6:.1f}MB (ratio "
+                    f"{r:.2f}, band {TOTAL_BAND}) at C={shapes.C},"
+                    f"S={shapes.S}; {table}",
+                )
+    if len(ratios) >= 2:
+        (s0, r0), (s1, r1) = ratios[0], ratios[-1]
+        if r0 and abs(r1 - r0) / r0 > SCALE_DRIFT_MAX:
+            fail(
+                "scale",
+                f"est/peak ratio is scale-dependent: {r0:.3f} at "
+                f"C={s0.C} vs {r1:.3f} at C={s1.C} (max drift "
+                f"{SCALE_DRIFT_MAX:.0%}) — the estimator's asymptotics "
+                "no longer match the traced program",
+            )
+    return findings

@@ -1,0 +1,160 @@
+"""Shape-only tracing of the HOT_PROGRAMS manifest.
+
+Every jaxpr pass consumes :class:`TracedProgram`s produced here: the
+manifest entry's builder runs at a :class:`ProbeShapes` point, the
+callable is traced with ``jax.make_jaxpr`` over ``ShapeDtypeStruct``
+pytrees (no device buffers, no execution — abstract eval only, cost
+independent of the probe shape), and anything the trace *itself* says
+is captured:
+
+- warnings (the "Explicitly requested dtype float64 ..." class — the
+  only visible residue of a planted 64-bit literal when x64 is off) are
+  recorded for the dtype-promotion pass;
+- a ``TypeError`` naming a scan/while carry type mismatch is recorded
+  as ``error_kind="carry-mismatch"`` (dtype-promotion owns it: the
+  exact bug class a carry-dtype refactor introduces);
+- any other exception is ``error_kind="trace"`` (the engine reports it
+  as a ``trace-failure`` error — a broken manifest turns the gate red,
+  never silently shrinks coverage).
+
+Environment: the audit is CPU-only by policy (the ISSUE of record:
+"traced shape-only on CPU — no device, no execution"), and the mesh
+entries need >=8 virtual devices, so :func:`ensure_cpu_tracing_env`
+must run BEFORE jax is first imported in this process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+from typing import List, Optional, Tuple
+
+_DEVICE_FLAG = "--xla_force_host_platform_device_count"
+
+
+def ensure_cpu_tracing_env() -> None:
+    """Pin tracing to CPU with >=8 virtual devices. A no-op for any
+    knob the caller already set explicitly; must run before the first
+    ``import jax`` to take effect (harmless afterwards)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _DEVICE_FLAG not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + _DEVICE_FLAG + "=8").strip()
+
+
+@dataclasses.dataclass
+class TracedProgram:
+    name: str  # manifest entry name
+    hp: object  # HotProgram
+    shapes: object  # ProbeShapes this trace ran at
+    path: str  # repo-relative file of the defining module
+    line: int  # line of the manifest entry (suppression anchor)
+    closed_jaxpr: Optional[object] = None
+    arg_avals: Tuple = ()  # per-positional-arg flattened avals (donation)
+    warnings: List[str] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    error_kind: Optional[str] = None  # "carry-mismatch" | "trace"
+
+
+def _entry_lines(module_file: str) -> dict:
+    """Manifest entry name -> line number of its key in the module's
+    ``HOT_PROGRAMS`` dict literal (the noqa/baseline anchor line). The
+    parse is the SAME one the manifest-contract pass uses
+    (common.manifest_dict_literals), so findings anchor exactly to the
+    lines the contract checks."""
+    import ast
+
+    from tools.analysis.common import manifest_dict_literals
+
+    try:
+        tree = ast.parse(
+            open(module_file, encoding="utf-8").read(), filename=module_file
+        )
+    except (OSError, SyntaxError):
+        return {}
+    entries, _ = manifest_dict_literals(tree, "HOT_PROGRAMS")
+    return {name: lineno for name, lineno, _ in entries}
+
+
+def load_manifest(manifest_path: Optional[str] = None) -> dict:
+    """``{name: (HotProgram, module_file, line)}`` — the package's
+    collected manifest by default, or a single manifest module loaded
+    from ``manifest_path`` (the fixture/test hook)."""
+    if manifest_path is None:
+        from k8s_spot_rescheduler_tpu.hot_programs import collect
+
+        raw = collect()
+    else:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_audit_manifest", manifest_path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        raw = {
+            name: (hp, manifest_path)
+            for name, hp in getattr(mod, "HOT_PROGRAMS", {}).items()
+        }
+    out = {}
+    lines_by_file: dict = {}
+    for name, (hp, module_file) in raw.items():
+        if module_file not in lines_by_file:
+            lines_by_file[module_file] = _entry_lines(module_file)
+        line = lines_by_file[module_file].get(name, 1)
+        out[name] = (hp, module_file, line)
+    return out
+
+
+def trace_entry(name, hp, module_file, line, shapes) -> TracedProgram:
+    """Build and trace one manifest entry at one ProbeShapes point."""
+    import jax
+
+    from tools.analysis.common import relpath
+
+    t = TracedProgram(
+        name=name, hp=hp, shapes=shapes, path=relpath(module_file), line=line
+    )
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            built = hp.build(shapes)
+            fn, args = built[0], built[1]
+            static = tuple(built[2]) if len(built) > 2 else ()
+            t.closed_jaxpr = jax.make_jaxpr(fn, static_argnums=static)(*args)
+            t.arg_avals = tuple(
+                tuple(jax.tree_util.tree_leaves(a))
+                if i not in static
+                else ()
+                for i, a in enumerate(args)
+            )
+        t.warnings = [str(w.message) for w in caught]
+    except TypeError as err:
+        msg = str(err)
+        t.error = msg
+        t.error_kind = (
+            "carry-mismatch"
+            if "carry" in msg and ("differ" in msg or "equal types" in msg)
+            else "trace"
+        )
+    except Exception as err:  # noqa: BLE001 — ANY builder/trace failure
+        # must become a red finding, not an engine crash
+        t.error = f"{type(err).__name__}: {err}"
+        t.error_kind = "trace"
+    return t
+
+
+class TraceCache:
+    """One trace per (entry, shapes) across all passes."""
+
+    def __init__(self, manifest: dict):
+        self.manifest = manifest
+        self._cache: dict = {}
+
+    def get(self, name, shapes) -> TracedProgram:
+        key = (name, tuple(shapes))
+        if key not in self._cache:
+            hp, module_file, line = self.manifest[name]
+            self._cache[key] = trace_entry(name, hp, module_file, line, shapes)
+        return self._cache[key]

@@ -1,0 +1,124 @@
+"""transfer-audit: no host round-trips, by-value captures, or
+silently-copying donations in hot jaxprs.
+
+Three buffer-movement properties the AST tier cannot see (they exist
+only in what actually traced):
+
+- **pinned transfers** — a ``device_put`` or host callback
+  (``pure_callback``/``io_callback``/``debug_callback``) primitive
+  inside a hot program re-serializes every call against the host
+  (error);
+- **by-value constants** — a concrete array closed over at trace time
+  becomes a jaxpr const: it ships with the executable and re-uploads
+  per compile instead of riding the argument path once
+  (error past :data:`CONST_BYTES_LIMIT`; tiny scalars/offsets are the
+  normal residue of static shape math);
+- **donation aliasing** — every position named in an entry's
+  ``donate_argnums`` must alias some output (shape+dtype multiset
+  match). A donated-but-unaliasable buffer is silently COPIED by XLA:
+  the caller loses the input (API contract) and gains no in-place
+  update — for the delta scatter that would double the resident
+  cluster's footprint (error).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.analysis.common import ERROR, Finding
+from tools.analysis.jaxpr.jaxpr_utils import eqn_source, iter_eqns
+
+# a const bigger than this cannot be shape bookkeeping — it is cluster
+# state captured by value (the chunk-offset iotas of the chunked repair
+# are < 4 KiB at any plausible chunk count)
+CONST_BYTES_LIMIT = 64 * 1024
+
+_TRANSFER_PRIMS = {"device_put"}
+_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+}
+
+
+def run(traced) -> List[Finding]:
+    import numpy as np
+
+    t = traced
+    if t.closed_jaxpr is None:
+        return []
+    findings: List[Finding] = []
+
+    seen_prims = set()
+    for eqn in iter_eqns(t.closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name in _TRANSFER_PRIMS and name not in seen_prims:
+            seen_prims.add(name)
+            findings.append(Finding(
+                t.path, t.line, "transfer-audit",
+                f"hot program '{t.name}' embeds a {name} op"
+                f"{eqn_source(eqn)} — a device placement pinned inside "
+                "the traced program forces a transfer per call; move it "
+                "to the call boundary",
+                severity=ERROR, anchor=f"{t.name}.{name}", tier="jaxpr",
+            ))
+        elif name in _CALLBACK_PRIMS and name not in seen_prims:
+            seen_prims.add(name)
+            findings.append(Finding(
+                t.path, t.line, "transfer-audit",
+                f"hot program '{t.name}' embeds a host callback "
+                f"({name}){eqn_source(eqn)} — the device pipeline "
+                "drains on every call; hot programs must stay "
+                "device-only",
+                severity=ERROR, anchor=f"{t.name}.{name}", tier="jaxpr",
+            ))
+
+    for i, const in enumerate(t.closed_jaxpr.consts):
+        try:
+            nbytes = int(np.asarray(const).nbytes)
+        except Exception:  # noqa: BLE001 — non-array const: no buffer
+            continue
+        if nbytes > CONST_BYTES_LIMIT:
+            findings.append(Finding(
+                t.path, t.line, "transfer-audit",
+                f"hot program '{t.name}' captures a "
+                f"{nbytes / 1024:.0f} KiB constant by value (const #{i}, "
+                f"shape {np.shape(const)}) — closed-over concrete arrays "
+                "ship with the executable and re-upload per compile; "
+                "pass them as arguments",
+                severity=ERROR, anchor=f"{t.name}.const{i}",
+                tier="jaxpr",
+            ))
+
+    if t.hp.donate_argnums:
+        # multiset match donated input avals against output avals — the
+        # aliasing rule XLA applies (shape+dtype equality)
+        out_pool: dict = {}
+        for v in t.closed_jaxpr.jaxpr.outvars:
+            key = (tuple(v.aval.shape), str(v.aval.dtype))
+            out_pool[key] = out_pool.get(key, 0) + 1
+        for pos in t.hp.donate_argnums:
+            if pos >= len(t.arg_avals):
+                findings.append(Finding(
+                    t.path, t.line, "transfer-audit",
+                    f"hot program '{t.name}' declares donate_argnums "
+                    f"position {pos} but traces only "
+                    f"{len(t.arg_avals)} arguments",
+                    severity=ERROR, anchor=f"{t.name}.donate{pos}",
+                    tier="jaxpr",
+                ))
+                continue
+            for aval in t.arg_avals[pos]:
+                key = (tuple(aval.shape), str(np.dtype(aval.dtype)))
+                if out_pool.get(key, 0) > 0:
+                    out_pool[key] -= 1
+                else:
+                    findings.append(Finding(
+                        t.path, t.line, "transfer-audit",
+                        f"hot program '{t.name}' donates argument {pos} "
+                        f"({key[1]}{list(key[0])}) but NO output matches "
+                        "its shape/dtype — XLA copies instead of "
+                        "aliasing: the caller loses the buffer and gains "
+                        "no in-place update",
+                        severity=ERROR, anchor=f"{t.name}.donate{pos}",
+                        tier="jaxpr",
+                    ))
+    return findings

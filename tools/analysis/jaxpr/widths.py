@@ -1,0 +1,495 @@
+"""index-width: interval analysis over index-producing ops at the
+declared max shapes.
+
+The gate that makes ROADMAP-5's narrow-int carry packing safe to
+attempt: every value range the traced program can produce must fit the
+dtype that carries it AT :data:`hot_programs.MAX_SHAPES` (the 20x
+target, 1M pods / 100k nodes). A flattened ``C*S`` offset is 2.6e9
+there — past int32 — and XLA wraps silently.
+
+Abstract interpretation over the jaxpr: each var maps to a closed
+interval ``(lo, hi)`` in exact Python arithmetic, or ``None`` (unknown).
+Sources of known ranges are the *structural* quantities — ``iota``
+(``[0, n-1]``), ``argmax``/``argmin`` (``[0, axis-1]``),
+``axis_index`` (``[0, mesh_axis-1]``), literals and small consts —
+propagated through shape/arith/select/reduce ops, widened through scan
+carries to a bounded fixpoint, and dropped to unknown anywhere the
+transfer is not modeled. Program *inputs* are unknown by design:
+intervals prove facts about the indices the program derives, not about
+what the cluster feeds it (an input-derived sum may legitimately span
+its dtype).
+
+Checks (error tier):
+
+- every integer (non-bool) eqn output whose interval is known must fit
+  its dtype's range — this is where ``i32(C) * i32(S)`` overflow
+  surfaces;
+- every ``convert_element_type`` to a narrower integer must fit the
+  target (the narrow-int packing check);
+- structurally, an ``iota``/``argmax``/``argsort`` whose axis length
+  alone exceeds its index dtype is reported even when intervals are
+  unknown.
+
+Precision beats recall (the suite's standing rule): an unmodeled
+primitive yields unknown and costs coverage, never a false error.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from tools.analysis.common import ERROR, Finding
+from tools.analysis.jaxpr.jaxpr_utils import eqn_source, eqn_src, subjaxprs
+
+Interval = Optional[Tuple[float, float]]
+
+_SCAN_FIXPOINT_ITERS = 3
+
+
+def _dtype_range(dtype):
+    import numpy as np
+
+    name = dtype.name
+    if name == "bool":
+        return (0, 1)
+    if name.startswith(("int", "uint")):
+        info = np.iinfo(dtype)
+        return (int(info.min), int(info.max))
+    return None
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and not (
+        math.isnan(x) if isinstance(x, float) else False
+    )
+
+
+def _mk(lo, hi) -> Interval:
+    if not _finite(lo) or not _finite(hi):
+        return None
+    if isinstance(lo, float) and math.isinf(lo) and lo > 0:
+        return None
+    if isinstance(hi, float) and math.isinf(hi) and hi < 0:
+        return None
+    return (lo, hi)
+
+
+def _union(a: Interval, b: Interval) -> Interval:
+    if a is None or b is None:
+        return None
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+def _arith(op, a: Interval, b: Interval) -> Interval:
+    if a is None or b is None:
+        return None
+    try:
+        combos = [op(x, y) for x in a for y in b]
+    except (OverflowError, ZeroDivisionError, ValueError):
+        return None
+    if any(isinstance(c, float) and math.isnan(c) for c in combos):
+        return None
+    return _mk(min(combos), max(combos))
+
+
+def _const_interval(value) -> Interval:
+    import numpy as np
+
+    try:
+        arr = np.asarray(value)
+        if arr.size == 0 or arr.dtype.kind not in "biuf":
+            return None
+        lo, hi = arr.min(), arr.max()
+        if arr.dtype.kind == "f" and not (
+            np.isfinite(lo) and np.isfinite(hi)
+        ):
+            lo = float(lo) if np.isfinite(lo) else float("-inf")
+            hi = float(hi) if np.isfinite(hi) else float("inf")
+            return _mk(lo, hi)
+        if arr.dtype.kind == "b":
+            return (int(lo), int(hi))
+        if arr.dtype.kind in "iu":
+            return (int(lo), int(hi))
+        return (float(lo), float(hi))
+    except Exception:  # noqa: BLE001 — unintervalable const: unknown
+        return None
+
+
+class _Analyzer:
+    """One program's interval walk; findings dedupe by eqn site (the
+    scan-carry fixpoint revisits body eqns with widened intervals, and
+    one defect must stay one finding)."""
+
+    def __init__(self, report):
+        self._report = report  # callable(check_name, eqn, message)
+        self._mesh_sizes: Dict[str, int] = {}
+
+    # -- environment helpers ------------------------------------------
+
+    def _read(self, env, v) -> Interval:
+        import jax.core as jcore
+
+        if isinstance(v, jcore.Literal):
+            return _const_interval(v.val)
+        return env.get(id(v))
+
+    def _check_fits(self, eqn, aval, interval: Interval) -> None:
+        if interval is None:
+            return
+        rng = _dtype_range(getattr(aval, "dtype", None)) if hasattr(
+            aval, "dtype"
+        ) else None
+        if rng is None or getattr(aval.dtype, "name", "") == "bool":
+            return
+        lo, hi = interval
+        if lo < rng[0] or hi > rng[1]:
+            self._report(
+                "overflow",
+                eqn,
+                f"{eqn.primitive.name} produces values in "
+                f"[{lo:.0f}, {hi:.0f}] carried as {aval.dtype.name} "
+                f"(range [{rng[0]}, {rng[1]}]){eqn_source(eqn)} — "
+                "silent wraparound at the declared max shapes",
+            )
+
+    # -- structural checks (fire even with unknown intervals) ---------
+
+    def _structural(self, eqn) -> None:
+        name = eqn.primitive.name
+        if name == "iota":
+            dtype = eqn.params.get("dtype")
+            shape = eqn.params.get("shape") or ()
+            dim = eqn.params.get("dimension", 0)
+            rng = _dtype_range(dtype) if dtype is not None else None
+            if rng and shape and int(shape[dim]) - 1 > rng[1]:
+                self._report(
+                    "iota-width",
+                    eqn,
+                    f"iota of length {int(shape[dim])} carried as "
+                    f"{dtype.name} (max {rng[1]}){eqn_source(eqn)}",
+                )
+        elif name in ("argmax", "argmin"):
+            axes = eqn.params.get("axes") or ()
+            idx_dtype = eqn.params.get("index_dtype")
+            operand = eqn.invars[0].aval
+            rng = _dtype_range(idx_dtype) if idx_dtype is not None else None
+            for ax in axes:
+                if rng and int(operand.shape[ax]) - 1 > rng[1]:
+                    self._report(
+                        "arg-width",
+                        eqn,
+                        f"{name} over an axis of {int(operand.shape[ax])} "
+                        f"indexed as {idx_dtype.name} (max {rng[1]})"
+                        f"{eqn_source(eqn)}",
+                    )
+        elif name in ("sort", "argsort"):
+            # argsort indices ride the output dtype of the index operand
+            operand = eqn.invars[0].aval
+            dim = eqn.params.get("dimension", -1)
+            n = int(operand.shape[dim])
+            for out in eqn.outvars:
+                rng = _dtype_range(getattr(out.aval, "dtype", None))
+                if (
+                    rng
+                    and getattr(out.aval.dtype, "kind", "") in "iu"
+                    and n - 1 > rng[1]
+                ):
+                    self._report(
+                        "sort-width",
+                        eqn,
+                        f"{name} over an axis of {n} with "
+                        f"{out.aval.dtype.name} indices (max {rng[1]})"
+                        f"{eqn_source(eqn)}",
+                    )
+
+    # -- transfer functions -------------------------------------------
+
+    def _apply(self, eqn, ins: List[Interval]) -> List[Interval]:
+        name = eqn.primitive.name
+        p = eqn.params
+        one = [None] * len(eqn.outvars)
+
+        passthrough = {
+            "broadcast_in_dim", "reshape", "transpose", "squeeze",
+            "slice", "rev", "copy", "reduce_max", "reduce_min",
+            "dynamic_slice", "gather", "expand_dims", "real",
+            "stop_gradient", "reduce_precision",
+        }
+        if name in passthrough:
+            return [ins[0]]
+        if name == "convert_element_type":
+            return [ins[0]]
+        if name == "iota":
+            shape = p.get("shape") or (0,)
+            dim = p.get("dimension", 0)
+            return [(0, max(0, int(shape[dim]) - 1))]
+        if name == "axis_index":
+            size = self._mesh_sizes.get(p.get("axis_name"))
+            return [(0, size - 1)] if size else one
+        if name in ("argmax", "argmin"):
+            axes = p.get("axes") or (0,)
+            n = int(eqn.invars[0].aval.shape[axes[0]])
+            return [(0, max(0, n - 1))]
+        if name == "add":
+            return [_arith(lambda x, y: x + y, ins[0], ins[1])]
+        if name == "sub":
+            return [_arith(lambda x, y: x - y, ins[0], ins[1])]
+        if name == "mul":
+            return [_arith(lambda x, y: x * y, ins[0], ins[1])]
+        if name == "div":
+            return [_arith(lambda x, y: x / y if y else float("nan"),
+                           ins[0], ins[1])]
+        if name == "rem":
+            b = ins[1]
+            if b is not None:
+                k = max(abs(b[0]), abs(b[1]))
+                return [(-k, k)] if k else one
+            return one
+        if name == "max":
+            return [_arith(max, ins[0], ins[1])]
+        if name == "min":
+            return [_arith(min, ins[0], ins[1])]
+        if name == "neg":
+            return [None if ins[0] is None else (-ins[0][1], -ins[0][0])]
+        if name == "abs":
+            if ins[0] is None:
+                return one
+            lo, hi = ins[0]
+            alo = 0 if lo <= 0 <= hi else min(abs(lo), abs(hi))
+            return [(alo, max(abs(lo), abs(hi)))]
+        if name == "sign":
+            return [(-1, 1)]
+        if name in ("floor", "ceil", "round", "clamp"):
+            if name == "clamp":
+                lo = ins[0][0] if ins[0] else None
+                hi = ins[2][1] if ins[2] else None
+                mid = ins[1]
+                if lo is not None and hi is not None:
+                    return [(lo, hi)]
+                return [mid]
+            return [ins[0]]
+        if name in ("eq", "ne", "lt", "le", "gt", "ge", "is_finite"):
+            return [(0, 1)]
+        if name in ("and", "or", "xor", "not"):
+            if all(
+                getattr(v.aval.dtype, "name", "") == "bool"
+                for v in eqn.outvars
+            ):
+                return [(0, 1)]
+            return one
+        if name == "select_n":
+            out = ins[1] if len(ins) > 1 else None
+            for case in ins[2:]:
+                out = _union(out, case)
+            return [out]
+        if name == "reduce_sum":
+            if ins[0] is None:
+                return one
+            axes = p.get("axes") or ()
+            shape = eqn.invars[0].aval.shape
+            n = 1
+            for ax in axes:
+                n *= int(shape[ax])
+            lo, hi = ins[0]
+            return [_mk(min(n * lo, 0 if n == 0 else n * lo),
+                        max(n * hi, 0 if n == 0 else n * hi))
+                    if n else (0, 0)]
+        if name in ("cumsum", "cumlogsumexp", "cummax", "cummin",
+                    "cumprod"):
+            if name in ("cummax", "cummin"):
+                return [ins[0]]
+            if name != "cumsum" or ins[0] is None:
+                return one
+            axis = p.get("axis", 0)
+            n = int(eqn.invars[0].aval.shape[axis])
+            lo, hi = ins[0]
+            return [_mk(min(lo, n * lo), max(hi, n * hi))]
+        if name in ("reduce_and", "reduce_or"):
+            return [(0, 1)]
+        if name == "concatenate":
+            out = ins[0]
+            for nxt in ins[1:]:
+                out = _union(out, nxt)
+            return [out]
+        if name == "pad":
+            return [_union(ins[0], ins[1] if len(ins) > 1 else None)]
+        if name in ("dynamic_update_slice", "scatter", "scatter-add"):
+            return [_union(ins[0], ins[-1] if len(ins) > 1 else None)]
+        if name == "scan":
+            return self._scan(eqn, ins)
+        if name == "while":
+            return self._while(eqn, ins)
+        if name == "cond":
+            return self._cond(eqn, ins)
+        if name == "shard_map":
+            return self._shard_map(eqn, ins)
+        if name == "pmin":
+            return [ins[0]]
+        if name == "pmax":
+            return [ins[0]]
+        # generic call-like wrappers (pjit, remat, custom_*, closed_call):
+        # recurse when exactly one inner jaxpr matches the invars arity
+        subs = [
+            s for s in subjaxprs(eqn) if len(s.invars) == len(eqn.invars)
+        ]
+        if len(subs) >= 1 and name not in ("pallas_call",):
+            outs = self._eval(subs[0], ins)
+            if len(outs) == len(eqn.outvars):
+                return outs
+        # unmodeled: still walk inner jaxprs for structural checks
+        for s in subjaxprs(eqn):
+            self._eval(s, [None] * len(s.invars))
+        return one
+
+    # -- higher-order primitives --------------------------------------
+
+    def _scan(self, eqn, ins: List[Interval]) -> List[Interval]:
+        p = eqn.params
+        body = p["jaxpr"].jaxpr
+        n_const = p.get("num_consts", 0)
+        n_carry = p.get("num_carry", 0)
+        consts = ins[:n_const]
+        carries = list(ins[n_const:n_const + n_carry])
+        xs = ins[n_const + n_carry:]  # leading axis sliced: same interval
+        ys: List[Interval] = []
+        for _ in range(_SCAN_FIXPOINT_ITERS):
+            outs = self._eval(body, consts + carries + xs)
+            new_carries = outs[:n_carry]
+            ys = outs[n_carry:]
+            widened = [
+                _union(c, nc) for c, nc in zip(carries, new_carries)
+            ]
+            if widened == carries:
+                break
+            carries = widened
+        else:
+            # not converged: carries unknown, re-eval once for ys/checks
+            carries = [None] * n_carry
+            outs = self._eval(body, consts + carries + xs)
+            ys = outs[n_carry:]
+        return carries + ys
+
+    def _while(self, eqn, ins: List[Interval]) -> List[Interval]:
+        p = eqn.params
+        body = p["body_jaxpr"].jaxpr
+        n_body_const = p.get("body_nconsts", 0)
+        n_cond_const = p.get("cond_nconsts", 0)
+        consts = ins[n_cond_const:n_cond_const + n_body_const]
+        n_carry = len(eqn.invars) - n_cond_const - n_body_const
+        carries: List[Interval] = [None] * n_carry
+        self._eval(body, consts + carries)  # structural checks only
+        return [None] * len(eqn.outvars)
+
+    def _cond(self, eqn, ins: List[Interval]) -> List[Interval]:
+        branches = eqn.params.get("branches") or ()
+        operands = ins[1:]
+        out: Optional[List[Interval]] = None
+        for br in branches:
+            body = br.jaxpr if hasattr(br, "jaxpr") else br
+            outs = self._eval(body, list(operands))
+            if out is None:
+                out = outs
+            else:
+                out = [_union(a, b) for a, b in zip(out, outs)]
+        return out if out is not None else [None] * len(eqn.outvars)
+
+    def _shard_map(self, eqn, ins: List[Interval]) -> List[Interval]:
+        body = eqn.params.get("jaxpr")
+        if body is None:
+            return [None] * len(eqn.outvars)
+        if hasattr(body, "jaxpr"):
+            body = body.jaxpr
+        mesh = eqn.params.get("mesh")
+        saved = dict(self._mesh_sizes)
+        try:
+            shape = getattr(mesh, "shape", None)
+            if shape:
+                self._mesh_sizes.update(
+                    {k: int(v) for k, v in dict(shape).items()}
+                )
+        except Exception:  # noqa: BLE001 — mesh introspection best-effort
+            pass
+        try:
+            # sharding slices values, never transforms them: intervals
+            # pass through both directions
+            outs = self._eval(body, list(ins))
+        finally:
+            self._mesh_sizes = saved
+        if len(outs) == len(eqn.outvars):
+            return outs
+        return [None] * len(eqn.outvars)
+
+    # -- driver --------------------------------------------------------
+
+    def _eval(
+        self, jaxpr, in_intervals: List[Interval], const_ivs=None
+    ) -> List[Interval]:
+        env: Dict[int, Interval] = {}
+        for v, iv in zip(jaxpr.invars, in_intervals):
+            env[id(v)] = iv
+        for i, v in enumerate(jaxpr.constvars):
+            # top level: traced-in consts carry real intervals; nested
+            # jaxprs' constvars are caller-bound and unknown here
+            env[id(v)] = const_ivs[i] if const_ivs else None
+        for eqn in jaxpr.eqns:
+            self._structural(eqn)
+            ins = [self._read(env, v) for v in eqn.invars]
+            try:
+                outs = self._apply(eqn, ins)
+            except Exception:  # noqa: BLE001 — a transfer bug must cost
+                # recall (unknown), never crash the audit
+                outs = [None] * len(eqn.outvars)
+            if len(outs) != len(eqn.outvars):
+                outs = [None] * len(eqn.outvars)
+            for v, iv in zip(eqn.outvars, outs):
+                env[id(v)] = iv
+                self._check_fits(eqn, v.aval, iv)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+
+def run(traced) -> List[Finding]:
+    t = traced
+    if t.closed_jaxpr is None:
+        return []
+
+    # one finding per (check, primitive, source site): the scan-carry
+    # fixpoint revisits body eqns with progressively wider intervals —
+    # re-fires OVERWRITE the message, so the final (widest) bound is
+    # what gets reported, once
+    sites: dict = {}
+
+    def report(check: str, eqn, message: str) -> None:
+        src = eqn_src(eqn)
+        site = src if src is not None else id(eqn)
+        sites[(check, eqn.primitive.name, site)] = message
+
+    analyzer = _Analyzer(report)
+    closed = t.closed_jaxpr
+    analyzer._eval(
+        closed.jaxpr,
+        [None] * len(closed.jaxpr.invars),  # program inputs: unknown
+        const_ivs=[_const_interval(c) for c in closed.consts],
+    )
+
+    findings: List[Finding] = []
+    ordinals: dict = {}
+    for (check, prim, site), message in sites.items():
+        # anchor on the traced source line when jax exposes it (stable
+        # across unrelated edits); fall back to an insertion ordinal
+        # per (check, primitive) — never a global counter, which would
+        # renumber every later anchor when an earlier finding appears
+        if isinstance(site, tuple):
+            suffix = f"L{site[1]}"
+        else:
+            ordinals[(check, prim)] = ordinals.get((check, prim), 0) + 1
+            suffix = str(ordinals[(check, prim)])
+        findings.append(Finding(
+            t.path, t.line, "index-width",
+            f"hot program '{t.name}' at max shapes "
+            f"(C={t.shapes.C}, K={t.shapes.K}, S={t.shapes.S}): {message}",
+            severity=ERROR,
+            anchor=f"{t.name}.{check}.{prim}.{suffix}",
+            tier="jaxpr",
+        ))
+    return findings

@@ -14,5 +14,6 @@ ALL_PASSES = (
     ("metrics-contract", contracts.run_metrics),
     ("config-contract", contracts.run_config),
     ("kube-write-retry", contracts.run_kube_writes),
+    ("manifest-contract", contracts.run_manifest),
     ("lock-discipline", locks.run),
 )

@@ -25,7 +25,7 @@ and pairs of modules that must stay in lockstep:
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from tools.analysis.common import ERROR, WARN, Finding, relpath
 from tools.analysis.symbols import Project, dotted
@@ -341,4 +341,133 @@ def run_kube_writes(project: Project, files) -> List[Finding]:
                                 "their cadence)",
                                 severity=ERROR, anchor=f"{fname}.retries",
                             ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# manifest-contract
+
+# The jit-root <-> HOT_PROGRAMS lockstep (docs/ANALYSIS.md "Jaxpr
+# tier"): every jit/pjit/shard_map root the call graph can see inside
+# the hot-path scope must be exercised by some jaxpr-tier manifest
+# entry (its qualname matched by an entry's ``covers``) or listed in an
+# ``EXEMPT_JIT_ROOTS`` dict with a justification — and, symmetrically,
+# every ``covers`` string must still name a live root. Deleting a
+# manifest entry or adding an unregistered jit root turns the gate red:
+# the jaxpr tier's coverage can never silently shrink.
+#
+# The pass is inert on trees with no manifest infrastructure at all (no
+# ``HOT_PROGRAMS`` / ``EXEMPT_JIT_ROOTS`` assignment anywhere in the
+# analyzed files): fixture trees exercising other passes must not be
+# forced to carry manifests, and the real package always walks
+# hot_programs.py, so the gate is always live where it matters.
+
+
+def _covers_matches(cover: str, root_qual: str) -> bool:
+    """``cover`` matches ``root_qual`` as a dot/colon-bounded suffix."""
+    if not cover:
+        return False
+    if root_qual == cover:
+        return True
+    if root_qual.endswith(cover):
+        boundary = root_qual[-len(cover) - 1]
+        return boundary in ".:"
+    return False
+
+
+def _manifest_surface(project: Project):
+    """((entry_name, path, line, covers)..., exempts {pattern: (path,
+    line)}, any_infra) parsed from HOT_PROGRAMS / EXEMPT_JIT_ROOTS
+    dict literals (plain or annotated assignments — the shared parser
+    in common.manifest_dict_literals, also used by the jaxpr tracer's
+    line anchoring) in the analyzed files."""
+    from tools.analysis.common import manifest_dict_literals
+
+    entries = []
+    exempts: Dict[str, Tuple[str, int]] = {}
+    any_infra = False
+    for mod in project.modules.values():
+        path = relpath(mod.path)
+        programs, has_programs = manifest_dict_literals(
+            mod.tree, "HOT_PROGRAMS"
+        )
+        exempt_keys, has_exempts = manifest_dict_literals(
+            mod.tree, "EXEMPT_JIT_ROOTS"
+        )
+        any_infra = any_infra or has_programs or has_exempts
+        for name, lineno, val in programs:
+            covers: List[str] = []
+            if isinstance(val, ast.Call):
+                for kw in val.keywords:
+                    if kw.arg != "covers":
+                        continue
+                    if isinstance(kw.value, (ast.Tuple, ast.List)):
+                        covers = [
+                            e.value
+                            for e in kw.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        ]
+            entries.append((name, path, lineno, covers))
+        for pattern, lineno, _ in exempt_keys:
+            exempts[pattern] = (path, lineno)
+    return entries, exempts, any_infra
+
+
+def run_manifest(project: Project, files) -> List[Finding]:
+    from tools.analysis.passes.hotpath import in_scope, jit_roots
+
+    entries, exempts, any_infra = _manifest_surface(project)
+    if not any_infra:
+        return []
+    findings: List[Finding] = []
+
+    roots = []
+    seen_roots = set()
+    for info in jit_roots(project):
+        if not in_scope(info.path):
+            continue
+        if info.qual in seen_roots:
+            continue
+        seen_roots.add(info.qual)
+        roots.append(info)
+
+    all_covers = [c for _, _, _, covers in entries for c in covers]
+
+    for info in roots:
+        covered = any(_covers_matches(c, info.qual) for c in all_covers)
+        exempted = any(
+            _covers_matches(pat, info.qual) for pat in exempts
+        )
+        if not covered and not exempted:
+            findings.append(Finding(
+                relpath(info.path), info.line, "manifest-contract",
+                f"jit root '{info.qual}' is not covered by any "
+                "HOT_PROGRAMS entry (jaxpr-tier audit) and not listed "
+                "in EXEMPT_JIT_ROOTS — register a traced probe for it "
+                "or exempt it with a justification",
+                severity=ERROR, anchor=info.qual.split(":", 1)[-1],
+            ))
+
+    root_quals = [info.qual for info in roots]
+    for name, path, line, covers in entries:
+        for c in covers:
+            if not any(_covers_matches(c, q) for q in root_quals):
+                findings.append(Finding(
+                    path, line, "manifest-contract",
+                    f"HOT_PROGRAMS entry '{name}' covers "
+                    f"'{c}' but no such jit root exists — the root was "
+                    "removed or renamed; fix or delete the manifest "
+                    "entry (coverage bookkeeping must not rot)",
+                    severity=ERROR, anchor=f"{name}.{c}",
+                ))
+
+    for pat, (path, line) in sorted(exempts.items()):
+        if not any(_covers_matches(pat, q) for q in root_quals):
+            findings.append(Finding(
+                path, line, "manifest-contract",
+                f"EXEMPT_JIT_ROOTS pattern '{pat}' matches no jit "
+                "root — stale exemption; delete it",
+                severity=WARN, anchor=f"exempt.{pat}",
+            ))
     return findings

@@ -102,11 +102,14 @@ def _first_function_ref(project: Project, mod, arg, scope):
 # reachability
 
 
-def jit_reachable(project: Project) -> Set[FunctionInfo]:
-    """Functions reachable from any jit/pjit/shard_map root."""
+def jit_roots(project: Project) -> List[FunctionInfo]:
+    """Every function the call graph can see as a jit/pjit/shard_map
+    root: decorated defs plus resolvable ``jax.jit(f, ...)`` /
+    ``shard_map(f, ...)`` first-argument references. Shared by the
+    reachability walk below and the manifest-contract pass
+    (tools/analysis/passes/contracts.py) — ONE definition of "root" so
+    the jaxpr tier's coverage contract matches what these vets vet."""
     roots: List[FunctionInfo] = []
-    edges: Dict[FunctionInfo, Set[FunctionInfo]] = {}
-
     for mod in project.modules.values():
         parents = parent_map(mod.tree)
         # decorated roots
@@ -123,6 +126,15 @@ def jit_reachable(project: Project) -> Set[FunctionInfo]:
                     target = _first_function_ref(project, mod, arg, scope)
                     if target is not None:
                         roots.append(target)
+    return roots
+
+
+def jit_reachable(project: Project) -> Set[FunctionInfo]:
+    """Functions reachable from any jit/pjit/shard_map root."""
+    roots = jit_roots(project)
+    edges: Dict[FunctionInfo, Set[FunctionInfo]] = {}
+
+    for mod in project.modules.values():
         # call edges + function-reference-argument edges + nesting edges
         for info in mod.functions.values():
             out = edges.setdefault(info, set())

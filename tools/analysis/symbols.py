@@ -88,7 +88,10 @@ def _module_id(path: Path, root: Path) -> str:
     try:
         rel = path.resolve().relative_to(root.resolve())
     except ValueError:
-        rel = Path(path.name)
+        # out-of-tree file (fixture trees under tmp): keep the FULL
+        # path-derived id, so suffix-matched quals (manifest-contract
+        # covers, baseline anchors) behave the same as in-tree
+        rel = Path(*(p for p in path.resolve().parts if p != "/"))
     return ".".join(rel.with_suffix("").parts)
 
 
