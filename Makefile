@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke chaos-smoke watch-soak quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke serve-smoke chaos-smoke watch-soak quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -19,7 +19,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
 # stand-in and tools/analysis is the go-vet analog, two tiers deep
 # (this image ships no Python linter and installs are forbidden).
-check: lint analyze audit-jaxpr test bench-smoke repair-smoke chaos-smoke watch-soak
+check: lint analyze audit-jaxpr test bench-smoke serve-smoke repair-smoke chaos-smoke watch-soak
 
 lint:
 	python tools/lint.py
@@ -62,6 +62,15 @@ bench:
 # fewer bytes than the first full-pack tick.
 bench-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --smoke --watchdog 600
+
+# Multi-tenant planner-service smoke (CPU-only): >=4 synthetic tenant
+# agents plan concurrently through one in-process service over real
+# HTTP; fails unless every tenant's selection is bit-identical to its
+# solo in-process SolverPlanner plan, at least one batched solve
+# carried lanes from >=2 tenants (service_batch_lanes), and no agent
+# fell back to the local oracle.
+serve-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --serve-smoke --watchdog 600
 
 # 8-virtual-device spot-chunked repair smoke: a drain only repair can
 # prove, at a budget that previously forced the repair-less 2-D tier —

@@ -817,6 +817,168 @@ def run_smoke(args, metric: str, unit: str) -> int:
     return 0 if ok else 1
 
 
+def serve_smoke(n_tenants: int = 4, seed: int = 0) -> dict:
+    """The multi-tenant planner-service acceptance core (``make
+    serve-smoke``; reused by tests/test_service.py):
+
+    - N synthetic tenant clusters plan SOLO through one in-process
+      SolverPlanner (the single-tenant truth);
+    - the same N tenants then plan CONCURRENTLY through a real
+      ServiceServer over HTTP via RemotePlanner agents (observe/pack
+      local, wire-protocol solve remote), with a batch window wide
+      enough that they coalesce;
+    - FAILS unless every tenant's selection (drained node + proven
+      assignments) is bit-identical to its solo plan, at least one
+      batch carried lanes from >=2 tenants (service_batch_lanes /
+      service_batch_tenants), and no agent fell back.
+    """
+    import dataclasses
+    import threading
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+    from k8s_spot_rescheduler_tpu.service.server import ServiceServer
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    spec = dataclasses.replace(
+        CONFIGS[2], name="serve-smoke", n_on_demand=8, n_spot=8, n_pods=80
+    )
+    cfg = ReschedulerConfig(resources=spec.resources, solver="jax")
+    tenants = []
+    for i in range(n_tenants):
+        client = generate_cluster(spec, seed + i)
+        store = client.columnar_store(
+            cfg.resources,
+            on_demand_label=cfg.on_demand_node_label,
+            spot_label=cfg.spot_node_label,
+        )
+        tenants.append((store, client.list_pdbs()))
+
+    def selection(report):
+        if report.plan is None:
+            return (False, None, None)
+        return (
+            True,
+            report.plan.node.node.name,
+            dict(report.plan.assignments),
+        )
+
+    # solo truth: ONE planner instance (jit caches and pads persist, as
+    # in production) planning each tenant in turn
+    solo = SolverPlanner(cfg)
+    solo_sel = [selection(solo.plan(store, pdbs)) for store, pdbs in tenants]
+    solo_lanes = [
+        int(np.asarray(store.pack(pdbs)[0].cand_valid.sum()))
+        for store, pdbs in tenants
+    ]
+
+    before = metrics.service_snapshot()
+    server = ServiceServer(
+        cfg, "127.0.0.1:0", batch_window_s=0.5,
+        # every tenant must be admitted (503-shedding would read as a
+        # spurious fallback failure) — the smoke tests batching, not
+        # the depth cap
+        max_inflight=max(16, 2 * n_tenants),
+    )
+    server.start_background()
+    agents = [
+        RemotePlanner(cfg, f"http://{server.address}", tenant=f"tenant-{i}")
+        for i in range(n_tenants)
+    ]
+    results: list = [None] * n_tenants
+    times = [0.0] * n_tenants
+    barrier = threading.Barrier(n_tenants)
+
+    def run_agent(i):
+        store, pdbs = tenants[i]
+        barrier.wait()
+        t0 = time.perf_counter()
+        results[i] = agents[i].plan(store, pdbs)
+        times[i] = (time.perf_counter() - t0) * 1e3
+
+    threads = [
+        threading.Thread(target=run_agent, args=(i,))
+        for i in range(n_tenants)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.close()
+
+    after = metrics.service_snapshot()
+    mismatches = []
+    for i, report in enumerate(results):
+        got = selection(report)
+        if got != solo_sel[i] or report.solver != "remote":
+            mismatches.append(
+                {"tenant": i, "solo": solo_sel[i], "served": got,
+                 "solver": report.solver}
+            )
+    fallbacks = (
+        after["remote_planner_fallback"] - before["remote_planner_fallback"]
+    )
+    cobatched = after["batch_tenants_max"] >= 2
+    # lanes prove it too: one batch carried more lanes than any single
+    # tenant holds
+    lanes_prove = after["batch_lanes_max"] > max(solo_lanes)
+    ok = not mismatches and fallbacks == 0 and cobatched and lanes_prove
+    return {
+        "ok": ok,
+        "n_tenants": n_tenants,
+        "serve_ms": round(float(np.median(times)), 2),
+        "batch_tenants_max": int(after["batch_tenants_max"]),
+        "batch_lanes_max": int(after["batch_lanes_max"]),
+        "batch_occupancy": round(
+            after["batch_tenants_max"] / max(n_tenants, 1), 3
+        ),
+        "solo_lanes_max": max(solo_lanes),
+        "remote_fallbacks": int(fallbacks),
+        "mismatches": mismatches,
+    }
+
+
+def run_serve_smoke(args, metric: str, unit: str) -> int:
+    """CI smoke of the multi-tenant planner service (``make
+    serve-smoke``): >=4 concurrent synthetic tenants batched through one
+    in-process service over real HTTP must produce selections
+    bit-identical to each tenant's solo in-process plan, with at least
+    one batch sharing lanes across >=2 tenants."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    result = serve_smoke(n_tenants=max(4, args.tenants), seed=args.seed)
+    print(
+        f"serve-smoke: {result['n_tenants']} tenants  "
+        f"serve_ms={result['serve_ms']}  "
+        f"batch_tenants_max={result['batch_tenants_max']}  "
+        f"batch_lanes_max={result['batch_lanes_max']} "
+        f"(solo max {result['solo_lanes_max']})  "
+        f"fallbacks={result['remote_fallbacks']}  "
+        f"-> {'OK' if result['ok'] else 'FAIL: %s' % result['mismatches']}",
+        file=sys.stderr,
+    )
+    emit(
+        {
+            "metric": metric,
+            "value": result["serve_ms"],
+            "unit": unit,
+            "n_tenants": result["n_tenants"],
+            "serve_ms": result["serve_ms"],
+            "batch_occupancy": result["batch_occupancy"],
+            "batch_tenants_max": result["batch_tenants_max"],
+            "batch_lanes_max": result["batch_lanes_max"],
+            "remote_fallbacks": result["remote_fallbacks"],
+            "ok": result["ok"],
+        }
+    )
+    return 0 if result["ok"] else 1
+
+
 def run_chaos(args, metric: str, unit: str) -> int:
     """Chaos soak (``make chaos-smoke``): N control-loop ticks over a
     fixture-scale fake cluster behind the seeded fault-injection client
@@ -1304,6 +1466,8 @@ def _metric_for(args) -> tuple:
         return "watch_soak_completed_ticks", "count"
     if args.smoke:
         return "bench_smoke_delta_upload_bytes", "bytes"
+    if args.serve_smoke:
+        return "serve_smoke_agent_plan_ms", "ms"
     if args.quality:
         return "nodes_freed_vs_ilp_oracle_ratio", "ratio"
     if args.quality_boundary:
@@ -1410,6 +1574,16 @@ def main() -> int:
     ap.add_argument("--watch-soak-ticks", type=int, default=300,
                     help="ticks of the --watch-soak run (>=300 for the "
                          "acceptance run)")
+    ap.add_argument("--serve-smoke", action="store_true",
+                    help="CI smoke (make serve-smoke): N synthetic "
+                         "tenant agents against an in-process planner "
+                         "service over HTTP; fails unless every "
+                         "tenant's selection is bit-identical to its "
+                         "solo in-process plan and >=2 tenants shared "
+                         "one batched solve")
+    ap.add_argument("--tenants", type=int, default=4,
+                    help="tenant count for --serve-smoke (>=4 for the "
+                         "acceptance run)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke (make bench-smoke): tiny CPU-only "
                          "cluster, 5 ticks through the production "
@@ -1440,6 +1614,8 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         return run_watch_soak(args, metric, unit)
     if args.smoke:
         return run_smoke(args, metric, unit)
+    if args.serve_smoke:
+        return run_serve_smoke(args, metric, unit)
     if args.quality:
         return run_quality(
             args.seed, sweep=args.sweep, solver=args.solver or "numpy"

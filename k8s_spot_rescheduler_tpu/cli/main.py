@@ -167,6 +167,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "diffed field-by-field against the watch mirror; "
                         "drift is counted, evented, and healed by a "
                         "store replace (Go duration; 0 disables)")
+    p.add_argument("--planner-url", default=d.planner_url,
+                   help="plan through a remote multi-tenant planner "
+                        "service at this base URL instead of the "
+                        "in-process solver: observe/pack/actuate stay "
+                        "local, packed tensors ship over the binary "
+                        "wire protocol (service/wire.py); on failure "
+                        "the tick falls back to the local numpy oracle "
+                        "(empty = plan in-process)")
+    p.add_argument("--planner-timeout", default=f"{d.planner_timeout:g}s",
+                   help="per-plan HTTP deadline of the agent's planner-"
+                        "service call; past it the tick plans locally "
+                        "(Go duration)")
+    p.add_argument("--service-batch-window",
+                   default=f"{d.service_batch_window:g}s",
+                   help="--serve mode: how long the batching scheduler "
+                        "waits to coalesce concurrent tenants into one "
+                        "batched solve (Go duration; 0 = dispatch "
+                        "immediately)")
+    p.add_argument("--service-queue-timeout",
+                   default=f"{d.service_queue_timeout:g}s",
+                   help="--serve mode: bounded queue wait — a plan "
+                        "request unbatched past this is evicted with "
+                        "503 + Retry-After from the measured batch "
+                        "cadence (Go duration)")
+    p.add_argument("--serve", default="",
+                   help="run as the multi-tenant planner SERVICE on "
+                        "this address (e.g. 0.0.0.0:8642) instead of a "
+                        "control loop: /v2/plan (binary wire), /v1/plan "
+                        "(JSON adapter), /healthz; one TPU plans for a "
+                        "fleet of --planner-url agents")
     p.add_argument("--jax-cache-dir", default=d.jax_cache_dir,
                    help="persistent XLA compilation cache directory; the "
                         "~seconds cold compile of the solver programs is "
@@ -260,6 +290,10 @@ def config_from_args(args) -> ReschedulerConfig:
         staged_chunk_lanes=args.staged_chunk_lanes,
         staged_early_exit=args.staged_early_exit,
         jax_cache_dir=args.jax_cache_dir,
+        planner_url=args.planner_url,
+        planner_timeout=parse_duration(args.planner_timeout),
+        service_batch_window=parse_duration(args.service_batch_window),
+        service_queue_timeout=parse_duration(args.service_queue_timeout),
         kube_retry_max=args.kube_retry_max,
         kube_retry_base=args.kube_retry_base,
         breaker_threshold=args.breaker_threshold,
@@ -292,6 +326,19 @@ def main(argv=None) -> int:
     except (LabelFormatError, ValueError) as err:
         print(f"Error: {err}", file=sys.stderr)
         return 1
+
+    if args.serve:
+        # service mode: no control loop, no cluster client — one shared
+        # TPU planner serving a fleet of --planner-url agents
+        from k8s_spot_rescheduler_tpu.service.server import ServiceServer
+
+        if not args.no_metrics_server:
+            from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+            metrics.serve(config.listen_address)
+        log.info("Running planner service")
+        ServiceServer(config, args.serve).serve_forever()
+        return 0
 
     log.info("Running Rescheduler")
     if args.trace_dir:
@@ -403,7 +450,14 @@ def main(argv=None) -> int:
         return 1
 
     try:
-        planner = SolverPlanner(config)
+        if config.planner_url:
+            # agent mode: the solve crosses the wire to a shared
+            # planner service; everything else stays local
+            from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+
+            planner = RemotePlanner(config)
+        else:
+            planner = SolverPlanner(config)
     except ValueError as err:
         print(f"Error: {err}", file=sys.stderr)
         return 1

@@ -113,6 +113,7 @@ MANIFEST_MODULES = (
     "k8s_spot_rescheduler_tpu.solver.fallback",
     "k8s_spot_rescheduler_tpu.ops.pallas_ffd",
     "k8s_spot_rescheduler_tpu.parallel.sharded_ffd",
+    "k8s_spot_rescheduler_tpu.parallel.tenant_batch",
     "k8s_spot_rescheduler_tpu.planner.solver_planner",
 )
 

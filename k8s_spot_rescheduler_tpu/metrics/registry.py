@@ -346,6 +346,72 @@ observe_delta_events = Gauge(
 )
 
 
+# Multi-tenant planner-service observability (service/server.py +
+# service/agent.py): one TPU planning for a fleet means the batching
+# queue, the shared solves and the agents' degradation paths each need
+# their own series — a starved tenant or a silently-falling-back agent
+# must be visible on a dashboard, not inferred from latency.
+
+service_requests = Counter(
+    "service_requests",
+    "Plan requests the planner service accepted or refused, by outcome: "
+    "ok (planned in a batch), rejected (depth/body caps before the body "
+    "was read), expired (waited past the queue timeout and was evicted "
+    "with 503 + Retry-After), error (decode or solve failure).",
+    ["outcome"],
+    namespace=NAMESPACE,
+)
+
+service_batch_lanes = Gauge(
+    "service_batch_lanes",
+    "Candidate lanes in the last batched solve, summed across the "
+    "tenant lane-blocks that shared it (the co-batching proof: a value "
+    "above any single tenant's lane count means unrelated clusters "
+    "amortized one compile and one device dispatch).",
+    namespace=NAMESPACE,
+)
+
+service_batch_tenants = Gauge(
+    "service_batch_tenants",
+    "Tenant lane-blocks sharing the last batched solve (1 = the batch "
+    "window closed with a lone tenant; the fleet-scale win is this "
+    "sitting near the HBM-derived batch cap).",
+    namespace=NAMESPACE,
+)
+
+service_queue_wait_ms = Histogram(
+    "service_queue_wait_ms",
+    "Milliseconds a plan request spent in the tenant queue before its "
+    "batch dispatched (the fairness SLO: bounded by one batch interval "
+    "per deficit-round-robin design, regardless of other tenants' "
+    "flooding).",
+    namespace=NAMESPACE,
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+             5000.0, 30000.0),
+)
+
+service_tenant_evictions = Counter(
+    "service_tenant_evictions",
+    "Plan requests evicted from the service queue after waiting past "
+    "the queue timeout (answered 503 + Retry-After derived from the "
+    "measured batch cadence), per tenant — a single tenant's label "
+    "climbing means ITS submission rate, not the service, is the "
+    "problem (DRR protects the others).",
+    ["tenant"],
+    namespace=NAMESPACE,
+)
+
+remote_planner_fallback = Counter(
+    "remote_planner_fallback",
+    "Agent ticks planned by the LOCAL numpy-oracle fallback because the "
+    "remote planner service was unreachable, overloaded, or answered "
+    "out of protocol (service/agent.py RemotePlanner; the agent's "
+    "breaker skips the service for a backoff window after repeated "
+    "failures and re-engages on the next healthy reply).",
+    namespace=NAMESPACE,
+)
+
+
 def update_nodes_map(on_demand_label: str, spot_label: str, n_on_demand: int, n_spot: int) -> None:
     """reference metrics/metrics.go:73-80 (labels carry the configured
     node-class label strings, as in the reference)."""
@@ -474,6 +540,60 @@ def update_mirror_stale_planned() -> None:
 
 def update_observe_delta_events(n: int) -> None:
     observe_delta_events.set(n)
+
+
+# run-scoped maxima for the service gauges (gauges only hold the last
+# batch; the serve-smoke acceptance needs the run's high-water marks)
+_service_batch_max = {"lanes": 0, "tenants": 0}
+
+
+def update_service_request(outcome: str) -> None:
+    service_requests.labels(outcome).inc()
+
+
+def update_service_batch(lanes: int, tenants: int, waits_ms) -> None:
+    """One batched solve dispatched: refresh the occupancy gauges and
+    observe every member request's queue wait."""
+    service_batch_lanes.set(int(lanes))
+    service_batch_tenants.set(int(tenants))
+    _service_batch_max["lanes"] = max(_service_batch_max["lanes"], int(lanes))
+    _service_batch_max["tenants"] = max(
+        _service_batch_max["tenants"], int(tenants)
+    )
+    for w in waits_ms:
+        service_queue_wait_ms.observe(float(w))
+
+
+def update_service_tenant_eviction(tenant: str) -> None:
+    service_tenant_evictions.labels(tenant).inc()
+
+
+def update_remote_planner_fallback() -> None:
+    remote_planner_fallback.inc()
+
+
+def service_snapshot() -> dict:
+    """Service/agent counters via the public collect() API (tests and
+    the serve-smoke harness diff before/after), plus the run's batch
+    occupancy high-water marks."""
+    by_outcome = {}
+    for sample in service_requests.collect()[0].samples:
+        if sample.name.endswith("_total"):
+            by_outcome[sample.labels.get("outcome", "")] = sample.value
+    lanes = tenants = 0.0
+    for sample in service_batch_lanes.collect()[0].samples:
+        lanes = sample.value
+    for sample in service_batch_tenants.collect()[0].samples:
+        tenants = sample.value
+    return {
+        "requests": by_outcome,
+        "batch_lanes": lanes,
+        "batch_tenants": tenants,
+        "batch_lanes_max": _service_batch_max["lanes"],
+        "batch_tenants_max": _service_batch_max["tenants"],
+        "tenant_evictions": _labeled_counter_total(service_tenant_evictions),
+        "remote_planner_fallback": _counter_value(remote_planner_fallback),
+    }
 
 
 def _counter_value(counter) -> float:

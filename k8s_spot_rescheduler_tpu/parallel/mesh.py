@@ -26,6 +26,7 @@ from jax.sharding import Mesh
 
 CAND_AXIS = "cand"
 SPOT_AXIS = "spot"
+TENANT_AXIS = "tenant"
 
 
 def pick_mesh_shape(n_devices: int) -> Tuple[int, int]:
@@ -54,6 +55,20 @@ def make_cand_mesh(devices=None) -> Mesh:
         (len(devices),), devices=np.asarray(devices)
     )
     return Mesh(grid, (CAND_AXIS,))
+
+
+def make_tenant_mesh(devices=None) -> Mesh:
+    """A 1-D all-device mesh over the TENANT axis — the multi-tenant
+    planner service's batching layout (parallel/tenant_batch.py): every
+    device holds a block of whole tenant problems, each solved by the
+    complete single-chip union program. Tenants are clusters; clusters
+    never interact — zero collectives, like the cand-only layout one
+    level up the nesting."""
+    devices = devices if devices is not None else jax.devices()
+    grid = mesh_utils.create_device_mesh(
+        (len(devices),), devices=np.asarray(devices)
+    )
+    return Mesh(grid, (TENANT_AXIS,))
 
 
 def make_mesh(shape: Tuple[int, int] | None = None, devices=None) -> Mesh:

@@ -1,0 +1,157 @@
+"""Multi-tenant batched selection: a fleet of clusters in one solve.
+
+The cand-sharded tier (parallel/sharded_ffd.plan_union_cand_sharded)
+proves candidate lanes solve with zero cross-lane collectives — lanes
+are Fork/Revert forks and never interact. Tenants (whole clusters) are
+one level coarser: not only do their lanes not interact, they do not
+even share a spot pool. So a fleet's concurrent plan requests, padded to
+one shape bucket (service/buckets.py), stack along a new leading tenant
+axis and solve as ONE device program:
+
+- each tenant's problem runs the COMPLETE single-chip union program
+  (first-fit ∪ best-fit ∪ repair — the same ``solve`` composition
+  SolverPlanner builds, so a batched tenant's selection is bit-identical
+  to its solo in-process plan, pinned by ``make serve-smoke``);
+- selection happens on device per tenant (solver/select.selection_vector)
+  and the host fetches one [T, 3+K] int32 matrix — a few hundred bytes
+  per tenant, the same boundary discipline as the in-process planner;
+- on a multi-device mesh the tenant axis shards over the devices
+  (parallel/mesh.make_tenant_mesh) with everything else local: zero
+  collectives, embarrassing parallelism at cluster granularity. On one
+  device (or a tenant count the mesh does not divide) the batch runs as
+  a plain ``vmap`` — same program, same results.
+
+This is ROADMAP item 2's kernel: the device-only solve is ~1 ms/tick
+and a tick is seconds long, so one TPU that solves T tenants per batch
+serves T clusters at the cost the reference pays for one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.parallel.mesh import TENANT_AXIS
+# the jax>=0.6 / experimental shard_map version shim lives beside the
+# other mesh programs — one shim, every sharded path
+from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import shard_map
+from k8s_spot_rescheduler_tpu.solver.select import selection_vector
+
+
+def plan_tenants_batched(
+    mesh: Mesh | None,
+    stacked: PackedCluster,
+    *,
+    rounds: int = 0,
+    best_fit_fallback: bool = True,
+):
+    """Solve T stacked tenant problems; returns int32 [T, 3 + K].
+
+    ``stacked`` is a PackedCluster whose every field carries a leading
+    tenant axis (service/buckets.stack_bucket). Row t decodes with
+    ``solver/select.decode_selection`` exactly as a solo solve would.
+    """
+    from k8s_spot_rescheduler_tpu.solver.fallback import (
+        with_best_fit_fallback,
+        with_repair,
+    )
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    if best_fit_fallback and rounds > 0:
+        solve = with_repair(plan_ffd, rounds)
+    elif best_fit_fallback:
+        solve = with_best_fit_fallback(plan_ffd)
+    else:
+        solve = plan_ffd
+
+    def tenant_select(p):
+        return selection_vector(solve, p)
+
+    T = stacked.slot_req.shape[0]
+    n = mesh.devices.size if mesh is not None else 1
+    if n <= 1 or T % n != 0:
+        # single device, or a tenant count the mesh does not divide
+        # evenly. PlannerService._solve pads every mesh batch's tenant
+        # axis to a device multiple with all-invalid problems, so with
+        # a mesh in play this branch never runs in the service — it is
+        # the CPU/1-chip path and the direct-caller fallback.
+        return jax.vmap(tenant_select)(stacked)
+    specs = PackedCluster(*(P(TENANT_AXIS) for _ in PackedCluster._fields))
+
+    def local(block):
+        # one device's tenant block, vmapped — no collectives at all
+        return jax.vmap(tenant_select)(block)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=P(TENANT_AXIS),
+        check_vma=False,
+    )
+    return fn(stacked)
+
+
+def make_tenant_batch_planner(
+    mesh: Mesh | None = None,
+    *,
+    rounds: int = 0,
+    best_fit_fallback: bool = True,
+):
+    """The service's jitted batch program. One returned callable serves
+    every bucket: jit re-specializes per stacked shape, and the bucket
+    discipline (powers of two per axis) bounds the distinct shapes to
+    O(log C · log K · log S) for the fleet's lifetime."""
+    return jax.jit(
+        functools.partial(
+            plan_tenants_batched,
+            mesh,
+            rounds=rounds,
+            best_fit_fallback=best_fit_fallback,
+        )
+    )
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr): the batched tenant program, traced at the
+# declared max shapes with an 8-tenant stack over the tenant mesh (the
+# audit env exposes 8 virtual CPU devices), so the index-width and
+# dtype passes see the exact program the service dispatches.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+TENANT_PROBE_COUNT = 8
+
+
+def _tenant_batch_build(s):
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_tenant_mesh
+
+    base = packed_struct(s)
+    stacked = PackedCluster(
+        *(
+            jax.ShapeDtypeStruct((TENANT_PROBE_COUNT,) + f.shape, f.dtype)
+            for f in base
+        )
+    )
+    return (
+        functools.partial(
+            plan_tenants_batched, make_tenant_mesh(), rounds=8
+        ),
+        (stacked,),
+    )
+
+
+HOT_PROGRAMS = {
+    "service.tenant_batch": HotProgram(
+        build=_tenant_batch_build,
+        covers=(
+            "parallel.tenant_batch:plan_tenants_batched",
+            "parallel.tenant_batch:plan_tenants_batched.local",
+        ),
+    ),
+}

@@ -582,45 +582,18 @@ class SolverPlanner:
                 )
                 n_feasible = sel.n_feasible
             else:
-                result = self._solve_host(packed)
-                if cfg.fallback_best_fit:
-                    from k8s_spot_rescheduler_tpu.solver.result import (
-                        SolveResult,
-                    )
+                # the shared host union (first-fit ∪ best-fit ∪ repair,
+                # cond-gated like the device path) — one implementation
+                # for this branch and the planner service's host path
+                from k8s_spot_rescheduler_tpu.solver.numpy_oracle import (
+                    plan_union_oracle,
+                )
 
-                    bf = self._solve_host(packed, best_fit=True)
-                    result = SolveResult(
-                        feasible=result.feasible | bf.feasible,
-                        assignment=np.where(
-                            result.feasible[:, None],
-                            result.assignment,
-                            bf.assignment,
-                        ),
-                    )
-                    need_repair = bool(
-                        np.any(
-                            np.asarray(packed.cand_valid) & ~result.feasible
-                        )
-                    )
-                    if cfg.repair_rounds > 0 and need_repair:
-                        # mirror of the device path's lax.cond gate
-                        # (solver/fallback.with_repair): repair results are
-                        # only consumed for lanes greedy failed
-                        from k8s_spot_rescheduler_tpu.solver.repair import (
-                            plan_repair_oracle,
-                        )
-
-                        rp = plan_repair_oracle(
-                            packed, rounds=cfg.repair_rounds
-                        )
-                        result = SolveResult(
-                            feasible=result.feasible | rp.feasible,
-                            assignment=np.where(
-                                result.feasible[:, None],
-                                result.assignment,
-                                rp.assignment,
-                            ),
-                        )
+                result = plan_union_oracle(
+                    packed,
+                    best_fit_fallback=cfg.fallback_best_fit,
+                    repair_rounds=cfg.repair_rounds,
+                )
                 feasible = np.asarray(result.feasible)
                 n_feasible = int(feasible.sum())
                 plan = None

@@ -1,0 +1,262 @@
+"""The per-cluster agent: a Planner whose solver lives across the wire.
+
+``RemotePlanner`` implements the same ``Planner`` surface the control
+loop already speaks (plan / plan_async), so the agent topology changes
+NOTHING above the planner boundary: observe, pack and actuate stay
+local and chaos-hardened (PR 4's retrying kube reads, crash containment,
+orphan-taint recovery all apply unchanged). What moves is only the
+solve: the locally-packed ``PackedCluster`` ships to the shared planner
+service (service/server.py) over the binary wire protocol
+(service/wire.py), and the tiny selection vector comes back — the same
+few-hundred-byte boundary the in-process device fetch uses, so a fleet
+of agents costs the service O(tenants x packed bytes) ingress and
+near-zero egress.
+
+Degradation is the agent's job, not the loop's: a service that is
+unreachable, times out, overloads (503) or answers out of protocol
+degrades THIS tick to the local numpy-oracle fallback planner — the
+same containment the loop applies to a crashing in-process planner —
+counted in ``remote_planner_fallback_total``. Repeated failures open a
+circuit breaker that skips the service entirely for a doubling backoff
+window (bounded), so a dead service costs each tick one fallback solve,
+not one connect timeout; the first healthy reply closes the breaker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional, Sequence
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.models.cluster import PDBSpec
+from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+from k8s_spot_rescheduler_tpu.planner.base import PlanReport
+from k8s_spot_rescheduler_tpu.service import wire
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from k8s_spot_rescheduler_tpu.utils import logging as log
+
+
+class RemotePlanner:
+    """Planner over a remote multi-tenant planner service."""
+
+    accepts_columnar = True
+
+    # breaker: consecutive failures before the service is skipped, and
+    # the doubling skip window (seconds) that failure cadence buys
+    FAIL_THRESHOLD = 2
+    BACKOFF_BASE = 5.0
+    BACKOFF_MAX = 120.0
+
+    def __init__(
+        self,
+        config: ReschedulerConfig,
+        url: str = "",
+        *,
+        tenant: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ):
+        self.config = config
+        self.url = (url or config.planner_url).rstrip("/")
+        if not self.url:
+            raise ValueError("RemotePlanner needs a planner service url")
+        import socket
+
+        self.tenant = tenant or socket.gethostname()
+        self.timeout = float(
+            timeout if timeout is not None else config.planner_timeout
+        )
+        self._pad_c = 0
+        self._pad_s = 0
+        self._pad_k = config.max_pods_per_node_hint
+        self._fallback = None  # lazy local numpy-oracle planner
+        self._consecutive_failures = 0
+        self._skip_until = 0.0  # monotonic; breaker-open horizon
+        self.last_solver = "remote"
+
+    # ------------------------------------------------------------------
+
+    def _fallback_planner(self):
+        if self._fallback is None:
+            from k8s_spot_rescheduler_tpu.planner.solver_planner import (
+                SolverPlanner,
+            )
+
+            self._fallback = SolverPlanner(
+                dataclasses.replace(
+                    self.config, solver="numpy", planner_url=""
+                )
+            )
+        return self._fallback
+
+    def _note_failure(self, why: str, retry_after: float = 0.0) -> None:
+        self._consecutive_failures += 1
+        if self._consecutive_failures >= self.FAIL_THRESHOLD:
+            n = self._consecutive_failures - self.FAIL_THRESHOLD
+            backoff = min(
+                self.BACKOFF_BASE * (2.0 ** n), self.BACKOFF_MAX
+            )
+            backoff = max(backoff, retry_after)
+            self._skip_until = time.monotonic() + backoff
+            log.error(
+                "planner service unusable (%s; %d consecutive failures); "
+                "skipping it for %.1fs — local fallback plans until then",
+                why, self._consecutive_failures, backoff,
+            )
+        elif retry_after > 0:
+            # a single 503 already names its horizon: honor it without
+            # waiting for the threshold
+            self._skip_until = time.monotonic() + retry_after
+            log.warning(
+                "planner service overloaded (%s); retrying after %.1fs",
+                why, retry_after,
+            )
+        else:
+            log.warning("planner service call failed: %s", why)
+
+    def _note_success(self) -> None:
+        if self._consecutive_failures:
+            log.info(
+                "planner service healthy again after %d failed call(s)",
+                self._consecutive_failures,
+            )
+        self._consecutive_failures = 0
+        self._skip_until = 0.0
+
+    def _post(self, body: bytes) -> wire.PlanReply:
+        req = urllib.request.Request(
+            f"{self.url}/v2/plan",
+            data=body,
+            headers={
+                "Content-Type": "application/octet-stream",
+                # declare our own deadline so the service evicts (and
+                # frees the slot of) a request we will have abandoned
+                "X-Planner-Deadline": f"{self.timeout:.3f}",
+            },
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return wire.decode_plan_reply(resp.read())
+        except urllib.error.HTTPError as err:
+            retry_after = 0.0
+            if err.code == 503:
+                try:
+                    retry_after = float(err.headers.get("Retry-After", 0))
+                except (TypeError, ValueError):
+                    retry_after = 0.0
+            detail = ""
+            try:
+                wire.decode_plan_reply(err.read())
+            except wire.WireError as werr:
+                detail = str(werr)
+            raise _RemoteError(
+                f"HTTP {err.code}{': ' + detail if detail else ''}",
+                retry_after,
+            ) from err
+
+    # ------------------------------------------------------------------
+    # Planner surface
+
+    def plan(self, observation, pdbs: Sequence[PDBSpec]) -> PlanReport:
+        return self.plan_async(observation, pdbs)()
+
+    def plan_async(self, observation, pdbs: Sequence[PDBSpec]):
+        """Pack locally, dispatch the service call on a worker thread
+        (the loop's metrics pass overlaps the network round trip exactly
+        as it overlaps the in-process device solve), and return the
+        blocking ``finish`` callable."""
+        t0 = time.perf_counter()
+        cfg = self.config
+        if hasattr(observation, "pack"):  # ColumnarStore
+            packed, meta = observation.pack(
+                pdbs,
+                priority_threshold=cfg.priority_threshold,
+                delete_non_replicated=cfg.delete_non_replicated_pods,
+                pad_candidates=self._pad_c,
+                pad_spot=self._pad_s,
+                pad_slots=self._pad_k,
+            )
+        else:
+            packed, meta = pack_cluster(
+                observation,
+                pdbs,
+                resources=cfg.resources,
+                delete_non_replicated=cfg.delete_non_replicated_pods,
+                pad_candidates=self._pad_c,
+                pad_spot=self._pad_s,
+                pad_slots=self._pad_k,
+            )
+        # high-water pads: stable shapes keep the whole fleet in few
+        # service-side buckets (and the service in few compiles)
+        self._pad_c = max(self._pad_c, packed.slot_req.shape[0])
+        self._pad_k = max(self._pad_k, packed.slot_req.shape[1])
+        self._pad_s = max(self._pad_s, packed.spot_free.shape[0])
+        self.last_packed = packed
+
+        for blocked in meta.blocking_pods():
+            log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)
+
+        breaker_open = time.monotonic() < self._skip_until
+        box: dict = {}
+        worker: Optional[threading.Thread] = None
+        if not breaker_open:
+            body = wire.encode_plan_request(self.tenant, packed)
+
+            def call():
+                try:
+                    box["reply"] = self._post(body)
+                except _RemoteError as err:
+                    box["error"] = err
+                except Exception as err:  # noqa: BLE001 — transport/proto
+                    box["error"] = _RemoteError(str(err), 0.0)
+
+            worker = threading.Thread(target=call, daemon=True)
+            worker.start()
+
+        def finish() -> PlanReport:
+            if worker is not None:
+                worker.join()
+            reply = box.get("reply")
+            if reply is None:
+                err = box.get("error")
+                if err is not None:
+                    self._note_failure(str(err), err.retry_after)
+                return self._plan_fallback(observation, pdbs)
+            self._note_success()
+            self.last_solver = "remote"
+            plan = None
+            if reply.found and reply.index < meta.n_candidates:
+                plan = meta.build_plan(
+                    reply.index, np.asarray(reply.row)
+                )
+            return PlanReport(
+                plan=plan,
+                n_candidates=meta.n_candidates,
+                n_feasible=reply.n_feasible,
+                solve_seconds=time.perf_counter() - t0,
+                solver="remote",
+                feasible_candidates=[plan] if plan else [],
+            )
+
+        return finish
+
+    def _plan_fallback(self, observation, pdbs) -> PlanReport:
+        """This tick plans locally (numpy oracle) — the service is down,
+        slow, overloaded or out of protocol. Counted; the loop keeps
+        running at full fidelity minus device speed."""
+        metrics.update_remote_planner_fallback()
+        report = self._fallback_planner().plan(observation, pdbs)
+        self.last_solver = "remote-fallback"
+        return dataclasses.replace(report, solver="remote-fallback")
+
+
+class _RemoteError(Exception):
+    def __init__(self, message: str, retry_after: float):
+        super().__init__(message)
+        self.retry_after = float(retry_after)

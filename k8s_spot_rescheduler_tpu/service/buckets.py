@@ -1,0 +1,159 @@
+"""Shape buckets: how unrelated tenants come to share one compile.
+
+A jitted solver program is specialized to its tensor shapes, so a fleet
+of clusters whose packed problems all differ by a few lanes would each
+pay a cold XLA compile and could never share a batch. The service
+therefore rounds every incoming problem UP to a shape *bucket* — each of
+C (candidate lanes), K (pod slots) and S (spot nodes) to the next power
+of two (floored at the TPU sublane width) — and pads the problem into
+it. Two consequences, both load-bearing:
+
+- tenants in the same bucket stack into ONE batched solve under ONE
+  compiled program (parallel/tenant_batch.py), with per-tenant lane
+  blocks along the leading axis;
+- the number of distinct compiles is O(log C · log K · log S) for the
+  whole fleet, the same recompile-bounding discipline as the delta
+  scatter's power-of-two pads (planner/solver_planner._pad_pow2).
+
+Padding is semantics-free by the same invariant the in-process
+high-water padding relies on: padded candidate lanes have
+``cand_valid=False`` (never feasible, never selected), padded pod slots
+have ``slot_valid=False`` (place nothing), and padded spot rows have
+``spot_ok=False`` with zero capacity (fit nowhere). A tenant's selection
+out of the padded problem is therefore identical to its unpadded solve —
+``make serve-smoke`` pins this bit-for-bit against solo in-process plans.
+
+Batch sizing is an HBM question, answered by the same estimator the
+auto-shard dispatch trusts (solver/memory.estimate_union_hbm_breakdown):
+one tenant's program at the bucket shapes costs ``per_tenant_bytes``;
+the batch caps at ``budget // per_tenant_bytes`` tenants so a full batch
+provably fits the device before anything is compiled or stacked.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.solver import memory
+
+# Floors match the packer's _pad_dim minimum (multiples of 8 below the
+# 128-lane width) so a tiny tenant's bucket is not pathologically small.
+MIN_DIM = 8
+
+
+def _pow2_at_least(n: int, floor: int = MIN_DIM) -> int:
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+class Bucket(NamedTuple):
+    """One shared-compile shape class. R/W/A are carried unrounded:
+    they come from the config's resource axes and the constraint
+    interning and are already tiny and stable."""
+
+    C: int
+    K: int
+    S: int
+    R: int
+    W: int
+    A: int
+
+    @property
+    def key(self) -> str:
+        return f"C{self.C}xK{self.K}xS{self.S}xR{self.R}xW{self.W}xA{self.A}"
+
+
+def bucket_for(packed: PackedCluster) -> Bucket:
+    C, K, S, R, W, A = memory.packed_shapes(packed)
+    return Bucket(
+        C=_pow2_at_least(C), K=_pow2_at_least(K), S=_pow2_at_least(S),
+        R=R, W=W, A=A,
+    )
+
+
+def _pad_leading(arr: np.ndarray, n: int) -> np.ndarray:
+    """Pad axis 0 to length n with zeros (False for bool)."""
+    if arr.shape[0] == n:
+        return arr
+    out = np.zeros((n,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def pad_to_bucket(packed: PackedCluster, b: Bucket) -> PackedCluster:
+    """Pad a problem into its bucket. Pads are inert by construction:
+    invalid lanes, empty slots, not-ok zero-capacity spots."""
+    C, K, S, R, W, A = memory.packed_shapes(packed)
+    if (R, W, A) != (b.R, b.W, b.A):
+        raise ValueError(
+            f"packed (R={R}, W={W}, A={A}) does not belong to bucket {b.key}"
+        )
+    if C > b.C or K > b.K or S > b.S:
+        raise ValueError(
+            f"packed (C={C}, K={K}, S={S}) exceeds bucket {b.key}"
+        )
+
+    def pad_slots(arr):
+        # [C, K, ...] -> [b.C, b.K, ...]: K pads first (middle axis),
+        # then lanes
+        if arr.shape[1] != b.K:
+            out = np.zeros((arr.shape[0], b.K) + arr.shape[2:], arr.dtype)
+            out[:, : arr.shape[1]] = arr
+            arr = out
+        return _pad_leading(arr, b.C)
+
+    return PackedCluster(
+        slot_req=pad_slots(packed.slot_req),
+        slot_valid=pad_slots(packed.slot_valid),
+        slot_tol=pad_slots(packed.slot_tol),
+        slot_aff=pad_slots(packed.slot_aff),
+        cand_valid=_pad_leading(packed.cand_valid, b.C),
+        spot_free=_pad_leading(packed.spot_free, b.S),
+        spot_count=_pad_leading(packed.spot_count, b.S),
+        spot_max_pods=_pad_leading(packed.spot_max_pods, b.S),
+        spot_taints=_pad_leading(packed.spot_taints, b.S),
+        spot_ok=_pad_leading(packed.spot_ok, b.S),
+        spot_aff=_pad_leading(packed.spot_aff, b.S),
+    )
+
+
+def stack_bucket(problems: List[PackedCluster], b: Bucket) -> PackedCluster:
+    """Stack already-padded problems along a new leading tenant axis —
+    the [T, ...] pytree parallel/tenant_batch.plan_tenants_batched
+    consumes."""
+    return PackedCluster(
+        *(
+            np.stack([getattr(p, f) for p in problems])
+            for f in PackedCluster._fields
+        )
+    )
+
+
+def per_tenant_hbm_bytes(
+    b: Bucket, *, repair_spot_chunks: int = 1
+) -> int:
+    """One tenant's estimated solver footprint at the bucket shapes
+    (solver/memory's union-program model — the batch dimension
+    multiplies it linearly; lanes across tenants share nothing)."""
+    return memory.estimate_union_hbm_bytes(
+        b.C, b.K, b.S, b.R, b.W, b.A, repair_spot_chunks=repair_spot_chunks
+    )
+
+
+def max_batch_tenants(
+    b: Bucket,
+    *,
+    budget_bytes: int = 0,
+    repair_spot_chunks: int = 1,
+    cap: int = 64,
+) -> int:
+    """How many tenants may share one batched solve at these shapes:
+    ``budget // per-tenant estimate``, floored at 1 (a single tenant
+    that alone exceeds the budget is the auto-shard tiers' problem, not
+    the batcher's), capped to keep worst-case batch latency bounded."""
+    budget = budget_bytes or memory.device_hbm_budget()
+    per = per_tenant_hbm_bytes(b, repair_spot_chunks=repair_spot_chunks)
+    return max(1, min(int(cap), budget // max(per, 1)))

@@ -1,0 +1,921 @@
+"""The multi-tenant planner service: one TPU planning for a fleet.
+
+The single-tenant sidecar (sidecar/server.py) guarded a one-solve-at-a-
+time lock: correct for one cluster, but it leaves the device idle
+between ticks — the device-only solve is ~1 ms while a housekeeping
+tick is seconds (docs/RESULTS.md), so a whole accelerator per cluster
+is ~99.9% idle. This module replaces the lock with a *batching
+scheduler*:
+
+- per-cluster agents (service/agent.py) POST packed problems over the
+  binary wire protocol (service/wire.py) to ``/v2/plan``;
+- concurrent requests are padded into shape buckets
+  (service/buckets.py) — tenants in one bucket share one jit compile —
+  and stacked into ONE batched device solve with per-tenant lane blocks
+  (parallel/tenant_batch.py), batch size capped by the HBM estimate so
+  a full batch provably fits the device;
+- a deficit-round-robin queue gives per-tenant fairness: each batch
+  round offers every waiting tenant one lane-block's worth of quantum,
+  so a tenant flooding the queue delays only itself — another tenant's
+  head request rides the very next batch;
+- the wait is bounded: a request still queued past the queue timeout is
+  evicted with 503 + ``Retry-After`` derived from the *measured* batch
+  cadence (how long until a batch slot actually frees), not a static
+  guess;
+- the legacy JSON ``/v1/plan`` survives as a thin decode→pack adapter
+  over the same queue, so there is exactly one solve path;
+- the sidecar's edge bounds carry over unchanged: ``max_body_bytes``
+  caps any request body (413), ``max_inflight`` caps handler depth with
+  rejects issued BEFORE the body is read (memory-bounded bursts).
+
+``GET /healthz`` reports queue depth, per-bucket occupancy, per-tenant
+last-plan age and the measured cadence alongside the control-loop
+health snapshot, so a probe can see a starving tenant without Prometheus.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.service import buckets as bucketing
+from k8s_spot_rescheduler_tpu.service import wire
+from k8s_spot_rescheduler_tpu.service.buckets import Bucket
+from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from k8s_spot_rescheduler_tpu.utils import logging as log
+
+
+class ServiceBusy(Exception):
+    """The queue refused or expired a request; retry after ``retry_after``
+    seconds (the measured batch cadence, ceil'd)."""
+
+    def __init__(self, message: str, retry_after: int):
+        super().__init__(message)
+        self.retry_after = int(retry_after)
+
+
+# per-tenant bookkeeping bounds: tenant ids are CLIENT-supplied (wire
+# frame / X-Tenant header), so every keyed structure must be pruned or a
+# churning fleet (fresh hostname per agent restart) grows the long-lived
+# service without bound
+TENANT_STATE_TTL_S = 3600.0
+TENANT_STATE_MAX = 4096
+
+
+class _Request:
+    __slots__ = (
+        "tenant", "packed", "bucket", "lanes", "enqueued", "event",
+        "reply", "error",
+    )
+
+    def __init__(self, tenant: str, packed: PackedCluster, bucket: Bucket,
+                 enqueued: float):
+        self.tenant = tenant
+        self.packed = packed
+        self.bucket = bucket
+        # DRR cost: the lanes this problem actually solves (valid lanes,
+        # not pad) — a tenant shipping big problems drains its deficit
+        # faster than one shipping small ones
+        self.lanes = int(np.asarray(packed.cand_valid).sum())
+        self.enqueued = enqueued
+        self.event = threading.Event()
+        self.reply: Optional[wire.PlanReply] = None
+        self.error: Optional[ServiceBusy] = None
+
+
+class PlannerService:
+    """The queue + batcher + solver. HTTP lives in :class:`ServiceServer`;
+    this class is directly drivable by tests (virtual clock, no threads:
+    ``submit_nowait`` + ``drain_once``)."""
+
+    def __init__(
+        self,
+        config: ReschedulerConfig,
+        *,
+        queue_timeout_s: Optional[float] = None,
+        batch_window_s: Optional[float] = None,
+        max_batch_tenants: int = 0,
+        clock: Optional[Clock] = None,
+    ):
+        self.config = config
+        self.clock = clock or RealClock()
+        self.queue_timeout_s = float(
+            queue_timeout_s
+            if queue_timeout_s is not None
+            else config.service_queue_timeout
+        )
+        self.batch_window_s = float(
+            batch_window_s
+            if batch_window_s is not None
+            else config.service_batch_window
+        )
+        # 0 = derive per bucket from the HBM budget
+        self.max_batch_tenants = int(max_batch_tenants)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queues: Dict[str, deque] = {}  # tenant -> FIFO of _Request
+        self._ring: List[str] = []  # DRR ring, activation order
+        self._rr_pos = 0
+        self._deficit: Dict[str, int] = {}
+        self._last_plan_wall: Dict[str, float] = {}
+        self._batch_cap: Dict[Bucket, int] = {}  # HBM cap memo per bucket
+        self._cadence_s: Optional[float] = None  # EMA of batch intervals
+        self._last_batch_mono: Optional[float] = None
+        self._batched = None  # lazy jitted tenant-batch program
+        self._mesh = None
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # test seam: solve_hook(stacked, reqs) -> int32 [T, 3+K]
+        self.solve_hook = None
+
+    # ------------------------------------------------------------------
+    # queue
+
+    def submit_nowait(self, tenant: str, packed: PackedCluster) -> _Request:
+        """Enqueue one problem; returns the pending request (its
+        ``event`` fires when a batch delivered ``reply`` or ``error``)."""
+        req = _Request(
+            tenant, packed, bucketing.bucket_for(packed), self.clock.now()
+        )
+        with self._work:
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+            if tenant not in self._deficit:
+                self._ring.append(tenant)
+                self._deficit[tenant] = 0
+            q.append(req)
+            self._work.notify_all()
+        return req
+
+    def submit(
+        self,
+        tenant: str,
+        packed: PackedCluster,
+        timeout_s: Optional[float] = None,
+    ) -> wire.PlanReply:
+        """Enqueue and wait for the batch that carries this request.
+        Raises :class:`ServiceBusy` when the bounded wait expires — the
+        request is evicted from the queue so an abandoned caller cannot
+        occupy a batch slot. ``timeout_s`` is the CLIENT's declared
+        deadline (agents send it as ``X-Planner-Deadline``): waiting any
+        longer than the caller will would solve — and hold an inflight
+        slot for — a request nobody is listening to anymore."""
+        wait_s = self.queue_timeout_s
+        if timeout_s is not None and timeout_s > 0:
+            wait_s = max(0.05, min(wait_s, float(timeout_s)))
+        req = self.submit_nowait(tenant, packed)
+        if self._thread is None:
+            # no scheduler thread (an in-process caller — e.g.
+            # PlannerSidecar.plan without start_background): drain the
+            # queue on the caller's thread so the historical synchronous
+            # contract holds instead of timing out against nobody
+            while not req.event.is_set() and self.drain_once():
+                pass
+        if not req.event.wait(wait_s):
+            if self._evict(req):
+                metrics.update_service_request("expired")
+                metrics.update_service_tenant_eviction(req.tenant)
+                raise ServiceBusy(
+                    "plan request waited past the %.1fs queue timeout"
+                    % wait_s,
+                    self.retry_after(),
+                )
+            # already popped into an in-flight batch: the solve is not
+            # interruptible (an XLA dispatch cannot be cancelled), so
+            # ride it out — same contract as the old sidecar lock
+            req.event.wait()
+        if req.error is not None:
+            raise req.error
+        if req.reply is None:
+            raise RuntimeError("request completed without reply or error")
+        return req.reply
+
+    def _evict(self, req: _Request) -> bool:
+        with self._work:
+            q = self._queues.get(req.tenant)
+            if q is not None and req in q:
+                q.remove(req)
+                return True
+        return False
+
+    def retry_after(self) -> int:
+        """Seconds until a batch slot plausibly frees: the measured
+        batch cadence (EMA over completed batches), ceil'd; 1 before
+        any batch has completed."""
+        cadence = self._cadence_s
+        if cadence is None or cadence <= 0:
+            return 1
+        return max(1, int(math.ceil(cadence)))
+
+    def queue_depth(self) -> int:
+        with self._work:
+            return sum(len(q) for q in self._queues.values())
+
+    def healthz_snapshot(self) -> dict:
+        """Queue depth, per-bucket occupancy, per-tenant last-plan age
+        and the measured cadence — the service half of /healthz."""
+        with self._work:
+            depth = 0
+            by_bucket: Dict[str, int] = {}
+            for q in self._queues.values():
+                depth += len(q)
+                for req in q:
+                    key = req.bucket.key
+                    by_bucket[key] = by_bucket.get(key, 0) + 1
+            wall = self.clock.wall()
+            tenants = {
+                t: round(max(0.0, wall - w), 3)
+                for t, w in self._last_plan_wall.items()
+            }
+            cadence = self._cadence_s
+        return {
+            "queue_depth": depth,
+            "bucket_occupancy": by_bucket,
+            "tenant_last_plan_age_s": tenants,
+            "batch_cadence_s": (
+                None if cadence is None else round(cadence, 3)
+            ),
+            "batch_window_s": self.batch_window_s,
+        }
+
+    # ------------------------------------------------------------------
+    # batching
+
+    def _pop_batch_locked(self):
+        """One deficit-round-robin pass: pick the bucket of the oldest
+        waiting request (bounded wait beats throughput), then walk the
+        tenant ring giving each tenant one quantum (a full lane-block,
+        ``bucket.C`` lanes) and popping head requests of that bucket
+        while its deficit covers their lane cost. Caller holds the lock."""
+        oldest: Optional[_Request] = None
+        for q in self._queues.values():
+            if q and (oldest is None or q[0].enqueued < oldest.enqueued):
+                oldest = q[0]
+        if oldest is None:
+            return []
+        bucket = oldest.bucket
+        cap = self.max_batch_tenants or self._batch_cap.get(bucket, 0)
+        if not cap:
+            # memoized per bucket: the estimate is constant in (bucket,
+            # config), and with solver_hbm_budget=0 it queries backend
+            # memory stats — not something to repeat per pop under the
+            # queue lock
+            cap = bucketing.max_batch_tenants(
+                bucket,
+                budget_bytes=self.config.solver_hbm_budget,
+                repair_spot_chunks=(
+                    1
+                    if self.config.fallback_best_fit
+                    and self.config.repair_rounds > 0
+                    else 0
+                ),
+            )
+            self._batch_cap[bucket] = cap
+        batch: List[_Request] = []
+        # refill each waiting tenant's deficit ONCE per batch: one full
+        # lane-block of quantum. quantum >= any request's lane cost, so
+        # every tenant is guaranteed a slot in the very next batch — the
+        # bounded-wait fairness claim — while lane accounting still lets
+        # small-problem tenants pack denser than big-problem ones.
+        refilled: set = set()
+        while len(batch) < cap:
+            popped = False
+            # one full ring rotation, ONE pop per tenant per pass:
+            # interleaving is what keeps a flooding tenant from filling
+            # the batch before the rotation reaches anyone else
+            for _ in range(len(self._ring)):
+                if len(batch) >= cap or not self._ring:
+                    break
+                self._rr_pos %= len(self._ring)
+                tenant = self._ring[self._rr_pos]
+                q = self._queues.get(tenant)
+                if not q:
+                    # empty queue leaves the ring AND the queue map;
+                    # deficit resets (classic DRR: credit must not
+                    # accrue while idle) and a churned tenant id leaves
+                    # no residue behind
+                    self._ring.pop(self._rr_pos)
+                    self._deficit.pop(tenant, None)
+                    self._queues.pop(tenant, None)
+                    continue
+                if q[0].bucket == bucket:
+                    if tenant not in refilled:
+                        refilled.add(tenant)
+                        # clamp: credit saved while batches were full
+                        # must not compound into a later burst
+                        self._deficit[tenant] = min(
+                            self._deficit.get(tenant, 0) + bucket.C,
+                            2 * bucket.C,
+                        )
+                    if self._deficit[tenant] >= max(q[0].lanes, 1):
+                        req = q.popleft()
+                        self._deficit[tenant] -= max(req.lanes, 1)
+                        batch.append(req)
+                        popped = True
+                self._rr_pos += 1
+            if not popped:
+                break
+        return batch
+
+    def drain_once(self) -> bool:
+        """Form and solve ONE batch; returns True if a batch dispatched.
+        The scheduler thread loops this; tests call it directly under a
+        virtual clock."""
+        with self._work:
+            batch = self._pop_batch_locked()
+        if not batch:
+            return False
+        bucket = batch[0].bucket
+        now = self.clock.now()
+        waits_ms = [max(0.0, now - r.enqueued) * 1e3 for r in batch]
+        t0 = self.clock.now()
+        try:
+            padded = [
+                bucketing.pad_to_bucket(r.packed, bucket) for r in batch
+            ]
+            stacked = bucketing.stack_bucket(padded, bucket)
+            if self.solve_hook is not None:
+                out = np.asarray(self.solve_hook(stacked, batch))
+            else:
+                out = self._solve(stacked)
+        except Exception as err:  # noqa: BLE001 — contain: fail the batch,
+            # not the service (the agents fall back to their local oracle)
+            log.error("batched solve failed: %s", err)
+            for req in batch:
+                req.error = ServiceBusy(f"solve failed: {err}", 0)
+                metrics.update_service_request("error")
+                req.event.set()
+            return True
+        solve_ms = (self.clock.now() - t0) * 1e3
+        lanes = sum(r.lanes for r in batch)
+        tenants = len({r.tenant for r in batch})
+        metrics.update_service_batch(lanes, tenants, waits_ms)
+        wall = self.clock.wall()
+        end = self.clock.now()
+        with self._work:
+            # bookkeeping a concurrent /healthz iterates — same lock
+            for req in batch:
+                self._last_plan_wall[req.tenant] = wall
+            # bounded: tenant ids are client-supplied, so the age map
+            # drops entries past the TTL and hard-caps at the newest
+            # TENANT_STATE_MAX (a churning fleet must not grow the
+            # service or its /healthz response without bound)
+            cutoff = wall - TENANT_STATE_TTL_S
+            stale = [
+                t for t, w in self._last_plan_wall.items() if w < cutoff
+            ]
+            for t in stale:
+                del self._last_plan_wall[t]
+            if len(self._last_plan_wall) > TENANT_STATE_MAX:
+                newest = sorted(
+                    self._last_plan_wall.items(),
+                    key=lambda kv: kv[1],
+                    reverse=True,
+                )[:TENANT_STATE_MAX]
+                self._last_plan_wall = dict(newest)
+            if self._last_batch_mono is not None:
+                interval = max(1e-9, end - self._last_batch_mono)
+                self._cadence_s = (
+                    interval
+                    if self._cadence_s is None
+                    else 0.7 * self._cadence_s + 0.3 * interval
+                )
+            self._last_batch_mono = end
+        for i, req in enumerate(batch):
+            K = req.packed.slot_req.shape[1]
+            vec = out[i]
+            req.reply = wire.PlanReply(
+                found=bool(vec[1]),
+                index=int(vec[0]),
+                n_feasible=int(vec[2]),
+                # trim the bucket's K pad back to the tenant's K: slot
+                # indices beyond the tenant's own slots are pad rows
+                row=np.asarray(vec[3 : 3 + K], np.int32),
+                solve_ms=float(solve_ms / max(len(batch), 1)),
+                queue_wait_ms=float(waits_ms[i]),
+                batch_lanes=lanes,
+                batch_tenants=tenants,
+            )
+            metrics.update_service_request("ok")
+            req.event.set()
+        return True
+
+    # ------------------------------------------------------------------
+    # solving
+
+    def batch_program(self) -> str:
+        """What actually solves batches (surfaced on /healthz so a
+        configured solver name can never silently misreport)."""
+        return (
+            "numpy-oracle"
+            if self.config.solver == "numpy"
+            else "tenant-batch(jax union)"
+        )
+
+    def _solve(self, stacked: PackedCluster) -> np.ndarray:
+        if self.config.solver == "numpy":
+            return self._solve_host(stacked)
+        if self._batched is None:
+            from k8s_spot_rescheduler_tpu.parallel.tenant_batch import (
+                make_tenant_batch_planner,
+            )
+
+            try:
+                import jax
+
+                if len(jax.devices()) > 1:
+                    from k8s_spot_rescheduler_tpu.parallel.mesh import (
+                        make_tenant_mesh,
+                    )
+
+                    self._mesh = make_tenant_mesh()
+            except Exception:  # noqa: BLE001 — no backend info: stay 1-chip
+                self._mesh = None
+            cfg = self.config
+            if cfg.solver not in ("jax",):
+                # pallas/sharded are per-tenant SINGLE-problem kernel
+                # choices; the service's scale story is the tenant
+                # batch, which composes the jax union program. Say so
+                # instead of silently no-opping the flag.
+                log.info(
+                    "planner service batches tenants with the jax union "
+                    "program (configured solver %r selects in-process "
+                    "kernels; /healthz reports batch_program)",
+                    cfg.solver,
+                )
+            self._batched = make_tenant_batch_planner(
+                self._mesh,
+                rounds=(
+                    cfg.repair_rounds if cfg.fallback_best_fit else 0
+                ),
+                best_fit_fallback=cfg.fallback_best_fit,
+            )
+        T = stacked.slot_req.shape[0]
+        if self._mesh is not None:
+            # pad the tenant axis to a device multiple so the batch
+            # SHARDS instead of falling to one-device vmap; pad tenants
+            # are all-invalid problems (found=False rows, discarded)
+            n = int(self._mesh.devices.size)
+            pad = (-T) % n
+            if pad:
+                stacked = PackedCluster(
+                    *(
+                        np.concatenate(
+                            [
+                                np.asarray(f),
+                                np.zeros((pad,) + f.shape[1:], f.dtype),
+                            ]
+                        )
+                        for f in stacked
+                    )
+                )
+        return np.asarray(self._batched(stacked))[:T]
+
+    def _solve_host(self, stacked: PackedCluster) -> np.ndarray:
+        """The numpy-oracle batch path (CI / --solver numpy): the SAME
+        union helper SolverPlanner's host branch calls
+        (solver/numpy_oracle.plan_union_oracle), per tenant — one host
+        union, so the two paths cannot drift."""
+        from k8s_spot_rescheduler_tpu.solver.numpy_oracle import (
+            plan_union_oracle,
+        )
+
+        cfg = self.config
+        T = stacked.slot_req.shape[0]
+        K = stacked.slot_req.shape[2]
+        out = np.zeros((T, 3 + K), np.int32)
+        for t in range(T):
+            packed = PackedCluster(
+                *(np.asarray(getattr(stacked, f)[t]) for f in stacked._fields)
+            )
+            result = plan_union_oracle(
+                packed,
+                best_fit_fallback=cfg.fallback_best_fit,
+                repair_rounds=cfg.repair_rounds,
+            )
+            feasible = np.asarray(result.feasible)
+            idx = int(np.argmax(feasible)) if feasible.size else 0
+            out[t, 0] = idx
+            out[t, 1] = int(bool(feasible.any()))
+            out[t, 2] = int(feasible.sum())
+            if feasible.size:
+                out[t, 3:] = np.asarray(result.assignment[idx], np.int32)
+        return out
+
+    # ------------------------------------------------------------------
+    # scheduler thread
+
+    def start_scheduler(self) -> None:
+        if self._thread is not None:
+            return
+        with self._work:
+            self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop_scheduler(self) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._stop and not any(
+                    self._queues.get(t) for t in self._queues
+                ):
+                    self._work.wait(timeout=1.0)
+                if self._stop:
+                    return
+            # coalescing window: concurrent tenants land in one batch
+            if self.batch_window_s > 0:
+                self.clock.sleep(self.batch_window_s)
+            while self.drain_once():
+                pass
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+
+
+class ServiceServer:
+    """HTTP front of a :class:`PlannerService`: ``/v2/plan`` (binary
+    wire), ``/v1/plan`` (legacy JSON adapter over the same queue) and
+    ``/healthz``. Edge bounds are the sidecar's, unchanged: body cap
+    (413), handler depth cap with pre-body-read rejection (503)."""
+
+    def __init__(
+        self,
+        config: ReschedulerConfig,
+        address: str = "127.0.0.1:8642",
+        *,
+        max_body_bytes: int = 128 << 20,
+        queue_timeout_s: Optional[float] = None,
+        # fleet-facing default: comfortably above the HBM-derived batch
+        # caps so concurrently-ticking agents are queued (and batched),
+        # not shed; the single-tenant sidecar surface keeps its
+        # historical 4
+        max_inflight: int = 16,
+        batch_window_s: Optional[float] = None,
+        max_batch_tenants: int = 0,
+        clock: Optional[Clock] = None,
+    ):
+        self.config = config
+        self.service = PlannerService(
+            config,
+            queue_timeout_s=queue_timeout_s,
+            batch_window_s=batch_window_s,
+            max_batch_tenants=max_batch_tenants,
+            clock=clock,
+        )
+        self.max_body_bytes = int(max_body_bytes)
+        self.max_inflight = int(max_inflight)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        host, _, port = address.rpartition(":")
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send_json(self, obj, code=200, headers=()):
+                data = json.dumps(obj).encode()
+                self._send_bytes(data, "application/json", code, headers)
+
+            def _send_bytes(self, data, ctype, code=200, headers=()):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    from k8s_spot_rescheduler_tpu.loop import health
+
+                    out = {
+                        "ok": True,
+                        "solver": server.config.solver,
+                        "batch_program": server.service.batch_program(),
+                    }
+                    out.update(server.service.healthz_snapshot())
+                    out.update(health.snapshot())
+                    return self._send_json(out)
+                return self._send_json({"error": "not found"}, 404)
+
+            def _reject_unread(self, obj, code, headers=()):
+                """A response sent BEFORE the body was read must close
+                the connection: under keep-alive the unconsumed body
+                bytes would desync the next request on this socket.
+                Applies to every pre-read reject — 400/404/413/503."""
+                self.close_connection = True
+                return self._send_json(
+                    obj, code,
+                    headers=tuple(headers) + (("Connection", "close"),),
+                )
+
+            def _read_body(self):
+                """Content-Length checks + the body read, or None if a
+                reject was already sent."""
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self._reject_unread({"error": "bad Content-Length"}, 400)
+                    return None
+                if length < 0:
+                    # must not reach rfile.read(-1): buffer-until-EOF is
+                    # the exact exhaustion the size cap prevents
+                    self._reject_unread({"error": "bad Content-Length"}, 400)
+                    return None
+                if length > server.max_body_bytes:
+                    self._reject_unread(
+                        {
+                            "error": "request exceeds %d-byte limit"
+                            % server.max_body_bytes
+                        },
+                        413,
+                    )
+                    metrics.update_service_request("rejected")
+                    return None
+                if not server._admit():
+                    metrics.update_service_request("rejected")
+                    self._reject_unread(
+                        {
+                            "error": "planner overloaded (%d requests in "
+                            "flight)" % server.max_inflight
+                        },
+                        503,
+                        headers=[(
+                            "Retry-After",
+                            str(server.service.retry_after()),
+                        )],
+                    )
+                    return None
+                try:
+                    return self.rfile.read(length)
+                except Exception:
+                    # the slot was admitted above but the caller's
+                    # finally-release is only reached once we RETURN a
+                    # body — a client aborting mid-upload must not leak
+                    # its inflight slot forever
+                    server._release()
+                    raise
+
+            def do_POST(self):
+                if self.path == "/v2/plan":
+                    return self._post_wire()
+                if self.path == "/v1/plan":
+                    return self._post_json()
+                return self._reject_unread({"error": "not found"}, 404)
+
+            def _post_wire(self):
+                body = self._read_body()
+                if body is None:
+                    return
+                try:
+                    try:
+                        tenant, packed = wire.decode_plan_request(body)
+                    except wire.WireError as err:
+                        metrics.update_service_request("error")
+                        return self._send_bytes(
+                            wire.encode_error(str(err)),
+                            "application/octet-stream", 400,
+                        )
+                    try:
+                        # the agent declares its own HTTP deadline:
+                        # waiting longer server-side would batch-solve
+                        # (and hold an inflight slot for) a request the
+                        # caller already abandoned
+                        try:
+                            deadline = float(
+                                self.headers.get("X-Planner-Deadline", 0)
+                                or 0
+                            )
+                        except (TypeError, ValueError):
+                            deadline = 0.0
+                        reply = server.service.submit(
+                            tenant, packed,
+                            timeout_s=deadline or None,
+                        )
+                    except ServiceBusy as err:
+                        return self._send_bytes(
+                            wire.encode_error(str(err)),
+                            "application/octet-stream", 503,
+                            headers=[("Retry-After", str(err.retry_after))],
+                        )
+                    return self._send_bytes(
+                        wire.encode_plan_reply(reply),
+                        "application/octet-stream",
+                    )
+                except Exception as err:  # noqa: BLE001 — handler survives
+                    log.error("service /v2/plan failed: %s", err)
+                    metrics.update_service_request("error")
+                    return self._send_bytes(
+                        wire.encode_error(str(err)),
+                        "application/octet-stream", 500,
+                    )
+                finally:
+                    server._release()
+
+            def _post_json(self):
+                body = self._read_body()
+                if body is None:
+                    return
+                try:
+                    try:
+                        snapshot = json.loads(body)
+                    except ValueError as err:
+                        return self._send_json({"error": str(err)}, 400)
+                    tenant = self.headers.get("X-Tenant") or "default"
+                    try:
+                        result = server.plan_json(snapshot, tenant=tenant)
+                    except ServiceBusy as err:
+                        return self._send_json(
+                            {"error": str(err)}, 503,
+                            headers=[("Retry-After", str(err.retry_after))],
+                        )
+                    except (ValueError, KeyError) as err:
+                        return self._send_json({"error": str(err)}, 400)
+                    return self._send_json(result)
+                except Exception as err:  # noqa: BLE001 — solver failure
+                    log.error("service /v1/plan failed: %s", err)
+                    return self._send_json({"error": str(err)}, 500)
+                finally:
+                    server._release()
+
+        self.server = ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), Handler
+        )
+
+    def _admit(self) -> bool:
+        with self._inflight_lock:
+            if self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            return True
+
+    def _release(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    @property
+    def address(self) -> str:
+        host, port = self.server.server_address
+        return f"{host}:{port}"
+
+    # ------------------------------------------------------------------
+    # the legacy JSON adapter: decode -> pack -> the SAME queue
+
+    def plan_json(self, body: dict, *, tenant: str = "default") -> dict:
+        """Kubernetes-JSON snapshot in, legacy /v1/plan response out —
+        packed host-side and solved through the batching queue exactly
+        like a wire-protocol tenant (one solve path)."""
+        from k8s_spot_rescheduler_tpu.io.kube import (
+            decode_node,
+            decode_pdb,
+            decode_pod,
+        )
+        from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+        from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+
+        cfg = self.config
+        nodes = [decode_node(o) for o in body.get("nodes", [])]
+        pods = [decode_pod(o) for o in body.get("pods", [])]
+        pdbs = [decode_pdb(o) for o in body.get("pdbs", [])]
+        pvc_objs = body.get("pvcs") or []
+        pv_objs = body.get("pvs") or []
+        if pvc_objs or pv_objs:
+            from k8s_spot_rescheduler_tpu.io.kube import (
+                decode_volume_snapshots,
+            )
+            from k8s_spot_rescheduler_tpu.models.volumes import (
+                resolve_volume_affinity,
+            )
+
+            pvcs, pvs = decode_volume_snapshots(pvc_objs, pv_objs)
+            pods = [
+                resolve_volume_affinity(p, pvcs, pvs)
+                if p.pvc_resolvable
+                else p
+                for p in pods
+            ]
+        pods_by_node: dict = {}
+        for pod in pods:
+            pods_by_node.setdefault(pod.node_name, []).append(pod)
+        node_map = build_node_map(
+            [n for n in nodes if n.ready],
+            pods_by_node,
+            on_demand_label=cfg.on_demand_node_label,
+            spot_label=cfg.spot_node_label,
+            priority_threshold=cfg.priority_threshold,
+            # not-ready nodes are presence-only (zone/spread counts) —
+            # dropping them would overstate the spread domain-min, the
+            # permissive direction (same rule as the control loop)
+            unready_nodes=[n for n in nodes if not n.ready],
+        )
+        packed, meta = pack_cluster(
+            node_map,
+            pdbs,
+            resources=cfg.resources,
+            delete_non_replicated=cfg.delete_non_replicated_pods,
+            pad_slots=cfg.max_pods_per_node_hint,
+        )
+        reply = self.service.submit(tenant, packed)
+        out = {
+            "found": reply.found,
+            "nCandidates": meta.n_candidates,
+            "nFeasible": reply.n_feasible,
+            "solveMs": round(reply.solve_ms, 3),
+            "batchLanes": reply.batch_lanes,
+            "batchTenants": reply.batch_tenants,
+        }
+        if reply.found:
+            plan = meta.build_plan(reply.index, np.asarray(reply.row))
+            out["node"] = plan.node.node.name
+            out["pods"] = [p.uid for p in plan.pods]
+            out["assignments"] = plan.assignments
+        return out
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def serve_forever(self) -> None:
+        log.info("planner service listening on %s", self.address)
+        self.service.start_scheduler()
+        self._serving = True
+        self.server.serve_forever()
+
+    def start_background(self) -> None:
+        self.service.start_scheduler()
+        self._serving = True
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self) -> None:
+        # shutdown() handshakes with a RUNNING serve_forever loop; with
+        # no loop ever started (in-process use) it would block forever
+        # on an event only serve_forever sets
+        if getattr(self, "_serving", False):
+            self.server.shutdown()
+        self.server.server_close()
+        self.service.stop_scheduler()
+
+
+def main(argv=None) -> int:
+    """``python -m k8s_spot_rescheduler_tpu.service.server`` — the
+    standalone multi-tenant planner (also reachable as ``--serve`` on
+    the main CLI)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="spot-rescheduler-planner-service")
+    ap.add_argument("--listen", default="127.0.0.1:8642")
+    ap.add_argument("--solver", default="jax",
+                    choices=["jax", "numpy", "pallas", "sharded"])
+    ap.add_argument("--max-body-mb", type=int, default=128,
+                    help="reject request bodies larger than this (413)")
+    ap.add_argument("--queue-timeout", type=float, default=30.0,
+                    help="seconds a plan request may wait in the tenant "
+                         "queue before 503 + measured-cadence Retry-After")
+    ap.add_argument("--batch-window", type=float, default=0.02,
+                    help="seconds the batcher waits to coalesce "
+                         "concurrent tenants into one solve")
+    ap.add_argument("--max-inflight", type=int, default=16,
+                    help="reject immediately (503) past this many "
+                         "concurrent requests — bounds worst-case request "
+                         "memory at max-inflight x max-body-mb")
+    ap.add_argument("-v", "--verbosity", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.setup(args.verbosity)
+    server = ServiceServer(
+        ReschedulerConfig(
+            solver=args.solver,
+            service_queue_timeout=args.queue_timeout,
+            service_batch_window=args.batch_window,
+        ),
+        args.listen,
+        max_body_bytes=args.max_body_mb << 20,
+        max_inflight=args.max_inflight,
+    )
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,409 @@
+"""The planner service wire protocol: versioned, framed, binary.
+
+The multi-tenant planner service (service/server.py) receives whole
+``PackedCluster`` problems from per-cluster agents and returns the tiny
+selection vector — tensors in both directions, never Kubernetes JSON
+(the agent already packed; re-encoding 30 MB of objects would put the
+decode cost the columnar path removed back on every tick). This module
+is that boundary's byte format, shared by agent and server and pinned
+byte-for-byte by tests/test_wire_fixtures.py.
+
+Layout (all integers little-endian)::
+
+    header   = MAGIC "KSRW" | u8 version | u8 kind | u16 frame_count
+    frame    = u16 name_len | name utf-8 | u8 dtype_code | u8 ndim
+             | u32 dim * ndim | u64 payload_len | payload (C-order)
+
+Frames are dtype/shape-tagged numpy buffers; strings (tenant ids, error
+text) travel as uint8 frames of utf-8 bytes. There is deliberately NO
+pickle, NO schema negotiation and NO self-describing container format:
+the decoder admits exactly the dtype table below and the message kinds
+below, and anything else is a typed :class:`WireError` — a planner
+service is a write-capable network surface and must not grow an
+arbitrary-deserialization hole.
+
+Version bump policy
+-------------------
+``WIRE_VERSION`` is a single byte covering the whole message layout.
+Bump it when (and only when) an already-shipped frame changes meaning:
+field renamed, dtype changed, header reshaped, kind renumbered. ADDING
+a new frame name or a new message kind is backward compatible (decoders
+ignore unknown frame names; unknown KINDS are an error) and must NOT
+bump the version. A decoder seeing a version it does not speak raises
+:class:`WireVersionError` — a typed error the server answers with a
+clean 400, never a crash — so a mixed-version fleet fails request by
+request, loudly, instead of corrupting tensors. Every bump must update
+the byte-golden fixtures in tests/test_wire_fixtures.py in the same
+commit; the goldens exist precisely so this file cannot drift silently.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, NamedTuple, Tuple
+
+import numpy as np
+
+MAGIC = b"KSRW"
+WIRE_VERSION = 1
+
+# message kinds (u8). New kinds append; renumbering is a version bump.
+KIND_PLAN_REQUEST = 1  # agent -> service: tenant + PackedCluster
+KIND_PLAN_REPLY = 2  # service -> agent: selection + batch telemetry
+KIND_PACKED_DELTA = 3  # agent -> service: tenant + PackedDelta
+KIND_ERROR = 4  # service -> agent: typed error text
+
+# dtype table (u8 code <-> numpy dtype). Append-only; reordering is a
+# version bump. bool travels as its own code (1 byte/element) so the
+# decoder can hand back real bool arrays, not u8 lookalikes.
+_DTYPE_CODES: Tuple[np.dtype, ...] = tuple(
+    np.dtype(d) for d in ("<f4", "<i4", "<i8", "<u4", "u1", "?")
+)
+_CODE_OF: Dict[np.dtype, int] = {d: i for i, d in enumerate(_DTYPE_CODES)}
+
+_HEADER = struct.Struct("<4sBBH")
+_FRAME_HEAD = struct.Struct("<H")
+_FRAME_TAG = struct.Struct("<BB")
+_DIM = struct.Struct("<I")
+_PAYLEN = struct.Struct("<Q")
+
+# hard ceilings a hostile or corrupt message cannot talk past: the
+# decoder rejects before allocating (ndim is bounded by the tensor
+# model; 255 frames is far above any real message's dozen)
+MAX_NDIM = 8
+MAX_FRAMES = 255
+
+
+class WireError(ValueError):
+    """Malformed or out-of-contract wire bytes (typed; never a crash)."""
+
+
+class WireVersionError(WireError):
+    """The message speaks a protocol version this decoder does not."""
+
+
+def _encode_frame(name: str, arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr)
+    if arr.dtype.byteorder == ">":
+        # actually swap a big-endian input to the wire order — mapping
+        # the dtype code alone would tag byte-reversed payloads as
+        # little-endian, silent corruption on the far side
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    code = _CODE_OF.get(arr.dtype)
+    if code is None:
+        raise WireError(f"dtype {arr.dtype} has no wire code (frame {name!r})")
+    payload = np.ascontiguousarray(arr).tobytes()
+    nb = name.encode("utf-8")
+    parts = [
+        _FRAME_HEAD.pack(len(nb)),
+        nb,
+        _FRAME_TAG.pack(code, arr.ndim),
+    ]
+    parts.extend(_DIM.pack(d) for d in arr.shape)
+    parts.append(_PAYLEN.pack(len(payload)))
+    parts.append(payload)
+    return b"".join(parts)
+
+
+def encode_frames(kind: int, frames: List[Tuple[str, np.ndarray]]) -> bytes:
+    """One wire message: header + the given (name, array) frames, in
+    the given order (the order is part of the byte-golden contract)."""
+    if len(frames) > MAX_FRAMES:
+        raise WireError(f"{len(frames)} frames exceeds the {MAX_FRAMES} cap")
+    out = [_HEADER.pack(MAGIC, WIRE_VERSION, kind, len(frames))]
+    out.extend(_encode_frame(n, a) for n, a in frames)
+    return b"".join(out)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int, what: str) -> bytes:
+        if self.pos + n > len(self.data):
+            raise WireError(
+                f"truncated message: {what} needs {n} bytes, "
+                f"{len(self.data) - self.pos} remain"
+            )
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+
+def decode_frames(data: bytes) -> Tuple[int, Dict[str, np.ndarray]]:
+    """(kind, {name: array}) or a typed WireError. Arrays are zero-copy
+    views into ``data`` (read-only) — the solve path only reads them."""
+    r = _Reader(bytes(data) if isinstance(data, (bytearray, memoryview)) else data)
+    magic, version, kind, n_frames = _HEADER.unpack(r.take(_HEADER.size, "header"))
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r} (not a planner wire message)")
+    if version != WIRE_VERSION:
+        raise WireVersionError(
+            f"wire version {version} not supported (this build speaks "
+            f"{WIRE_VERSION}; see the version bump policy in service/wire.py)"
+        )
+    if kind not in (
+        KIND_PLAN_REQUEST, KIND_PLAN_REPLY, KIND_PACKED_DELTA, KIND_ERROR
+    ):
+        raise WireError(f"unknown message kind {kind}")
+    if n_frames > MAX_FRAMES:
+        raise WireError(f"{n_frames} frames exceeds the {MAX_FRAMES} cap")
+    frames: Dict[str, np.ndarray] = {}
+    for _ in range(n_frames):
+        (name_len,) = _FRAME_HEAD.unpack(r.take(_FRAME_HEAD.size, "frame name length"))
+        name = r.take(name_len, "frame name").decode("utf-8")
+        if name in frames:
+            raise WireError(f"duplicate frame {name!r}")
+        code, ndim = _FRAME_TAG.unpack(r.take(_FRAME_TAG.size, "frame tag"))
+        if code >= len(_DTYPE_CODES):
+            raise WireError(f"unknown dtype code {code} (frame {name!r})")
+        if ndim > MAX_NDIM:
+            raise WireError(f"frame {name!r} rank {ndim} exceeds {MAX_NDIM}")
+        shape = tuple(
+            _DIM.unpack(r.take(_DIM.size, f"{name} dim"))[0] for _ in range(ndim)
+        )
+        (paylen,) = _PAYLEN.unpack(r.take(_PAYLEN.size, "payload length"))
+        dtype = _DTYPE_CODES[code]
+        # exact Python-int arithmetic: an np.prod here would wrap on
+        # crafted u32 dims and let paylen=0 sail past the check
+        want = dtype.itemsize
+        for d in shape:
+            want *= int(d)
+        if paylen != want:
+            raise WireError(
+                f"frame {name!r}: payload {paylen} bytes != shape "
+                f"{shape} x {dtype} = {want}"
+            )
+        payload = r.take(paylen, f"{name} payload")
+        frames[name] = np.frombuffer(payload, dtype).reshape(shape)
+    if r.pos != len(r.data):
+        raise WireError(f"{len(r.data) - r.pos} trailing bytes after last frame")
+    return kind, frames
+
+
+# ---------------------------------------------------------------------------
+# PackedCluster / PackedDelta messages
+
+# the wire dtype contract per tensor field — the same pack contract the
+# PackedCluster docstring pins; the decoder REJECTS a frame whose dtype
+# disagrees instead of silently casting (a u8-cast bool mask would solve
+# the wrong problem without erroring anywhere downstream)
+_PACKED_DTYPES = {
+    "slot_req": np.dtype("<f4"),
+    "slot_valid": np.dtype("?"),
+    "slot_tol": np.dtype("<u4"),
+    "slot_aff": np.dtype("<u4"),
+    "cand_valid": np.dtype("?"),
+    "spot_free": np.dtype("<f4"),
+    "spot_count": np.dtype("<i4"),
+    "spot_max_pods": np.dtype("<i4"),
+    "spot_taints": np.dtype("<u4"),
+    "spot_ok": np.dtype("?"),
+    "spot_aff": np.dtype("<u4"),
+}
+
+_DELTA_DTYPES = {
+    "lanes": np.dtype("<i4"),
+    "lane_slot_req": np.dtype("<f4"),
+    "lane_slot_valid": np.dtype("?"),
+    "lane_slot_tol": np.dtype("<u4"),
+    "lane_slot_aff": np.dtype("<u4"),
+    "cand_rows": np.dtype("<i4"),
+    "cand_valid": np.dtype("?"),
+    "spot_rows": np.dtype("<i4"),
+    "spot_free": np.dtype("<f4"),
+    "spot_count": np.dtype("<i4"),
+    "spot_max_pods": np.dtype("<i4"),
+    "spot_taints": np.dtype("<u4"),
+    "spot_ok": np.dtype("?"),
+    "spot_aff": np.dtype("<u4"),
+}
+
+_PACKED_RANKS = {
+    "slot_req": 3, "slot_valid": 2, "slot_tol": 3, "slot_aff": 3,
+    "cand_valid": 1, "spot_free": 2, "spot_count": 1, "spot_max_pods": 1,
+    "spot_taints": 2, "spot_ok": 1, "spot_aff": 2,
+}
+
+
+def _str_frame(s: str) -> np.ndarray:
+    return np.frombuffer(s.encode("utf-8"), np.uint8)
+
+
+def _frame_str(arr: np.ndarray, what: str) -> str:
+    try:
+        return bytes(np.asarray(arr, np.uint8)).decode("utf-8")
+    except UnicodeDecodeError as err:
+        raise WireError(f"{what} is not valid utf-8: {err}") from err
+
+
+def encode_plan_request(tenant: str, packed) -> bytes:
+    """Agent -> service: one tenant's full packed problem."""
+    frames: List[Tuple[str, np.ndarray]] = [("tenant", _str_frame(tenant))]
+    frames.extend((f, getattr(packed, f)) for f in type(packed)._fields)
+    return encode_frames(KIND_PLAN_REQUEST, frames)
+
+
+def _check_tensor_fields(frames, dtypes, ranks, what):
+    out = {}
+    for name, dtype in dtypes.items():
+        arr = frames.get(name)
+        if arr is None:
+            raise WireError(f"{what} missing tensor frame {name!r}")
+        if arr.dtype != dtype:
+            raise WireError(
+                f"{what} frame {name!r}: dtype {arr.dtype} != contract {dtype}"
+            )
+        rank = ranks.get(name)
+        if rank is not None and arr.ndim != rank:
+            raise WireError(
+                f"{what} frame {name!r}: rank {arr.ndim} != contract {rank}"
+            )
+        out[name] = arr
+    return out
+
+
+def decode_plan_request(data: bytes):
+    """(tenant, PackedCluster) from KIND_PLAN_REQUEST bytes; every
+    tensor's dtype and rank is checked against the pack contract, and
+    the cross-field shape consistency (shared C/K/S/R/W/A dims) is
+    verified — a request that decodes is safe to pad, stack and solve."""
+    from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+
+    kind, frames = decode_frames(data)
+    if kind != KIND_PLAN_REQUEST:
+        raise WireError(f"expected PLAN_REQUEST, got kind {kind}")
+    tenant = _frame_str(frames.get("tenant", np.zeros(0, np.uint8)), "tenant id")
+    if not tenant:
+        raise WireError("plan request carries no tenant id")
+    t = _check_tensor_fields(frames, _PACKED_DTYPES, _PACKED_RANKS, "plan request")
+    C, K, R = t["slot_req"].shape
+    S = t["spot_free"].shape[0]
+    W = t["spot_taints"].shape[1]
+    A = t["spot_aff"].shape[1]
+    expect = {
+        "slot_valid": (C, K), "slot_tol": (C, K, W), "slot_aff": (C, K, A),
+        "cand_valid": (C,), "spot_free": (S, R), "spot_count": (S,),
+        "spot_max_pods": (S,), "spot_taints": (S, W), "spot_ok": (S,),
+        "spot_aff": (S, A),
+    }
+    for name, shape in expect.items():
+        if t[name].shape != shape:
+            raise WireError(
+                f"plan request frame {name!r}: shape {t[name].shape} "
+                f"inconsistent with (C={C}, K={K}, S={S}, R={R}, W={W}, "
+                f"A={A}) — expected {shape}"
+            )
+    return tenant, PackedCluster(**t)
+
+
+def encode_packed_delta(tenant: str, delta) -> bytes:
+    """Agent -> service: a churn-proportional PackedDelta (the wire
+    twin of the device-resident scatter path; a future delta-shipping
+    agent sends this instead of the full pack when shapes are stable)."""
+    frames: List[Tuple[str, np.ndarray]] = [("tenant", _str_frame(tenant))]
+    frames.extend((f, getattr(delta, f)) for f in type(delta)._fields)
+    return encode_frames(KIND_PACKED_DELTA, frames)
+
+
+def decode_packed_delta(data: bytes):
+    """(tenant, PackedDelta) from KIND_PACKED_DELTA bytes."""
+    from k8s_spot_rescheduler_tpu.models.columnar import PackedDelta
+
+    kind, frames = decode_frames(data)
+    if kind != KIND_PACKED_DELTA:
+        raise WireError(f"expected PACKED_DELTA, got kind {kind}")
+    tenant = _frame_str(frames.get("tenant", np.zeros(0, np.uint8)), "tenant id")
+    if not tenant:
+        raise WireError("packed delta carries no tenant id")
+    t = _check_tensor_fields(frames, _DELTA_DTYPES, {}, "packed delta")
+    for sec in (
+        ("lanes", "lane_slot_req", "lane_slot_valid", "lane_slot_tol",
+         "lane_slot_aff"),
+        ("cand_rows", "cand_valid"),
+        ("spot_rows", "spot_free", "spot_count", "spot_max_pods",
+         "spot_taints", "spot_ok", "spot_aff"),
+    ):
+        n = t[sec[0]].shape[0]
+        for name in sec[1:]:
+            if t[name].shape[0] != n:
+                raise WireError(
+                    f"packed delta frame {name!r}: leading dim "
+                    f"{t[name].shape[0]} != section length {n}"
+                )
+    return tenant, PackedDelta(**t)
+
+
+# ---------------------------------------------------------------------------
+# plan reply
+
+class PlanReply(NamedTuple):
+    """The selection + batch telemetry one plan request gets back —
+    deliberately the same few hundred bytes the in-process device
+    boundary fetches (solver/select.Selection), plus what the agent's
+    metrics need to see about the batch it rode in."""
+
+    found: bool
+    index: int
+    n_feasible: int
+    row: np.ndarray  # int32 [K]
+    solve_ms: float  # the batched device solve, amortized share
+    queue_wait_ms: float  # this request's time in the tenant queue
+    batch_lanes: int  # candidate lanes in the batch it rode in
+    batch_tenants: int  # tenant lane-blocks sharing that batch
+
+
+def encode_plan_reply(reply: PlanReply) -> bytes:
+    frames = [
+        ("found", np.array([reply.found], np.uint8)),
+        ("index", np.array([reply.index], "<i4")),
+        ("n_feasible", np.array([reply.n_feasible], "<i4")),
+        ("row", np.ascontiguousarray(np.asarray(reply.row, "<i4"))),
+        ("solve_ms", np.array([reply.solve_ms], "<f4")),
+        ("queue_wait_ms", np.array([reply.queue_wait_ms], "<f4")),
+        ("batch_lanes", np.array([reply.batch_lanes], "<i4")),
+        ("batch_tenants", np.array([reply.batch_tenants], "<i4")),
+    ]
+    return encode_frames(KIND_PLAN_REPLY, frames)
+
+
+def _scalar(frames, name, dtype, what):
+    arr = frames.get(name)
+    if arr is None or arr.dtype != np.dtype(dtype) or arr.size != 1:
+        raise WireError(f"{what} frame {name!r} missing or malformed")
+    return arr.reshape(())[()]
+
+
+def decode_plan_reply(data: bytes) -> PlanReply:
+    kind, frames = decode_frames(data)
+    if kind == KIND_ERROR:
+        raise WireError(
+            "service error: "
+            + _frame_str(frames.get("message", np.zeros(0, np.uint8)), "error")
+        )
+    if kind != KIND_PLAN_REPLY:
+        raise WireError(f"expected PLAN_REPLY, got kind {kind}")
+    row = frames.get("row")
+    if row is None or row.dtype != np.dtype("<i4") or row.ndim != 1:
+        raise WireError("plan reply frame 'row' missing or malformed")
+    return PlanReply(
+        found=bool(_scalar(frames, "found", "u1", "plan reply")),
+        index=int(_scalar(frames, "index", "<i4", "plan reply")),
+        n_feasible=int(_scalar(frames, "n_feasible", "<i4", "plan reply")),
+        row=row,
+        solve_ms=float(_scalar(frames, "solve_ms", "<f4", "plan reply")),
+        queue_wait_ms=float(
+            _scalar(frames, "queue_wait_ms", "<f4", "plan reply")
+        ),
+        batch_lanes=int(_scalar(frames, "batch_lanes", "<i4", "plan reply")),
+        batch_tenants=int(
+            _scalar(frames, "batch_tenants", "<i4", "plan reply")
+        ),
+    )
+
+
+def encode_error(message: str) -> bytes:
+    """In-protocol error body (rides under an HTTP error status so
+    binary clients never have to sniff JSON out of an octet stream)."""
+    return encode_frames(KIND_ERROR, [("message", _str_frame(message))])

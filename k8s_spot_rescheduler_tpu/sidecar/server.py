@@ -1,12 +1,10 @@
-"""Planner sidecar: the solver behind a JSON/HTTP service boundary.
+"""Planner sidecar: the JSON/HTTP face of the multi-tenant service.
 
 BASELINE.json's north star splits control loop and solver across a
 process boundary ("the Go side calls a gRPC/JAX sidecar") so an existing
 controller — including the Go reference itself — can delegate only the
 per-tick drain *plan* to the TPU while keeping its own eviction path.
-This is that boundary: POST a cluster snapshot in Kubernetes API shapes
-(the same objects the controller already holds), get back the drain
-decision.
+This module keeps that JSON boundary:
 
     POST /v1/plan
       {"nodes": [<k8s Node>...], "pods": [<k8s Pod>...],
@@ -14,50 +12,49 @@ decision.
        "pvcs": [<k8s PVC>...], "pvs": [<k8s PV>...]}   # optional
     → {"found": true, "node": "od-17", "pods": [...],
        "assignments": {"ns/pod": "spot-3", ...},
-       "nCandidates": 2500, "nFeasible": 856, "solveMs": 66.2}
+       "nCandidates": 2500, "nFeasible": 856, "solveMs": 1.2,
+       "batchLanes": 24, "batchTenants": 3}
 
     PVC/PV sections are optional: with them, PVC-backed pods resolve
     their volume topology (models/volumes.py) exactly as the in-process
     loop does; without them such pods stay conservatively unplaceable.
 
-    GET /healthz → {"ok": true, "solver": "pallas"}
+    GET /healthz → {"ok": true, "solver": "pallas",
+                    "queue_depth": 0, "bucket_occupancy": {...},
+                    "tenant_last_plan_age_s": {...},
+                    "batch_cadence_s": 0.004, ...}
 
-One SolverPlanner lives for the process lifetime, so jit caches and the
-high-water-mark padding survive across requests — a steady stream of
-plans never recompiles.
+Since the multi-tenant promotion (service/server.py), the sidecar IS the
+planner service: ``PlannerSidecar`` is the service's HTTP server with
+the historical constructor surface (``busy_timeout_s`` maps onto the
+queue's bounded wait). The one-solve-at-a-time lock is gone — /v1/plan
+requests decode, pack and ride the SAME batching queue as the binary
+``/v2/plan`` tenants, so there is exactly one solve path and JSON
+callers co-batch with wire-protocol agents. Consequences visible at
+this boundary:
+
+- a request that cannot be batched within ``busy_timeout_s`` gets 503
+  with ``Retry-After`` derived from the MEASURED batch cadence (how
+  long until a batch slot actually frees), not the static timeout;
+- ``max_inflight``/``max_body_bytes`` keep their pre-body-read
+  rejection semantics (a burst holds at most max_inflight bodies);
+- jit caches and shape-bucket compiles live for the process lifetime —
+  a steady stream of plans never recompiles.
 """
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
-from k8s_spot_rescheduler_tpu.io.kube import decode_node, decode_pdb, decode_pod
-from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
-from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+from k8s_spot_rescheduler_tpu.service.server import ServiceServer
+from k8s_spot_rescheduler_tpu.utils.clock import Clock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
 
 
-class PlannerSidecar:
-    """Deployable solver service (deploy/sidecar.yaml ships it), so its
-    edges are bounded:
-
-    - ``max_body_bytes`` caps the snapshot size (413 beyond it; a 50k-pod
-      cluster LIST is ~30 MB, so the default leaves ample headroom while
-      keeping a misdirected upload from exhausting memory);
-    - one solve runs at a time (jit caches are per-process; concurrent
-      tracing would thrash them); a request whose turn has not come
-      within ``busy_timeout_s`` gets 503 + Retry-After. The solve itself
-      is not interruptible (an XLA dispatch cannot be safely cancelled
-      mid-flight), so the busy timeout is the deadline knob;
-    - ``max_inflight`` caps queue DEPTH: past it, /v1/plan returns 503
-      immediately — before the body is even read — so a burst cannot
-      hold more than max_inflight x max_body_bytes of request memory
-      (ThreadingHTTPServer is thread-per-request; the busy timeout
-      alone only capped queue *time*).
-    """
+class PlannerSidecar(ServiceServer):
+    """Deployable solver service (deploy/sidecar.yaml ships it). The
+    historical single-tenant surface over the multi-tenant core."""
 
     def __init__(
         self,
@@ -67,208 +64,27 @@ class PlannerSidecar:
         max_body_bytes: int = 128 << 20,
         busy_timeout_s: float = 30.0,
         max_inflight: int = 4,
+        batch_window_s: Optional[float] = None,
+        clock: Optional[Clock] = None,
     ):
-        self.config = config
-        self.planner = SolverPlanner(config)
-        self.max_body_bytes = int(max_body_bytes)
-        self.busy_timeout_s = float(busy_timeout_s)
-        self.max_inflight = int(max_inflight)
-        self._lock = threading.Lock()  # one solve at a time; jit is cached
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
-        host, _, port = address.rpartition(":")
-        sidecar = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _send(self, obj, code=200, headers=()):
-                data = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in headers:
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(data)
-
-            def do_GET(self):
-                if self.path == "/healthz":
-                    # merge the control loop's degradation state
-                    # (loop/health.py): when a controller shares this
-                    # process, a liveness probe here sees planner
-                    # fallback / breaker status and the age of the last
-                    # completed tick without scraping Prometheus
-                    from k8s_spot_rescheduler_tpu.loop import health
-
-                    out = {"ok": True, "solver": sidecar.config.solver}
-                    out.update(health.snapshot())
-                    return self._send(out)
-                return self._send({"error": "not found"}, 404)
-
-            def _reject_unread(self, obj, code, headers=()):
-                """A response sent BEFORE the body was read must close
-                the connection: under keep-alive the unconsumed body
-                bytes would desync the next request on this socket
-                (advisor r4; harmless today with HTTP/1.0
-                close-per-request, load-bearing the day
-                protocol_version is raised). Applies to every pre-read
-                reject — 400/404/413/503 alike."""
-                self.close_connection = True
-                return self._send(
-                    obj, code,
-                    headers=tuple(headers) + (("Connection", "close"),),
-                )
-
-            def do_POST(self):
-                if self.path != "/v1/plan":
-                    return self._reject_unread({"error": "not found"}, 404)
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                except ValueError:
-                    return self._reject_unread(
-                        {"error": "bad Content-Length"}, 400
-                    )
-                if length < 0:
-                    # a negative length must not reach rfile.read(-1),
-                    # which would buffer the stream until EOF — the exact
-                    # exhaustion the size cap exists to prevent
-                    return self._reject_unread(
-                        {"error": "bad Content-Length"}, 400
-                    )
-                if length > sidecar.max_body_bytes:
-                    return self._reject_unread(
-                        {
-                            "error": "snapshot exceeds %d-byte limit"
-                            % sidecar.max_body_bytes
-                        },
-                        413,
-                    )
-                # depth guard BEFORE the body read: a rejected request
-                # never buffers its payload, so a burst holds at most
-                # max_inflight parsed bodies regardless of its size
-                if not sidecar._admit():
-                    return self._reject_unread(
-                        {
-                            "error": "planner overloaded (%d requests in "
-                            "flight)" % sidecar.max_inflight
-                        },
-                        503,
-                        headers=[("Retry-After", "1")],
-                    )
-                try:
-                    try:
-                        body = json.loads(self.rfile.read(length))
-                    except ValueError as err:
-                        return self._send({"error": str(err)}, 400)
-                    if not sidecar._lock.acquire(
-                        timeout=sidecar.busy_timeout_s
-                    ):
-                        return self._send(
-                            {"error": "planner busy (solve in progress)"},
-                            503,
-                            headers=[("Retry-After", "1")],
-                        )
-                    try:
-                        result = sidecar.plan_locked(body)
-                    except (ValueError, KeyError) as err:
-                        return self._send({"error": str(err)}, 400)
-                    except Exception as err:  # noqa: BLE001 — solver failure
-                        log.error("sidecar plan failed: %s", err)
-                        return self._send({"error": str(err)}, 500)
-                    finally:
-                        sidecar._lock.release()
-                    return self._send(result)
-                finally:
-                    sidecar._release()
-
-        self.server = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
-
-    def _admit(self) -> bool:
-        with self._inflight_lock:
-            if self._inflight >= self.max_inflight:
-                return False
-            self._inflight += 1
-            return True
-
-    def _release(self) -> None:
-        with self._inflight_lock:
-            self._inflight -= 1
-
-    @property
-    def address(self) -> str:
-        host, port = self.server.server_address
-        return f"{host}:{port}"
+        super().__init__(
+            config,
+            address,
+            max_body_bytes=max_body_bytes,
+            queue_timeout_s=busy_timeout_s,
+            max_inflight=max_inflight,
+            batch_window_s=batch_window_s,
+            clock=clock,
+        )
 
     def plan(self, body: dict) -> dict:
-        """Decode + solve, serialized on the sidecar lock (public entry
-        for in-process callers; the HTTP handler holds the lock already
-        and calls plan_locked)."""
-        if not self._lock.acquire(timeout=self.busy_timeout_s):
-            raise TimeoutError("planner busy (solve in progress)")
-        try:
-            return self.plan_locked(body)
-        finally:
-            self._lock.release()
-
-    def plan_locked(self, body: dict) -> dict:
-        nodes = [decode_node(o) for o in body.get("nodes", [])]
-        pods = [decode_pod(o) for o in body.get("pods", [])]
-        pdbs = [decode_pdb(o) for o in body.get("pdbs", [])]
-        pvc_objs = body.get("pvcs") or []
-        pv_objs = body.get("pvs") or []
-        if pvc_objs or pv_objs:
-            from k8s_spot_rescheduler_tpu.io.kube import (
-                decode_volume_snapshots,
-            )
-            from k8s_spot_rescheduler_tpu.models.volumes import (
-                resolve_volume_affinity,
-            )
-
-            pvcs, pvs = decode_volume_snapshots(pvc_objs, pv_objs)
-            pods = [
-                resolve_volume_affinity(p, pvcs, pvs)
-                if p.pvc_resolvable
-                else p
-                for p in pods
-            ]
-        pods_by_node: dict = {}
-        for pod in pods:
-            pods_by_node.setdefault(pod.node_name, []).append(pod)
-        node_map = build_node_map(
-            [n for n in nodes if n.ready],
-            pods_by_node,
-            on_demand_label=self.config.on_demand_node_label,
-            spot_label=self.config.spot_node_label,
-            priority_threshold=self.config.priority_threshold,
-            # not-ready nodes are presence-only (zone/spread counts) —
-            # dropping them would overstate the spread domain-min, the
-            # permissive direction (same rule as the control loop)
-            unready_nodes=[n for n in nodes if not n.ready],
-        )
-        report = self.planner.plan(node_map, pdbs)
-        out = {
-            "found": report.plan is not None,
-            "nCandidates": report.n_candidates,
-            "nFeasible": report.n_feasible,
-            "solveMs": round(report.solve_seconds * 1e3, 3),
-        }
-        if report.plan is not None:
-            out["node"] = report.plan.node.node.name
-            out["pods"] = [p.uid for p in report.plan.pods]
-            out["assignments"] = report.plan.assignments
-        return out
+        """Decode + pack + solve through the batching queue (public
+        entry for in-process callers; HTTP callers use /v1/plan)."""
+        return self.plan_json(body)
 
     def serve_forever(self) -> None:
         log.info("planner sidecar listening on %s", self.address)
-        self.server.serve_forever()
-
-    def start_background(self) -> None:
-        threading.Thread(target=self.server.serve_forever, daemon=True).start()
-
-    def close(self) -> None:
-        self.server.shutdown()
+        super().serve_forever()
 
 
 def main(argv=None) -> int:
@@ -281,8 +97,9 @@ def main(argv=None) -> int:
     ap.add_argument("--max-body-mb", type=int, default=128,
                     help="reject /v1/plan snapshots larger than this (413)")
     ap.add_argument("--busy-timeout", type=float, default=30.0,
-                    help="seconds a request may wait for the in-flight "
-                         "solve before 503 (backpressure, not queueing)")
+                    help="seconds a request may wait in the batching "
+                         "queue before 503 (backpressure; Retry-After "
+                         "reports the measured batch cadence)")
     ap.add_argument("--max-inflight", type=int, default=4,
                     help="reject /v1/plan immediately (503) past this many "
                          "concurrent requests — bounds worst-case request "

@@ -76,3 +76,41 @@ def plan_oracle(packed: PackedCluster, best_fit: bool = False) -> SolveResult:
             assign[c] = -1  # revert (rescheduler.go:273)
 
     return SolveResult(feasible=feasible, assignment=assign)
+
+
+def plan_union_oracle(
+    packed: PackedCluster,
+    *,
+    best_fit_fallback: bool = True,
+    repair_rounds: int = 0,
+) -> SolveResult:
+    """The host-side union composition — first-fit ∪ best-fit ∪ repair,
+    mirroring the device path's ``lax.cond`` gating (solver/fallback.py:
+    later passes are consumed only for lanes the earlier ones failed).
+    The ONE host union: SolverPlanner's numpy branch and the planner
+    service's host batch path both call this, so the two cannot drift."""
+    result = plan_oracle(packed)
+    if best_fit_fallback:
+        bf = plan_oracle(packed, best_fit=True)
+        result = SolveResult(
+            feasible=result.feasible | bf.feasible,
+            assignment=np.where(
+                result.feasible[:, None], result.assignment, bf.assignment
+            ),
+        )
+        need_repair = bool(
+            np.any(np.asarray(packed.cand_valid) & ~result.feasible)
+        )
+        if repair_rounds > 0 and need_repair:
+            from k8s_spot_rescheduler_tpu.solver.repair import (
+                plan_repair_oracle,
+            )
+
+            rp = plan_repair_oracle(packed, rounds=repair_rounds)
+            result = SolveResult(
+                feasible=result.feasible | rp.feasible,
+                assignment=np.where(
+                    result.feasible[:, None], result.assignment, rp.assignment
+                ),
+            )
+    return result

@@ -27,6 +27,26 @@ class Selection(NamedTuple):
     row: np.ndarray  # int32 [K] spot assignment of that lane
 
 
+def selection_vector(solve_fn, packed):
+    """Solve + select, traced: the int32 vector [idx, found, n_feasible,
+    row...] a single host fetch decodes (``decode_selection``). Shared
+    by the in-process fused planner below and the multi-tenant batched
+    program (parallel/tenant_batch.py), so the two paths cannot drift."""
+    res = solve_fn(packed)
+    feasible = res.feasible
+    # candidates are pre-sorted least-requested-first, so argmax of the
+    # boolean mask IS the reference's drain choice
+    idx = jnp.argmax(feasible).astype(jnp.int32)
+    return jnp.concatenate(
+        [
+            idx[None],
+            jnp.any(feasible).astype(jnp.int32)[None],
+            feasible.sum().astype(jnp.int32)[None],
+            res.assignment[idx].astype(jnp.int32),
+        ]
+    )
+
+
 def make_fused_planner(solve_fn):
     """Wrap a PackedCluster->SolveResult solver into a jitted function
     returning one int32 vector [idx, found, n_feasible, row...]; decode
@@ -34,19 +54,7 @@ def make_fused_planner(solve_fn):
 
     @jax.jit
     def fused(packed):
-        res = solve_fn(packed)
-        feasible = res.feasible
-        # candidates are pre-sorted least-requested-first, so argmax of the
-        # boolean mask IS the reference's drain choice
-        idx = jnp.argmax(feasible).astype(jnp.int32)
-        return jnp.concatenate(
-            [
-                idx[None],
-                jnp.any(feasible).astype(jnp.int32)[None],
-                feasible.sum().astype(jnp.int32)[None],
-                res.assignment[idx].astype(jnp.int32),
-            ]
-        )
+        return selection_vector(solve_fn, packed)
 
     return fused
 

@@ -172,6 +172,26 @@ class ReschedulerConfig:
     # direct apiserver LIST, or skips the tick (feeding the circuit
     # breaker) when no direct path exists. 0 disables the gate.
     mirror_staleness_budget: float = 60.0
+    # --- multi-tenant planner service (service/, docs/DESIGN.md §11) ---
+    # Agent mode: plan through a remote planner service instead of the
+    # in-process solver. The per-cluster agent keeps observe/pack/
+    # actuate local (chaos-hardened, PR 4) and ships only packed
+    # tensors over the binary wire protocol (service/wire.py); on
+    # service failure it degrades through the numpy-oracle fallback +
+    # circuit breaker (remote_planner_fallback_total). Empty = plan
+    # in-process (the reference topology).
+    planner_url: str = ""
+    # Per-plan HTTP deadline of the agent's service call; past it the
+    # tick falls back locally rather than stall the control loop.
+    planner_timeout: float = 10.0
+    # Service batching window: how long the scheduler waits after work
+    # arrives to coalesce concurrent tenants into one batched solve.
+    # 0 = dispatch immediately (every request solves alone).
+    service_batch_window: float = 0.02
+    # Bounded queue wait: a plan request still unbatched past this is
+    # evicted with 503 + Retry-After derived from the measured batch
+    # cadence (service_tenant_evictions_total, per tenant).
+    service_queue_timeout: float = 30.0
     # Anti-entropy resync audit (io/watch.py): every interval, one
     # LIST per watched resource is diffed field-by-field against the
     # incremental mirror; drift forces a store replace + full repack
@@ -209,6 +229,14 @@ class ReschedulerConfig:
             )
         if self.resync_interval < 0:
             raise ValueError("resync_interval must be >= 0 (0 = off)")
+        if self.planner_timeout <= 0:
+            raise ValueError("planner_timeout must be > 0")
+        if self.service_batch_window < 0:
+            raise ValueError(
+                "service_batch_window must be >= 0 (0 = no coalescing)"
+            )
+        if self.service_queue_timeout <= 0:
+            raise ValueError("service_queue_timeout must be > 0")
         if not 0.0 <= self.chaos_watch_stall_rate <= 1.0:
             raise ValueError(
                 "chaos_watch_stall_rate must be a probability in [0, 1]"

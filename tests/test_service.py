@@ -1,0 +1,516 @@
+"""Multi-tenant planner service tests: queue/batch/fairness mechanics
+(service/server.py), bucket policy (service/buckets.py) and the agent's
+degradation ladder (service/agent.py RemotePlanner).
+
+The JSON sidecar boundary is covered in tests/test_sidecar.py; the
+wire-format byte goldens in tests/test_wire_fixtures.py; the
+bit-identical-to-solo acceptance runs as ``make serve-smoke``
+(bench.serve_smoke, reused by the acceptance test at the bottom)."""
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.service import buckets as bucketing
+from k8s_spot_rescheduler_tpu.service import wire
+from k8s_spot_rescheduler_tpu.service.server import (
+    PlannerService,
+    ServiceBusy,
+    ServiceServer,
+)
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from k8s_spot_rescheduler_tpu.utils.durations import parse_duration
+
+
+def tiny_packed(n_lanes: int = 2, seed: int = 0) -> PackedCluster:
+    """A minimal consistent problem: C=2 lanes, K=2 slots, S=2 spots.
+    ``n_lanes`` valid lanes (DRR cost); values vary with ``seed`` so
+    distinct requests are distinct tensors."""
+    rng = np.random.default_rng(seed)
+    C, K, S, R, W, A = 2, 2, 2, 2, 1, 2
+    return PackedCluster(
+        slot_req=rng.random((C, K, R), np.float32),
+        slot_valid=np.ones((C, K), bool),
+        slot_tol=np.zeros((C, K, W), np.uint32),
+        slot_aff=np.zeros((C, K, A), np.uint32),
+        cand_valid=np.arange(C) < n_lanes,
+        spot_free=np.full((S, R), 100.0, np.float32),
+        spot_count=np.zeros(S, np.int32),
+        spot_max_pods=np.full(S, 58, np.int32),
+        spot_taints=np.zeros((S, W), np.uint32),
+        spot_ok=np.ones(S, bool),
+        spot_aff=np.zeros((S, A), np.uint32),
+    )
+
+
+def _stub_solve(record=None):
+    def solve(stacked, reqs):
+        if record is not None:
+            record.append([r.tenant for r in reqs])
+        T = stacked.slot_req.shape[0]
+        K = stacked.slot_req.shape[2]
+        return np.zeros((T, 3 + K), np.int32)
+
+    return solve
+
+
+def _service(clock=None, **kwargs) -> PlannerService:
+    return PlannerService(
+        ReschedulerConfig(solver="numpy"),
+        clock=clock or FakeClock(),
+        batch_window_s=0,
+        **kwargs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# buckets
+
+
+def test_bucket_rounding_and_padding_semantics():
+    packed = tiny_packed()
+    b = bucketing.bucket_for(packed)
+    # powers of two with the sublane floor
+    assert (b.C, b.K, b.S) == (8, 8, 8)
+    assert (b.R, b.W, b.A) == (2, 1, 2)
+    padded = bucketing.pad_to_bucket(packed, b)
+    assert padded.slot_req.shape == (8, 8, 2)
+    assert padded.spot_free.shape == (8, 2)
+    # pads are inert: invalid lanes, empty slots, not-ok zero-cap spots
+    assert not padded.cand_valid[2:].any()
+    assert not padded.slot_valid[:, 2:].any()
+    assert not padded.spot_ok[2:].any()
+    assert not padded.spot_free[2:].any()
+    # the original problem survives verbatim in the prefix
+    np.testing.assert_array_equal(padded.slot_req[:2, :2], packed.slot_req)
+    # a problem from another shape family is refused, not mis-padded
+    with pytest.raises(ValueError):
+        bucketing.pad_to_bucket(
+            packed._replace(spot_aff=np.zeros((2, 3), np.uint32)), b
+        )
+
+
+def test_bucket_batch_cap_tracks_hbm_estimate():
+    b = bucketing.Bucket(C=256, K=32, S=256, R=4, W=2, A=2)
+    per = bucketing.per_tenant_hbm_bytes(b)
+    assert bucketing.max_batch_tenants(b, budget_bytes=10 * per) == 10
+    # never zero: a lone over-budget tenant is the auto-shard tiers'
+    # problem, not the batcher's
+    assert bucketing.max_batch_tenants(b, budget_bytes=per // 2) == 1
+    # and capped, so worst-case batch latency stays bounded
+    assert bucketing.max_batch_tenants(b, budget_bytes=10**18) == 64
+
+
+# ---------------------------------------------------------------------------
+# queue + DRR fairness
+
+
+def test_flooding_tenant_cannot_starve_another():
+    """The fairness acceptance: tenant A floods 20 requests, tenant B
+    submits one — B's request rides the VERY NEXT batch (bounded by one
+    batch interval), because each DRR pass offers every tenant a slot
+    before revisiting anyone."""
+    clock = FakeClock()
+    svc = _service(clock, max_batch_tenants=2)
+    batches = []
+    svc.solve_hook = _stub_solve(batches)
+    for i in range(20):
+        svc.submit_nowait("flooder", tiny_packed(seed=i))
+    b_req = svc.submit_nowait("victim", tiny_packed(seed=99))
+    assert svc.drain_once()
+    # first batch: one from each tenant, NOT two from the flooder
+    assert batches[0] == ["flooder", "victim"]
+    assert b_req.event.is_set() and b_req.reply is not None
+    assert b_req.reply.batch_tenants == 2
+    # the flood then drains alone
+    while svc.drain_once():
+        pass
+    assert all(t == ["flooder"] for t in [b[:1] for b in batches[1:]])
+    assert svc.queue_depth() == 0
+
+
+def test_drr_interleaves_within_batch_capacity():
+    """With room for 6, three tenants' floods interleave one request per
+    tenant per pass — not tenant-by-tenant fills."""
+    clock = FakeClock()
+    svc = _service(clock, max_batch_tenants=6)
+    batches = []
+    svc.solve_hook = _stub_solve(batches)
+    for tenant in ("a", "b", "c"):
+        for i in range(3):
+            svc.submit_nowait(tenant, tiny_packed(seed=i))
+    assert svc.drain_once()
+    assert batches[0][:3] == ["a", "b", "c"]  # first pass: one each
+    assert sorted(batches[0]) == ["a", "a", "b", "b", "c", "c"]
+
+
+def test_batch_picks_oldest_request_bucket():
+    """Bounded wait beats throughput: the batch solves the bucket of
+    the OLDEST waiting request, even when a newer bucket has more
+    tenants queued."""
+    clock = FakeClock()
+    svc = _service(clock, max_batch_tenants=8)
+    batches = []
+    svc.solve_hook = _stub_solve(batches)
+    big = tiny_packed()._replace(
+        slot_req=np.zeros((20, 2, 2), np.float32),
+        slot_valid=np.ones((20, 2), bool),
+        slot_tol=np.zeros((20, 2, 1), np.uint32),
+        slot_aff=np.zeros((20, 2, 2), np.uint32),
+        cand_valid=np.ones(20, bool),
+    )
+    old = svc.submit_nowait("elder", big)  # bucket C=32
+    clock.advance(1.0)
+    for i in range(3):
+        svc.submit_nowait(f"t{i}", tiny_packed(seed=i))  # bucket C=8
+    assert svc.drain_once()
+    assert batches[0] == ["elder"]
+    assert old.event.is_set()
+
+
+def test_expired_request_is_evicted_with_cadence_retry_after():
+    """A request nobody batches within the queue timeout is evicted —
+    503 + Retry-After from the measured cadence — and counted per
+    tenant in service_tenant_evictions_total."""
+    clock = FakeClock()
+    svc = _service(clock)
+    svc.queue_timeout_s = 0.05
+    svc._cadence_s = 3.2
+    # a scheduler nominally exists but never drains (submit's inline
+    # drain is for scheduler-LESS in-process callers; here the queued
+    # request must genuinely rot)
+    svc._thread = object()
+    before = metrics.service_snapshot()["tenant_evictions"]
+    with pytest.raises(ServiceBusy) as err:
+        svc.submit("loner", tiny_packed())
+    assert err.value.retry_after == 4  # ceil(3.2)
+    assert metrics.service_snapshot()["tenant_evictions"] == before + 1
+    assert svc.queue_depth() == 0  # really evicted, not abandoned
+
+
+def test_client_deadline_bounds_server_wait():
+    """A client-declared deadline (the agent's X-Planner-Deadline)
+    tightens the server-side wait below service_queue_timeout: the
+    service must not keep solving for a caller that already hung up."""
+    import time
+
+    clock = FakeClock()
+    svc = _service(clock)  # queue_timeout stays the 30 s default
+    svc._thread = object()  # scheduler "exists" but never drains
+    t0 = time.monotonic()
+    with pytest.raises(ServiceBusy):
+        svc.submit("impatient", tiny_packed(), timeout_s=0.1)
+    assert time.monotonic() - t0 < 5.0  # the 0.1 s deadline, not 30 s
+
+
+def test_tenant_state_is_pruned():
+    """Tenant ids are client-supplied: the last-plan-age map (serialized
+    into every /healthz) drops entries past the TTL and hard-caps, and
+    an emptied tenant leaves no queue residue behind."""
+    from k8s_spot_rescheduler_tpu.service import server as srv
+
+    clock = FakeClock()
+    svc = _service(clock)
+    svc.solve_hook = _stub_solve()
+    for i in range(5):
+        svc.submit_nowait(f"churner-{i}", tiny_packed(seed=i))
+    while svc.drain_once():
+        pass
+    assert len(svc._last_plan_wall) == 5
+    assert svc._queues == {}  # emptied tenants fully pruned
+    # a batch far in the future prunes everything past the TTL
+    clock.advance(srv.TENANT_STATE_TTL_S + 10)
+    svc.submit_nowait("fresh", tiny_packed())
+    assert svc.drain_once()
+    assert set(svc._last_plan_wall) == {"fresh"}
+
+
+def test_solve_failure_contained_per_batch():
+    """A solve exception fails THAT batch's requests with a typed error;
+    the service survives and the next batch solves normally."""
+    clock = FakeClock()
+    svc = _service(clock)
+
+    def exploding(stacked, reqs):
+        raise RuntimeError("device fell over")
+
+    svc.solve_hook = exploding
+    req = svc.submit_nowait("t", tiny_packed())
+    assert svc.drain_once()
+    assert req.error is not None and "device fell over" in str(req.error)
+    svc.solve_hook = _stub_solve()
+    req2 = svc.submit_nowait("t", tiny_packed())
+    assert svc.drain_once()
+    assert req2.reply is not None
+
+
+def test_mesh_batch_pads_tenants_and_matches_single_device():
+    """On a multi-device backend (conftest forces 8 virtual CPU
+    devices) the service pads the tenant axis to a device multiple so
+    the batch SHARDS over the tenant mesh — and the sharded results are
+    identical to the plain single-device vmap program, row for row."""
+    import jax
+
+    if len(jax.devices()) <= 1:
+        pytest.skip("needs >1 device")
+    from k8s_spot_rescheduler_tpu.parallel.tenant_batch import (
+        make_tenant_batch_planner,
+    )
+
+    svc = PlannerService(
+        ReschedulerConfig(solver="jax"), clock=FakeClock(), batch_window_s=0
+    )
+    packs = [tiny_packed(seed=i) for i in range(3)]  # 3 % 8 != 0
+    b = bucketing.bucket_for(packs[0])
+    stacked = bucketing.stack_bucket(
+        [bucketing.pad_to_bucket(p, b) for p in packs], b
+    )
+    out = svc._solve(stacked)
+    assert svc._mesh is not None  # the mesh path really engaged
+    assert out.shape[0] == 3  # pad tenants trimmed back off
+    ref = np.asarray(make_tenant_batch_planner(None, rounds=8)(stacked))
+    np.testing.assert_array_equal(out, ref)
+
+
+# ---------------------------------------------------------------------------
+# HTTP wire surface
+
+
+def _wire_post(address, body, timeout=30):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{address}/v2/plan",
+        data=body,
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+@pytest.fixture()
+def wire_server():
+    s = ServiceServer(
+        ReschedulerConfig(solver="numpy"), "127.0.0.1:0",
+        batch_window_s=0.01,
+    )
+    s.start_background()
+    yield s
+    s.close()
+
+
+def test_wire_endpoint_plans(wire_server):
+    code, body = _wire_post(
+        wire_server.address, wire.encode_plan_request("t1", tiny_packed())
+    )
+    assert code == 200
+    reply = wire.decode_plan_reply(body)
+    assert reply.found and reply.n_feasible == 2
+    assert reply.batch_tenants >= 1 and reply.batch_lanes >= 2
+
+
+def test_wire_endpoint_unknown_version_is_400_not_crash(wire_server):
+    blob = bytearray(wire.encode_plan_request("t1", tiny_packed()))
+    blob[4] = wire.WIRE_VERSION + 3
+    code, body = _wire_post(wire_server.address, bytes(blob))
+    assert code == 400
+    with pytest.raises(wire.WireError) as err:
+        wire.decode_plan_reply(body)
+    assert "version" in str(err.value)
+    # the server survives out-of-protocol bytes
+    code, _ = _wire_post(
+        wire_server.address, wire.encode_plan_request("t1", tiny_packed())
+    )
+    assert code == 200
+
+
+def test_wire_endpoint_garbage_is_400(wire_server):
+    code, body = _wire_post(wire_server.address, b"\x00" * 64)
+    assert code == 400
+
+
+# ---------------------------------------------------------------------------
+# RemotePlanner degradation ladder
+
+
+def _observation():
+    """(node_map, pdbs) for RemotePlanner.plan — the object path."""
+    from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+    from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock as FC
+    from tests.fixtures import (
+        ON_DEMAND_LABEL,
+        ON_DEMAND_LABELS,
+        SPOT_LABEL,
+        SPOT_LABELS,
+        make_node,
+        make_pod,
+    )
+
+    fc = FakeCluster(FC())
+    fc.add_node(make_node("od-1", ON_DEMAND_LABELS))
+    fc.add_node(make_node("spot-1", SPOT_LABELS))
+    fc.add_node(make_node("spot-2", SPOT_LABELS))
+    fc.add_pod(make_pod("a", 300, "od-1"))
+    fc.add_pod(make_pod("b", 200, "od-1"))
+    nodes = fc.list_ready_nodes()
+    pods = {n.name: fc.list_pods_on_node(n.name) for n in nodes}
+    return build_node_map(
+        nodes, pods,
+        on_demand_label=ON_DEMAND_LABEL,
+        spot_label=SPOT_LABEL,
+    ), fc.list_pdbs()
+
+
+def test_remote_planner_plans_falls_back_and_recovers():
+    """The degradation acceptance: a healthy service plans remotely;
+    the service dying mid-tick degrades the NEXT tick to the local
+    numpy oracle (counted in remote_planner_fallback_total) with the
+    same drain decision; a healthy service again -> remote planning
+    resumes on the next reply and the breaker resets."""
+    from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=5.0)
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server.start_background()
+    node_map, pdbs = _observation()
+
+    agent = RemotePlanner(cfg, f"http://{server.address}", tenant="c1")
+    r1 = agent.plan(node_map, pdbs)
+    assert r1.solver == "remote"
+    assert r1.plan is not None and r1.plan.node.node.name == "od-1"
+    want = dict(r1.plan.assignments)
+
+    # service goes away mid-operation
+    server.close()
+    before = metrics.service_snapshot()["remote_planner_fallback"]
+    r2 = agent.plan(node_map, pdbs)
+    assert r2.solver == "remote-fallback"
+    assert r2.plan is not None and r2.plan.node.node.name == "od-1"
+    assert dict(r2.plan.assignments) == want  # same oracle, same answer
+    assert metrics.service_snapshot()["remote_planner_fallback"] == before + 1
+    assert agent._consecutive_failures == 1
+
+    # service returns (new port — the agent is repointed, which keeps
+    # the test deterministic; the breaker state is what's under test)
+    server2 = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server2.start_background()
+    try:
+        agent.url = f"http://{server2.address}"
+        agent._skip_until = 0.0  # backoff horizon passed
+        r3 = agent.plan(node_map, pdbs)
+        assert r3.solver == "remote"
+        assert r3.plan is not None and dict(r3.plan.assignments) == want
+        assert agent._consecutive_failures == 0  # healthy reply resets
+    finally:
+        server2.close()
+
+
+def test_remote_planner_breaker_skips_dead_service():
+    """Past FAIL_THRESHOLD consecutive failures the breaker opens: the
+    agent stops paying connect timeouts and plans locally until the
+    backoff horizon passes."""
+    from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=0.5)
+    # nothing listens here (bound-then-closed port)
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    agent = RemotePlanner(cfg, f"http://127.0.0.1:{port}", tenant="c1")
+    node_map, pdbs = _observation()
+    for i in range(agent.FAIL_THRESHOLD):
+        r = agent.plan(node_map, pdbs)
+        assert r.solver == "remote-fallback"
+    assert agent._skip_until > 0  # breaker open
+    # while open, no network call is attempted: plan_async starts no
+    # worker thread, and the tick still produces a plan
+    finish = agent.plan_async(node_map, pdbs)
+    r = finish()
+    assert r.solver == "remote-fallback" and r.plan is not None
+
+
+def test_remote_planner_honors_503_retry_after():
+    """An overloaded service's Retry-After opens the skip window even
+    below the failure threshold — one 503 must not cost the next tick
+    another doomed round trip inside the named horizon."""
+    from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=5.0)
+    server = ServiceServer(
+        cfg, "127.0.0.1:0", batch_window_s=0.01, max_inflight=0
+    )  # every request rejects 503 before the body is read
+    server.service._cadence_s = 9.0
+    server.start_background()
+    try:
+        agent = RemotePlanner(cfg, f"http://{server.address}", tenant="c1")
+        node_map, pdbs = _observation()
+        import time
+
+        t0 = time.monotonic()
+        r = agent.plan(node_map, pdbs)
+        assert r.solver == "remote-fallback"
+        assert agent._skip_until >= t0 + 8.0  # the named 9 s horizon
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+
+
+def test_service_flags_flow_into_config():
+    from k8s_spot_rescheduler_tpu.cli.main import (
+        build_parser,
+        config_from_args,
+    )
+
+    args = build_parser().parse_args([
+        "--planner-url", "http://planner.svc:8642",
+        "--planner-timeout", "3s",
+        "--service-batch-window", "50ms",
+        "--service-queue-timeout", "1m",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.planner_url == "http://planner.svc:8642"
+    assert cfg.planner_timeout == 3.0
+    assert cfg.service_batch_window == pytest.approx(0.05)
+    assert cfg.service_queue_timeout == 60.0
+    # defaults parse too (the flag defaults are duration strings)
+    d = ReschedulerConfig()
+    args = build_parser().parse_args([])
+    cfg = config_from_args(args)
+    assert cfg.planner_timeout == d.planner_timeout
+    assert cfg.service_batch_window == pytest.approx(d.service_batch_window)
+    assert cfg.service_queue_timeout == d.service_queue_timeout
+    assert parse_duration(args.serve or "0") == 0  # runtime-only, default off
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        ReschedulerConfig(planner_timeout=0)
+    with pytest.raises(ValueError):
+        ReschedulerConfig(service_batch_window=-1)
+    with pytest.raises(ValueError):
+        ReschedulerConfig(service_queue_timeout=0)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the serve-smoke core (same code `make serve-smoke` runs)
+
+
+def test_serve_smoke_core():
+    import bench
+
+    result = bench.serve_smoke(n_tenants=4, seed=0)
+    assert result["ok"], result

@@ -1,4 +1,9 @@
-"""Planner-sidecar tests: the solver behind its JSON/HTTP boundary."""
+"""Planner-sidecar tests: the solver behind its JSON/HTTP boundary.
+
+Since the multi-tenant promotion the sidecar IS the planner service
+(service/server.py): /v1/plan decodes, packs and rides the batching
+queue. These tests cover the JSON boundary's contract — the service's
+own queue/batch/fairness mechanics live in tests/test_service.py."""
 
 import json
 import urllib.request
@@ -33,7 +38,15 @@ def test_healthz(sidecar):
     with urllib.request.urlopen(
         f"http://{sidecar.address}/healthz", timeout=10
     ) as resp:
-        assert json.loads(resp.read())["ok"] is True
+        out = json.loads(resp.read())
+    assert out["ok"] is True
+    # the service half: queue depth, per-bucket occupancy, per-tenant
+    # last-plan ages and the measured batch cadence ride along so a
+    # probe can see a starving tenant without scraping Prometheus
+    assert out["queue_depth"] == 0
+    assert out["bucket_occupancy"] == {}
+    assert out["tenant_last_plan_age_s"] == {}
+    assert "batch_cadence_s" in out and "batch_window_s" in out
 
 
 def test_plan_over_http(sidecar):
@@ -107,7 +120,7 @@ def test_oversized_snapshot_rejected():
 
 
 def test_busy_timeout_yields_503():
-    """A request that cannot get its turn within busy_timeout_s gets 503 +
+    """A request that cannot be batched within busy_timeout_s gets 503 +
     Retry-After instead of queueing unboundedly."""
     import threading
     import time
@@ -115,14 +128,13 @@ def test_busy_timeout_yields_503():
     s = PlannerSidecar(
         ReschedulerConfig(solver="numpy"), "127.0.0.1:0", busy_timeout_s=0.2
     )
-    inner = s.planner
+    real_host = s.service._solve_host
 
-    class Slow:
-        def plan(self, node_map, pdbs):
-            time.sleep(1.5)
-            return inner.plan(node_map, pdbs)
+    def slow_solve(stacked, reqs):
+        time.sleep(1.5)
+        return real_host(stacked)
 
-    s.planner = Slow()
+    s.service.solve_hook = slow_solve
     s.start_background()
     try:
         body = json.dumps({
@@ -134,15 +146,22 @@ def test_busy_timeout_yields_503():
         def fire():
             results.append(_post_raw(s, body))
 
-        threads = [threading.Thread(target=fire) for _ in range(3)]
-        for t in threads:
+        # first request rides the first batch and holds the (slow) solve
+        first = threading.Thread(target=fire)
+        first.start()
+        time.sleep(0.5)  # batch window passed; the 1.5 s solve is in flight
+        # these arrive while the scheduler is busy: still QUEUED past the
+        # 0.2 s bounded wait -> evicted with 503 + Retry-After
+        late = [threading.Thread(target=fire) for _ in range(2)]
+        for t in late:
             t.start()
-            time.sleep(0.05)  # ensure one holds the lock first
-        for t in threads:
+        for t in [first] + late:
             t.join()
         codes = sorted(c for c, _ in results)
         assert codes[0] == 200, f"no request succeeded: {results}"
         assert 503 in codes, f"no request saw backpressure: {codes}"
+        rejected = [out for code, out in results if code == 503]
+        assert all("queue timeout" in out["error"] for out in rejected)
     finally:
         s.close()
 
@@ -192,14 +211,13 @@ def test_inflight_depth_cap_rejects_immediately():
         busy_timeout_s=30.0, max_inflight=2,
     )
     release = threading.Event()
-    inner = s.planner
+    real_host = s.service._solve_host
 
-    class Gated:
-        def plan(self, node_map, pdbs):
-            release.wait(timeout=30)
-            return inner.plan(node_map, pdbs)
+    def gated_solve(stacked, reqs):
+        release.wait(timeout=30)
+        return real_host(stacked)
 
-    s.planner = Gated()
+    s.service.solve_hook = gated_solve
     s.start_background()
     try:
         body = json.dumps({
@@ -236,6 +254,117 @@ def test_inflight_depth_cap_rejects_immediately():
     finally:
         release.set()
         s.close()
+
+
+def _post_raw_headers(s, data, headers=None):
+    """(status, body, response headers) — Retry-After assertions need
+    the header surface, which _post_raw drops."""
+    req = urllib.request.Request(
+        f"http://{s.address}/v1/plan",
+        data=data,
+        headers=headers or {"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read()), dict(err.headers)
+
+
+def test_retry_after_derives_from_measured_batch_cadence():
+    """Regression (multi-tenant promotion): the 503 Retry-After value is
+    the MEASURED batch cadence — how long until a batch slot actually
+    frees — not the static busy timeout. Two layers: the cadence EMA
+    itself under a virtual clock, and the HTTP header carrying it."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.service.server import PlannerService
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from tests.test_service import tiny_packed
+
+    # --- cadence measurement, virtual clock, no threads ---
+    clock = FakeClock()
+    svc = PlannerService(
+        ReschedulerConfig(solver="numpy"), clock=clock, batch_window_s=0
+    )
+    svc.solve_hook = lambda stacked, reqs: np.zeros(
+        (stacked.slot_req.shape[0], 3 + stacked.slot_req.shape[2]), np.int32
+    )
+    assert svc.retry_after() == 1  # no batch yet: the floor, not 30
+    for _ in range(4):  # batches complete 7 s apart
+        svc.submit_nowait("a", tiny_packed())
+        assert svc.drain_once()
+        clock.advance(7.0)
+    assert svc._cadence_s == pytest.approx(7.0)
+    assert svc.retry_after() == 7  # ceil of the EMA, not busy_timeout
+
+    # --- the header: a depth-cap 503 carries the measured cadence ---
+    s = PlannerSidecar(
+        ReschedulerConfig(solver="numpy"), "127.0.0.1:0",
+        busy_timeout_s=30.0, max_inflight=1,
+    )
+    release = threading.Event()
+    real_host = s.service._solve_host
+    s.service.solve_hook = lambda stacked, reqs: (
+        release.wait(timeout=30), real_host(stacked)
+    )[1]
+    s.service._cadence_s = 7.0  # as measured above
+    s.start_background()
+    try:
+        body = json.dumps({
+            "nodes": [_node("od-1", "worker"), _node("spot-1", "spot-worker")],
+            "pods": [_pod("a", "od-1", cpu="100m")],
+        }).encode()
+        occupant = threading.Thread(
+            target=lambda: _post_raw(s, body)
+        )
+        occupant.start()
+        time.sleep(0.3)  # the lone inflight slot is held
+        code, out, headers = _post_raw_headers(s, body)
+        assert code == 503
+        assert headers.get("Retry-After") == "7", headers
+        release.set()
+        occupant.join()
+    finally:
+        release.set()
+        s.close()
+
+
+def test_inprocess_plan_without_server_is_synchronous():
+    """The documented in-process entry — PlannerSidecar.plan() with no
+    HTTP server or scheduler thread started — solves on the caller's
+    thread (the historical synchronous contract), not a 30 s timeout
+    against a scheduler nobody started."""
+    s = PlannerSidecar(ReschedulerConfig(solver="numpy"), "127.0.0.1:0")
+    try:
+        out = s.plan({
+            "nodes": [_node("od-1", "worker"), _node("spot-1", "spot-worker")],
+            "pods": [_pod("a", "od-1", cpu="300m")],
+        })
+        assert out["found"] is True and out["node"] == "od-1"
+    finally:
+        s.close()
+
+
+def test_healthz_reports_tenant_ages_after_plans(sidecar):
+    """After a plan, /healthz shows the tenant's last-plan age and the
+    measured cadence — the per-tenant starvation surface."""
+    body = {
+        "nodes": [_node("od-1", "worker"), _node("spot-1", "spot-worker")],
+        "pods": [_pod("a", "od-1", cpu="300m")],
+    }
+    out = _post(sidecar, body)
+    assert out["found"] is True
+    with urllib.request.urlopen(
+        f"http://{sidecar.address}/healthz", timeout=10
+    ) as resp:
+        health = json.loads(resp.read())
+    ages = health["tenant_last_plan_age_s"]
+    assert "default" in ages and ages["default"] >= 0.0
 
 
 def test_negative_content_length_rejected():

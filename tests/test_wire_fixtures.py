@@ -244,6 +244,189 @@ def test_wire_full_tick_drains_the_worker(wire_stub):
     assert len(wire_stub.patches) == 2
 
 
+# ---------------------------------------------------------------------------
+# Binary wire protocol goldens (service/wire.py).
+#
+# The multi-tenant planner service's agent<->service boundary is framed
+# binary tensors; these fixtures pin it BYTE-FOR-BYTE. Version bump
+# policy (see the service/wire.py header): WIRE_VERSION moves only when
+# an already-shipped frame changes meaning, and every bump must update
+# the digests below in the same commit — that is the point of them. A
+# digest mismatch without a version bump is silent protocol drift, the
+# exact failure class these goldens exist to catch.
+
+GOLDEN_REQUEST_SHA256 = (
+    "5177a98ea2b36e152282bdb8729be717c96f7ad1bd8d017ffed2dba9dbcbba4f"
+)
+GOLDEN_DELTA_SHA256 = (
+    "c963fd338eae41819ffb9b43e4442f4e1cb0264990f98955b7f6c69b389a22a9"
+)
+GOLDEN_REPLY_SHA256 = (
+    "3eaa5c27844e5ed2f355ae28c5e592c75c012159cc0053c622b83497ef93a58c"
+)
+# header of the golden request: MAGIC "KSRW" | version=1 | kind=1
+# (PLAN_REQUEST) | 12 frames, then the first frame's name tag
+GOLDEN_REQUEST_HEAD_HEX = "4b53525701010c00060074656e616e74"
+
+
+def _golden_packed():
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+
+    C, K, S, R, W, A = 2, 3, 2, 2, 1, 2
+    return PackedCluster(
+        slot_req=np.arange(C * K * R, dtype=np.float32).reshape(C, K, R) / 4,
+        slot_valid=np.array([[1, 1, 0], [1, 0, 0]], bool),
+        slot_tol=np.arange(C * K * W, dtype=np.uint32).reshape(C, K, W),
+        slot_aff=np.arange(C * K * A, dtype=np.uint32).reshape(C, K, A),
+        cand_valid=np.array([1, 1], bool),
+        spot_free=np.arange(S * R, dtype=np.float32).reshape(S, R) + 0.5,
+        spot_count=np.array([3, 1], np.int32),
+        spot_max_pods=np.array([58, 58], np.int32),
+        spot_taints=np.arange(S * W, dtype=np.uint32).reshape(S, W),
+        spot_ok=np.array([1, 0], bool),
+        spot_aff=np.arange(S * A, dtype=np.uint32).reshape(S, A),
+    )
+
+
+def _golden_delta():
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.models.columnar import PackedDelta
+
+    L, K, R, W, A, M = 1, 3, 2, 1, 2, 2
+    return PackedDelta(
+        lanes=np.array([1], np.int32),
+        lane_slot_req=np.arange(L * K * R, dtype=np.float32).reshape(L, K, R),
+        lane_slot_valid=np.array([[1, 0, 0]], bool),
+        lane_slot_tol=np.arange(L * K * W, dtype=np.uint32).reshape(L, K, W),
+        lane_slot_aff=np.arange(L * K * A, dtype=np.uint32).reshape(L, K, A),
+        cand_rows=np.array([0], np.int32),
+        cand_valid=np.array([0], bool),
+        spot_rows=np.array([0, 1], np.int32),
+        spot_free=np.arange(M * R, dtype=np.float32).reshape(M, R),
+        spot_count=np.array([2, 2], np.int32),
+        spot_max_pods=np.array([58, 58], np.int32),
+        spot_taints=np.arange(M * W, dtype=np.uint32).reshape(M, W),
+        spot_ok=np.array([1, 1], bool),
+        spot_aff=np.arange(M * A, dtype=np.uint32).reshape(M, A),
+    )
+
+
+def _golden_reply():
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    return wire.PlanReply(
+        found=True, index=1, n_feasible=2,
+        row=np.array([0, 1, -1], np.int32),
+        solve_ms=1.25, queue_wait_ms=3.5, batch_lanes=24, batch_tenants=3,
+    )
+
+
+def test_wire_protocol_byte_golden():
+    """The encoded bytes of all three message kinds are pinned: any
+    layout change — field order, dtype codes, header shape — breaks
+    this test and must ship with a WIRE_VERSION decision (bump on
+    meaning change, golden refresh always)."""
+    import hashlib
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    assert wire.WIRE_VERSION == 1  # bumping? update every digest below
+    req = wire.encode_plan_request("golden-tenant", _golden_packed())
+    assert hashlib.sha256(req).hexdigest() == GOLDEN_REQUEST_SHA256
+    assert req[:16].hex() == GOLDEN_REQUEST_HEAD_HEX
+    delta = wire.encode_packed_delta("golden-tenant", _golden_delta())
+    assert hashlib.sha256(delta).hexdigest() == GOLDEN_DELTA_SHA256
+    reply = wire.encode_plan_reply(_golden_reply())
+    assert hashlib.sha256(reply).hexdigest() == GOLDEN_REPLY_SHA256
+
+
+def test_wire_protocol_roundtrip():
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    packed = _golden_packed()
+    tenant, dec = wire.decode_plan_request(
+        wire.encode_plan_request("golden-tenant", packed)
+    )
+    assert tenant == "golden-tenant"
+    for f in dec._fields:
+        got, want = getattr(dec, f), getattr(packed, f)
+        assert got.dtype == want.dtype and got.shape == want.shape, f
+        np.testing.assert_array_equal(got, want, err_msg=f)
+
+    delta = _golden_delta()
+    tenant, ddec = wire.decode_packed_delta(
+        wire.encode_packed_delta("golden-tenant", delta)
+    )
+    assert tenant == "golden-tenant"
+    for f in ddec._fields:
+        np.testing.assert_array_equal(
+            getattr(ddec, f), getattr(delta, f), err_msg=f
+        )
+
+    reply = _golden_reply()
+    rdec = wire.decode_plan_reply(wire.encode_plan_reply(reply))
+    assert rdec.found == reply.found and rdec.index == reply.index
+    assert rdec.n_feasible == reply.n_feasible
+    np.testing.assert_array_equal(rdec.row, reply.row)
+    assert rdec.solve_ms == reply.solve_ms
+    assert rdec.queue_wait_ms == reply.queue_wait_ms
+    assert rdec.batch_lanes == reply.batch_lanes
+    assert rdec.batch_tenants == reply.batch_tenants
+
+
+def test_wire_unknown_version_is_typed_error():
+    """A future (or corrupt) protocol version must decode to the TYPED
+    WireVersionError — the server answers 400, never crashes — and the
+    version byte is exactly header offset 4."""
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    blob = bytearray(wire.encode_plan_request("t", _golden_packed()))
+    assert blob[4] == wire.WIRE_VERSION
+    blob[4] = wire.WIRE_VERSION + 1
+    with pytest.raises(wire.WireVersionError):
+        wire.decode_frames(bytes(blob))
+    # and the subclass relationship holds: version errors are WireErrors
+    assert issubclass(wire.WireVersionError, wire.WireError)
+
+
+def test_wire_malformed_inputs_are_typed_errors():
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    blob = wire.encode_plan_request("t", _golden_packed())
+    with pytest.raises(wire.WireError):
+        wire.decode_frames(blob[: len(blob) // 2])  # truncated
+    with pytest.raises(wire.WireError):
+        wire.decode_frames(b"NOPE" + blob[4:])  # bad magic
+    with pytest.raises(wire.WireError):
+        wire.decode_frames(blob + b"\x00")  # trailing garbage
+    bad_kind = bytearray(blob)
+    bad_kind[5] = 200
+    with pytest.raises(wire.WireError):
+        wire.decode_frames(bytes(bad_kind))
+    # a request whose tensor dtype breaks the pack contract is refused
+    packed = _golden_packed()._replace(
+        spot_count=np.array([3, 1], np.int64)
+    )
+    with pytest.raises(wire.WireError):
+        wire.decode_plan_request(wire.encode_plan_request("t", packed))
+    # cross-field shape inconsistency is refused
+    packed = _golden_packed()._replace(spot_ok=np.array([1], bool))
+    with pytest.raises(wire.WireError):
+        wire.decode_plan_request(wire.encode_plan_request("t", packed))
+    # a reply is not a request
+    with pytest.raises(wire.WireError):
+        wire.decode_plan_request(wire.encode_plan_reply(_golden_reply()))
+
+
 def test_wire_sidecar_plans_the_same_drain():
     """The planner-sidecar boundary (SURVEY.md §2.3): POSTing the same
     wire payloads to /v1/plan yields the same drain decision the

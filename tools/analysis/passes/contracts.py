@@ -173,6 +173,7 @@ RUNTIME_ONLY_FLAGS = {
     "--leader-elect-identity",
     "--leader-elect-lease-duration",
     "--watch-cache",
+    "--serve",
 }
 
 
