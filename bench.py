@@ -269,6 +269,8 @@ def run_incremental_ticks(
     from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
     from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 
+    from k8s_spot_rescheduler_tpu.utils import tracing
+
     cfg = ReschedulerConfig(
         solver=solver if solver in ("jax", "pallas") else "jax",
         resources=spec.resources,
@@ -277,7 +279,7 @@ def run_incremental_ticks(
         cfg = dataclasses.replace(cfg, staged_chunk_lanes=staged_chunk_lanes)
     planner = SolverPlanner(cfg)
     uids = iter(list(client.pods))
-    tick_ms, reports, sync_ms = [], [], []
+    tick_ms, reports, sync_ms, traces = [], [], [], []
     for i in range(n_ticks):
         if i:
             # light churn, the steady-state regime: a few evictions'
@@ -290,9 +292,13 @@ def run_incremental_ticks(
                     client._remove_pod(uid)
             sync_ms.append((time.perf_counter() - t_s) * 1e3)
         t0 = time.perf_counter()
-        reports.append(planner.plan(store, pdbs))
+        # each tick under its own trace, exactly as the control loop
+        # runs it — the smoke reads the span breakdown off these
+        with tracing.tick_trace() as trace:
+            reports.append(planner.plan(store, pdbs))
+        traces.append(trace)
         tick_ms.append((time.perf_counter() - t0) * 1e3)
-    return tick_ms, reports, sync_ms
+    return tick_ms, reports, sync_ms, traces
 
 
 def run_quality(seed: int, sweep: int = 1, solver: str = "numpy") -> int:
@@ -736,6 +742,21 @@ def run_replay_bench(
     return 0
 
 
+def _span_ms_median(traces, name: str) -> float:
+    """Median per-tick total duration of spans called ``name`` across
+    the given traces (0.0 when the span never fired — e.g. queue/wire
+    spans on the in-process path). The smoke/serve-smoke JSON lines
+    report these so BENCH_r0*.json tracks where the tick's milliseconds
+    actually go (queue vs solve vs wire)."""
+    totals = []
+    for trace in traces:
+        if trace is None:
+            continue
+        spans = trace.find(name)
+        totals.append(sum(s.dur_ms for s in spans) if spans else 0.0)
+    return float(np.median(totals)) if totals else 0.0
+
+
 def run_smoke(args, metric: str, unit: str) -> int:
     """CI smoke of the incremental device pipeline (``make bench-smoke``):
     a tiny CPU-only cluster (C≈64, S≈64) runs 5 full ticks through the
@@ -754,7 +775,7 @@ def run_smoke(args, metric: str, unit: str) -> int:
         CONFIGS[2], name="bench-smoke", n_on_demand=64, n_spot=64, n_pods=600
     )
     _, _, pack_s, client, store, pdbs = build_problem(2, args.seed, spec=spec)
-    tick_ms, reports, sync_ms = run_incremental_ticks(
+    tick_ms, reports, sync_ms, traces = run_incremental_ticks(
         client, store, pdbs, spec, "jax",
         n_ticks=5, churn=3, staged_chunk_lanes=16,
     )
@@ -808,6 +829,18 @@ def run_smoke(args, metric: str, unit: str) -> int:
             # observe split: mirror sync (O(churn)) vs full pack
             "sync_ms": round(float(np.median(sync_ms)), 3),
             "pack_ms": round(pack_s * 1e3, 3),
+            # span breakdown (steady ticks, cold tick 0 excluded):
+            # in-process path, so queue/wire are structurally 0 — the
+            # serve-smoke line carries the cross-process split
+            "span_queue_ms": round(
+                _span_ms_median(traces[1:], "service.queue-wait"), 3
+            ),
+            "span_solve_ms": round(
+                _span_ms_median(traces[1:], "plan.solve"), 3
+            ),
+            "span_wire_ms": round(
+                _span_ms_median(traces[1:], "wire.transfer"), 3
+            ),
             # full jaxpr-tier audit wall (subprocess incl. jax import):
             # the tracing-cost trajectory for `make audit-jaxpr`
             "audit_jaxpr_ms": round(audit_jaxpr_ms, 1),
@@ -830,7 +863,13 @@ def serve_smoke(n_tenants: int = 4, seed: int = 0) -> dict:
     - FAILS unless every tenant's selection (drained node + proven
       assignments) is bit-identical to its solo plan, at least one
       batch carried lanes from >=2 tenants (service_batch_lanes /
-      service_batch_tenants), and no agent fell back.
+      service_batch_tenants), and no agent fell back;
+    - every agent's plan must produce ONE trace tree holding both the
+      agent-side spans and the server-returned spans (queue-wait /
+      batch / solve grafted under wire.request) under a single trace
+      ID that round-tripped the wire — the end-to-end tracing
+      acceptance — and the JSON line reports the
+      span_queue_ms/span_solve_ms/span_wire_ms medians off those trees.
     """
     import dataclasses
     import threading
@@ -925,7 +964,29 @@ def serve_smoke(n_tenants: int = 4, seed: int = 0) -> dict:
     # lanes prove it too: one batch carried more lanes than any single
     # tenant holds
     lanes_prove = after["batch_lanes_max"] > max(solo_lanes)
-    ok = not mismatches and fallbacks == 0 and cobatched and lanes_prove
+    # end-to-end tracing acceptance: each agent's tick trace holds the
+    # agent-side spans AND the server-returned spans under ONE trace id
+    # that crossed the wire (the server keyed its span block by it)
+    traces = [a.last_trace for a in agents]
+    trace_bad = []
+    for i, trace in enumerate(traces):
+        if trace is None or not trace.trace_id:
+            trace_bad.append({"tenant": i, "why": "no trace recorded"})
+            continue
+        have = {
+            name
+            for name in ("plan.pack", "wire.request", "service.queue-wait",
+                         "service.solve", "wire.transfer")
+            if trace.find(name)
+        }
+        missing = {"plan.pack", "wire.request", "service.queue-wait",
+                   "service.solve", "wire.transfer"} - have
+        if missing:
+            trace_bad.append({"tenant": i, "missing": sorted(missing)})
+    ok = (
+        not mismatches and fallbacks == 0 and cobatched and lanes_prove
+        and not trace_bad
+    )
     return {
         "ok": ok,
         "n_tenants": n_tenants,
@@ -938,6 +999,14 @@ def serve_smoke(n_tenants: int = 4, seed: int = 0) -> dict:
         "solo_lanes_max": max(solo_lanes),
         "remote_fallbacks": int(fallbacks),
         "mismatches": mismatches,
+        # the cross-process span split, medians over the agent traces:
+        # where a wire-planned tick's milliseconds actually went
+        "span_queue_ms": round(
+            _span_ms_median(traces, "service.queue-wait"), 3
+        ),
+        "span_solve_ms": round(_span_ms_median(traces, "service.solve"), 3),
+        "span_wire_ms": round(_span_ms_median(traces, "wire.transfer"), 3),
+        "trace_violations": trace_bad,
     }
 
 
@@ -952,6 +1021,7 @@ def run_serve_smoke(args, metric: str, unit: str) -> int:
 
     jax.config.update("jax_platforms", "cpu")
     result = serve_smoke(n_tenants=max(4, args.tenants), seed=args.seed)
+    fail_detail = result["mismatches"] or result["trace_violations"]
     print(
         f"serve-smoke: {result['n_tenants']} tenants  "
         f"serve_ms={result['serve_ms']}  "
@@ -959,7 +1029,9 @@ def run_serve_smoke(args, metric: str, unit: str) -> int:
         f"batch_lanes_max={result['batch_lanes_max']} "
         f"(solo max {result['solo_lanes_max']})  "
         f"fallbacks={result['remote_fallbacks']}  "
-        f"-> {'OK' if result['ok'] else 'FAIL: %s' % result['mismatches']}",
+        f"spans queue={result['span_queue_ms']} "
+        f"solve={result['span_solve_ms']} wire={result['span_wire_ms']} ms  "
+        f"-> {'OK' if result['ok'] else 'FAIL: %s' % fail_detail}",
         file=sys.stderr,
     )
     emit(
@@ -973,6 +1045,11 @@ def run_serve_smoke(args, metric: str, unit: str) -> int:
             "batch_tenants_max": result["batch_tenants_max"],
             "batch_lanes_max": result["batch_lanes_max"],
             "remote_fallbacks": result["remote_fallbacks"],
+            # the cross-process span breakdown (grafted traces): where
+            # the tunnel-RTT-bound milliseconds actually go
+            "span_queue_ms": result["span_queue_ms"],
+            "span_solve_ms": result["span_solve_ms"],
+            "span_wire_ms": result["span_wire_ms"],
             "ok": result["ok"],
         }
     )
@@ -983,13 +1060,20 @@ def run_chaos(args, metric: str, unit: str) -> int:
     """Chaos soak (``make chaos-smoke``): N control-loop ticks over a
     fixture-scale fake cluster behind the seeded fault-injection client
     (io/chaos.py heavy profile + scripted 429s + one mid-drain
-    interrupt). CPU-only by construction (numpy planner — the soak
-    proves the CONTROL PLANE, which is solver-independent). Fails unless
-    every robustness invariant holds: the loop never crashes, no
-    orphaned ToBeDeleted taint survives at end-state, no node is drained
-    twice without re-observation, and at least one drain lands after the
-    faults clear."""
+    interrupt + two scripted planner crashes). CPU-only by construction
+    (numpy planner — the soak proves the CONTROL PLANE, which is
+    solver-independent). Fails unless every robustness invariant holds:
+    the loop never crashes, no orphaned ToBeDeleted taint survives at
+    end-state, no node is drained twice without re-observation, and at
+    least one drain lands after the faults clear — and unless the
+    flight recorder captured every degradation: each contained planner
+    crash and each breaker engagement appears in the ring with its
+    cause and trace ID (counts equal to the independently-maintained
+    metric deltas), every auto-dump names a degradation kind, and no
+    dump fires outside one."""
     import dataclasses as _dc
+    import json as _json
+    import tempfile as _tempfile
 
     from k8s_spot_rescheduler_tpu.io.chaos import (
         ChaosClusterClient,
@@ -997,7 +1081,9 @@ def run_chaos(args, metric: str, unit: str) -> int:
         FaultPlan,
     )
     from k8s_spot_rescheduler_tpu.io.fake import FakeCluster
+    from k8s_spot_rescheduler_tpu.loop import flight
     from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.metrics import registry as _metrics
     from k8s_spot_rescheduler_tpu.models.cluster import (
         CPU,
         MEMORY,
@@ -1045,25 +1131,63 @@ def run_chaos(args, metric: str, unit: str) -> int:
         interrupt_on_taint=3,
     )
     chaos = ChaosClusterClient(fc, plan, clock=clock)
+    dump_dir = _tempfile.mkdtemp(prefix="chaos-flight-")
     config = ReschedulerConfig(
         solver="numpy",
         housekeeping_interval=10.0,
         node_drain_delay=30.0,
         pod_eviction_timeout=60.0,
         eviction_retry_time=5.0,
+        flight_dump_dir=dump_dir,
     )
-    planner = SolverPlanner(config)
+
+    class _ScriptedCrashPlanner:
+        """Planner wrapper raising on scripted tick indices: the
+        injected planner-crash half of the soak — containment (PR 4)
+        already catches it; the flight assertions below prove the
+        recorder reconstructs it. plan_async is defined explicitly (a
+        __getattr__ forward would hand the loop the INNER planner's,
+        bypassing the crash script — the _HintingPlanner lesson)."""
+
+        def __init__(self, inner, crash_calls):
+            self._inner = inner
+            self._crash_calls = set(crash_calls)
+            self._calls = 0
+
+        def _maybe_crash(self):
+            self._calls += 1
+            if self._calls in self._crash_calls:
+                raise RuntimeError(
+                    "chaos: scripted planner crash #%d" % self._calls
+                )
+
+        def plan(self, observation, pdbs):
+            self._maybe_crash()
+            return self._inner.plan(observation, pdbs)
+
+        def plan_async(self, observation, pdbs):
+            self._maybe_crash()
+            return self._inner.plan_async(observation, pdbs)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    planner = _ScriptedCrashPlanner(SolverPlanner(config), {5, 40})
 
     def make_controller():
         return Rescheduler(chaos, planner, config, clock=clock, recorder=chaos)
 
     n_ticks = int(args.chaos_ticks)
     quiesce_at = (n_ticks * 7) // 8
+    flight.RECORDER.reset()
+    metrics_before = _metrics.robustness_snapshot()
     r = make_controller()
     t0 = time.perf_counter()
     interrupts = completed = churn = 0
     drains = []
     fallbacks = 0
+    breaker_edges = 0
+    breaker_was_engaged = False
     violations = []
     for i in range(n_ticks):
         clock.sleep(config.housekeeping_interval)
@@ -1083,6 +1207,7 @@ def run_chaos(args, metric: str, unit: str) -> int:
             result = r.tick()
         except ChaosInterrupt:
             interrupts += 1
+            breaker_was_engaged = False  # fresh controller, fresh streak
             r = make_controller()
             continue
         except Exception as err:  # noqa: BLE001 — the invariant itself
@@ -1091,6 +1216,12 @@ def run_chaos(args, metric: str, unit: str) -> int:
             # Ctrl-C/SystemExit must propagate, not print a bogus FAIL
             violations.append(f"tick {i} crashed the loop: {err!r}")
             break
+        # independent count of breaker ENGAGE edges (entering the
+        # engaged state), diffed against the flight events below
+        engaged = r.breaker_engaged
+        if engaged and not breaker_was_engaged:
+            breaker_edges += 1
+        breaker_was_engaged = engaged
         completed += 1
         # the no-double-drain-without-re-observation invariant: every
         # drained node was observed WITH PODS at this tick's start (a
@@ -1114,6 +1245,71 @@ def run_chaos(args, metric: str, unit: str) -> int:
         violations.append(f"expected 1 mid-drain interrupt, saw {interrupts}")
     if not any(i >= quiesce_at for i, _ in drains):
         violations.append("no drain landed after faults cleared")
+
+    # --- flight-recorder capture (docs/OBSERVABILITY.md) ---
+    metric_fallbacks = int(
+        _metrics.robustness_snapshot()["planner_fallback"]
+        - metrics_before["planner_fallback"]
+    )
+    fl_counts = flight.RECORDER.counts()
+    if metric_fallbacks < 2:
+        violations.append(
+            "scripted planner crashes never reached containment "
+            f"(planner_fallback delta {metric_fallbacks} < 2)"
+        )
+    if fl_counts.get("planner-fallback", 0) != metric_fallbacks:
+        violations.append(
+            f"flight ring holds {fl_counts.get('planner-fallback', 0)} "
+            f"planner-fallback events but the metric counted "
+            f"{metric_fallbacks} — the recorder missed a degradation"
+        )
+    if fl_counts.get("breaker-engage", 0) != breaker_edges:
+        violations.append(
+            f"flight ring holds {fl_counts.get('breaker-engage', 0)} "
+            f"breaker-engage events but the loop engaged "
+            f"{breaker_edges} time(s)"
+        )
+    for ev in flight.RECORDER.events():
+        if ev["kind"] in ("planner-fallback", "breaker-engage"):
+            if not ev["cause"] or not ev["trace_id"]:
+                violations.append(
+                    f"flight event {ev['kind']} missing its cause/trace "
+                    f"id: {ev!r}"
+                )
+                break
+    # the scripted crash's cause chain must survive into the ring
+    fb_events = flight.RECORDER.events("planner-fallback")
+    if fb_events and not any(
+        "scripted planner crash" in ev["cause"] for ev in fb_events
+    ):
+        violations.append(
+            "no planner-fallback event carries the injected crash cause"
+        )
+    # every auto-dump names a degradation kind; clean ticks never dump
+    dump_files = sorted(
+        f for f in os.listdir(dump_dir) if f.endswith(".json")
+    )
+    degr_total = sum(
+        fl_counts.get(k, 0) for k in flight.DEGRADATION_KINDS
+    )
+    if degr_total and not dump_files:
+        violations.append(
+            f"{degr_total} degradation event(s) fired but no flight "
+            "dump was written"
+        )
+    if not degr_total and dump_files:
+        violations.append(
+            f"{len(dump_files)} dump(s) written on a clean soak"
+        )
+    for fname in dump_files:
+        with open(os.path.join(dump_dir, fname)) as fh:
+            payload = _json.load(fh)
+        if payload.get("reason") not in flight.DEGRADATION_KINDS:
+            violations.append(
+                f"dump {fname} reason {payload.get('reason')!r} is not "
+                "a degradation kind"
+            )
+            break
     wall = time.perf_counter() - t0
     ok = not violations
     print(
@@ -1137,6 +1333,13 @@ def run_chaos(args, metric: str, unit: str) -> int:
             "mid_drain_interrupts": int(interrupts),
             "injected_faults": int(sum(chaos.stats.values())),
             "planner_fallback_ticks": int(fallbacks),
+            "flight_planner_fallbacks": int(
+                fl_counts.get("planner-fallback", 0)
+            ),
+            "flight_breaker_engagements": int(
+                fl_counts.get("breaker-engage", 0)
+            ),
+            "flight_dumps": len(dump_files),
             "orphaned_taints_end": len(orphans),
             "wall_s": round(wall, 2),
             "ok": ok,
@@ -1175,6 +1378,7 @@ def watch_soak(
         raw_pod,
     )
     from k8s_spot_rescheduler_tpu.io.watch import WatchingKubeClusterClient
+    from k8s_spot_rescheduler_tpu.loop import flight
     from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
     from k8s_spot_rescheduler_tpu.metrics import registry as metrics
     from k8s_spot_rescheduler_tpu.models.cluster import build_node_map
@@ -1206,7 +1410,10 @@ def watch_soak(
     )
     chaos = ChaosClusterClient(src, plan, clock=clock)
     # snapshot BEFORE the seeding relists so the delta accounting below
-    # covers every LIST of the run (metrics are process-cumulative)
+    # covers every LIST of the run (metrics are process-cumulative);
+    # the flight ring resets so its counts diff cleanly against the
+    # metric deltas (capture parity is an acceptance criterion)
+    flight.RECORDER.reset()
     before = metrics.freshness_snapshot()
     wc = WatchingKubeClusterClient(
         chaos, clock=clock, progress_deadline=progress_deadline,
@@ -1367,6 +1574,42 @@ def watch_soak(
     if d["resync_audits"] < 1:
         violations.append("no anti-entropy audit ever ran")
 
+    # --- flight-recorder capture (docs/OBSERVABILITY.md): every
+    # injected stall and every freshness bypass appears in the ring,
+    # count-for-count with the independently-updated metrics, each
+    # with its cause (and, for in-tick kinds, its trace ID) ---
+    fl_counts = flight.RECORDER.counts()
+    if fl_counts.get("watch-stall", 0) != int(d["watch_stalls"]):
+        violations.append(
+            f"flight ring holds {fl_counts.get('watch-stall', 0)} "
+            f"watch-stall events but metrics counted "
+            f"{int(d['watch_stalls'])}"
+        )
+    if fl_counts.get("freshness-bypass", 0) != int(d["freshness_bypass"]):
+        violations.append(
+            f"flight ring holds {fl_counts.get('freshness-bypass', 0)} "
+            f"freshness-bypass events but metrics counted "
+            f"{int(d['freshness_bypass'])}"
+        )
+    for ev in flight.RECORDER.events():
+        if ev["kind"] in ("watch-stall", "freshness-bypass"):
+            if not ev["cause"]:
+                violations.append(f"flight event missing cause: {ev!r}")
+                break
+            if ev["kind"] == "freshness-bypass" and not ev["trace_id"]:
+                # bypass fires inside a tick: its trace id must ride
+                violations.append(
+                    f"freshness-bypass event missing trace id: {ev!r}"
+                )
+                break
+    # no dump dir is configured here, so clean OR degraded ticks alike
+    # must write nothing — the ring is the capture surface
+    if flight.RECORDER.dump_count() != 0:
+        violations.append(
+            f"{flight.RECORDER.dump_count()} dump(s) written without a "
+            "configured dump dir"
+        )
+
     # parity: the incremental mirror packs bit-identically to a fresh
     # LIST of the same end state (a plan is a pure function of the pack)
     wc.refresh()
@@ -1410,6 +1653,8 @@ def watch_soak(
             else round(heal_wall - corrupt_wall, 1)
         ),
         "freshness_bypass_ticks": int(d["freshness_bypass"]),
+        "flight_stalls": int(fl_counts.get("watch-stall", 0)),
+        "flight_bypasses": int(fl_counts.get("freshness-bypass", 0)),
         "mirror_stale_planned": int(d["mirror_stale_planned"]),
         "full_lists": int(total_lists),
         "direct_bypass_reads": int(src.direct_reads),
@@ -1841,7 +2086,7 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
     # (delta-pack into the device-resident cache + staged early-exit
     # solve). Tick 0 is the cold full upload + compiles; the steady
     # number is the median of the post-first-tick full ticks.
-    tick_ms, tick_reports, sync_ms_list = run_incremental_ticks(
+    tick_ms, tick_reports, sync_ms_list, _ = run_incremental_ticks(
         client, store, pdbs, spec, args.solver,
         n_ticks=max(4, min(8, args.repeats)),
     )

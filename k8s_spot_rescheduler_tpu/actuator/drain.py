@@ -37,6 +37,7 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
 )
 from k8s_spot_rescheduler_tpu.utils.clock import Clock
 from k8s_spot_rescheduler_tpu.utils import logging as log
+from k8s_spot_rescheduler_tpu.utils import tracing
 
 VERIFY_POLL_INTERVAL = 5.0  # scaler.go:143 time.Sleep(5 * time.Second)
 
@@ -140,9 +141,10 @@ def drain_node(
         # retry period until the deadline (scaler.go:47-62).
         remaining: List[PodSpec] = list(pods)
         while remaining:
-            remaining, err = _evict_round(
-                client, remaining, max_graceful_termination
-            )
+            with tracing.span("drain.evict", pods=len(remaining)):
+                remaining, err = _evict_round(
+                    client, remaining, max_graceful_termination
+                )
             if err is not None:
                 last_error = err
             if remaining:
@@ -173,22 +175,26 @@ def drain_node(
         gone: set = set()
         while clock.now() < retry_until + VERIFY_POLL_INTERVAL:
             fresh: set = set()  # gone verdicts observed THIS round
-            for pod in pods:
-                if pod.uid in gone:
-                    continue
-                try:
-                    returned = client.get_pod(pod.namespace, pod.name)
-                except Exception as err:  # noqa: BLE001 — scaler.go:129-133
-                    log.error("Failed to check pod %s: %s", pod.uid, err)
-                    continue  # only this pod counts as not-yet-gone
-                if returned is None or returned.node_name != node.name:
-                    fresh.add(pod.uid)
-                else:
-                    # expected while evictions propagate — the reference
-                    # logs it at plain glog info (scaler/scaler.go:131-135),
-                    # not error; vlog-gated here so proof artifacts and
-                    # quiet production logs don't carry per-poll noise
-                    log.vlog(2, "Not deleted yet %s", pod.name)
+            with tracing.span(
+                "drain.verify", remaining=len(pods) - len(gone)
+            ):
+                for pod in pods:
+                    if pod.uid in gone:
+                        continue
+                    try:
+                        returned = client.get_pod(pod.namespace, pod.name)
+                    except Exception as err:  # noqa: BLE001 — scaler.go:129-133
+                        log.error("Failed to check pod %s: %s", pod.uid, err)
+                        continue  # only this pod counts as not-yet-gone
+                    if returned is None or returned.node_name != node.name:
+                        fresh.add(pod.uid)
+                    else:
+                        # expected while evictions propagate — the
+                        # reference logs it at plain glog info
+                        # (scaler/scaler.go:131-135), not error;
+                        # vlog-gated here so proof artifacts and quiet
+                        # production logs don't carry per-poll noise
+                        log.vlog(2, "Not deleted yet %s", pod.name)
             confirmed = len(gone) + len(fresh) == len(pods)
             if confirmed:
                 # re-confirm earlier rounds' memoized verdicts with one

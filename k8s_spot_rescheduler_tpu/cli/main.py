@@ -197,6 +197,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "control loop: /v2/plan (binary wire), /v1/plan "
                         "(JSON adapter), /healthz; one TPU plans for a "
                         "fleet of --planner-url agents")
+    p.add_argument("--trace-enabled", type=_bool, default=d.trace_enabled,
+                   help="per-tick span-tree tracing with wire-propagated "
+                        "trace IDs (utils/tracing.py; always-on-cheap — "
+                        "O(spans) host work, no device syncs); false = "
+                        "phase histograms only")
+    p.add_argument("--flight-ring-size", type=int,
+                   default=d.flight_ring_size,
+                   help="completed tick traces the flight recorder's "
+                        "in-memory postmortem ring retains "
+                        "(loop/flight.py)")
+    p.add_argument("--flight-dump-dir", default=d.flight_dump_dir,
+                   help="directory the flight recorder auto-dumps a "
+                        "redacted JSON postmortem into when a "
+                        "degradation edge fires (planner fallback, "
+                        "breaker engage, freshness bypass, watch stall, "
+                        "service shed); empty = in-memory ring only")
+    p.add_argument("--debug-endpoints", type=_bool,
+                   default=d.debug_endpoints,
+                   help="serve GET /debug/trace and /debug/flight on "
+                        "the sidecar/service HTTP servers (off by "
+                        "default; debug surfaces are opt-in)")
     p.add_argument("--jax-cache-dir", default=d.jax_cache_dir,
                    help="persistent XLA compilation cache directory; the "
                         "~seconds cold compile of the solver programs is "
@@ -305,6 +326,10 @@ def config_from_args(args) -> ReschedulerConfig:
         watch_progress_deadline=parse_duration(args.watch_progress_deadline),
         mirror_staleness_budget=parse_duration(args.mirror_staleness_budget),
         resync_interval=parse_duration(args.resync_interval),
+        trace_enabled=args.trace_enabled,
+        flight_ring_size=args.flight_ring_size,
+        flight_dump_dir=args.flight_dump_dir,
+        debug_endpoints=args.debug_endpoints,
         resources=tuple(r for r in args.resources.split(",") if r),
         mesh_shape=(
             tuple(int(x) for x in args.mesh_shape.lower().split("x"))

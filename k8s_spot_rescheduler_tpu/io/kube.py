@@ -42,6 +42,7 @@ from k8s_spot_rescheduler_tpu.models.cluster import (
 )
 from k8s_spot_rescheduler_tpu.utils.quantity import parse_cpu_millis, parse_quantity
 from k8s_spot_rescheduler_tpu.utils import logging as log
+from k8s_spot_rescheduler_tpu.utils import tracing
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -779,35 +780,48 @@ class KubeClusterClient:
         from k8s_spot_rescheduler_tpu.metrics import registry as metrics
 
         attempt = 0
-        while True:
-            try:
-                with self._open(method, path, None, timeout=timeout) as resp:
-                    return resp.read()
-            except Exception as err:  # noqa: BLE001 — classified below
-                retryable, retry_after = transient_http_error(err)
-                if not retryable:
-                    raise
-                if attempt >= self.retry_max:
-                    metrics.update_kube_request_failure()
-                    raise
-                # full jitter around the exponential midpoint: delay in
-                # [0.5, 1.5) x base x 2^attempt, floored by Retry-After —
-                # capped: one bad header (a degraded LB answering
-                # "Retry-After: 3600") must not stall the tick for hours
-                # inside a single read; past the cap the error surfaces
-                # through the observe-skip/breaker machinery instead
-                delay = self.retry_base * (2.0 ** attempt)
-                delay *= 0.5 + self._retry_rng.random()
-                if retry_after is not None:
-                    delay = max(delay, min(retry_after, RETRY_AFTER_CAP))
-                metrics.update_kube_request_retry()
-                log.vlog(
-                    2,
-                    "kube %s %s failed transiently (%s); retry %d/%d in %.2fs",
-                    method, path, err, attempt + 1, self.retry_max, delay,
-                )
-                self._retry_sleep(delay)
-                attempt += 1
+        # one span per kube READ, retries included (attempts attr):
+        # the tick trace shows which apiserver call a slow observe
+        # actually waited on. The path attr is redacted at dump time
+        # (it can carry namespaces/pod names).
+        with tracing.span("kube.get", path=path) as sp:
+            while True:
+                try:
+                    with self._open(
+                        method, path, None, timeout=timeout
+                    ) as resp:
+                        body = resp.read()
+                    if sp is not None and attempt:
+                        sp.attrs["attempts"] = attempt + 1
+                    return body
+                except Exception as err:  # noqa: BLE001 — classified below
+                    retryable, retry_after = transient_http_error(err)
+                    if not retryable:
+                        raise
+                    if attempt >= self.retry_max:
+                        metrics.update_kube_request_failure()
+                        raise
+                    # full jitter around the exponential midpoint: delay
+                    # in [0.5, 1.5) x base x 2^attempt, floored by
+                    # Retry-After — capped: one bad header (a degraded
+                    # LB answering "Retry-After: 3600") must not stall
+                    # the tick for hours inside a single read; past the
+                    # cap the error surfaces through the
+                    # observe-skip/breaker machinery instead
+                    delay = self.retry_base * (2.0 ** attempt)
+                    delay *= 0.5 + self._retry_rng.random()
+                    if retry_after is not None:
+                        delay = max(delay, min(retry_after, RETRY_AFTER_CAP))
+                    metrics.update_kube_request_retry()
+                    log.vlog(
+                        2,
+                        "kube %s %s failed transiently (%s); "
+                        "retry %d/%d in %.2fs",
+                        method, path, err, attempt + 1, self.retry_max,
+                        delay,
+                    )
+                    self._retry_sleep(delay)
+                    attempt += 1
 
     def _request(
         self,

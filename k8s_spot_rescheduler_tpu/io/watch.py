@@ -433,6 +433,19 @@ class Watcher(threading.Thread):
                 # (the branch below), never a tight relist loop
                 self.stall_count += 1
                 metrics.update_watch_stall(self.resource)
+                # same event, third surface: the flight recorder keeps
+                # the stall in the postmortem ring beside the counters
+                # (fires on the watcher thread — between ticks — so no
+                # tick trace ID to carry)
+                from k8s_spot_rescheduler_tpu.loop import flight
+
+                flight.note_event(
+                    "watch-stall",
+                    cause="stream open but silent past the %.0fs "
+                          "progress deadline; reconnected from rv=%s"
+                          % (self.progress_deadline, self._rv),
+                    resource=self.resource,
+                )
                 log.error(
                     "watch %s: stream open but silent past the %.0fs "
                     "progress deadline; killing and reconnecting from "

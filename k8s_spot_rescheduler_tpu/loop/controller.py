@@ -52,7 +52,7 @@ from typing import List, Optional
 
 from k8s_spot_rescheduler_tpu.actuator.drain import DrainError, drain_node
 from k8s_spot_rescheduler_tpu.io.cluster import ClusterClient, EventSink
-from k8s_spot_rescheduler_tpu.loop import health
+from k8s_spot_rescheduler_tpu.loop import flight, health
 from k8s_spot_rescheduler_tpu.metrics import registry as metrics
 from k8s_spot_rescheduler_tpu.models.cluster import (
     NodeMap,
@@ -133,6 +133,13 @@ class Rescheduler:
         # (the startup LIST is itself fresh)
         self._next_resync_wall: Optional[float] = None
         health.STATE.set_clock(self.clock.now)
+        # flight recorder (loop/flight.py): ring size + dump dir come
+        # from config; recorded history survives reconstruction (the
+        # chaos soak restarts the controller mid-run)
+        flight.configure(
+            ring_size=config.flight_ring_size,
+            dump_dir=config.flight_dump_dir,
+        )
         if config.reconcile_orphaned_taints and startup_sweep:
             # startup sweep: a previous process may have died mid-drain,
             # leaving a ToBeDeleted taint nobody owns. ``startup_sweep``
@@ -328,12 +335,18 @@ class Rescheduler:
                 "Planner %r failed: %s; degrading tick to the numpy-oracle "
                 "fallback", self.config.solver, err,
             )
-            # one event, two surfaces: the Prometheus counter and the
-            # /healthz field increment together, per contained planner
-            # exception (re-plans inside a multi-drain tick included),
-            # so the two never diverge
+            # one event, three surfaces: the Prometheus counter, the
+            # /healthz field and the flight-recorder event fire together,
+            # per contained planner exception (re-plans inside a
+            # multi-drain tick included), so the three never diverge
             metrics.update_planner_fallback()
             health.STATE.note_planner_fallback()
+            flight.note_event(
+                "planner-fallback",
+                cause=f"{type(err).__name__}: {err}",
+                trace_id=tracing.current_trace_id(),
+                solver=self.config.solver,
+            )
         try:
             if run_metrics:
                 # the primary may have died before its metrics pass ran;
@@ -446,6 +459,13 @@ class Rescheduler:
             recovered.append(node.name)
             metrics.update_taint_recovered()
             health.STATE.note_taint_recovered()
+            flight.note_event(
+                "orphan-taint-recovered",
+                cause="removed orphaned ToBeDeleted taint left by an "
+                      "interrupted drain",
+                trace_id=tracing.current_trace_id(),
+                node=node.name,
+            )
             log.info("Recovered orphaned %s taint on %s",
                      TO_BE_DELETED_TAINT, node.name)
             self.recorder.event(
@@ -547,6 +567,12 @@ class Rescheduler:
             "direct LIST this tick (cache bypassed)", staleness, budget,
         )
         metrics.update_freshness_bypass()
+        flight.note_event(
+            "freshness-bypass",
+            cause="watch mirror %.1fs stale (budget %.1fs); direct-LIST "
+                  "observe this tick" % (staleness, budget),
+            trace_id=tracing.current_trace_id(),
+        )
         self._observe_client = bypass
         return None
 
@@ -590,6 +616,32 @@ class Rescheduler:
     # --- the tick ---
 
     def tick(self) -> TickResult:
+        """One housekeeping pass, scoped under a fresh tick trace
+        (``trace_enabled``): every phase, kube read, drain round and —
+        in agent mode — the service round trip record into one span
+        tree, which lands in the flight ring when the tick completes."""
+        trace = (
+            tracing.start_trace() if self.config.trace_enabled else None
+        )
+        try:
+            result = self._tick_guarded()
+        finally:
+            if trace is not None:
+                tracing.end_trace(trace)
+        if trace is not None:
+            trace.set_attr("skipped", result.skipped)
+            if result.planner_fallback:
+                trace.set_attr("planner_fallback", True)
+            if result.report is not None:
+                trace.set_attr("solver", result.report.solver)
+                trace.set_attr(
+                    "solve_ms",
+                    round(result.report.solve_seconds * 1e3, 3),
+                )
+            flight.record_tick(trace.to_dict())
+        return result
+
+    def _tick_guarded(self) -> TickResult:
         recovered: List[str] = []
         if self.config.reconcile_orphaned_taints:
             # before the gates: an orphaned taint must not wait out a
@@ -613,6 +665,20 @@ class Rescheduler:
         result.recovered_taints = recovered
         if result.skipped == "error":
             self._consecutive_errors += 1
+            if (
+                self.config.breaker_threshold > 0
+                and self._consecutive_errors == self.config.breaker_threshold
+            ):
+                # the ENGAGE edge, once per streak (each further failure
+                # widens the interval but is the same engagement)
+                flight.note_event(
+                    "breaker-engage",
+                    cause="%d consecutive error-skipped ticks; interval "
+                          "widened to %.0fs"
+                          % (self._consecutive_errors,
+                             self.effective_interval()),
+                    trace_id=tracing.current_trace_id(),
+                )
             health.STATE.note_error(
                 self._consecutive_errors,
                 self.effective_interval() if self.breaker_engaged else None,
@@ -679,6 +745,12 @@ class Rescheduler:
             # the mirror aged past the budget while this tick observed
             # — refuse to plan from it (the skip feeds the breaker)
             metrics.update_mirror_stale_planned()
+            flight.note_event(
+                "stale-mirror-plan-refused",
+                cause="mirror aged past the staleness budget between "
+                      "the gate and the plan; tick skipped",
+                trace_id=tracing.current_trace_id(),
+            )
             log.error(
                 "Watch mirror aged past the staleness budget between "
                 "the gate and the plan; skipping the tick"

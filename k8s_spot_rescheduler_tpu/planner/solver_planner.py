@@ -40,6 +40,7 @@ from k8s_spot_rescheduler_tpu.planner.base import PlanReport
 from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
+from k8s_spot_rescheduler_tpu.utils import tracing
 
 
 def _enable_jax_compilation_cache(cache_dir: str) -> None:
@@ -481,25 +482,30 @@ class SolverPlanner:
         between the two so it overlaps the in-flight solve."""
         t0 = time.perf_counter()
         cfg = self.config
-        if hasattr(observation, "pack"):  # ColumnarStore
-            packed, meta = observation.pack(
-                pdbs,
-                priority_threshold=cfg.priority_threshold,
-                delete_non_replicated=cfg.delete_non_replicated_pods,
-                pad_candidates=self._pad_c,
-                pad_spot=self._pad_s,
-                pad_slots=self._pad_k,
-            )
-        else:
-            packed, meta = pack_cluster(
-                observation,
-                pdbs,
-                resources=cfg.resources,
-                delete_non_replicated=cfg.delete_non_replicated_pods,
-                pad_candidates=self._pad_c,
-                pad_spot=self._pad_s,
-                pad_slots=self._pad_k,
-            )
+        # spans land on the controller's ambient tick trace (no-ops
+        # when tracing is off or no trace is active)
+        with tracing.span("plan.pack") as pack_sp:
+            if hasattr(observation, "pack"):  # ColumnarStore
+                packed, meta = observation.pack(
+                    pdbs,
+                    priority_threshold=cfg.priority_threshold,
+                    delete_non_replicated=cfg.delete_non_replicated_pods,
+                    pad_candidates=self._pad_c,
+                    pad_spot=self._pad_s,
+                    pad_slots=self._pad_k,
+                )
+            else:
+                packed, meta = pack_cluster(
+                    observation,
+                    pdbs,
+                    resources=cfg.resources,
+                    delete_non_replicated=cfg.delete_non_replicated_pods,
+                    pad_candidates=self._pad_c,
+                    pad_spot=self._pad_s,
+                    pad_slots=self._pad_k,
+                )
+            if pack_sp is not None:
+                pack_sp.attrs["lanes"] = int(packed.slot_req.shape[0])
         # high-water-mark padding: shapes only ever grow → no recompile churn
         self._pad_c = max(self._pad_c, packed.slot_req.shape[0])
         self._pad_k = max(self._pad_k, packed.slot_req.shape[1])
@@ -544,12 +550,18 @@ class SolverPlanner:
                 self._host_prev = None
             device_packed = packed
             if cfg.incremental_device_cache and single_chip:
-                (
-                    device_packed,
-                    delta_lanes,
-                    full_repack,
-                    upload_bytes,
-                ) = self._upload_incremental(packed)
+                with tracing.span("plan.delta-upload") as up_sp:
+                    (
+                        device_packed,
+                        delta_lanes,
+                        full_repack,
+                        upload_bytes,
+                    ) = self._upload_incremental(packed)
+                    if up_sp is not None:
+                        up_sp.attrs["delta_bytes"] = int(upload_bytes)
+                        up_sp.attrs["lanes"] = int(delta_lanes)
+                        if full_repack:
+                            up_sp.attrs["full_repack"] = True
             elif cfg.staged_chunk_lanes > 0 and single_chip:
                 # cache off but staging on: ship the problem ONCE — the
                 # per-chunk jit calls would otherwise each re-upload the
@@ -575,31 +587,37 @@ class SolverPlanner:
 
         def finish() -> PlanReport:
             staged_stats = None
-            if fetch is not None:
-                sel, staged_stats = fetch()
-                plan = (
-                    meta.build_plan(sel.index, sel.row) if sel.found else None
-                )
-                n_feasible = sel.n_feasible
-            else:
-                # the shared host union (first-fit ∪ best-fit ∪ repair,
-                # cond-gated like the device path) — one implementation
-                # for this branch and the planner service's host path
-                from k8s_spot_rescheduler_tpu.solver.numpy_oracle import (
-                    plan_union_oracle,
-                )
+            with tracing.span("plan.solve"):
+                if fetch is not None:
+                    sel, staged_stats = fetch()
+                    plan = (
+                        meta.build_plan(sel.index, sel.row)
+                        if sel.found
+                        else None
+                    )
+                    n_feasible = sel.n_feasible
+                else:
+                    # the shared host union (first-fit ∪ best-fit ∪
+                    # repair, cond-gated like the device path) — one
+                    # implementation for this branch and the planner
+                    # service's host path
+                    from k8s_spot_rescheduler_tpu.solver.numpy_oracle import (
+                        plan_union_oracle,
+                    )
 
-                result = plan_union_oracle(
-                    packed,
-                    best_fit_fallback=cfg.fallback_best_fit,
-                    repair_rounds=cfg.repair_rounds,
-                )
-                feasible = np.asarray(result.feasible)
-                n_feasible = int(feasible.sum())
-                plan = None
-                if n_feasible:
-                    c = int(np.argmax(feasible))
-                    plan = meta.build_plan(c, np.asarray(result.assignment[c]))
+                    result = plan_union_oracle(
+                        packed,
+                        best_fit_fallback=cfg.fallback_best_fit,
+                        repair_rounds=cfg.repair_rounds,
+                    )
+                    feasible = np.asarray(result.feasible)
+                    n_feasible = int(feasible.sum())
+                    plan = None
+                    if n_feasible:
+                        c = int(np.argmax(feasible))
+                        plan = meta.build_plan(
+                            c, np.asarray(result.assignment[c])
+                        )
 
             self._report_conservatism(packed, meta, n_feasible)
 

@@ -24,6 +24,7 @@ not one connect timeout; the first healthy reply closes the breaker.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
@@ -33,6 +34,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from k8s_spot_rescheduler_tpu.loop import flight
 from k8s_spot_rescheduler_tpu.metrics import registry as metrics
 from k8s_spot_rescheduler_tpu.models.cluster import PDBSpec
 from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
@@ -40,6 +42,7 @@ from k8s_spot_rescheduler_tpu.planner.base import PlanReport
 from k8s_spot_rescheduler_tpu.service import wire
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
+from k8s_spot_rescheduler_tpu.utils import tracing
 
 
 class RemotePlanner:
@@ -78,6 +81,11 @@ class RemotePlanner:
         self._consecutive_failures = 0
         self._skip_until = 0.0  # monotonic; breaker-open horizon
         self.last_solver = "remote"
+        # the trace the last plan recorded into: the controller's tick
+        # trace when one is ambient, else a standalone trace (direct
+        # callers like bench.serve_smoke read the grafted span tree off
+        # this); None with tracing disabled
+        self.last_trace = None
 
     # ------------------------------------------------------------------
 
@@ -128,16 +136,21 @@ class RemotePlanner:
         self._consecutive_failures = 0
         self._skip_until = 0.0
 
-    def _post(self, body: bytes) -> wire.PlanReply:
+    def _post(self, body: bytes, trace_id: str = "") -> wire.PlanReply:
+        headers = {
+            "Content-Type": "application/octet-stream",
+            # declare our own deadline so the service evicts (and
+            # frees the slot of) a request we will have abandoned
+            "X-Planner-Deadline": f"{self.timeout:.3f}",
+        }
+        if trace_id:
+            # belt to the wire frame: proxies/logs see the correlation
+            # id even when the binary body is opaque to them
+            headers["X-Trace-Id"] = trace_id
         req = urllib.request.Request(
             f"{self.url}/v2/plan",
             data=body,
-            headers={
-                "Content-Type": "application/octet-stream",
-                # declare our own deadline so the service evicts (and
-                # frees the slot of) a request we will have abandoned
-                "X-Planner-Deadline": f"{self.timeout:.3f}",
-            },
+            headers=headers,
             method="POST",
         )
         try:
@@ -170,28 +183,50 @@ class RemotePlanner:
         """Pack locally, dispatch the service call on a worker thread
         (the loop's metrics pass overlaps the network round trip exactly
         as it overlaps the in-process device solve), and return the
-        blocking ``finish`` callable."""
+        blocking ``finish`` callable.
+
+        Tracing: the pack and the wire round trip record into the
+        controller's ambient tick trace (or a standalone trace for
+        direct callers); the tick's trace ID ships with the request
+        (wire v2 frame + ``X-Trace-Id``) and the server's own spans come
+        back in the reply and are grafted under ``wire.request`` — one
+        tree separates queue, solve and wire time per tick. The worker
+        thread only stores raw timestamps; all trace mutation happens on
+        the caller's thread at ``finish`` (traces are single-threaded)."""
         t0 = time.perf_counter()
         cfg = self.config
-        if hasattr(observation, "pack"):  # ColumnarStore
-            packed, meta = observation.pack(
-                pdbs,
-                priority_threshold=cfg.priority_threshold,
-                delete_non_replicated=cfg.delete_non_replicated_pods,
-                pad_candidates=self._pad_c,
-                pad_spot=self._pad_s,
-                pad_slots=self._pad_k,
+        trace = tracing.current_trace()
+        if trace is None and cfg.trace_enabled:
+            trace = tracing.Trace()
+        self.last_trace = trace
+
+        def _sp(name, **attrs):
+            return (
+                trace.span(name, **attrs)
+                if trace is not None
+                else contextlib.nullcontext()
             )
-        else:
-            packed, meta = pack_cluster(
-                observation,
-                pdbs,
-                resources=cfg.resources,
-                delete_non_replicated=cfg.delete_non_replicated_pods,
-                pad_candidates=self._pad_c,
-                pad_spot=self._pad_s,
-                pad_slots=self._pad_k,
-            )
+
+        with _sp("plan.pack"):
+            if hasattr(observation, "pack"):  # ColumnarStore
+                packed, meta = observation.pack(
+                    pdbs,
+                    priority_threshold=cfg.priority_threshold,
+                    delete_non_replicated=cfg.delete_non_replicated_pods,
+                    pad_candidates=self._pad_c,
+                    pad_spot=self._pad_s,
+                    pad_slots=self._pad_k,
+                )
+            else:
+                packed, meta = pack_cluster(
+                    observation,
+                    pdbs,
+                    resources=cfg.resources,
+                    delete_non_replicated=cfg.delete_non_replicated_pods,
+                    pad_candidates=self._pad_c,
+                    pad_spot=self._pad_s,
+                    pad_slots=self._pad_k,
+                )
         # high-water pads: stable shapes keep the whole fleet in few
         # service-side buckets (and the service in few compiles)
         self._pad_c = max(self._pad_c, packed.slot_req.shape[0])
@@ -206,15 +241,21 @@ class RemotePlanner:
         box: dict = {}
         worker: Optional[threading.Thread] = None
         if not breaker_open:
-            body = wire.encode_plan_request(self.tenant, packed)
+            trace_id = trace.trace_id if trace is not None else ""
+            body = wire.encode_plan_request(
+                self.tenant, packed, trace_id=trace_id
+            )
 
             def call():
+                box["t_send"] = time.perf_counter()
                 try:
-                    box["reply"] = self._post(body)
+                    box["reply"] = self._post(body, trace_id=trace_id)
                 except _RemoteError as err:
                     box["error"] = err
                 except Exception as err:  # noqa: BLE001 — transport/proto
                     box["error"] = _RemoteError(str(err), 0.0)
+                finally:
+                    box["t_recv"] = time.perf_counter()
 
             worker = threading.Thread(target=call, daemon=True)
             worker.start()
@@ -227,9 +268,34 @@ class RemotePlanner:
                 err = box.get("error")
                 if err is not None:
                     self._note_failure(str(err), err.retry_after)
-                return self._plan_fallback(observation, pdbs)
+                return self._plan_fallback(
+                    observation, pdbs,
+                    cause=str(box.get("error", "breaker open")),
+                )
             self._note_success()
             self.last_solver = "remote"
+            if trace is not None:
+                # graft the server's span block under the measured round
+                # trip; the residual (rtt minus server-side work) is the
+                # wire itself — tunnel, TLS, serialization on the path
+                rtt_ms = max(
+                    0.0, (box["t_recv"] - box["t_send"]) * 1e3
+                )
+                server_ms = sum(d for _, _, d in reply.spans)
+                trace.graft(
+                    tracing.make_span("wire.request", 0.0, rtt_ms),
+                    children=reply.spans,
+                    attrs={
+                        "batch_lanes": reply.batch_lanes,
+                        "batch_tenants": reply.batch_tenants,
+                    },
+                )
+                trace.graft(
+                    tracing.make_span(
+                        "wire.transfer", 0.0,
+                        max(0.0, rtt_ms - server_ms),
+                    )
+                )
             plan = None
             if reply.found and reply.index < meta.n_candidates:
                 plan = meta.build_plan(
@@ -246,11 +312,19 @@ class RemotePlanner:
 
         return finish
 
-    def _plan_fallback(self, observation, pdbs) -> PlanReport:
+    def _plan_fallback(self, observation, pdbs, cause: str = "") -> PlanReport:
         """This tick plans locally (numpy oracle) — the service is down,
-        slow, overloaded or out of protocol. Counted; the loop keeps
-        running at full fidelity minus device speed."""
+        slow, overloaded or out of protocol. Counted (metric + flight
+        event, same site); the loop keeps running at full fidelity minus
+        device speed."""
         metrics.update_remote_planner_fallback()
+        flight.note_event(
+            "remote-planner-fallback",
+            cause=cause or "planner service unusable",
+            trace_id=tracing.current_trace_id() or (
+                self.last_trace.trace_id if self.last_trace else ""
+            ),
+        )
         report = self._fallback_planner().plan(observation, pdbs)
         self.last_solver = "remote-fallback"
         return dataclasses.replace(report, solver="remote-fallback")

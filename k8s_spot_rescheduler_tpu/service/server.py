@@ -38,12 +38,14 @@ from __future__ import annotations
 import json
 import math
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from k8s_spot_rescheduler_tpu.loop import flight
 from k8s_spot_rescheduler_tpu.metrics import registry as metrics
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.service import buckets as bucketing
@@ -52,6 +54,7 @@ from k8s_spot_rescheduler_tpu.service.buckets import Bucket
 from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
+from k8s_spot_rescheduler_tpu.utils import tracing
 
 
 class ServiceBusy(Exception):
@@ -74,11 +77,11 @@ TENANT_STATE_MAX = 4096
 class _Request:
     __slots__ = (
         "tenant", "packed", "bucket", "lanes", "enqueued", "event",
-        "reply", "error",
+        "reply", "error", "trace_id",
     )
 
     def __init__(self, tenant: str, packed: PackedCluster, bucket: Bucket,
-                 enqueued: float):
+                 enqueued: float, trace_id: str = ""):
         self.tenant = tenant
         self.packed = packed
         self.bucket = bucket
@@ -90,6 +93,10 @@ class _Request:
         self.event = threading.Event()
         self.reply: Optional[wire.PlanReply] = None
         self.error: Optional[ServiceBusy] = None
+        # the agent's tick trace ID (wire v2 / X-Trace-Id): server-side
+        # spans are keyed by it so the reply's span block grafts into
+        # the right tick tree on the far side
+        self.trace_id = trace_id
 
 
 class PlannerService:
@@ -140,11 +147,14 @@ class PlannerService:
     # ------------------------------------------------------------------
     # queue
 
-    def submit_nowait(self, tenant: str, packed: PackedCluster) -> _Request:
+    def submit_nowait(
+        self, tenant: str, packed: PackedCluster, trace_id: str = ""
+    ) -> _Request:
         """Enqueue one problem; returns the pending request (its
         ``event`` fires when a batch delivered ``reply`` or ``error``)."""
         req = _Request(
-            tenant, packed, bucketing.bucket_for(packed), self.clock.now()
+            tenant, packed, bucketing.bucket_for(packed), self.clock.now(),
+            trace_id=trace_id,
         )
         with self._work:
             q = self._queues.get(tenant)
@@ -162,6 +172,7 @@ class PlannerService:
         tenant: str,
         packed: PackedCluster,
         timeout_s: Optional[float] = None,
+        trace_id: str = "",
     ) -> wire.PlanReply:
         """Enqueue and wait for the batch that carries this request.
         Raises :class:`ServiceBusy` when the bounded wait expires — the
@@ -173,7 +184,7 @@ class PlannerService:
         wait_s = self.queue_timeout_s
         if timeout_s is not None and timeout_s > 0:
             wait_s = max(0.05, min(wait_s, float(timeout_s)))
-        req = self.submit_nowait(tenant, packed)
+        req = self.submit_nowait(tenant, packed, trace_id=trace_id)
         if self._thread is None:
             # no scheduler thread (an in-process caller — e.g.
             # PlannerSidecar.plan without start_background): drain the
@@ -185,6 +196,13 @@ class PlannerService:
             if self._evict(req):
                 metrics.update_service_request("expired")
                 metrics.update_service_tenant_eviction(req.tenant)
+                flight.note_event(
+                    "service-shed",
+                    cause="plan request waited past the %.1fs queue "
+                          "timeout" % wait_s,
+                    trace_id=req.trace_id,
+                    tenant=req.tenant,
+                )
                 raise ServiceBusy(
                     "plan request waited past the %.1fs queue timeout"
                     % wait_s,
@@ -344,6 +362,7 @@ class PlannerService:
                 bucketing.pad_to_bucket(r.packed, bucket) for r in batch
             ]
             stacked = bucketing.stack_bucket(padded, bucket)
+            t_solve = self.clock.now()
             if self.solve_hook is not None:
                 out = np.asarray(self.solve_hook(stacked, batch))
             else:
@@ -356,6 +375,8 @@ class PlannerService:
                 metrics.update_service_request("error")
                 req.event.set()
             return True
+        batch_ms = (t_solve - t0) * 1e3
+        solve_wall_ms = (self.clock.now() - t_solve) * 1e3
         solve_ms = (self.clock.now() - t0) * 1e3
         lanes = sum(r.lanes for r in batch)
         tenants = len({r.tenant for r in batch})
@@ -405,6 +426,24 @@ class PlannerService:
                 queue_wait_ms=float(waits_ms[i]),
                 batch_lanes=lanes,
                 batch_tenants=tenants,
+                # server-side spans, offset from THIS request's enqueue:
+                # how its wall time split between the tenant queue, the
+                # bucket pad/stack, and the shared solve. The HTTP layer
+                # prepends admit/decode and appends encode; the agent
+                # grafts the whole block under its wire.request span.
+                spans=(
+                    tracing.make_span(
+                        "service.queue-wait", 0.0, waits_ms[i]
+                    ),
+                    tracing.make_span(
+                        "service.batch", waits_ms[i], batch_ms
+                    ),
+                    tracing.make_span(
+                        "service.solve",
+                        waits_ms[i] + batch_ms,
+                        solve_wall_ms,
+                    ),
+                ),
             )
             metrics.update_service_request("ok")
             req.event.set()
@@ -585,6 +624,18 @@ class ServiceServer:
         self.max_inflight = int(max_inflight)
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # flight recorder knobs ride the same config the control loop
+        # uses; in service-only mode this process records request-level
+        # degradation events (sheds, solve failures) instead of ticks
+        flight.configure(
+            ring_size=config.flight_ring_size,
+            dump_dir=config.flight_dump_dir,
+        )
+        # the last few requests' server-side span blocks, keyed by the
+        # agent trace ID that sent them (/debug/trace on a service that
+        # has no tick of its own)
+        self._recent_lock = threading.Lock()
+        self._recent: deque = deque(maxlen=32)
         host, _, port = address.rpartition(":")
         server = self
 
@@ -616,6 +667,29 @@ class ServiceServer:
                     }
                     out.update(server.service.healthz_snapshot())
                     out.update(health.snapshot())
+                    return self._send_json(out)
+                if self.path.startswith("/debug/"):
+                    return self._debug_get()
+                return self._send_json({"error": "not found"}, 404)
+
+            def _debug_get(self):
+                """/debug/trace (last tick tree + recent server span
+                blocks) and /debug/flight (ring summary; ?dump=1 writes
+                a postmortem). Gated OFF by default — 404, not 403, so
+                a disabled surface is indistinguishable from an absent
+                one."""
+                if not server.config.debug_endpoints:
+                    return self._send_json({"error": "not found"}, 404)
+                path, _, query = self.path.partition("?")
+                if path == "/debug/trace":
+                    return self._send_json({
+                        "last_tick": flight.last_tick(),
+                        "recent_requests": server.recent_request_traces(),
+                    })
+                if path == "/debug/flight":
+                    out = flight.snapshot()
+                    if "dump=1" in query.split("&"):
+                        out["dumped"] = flight.dump("debug-endpoint")
                     return self._send_json(out)
                 return self._send_json({"error": "not found"}, 404)
 
@@ -655,6 +729,12 @@ class ServiceServer:
                     return None
                 if not server._admit():
                     metrics.update_service_request("rejected")
+                    flight.note_event(
+                        "service-shed",
+                        cause="planner overloaded (%d requests in flight)"
+                        % server.max_inflight,
+                        trace_id=self.headers.get("X-Trace-Id", "") or "",
+                    )
                     self._reject_unread(
                         {
                             "error": "planner overloaded (%d requests in "
@@ -685,18 +765,37 @@ class ServiceServer:
                 return self._reject_unread({"error": "not found"}, 404)
 
             def _post_wire(self):
+                t_req = time.perf_counter()
                 body = self._read_body()
                 if body is None:
                     return
+                # the reply speaks the REQUEST's protocol version so an
+                # un-upgraded v1 agent keeps decoding; before a
+                # successful decode the raw header byte is the best
+                # guess (falling back to v1, which every decoder speaks)
+                raw_version = body[4] if len(body) > 4 else 0
+                reply_version = (
+                    raw_version
+                    if raw_version in wire.SUPPORTED_VERSIONS
+                    else 1
+                )
                 try:
+                    admit_ms = (time.perf_counter() - t_req) * 1e3
                     try:
-                        tenant, packed = wire.decode_plan_request(body)
+                        t_dec = time.perf_counter()
+                        req = wire.decode_plan_request_ex(body)
+                        decode_ms = (time.perf_counter() - t_dec) * 1e3
                     except wire.WireError as err:
                         metrics.update_service_request("error")
                         return self._send_bytes(
-                            wire.encode_error(str(err)),
+                            wire.encode_error(
+                                str(err), version=reply_version
+                            ),
                             "application/octet-stream", 400,
                         )
+                    trace_id = req.trace_id or (
+                        self.headers.get("X-Trace-Id", "") or ""
+                    )
                     try:
                         # the agent declares its own HTTP deadline:
                         # waiting longer server-side would batch-solve
@@ -710,24 +809,50 @@ class ServiceServer:
                         except (TypeError, ValueError):
                             deadline = 0.0
                         reply = server.service.submit(
-                            tenant, packed,
+                            req.tenant, req.packed,
                             timeout_s=deadline or None,
+                            trace_id=trace_id,
                         )
                     except ServiceBusy as err:
                         return self._send_bytes(
-                            wire.encode_error(str(err)),
+                            wire.encode_error(
+                                str(err), version=reply_version
+                            ),
                             "application/octet-stream", 503,
                             headers=[("Retry-After", str(err.retry_after))],
                         )
+                    # complete the server-side span block: admit (slot
+                    # + body read) and decode ahead of the queue spans,
+                    # encode measured on a first encode and shipped via
+                    # a second (the reply is a few hundred bytes; the
+                    # re-encode costs less than leaving the span out)
+                    spans = (
+                        tracing.make_span("service.admit", 0.0, admit_ms),
+                        tracing.make_span(
+                            "service.decode", admit_ms, decode_ms
+                        ),
+                    ) + reply.spans
+                    t_enc = time.perf_counter()
+                    wire.encode_plan_reply(
+                        reply._replace(spans=spans), version=req.version
+                    )
+                    encode_ms = (time.perf_counter() - t_enc) * 1e3
+                    spans = spans + (
+                        tracing.make_span("service.encode", 0.0, encode_ms),
+                    )
+                    server.note_request_trace(trace_id, req.tenant, spans)
                     return self._send_bytes(
-                        wire.encode_plan_reply(reply),
+                        wire.encode_plan_reply(
+                            reply._replace(spans=spans),
+                            version=req.version,
+                        ),
                         "application/octet-stream",
                     )
                 except Exception as err:  # noqa: BLE001 — handler survives
                     log.error("service /v2/plan failed: %s", err)
                     metrics.update_service_request("error")
                     return self._send_bytes(
-                        wire.encode_error(str(err)),
+                        wire.encode_error(str(err), version=reply_version),
                         "application/octet-stream", 500,
                     )
                 finally:
@@ -773,6 +898,26 @@ class ServiceServer:
     def _release(self) -> None:
         with self._inflight_lock:
             self._inflight -= 1
+
+    def note_request_trace(self, trace_id: str, tenant: str, spans) -> None:
+        """Remember one request's server-side span block, keyed by the
+        agent's trace ID (/debug/trace on the service process). The
+        tenant id is client-supplied and /debug responses may leave the
+        process, so it rides hashed per the redaction policy."""
+        entry = {
+            "trace_id": trace_id,
+            "tenant": flight.redact_text(tenant),
+            "spans": [
+                {"name": n, "t0_ms": round(t0, 3), "dur_ms": round(d, 3)}
+                for n, t0, d in spans
+            ],
+        }
+        with self._recent_lock:
+            self._recent.append(entry)
+
+    def recent_request_traces(self) -> list:
+        with self._recent_lock:
+            return list(self._recent)
 
     @property
     def address(self) -> str:

@@ -35,17 +35,35 @@ clean 400, never a crash — so a mixed-version fleet fails request by
 request, loudly, instead of corrupting tensors. Every bump must update
 the byte-golden fixtures in tests/test_wire_fixtures.py in the same
 commit; the goldens exist precisely so this file cannot drift silently.
+
+Version history
+---------------
+- **1** — the original PLAN_REQUEST / PLAN_REPLY / PACKED_DELTA / ERROR
+  layout. Still fully decodable (``SUPPORTED_VERSIONS``): a version-1
+  payload from an un-upgraded agent plans exactly as before, and the
+  service answers it in version 1 (the reply mirrors the request's
+  version), so a mixed-version fleet interoperates without flag days.
+- **2** — tick tracing (docs/OBSERVABILITY.md): PLAN_REQUEST may carry
+  an optional ``trace_id`` frame (the agent's tick trace ID, also sent
+  as ``X-Trace-Id``), and PLAN_REPLY may carry three optional span
+  frames (``span_names``/``span_t0_ms``/``span_dur_ms``) returning the
+  server-side spans — queue-wait, batch assembly, solve, ... — the
+  agent grafts into its tick trace. All trace frames are optional:
+  their absence is a valid version-2 message. The bump (rather than
+  frame addition alone) marks the reply-mirroring contract: a v2-aware
+  peer may rely on span frames surviving the round trip.
 """
 
 from __future__ import annotations
 
 import struct
-from typing import Dict, List, NamedTuple, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 MAGIC = b"KSRW"
-WIRE_VERSION = 1
+WIRE_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 
 # message kinds (u8). New kinds append; renumbering is a version bump.
 KIND_PLAN_REQUEST = 1  # agent -> service: tenant + PackedCluster
@@ -105,12 +123,21 @@ def _encode_frame(name: str, arr: np.ndarray) -> bytes:
     return b"".join(parts)
 
 
-def encode_frames(kind: int, frames: List[Tuple[str, np.ndarray]]) -> bytes:
+def encode_frames(
+    kind: int,
+    frames: List[Tuple[str, np.ndarray]],
+    version: Optional[int] = None,
+) -> bytes:
     """One wire message: header + the given (name, array) frames, in
-    the given order (the order is part of the byte-golden contract)."""
+    the given order (the order is part of the byte-golden contract).
+    ``version`` defaults to ``WIRE_VERSION``; the server passes the
+    REQUEST's version so an un-upgraded agent can decode its reply."""
+    version = WIRE_VERSION if version is None else int(version)
+    if version not in SUPPORTED_VERSIONS:
+        raise WireError(f"cannot encode unsupported wire version {version}")
     if len(frames) > MAX_FRAMES:
         raise WireError(f"{len(frames)} frames exceeds the {MAX_FRAMES} cap")
-    out = [_HEADER.pack(MAGIC, WIRE_VERSION, kind, len(frames))]
+    out = [_HEADER.pack(MAGIC, version, kind, len(frames))]
     out.extend(_encode_frame(n, a) for n, a in frames)
     return b"".join(out)
 
@@ -132,16 +159,28 @@ class _Reader:
 
 
 def decode_frames(data: bytes) -> Tuple[int, Dict[str, np.ndarray]]:
-    """(kind, {name: array}) or a typed WireError. Arrays are zero-copy
-    views into ``data`` (read-only) — the solve path only reads them."""
+    """(kind, {name: array}) or a typed WireError; see
+    :func:`decode_frames_v` for the variant that also reports the
+    message's protocol version."""
+    _, kind, frames = decode_frames_v(data)
+    return kind, frames
+
+
+def decode_frames_v(data: bytes) -> Tuple[int, int, Dict[str, np.ndarray]]:
+    """(version, kind, {name: array}) or a typed WireError. Arrays are
+    zero-copy views into ``data`` (read-only) — the solve path only
+    reads them. Every version in ``SUPPORTED_VERSIONS`` decodes (a
+    version-1 payload from an un-upgraded agent simply carries no trace
+    frames); anything else is a clean :class:`WireVersionError`."""
     r = _Reader(bytes(data) if isinstance(data, (bytearray, memoryview)) else data)
     magic, version, kind, n_frames = _HEADER.unpack(r.take(_HEADER.size, "header"))
     if magic != MAGIC:
         raise WireError(f"bad magic {magic!r} (not a planner wire message)")
-    if version != WIRE_VERSION:
+    if version not in SUPPORTED_VERSIONS:
         raise WireVersionError(
             f"wire version {version} not supported (this build speaks "
-            f"{WIRE_VERSION}; see the version bump policy in service/wire.py)"
+            f"{sorted(SUPPORTED_VERSIONS)}; see the version bump policy "
+            "in service/wire.py)"
         )
     if kind not in (
         KIND_PLAN_REQUEST, KIND_PLAN_REPLY, KIND_PACKED_DELTA, KIND_ERROR
@@ -179,7 +218,7 @@ def decode_frames(data: bytes) -> Tuple[int, Dict[str, np.ndarray]]:
         frames[name] = np.frombuffer(payload, dtype).reshape(shape)
     if r.pos != len(r.data):
         raise WireError(f"{len(r.data) - r.pos} trailing bytes after last frame")
-    return kind, frames
+    return version, kind, frames
 
 
 # ---------------------------------------------------------------------------
@@ -238,11 +277,21 @@ def _frame_str(arr: np.ndarray, what: str) -> str:
         raise WireError(f"{what} is not valid utf-8: {err}") from err
 
 
-def encode_plan_request(tenant: str, packed) -> bytes:
-    """Agent -> service: one tenant's full packed problem."""
+def encode_plan_request(
+    tenant: str,
+    packed,
+    trace_id: str = "",
+    version: Optional[int] = None,
+) -> bytes:
+    """Agent -> service: one tenant's full packed problem, optionally
+    stamped with the agent's tick trace ID (wire v2; omitted when empty
+    or when encoding a version-1 message for an old server)."""
+    version = WIRE_VERSION if version is None else int(version)
     frames: List[Tuple[str, np.ndarray]] = [("tenant", _str_frame(tenant))]
     frames.extend((f, getattr(packed, f)) for f in type(packed)._fields)
-    return encode_frames(KIND_PLAN_REQUEST, frames)
+    if trace_id and version >= 2:
+        frames.append(("trace_id", _str_frame(trace_id)))
+    return encode_frames(KIND_PLAN_REQUEST, frames, version=version)
 
 
 def _check_tensor_fields(frames, dtypes, ranks, what):
@@ -264,19 +313,40 @@ def _check_tensor_fields(frames, dtypes, ranks, what):
     return out
 
 
+class PlanRequest(NamedTuple):
+    """A fully-decoded plan request: its protocol version (the reply
+    mirrors it), tenant, problem tensors, and the optional trace ID."""
+
+    version: int
+    tenant: str
+    packed: object  # PackedCluster
+    trace_id: str
+
+
 def decode_plan_request(data: bytes):
-    """(tenant, PackedCluster) from KIND_PLAN_REQUEST bytes; every
-    tensor's dtype and rank is checked against the pack contract, and
-    the cross-field shape consistency (shared C/K/S/R/W/A dims) is
-    verified — a request that decodes is safe to pad, stack and solve."""
+    """(tenant, PackedCluster) from KIND_PLAN_REQUEST bytes; see
+    :func:`decode_plan_request_ex` for version + trace metadata."""
+    req = decode_plan_request_ex(data)
+    return req.tenant, req.packed
+
+
+def decode_plan_request_ex(data: bytes) -> PlanRequest:
+    """Full decode of KIND_PLAN_REQUEST bytes; every tensor's dtype and
+    rank is checked against the pack contract, and the cross-field
+    shape consistency (shared C/K/S/R/W/A dims) is verified — a request
+    that decodes is safe to pad, stack and solve. The ``trace_id`` is
+    empty for version-1 payloads (or when the agent sent none)."""
     from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 
-    kind, frames = decode_frames(data)
+    version, kind, frames = decode_frames_v(data)
     if kind != KIND_PLAN_REQUEST:
         raise WireError(f"expected PLAN_REQUEST, got kind {kind}")
     tenant = _frame_str(frames.get("tenant", np.zeros(0, np.uint8)), "tenant id")
     if not tenant:
         raise WireError("plan request carries no tenant id")
+    trace_id = ""
+    if "trace_id" in frames:
+        trace_id = _frame_str(frames["trace_id"], "trace id")
     t = _check_tensor_fields(frames, _PACKED_DTYPES, _PACKED_RANKS, "plan request")
     C, K, R = t["slot_req"].shape
     S = t["spot_free"].shape[0]
@@ -295,16 +365,16 @@ def decode_plan_request(data: bytes):
                 f"inconsistent with (C={C}, K={K}, S={S}, R={R}, W={W}, "
                 f"A={A}) — expected {shape}"
             )
-    return tenant, PackedCluster(**t)
+    return PlanRequest(version, tenant, PackedCluster(**t), trace_id)
 
 
-def encode_packed_delta(tenant: str, delta) -> bytes:
+def encode_packed_delta(tenant: str, delta, version: Optional[int] = None) -> bytes:
     """Agent -> service: a churn-proportional PackedDelta (the wire
     twin of the device-resident scatter path; a future delta-shipping
     agent sends this instead of the full pack when shapes are stable)."""
     frames: List[Tuple[str, np.ndarray]] = [("tenant", _str_frame(tenant))]
     frames.extend((f, getattr(delta, f)) for f in type(delta)._fields)
-    return encode_frames(KIND_PACKED_DELTA, frames)
+    return encode_frames(KIND_PACKED_DELTA, frames, version=version)
 
 
 def decode_packed_delta(data: bytes):
@@ -342,7 +412,10 @@ class PlanReply(NamedTuple):
     """The selection + batch telemetry one plan request gets back —
     deliberately the same few hundred bytes the in-process device
     boundary fetches (solver/select.Selection), plus what the agent's
-    metrics need to see about the batch it rode in."""
+    metrics need to see about the batch it rode in. ``spans`` (wire v2)
+    carries the server-side trace spans as flat
+    ``(name, t0_ms, dur_ms)`` tuples the agent grafts into its tick
+    trace; empty on version-1 replies."""
 
     found: bool
     index: int
@@ -352,9 +425,11 @@ class PlanReply(NamedTuple):
     queue_wait_ms: float  # this request's time in the tenant queue
     batch_lanes: int  # candidate lanes in the batch it rode in
     batch_tenants: int  # tenant lane-blocks sharing that batch
+    spans: Tuple[Tuple[str, float, float], ...] = ()
 
 
-def encode_plan_reply(reply: PlanReply) -> bytes:
+def encode_plan_reply(reply: PlanReply, version: Optional[int] = None) -> bytes:
+    version = WIRE_VERSION if version is None else int(version)
     frames = [
         ("found", np.array([reply.found], np.uint8)),
         ("index", np.array([reply.index], "<i4")),
@@ -365,7 +440,22 @@ def encode_plan_reply(reply: PlanReply) -> bytes:
         ("batch_lanes", np.array([reply.batch_lanes], "<i4")),
         ("batch_tenants", np.array([reply.batch_tenants], "<i4")),
     ]
-    return encode_frames(KIND_PLAN_REPLY, frames)
+    if reply.spans and version >= 2:
+        # the compact server-span block: newline-joined names + two
+        # parallel f4 vectors. Names come from utils/tracing.SPAN_NAMES
+        # (never cluster-derived strings) so the frame stays both small
+        # and redaction-clean.
+        names = [s[0] for s in reply.spans]
+        if any("\n" in n for n in names):
+            raise WireError("span names must not contain newlines")
+        frames.append(("span_names", _str_frame("\n".join(names))))
+        frames.append(
+            ("span_t0_ms", np.asarray([s[1] for s in reply.spans], "<f4"))
+        )
+        frames.append(
+            ("span_dur_ms", np.asarray([s[2] for s in reply.spans], "<f4"))
+        )
+    return encode_frames(KIND_PLAN_REPLY, frames, version=version)
 
 
 def _scalar(frames, name, dtype, what):
@@ -373,6 +463,25 @@ def _scalar(frames, name, dtype, what):
     if arr is None or arr.dtype != np.dtype(dtype) or arr.size != 1:
         raise WireError(f"{what} frame {name!r} missing or malformed")
     return arr.reshape(())[()]
+
+
+def _decode_reply_spans(frames) -> Tuple[Tuple[str, float, float], ...]:
+    """The optional server-span block of a v2 reply; () when absent.
+    Malformed span frames are a WireError like any other frame — a
+    reply that claims spans must carry a coherent block."""
+    names_frame = frames.get("span_names")
+    if names_frame is None:
+        return ()
+    names = _frame_str(names_frame, "span names").split("\n")
+    t0 = frames.get("span_t0_ms")
+    dur = frames.get("span_dur_ms")
+    for name, arr in (("span_t0_ms", t0), ("span_dur_ms", dur)):
+        if arr is None or arr.dtype != np.dtype("<f4") or arr.ndim != 1 \
+                or arr.size != len(names):
+            raise WireError(f"plan reply frame {name!r} missing or malformed")
+    return tuple(
+        (names[i], float(t0[i]), float(dur[i])) for i in range(len(names))
+    )
 
 
 def decode_plan_reply(data: bytes) -> PlanReply:
@@ -400,10 +509,16 @@ def decode_plan_reply(data: bytes) -> PlanReply:
         batch_tenants=int(
             _scalar(frames, "batch_tenants", "<i4", "plan reply")
         ),
+        spans=_decode_reply_spans(frames),
     )
 
 
-def encode_error(message: str) -> bytes:
+def encode_error(message: str, version: Optional[int] = None) -> bytes:
     """In-protocol error body (rides under an HTTP error status so
-    binary clients never have to sniff JSON out of an octet stream)."""
-    return encode_frames(KIND_ERROR, [("message", _str_frame(message))])
+    binary clients never have to sniff JSON out of an octet stream).
+    ``version`` mirrors the request's when known; version 1 is the safe
+    answer to a request whose version could not be read (both old and
+    new decoders accept it)."""
+    return encode_frames(
+        KIND_ERROR, [("message", _str_frame(message))], version=version
+    )

@@ -201,6 +201,26 @@ class ReschedulerConfig:
     # pays the LIST cost; background in cadence, not threading.
     # 0 disables.
     resync_interval: float = 300.0
+    # --- tick tracing + flight recorder (docs/OBSERVABILITY.md) ---
+    # Per-tick span-tree tracing (utils/tracing.py): a tick-scoped
+    # trace ID threaded through observe/plan/actuate, the kube read
+    # path, and — in agent mode — across the planner-service wire
+    # (X-Trace-Id + wire v2 trace frame; server spans graft back into
+    # the tick tree). Always-on-cheap (O(spans) host work, no device
+    # syncs); off = the phase histograms alone, as before.
+    trace_enabled: bool = True
+    # Flight recorder (loop/flight.py): how many completed tick traces
+    # the in-memory postmortem ring retains.
+    flight_ring_size: int = 64
+    # Directory the flight recorder auto-dumps a redacted JSON
+    # postmortem into whenever a degradation edge fires (planner
+    # fallback, breaker engage, freshness bypass, watch stall, service
+    # shed); empty = never write to disk (ring + /debug only).
+    flight_dump_dir: str = ""
+    # Serve GET /debug/trace (last tick tree) and /debug/flight (ring
+    # summary + dump trigger) on the sidecar/service HTTP servers.
+    # Off by default: debug surfaces are opt-in, never ambient.
+    debug_endpoints: bool = False
 
     def __post_init__(self):
         from k8s_spot_rescheduler_tpu.utils.labels import validate_label
@@ -241,3 +261,5 @@ class ReschedulerConfig:
             raise ValueError(
                 "chaos_watch_stall_rate must be a probability in [0, 1]"
             )
+        if self.flight_ring_size < 1:
+            raise ValueError("flight_ring_size must be >= 1")

@@ -494,6 +494,60 @@ def test_config_doc_mention_required(tmp_path):
     assert "undocumented" in r.stdout and "PARITY.md" in r.stdout
 
 
+# --- trace-contract -------------------------------------------------------
+
+
+def test_seeded_trace_contract(tmp_path):
+    """Both directions: an emitted-but-undeclared span name and a
+    declared-but-never-emitted registry entry each turn the gate red;
+    a name emitted AND declared is clean."""
+    _seed(tmp_path, "pkg/utils/tracing.py", """\
+        SPAN_NAMES = {
+            "good": "emitted below",
+            "dead": "declared here, emitted nowhere",
+        }
+
+        def span(name, **attrs):
+            pass
+
+        def phase(name):
+            pass
+
+        def make_span(name, t0_ms, dur_ms):
+            return (name, t0_ms, dur_ms)
+    """)
+    _seed(tmp_path, "pkg/loop/ctrl.py", """\
+        from pkg.utils import tracing
+
+        def tick():
+            with tracing.phase("good"):
+                pass
+            with tracing.span("rogue"):
+                pass
+            return tracing.make_span("good", 0.0, 1.0)
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    assert "rogue" in r.stdout  # emitted, never declared
+    assert "dead" in r.stdout  # declared, never emitted
+    hits = [l for l in r.stdout.splitlines() if "trace-contract" in l]
+    assert len(hits) == 2, r.stdout  # 'good' is clean in both directions
+
+
+def test_trace_contract_inert_without_registry(tmp_path):
+    """A tree with no utils/tracing.py SPAN_NAMES (every other fixture
+    tree in this file) must not be forced to carry one."""
+    _seed(tmp_path, "pkg/loop/ctrl.py", """\
+        from pkg.utils import tracing
+
+        def tick():
+            with tracing.span("anything"):
+                pass
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "trace-contract" not in r.stdout
+
+
 # --- kube-write-retry -----------------------------------------------------
 
 

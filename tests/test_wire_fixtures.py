@@ -255,18 +255,54 @@ def test_wire_full_tick_drains_the_worker(wire_stub):
 # digest mismatch without a version bump is silent protocol drift, the
 # exact failure class these goldens exist to catch.
 
-GOLDEN_REQUEST_SHA256 = (
+# --- version-1 goldens (the shipped PR-8 protocol) ---
+# Encoding with version=1 must stay BIT-IDENTICAL to what version-1-only
+# builds shipped: these digests are copied unchanged from before the v2
+# bump — the strongest possible proof that the bump is purely additive
+# on the wire and an un-upgraded peer sees the exact old bytes.
+GOLDEN_V1_REQUEST_SHA256 = (
     "5177a98ea2b36e152282bdb8729be717c96f7ad1bd8d017ffed2dba9dbcbba4f"
 )
-GOLDEN_DELTA_SHA256 = (
+GOLDEN_V1_DELTA_SHA256 = (
     "c963fd338eae41819ffb9b43e4442f4e1cb0264990f98955b7f6c69b389a22a9"
 )
-GOLDEN_REPLY_SHA256 = (
+GOLDEN_V1_REPLY_SHA256 = (
     "3eaa5c27844e5ed2f355ae28c5e592c75c012159cc0053c622b83497ef93a58c"
 )
-# header of the golden request: MAGIC "KSRW" | version=1 | kind=1
+# header of the v1 golden request: MAGIC "KSRW" | version=1 | kind=1
 # (PLAN_REQUEST) | 12 frames, then the first frame's name tag
-GOLDEN_REQUEST_HEAD_HEX = "4b53525701010c00060074656e616e74"
+GOLDEN_V1_REQUEST_HEAD_HEX = "4b53525701010c00060074656e616e74"
+
+# --- version-2 goldens (trace frames, ISSUE 9) ---
+# Same layouts, version byte 2, plus the OPTIONAL trace frames: a
+# trace_id frame on requests, span_names/span_t0_ms/span_dur_ms on
+# replies. Both with-and-without variants are pinned.
+GOLDEN_V2_REQUEST_SHA256 = (
+    "3aa861318f26e7ff990d7ce07c5b8a62ce02d859dd77778656b987f1257e1b79"
+)
+GOLDEN_V2_REQUEST_TRACE_SHA256 = (
+    "ed121a2062d6394b34665ba34960e621626d6d36e1de71844fc9da99d7f5ca0c"
+)
+GOLDEN_V2_REPLY_SHA256 = (
+    "f5ea1e0694cdb2b502ce5e93d8a641ee03f20c0fb0c40f7482af7b256be2ba03"
+)
+GOLDEN_V2_REPLY_SPANS_SHA256 = (
+    "e2fa0500a3b66945f85581d6d8895cefb00f816dcadd4fc8f00b01c1aa5c4343"
+)
+GOLDEN_V2_DELTA_SHA256 = (
+    "b01e6863b442e508d38993e5969ae1b78b8b778df0c1a2d72afe9d208cf8c713"
+)
+GOLDEN_V2_REQUEST_HEAD_HEX = "4b53525702010c00060074656e616e74"
+
+GOLDEN_TRACE_ID = "00f1e2d3c4b5a697"
+GOLDEN_SPANS = (
+    ("service.admit", 0.0, 0.25),
+    ("service.decode", 0.25, 0.5),
+    ("service.queue-wait", 0.0, 3.5),
+    ("service.batch", 3.5, 0.75),
+    ("service.solve", 4.25, 1.25),
+    ("service.encode", 0.0, 0.125),
+)
 
 
 def _golden_packed():
@@ -326,23 +362,62 @@ def _golden_reply():
     )
 
 
-def test_wire_protocol_byte_golden():
-    """The encoded bytes of all three message kinds are pinned: any
-    layout change — field order, dtype codes, header shape — breaks
-    this test and must ship with a WIRE_VERSION decision (bump on
-    meaning change, golden refresh always)."""
+def test_wire_protocol_byte_golden_v1():
+    """Version-1 encodings are pinned to the digests version-1-only
+    builds shipped — the v2 bump changed NOTHING about what an old
+    peer receives or sends (trace frames are v2-gated)."""
     import hashlib
 
     from k8s_spot_rescheduler_tpu.service import wire
 
-    assert wire.WIRE_VERSION == 1  # bumping? update every digest below
+    assert 1 in wire.SUPPORTED_VERSIONS
+    req = wire.encode_plan_request("golden-tenant", _golden_packed(),
+                                   version=1)
+    assert hashlib.sha256(req).hexdigest() == GOLDEN_V1_REQUEST_SHA256
+    assert req[:16].hex() == GOLDEN_V1_REQUEST_HEAD_HEX
+    # a trace id handed to a v1 encode is DROPPED, not smuggled: the
+    # bytes stay exactly the shipped protocol
+    req_t = wire.encode_plan_request(
+        "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID,
+        version=1,
+    )
+    assert hashlib.sha256(req_t).hexdigest() == GOLDEN_V1_REQUEST_SHA256
+    delta = wire.encode_packed_delta("golden-tenant", _golden_delta(),
+                                     version=1)
+    assert hashlib.sha256(delta).hexdigest() == GOLDEN_V1_DELTA_SHA256
+    reply = wire.encode_plan_reply(_golden_reply(), version=1)
+    assert hashlib.sha256(reply).hexdigest() == GOLDEN_V1_REPLY_SHA256
+
+
+def test_wire_protocol_byte_golden_v2():
+    """The current-version encodings, pinned with the trace frames both
+    absent and present: any layout change breaks this test and must
+    ship with a WIRE_VERSION decision (bump on meaning change, golden
+    refresh always)."""
+    import hashlib
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    assert wire.WIRE_VERSION == 2  # bumping? update every digest below
     req = wire.encode_plan_request("golden-tenant", _golden_packed())
-    assert hashlib.sha256(req).hexdigest() == GOLDEN_REQUEST_SHA256
-    assert req[:16].hex() == GOLDEN_REQUEST_HEAD_HEX
+    assert hashlib.sha256(req).hexdigest() == GOLDEN_V2_REQUEST_SHA256
+    assert req[:16].hex() == GOLDEN_V2_REQUEST_HEAD_HEX
+    req_t = wire.encode_plan_request(
+        "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID
+    )
+    assert (
+        hashlib.sha256(req_t).hexdigest() == GOLDEN_V2_REQUEST_TRACE_SHA256
+    )
     delta = wire.encode_packed_delta("golden-tenant", _golden_delta())
-    assert hashlib.sha256(delta).hexdigest() == GOLDEN_DELTA_SHA256
+    assert hashlib.sha256(delta).hexdigest() == GOLDEN_V2_DELTA_SHA256
     reply = wire.encode_plan_reply(_golden_reply())
-    assert hashlib.sha256(reply).hexdigest() == GOLDEN_REPLY_SHA256
+    assert hashlib.sha256(reply).hexdigest() == GOLDEN_V2_REPLY_SHA256
+    reply_s = wire.encode_plan_reply(
+        _golden_reply()._replace(spans=GOLDEN_SPANS)
+    )
+    assert (
+        hashlib.sha256(reply_s).hexdigest() == GOLDEN_V2_REPLY_SPANS_SHA256
+    )
 
 
 def test_wire_protocol_roundtrip():
@@ -379,6 +454,24 @@ def test_wire_protocol_roundtrip():
     assert rdec.queue_wait_ms == reply.queue_wait_ms
     assert rdec.batch_lanes == reply.batch_lanes
     assert rdec.batch_tenants == reply.batch_tenants
+    assert rdec.spans == ()  # no span frames -> empty, never None
+
+    # trace frames round-trip: the request's trace id and the reply's
+    # server-span block (f4 timings compare within float32 precision)
+    req_ex = wire.decode_plan_request_ex(
+        wire.encode_plan_request(
+            "golden-tenant", packed, trace_id=GOLDEN_TRACE_ID
+        )
+    )
+    assert req_ex.version == wire.WIRE_VERSION
+    assert req_ex.trace_id == GOLDEN_TRACE_ID
+    sdec = wire.decode_plan_reply(
+        wire.encode_plan_reply(reply._replace(spans=GOLDEN_SPANS))
+    )
+    assert [s[0] for s in sdec.spans] == [s[0] for s in GOLDEN_SPANS]
+    for got, want in zip(sdec.spans, GOLDEN_SPANS):
+        assert got[1] == pytest.approx(want[1], abs=1e-4)
+        assert got[2] == pytest.approx(want[2], abs=1e-4)
 
 
 def test_wire_unknown_version_is_typed_error():
@@ -389,11 +482,51 @@ def test_wire_unknown_version_is_typed_error():
 
     blob = bytearray(wire.encode_plan_request("t", _golden_packed()))
     assert blob[4] == wire.WIRE_VERSION
-    blob[4] = wire.WIRE_VERSION + 1
+    blob[4] = max(wire.SUPPORTED_VERSIONS) + 1
     with pytest.raises(wire.WireVersionError):
         wire.decode_frames(bytes(blob))
     # and the subclass relationship holds: version errors are WireErrors
     assert issubclass(wire.WireVersionError, wire.WireError)
+
+
+def test_wire_v1_payload_still_plans():
+    """The back-compat half of the v2 bump: a version-1 payload from an
+    un-upgraded agent decodes (trace simply absent) AND plans through a
+    real ServiceServer — which answers in version 1, so the old agent
+    can decode its reply too."""
+    import urllib.request
+
+    from k8s_spot_rescheduler_tpu.service import wire
+    from k8s_spot_rescheduler_tpu.service.server import ServiceServer
+
+    v1_body = wire.encode_plan_request(
+        "old-agent", _golden_packed(), version=1
+    )
+    # direct decode: version reported, trace empty, tensors intact
+    req = wire.decode_plan_request_ex(v1_body)
+    assert req.version == 1 and req.trace_id == ""
+    assert req.tenant == "old-agent"
+
+    srv = ServiceServer(
+        ReschedulerConfig(solver="numpy", resources=("cpu", "memory")),
+        "127.0.0.1:0", batch_window_s=0.0,
+    )
+    srv.start_background()
+    try:
+        post = urllib.request.Request(
+            f"http://{srv.address}/v2/plan", data=v1_body, method="POST",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(post, timeout=30) as resp:
+            raw = resp.read()
+        # the reply mirrors the request's version (offset 4) and omits
+        # the v2 span frames — bytes an un-upgraded decoder accepts
+        assert raw[4] == 1
+        reply = wire.decode_plan_reply(raw)
+        assert reply.spans == ()
+        assert reply.n_feasible >= 0
+    finally:
+        srv.close()
 
 
 def test_wire_malformed_inputs_are_typed_errors():

@@ -14,6 +14,7 @@ ALL_PASSES = (
     ("metrics-contract", contracts.run_metrics),
     ("config-contract", contracts.run_config),
     ("kube-write-retry", contracts.run_kube_writes),
+    ("trace-contract", contracts.run_trace),
     ("manifest-contract", contracts.run_manifest),
     ("lock-discipline", locks.run),
 )

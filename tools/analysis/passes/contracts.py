@@ -20,6 +20,17 @@ and pairs of modules that must stay in lockstep:
     wrappers may call the retrying ``_read_retrying`` path, and always
     with a literal "GET" (the actuator owns eviction/taint cadence;
     a retried write would double-fire side effects).
+
+``trace-contract``
+    Every span name emitted anywhere (a literal first argument to
+    ``tracing.span`` / ``tracing.phase`` / ``tracing.make_span``) is
+    declared in the ``SPAN_NAMES`` registry in utils/tracing.py, and
+    every declared name is emitted somewhere — so dashboards and the
+    flight-recorder dump schema cannot silently drift from the code
+    (the same lockstep metrics-contract enforces for Prometheus
+    series). Names passed through variables are unscannable by design
+    (precision over recall, like the rest of the suite); the project
+    emits spans with literal names only.
 """
 
 from __future__ import annotations
@@ -342,6 +353,115 @@ def run_kube_writes(project: Project, files) -> List[Finding]:
                                 "their cadence)",
                                 severity=ERROR, anchor=f"{fname}.retries",
                             ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# trace-contract
+
+# the emitting helpers in utils/tracing.py; a literal first argument to
+# any of them through a tracing-module alias is a span-name emission
+_TRACE_EMITTERS = {"span", "phase", "make_span"}
+
+
+def _tracing_aliases(mod) -> Set[str]:
+    """Local names this module binds the tracing module to."""
+    out = set()
+    for bound, imp in mod.imports.items():
+        target = imp[1] if imp[0] == "module" else f"{imp[1]}.{imp[2]}"
+        if target.endswith("utils.tracing") or target == "tracing":
+            out.add(bound)
+    return out
+
+
+def _span_registry(mod) -> Dict[str, int]:
+    """{name: line} from the SPAN_NAMES dict literal in utils/tracing.py."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for tgt in targets:
+            if (
+                isinstance(tgt, ast.Name)
+                and tgt.id == "SPAN_NAMES"
+                and isinstance(node.value, ast.Dict)
+            ):
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(
+                        key.value, str
+                    ):
+                        out[key.value] = key.lineno
+    return out
+
+
+def run_trace(project: Project, files) -> List[Finding]:
+    tracing_mod = _find_module(project, "utils/tracing.py")
+    if tracing_mod is None:
+        return []
+    declared = _span_registry(tracing_mod)
+    if not declared:
+        # a tracing module without a registry: nothing to enforce
+        # (fixture trees exercising other passes stay inert)
+        return []
+    findings: List[Finding] = []
+    emitted: Set[str] = set()
+
+    for mod in project.modules.values():
+        if mod is tracing_mod:
+            # the module's own internals pass names through variables
+            # (phase -> Trace.span); only alias-based emission counts
+            continue
+        aliases = _tracing_aliases(mod)
+        if not aliases:
+            continue
+        path = relpath(mod.path)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in _TRACE_EMITTERS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in aliases
+            ):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (
+                isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+            ):
+                continue  # variable name: unscannable (precision > recall)
+            if arg.value in declared:
+                emitted.add(arg.value)
+            else:
+                findings.append(Finding(
+                    path, node.lineno, "trace-contract",
+                    f"span name '{arg.value}' is emitted but not "
+                    "declared in utils/tracing.py SPAN_NAMES — the "
+                    "dashboards and the flight-recorder schema key on "
+                    "the registry; declare it (with a description) or "
+                    "fix the name",
+                    severity=ERROR, anchor=arg.value,
+                ))
+
+    reg_path = relpath(tracing_mod.path)
+    for name, line in sorted(declared.items()):
+        if name not in emitted:
+            findings.append(Finding(
+                reg_path, line, "trace-contract",
+                f"span name '{name}' is declared in SPAN_NAMES but "
+                "never emitted anywhere in the package — dead registry "
+                "entry (or the emitting call site was lost in a "
+                "refactor)",
+                severity=ERROR, anchor=name,
+            ))
     return findings
 
 
