@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke serve-smoke chaos-smoke watch-soak quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke serve-smoke chaos-smoke watch-soak fleet-chaos-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -19,7 +19,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
 # stand-in and tools/analysis is the go-vet analog, two tiers deep
 # (this image ships no Python linter and installs are forbidden).
-check: lint analyze audit-jaxpr test bench-smoke serve-smoke repair-smoke chaos-smoke watch-soak
+check: lint analyze audit-jaxpr test bench-smoke serve-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke
 
 lint:
 	python tools/lint.py
@@ -98,6 +98,17 @@ chaos-smoke:
 # to a fresh LIST at end-state.
 watch-soak:
 	env JAX_PLATFORMS=cpu python bench.py --watch-soak --watch-soak-ticks 300 --watchdog 300
+
+# Fleet failure-domain smoke (CPU-only, seconds of wall on a virtual
+# clock): 4 agents x 2 planner-service replicas over real HTTP under
+# seeded wire/HTTP faults, one scripted sick-device phase and one
+# graceful replica kill + warm restart; fails unless zero agent crashes,
+# every selection is bit-identical to the solo in-process plan,
+# sick-detection/recovery and failover edges fire, flight-recorder
+# deltas equal metric deltas, and the restarted replica pre-warms from
+# its persisted state. Budget: <60 s wall.
+fleet-chaos-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --fleet-chaos --watchdog 60
 
 quality:
 	python bench.py --quality

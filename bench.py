@@ -87,10 +87,62 @@ def drop_non_finite(obj):
     return obj
 
 
+# backend attestation rides every emitted JSON line unless the watchdog
+# is firing (a hung backend must not block the diagnostic line's exit)
+_attest_enabled = [True]
+
+
+def backend_attestation() -> dict:
+    """Which backend actually solved — self-labeled in every BENCH/
+    MULTICHIP JSON line so a CPU-fallback round reads as the artifact it
+    is instead of tribal knowledge (the BENCH_r01/r05 confusion: two
+    rounds of regressions that were really the tunneled chip's sick
+    phases). Reports the live device platform plus the degradation
+    counters that say whether any solve in the run fell off the device:
+    the service watchdog's sick gauge and the planner fallback totals.
+    Never imports jax itself — a bench that never initialized a backend
+    attests exactly that."""
+    out: dict = {}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        out["solve_backend"] = "jax-not-loaded"
+    else:
+        try:
+            d = jax.devices()[0]
+            out["solve_backend"] = f"{d.platform}/{d.device_kind}"
+            out["n_devices"] = len(jax.devices())
+        except Exception as err:  # noqa: BLE001 — attest the failure
+            out["solve_backend"] = f"unavailable: {str(err)[-80:]}"
+    try:
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+        svc = metrics.service_snapshot()
+        rob = metrics.robustness_snapshot()
+        out["device_sick"] = bool(svc.get("device_sick"))
+        out["planner_fallbacks"] = int(rob.get("planner_fallback", 0))
+        out["remote_planner_fallbacks"] = int(
+            svc.get("remote_planner_fallback", 0)
+        )
+    except Exception as err:  # noqa: BLE001 — counters are best-effort
+        out["counters_error"] = str(err)[-80:]
+    return out
+
+
 def emit(obj: dict) -> None:
     """Print THE one JSON line (at most once per process). The lock is
     acquired and never released: whichever thread (main or watchdog) wins
-    the non-blocking acquire is the only one that prints."""
+    the non-blocking acquire is the only one that prints. Every line
+    carries ``backend_attestation`` (unless the watchdog is firing) so
+    the solve backend is recorded in the result itself. The attestation
+    is computed BEFORE the lock: if jax.devices() wedges here, the
+    watchdog's own emit still wins the lock and exits with its
+    diagnostic line."""
+    if _attest_enabled[0] and "backend_attestation" not in obj:
+        try:
+            obj = dict(obj)
+            obj["backend_attestation"] = backend_attestation()
+        except Exception:  # noqa: BLE001 — the line must still print
+            pass
     if not _emit_once.acquire(blocking=False):
         return
     print(json.dumps(drop_non_finite(obj)), flush=True)
@@ -113,6 +165,9 @@ def start_watchdog(seconds: float, metric: str, unit: str) -> threading.Timer:
     a hung device fetch cannot be interrupted any other way."""
 
     def fire() -> None:
+        # a wedged backend must not block the diagnostic line: skip the
+        # attestation's jax.devices() on this path
+        _attest_enabled[0] = False
         emit_error(metric, unit, f"watchdog: bench exceeded {seconds:.0f}s budget")
         sys.stdout.flush()
         os._exit(3)
@@ -1056,6 +1111,334 @@ def run_serve_smoke(args, metric: str, unit: str) -> int:
     return 0 if result["ok"] else 1
 
 
+def fleet_chaos_smoke(n_agents: int = 4, seed: int = 0) -> dict:
+    """The fleet failure-domain acceptance core (``make
+    fleet-chaos-smoke``; reused by tests/test_fleet_chaos.py):
+
+    N agents x 2 planner-service replicas over real HTTP on a shared
+    virtual clock, driven through four scripted phases —
+
+    1. **healthy**: every agent plans through replica A; selections must
+       be bit-identical to each tenant's solo in-process plan; the
+       device-health watchdog calibrates its baseline;
+    2. **wire chaos**: the seeded ``ServiceFaultPlan`` (connection
+       resets, slow-loris uploads, truncated/corrupted replies, a
+       scripted 503 storm) runs on every agent's transport — agents must
+       fail over down the endpoint list and fall back to the local
+       oracle only with both replicas unusable, with ZERO crashes and
+       every selection still solo-identical;
+    3. **sick device**: replica A's solve path gains scripted per-batch
+       latency; the watchdog must flip within ``device_sick_threshold``
+       consecutive slow batches (/healthz ``device:"sick"``, gauge 1,
+       flight ``device-sick``), serve host-path plans meanwhile, and
+       recover ONLY after the hysteresis probes pass once the phase
+       ends;
+    4. **replica kill/restart**: replica A drains gracefully (SIGTERM
+       contract) and dies; agents fail over to B (``failover_ms``
+       measured); A restarts on the same address, pre-warms from its
+       persisted state (``warmed_buckets``), and serves again once its
+       breaker window passes.
+
+    Accounting acceptance: zero agent crashes, zero non-solo-identical
+    selections (no eviction could ever come from a stale or unproven
+    plan), and flight-recorder deltas exactly equal to metric deltas for
+    remote-planner-fallback, failover and device-sick."""
+    import dataclasses
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
+    from k8s_spot_rescheduler_tpu.loop import flight
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+    from k8s_spot_rescheduler_tpu.service.chaos import (
+        ChaosAgentTransport,
+        ServiceChaos,
+        ServiceFaultPlan,
+    )
+    from k8s_spot_rescheduler_tpu.service.server import ServiceServer
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    spec = dataclasses.replace(
+        CONFIGS[2], name="fleet-chaos", n_on_demand=6, n_spot=6, n_pods=48
+    )
+    cfg = ReschedulerConfig(
+        resources=spec.resources,
+        solver="numpy",  # CPU CI: the host oracle IS the proven path
+        device_sick_threshold=3,
+        service_drain_grace=2.0,
+        planner_timeout=5.0,
+    )
+    tenants = []
+    for i in range(n_agents):
+        client = generate_cluster(spec, seed + i)
+        store = client.columnar_store(
+            cfg.resources,
+            on_demand_label=cfg.on_demand_node_label,
+            spot_label=cfg.spot_node_label,
+        )
+        tenants.append((store, client.list_pdbs()))
+
+    def selection(report):
+        if report.plan is None:
+            return (False, None, None)
+        return (
+            True,
+            report.plan.node.node.name,
+            dict(report.plan.assignments),
+        )
+
+    solo = SolverPlanner(cfg)
+    solo_sel = [selection(solo.plan(store, pdbs)) for store, pdbs in tenants]
+
+    clock = FakeClock()
+    state_dir = tempfile.mkdtemp(prefix="fleet-chaos-state-")
+    cfg_srv = dataclasses.replace(cfg, service_state_dir=state_dir)
+
+    def new_replica(addr="127.0.0.1:0"):
+        srv = ServiceServer(
+            cfg_srv, addr, batch_window_s=0.0,
+            max_inflight=max(16, 4 * n_agents), clock=clock,
+        )
+        # scheduler-less: submissions drain synchronously on the handler
+        # thread, so no background thread ever sleeps on the shared
+        # virtual clock — the run is deterministic tick by tick
+        srv.start_background(scheduler=False)
+        return srv
+
+    replica_a = new_replica()
+    replica_b = new_replica()
+    addr_a = replica_a.address
+
+    agent_plan = ServiceFaultPlan(
+        seed=seed + 7,
+        connect_reset_rate=0.15,
+        slow_loris_rate=0.05,
+        reply_truncate_rate=0.10,
+        reply_corrupt_rate=0.10,
+        http_503_script=(3, 4),
+        http_503_retry_after=0.5,
+        http_5xx_rate=0.05,
+    )
+    agents, chaos_transports = [], []
+    for i in range(n_agents):
+        agent = RemotePlanner(
+            cfg,
+            f"http://{addr_a},http://{replica_b.address}",
+            tenant=f"fleet-tenant-{i}",
+            clock=clock,
+        )
+        chaos = ChaosAgentTransport(
+            agent.transport, dataclasses.replace(agent_plan, seed=seed + i),
+            clock=clock,
+        )
+        chaos.enabled = False
+        agent.transport = chaos
+        agents.append(agent)
+        chaos_transports.append(chaos)
+
+    m0 = metrics.service_snapshot()
+    f0 = flight.RECORDER.counts()
+    crashes, mismatches = [], []
+    tick_no = [0]
+    failover_ms: list = []
+
+    def fleet_tick(note=""):
+        """One synchronous fleet housekeeping tick: every agent plans
+        once; wall time is measured per agent; virtual time advances a
+        housekeeping interval afterwards."""
+        tick_no[0] += 1
+        walls = []
+        for i, agent in enumerate(agents):
+            store, pdbs = tenants[i]
+            t0 = time.perf_counter()
+            try:
+                report = agent.plan(store, pdbs)
+            except Exception as err:  # noqa: BLE001 — the acceptance: NEVER raises (Ctrl-C still propagates)
+                crashes.append(
+                    {"tick": tick_no[0], "tenant": i, "note": note,
+                     "error": f"{type(err).__name__}: {err}"}
+                )
+                continue
+            walls.append((time.perf_counter() - t0) * 1e3)
+            got = selection(report)
+            if got != solo_sel[i] or report.solver not in (
+                "remote", "remote-fallback"
+            ):
+                mismatches.append(
+                    {"tick": tick_no[0], "tenant": i, "note": note,
+                     "solo": solo_sel[i], "got": got,
+                     "solver": report.solver}
+                )
+        clock.advance(3.0)  # the virtual housekeeping interval
+        return walls
+
+    # --- phase 1: healthy warmup (calibrates the watchdog baseline) ---
+    for _ in range(6):
+        fleet_tick("healthy")
+
+    # --- phase 2: wire/HTTP chaos on every agent transport ---
+    for chaos in chaos_transports:
+        chaos.enabled = True
+    for _ in range(8):
+        fleet_tick("wire-chaos")
+    for chaos in chaos_transports:
+        chaos.enabled = False
+    # let breaker windows from the chaos phase expire before phase 3
+    clock.advance(60.0)
+
+    # --- phase 3: scripted sick-device phase on replica A ---
+    svc_a = replica_a.service
+    svc_a.chaos = ServiceChaos(
+        ServiceFaultPlan(seed=seed, sick_phase=(1, 10**9, 2.0)),
+        clock=clock,
+    )
+    sick_detect_ticks = None
+    for n in range(1, 5):
+        fleet_tick("sick-phase")
+        if (
+            sick_detect_ticks is None
+            and svc_a.healthz_snapshot()["device"] == "sick"
+        ):
+            sick_detect_ticks = n
+    sick_snapshot = svc_a.healthz_snapshot()
+    sick_gauge_during = metrics.service_snapshot()["device_sick"]
+    wd = svc_a._devhealth
+    sick_detect_batches = wd.detect_streak if wd is not None else -1
+    # phase ends: quiesce the latency; hysteresis probes must recover it
+    svc_a.chaos.enabled = False
+    recovered_after = None
+    for n in range(1, 6):
+        fleet_tick("recovery")
+        if svc_a.healthz_snapshot()["device"] == "ok":
+            recovered_after = n
+            break
+    end_snapshot = svc_a.healthz_snapshot()
+
+    # --- phase 4: graceful kill of replica A, failover, warm restart ---
+    replica_a.graceful_shutdown()
+    for _ in range(3):
+        walls = fleet_tick("replica-kill")
+        failover_ms.extend(walls)
+    restarted = new_replica(addr_a)
+    warmed = list(restarted.service.warmed_buckets)
+    # breaker horizons on A expire; agents must return to the primary
+    clock.advance(180.0)
+    for _ in range(2):
+        fleet_tick("replica-restart")
+    primary_back = all(
+        agent.last_endpoint == f"http://{addr_a}" for agent in agents
+    )
+
+    for srv in (replica_b, restarted):
+        srv.close()
+
+    m1 = metrics.service_snapshot()
+    f1 = flight.RECORDER.counts()
+
+    def fdelta(kind):
+        return f1.get(kind, 0) - f0.get(kind, 0)
+
+    fallback_metric = (
+        m1["remote_planner_fallback"] - m0["remote_planner_fallback"]
+    )
+    failover_metric = (
+        m1["remote_planner_failover"] - m0["remote_planner_failover"]
+    )
+    flight_eq_metrics = (
+        fdelta("remote-planner-fallback") == fallback_metric
+        and fdelta("failover") == failover_metric
+        and fdelta("device-sick") == 1
+        and fdelta("device-recovered") == 1
+    )
+    ok = (
+        not crashes
+        and not mismatches
+        and sick_detect_ticks is not None
+        and sick_snapshot.get("device") == "sick"
+        and sick_gauge_during == 1.0
+        and 0 < sick_detect_batches <= cfg.device_sick_threshold
+        and recovered_after is not None
+        and end_snapshot.get("device") == "ok"
+        and m1["device_sick"] == 0.0
+        and failover_metric > 0
+        and flight_eq_metrics
+        and bool(warmed)
+        and primary_back
+    )
+    return {
+        "ok": ok,
+        "n_agents": n_agents,
+        "ticks": tick_no[0],
+        "crashes": crashes,
+        "mismatches": mismatches,
+        "sick_detect_ticks": sick_detect_ticks,
+        "sick_detect_batches": sick_detect_batches,
+        "recovered_after_ticks": recovered_after,
+        "failover_ms": round(
+            float(np.median(failover_ms)) if failover_ms else 0.0, 2
+        ),
+        "failovers": int(failover_metric),
+        "fallbacks": int(fallback_metric),
+        "flight_eq_metrics": flight_eq_metrics,
+        "flight_deltas": {
+            k: fdelta(k)
+            for k in ("remote-planner-fallback", "failover",
+                      "device-sick", "device-recovered", "service-shed")
+        },
+        "warmed_buckets": warmed,
+        "primary_back": primary_back,
+        "device_end_state": end_snapshot.get("device"),
+    }
+
+
+def run_fleet_chaos(args, metric: str, unit: str) -> int:
+    """CI smoke of the fleet failure domains (``make fleet-chaos-smoke``):
+    see :func:`fleet_chaos_smoke` for the scripted phases and the
+    acceptance accounting."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = fleet_chaos_smoke(n_agents=max(4, args.tenants), seed=args.seed)
+    detail = (
+        result["crashes"] or result["mismatches"]
+        or {"flight_deltas": result["flight_deltas"]}
+    )
+    print(
+        f"fleet-chaos-smoke: {result['n_agents']} agents x 2 replicas, "
+        f"{result['ticks']} ticks  "
+        f"sick_detect={result['sick_detect_ticks']} tick(s)/"
+        f"{result['sick_detect_batches']} batch(es)  "
+        f"recovered_after={result['recovered_after_ticks']}  "
+        f"failovers={result['failovers']} "
+        f"(median {result['failover_ms']} ms)  "
+        f"fallbacks={result['fallbacks']}  "
+        f"warmed={result['warmed_buckets']}  "
+        f"flight==metrics: {result['flight_eq_metrics']}  "
+        f"-> {'OK' if result['ok'] else 'FAIL: %s' % detail}",
+        file=sys.stderr,
+    )
+    emit(
+        {
+            "metric": metric,
+            "value": result["failover_ms"],
+            "unit": unit,
+            "n_agents": result["n_agents"],
+            "ticks": result["ticks"],
+            "failover_ms": result["failover_ms"],
+            "sick_detect_ticks": result["sick_detect_ticks"],
+            "sick_detect_batches": result["sick_detect_batches"],
+            "recovered_after_ticks": result["recovered_after_ticks"],
+            "failovers": result["failovers"],
+            "fallbacks": result["fallbacks"],
+            "flight_eq_metrics": result["flight_eq_metrics"],
+            "warmed_buckets": len(result["warmed_buckets"]),
+            "ok": result["ok"],
+        }
+    )
+    return 0 if result["ok"] else 1
+
+
 def run_chaos(args, metric: str, unit: str) -> int:
     """Chaos soak (``make chaos-smoke``): N control-loop ticks over a
     fixture-scale fake cluster behind the seeded fault-injection client
@@ -1713,6 +2096,8 @@ def _metric_for(args) -> tuple:
         return "bench_smoke_delta_upload_bytes", "bytes"
     if args.serve_smoke:
         return "serve_smoke_agent_plan_ms", "ms"
+    if args.fleet_chaos:
+        return "fleet_chaos_failover_ms", "ms"
     if args.quality:
         return "nodes_freed_vs_ilp_oracle_ratio", "ratio"
     if args.quality_boundary:
@@ -1829,6 +2214,16 @@ def main() -> int:
     ap.add_argument("--tenants", type=int, default=4,
                     help="tenant count for --serve-smoke (>=4 for the "
                          "acceptance run)")
+    ap.add_argument("--fleet-chaos", action="store_true",
+                    help="CI smoke (make fleet-chaos-smoke): 4 agents x "
+                         "2 service replicas on a virtual clock under "
+                         "seeded wire/HTTP faults, one scripted "
+                         "sick-device phase and one graceful replica "
+                         "kill + warm restart; fails unless zero agent "
+                         "crashes, every selection bit-identical to the "
+                         "solo in-process plan, detection/recovery "
+                         "edges fire, and flight deltas == metric "
+                         "deltas")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke (make bench-smoke): tiny CPU-only "
                          "cluster, 5 ticks through the production "
@@ -1861,6 +2256,8 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         return run_smoke(args, metric, unit)
     if args.serve_smoke:
         return run_serve_smoke(args, metric, unit)
+    if args.fleet_chaos:
+        return run_fleet_chaos(args, metric, unit)
     if args.quality:
         return run_quality(
             args.seed, sweep=args.sweep, solver=args.solver or "numpy"

@@ -175,10 +175,50 @@ def build_parser() -> argparse.ArgumentParser:
                         "wire protocol (service/wire.py); on failure "
                         "the tick falls back to the local numpy oracle "
                         "(empty = plan in-process)")
+    p.add_argument("--planner-urls", default=d.planner_urls,
+                   help="ORDERED comma-separated planner-service "
+                        "endpoints: per-endpoint circuit breakers, "
+                        "failover down the list on failure/breaker-open, "
+                        "local numpy-oracle fallback only when every "
+                        "endpoint is dead (takes precedence over "
+                        "--planner-url)")
     p.add_argument("--planner-timeout", default=f"{d.planner_timeout:g}s",
                    help="per-plan HTTP deadline of the agent's planner-"
                         "service call; past it the tick plans locally "
                         "(Go duration)")
+    p.add_argument("--device-sick-threshold", type=int,
+                   default=d.device_sick_threshold,
+                   help="--serve mode: consecutive slower-than-baseline "
+                        "batched solves before the device-health "
+                        "watchdog declares the accelerator sick and "
+                        "flips the service to the numpy-oracle host "
+                        "path (0 = watchdog off)")
+    p.add_argument("--service-drain-grace",
+                   default=f"{d.service_drain_grace:g}s",
+                   help="--serve mode: seconds SIGTERM lets queued "
+                        "batches finish before the rest are evicted "
+                        "with 503; new arrivals get Retry-After = this "
+                        "grace (Go duration)")
+    p.add_argument("--service-state-dir", default=d.service_state_dir,
+                   help="--serve mode: persist per-tenant pack "
+                        "fingerprints + the bucket warmup list here and "
+                        "pre-warm those compiles on boot (warm restart; "
+                        "empty = cold restarts)")
+    from k8s_spot_rescheduler_tpu.service.chaos import (
+        ServiceFaultPlan as _ServiceFaultPlan,
+    )
+
+    p.add_argument("--service-chaos-profile",
+                   default=d.service_chaos_profile,
+                   choices=list(_ServiceFaultPlan.PROFILES),
+                   help="seeded fault injection on the planner-service "
+                        "path (service/chaos.py): wire faults on the "
+                        "agent transport, solve/decode faults in the "
+                        "service — testing/demo only, never production")
+    p.add_argument("--service-chaos-seed", type=int,
+                   default=d.service_chaos_seed,
+                   help="seed of the service chaos fault stream "
+                        "(deterministic)")
     p.add_argument("--service-batch-window",
                    default=f"{d.service_batch_window:g}s",
                    help="--serve mode: how long the batching scheduler "
@@ -312,9 +352,15 @@ def config_from_args(args) -> ReschedulerConfig:
         staged_early_exit=args.staged_early_exit,
         jax_cache_dir=args.jax_cache_dir,
         planner_url=args.planner_url,
+        planner_urls=args.planner_urls,
         planner_timeout=parse_duration(args.planner_timeout),
         service_batch_window=parse_duration(args.service_batch_window),
         service_queue_timeout=parse_duration(args.service_queue_timeout),
+        device_sick_threshold=args.device_sick_threshold,
+        service_drain_grace=parse_duration(args.service_drain_grace),
+        service_state_dir=args.service_state_dir,
+        service_chaos_profile=args.service_chaos_profile,
+        service_chaos_seed=args.service_chaos_seed,
         kube_retry_max=args.kube_retry_max,
         kube_retry_base=args.kube_retry_base,
         breaker_threshold=args.breaker_threshold,
@@ -355,14 +401,21 @@ def main(argv=None) -> int:
     if args.serve:
         # service mode: no control loop, no cluster client — one shared
         # TPU planner serving a fleet of --planner-url agents
-        from k8s_spot_rescheduler_tpu.service.server import ServiceServer
+        from k8s_spot_rescheduler_tpu.service.server import (
+            ServiceServer,
+            install_sigterm_drain,
+        )
 
         if not args.no_metrics_server:
             from k8s_spot_rescheduler_tpu.metrics import registry as metrics
 
             metrics.serve(config.listen_address)
         log.info("Running planner service")
-        ServiceServer(config, args.serve).serve_forever()
+        server = ServiceServer(config, args.serve)
+        # SIGTERM = graceful drain: stop admitting, finish queued
+        # batches within service_drain_grace, persist warm state, exit
+        install_sigterm_drain(server)
+        server.serve_forever()
         return 0
 
     log.info("Running Rescheduler")
@@ -475,9 +528,10 @@ def main(argv=None) -> int:
         return 1
 
     try:
-        if config.planner_url:
+        if config.planner_url or config.planner_urls:
             # agent mode: the solve crosses the wire to a shared
-            # planner service; everything else stays local
+            # planner service (failover list supported); everything
+            # else stays local
             from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
 
             planner = RemotePlanner(config)

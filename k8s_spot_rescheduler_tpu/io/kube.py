@@ -978,7 +978,7 @@ class KubeClusterClient:
 
         try:
             pvcs, pvs = self.list_volume_snapshots()
-        except Exception as err:  # noqa: BLE001 — stay conservative
+        except Exception as err:  # noqa: BLE001, exception-discipline — stay conservative: the pods remain unmodeled (the SAFE direction, blocked_candidates 'unmodeled' surfaces it) and the retry layer already counted the read failure
             log.error("PVC/PV list failed; volume pods stay unmodeled: %s", err)
             return pods
         out = []
@@ -1109,7 +1109,7 @@ class KubeClusterClient:
             self._request(
                 "POST", f"/api/v1/namespaces/{namespace}/events", body
             )
-        except Exception as err:  # noqa: BLE001 — events are best-effort
+        except Exception as err:  # noqa: BLE001, exception-discipline — events are best-effort decoration by contract (the reference's recorder is fire-and-forget too); nothing degrades when one is lost
             log.vlog(4, "event post failed: %s", err)
 
 

@@ -181,7 +181,7 @@ class LeaseElector:
         with self._lock:
             try:
                 self.is_leader = self._ensure()
-            except Exception as err:  # noqa: BLE001
+            except Exception as err:  # noqa: BLE001, exception-discipline — demotion IS the recorded outcome: is_leader flips false, the loop stands by, and the single-attempt lease read's failure already surfaced through the kube layer
                 log.vlog(2, "leader election: demoted on error: %s", err)
                 self.is_leader = False
             return self.is_leader

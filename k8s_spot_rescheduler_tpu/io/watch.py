@@ -720,7 +720,7 @@ class WatchingKubeClusterClient:
         try:
             pvcs, pvs = self.client.list_volume_snapshots()
             self._vol_snapshot = (pvcs, pvs)  # single atomic reassignment
-        except Exception as err:  # noqa: BLE001 — stay conservative
+        except Exception as err:  # noqa: BLE001, exception-discipline — stay conservative: unresolved volume pods remain unmodeled (the SAFE direction) and retry next tick; the kube retry layer counted the read failure
             log.error("PVC/PV list failed; volume pods stay unmodeled: %s", err)
             return
         for key, pod in unresolved:

@@ -172,7 +172,7 @@ class Rescheduler:
                 on_demand_label=self.config.on_demand_node_label,
                 spot_label=self.config.spot_node_label,
             )
-        except Exception as err:  # noqa: BLE001 — fall back to objects
+        except Exception as err:  # noqa: BLE001, exception-discipline — fall back to objects: the reference-faithful observe path runs instead; nothing is lost, only vectorization
             log.error("Columnar observe unavailable: %s", err)
             return None
 
@@ -191,7 +191,7 @@ class Rescheduler:
                 n.name: client.list_pods_on_node(n.name)
                 for n in list(nodes) + list(unready)
             }
-        except Exception as err:  # noqa: BLE001 — skip tick on any API error
+        except Exception as err:  # noqa: BLE001, exception-discipline — skip tick on any API error: the None return flows into the skipped="error" path whose breaker/health accounting (note_error) records it
             log.error("Failed to list cluster state: %s", err)
             return None
         return build_node_map(
@@ -355,7 +355,7 @@ class Rescheduler:
                     self._tick_metrics(observation, pdbs)
             with tracing.phase("plan"):
                 return self._fallback().plan(observation, pdbs), True
-        except Exception as err:  # noqa: BLE001
+        except Exception as err:  # noqa: BLE001, exception-discipline — both planners dead: the None return becomes skipped="error", counted by the breaker/health path (the primary's crash already fired planner_fallback + the flight event)
             log.error("Fallback planner failed too: %s", err)
             return None, True
 
@@ -413,7 +413,7 @@ class Rescheduler:
             lister = getattr(self.client, "list_unready_nodes", None)
             if lister is not None:
                 nodes += list(lister())
-        except Exception as err:  # noqa: BLE001 — sweep retries next tick
+        except Exception as err:  # noqa: BLE001, exception-discipline — sweep retries next tick; an orphan heals one tick later and the read failure was already counted by the kube retry layer
             log.error("Orphaned-taint sweep skipped (list failed): %s", err)
             return []
         from k8s_spot_rescheduler_tpu.utils.labels import matches_label
@@ -450,7 +450,7 @@ class Rescheduler:
             # NoSchedule residue this sweep exists to remove
             try:
                 self.client.remove_taint(node.name, TO_BE_DELETED_TAINT)
-            except Exception as err:  # noqa: BLE001
+            except Exception as err:  # noqa: BLE001, exception-discipline — retried next tick by the same sweep; success is what's counted (orphaned_taints_recovered)
                 log.error(
                     "Failed to remove orphaned taint on %s: %s "
                     "(will retry next tick)", node.name, err,
@@ -484,7 +484,7 @@ class Rescheduler:
             if refresh is not None:
                 try:
                     refresh()
-                except Exception as err:  # noqa: BLE001
+                except Exception as err:  # noqa: BLE001, exception-discipline — advisory cache hygiene: the worst case is one redundant re-recovery next tick, itself counted
                     log.error(
                         "Cache refresh after taint recovery failed: %s", err
                     )
@@ -514,7 +514,7 @@ class Rescheduler:
         self._next_resync_wall = now + self.config.resync_interval
         try:
             drift = audit()
-        except Exception as err:  # noqa: BLE001 — audit is advisory
+        except Exception as err:  # noqa: BLE001, exception-discipline — audit is advisory and rescheduled; a LIST failure was counted by the kube retry layer, and mirror staleness has its own gate + gauge
             log.error(
                 "Anti-entropy resync audit failed (next attempt in "
                 "%.0fs): %s", self.config.resync_interval, err,
@@ -649,17 +649,17 @@ class Rescheduler:
             # recorder/sink that raises must not escape tick()
             try:
                 recovered = self.reconcile_orphaned_taints()
-            except Exception as err:  # noqa: BLE001
+            except Exception as err:  # noqa: BLE001, exception-discipline — the sweep re-runs next tick; recovery successes are what's counted
                 log.error("Orphaned-taint sweep failed: %s", err)
         try:
             # also pre-gate: the mirror stays audited while cooldown or
             # the unschedulable gate holds ticks back
             self._maybe_resync_audit()
-        except Exception as err:  # noqa: BLE001
+        except Exception as err:  # noqa: BLE001, exception-discipline — the audit retries at its next interval; staleness has its own gate + gauge
             log.error("Anti-entropy resync audit crashed: %s", err)
         try:
             result = self._tick_inner()
-        except Exception as err:  # noqa: BLE001 — the loop must not die
+        except Exception as err:  # noqa: BLE001, exception-discipline — the loop must not die; skipped="error" below drives the breaker + health accounting that records it
             log.error("Tick aborted by unexpected error: %s", err)
             result = TickResult(skipped="error")
         result.recovered_taints = recovered
@@ -685,7 +685,18 @@ class Rescheduler:
             )
         elif result.skipped == "":
             self._consecutive_errors = 0
-            health.STATE.note_success(fallback=result.planner_fallback)
+            # agent mode degrades INSIDE the planner (RemotePlanner
+            # plans locally when every endpoint is dead, reporting
+            # solver "remote-fallback" without raising) — /healthz must
+            # read degraded for those ticks exactly as for a contained
+            # in-process planner crash
+            remote_fell_back = (
+                result.report is not None
+                and result.report.solver == "remote-fallback"
+            )
+            health.STATE.note_success(
+                fallback=result.planner_fallback or remote_fell_back
+            )
         elif result.skipped == "unschedulable":
             # the observation behind this verdict SUCCEEDED — the
             # apiserver is provably healthy, so the observe-error
@@ -711,7 +722,7 @@ class Rescheduler:
 
         try:
             unschedulable = self._observe_client.list_unschedulable_pods()
-        except Exception as err:  # noqa: BLE001
+        except Exception as err:  # noqa: BLE001, exception-discipline — the skipped="error" return feeds the breaker/health accounting (note_error), which records it
             # skip the tick, matching the observe-error policy: treating
             # an unknown state as "zero unschedulable pods" would defeat
             # the don't-make-things-worse gate exactly when the
@@ -732,7 +743,7 @@ class Rescheduler:
 
             try:
                 pdbs = self._observe_client.list_pdbs()
-            except Exception as err:  # noqa: BLE001
+            except Exception as err:  # noqa: BLE001, exception-discipline — skipped="error" feeds the breaker/health accounting, which records it
                 log.error("Failed to list PDBs: %s", err)
                 return TickResult(skipped="error")
 
@@ -793,7 +804,7 @@ class Rescheduler:
                     break
                 try:
                     pdbs = self._observe_client.list_pdbs()
-                except Exception as err:  # noqa: BLE001
+                except Exception as err:  # noqa: BLE001, exception-discipline — the multi-drain loop stops at the drains already proven; this tick still completes and reports them
                     log.error("Failed to list PDBs: %s", err)
                     break
                 report, used_fallback = self._plan_guarded(

@@ -46,15 +46,18 @@ from k8s_spot_rescheduler_tpu.utils import logging as log
 # event log for context but never trigger a dump.
 DEGRADATION_KINDS = frozenset({
     "planner-fallback",        # contained planner crash -> numpy oracle
-    "remote-planner-fallback",  # service unreachable -> local oracle
+    "remote-planner-fallback",  # EVERY endpoint dead -> local oracle
     "breaker-engage",          # consecutive errors widened the interval
     "freshness-bypass",        # stale mirror -> direct-LIST observe
     "watch-stall",             # open-but-silent stream killed
-    "service-shed",            # planner service 503 (inflight/queue)
+    "service-shed",            # planner service 503 (inflight/queue/drain)
+    "device-sick",             # watchdog flipped the service host-side
+    "failover",                # served by a non-primary planner endpoint
 })
 CONTEXT_KINDS = frozenset({
     "orphan-taint-recovered",
     "stale-mirror-plan-refused",
+    "device-recovered",        # hysteresis probes passed; device resumes
 })
 EVENT_KINDS = DEGRADATION_KINDS | CONTEXT_KINDS
 

@@ -403,11 +403,32 @@ service_tenant_evictions = Counter(
 
 remote_planner_fallback = Counter(
     "remote_planner_fallback",
-    "Agent ticks planned by the LOCAL numpy-oracle fallback because the "
-    "remote planner service was unreachable, overloaded, or answered "
-    "out of protocol (service/agent.py RemotePlanner; the agent's "
-    "breaker skips the service for a backoff window after repeated "
-    "failures and re-engages on the next healthy reply).",
+    "Agent ticks planned by the LOCAL numpy-oracle fallback because "
+    "EVERY configured planner endpoint was unreachable, overloaded, "
+    "breaker-open, or answered out of protocol (service/agent.py "
+    "RemotePlanner; per-endpoint breakers skip a failing replica for a "
+    "backoff window and re-engage on the next healthy reply).",
+    namespace=NAMESPACE,
+)
+
+remote_planner_failover = Counter(
+    "remote_planner_failover",
+    "Agent ticks served by a planner endpoint AFTER at least one "
+    "earlier endpoint in the ordered --planner-urls list failed or was "
+    "breaker-open this tick — full-fidelity remote plans, but the "
+    "primary replica is unhealthy (flight recorder kind: failover).",
+    namespace=NAMESPACE,
+)
+
+service_device_sick = Gauge(
+    "service_device_sick",
+    "1 while the planner service's device-health watchdog "
+    "(service/devhealth.py) holds the accelerator SICK — consecutive "
+    "slower-than-baseline batched solves, a canary timeout, or an XLA "
+    "error — and every batch is served by the numpy-oracle host path; "
+    "flips back only after hysteresis recovery probes pass. The "
+    "/healthz 'device' field and the flight recorder's device-sick "
+    "event are driven by the same edge.",
     namespace=NAMESPACE,
 )
 
@@ -572,6 +593,14 @@ def update_remote_planner_fallback() -> None:
     remote_planner_fallback.inc()
 
 
+def update_remote_planner_failover() -> None:
+    remote_planner_failover.inc()
+
+
+def update_service_device_sick(sick: bool) -> None:
+    service_device_sick.set(1 if sick else 0)
+
+
 def service_snapshot() -> dict:
     """Service/agent counters via the public collect() API (tests and
     the serve-smoke harness diff before/after), plus the run's batch
@@ -585,6 +614,9 @@ def service_snapshot() -> dict:
         lanes = sample.value
     for sample in service_batch_tenants.collect()[0].samples:
         tenants = sample.value
+    device_sick = 0.0
+    for sample in service_device_sick.collect()[0].samples:
+        device_sick = sample.value
     return {
         "requests": by_outcome,
         "batch_lanes": lanes,
@@ -593,6 +625,8 @@ def service_snapshot() -> dict:
         "batch_tenants_max": _service_batch_max["tenants"],
         "tenant_evictions": _labeled_counter_total(service_tenant_evictions),
         "remote_planner_fallback": _counter_value(remote_planner_fallback),
+        "remote_planner_failover": _counter_value(remote_planner_failover),
+        "device_sick": device_sick,
     }
 
 

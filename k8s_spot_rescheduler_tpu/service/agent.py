@@ -12,14 +12,34 @@ few-hundred-byte boundary the in-process device fetch uses, so a fleet
 of agents costs the service O(tenants x packed bytes) ingress and
 near-zero egress.
 
-Degradation is the agent's job, not the loop's: a service that is
-unreachable, times out, overloads (503) or answers out of protocol
-degrades THIS tick to the local numpy-oracle fallback planner — the
-same containment the loop applies to a crashing in-process planner —
-counted in ``remote_planner_fallback_total``. Repeated failures open a
-circuit breaker that skips the service entirely for a doubling backoff
-window (bounded), so a dead service costs each tick one fallback solve,
-not one connect timeout; the first healthy reply closes the breaker.
+Degradation is the agent's job, not the loop's, and it is a LADDER, not
+a cliff:
+
+1. **failover** — the agent accepts an ordered list of planner
+   endpoints (``planner_urls`` / a comma list in ``planner_url``). Each
+   endpoint carries its OWN consecutive-failure breaker; a tick walks
+   the list in order, skipping breaker-open endpoints and failing over
+   past an endpoint that resets, times out, 5xxs, or answers out of
+   protocol. A reply from any endpoint is a full-fidelity remote plan —
+   a dead primary replica costs the fleet one connect failure per
+   breaker window, not a fallback. Served-after-failover ticks are
+   counted (``remote_planner_failover_total``) and evented (flight kind
+   ``failover``), both from the same site.
+2. **local fallback** — only when EVERY endpoint is dead or breaker-open
+   does the tick degrade to the in-process numpy-oracle fallback planner
+   (``remote_planner_fallback_total``, flight ``remote-planner-fallback``)
+   — the same containment the loop applies to a crashing in-process
+   planner. The first healthy reply closes that endpoint's breaker.
+
+A 503's ``Retry-After`` is honored below the breaker threshold as the
+skip window; at/above the threshold the skip window is
+``max(doubling backoff, Retry-After)`` with the server-suggested value
+capped at ``RETRY_AFTER_CAP_S`` — one bad LB header must not park an
+agent on its fallback for hours (the same 30 s cap the kube read path
+applies, docs/ROBUSTNESS.md).
+
+The transport is a seam (``self.transport``): ``service/chaos.py``
+wraps it to inject wire faults in ``make fleet-chaos-smoke``.
 """
 
 from __future__ import annotations
@@ -30,7 +50,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -40,21 +60,52 @@ from k8s_spot_rescheduler_tpu.models.cluster import PDBSpec
 from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
 from k8s_spot_rescheduler_tpu.planner.base import PlanReport
 from k8s_spot_rescheduler_tpu.service import wire
+from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
 from k8s_spot_rescheduler_tpu.utils import tracing
 
 
+class RemoteCallError(Exception):
+    """A planner-service call failed at the HTTP layer (typed so the
+    503 Retry-After can ride along to the breaker)."""
+
+    def __init__(self, message: str, retry_after: float):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+# historical name (pre-failover); tests and chaos wrappers may hold it
+_RemoteError = RemoteCallError
+
+
+class _Endpoint:
+    """Per-endpoint breaker state: failures at replica A must not make
+    the agent skip replica B."""
+
+    __slots__ = ("url", "consecutive_failures", "skip_until")
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.consecutive_failures = 0
+        self.skip_until = 0.0  # on the agent's clock (monotonic)
+
+
 class RemotePlanner:
-    """Planner over a remote multi-tenant planner service."""
+    """Planner over a remote multi-tenant planner service (or an
+    ordered failover list of its replicas)."""
 
     accepts_columnar = True
 
-    # breaker: consecutive failures before the service is skipped, and
+    # breaker: consecutive failures before an endpoint is skipped, and
     # the doubling skip window (seconds) that failure cadence buys
     FAIL_THRESHOLD = 2
     BACKOFF_BASE = 5.0
     BACKOFF_MAX = 120.0
+    # cap on the SERVER-suggested Retry-After contribution to the skip
+    # window (a misconfigured LB header must not stall failback for
+    # hours; outages past this belong to the doubling backoff)
+    RETRY_AFTER_CAP_S = 30.0
 
     def __init__(
         self,
@@ -63,10 +114,14 @@ class RemotePlanner:
         *,
         tenant: Optional[str] = None,
         timeout: Optional[float] = None,
+        clock: Optional[Clock] = None,
     ):
         self.config = config
-        self.url = (url or config.planner_url).rstrip("/")
-        if not self.url:
+        raw = url or config.planner_urls or config.planner_url
+        self._endpoints: List[_Endpoint] = [
+            _Endpoint(u.strip()) for u in raw.split(",") if u.strip()
+        ]
+        if not self._endpoints:
             raise ValueError("RemotePlanner needs a planner service url")
         import socket
 
@@ -74,18 +129,69 @@ class RemotePlanner:
         self.timeout = float(
             timeout if timeout is not None else config.planner_timeout
         )
+        self.clock = clock or RealClock()
+        # seam: (url, body, headers, timeout) -> reply bytes; raises
+        # RemoteCallError for HTTP errors. service/chaos.py wraps it.
+        self.transport = self._transport_urllib
+        if config.service_chaos_profile not in ("", "off", "none"):
+            from k8s_spot_rescheduler_tpu.service.chaos import (
+                ChaosAgentTransport,
+                ServiceFaultPlan,
+            )
+
+            log.info(
+                "CHAOS: service-path fault injection on the agent "
+                "transport (profile=%s seed=%d) — testing mode",
+                config.service_chaos_profile, config.service_chaos_seed,
+            )
+            self.transport = ChaosAgentTransport(
+                self.transport,
+                ServiceFaultPlan.profile(
+                    config.service_chaos_profile,
+                    config.service_chaos_seed,
+                ),
+                clock=self.clock,
+            )
         self._pad_c = 0
         self._pad_s = 0
         self._pad_k = config.max_pods_per_node_hint
         self._fallback = None  # lazy local numpy-oracle planner
-        self._consecutive_failures = 0
-        self._skip_until = 0.0  # monotonic; breaker-open horizon
         self.last_solver = "remote"
+        self.last_endpoint = ""
         # the trace the last plan recorded into: the controller's tick
         # trace when one is ambient, else a standalone trace (direct
         # callers like bench.serve_smoke read the grafted span tree off
         # this); None with tracing disabled
         self.last_trace = None
+
+    # ------------------------------------------------------------------
+    # single-endpoint compatibility surface (tests, serve_smoke)
+
+    @property
+    def url(self) -> str:
+        return self._endpoints[0].url
+
+    @url.setter
+    def url(self, value: str) -> None:
+        # repointing resets that endpoint's breaker (a NEW replica owes
+        # nothing to the old one's failure streak)
+        self._endpoints[0] = _Endpoint(value)
+
+    @property
+    def urls(self) -> List[str]:
+        return [ep.url for ep in self._endpoints]
+
+    @property
+    def _consecutive_failures(self) -> int:
+        return self._endpoints[0].consecutive_failures
+
+    @property
+    def _skip_until(self) -> float:
+        return self._endpoints[0].skip_until
+
+    @_skip_until.setter
+    def _skip_until(self, value: float) -> None:
+        self._endpoints[0].skip_until = float(value)
 
     # ------------------------------------------------------------------
 
@@ -97,65 +203,68 @@ class RemotePlanner:
 
             self._fallback = SolverPlanner(
                 dataclasses.replace(
-                    self.config, solver="numpy", planner_url=""
+                    self.config, solver="numpy",
+                    planner_url="", planner_urls="",
                 )
             )
         return self._fallback
 
-    def _note_failure(self, why: str, retry_after: float = 0.0) -> None:
-        self._consecutive_failures += 1
-        if self._consecutive_failures >= self.FAIL_THRESHOLD:
-            n = self._consecutive_failures - self.FAIL_THRESHOLD
+    def _note_failure(
+        self, ep: _Endpoint, why: str, retry_after: float = 0.0
+    ) -> None:
+        ep.consecutive_failures += 1
+        # one bad LB header must not stall failback for hours: the
+        # server-suggested horizon is capped wherever it feeds the skip
+        # window (regression-tested; docs/ROBUSTNESS.md)
+        suggested = min(max(retry_after, 0.0), self.RETRY_AFTER_CAP_S)
+        if ep.consecutive_failures >= self.FAIL_THRESHOLD:
+            n = ep.consecutive_failures - self.FAIL_THRESHOLD
             backoff = min(
                 self.BACKOFF_BASE * (2.0 ** n), self.BACKOFF_MAX
             )
-            backoff = max(backoff, retry_after)
-            self._skip_until = time.monotonic() + backoff
+            # a LONGER server-suggested Retry-After beats the schedule
+            # (the server knows its queue) — capped above
+            backoff = max(backoff, suggested)
+            ep.skip_until = self.clock.now() + backoff
             log.error(
-                "planner service unusable (%s; %d consecutive failures); "
-                "skipping it for %.1fs — local fallback plans until then",
-                why, self._consecutive_failures, backoff,
+                "planner endpoint %s unusable (%s; %d consecutive "
+                "failures); skipping it for %.1fs",
+                ep.url, why, ep.consecutive_failures, backoff,
             )
-        elif retry_after > 0:
+        elif suggested > 0:
             # a single 503 already names its horizon: honor it without
             # waiting for the threshold
-            self._skip_until = time.monotonic() + retry_after
+            ep.skip_until = self.clock.now() + suggested
             log.warning(
-                "planner service overloaded (%s); retrying after %.1fs",
-                why, retry_after,
+                "planner endpoint %s overloaded (%s); retrying after %.1fs",
+                ep.url, why, suggested,
             )
         else:
-            log.warning("planner service call failed: %s", why)
-
-    def _note_success(self) -> None:
-        if self._consecutive_failures:
-            log.info(
-                "planner service healthy again after %d failed call(s)",
-                self._consecutive_failures,
+            log.warning(
+                "planner endpoint %s call failed: %s", ep.url, why
             )
-        self._consecutive_failures = 0
-        self._skip_until = 0.0
 
-    def _post(self, body: bytes, trace_id: str = "") -> wire.PlanReply:
-        headers = {
-            "Content-Type": "application/octet-stream",
-            # declare our own deadline so the service evicts (and
-            # frees the slot of) a request we will have abandoned
-            "X-Planner-Deadline": f"{self.timeout:.3f}",
-        }
-        if trace_id:
-            # belt to the wire frame: proxies/logs see the correlation
-            # id even when the binary body is opaque to them
-            headers["X-Trace-Id"] = trace_id
+    def _note_success(self, ep: _Endpoint) -> None:
+        if ep.consecutive_failures:
+            log.info(
+                "planner endpoint %s healthy again after %d failed call(s)",
+                ep.url, ep.consecutive_failures,
+            )
+        ep.consecutive_failures = 0
+        ep.skip_until = 0.0
+
+    def _transport_urllib(
+        self, url: str, body: bytes, headers: dict, timeout: float
+    ) -> bytes:
+        """The default transport: one POST, reply bytes back.
+        HTTP error statuses become :class:`RemoteCallError` carrying any
+        503 Retry-After; everything else propagates as-is."""
         req = urllib.request.Request(
-            f"{self.url}/v2/plan",
-            data=body,
-            headers=headers,
-            method="POST",
+            url, data=body, headers=headers, method="POST"
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return wire.decode_plan_reply(resp.read())
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.read()
         except urllib.error.HTTPError as err:
             retry_after = 0.0
             if err.code == 503:
@@ -168,7 +277,7 @@ class RemotePlanner:
                 wire.decode_plan_reply(err.read())
             except wire.WireError as werr:
                 detail = str(werr)
-            raise _RemoteError(
+            raise RemoteCallError(
                 f"HTTP {err.code}{': ' + detail if detail else ''}",
                 retry_after,
             ) from err
@@ -180,19 +289,20 @@ class RemotePlanner:
         return self.plan_async(observation, pdbs)()
 
     def plan_async(self, observation, pdbs: Sequence[PDBSpec]):
-        """Pack locally, dispatch the service call on a worker thread
-        (the loop's metrics pass overlaps the network round trip exactly
-        as it overlaps the in-process device solve), and return the
-        blocking ``finish`` callable.
+        """Pack locally, walk the endpoint ladder on a worker thread
+        (the loop's metrics pass overlaps the network round trips
+        exactly as it overlaps the in-process device solve), and return
+        the blocking ``finish`` callable.
 
         Tracing: the pack and the wire round trip record into the
         controller's ambient tick trace (or a standalone trace for
         direct callers); the tick's trace ID ships with the request
-        (wire v2 frame + ``X-Trace-Id``) and the server's own spans come
-        back in the reply and are grafted under ``wire.request`` — one
-        tree separates queue, solve and wire time per tick. The worker
-        thread only stores raw timestamps; all trace mutation happens on
-        the caller's thread at ``finish`` (traces are single-threaded)."""
+        (wire v2 frame + ``X-Trace-Id``) and the serving endpoint's
+        spans come back in the reply and are grafted under
+        ``wire.request``; each FAILED endpoint attempt grafts a
+        ``wire.failover`` span. The worker thread only stores raw
+        timestamps and outcomes; all trace mutation happens on the
+        caller's thread at ``finish`` (traces are single-threaded)."""
         t0 = time.perf_counter()
         cfg = self.config
         trace = tracing.current_trace()
@@ -237,25 +347,80 @@ class RemotePlanner:
         for blocked in meta.blocking_pods():
             log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)
 
-        breaker_open = time.monotonic() < self._skip_until
-        box: dict = {}
+        live = [
+            ep for ep in self._endpoints
+            if self.clock.now() >= ep.skip_until
+        ]
+        box: dict = {"attempts": [], "skipped_before": 0}
         worker: Optional[threading.Thread] = None
-        if not breaker_open:
+        if live:
             trace_id = trace.trace_id if trace is not None else ""
             body = wire.encode_plan_request(
                 self.tenant, packed, trace_id=trace_id
             )
+            headers = {
+                "Content-Type": "application/octet-stream",
+                # declare our own deadline so the service evicts (and
+                # frees the slot of) a request we will have abandoned
+                "X-Planner-Deadline": f"{self.timeout:.3f}",
+            }
+            if trace_id:
+                # belt to the wire frame: proxies/logs see the
+                # correlation id even when the binary body is opaque
+                headers["X-Trace-Id"] = trace_id
 
             def call():
                 box["t_send"] = time.perf_counter()
-                try:
-                    box["reply"] = self._post(body, trace_id=trace_id)
-                except _RemoteError as err:
-                    box["error"] = err
-                except Exception as err:  # noqa: BLE001 — transport/proto
-                    box["error"] = _RemoteError(str(err), 0.0)
-                finally:
-                    box["t_recv"] = time.perf_counter()
+                # ONE deadline budget for the whole ladder: the tick's
+                # documented planner_timeout bounds the plan call, not
+                # each endpoint — three blackholed replicas must not
+                # stall the loop 3x the deadline
+                deadline = box["t_send"] + self.timeout
+                skipped = 0
+                for ep in self._endpoints:
+                    if self.clock.now() < ep.skip_until:
+                        # counts toward failover only if it precedes the
+                        # endpoint that eventually serves
+                        skipped += 1
+                        continue
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        box["attempts"].append((
+                            ep.url,
+                            "plan deadline exhausted before this "
+                            "endpoint was tried",
+                            0.0,
+                        ))
+                        # not an endpoint failure: its breaker is
+                        # untouched — we simply ran out of budget
+                        continue
+                    t_ep = time.perf_counter()
+                    try:
+                        raw = self.transport(
+                            f"{ep.url}/v2/plan", body, headers,
+                            max(0.05, remaining),
+                        )
+                        reply = wire.decode_plan_reply(raw)
+                    except RemoteCallError as err:
+                        self._note_failure(ep, str(err), err.retry_after)
+                        box["attempts"].append((
+                            ep.url, str(err),
+                            (time.perf_counter() - t_ep) * 1e3,
+                        ))
+                        continue
+                    except Exception as err:  # noqa: BLE001, exception-discipline — transport/protocol failure of ONE endpoint: recorded as a failover attempt and the ladder continues; the terminal all-dead case is counted+evented in _plan_fallback
+                        self._note_failure(ep, str(err), 0.0)
+                        box["attempts"].append((
+                            ep.url, str(err),
+                            (time.perf_counter() - t_ep) * 1e3,
+                        ))
+                        continue
+                    self._note_success(ep)
+                    box["reply"] = reply
+                    box["endpoint"] = ep.url
+                    box["skipped_before"] = skipped
+                    break
+                box["t_recv"] = time.perf_counter()
 
             worker = threading.Thread(target=call, daemon=True)
             worker.start()
@@ -264,16 +429,41 @@ class RemotePlanner:
             if worker is not None:
                 worker.join()
             reply = box.get("reply")
+            attempts = box["attempts"]
+            if trace is not None:
+                for ep_url, why, dur_ms in attempts:
+                    trace.graft(
+                        tracing.make_span("wire.failover", 0.0, dur_ms),
+                        attrs={"endpoint": ep_url, "error": True},
+                    )
             if reply is None:
-                err = box.get("error")
-                if err is not None:
-                    self._note_failure(str(err), err.retry_after)
+                causes = "; ".join(why for _, why, _ in attempts)
                 return self._plan_fallback(
                     observation, pdbs,
-                    cause=str(box.get("error", "breaker open")),
+                    cause=causes or "breaker open on every endpoint",
                 )
-            self._note_success()
             self.last_solver = "remote"
+            self.last_endpoint = box.get("endpoint", "")
+            skipped_before = box["skipped_before"]
+            if attempts or skipped_before:
+                # served, but only after at least one EARLIER endpoint
+                # failed or was breaker-open: a failover tick. Metric
+                # and flight event fire together so the two surfaces
+                # always agree. (A breaker-open endpoint LATER in the
+                # list is irrelevant — the primary serving is healthy.)
+                metrics.update_remote_planner_failover()
+                flight.note_event(
+                    "failover",
+                    cause=(
+                        f"{len(attempts)} endpoint(s) failed, "
+                        f"{skipped_before} breaker-open; served by "
+                        f"{box.get('endpoint', '?')}"
+                    ),
+                    trace_id=(
+                        trace.trace_id if trace is not None else ""
+                    ),
+                    endpoints_tried=len(attempts) + skipped_before + 1,
+                )
             if trace is not None:
                 # graft the server's span block under the measured round
                 # trip; the residual (rtt minus server-side work) is the
@@ -313,10 +503,10 @@ class RemotePlanner:
         return finish
 
     def _plan_fallback(self, observation, pdbs, cause: str = "") -> PlanReport:
-        """This tick plans locally (numpy oracle) — the service is down,
-        slow, overloaded or out of protocol. Counted (metric + flight
-        event, same site); the loop keeps running at full fidelity minus
-        device speed."""
+        """This tick plans locally (numpy oracle) — every endpoint is
+        down, slow, overloaded or out of protocol. Counted (metric +
+        flight event, same site); the loop keeps running at full
+        fidelity minus device speed."""
         metrics.update_remote_planner_fallback()
         flight.note_event(
             "remote-planner-fallback",
@@ -327,10 +517,5 @@ class RemotePlanner:
         )
         report = self._fallback_planner().plan(observation, pdbs)
         self.last_solver = "remote-fallback"
+        self.last_endpoint = ""
         return dataclasses.replace(report, solver="remote-fallback")
-
-
-class _RemoteError(Exception):
-    def __init__(self, message: str, retry_after: float):
-        super().__init__(message)
-        self.retry_after = float(retry_after)

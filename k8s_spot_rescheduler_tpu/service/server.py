@@ -31,12 +31,34 @@ scheduler*:
 ``GET /healthz`` reports queue depth, per-bucket occupancy, per-tenant
 last-plan age and the measured cadence alongside the control-loop
 health snapshot, so a probe can see a starving tenant without Prometheus.
+
+Fleet failure containment (docs/ROBUSTNESS.md "Fleet failure domains"):
+
+- a **device-health watchdog** (service/devhealth.py) times every
+  batched device solve against a calibrated baseline and runs idle
+  canaries; a sick device (consecutive slow batches, canary timeout, or
+  an XLA error) flips the service to its numpy-oracle host path —
+  ``/healthz`` says ``device: "sick"``, the ``service_device_sick``
+  gauge reads 1 and the flight recorder holds a ``device-sick`` event —
+  and only hysteresis-gated recovery probes flip it back;
+- **graceful drain**: SIGTERM (``ServiceServer.graceful_shutdown``)
+  stops admitting (503 + Retry-After), finishes queued batches within
+  ``service_drain_grace``, persists the warm state, then exits;
+- **warm restart**: per-tenant last-pack fingerprints and the
+  recently-used bucket list persist to ``service_state_dir``; a
+  restarted replica pre-warms those bucket compiles on boot so N
+  reconnecting agents do not land on a compile storm;
+- **chaos hooks** (service/chaos.py): a seeded ``ServiceFaultPlan`` can
+  corrupt incoming requests ahead of the decode and inject scripted
+  batch-solve failures / sick-phase latency inside the timed solve
+  window — how ``make fleet-chaos-smoke`` proves all of the above.
 """
 
 from __future__ import annotations
 
 import json
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -51,6 +73,7 @@ from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.service import buckets as bucketing
 from k8s_spot_rescheduler_tpu.service import wire
 from k8s_spot_rescheduler_tpu.service.buckets import Bucket
+from k8s_spot_rescheduler_tpu.service.devhealth import DeviceHealthWatchdog
 from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
@@ -72,6 +95,13 @@ class ServiceBusy(Exception):
 # service without bound
 TENANT_STATE_TTL_S = 3600.0
 TENANT_STATE_MAX = 4096
+
+# warm-restart state (service_state_dir): file name, save cadence, and
+# how many recently-used buckets a restarted replica pre-warms
+STATE_FILE = "planner_warm_state.json"
+STATE_SAVE_INTERVAL_S = 60.0
+WARM_MAX_BUCKETS = 8
+SEEN_BUCKETS_MAX = 64
 
 
 class _Request:
@@ -140,9 +170,41 @@ class PlannerService:
         self._batched = None  # lazy jitted tenant-batch program
         self._mesh = None
         self._stop = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
-        # test seam: solve_hook(stacked, reqs) -> int32 [T, 3+K]
+        # test seam: solve_hook(stacked, reqs) -> int32 [T, 3+K]. When
+        # set it IS the device path: the watchdog times it and the sick
+        # flip routes around it, exactly as for the real device solve.
         self.solve_hook = None
+        # device-health watchdog (lazy; None while device_sick_threshold
+        # is 0) + the server-side chaos hook (None outside chaos runs)
+        self._devhealth: Optional[DeviceHealthWatchdog] = None
+        self.chaos = None
+        if config.service_chaos_profile not in ("", "off", "none"):
+            from k8s_spot_rescheduler_tpu.service.chaos import (
+                ServiceChaos,
+                ServiceFaultPlan,
+            )
+
+            self.chaos = ServiceChaos(
+                ServiceFaultPlan.profile(
+                    config.service_chaos_profile,
+                    config.service_chaos_seed,
+                ),
+                clock=self.clock,
+            )
+        # warm-restart bookkeeping: recently-used bucket shapes (dims ->
+        # last-used wall) and per-tenant last-pack fingerprints, both
+        # bounded, persisted to service_state_dir
+        self._seen_buckets: Dict[tuple, float] = {}
+        self._tenant_bucket: Dict[str, str] = {}
+        self._last_state_save: Optional[float] = None
+        self.warmed_buckets: List[str] = []
+        # stacked shapes whose program has already run once: the FIRST
+        # solve of a shape includes its XLA compile and must not be
+        # judged (or baselined) as device latency by the watchdog — a
+        # fleet ramp-up's compiles are not a sick accelerator
+        self._timed_shapes: set = set()
 
     # ------------------------------------------------------------------
     # queue
@@ -157,6 +219,15 @@ class PlannerService:
             trace_id=trace_id,
         )
         with self._work:
+            if self._draining:
+                # graceful drain: stop admitting; the Retry-After horizon
+                # is the drain grace (by then this replica is gone and a
+                # failover endpoint or a fresh replica answers)
+                raise ServiceBusy(
+                    "service draining (graceful shutdown); retry another "
+                    "replica",
+                    self.drain_retry_after(),
+                )
             q = self._queues.get(tenant)
             if q is None:
                 q = self._queues[tenant] = deque()
@@ -240,8 +311,10 @@ class PlannerService:
             return sum(len(q) for q in self._queues.values())
 
     def healthz_snapshot(self) -> dict:
-        """Queue depth, per-bucket occupancy, per-tenant last-plan age
-        and the measured cadence — the service half of /healthz."""
+        """Queue depth, per-bucket occupancy, per-tenant last-plan age,
+        the measured cadence, the drain flag and the device-health
+        verdict — the service half of /healthz."""
+        wd = self._watchdog()  # takes (and releases) the lock itself
         with self._work:
             depth = 0
             by_bucket: Dict[str, int] = {}
@@ -256,7 +329,8 @@ class PlannerService:
                 for t, w in self._last_plan_wall.items()
             }
             cadence = self._cadence_s
-        return {
+            draining = self._draining
+        out = {
             "queue_depth": depth,
             "bucket_occupancy": by_bucket,
             "tenant_last_plan_age_s": tenants,
@@ -264,7 +338,13 @@ class PlannerService:
                 None if cadence is None else round(cadence, 3)
             ),
             "batch_window_s": self.batch_window_s,
+            "draining": draining,
         }
+        if wd is not None:
+            out.update(wd.snapshot())
+        else:
+            out["device"] = "unwatched"  # device_sick_threshold = 0
+        return out
 
     # ------------------------------------------------------------------
     # batching
@@ -363,12 +443,10 @@ class PlannerService:
             ]
             stacked = bucketing.stack_bucket(padded, bucket)
             t_solve = self.clock.now()
-            if self.solve_hook is not None:
-                out = np.asarray(self.solve_hook(stacked, batch))
-            else:
-                out = self._solve(stacked)
+            out = self._solve_batch(stacked, batch)
         except Exception as err:  # noqa: BLE001 — contain: fail the batch,
-            # not the service (the agents fall back to their local oracle)
+            # not the service (the agents fall back to their local oracle);
+            # counted via update_service_request("error") below
             log.error("batched solve failed: %s", err)
             for req in batch:
                 req.error = ServiceBusy(f"solve failed: {err}", 0)
@@ -387,6 +465,13 @@ class PlannerService:
             # bookkeeping a concurrent /healthz iterates — same lock
             for req in batch:
                 self._last_plan_wall[req.tenant] = wall
+                # warm-restart fingerprint: the bucket this tenant's
+                # last pack landed in (persisted to service_state_dir)
+                self._tenant_bucket[req.tenant] = bucket.key
+            self._seen_buckets[tuple(bucket)] = wall
+            if len(self._seen_buckets) > SEEN_BUCKETS_MAX:
+                oldest = min(self._seen_buckets, key=self._seen_buckets.get)
+                del self._seen_buckets[oldest]
             # bounded: tenant ids are client-supplied, so the age map
             # drops entries past the TTL and hard-caps at the newest
             # TENANT_STATE_MAX (a churning fleet must not grow the
@@ -404,6 +489,12 @@ class PlannerService:
                     reverse=True,
                 )[:TENANT_STATE_MAX]
                 self._last_plan_wall = dict(newest)
+            if len(self._tenant_bucket) > len(self._last_plan_wall):
+                self._tenant_bucket = {
+                    t: b
+                    for t, b in self._tenant_bucket.items()
+                    if t in self._last_plan_wall
+                }
             if self._last_batch_mono is not None:
                 interval = max(1e-9, end - self._last_batch_mono)
                 self._cadence_s = (
@@ -447,7 +538,324 @@ class PlannerService:
             )
             metrics.update_service_request("ok")
             req.event.set()
+        if self._state_path() and (
+            self._last_state_save is None
+            or wall - self._last_state_save >= STATE_SAVE_INTERVAL_S
+        ):
+            # opportunistic warm-state save: a kill -9 at most loses one
+            # interval of fingerprints, never availability
+            self._last_state_save = wall
+            self.save_state()
         return True
+
+    # ------------------------------------------------------------------
+    # device health + solve routing
+
+    def _watchdog(self) -> Optional[DeviceHealthWatchdog]:
+        if self.config.device_sick_threshold <= 0:
+            return None
+        with self._work:
+            # lazy-create under the lock: a /healthz probe racing the
+            # first batch must not replace the instance the solve path
+            # just flipped sick (the gauge/flight/healthz agreement
+            # depends on there being exactly ONE watchdog)
+            if self._devhealth is None:
+                self._devhealth = DeviceHealthWatchdog(
+                    self.clock, self.config.device_sick_threshold
+                )
+            return self._devhealth
+
+    def _first_compile(self, stacked: PackedCluster) -> bool:
+        """True exactly once per stacked shape family: that solve pays
+        the jit compile, which the watchdog must not read as latency."""
+        key = (
+            stacked.slot_req.shape, stacked.spot_free.shape,
+            stacked.spot_taints.shape, stacked.spot_aff.shape,
+        )
+        if key in self._timed_shapes:
+            return False
+        if len(self._timed_shapes) > 4096:
+            self._timed_shapes.clear()
+        self._timed_shapes.add(key)
+        return True
+
+    def _device_solve_timed(self, stacked: PackedCluster, batch):
+        """One device-path solve (the solve_hook seam included), timed
+        on the service clock, with the server-side chaos hook inside the
+        timing window (injected sick-phase latency must be SEEN)."""
+        t = self.clock.now()
+        try:
+            if self.chaos is not None:
+                self.chaos.on_batch()
+            if self.solve_hook is not None:
+                out = np.asarray(self.solve_hook(stacked, batch))
+            else:
+                out = self._solve(stacked)
+            return np.asarray(out), self.clock.now() - t, None
+        except Exception as err:  # noqa: BLE001, exception-discipline — the error is RETURNED for classification: every caller either re-raises it or flips the watchdog, which fires the device-sick metric + flight event
+            return None, self.clock.now() - t, err
+
+    def _note_device_edge(self, edge: Optional[str]) -> None:
+        """Fire the gauge, the flight event and the log line for one
+        watchdog edge — ONE site per edge so /healthz, the
+        ``service_device_sick`` gauge and the flight recorder always
+        agree."""
+        if edge is None:
+            return
+        wd = self._devhealth
+        if edge == "sick":
+            metrics.update_service_device_sick(True)
+            flight.note_event(
+                "device-sick",
+                cause=wd.sick_reason or "device health watchdog fired",
+            )
+            log.error(
+                "device sick (%s) — serving the numpy-oracle host path "
+                "until hysteresis probes pass",
+                wd.sick_reason,
+            )
+        elif edge == "recovered":
+            metrics.update_service_device_sick(False)
+            flight.note_event(
+                "device-recovered",
+                cause=f"{wd.RECOVERY_PROBES} consecutive healthy probes",
+            )
+            log.info(
+                "device recovered after hysteresis probes; the device "
+                "solve path resumes"
+            )
+
+    def _solve_batch(self, stacked: PackedCluster, batch) -> np.ndarray:
+        """Route one stacked batch through the failure-domain ladder:
+        the device path while healthy (timed into the watchdog), the
+        numpy-oracle host path while sick (except hysteresis probes).
+        A device exception flips the watchdog and is contained to the
+        host path for the batch; host-path exceptions propagate to
+        drain_once's per-batch containment."""
+        wd = self._watchdog()
+        if wd is None:
+            out, _dur, err = self._device_solve_timed(stacked, batch)
+            if err is not None:
+                raise err
+            return out
+        if not wd.sick:
+            first = self._first_compile(stacked)
+            out, dur, err = self._device_solve_timed(stacked, batch)
+            if err is not None:
+                self._note_device_edge(wd.note_error(err))
+                # the batch still fails typed (drain_once contains it):
+                # the agents' local fallback owns THIS tick, the host
+                # path owns the next — no silently-different result from
+                # the batch that exposed the error
+                raise err
+            if not first:
+                # a shape's first solve carries its compile: neither a
+                # slowness verdict nor a baseline sample
+                self._note_device_edge(wd.note_batch(dur))
+            # a slow result is still a correct result
+            return out
+        if wd.should_probe():
+            first = self._first_compile(stacked)
+            out, dur, err = self._device_solve_timed(stacked, batch)
+            if err is not None:
+                self._note_device_edge(wd.note_probe(dur, ok=False))
+                return self._solve_host(stacked)
+            if not first:
+                self._note_device_edge(wd.note_probe(dur, ok=True))
+            return out
+        return self._solve_host(stacked)
+
+    def run_canary(self) -> None:
+        """Idle liveness canary (called from the scheduler loop): a tiny
+        all-invalid solve through the device path, timed into the
+        watchdog, so a wedging device is noticed before the next real
+        request pays for the discovery."""
+        wd = self._watchdog()
+        if wd is None or not wd.should_canary():
+            return
+        bucket = self._canary_bucket()
+        if bucket is None:
+            return  # nothing has solved yet: no R/W/A dims to build with
+        stacked = self._all_invalid_stack(bucket)
+        first = self._first_compile(stacked)
+        out, dur, err = self._device_solve_timed(stacked, [])
+        if err is None and first:
+            # the canary shape's first run pays its own compile — a
+            # liveness proof, not a latency sample
+            return
+        self._note_device_edge(wd.note_canary(dur, ok=err is None))
+
+    def _canary_bucket(self) -> Optional[Bucket]:
+        """The smallest bucket in the fleet's R/W/A shape family — tiny
+        by construction, so the canary costs one small compile and a
+        trivial solve."""
+        with self._work:
+            if not self._seen_buckets:
+                return None
+            dims = max(self._seen_buckets, key=self._seen_buckets.get)
+        b = Bucket(*dims)
+        return Bucket(
+            C=bucketing.MIN_DIM, K=bucketing.MIN_DIM, S=bucketing.MIN_DIM,
+            R=b.R, W=b.W, A=b.A,
+        )
+
+    @staticmethod
+    def _all_invalid_stack(b: Bucket) -> PackedCluster:
+        """A T=1 stacked problem of pure pad at the bucket's shape:
+        invalid lanes, empty slots, not-ok zero-capacity spots — solves
+        to found=False rows, compiles the real program."""
+        p = PackedCluster(
+            slot_req=np.zeros((b.C, b.K, b.R), np.float32),
+            slot_valid=np.zeros((b.C, b.K), bool),
+            slot_tol=np.zeros((b.C, b.K, b.W), np.uint32),
+            slot_aff=np.zeros((b.C, b.K, b.A), np.uint32),
+            cand_valid=np.zeros(b.C, bool),
+            spot_free=np.zeros((b.S, b.R), np.float32),
+            spot_count=np.zeros(b.S, np.int32),
+            spot_max_pods=np.zeros(b.S, np.int32),
+            spot_taints=np.zeros((b.S, b.W), np.uint32),
+            spot_ok=np.zeros(b.S, bool),
+            spot_aff=np.zeros((b.S, b.A), np.uint32),
+        )
+        return bucketing.stack_bucket([p], b)
+
+    # ------------------------------------------------------------------
+    # graceful drain + warm restart
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain_retry_after(self) -> int:
+        """The ONE Retry-After horizon every drain-refusal surface
+        quotes (typed ServiceBusy, HTTP header, log line): the grace —
+        by then this replica is gone and another answers."""
+        return max(1, int(math.ceil(self.config.service_drain_grace)))
+
+    def begin_drain(self) -> None:
+        """Stop admitting (new submissions get 503 + Retry-After); the
+        already-queued work still solves, bounded by
+        ``drain_pending``."""
+        with self._work:
+            if self._draining:
+                return
+            self._draining = True
+            self._work.notify_all()
+        log.info(
+            "planner service draining: refusing new plan requests "
+            "(Retry-After %ds); finishing queued batches",
+            self.drain_retry_after(),
+        )
+
+    def drain_pending(self) -> None:
+        """Finish queued batches within ``service_drain_grace``; evict
+        whatever remains past the grace with a typed 503 so no agent
+        blocks on a dying replica."""
+        grace = self.config.service_drain_grace
+        deadline = self.clock.now() + grace
+        while self.clock.now() < deadline:
+            if not self.drain_once():
+                break
+        with self._work:
+            leftover = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+        for req in leftover:
+            req.error = ServiceBusy(
+                "service draining (graceful shutdown); retry another "
+                "replica",
+                self.drain_retry_after(),
+            )
+            metrics.update_service_request("expired")
+            metrics.update_service_tenant_eviction(req.tenant)
+            flight.note_event(
+                "service-shed",
+                cause="queued plan request evicted by graceful drain",
+                trace_id=req.trace_id,
+                tenant=req.tenant,
+            )
+            req.event.set()
+
+    def _state_path(self) -> str:
+        d = self.config.service_state_dir
+        return os.path.join(d, STATE_FILE) if d else ""
+
+    def save_state(self) -> Optional[str]:
+        """Persist the warm-restart state (atomic rename): per-tenant
+        last-pack bucket fingerprints + the recently-used bucket list a
+        restarted replica pre-warms."""
+        path = self._state_path()
+        if not path:
+            return None
+        with self._work:
+            buckets = sorted(
+                self._seen_buckets,
+                key=self._seen_buckets.get,
+                reverse=True,
+            )
+            payload = {
+                "version": 1,
+                "tenants": dict(self._tenant_bucket),
+                "buckets": [list(dims) for dims in buckets],
+            }
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, sort_keys=True)
+            os.replace(tmp, path)
+            return path
+        except OSError as err:
+            # a full/readonly state volume must not take the service
+            # down; the only cost is a colder next restart
+            log.error("planner warm-state save failed: %s", err)
+            return None
+
+    def warm_start(self) -> List[str]:
+        """Pre-warm the persisted buckets' compiles on boot so a
+        restarted replica doesn't eat a compile storm from N
+        reconnecting agents; returns the warmed bucket keys."""
+        path = self._state_path()
+        if not path or not os.path.exists(path):
+            return []
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            bucket_dims = list(payload.get("buckets", ()))
+            tenants = payload.get("tenants", {})
+        except (OSError, ValueError, TypeError, AttributeError) as err:
+            # valid JSON of the wrong SHAPE (a list, "buckets": 5) must
+            # cost a cold start, never the boot — same contract as an
+            # unreadable file
+            log.error("planner warm state unreadable (%s); cold start", err)
+            return []
+        warmed: List[str] = []
+        wall = self.clock.wall()
+        for dims in bucket_dims[:WARM_MAX_BUCKETS]:
+            try:
+                b = Bucket(*(int(d) for d in dims))
+            except (TypeError, ValueError):
+                continue
+            try:
+                self._solve(self._all_invalid_stack(b))
+            except Exception as err:  # noqa: BLE001, exception-discipline — a failed pre-warm costs one later cold compile, never availability; boot continues and the failure is logged
+                log.error("bucket %s pre-warm failed: %s", b.key, err)
+                continue
+            warmed.append(b.key)
+            with self._work:
+                self._seen_buckets[tuple(b)] = wall
+        if isinstance(tenants, dict):
+            with self._work:
+                self._tenant_bucket.update(
+                    {str(t): str(k) for t, k in tenants.items()}
+                )
+        if warmed:
+            log.info(
+                "warm restart: pre-warmed %d bucket compile(s): %s",
+                len(warmed), ", ".join(warmed),
+            )
+        self.warmed_buckets = warmed
+        return warmed
 
     # ------------------------------------------------------------------
     # solving
@@ -478,7 +886,7 @@ class PlannerService:
                     )
 
                     self._mesh = make_tenant_mesh()
-            except Exception:  # noqa: BLE001 — no backend info: stay 1-chip
+            except Exception:  # noqa: BLE001, exception-discipline — no backend info: stay 1-chip, the single-device vmap program is the documented degradation and /healthz batch_program names it
                 self._mesh = None
             cfg = self.config
             if cfg.solver not in ("jax",):
@@ -573,14 +981,26 @@ class PlannerService:
     def _loop(self) -> None:
         while True:
             with self._work:
-                while not self._stop and not any(
-                    self._queues.get(t) for t in self._queues
-                ):
+                has_work = any(self._queues.get(t) for t in self._queues)
+                if not has_work and not self._stop and not self._draining:
                     self._work.wait(timeout=1.0)
+                    has_work = any(
+                        self._queues.get(t) for t in self._queues
+                    )
                 if self._stop:
                     return
+                if self._draining and not has_work:
+                    # graceful drain finished its queue; drain_pending
+                    # owns the bounded tail, nothing left to schedule
+                    return
+            if not has_work:
+                # idle: give the device-health watchdog its canary
+                # window (no-op unless overdue)
+                self.run_canary()
+                continue
             # coalescing window: concurrent tenants land in one batch
-            if self.batch_window_s > 0:
+            # (skipped while draining — latency no longer buys batching)
+            if self.batch_window_s > 0 and not self._draining:
                 self.clock.sleep(self.batch_window_s)
             while self.drain_once():
                 pass
@@ -727,6 +1147,24 @@ class ServiceServer:
                     )
                     metrics.update_service_request("rejected")
                     return None
+                if server.service.draining:
+                    # graceful drain: refuse BEFORE the body is read,
+                    # naming the horizon a failover replica answers by
+                    metrics.update_service_request("rejected")
+                    flight.note_event(
+                        "service-shed",
+                        cause="replica draining (graceful shutdown)",
+                        trace_id=self.headers.get("X-Trace-Id", "") or "",
+                    )
+                    self._reject_unread(
+                        {"error": "planner draining"},
+                        503,
+                        headers=[(
+                            "Retry-After",
+                            str(server.service.drain_retry_after()),
+                        )],
+                    )
+                    return None
                 if not server._admit():
                     metrics.update_service_request("rejected")
                     flight.note_event(
@@ -769,6 +1207,13 @@ class ServiceServer:
                 body = self._read_body()
                 if body is None:
                     return
+                chaos = server.service.chaos
+                if chaos is not None:
+                    # the decode chaos hook: a corrupted request must
+                    # come back as a clean typed 400, never a crash
+                    corrupted = chaos.corrupt_request(body)
+                    if corrupted is not None:
+                        body = corrupted
                 # the reply speaks the REQUEST's protocol version so an
                 # un-upgraded v1 agent keeps decoding; before a
                 # successful decode the raw header byte is the best
@@ -878,8 +1323,9 @@ class ServiceServer:
                     except (ValueError, KeyError) as err:
                         return self._send_json({"error": str(err)}, 400)
                     return self._send_json(result)
-                except Exception as err:  # noqa: BLE001 — solver failure
+                except Exception as err:  # noqa: BLE001 — handler survives
                     log.error("service /v1/plan failed: %s", err)
+                    metrics.update_service_request("error")
                     return self._send_json({"error": str(err)}, 500)
                 finally:
                     server._release()
@@ -1002,14 +1448,32 @@ class ServiceServer:
 
     def serve_forever(self) -> None:
         log.info("planner service listening on %s", self.address)
+        self.service.warm_start()
         self.service.start_scheduler()
         self._serving = True
         self.server.serve_forever()
 
-    def start_background(self) -> None:
-        self.service.start_scheduler()
+    def start_background(self, scheduler: bool = True) -> None:
+        """Serve on a daemon thread. ``scheduler=False`` skips the
+        batching thread: submissions then drain synchronously on the
+        handler thread — the deterministic mode the virtual-clock fleet
+        smoke drives (no thread ever sleeps on the shared clock)."""
+        self.service.warm_start()
+        if scheduler:
+            self.service.start_scheduler()
         self._serving = True
         threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def graceful_shutdown(self) -> None:
+        """The SIGTERM contract (docs/ROBUSTNESS.md): stop admitting
+        (503 + Retry-After = the drain grace), finish queued batches
+        within ``service_drain_grace``, persist the warm-restart state,
+        then stop serving."""
+        svc = self.service
+        svc.begin_drain()
+        svc.stop_scheduler()
+        svc.drain_pending()
+        self.close()  # close() persists the warm state
 
     def close(self) -> None:
         # shutdown() handshakes with a RUNNING serve_forever loop; with
@@ -1019,6 +1483,7 @@ class ServiceServer:
             self.server.shutdown()
         self.server.server_close()
         self.service.stop_scheduler()
+        self.service.save_state()
 
 
 def main(argv=None) -> int:
@@ -1043,6 +1508,13 @@ def main(argv=None) -> int:
                     help="reject immediately (503) past this many "
                          "concurrent requests — bounds worst-case request "
                          "memory at max-inflight x max-body-mb")
+    ap.add_argument("--state-dir", default="",
+                    help="persist per-tenant pack fingerprints + the "
+                         "bucket warmup list here so a restarted replica "
+                         "pre-warms its compiles (warm restart)")
+    ap.add_argument("--drain-grace", type=float, default=5.0,
+                    help="seconds SIGTERM lets queued batches finish "
+                         "before the rest are evicted with 503")
     ap.add_argument("-v", "--verbosity", type=int, default=0)
     args = ap.parse_args(argv)
     log.setup(args.verbosity)
@@ -1051,13 +1523,36 @@ def main(argv=None) -> int:
             solver=args.solver,
             service_queue_timeout=args.queue_timeout,
             service_batch_window=args.batch_window,
+            service_state_dir=args.state_dir,
+            service_drain_grace=args.drain_grace,
         ),
         args.listen,
         max_body_bytes=args.max_body_mb << 20,
         max_inflight=args.max_inflight,
     )
+    install_sigterm_drain(server)
     server.serve_forever()
     return 0
+
+
+def install_sigterm_drain(server: ServiceServer) -> bool:
+    """Route SIGTERM into the graceful-drain contract (no-op outside
+    the main thread — an embedded server's host process owns its own
+    signals). Returns whether the handler was installed."""
+    import signal
+
+    def _sigterm(*_):
+        # off the signal frame: graceful_shutdown blocks up to the
+        # drain grace and must not run inside the handler
+        threading.Thread(
+            target=server.graceful_shutdown, daemon=True
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+        return True
+    except ValueError:
+        return False
 
 
 if __name__ == "__main__":

@@ -191,7 +191,12 @@ def decode_frames_v(data: bytes) -> Tuple[int, int, Dict[str, np.ndarray]]:
     frames: Dict[str, np.ndarray] = {}
     for _ in range(n_frames):
         (name_len,) = _FRAME_HEAD.unpack(r.take(_FRAME_HEAD.size, "frame name length"))
-        name = r.take(name_len, "frame name").decode("utf-8")
+        try:
+            name = r.take(name_len, "frame name").decode("utf-8")
+        except UnicodeDecodeError as err:
+            # found by the fuzz corpus: a corrupted name byte must be a
+            # typed WireError (clean 400), not a raw UnicodeDecodeError
+            raise WireError(f"frame name is not valid utf-8: {err}") from err
         if name in frames:
             raise WireError(f"duplicate frame {name!r}")
         code, ndim = _FRAME_TAG.unpack(r.take(_FRAME_TAG.size, "frame tag"))

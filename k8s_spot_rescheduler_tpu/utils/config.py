@@ -181,9 +181,40 @@ class ReschedulerConfig:
     # circuit breaker (remote_planner_fallback_total). Empty = plan
     # in-process (the reference topology).
     planner_url: str = ""
+    # Fleet failover (docs/ROBUSTNESS.md "Fleet failure domains"): an
+    # ORDERED comma-separated list of planner-service endpoints. Each
+    # endpoint carries its own consecutive-failure breaker; a tick walks
+    # the list in order and fails over past dead/overloaded/breaker-open
+    # replicas, falling back to the in-process numpy oracle only when
+    # every endpoint is unusable. Takes precedence over ``planner_url``
+    # (which itself also accepts a comma list, kept as the
+    # single-endpoint spelling).
+    planner_urls: str = ""
     # Per-plan HTTP deadline of the agent's service call; past it the
     # tick falls back locally rather than stall the control loop.
     planner_timeout: float = 10.0
+    # Device-health watchdog (service/devhealth.py): consecutive
+    # slower-than-baseline batched solves before the planner service
+    # declares its accelerator sick and flips to the numpy-oracle host
+    # path (``/healthz`` device:"sick", ``service_device_sick`` gauge,
+    # flight ``device-sick`` event; hysteresis-gated recovery probes).
+    # 0 disables the watchdog.
+    device_sick_threshold: int = 3
+    # Graceful drain (SIGTERM): seconds the service lets already-queued
+    # batches finish before evicting the rest with 503; new arrivals are
+    # refused immediately with Retry-After = this grace.
+    service_drain_grace: float = 5.0
+    # Warm restart: directory the service persists per-tenant last-pack
+    # fingerprints and the recently-used bucket list into, and pre-warms
+    # those bucket compiles from on boot (a restarted replica must not
+    # eat a compile storm from N reconnecting agents). Empty = cold
+    # restarts.
+    service_state_dir: str = ""
+    # Service-path fault injection (service/chaos.py): seeded wire/HTTP/
+    # solve faults on the agent transport and the service solve hook.
+    # Empty profile = off (production default) — testing/demo only.
+    service_chaos_profile: str = ""
+    service_chaos_seed: int = 0
     # Service batching window: how long the scheduler waits after work
     # arrives to coalesce concurrent tenants into one batched solve.
     # 0 = dispatch immediately (every request solves alone).
@@ -257,6 +288,23 @@ class ReschedulerConfig:
             )
         if self.service_queue_timeout <= 0:
             raise ValueError("service_queue_timeout must be > 0")
+        if self.device_sick_threshold < 0:
+            raise ValueError(
+                "device_sick_threshold must be >= 0 (0 = watchdog off)"
+            )
+        if self.service_drain_grace < 0:
+            raise ValueError(
+                "service_drain_grace must be >= 0 (0 = evict queued "
+                "work immediately on drain)"
+            )
+        from k8s_spot_rescheduler_tpu.service.chaos import ServiceFaultPlan
+
+        if self.service_chaos_profile not in ServiceFaultPlan.PROFILES:
+            raise ValueError(
+                f"unknown service_chaos_profile "
+                f"{self.service_chaos_profile!r} "
+                f"(known: {', '.join(p for p in ServiceFaultPlan.PROFILES if p)})"
+            )
         if not 0.0 <= self.chaos_watch_stall_rate <= 1.0:
             raise ValueError(
                 "chaos_watch_stall_rate must be a probability in [0, 1]"

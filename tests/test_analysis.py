@@ -710,6 +710,110 @@ def test_seeded_lock_discipline(tmp_path):
     assert "Shared.bad" in hits[0]
 
 
+# --- exception-discipline -------------------------------------------------
+
+
+def test_seeded_exception_discipline():
+    """Blind excepts on the service/io/loop planes must re-raise,
+    record (flight/metrics/health), or carry the typed noqa — one
+    finding per undisciplined handler, none for the compliant forms."""
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp_path = Path(tmp)
+        _seed(tmp_path, "service/handler.py", """\
+            from k8s_spot_rescheduler_tpu.loop import flight, health
+            from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+            def bad_swallow():
+                try:
+                    work()
+                except Exception as err:
+                    log(err)
+
+            def bad_tuple():
+                try:
+                    work()
+                except (ValueError, Exception):
+                    pass
+
+            def ok_reraise():
+                try:
+                    work()
+                except Exception:
+                    raise
+
+            def ok_flight():
+                try:
+                    work()
+                except Exception as err:
+                    flight.note_event("service-shed", cause=str(err))
+
+            def ok_metrics():
+                try:
+                    work()
+                except Exception:
+                    metrics.update_service_request("error")
+
+            def ok_health():
+                try:
+                    work()
+                except BaseException:
+                    health.STATE.note_startup_degraded()
+
+            def ok_justified():
+                try:
+                    work()
+                except Exception:  # noqa: exception-discipline
+                    pass
+
+            def ok_specific():
+                try:
+                    work()
+                except ValueError:
+                    pass
+        """)
+        # out-of-scope plane: the same swallow in solver/ is NOT flagged
+        _seed(tmp_path, "solver/kernel.py", """\
+            def swallow():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """)
+        r = _analyze_tree(tmp_path)
+        assert r.returncode == 1
+        hits = [
+            l for l in r.stdout.splitlines() if "exception-discipline" in l
+        ]
+        assert len(hits) == 2, r.stdout
+        assert any("bad_swallow" in h for h in hits)
+        assert any("bad_tuple" in h for h in hits)
+        assert not any("solver/kernel.py" in h for h in hits)
+
+
+def test_seeded_exception_discipline_bare_except_in_loop():
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp_path = Path(tmp)
+        _seed(tmp_path, "loop/runner.py", """\
+            def swallow():
+                try:
+                    work()
+                except:  # noqa: bare-except
+                    pass
+        """)
+        r = _analyze_tree(tmp_path)
+        assert r.returncode == 1
+        hits = [
+            l for l in r.stdout.splitlines() if "exception-discipline" in l
+        ]
+        assert len(hits) == 1 and "bare except" in hits[0], r.stdout
+
+
 # --- jaxpr tier: dtype-promotion ------------------------------------------
 
 _MANIFEST_PRELUDE = """\

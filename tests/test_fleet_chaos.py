@@ -1,0 +1,651 @@
+"""Fleet failure-domain tests: service chaos layer (service/chaos.py),
+device-health watchdog (service/devhealth.py), agent endpoint failover
+(service/agent.py), graceful drain + warm restart (service/server.py),
+and the fleet-chaos acceptance core (bench.fleet_chaos_smoke).
+
+The queue/batch mechanics live in tests/test_service.py; this file owns
+what happens when the service stack is sick, dying, or lying.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.loop import flight
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.service import wire
+from k8s_spot_rescheduler_tpu.service.agent import RemoteCallError, RemotePlanner
+from k8s_spot_rescheduler_tpu.service.chaos import (
+    ChaosAgentTransport,
+    ServiceChaos,
+    ServiceChaosError,
+    ServiceFaultPlan,
+)
+from k8s_spot_rescheduler_tpu.service.devhealth import DeviceHealthWatchdog
+from k8s_spot_rescheduler_tpu.service.server import (
+    PlannerService,
+    ServiceBusy,
+    ServiceServer,
+)
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.test_service import _stub_solve, tiny_packed
+
+
+def _service(clock=None, **kwargs) -> PlannerService:
+    return PlannerService(
+        ReschedulerConfig(solver="numpy"),
+        clock=clock or FakeClock(),
+        batch_window_s=0,
+        **kwargs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# chaos layer
+
+
+def test_service_fault_plan_profiles_and_determinism():
+    plan = ServiceFaultPlan.profile("heavy", seed=3)
+    assert plan.connect_reset_rate > 0
+    with pytest.raises(ValueError):
+        ServiceFaultPlan.profile("bogus")
+    # config validation rejects unknown profiles up front
+    with pytest.raises(ValueError):
+        ReschedulerConfig(service_chaos_profile="bogus")
+
+    calls = []
+
+    def inner(url, body, headers, timeout):
+        calls.append(url)
+        return b"reply-bytes-" + bytes(64)
+
+    def run(seed):
+        t = ChaosAgentTransport(
+            inner, dataclasses.replace(plan, seed=seed), clock=FakeClock()
+        )
+        outcomes = []
+        for _ in range(60):
+            try:
+                outcomes.append(("ok", len(t(
+                    "http://x/v2/plan", b"b", {}, 5.0
+                ))))
+            except Exception as err:  # noqa: BLE001 — outcome recording
+                outcomes.append(("err", type(err).__name__))
+        return outcomes
+
+    # same seed -> identical fault sequence; and the heavy profile
+    # actually injected something
+    assert run(11) == run(11)
+    assert any(kind == "err" for kind, _ in run(11))
+
+
+def test_agent_transport_scripted_503_and_slow_loris():
+    clock = FakeClock()
+    plan = ServiceFaultPlan(
+        seed=0, http_503_script=(2,), http_503_retry_after=7.0,
+        slow_loris_rate=0.0,
+    )
+    t = ChaosAgentTransport(
+        lambda *a: b"ok" + bytes(32), plan, clock=clock
+    )
+    t("u", b"b", {}, 5.0)  # request 1 passes
+    with pytest.raises(RemoteCallError) as exc:
+        t("u", b"b", {}, 5.0)  # request 2 is the scripted 503
+    assert exc.value.retry_after == 7.0
+
+    loris = ChaosAgentTransport(
+        lambda *a: b"ok", ServiceFaultPlan(slow_loris_rate=1.0), clock=clock
+    )
+    t0 = clock.now()
+    with pytest.raises(TimeoutError):
+        loris("u", b"b", {}, 5.0)
+    assert clock.now() - t0 == pytest.approx(5.0)  # ate the whole deadline
+
+
+def test_server_chaos_sick_phase_and_scripted_solve_error():
+    clock = FakeClock()
+    chaos = ServiceChaos(
+        ServiceFaultPlan(sick_phase=(2, 3, 1.5), solve_error_script=(4,)),
+        clock=clock,
+    )
+    chaos.on_batch()  # batch 1: healthy, no latency
+    assert clock.now() == 0.0
+    chaos.on_batch()  # batch 2: sick phase
+    chaos.on_batch()  # batch 3: sick phase
+    assert clock.now() == pytest.approx(3.0)
+    with pytest.raises(ServiceChaosError):
+        chaos.on_batch()  # batch 4: scripted solve crash
+
+
+# ---------------------------------------------------------------------------
+# device-health watchdog
+
+
+def _calibrated(clock, threshold=3):
+    wd = DeviceHealthWatchdog(clock, threshold)
+    for _ in range(wd.CALIBRATION_BATCHES):
+        assert wd.note_batch(0.001) is None
+    return wd
+
+
+def test_watchdog_sick_within_threshold_consecutive_slow_batches():
+    clock = FakeClock()
+    wd = _calibrated(clock, threshold=3)
+    assert wd.note_batch(2.0) is None
+    assert wd.note_batch(2.0) is None
+    assert wd.note_batch(2.0) == "sick"  # exactly the threshold
+    assert wd.sick and wd.detect_streak == 3
+    assert wd.snapshot()["device"] == "sick"
+
+
+def test_watchdog_slow_streak_resets_on_a_healthy_batch():
+    wd = _calibrated(FakeClock())
+    wd.note_batch(2.0)
+    wd.note_batch(2.0)
+    wd.note_batch(0.001)  # streak broken
+    assert wd.note_batch(2.0) is None and not wd.sick
+
+
+def test_watchdog_uniformly_slow_solver_is_not_a_sick_device():
+    """Slowness is judged against the CALIBRATED baseline: a solver
+    that is slow from boot never flips the watchdog (it cannot be
+    distinguished from a slow solver)."""
+    clock = FakeClock()
+    wd = DeviceHealthWatchdog(clock, 3)
+    for _ in range(30):
+        assert wd.note_batch(2.0) is None
+    assert not wd.sick
+
+
+def test_watchdog_error_and_canary_edges():
+    clock = FakeClock()
+    wd = _calibrated(clock)
+    assert wd.note_error(RuntimeError("xla fell over")) == "sick"
+    assert "xla fell over" in wd.sick_reason
+
+    wd2 = _calibrated(clock)
+    assert wd2.note_canary(wd2.CANARY_TIMEOUT_S + 1, ok=True) == "sick"
+    assert "canary" in wd2.sick_reason
+
+
+def test_watchdog_recovery_is_hysteresis_gated():
+    clock = FakeClock()
+    wd = _calibrated(clock, threshold=1)
+    assert wd.note_batch(5.0) == "sick"
+    # probes are rate-limited on the clock: the first window is open,
+    # and a granted probe closes it until PROBE_INTERVAL_S passes
+    assert wd.should_probe()
+    assert not wd.should_probe()
+    # one healthy probe is NOT enough (hysteresis) and the window stays
+    # shut until the interval passes
+    assert wd.note_probe(0.001, ok=True) is None and wd.sick
+    assert not wd.should_probe()
+    clock.advance(wd.PROBE_INTERVAL_S)
+    assert wd.should_probe()
+    # a slow probe resets the healthy streak
+    assert wd.note_probe(5.0, ok=True) is None
+    clock.advance(wd.PROBE_INTERVAL_S)
+    assert wd.should_probe()
+    assert wd.note_probe(0.001, ok=True) is None and wd.sick
+    clock.advance(wd.PROBE_INTERVAL_S)
+    assert wd.should_probe()
+    assert wd.note_probe(0.001, ok=True) == "recovered"
+    assert not wd.sick and wd.snapshot()["device"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# service integration: sick flip routes batches to the host path
+
+
+def test_service_flips_to_host_path_and_recovers():
+    clock = FakeClock()
+    svc = _service(clock)
+    hook_calls = []
+
+    def device_hook(stacked, reqs):
+        hook_calls.append(clock.now())
+        T, K = stacked.slot_req.shape[0], stacked.slot_req.shape[2]
+        return np.zeros((T, 3 + K), np.int32)
+
+    svc.solve_hook = device_hook
+    svc.chaos = ServiceChaos(
+        ServiceFaultPlan(sick_phase=(0, 0, 0.0)), clock=clock
+    )
+    f0 = flight.RECORDER.counts()
+
+    # calibrate: healthy batches through the device hook (+1: the
+    # shape's FIRST solve carries its compile and is never sampled)
+    for i in range(DeviceHealthWatchdog.CALIBRATION_BATCHES + 1):
+        svc.submit_nowait("t", tiny_packed(seed=i))
+        assert svc.drain_once()
+    # scripted sick phase: every batch now pays 2 s on the clock
+    svc.chaos = ServiceChaos(
+        ServiceFaultPlan(sick_phase=(1, 10**9, 2.0)), clock=clock
+    )
+    for i in range(svc.config.device_sick_threshold):
+        svc.submit_nowait("t", tiny_packed(seed=10 + i))
+        assert svc.drain_once()
+    assert svc.healthz_snapshot()["device"] == "sick"
+    assert metrics.service_snapshot()["device_sick"] == 1.0
+    f1 = flight.RECORDER.counts()
+    assert f1.get("device-sick", 0) - f0.get("device-sick", 0) == 1
+
+    # while sick (and between probe windows) batches bypass the device
+    # hook entirely: the host oracle answers
+    n_hook = len(hook_calls)
+    svc._devhealth._last_probe = clock.now()  # close the probe window
+    req = svc.submit_nowait("t", tiny_packed())
+    assert svc.drain_once()
+    assert req.reply is not None
+    assert len(hook_calls) == n_hook  # device path untouched
+
+    # phase over: probes (healthy hook again) recover after hysteresis
+    svc.chaos.enabled = False
+    recovered = False
+    for i in range(6):
+        clock.advance(DeviceHealthWatchdog.PROBE_INTERVAL_S)
+        svc.submit_nowait("t", tiny_packed(seed=20 + i))
+        assert svc.drain_once()
+        if svc.healthz_snapshot()["device"] == "ok":
+            recovered = True
+            break
+    assert recovered
+    assert metrics.service_snapshot()["device_sick"] == 0.0
+    f2 = flight.RECORDER.counts()
+    assert f2.get("device-recovered", 0) - f0.get("device-recovered", 0) == 1
+
+
+def _spot_resized(packed, S):
+    R = packed.spot_free.shape[1]
+    W, A = packed.spot_taints.shape[1], packed.spot_aff.shape[1]
+    return packed._replace(
+        spot_free=np.full((S, R), 100.0, np.float32),
+        spot_count=np.zeros(S, np.int32),
+        spot_max_pods=np.full(S, 58, np.int32),
+        spot_taints=np.zeros((S, W), np.uint32),
+        spot_ok=np.ones(S, bool),
+        spot_aff=np.zeros((S, A), np.uint32),
+    )
+
+
+def test_first_solve_per_shape_is_compile_not_latency():
+    """A new bucket shape's first solve carries its XLA compile; a
+    fleet ramp-up of fresh shapes (each 'slow' once) must never flip
+    the watchdog — only repeated slowness of already-compiled shapes
+    does (review finding)."""
+    clock = FakeClock()
+    svc = _service(clock)
+    slow_once_keys = set()
+
+    def compile_like(stacked, reqs):
+        key = stacked.spot_free.shape
+        if key not in slow_once_keys:
+            slow_once_keys.add(key)
+            clock.advance(10.0)  # the "compile" of this shape
+        T, K = stacked.slot_req.shape[0], stacked.slot_req.shape[2]
+        return np.zeros((T, 3 + K), np.int32)
+
+    svc.solve_hook = compile_like
+    # calibrate on one shape (its own first call is the excluded one)
+    for i in range(DeviceHealthWatchdog.CALIBRATION_BATCHES + 1):
+        svc.submit_nowait("t", tiny_packed(seed=i))
+        assert svc.drain_once()
+    # three brand-new shapes arrive back to back, each paying a 10 s
+    # "compile" — device_sick_threshold consecutive slow-looking solves
+    # that must NOT flip the watchdog
+    for S in (10, 20, 40):
+        svc.submit_nowait("t", _spot_resized(tiny_packed(), S))
+        assert svc.drain_once()
+    assert svc.healthz_snapshot()["device"] == "ok"
+    # but genuine slowness on SEEN shapes still flips
+    svc.chaos = ServiceChaos(
+        ServiceFaultPlan(sick_phase=(1, 10**9, 10.0)), clock=clock
+    )
+    for i in range(svc.config.device_sick_threshold):
+        svc.submit_nowait("t", tiny_packed(seed=50 + i))
+        assert svc.drain_once()
+    assert svc.healthz_snapshot()["device"] == "sick"
+
+
+def test_device_solve_error_flips_sick_and_fails_batch_typed():
+    clock = FakeClock()
+    svc = _service(clock)
+
+    def exploding(stacked, reqs):
+        raise RuntimeError("XLA: device lost")
+
+    svc.solve_hook = exploding
+    req = svc.submit_nowait("t", tiny_packed())
+    assert svc.drain_once()
+    # the exposing batch fails typed (agents fall back locally for that
+    # tick) and the service is sick for subsequent batches
+    assert req.error is not None and "device lost" in str(req.error)
+    assert svc.healthz_snapshot()["device"] == "sick"
+    # next batch: served by the host path, no hook involved
+    svc._devhealth._last_probe = clock.now()
+    req2 = svc.submit_nowait("t", tiny_packed())
+    assert svc.drain_once()
+    assert req2.reply is not None
+
+
+# ---------------------------------------------------------------------------
+# agent failover ladder
+
+
+def _observation():
+    from tests.test_service import _observation as obs
+
+    return obs()
+
+
+def test_failover_to_second_endpoint_counted_and_evented():
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=2.0)
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.0)
+    server.start_background()
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    try:
+        agent = RemotePlanner(
+            cfg,
+            f"http://127.0.0.1:{dead_port},http://{server.address}",
+            tenant="fleet-1",
+        )
+        node_map, pdbs = _observation()
+        m0 = metrics.service_snapshot()
+        f0 = flight.RECORDER.counts()
+        report = agent.plan(node_map, pdbs)
+        # full-fidelity remote plan, served by the SECOND endpoint
+        assert report.solver == "remote"
+        assert agent.last_endpoint == f"http://{server.address}"
+        m1 = metrics.service_snapshot()
+        f1 = flight.RECORDER.counts()
+        assert m1["remote_planner_failover"] == m0["remote_planner_failover"] + 1
+        assert m1["remote_planner_fallback"] == m0["remote_planner_fallback"]
+        assert f1.get("failover", 0) - f0.get("failover", 0) == 1
+        # per-endpoint breakers: the dead endpoint accrued the failure,
+        # the serving endpoint stayed clean
+        assert agent._endpoints[0].consecutive_failures == 1
+        assert agent._endpoints[1].consecutive_failures == 0
+        # the failed attempt grafts a wire.failover span into the trace
+        assert agent.last_trace is not None
+        assert agent.last_trace.find("wire.failover")
+    finally:
+        server.close()
+
+
+def test_local_fallback_only_when_every_endpoint_dead():
+    import socket
+
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=0.5)
+    agent = RemotePlanner(
+        cfg, ",".join(f"http://127.0.0.1:{p}" for p in ports), tenant="t"
+    )
+    node_map, pdbs = _observation()
+    m0 = metrics.service_snapshot()
+    report = agent.plan(node_map, pdbs)
+    assert report.solver == "remote-fallback" and report.plan is not None
+    m1 = metrics.service_snapshot()
+    assert m1["remote_planner_fallback"] == m0["remote_planner_fallback"] + 1
+    # a failover was never counted: nobody served
+    assert m1["remote_planner_failover"] == m0["remote_planner_failover"]
+    # both endpoints accrued their own failures
+    assert all(ep.consecutive_failures == 1 for ep in agent._endpoints)
+
+
+def test_planner_urls_config_feeds_the_ladder():
+    cfg = ReschedulerConfig(
+        solver="numpy",
+        planner_urls="http://a:1, http://b:2",
+        planner_url="http://ignored:9",
+    )
+    agent = RemotePlanner(cfg, tenant="t")
+    assert agent.urls == ["http://a:1", "http://b:2"]
+    # single-endpoint compat surface still works
+    agent.url = "http://c:3"
+    assert agent.urls[0] == "http://c:3"
+
+
+def test_retry_after_above_breaker_threshold_capped_regression():
+    """The satellite fix: at/above the breaker threshold the skip
+    window honors a LONGER server Retry-After — max(backoff,
+    Retry-After) — but caps the server-suggested value at 30 s, so one
+    bad LB header cannot park the agent on its fallback for hours."""
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=1.0)
+    clock = FakeClock()
+    agent = RemotePlanner(cfg, "http://x:1", tenant="t", clock=clock)
+    ep = agent._endpoints[0]
+
+    # failure 1 (below threshold, no retry-after): warn only
+    agent._note_failure(ep, "HTTP 503", 0.0)
+    assert ep.skip_until == 0.0
+    # failure 2 (AT threshold): base backoff 5 s, server suggests 20 s
+    # -> the longer server horizon wins
+    agent._note_failure(ep, "HTTP 503", 20.0)
+    assert ep.skip_until == pytest.approx(clock.now() + 20.0)
+    # failure 3: server suggests an hour -> capped at 30 s (the backoff
+    # schedule value 10 s is smaller, so the cap IS the horizon)
+    agent._note_failure(ep, "HTTP 503", 3600.0)
+    assert ep.skip_until == pytest.approx(clock.now() + 30.0)
+    # deep into the schedule the doubling backoff exceeds the cap and
+    # rules unchallenged
+    for _ in range(4):
+        agent._note_failure(ep, "connection refused", 0.0)
+    assert ep.skip_until > clock.now() + 30.0
+    # below threshold a fresh endpoint still honors (capped) Retry-After
+    agent2 = RemotePlanner(cfg, "http://y:1", tenant="t", clock=clock)
+    agent2._note_failure(agent2._endpoints[0], "HTTP 503", 3600.0)
+    assert agent2._endpoints[0].skip_until == pytest.approx(
+        clock.now() + 30.0
+    )
+
+
+def test_no_failover_event_when_primary_serves_despite_later_breaker():
+    """A breaker-open endpoint LATER in the list must not brand a
+    healthy primary-served tick as a failover (review finding)."""
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=2.0)
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.0)
+    server.start_background()
+    try:
+        agent = RemotePlanner(
+            cfg, f"http://{server.address},http://127.0.0.1:1",
+            tenant="t",
+        )
+        # the SECOND endpoint's breaker is open; the primary is healthy
+        agent._endpoints[1].consecutive_failures = 5
+        agent._endpoints[1].skip_until = agent.clock.now() + 120.0
+        node_map, pdbs = _observation()
+        m0 = metrics.service_snapshot()
+        f0 = flight.RECORDER.counts()
+        report = agent.plan(node_map, pdbs)
+        assert report.solver == "remote"
+        assert agent.last_endpoint == f"http://{server.address}"
+        m1 = metrics.service_snapshot()
+        f1 = flight.RECORDER.counts()
+        assert m1["remote_planner_failover"] == m0["remote_planner_failover"]
+        assert f1.get("failover", 0) == f0.get("failover", 0)
+    finally:
+        server.close()
+
+
+def test_failover_ladder_shares_one_deadline_budget():
+    """Three blackholed endpoints must cost the tick ~planner_timeout
+    total, not 3x: each attempt gets the REMAINING budget, and an
+    endpoint never tried (budget gone) does not accrue breaker
+    failures (review finding)."""
+    import time as _time
+
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=0.5)
+    agent = RemotePlanner(
+        cfg, "http://a:1,http://b:1,http://c:1", tenant="t"
+    )
+    seen_timeouts = []
+
+    def blackhole(url, body, headers, timeout):
+        seen_timeouts.append(timeout)
+        _time.sleep(0.2)  # the transport eats real budget
+        raise TimeoutError("blackhole")
+
+    agent.transport = blackhole
+    node_map, pdbs = _observation()
+    t0 = _time.perf_counter()
+    report = agent.plan(node_map, pdbs)
+    wall = _time.perf_counter() - t0
+    assert report.solver == "remote-fallback"
+    # the whole ladder stayed near ONE planner_timeout (plus the local
+    # oracle solve), nowhere near 3x
+    assert wall < 3 * cfg.planner_timeout
+    # later attempts saw a SHRUNK budget
+    assert len(seen_timeouts) >= 2
+    assert seen_timeouts[1] < seen_timeouts[0]
+    # at most the budget's worth of endpoints were actually tried; any
+    # endpoint skipped on exhaustion kept a clean breaker
+    untried = [
+        ep for ep in agent._endpoints if ep.consecutive_failures == 0
+    ]
+    assert len(seen_timeouts) + len(untried) == 3
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + warm restart
+
+
+def test_graceful_drain_refuses_finishes_and_evicts():
+    clock = FakeClock()
+    svc = _service(clock)
+    svc.solve_hook = _stub_solve()
+    queued = svc.submit_nowait("t", tiny_packed())
+    svc.begin_drain()
+    # new arrivals refused with the drain-grace Retry-After
+    with pytest.raises(ServiceBusy) as exc:
+        svc.submit_nowait("t", tiny_packed())
+    assert exc.value.retry_after == max(
+        1, int(np.ceil(svc.config.service_drain_grace))
+    )
+    # queued work still finishes within the grace
+    svc.drain_pending()
+    assert queued.reply is not None and queued.error is None
+
+
+def test_graceful_drain_evicts_past_grace():
+    clock = FakeClock()
+    svc = _service(clock)
+
+    def slow_solve(stacked, reqs):
+        clock.advance(10.0)  # each batch eats far past the grace
+        return _stub_solve()(stacked, reqs)
+
+    svc.solve_hook = slow_solve
+    first = svc.submit_nowait("a", tiny_packed(seed=1))
+    # a different shape family: the second request can never ride the
+    # first's batch (batches are per-bucket)
+    base = tiny_packed(seed=2)
+    S, R = 10, base.spot_free.shape[1]
+    W, A = base.spot_taints.shape[1], base.spot_aff.shape[1]
+    second = svc.submit_nowait("b", base._replace(
+        spot_free=np.full((S, R), 100.0, np.float32),
+        spot_count=np.zeros(S, np.int32),
+        spot_max_pods=np.full(S, 58, np.int32),
+        spot_taints=np.zeros((S, W), np.uint32),
+        spot_ok=np.ones(S, bool),
+        spot_aff=np.zeros((S, A), np.uint32),
+    ))
+    svc.begin_drain()
+    svc.drain_pending()  # grace 30 s default? config default 5 s
+    # the first batch solved (started inside the grace), the second was
+    # evicted typed once the deadline passed
+    assert first.reply is not None
+    assert second.error is not None and "draining" in str(second.error)
+
+
+def test_drained_server_rejects_http_with_retry_after():
+    import urllib.error
+    import urllib.request
+
+    cfg = ReschedulerConfig(solver="numpy")
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.0)
+    server.start_background()
+    try:
+        server.service.begin_drain()
+        body = wire.encode_plan_request("t", tiny_packed())
+        req = urllib.request.Request(
+            f"http://{server.address}/v2/plan", data=body, method="POST",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 503
+        assert int(exc.value.headers["Retry-After"]) >= 1
+        assert server.service.healthz_snapshot()["draining"] is True
+    finally:
+        server.close()
+
+
+def test_warm_restart_persists_and_prewarms(tmp_path):
+    cfg = ReschedulerConfig(
+        solver="numpy", service_state_dir=str(tmp_path)
+    )
+    clock = FakeClock()
+    svc = PlannerService(cfg, clock=clock, batch_window_s=0)
+    svc.solve_hook = _stub_solve()
+    svc.submit_nowait("tenant-a", tiny_packed())
+    assert svc.drain_once()
+    path = svc.save_state()
+    assert path and os.path.exists(path)
+    payload = json.loads(open(path).read())
+    assert payload["tenants"]["tenant-a"].startswith("C")
+    assert payload["buckets"]
+
+    # a NEW service instance (the restarted replica) pre-warms those
+    # buckets through its real solve path on boot
+    svc2 = PlannerService(cfg, clock=FakeClock(), batch_window_s=0)
+    warmed = svc2.warm_start()
+    assert warmed == [payload["tenants"]["tenant-a"]]
+    assert svc2.warmed_buckets == warmed
+    # and the fingerprints carried over
+    assert svc2._tenant_bucket["tenant-a"] == warmed[0]
+
+
+def test_warm_start_survives_garbage_state(tmp_path):
+    cfg = ReschedulerConfig(
+        solver="numpy", service_state_dir=str(tmp_path)
+    )
+    state = tmp_path / "planner_warm_state.json"
+    for garbage in (
+        "{not json",
+        '{"buckets": 5}',  # valid JSON, wrong shape (review finding)
+        '[1, 2, 3]',  # top-level array: payload.get would AttributeError
+    ):
+        state.write_text(garbage)
+        svc = PlannerService(cfg, clock=FakeClock(), batch_window_s=0)
+        assert svc.warm_start() == []  # cold start, no crash
+
+
+# ---------------------------------------------------------------------------
+# the fleet acceptance core (the same function `make fleet-chaos-smoke`
+# runs, at the CI scale)
+
+
+def test_fleet_chaos_smoke_acceptance():
+    import bench
+
+    result = bench.fleet_chaos_smoke(n_agents=4, seed=0)
+    assert result["crashes"] == []
+    assert result["mismatches"] == []
+    assert result["ok"], result
+    assert result["sick_detect_batches"] <= 3
+    assert result["flight_eq_metrics"]
+    assert result["warmed_buckets"]

@@ -560,6 +560,78 @@ def test_wire_malformed_inputs_are_typed_errors():
         wire.decode_plan_request(wire.encode_plan_reply(_golden_reply()))
 
 
+def test_wire_fuzz_corpus_typed_errors_only():
+    """Seeded fuzz corpus over the byte-golden messages: every
+    truncation, bit flip and duplicate-frame mutation must decode to a
+    typed ``WireError`` or a structurally-valid message — NEVER an
+    unhandled exception. (A payload-byte flip that still satisfies the
+    frame contracts is legitimately valid wire carrying wrong numbers;
+    the crash surface is what this corpus pins.) The planner service is
+    a write-capable network surface: a crafted byte stream that raises
+    anything else is a denial-of-service primitive."""
+    import random
+
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    corpus = [
+        ("request", wire.decode_plan_request_ex,
+         wire.encode_plan_request(
+             "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID
+         )),
+        ("delta", wire.decode_packed_delta,
+         wire.encode_packed_delta("golden-tenant", _golden_delta())),
+        ("reply", wire.decode_plan_reply,
+         wire.encode_plan_reply(_golden_reply()._replace(
+             spans=GOLDEN_SPANS
+         ))),
+        ("error", wire.decode_plan_reply, wire.encode_error("boom")),
+    ]
+    rng = random.Random(0xF1EE7)
+
+    def must_be_typed(decode, blob, what):
+        try:
+            decode(blob)
+        except wire.WireError:
+            return  # the contract: typed, catchable, clean 400
+        except Exception as err:  # noqa: BLE001 — the fuzz verdict
+            pytest.fail(f"{what}: untyped {type(err).__name__}: {err}")
+
+    for name, decode, blob in corpus:
+        # every strict prefix is a truncation the decoder must refuse
+        for _ in range(150):
+            n = rng.randrange(len(blob))
+            with pytest.raises(wire.WireError):
+                decode(blob[:n])
+        # random single-bit flips anywhere in the message
+        for i in range(300):
+            mutated = bytearray(blob)
+            pos = rng.randrange(len(mutated))
+            mutated[pos] ^= 1 << rng.randrange(8)
+            must_be_typed(
+                decode, bytes(mutated), f"{name} bit-flip @{pos}"
+            )
+        # duplicate-frame splices: bump the header frame count and
+        # append a copy of the message's own tail bytes
+        for _ in range(30):
+            mutated = bytearray(blob)
+            count = int.from_bytes(mutated[6:8], "little")
+            mutated[6:8] = (count + 1).to_bytes(2, "little")
+            cut = rng.randrange(wire._HEADER.size, len(blob))
+            mutated.extend(blob[cut:])
+            must_be_typed(decode, bytes(mutated), f"{name} splice @{cut}")
+
+    # encoder-level duplicate frames are refused by the decoder too
+    dup = wire.encode_frames(
+        wire.KIND_PLAN_REPLY,
+        [("found", np.array([1], np.uint8)),
+         ("found", np.array([1], np.uint8))],
+    )
+    with pytest.raises(wire.WireError):
+        wire.decode_frames(dup)
+
+
 def test_wire_sidecar_plans_the_same_drain():
     """The planner-sidecar boundary (SURVEY.md §2.3): POSTing the same
     wire payloads to /v1/plan yields the same drain decision the

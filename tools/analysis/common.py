@@ -67,6 +67,7 @@ ANALYSIS_CODES = {
     "kube-write-retry",
     "lock-discipline",
     "manifest-contract",
+    "exception-discipline",
     "bare-noqa",
     "unknown-suppression",
     "stale-baseline",

@@ -5,7 +5,12 @@
 every analyzed file. Pass registration lives in tools/analysis/engine.py.
 """
 
-from tools.analysis.passes import contracts, hotpath, locks  # noqa: F401
+from tools.analysis.passes import (  # noqa: F401
+    contracts,
+    exceptions,
+    hotpath,
+    locks,
+)
 
 ALL_PASSES = (
     ("jax-host-sync", hotpath.run_host_sync),
@@ -17,4 +22,5 @@ ALL_PASSES = (
     ("trace-contract", contracts.run_trace),
     ("manifest-contract", contracts.run_manifest),
     ("lock-discipline", locks.run),
+    ("exception-discipline", exceptions.run),
 )

@@ -1,0 +1,122 @@
+"""exception-discipline: blind excepts on the control/service planes
+must be accounted for.
+
+PR 4's containment contract is that the loop survives every exception —
+which makes ``except Exception`` the house idiom in ``service/``,
+``io/`` and ``loop/``, and every such handler a place where a failure
+can silently vanish. A swallowed exception on these planes is precisely
+the degradation the flight recorder and the metrics surfaces exist to
+expose, so the rule is:
+
+    every ``except:`` / ``except Exception`` / ``except BaseException``
+    handler in a service/ io/ loop/ module must do at least one of
+
+    - re-raise (any ``raise`` in the handler body),
+    - record the degradation: call ``flight.*`` (note_event /
+      record_tick / dump), a ``metrics.update_*`` / ``metrics.observe_*``
+      updater, or a ``health.*`` note, or
+    - carry an explicit ``# noqa: exception-discipline`` justification
+      on the ``except`` line (the standard suppression grammar).
+
+Specific exception classes (``except ValueError``) are out of scope —
+the discipline targets the catch-alls, where "handled" and "lost" look
+identical to a reader. Solver/model/bench code is out of scope too: the
+rule is about the planes whose degradations have contractual
+metric/flight surfaces (docs/ROBUSTNESS.md, docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analysis.common import ERROR, Finding, relpath
+from tools.analysis.symbols import Project, dotted
+
+# path segments that put a module on a monitored plane (matches both
+# the real tree, k8s_spot_rescheduler_tpu/service/..., and fixture
+# trees, service/...)
+_SCOPED_SEGMENTS = {"service", "io", "loop"}
+
+# broad catches the discipline applies to
+_BROAD = {"Exception", "BaseException"}
+
+# call prefixes that count as recording the degradation
+_RECORDER_PREFIXES = (
+    "flight.",           # loop/flight.py note_event / record_tick / dump
+    "metrics.update_",   # metrics/registry.py counters + gauges
+    "metrics.observe_",  # metrics/registry.py histograms
+    "health.",           # loop/health.py STATE notes
+)
+
+
+def _in_scope(path: str) -> bool:
+    return any(seg in _SCOPED_SEGMENTS for seg in path.split("/")[:-1])
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True  # bare except
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for t in types:
+        name = dotted(t) or ""
+        if name.split(".")[-1] in _BROAD:
+            return True
+    return False
+
+
+def _discharges(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            if name.startswith(_RECORDER_PREFIXES):
+                return True
+    return False
+
+
+def run(project: Project, files) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        path = relpath(mod.path)
+        if not _in_scope(path):
+            continue
+
+        def walk(node: ast.AST, func: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                name = func
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    name = (
+                        f"{func}.{child.name}" if func else child.name
+                    )
+                if isinstance(
+                    child, ast.ExceptHandler
+                ) and _catches_broad(child) and not _discharges(child):
+                    caught = (
+                        "bare except"
+                        if child.type is None
+                        else f"except {ast.unparse(child.type)}"
+                    )
+                    findings.append(Finding(
+                        path, child.lineno, "exception-discipline",
+                        f"{caught} in {func or '<module>'} neither "
+                        "re-raises nor records the failure (flight.*, "
+                        "metrics.update_*/observe_*, health.*) — on the "
+                        "service/io/loop planes a swallowed exception "
+                        "is an invisible degradation; record it, "
+                        "re-raise, or justify with "
+                        "'# noqa: exception-discipline'",
+                        severity=ERROR,
+                        anchor=f"{func or '<module>'}.L{child.lineno}",
+                    ))
+                walk(child, name)
+
+        walk(mod.tree, "")
+    return findings
