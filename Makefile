@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke serve-smoke chaos-smoke watch-soak fleet-chaos-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke serve-smoke sched-smoke chaos-smoke watch-soak fleet-chaos-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -19,7 +19,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
 # stand-in and tools/analysis is the go-vet analog, two tiers deep
 # (this image ships no Python linter and installs are forbidden).
-check: lint analyze audit-jaxpr test bench-smoke serve-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke
+check: lint analyze audit-jaxpr test bench-smoke serve-smoke sched-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke
 
 lint:
 	python tools/lint.py
@@ -71,6 +71,16 @@ bench-smoke:
 # fell back to the local oracle.
 serve-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --serve-smoke --watchdog 600
+
+# Drain-schedule smoke (CPU-only, numpy-oracle parity path, FakeClock,
+# <60 s): schedule-mode exhaustion must free the same nodes as per-tick
+# planning in <= ceil(drains/horizon)+2 planner fetches; injected churn
+# must invalidate (flight delta == metric delta) and re-plan, never
+# mis-evict; the wire schedule (KIND_PLAN_SCHEDULE) must be
+# bit-identical to the local device cut; a replica killed under a
+# schedule in flight must cost nothing until the next cut fails over.
+sched-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --sched-smoke --watchdog 300
 
 # 8-virtual-device spot-chunked repair smoke: a drain only repair can
 # prove, at a budget that previously forced the repair-less 2-D tier —

@@ -735,20 +735,33 @@ def run_quality_scale(args, metric: str, unit: str, backend_note) -> int:
         f"candidates ({t_bound:.1f}s)",
         file=sys.stderr,
     )
+    horizon = max(0, int(args.schedule_horizon))
     cfg = ReschedulerConfig(
         solver=args.solver,
         resources=spec.resources,
         max_drains_per_tick=256,
+        # device-resident drain schedules: fetches drop from O(drains)
+        # to O(drains / horizon) — the sweep's wall clock was tunnel-RTT
+        # x drains before this (docs/RESULTS.md consolidation table)
+        plan_schedule_enabled=horizon > 0,
+        schedule_horizon=horizon or 32,
     )
     client = generate_cluster(spec, args.seed, reschedule_evicted=True)
+    stats: dict = {}
     t0 = time.perf_counter()
-    achieved = drain_to_exhaustion(client, cfg, max_ticks=200)
+    achieved = drain_to_exhaustion(
+        client, cfg, max_ticks=200, planner_stats=stats
+    )
     t_drain = time.perf_counter() - t0
     ratio = achieved / bound if bound else 1.0
+    fetches = int(stats.get("fetches_total", -1))
+    lens = stats.get("schedule_lens", [])
     print(
-        f"achieved {achieved} drains in {t_drain:.0f}s; "
-        f"achieved/bound {ratio:.3f} (bound relaxes bins+affinity: true "
-        f"oracle ratio is >= this)",
+        f"achieved {achieved} drains in {t_drain:.0f}s "
+        f"({fetches} planner fetches"
+        + (f", {len(lens)} schedule cuts" if horizon else "")
+        + f"); achieved/bound {ratio:.3f} (bound relaxes bins+affinity: "
+        f"true oracle ratio is >= this)",
         file=sys.stderr,
     )
     out = {
@@ -759,11 +772,46 @@ def run_quality_scale(args, metric: str, unit: str, backend_note) -> int:
         "bound": bound,
         "achieved": achieved,
         "scale": args.scale,
+        # the O(1)-fetch artifact: planner fetches for the WHOLE sweep,
+        # schedule length distribution, and the sweep wall clock
+        "fetches_total": fetches,
+        "schedule_horizon": horizon,
+        "sched_wall_s": round(t_drain, 2),
     }
+    if lens:
+        out["schedule_len_p50"] = float(np.percentile(lens, 50))
+        out["schedule_len_p95"] = float(np.percentile(lens, 95))
+    inv = metrics_schedule_invalidations()
+    if inv is not None:
+        out["schedule_invalidated"] = inv
+    if horizon > 0 and fetches >= 0:
+        fetch_bound = math.ceil(max(achieved, 1) / cfg.schedule_horizon) + 2
+        # churn-free synthetic sweep: every invalidation would add a
+        # fetch, so the bound holds exactly when the claim holds
+        fetch_bound += int(inv or 0)
+        out["fetch_bound"] = fetch_bound
+        if fetches > fetch_bound:
+            out["error"] = (
+                f"fetches_total {fetches} > ceil(drains/horizon)+2 = "
+                f"{fetch_bound}: the O(1)-fetch claim failed"
+            )
+            emit(out)
+            return 1
     if backend_note:
         out["error"] = backend_note
     emit(out)
     return 0
+
+
+def metrics_schedule_invalidations():
+    """Schedule invalidations so far this process (None if metrics are
+    unavailable) — quality-scale reports them beside the fetch bound."""
+    try:
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+
+        return int(metrics.robustness_snapshot()["schedule_invalidated"])
+    except Exception:  # noqa: BLE001 — bench-side best effort
+        return None
 
 
 def run_replay_bench(
@@ -1109,6 +1157,303 @@ def run_serve_smoke(args, metric: str, unit: str) -> int:
         }
     )
     return 0 if result["ok"] else 1
+
+
+def sched_smoke(seed: int = 0) -> tuple:
+    """The drain-schedule acceptance core (``make sched-smoke``; reused
+    by tests/test_schedule.py). Numpy-oracle parity path on a FakeClock,
+    three cases:
+
+    1. **local** — a quality cluster drained to exhaustion with
+       schedules on must free exactly the nodes the per-tick planner
+       frees, with planner fetches <= ceil(drains / horizon) + 2 (the
+       O(1)-fetch claim, measured) and zero invalidations on the
+       quiescent run; injected churn (a spot node removed under a
+       pending schedule) must INVALIDATE the tail — flight-event delta
+       == metric delta — and the next tick must re-plan and keep
+       draining;
+    2. **service** — the same schedule fetched through a real
+       ServiceServer over HTTP (wire v3 KIND_PLAN_SCHEDULE) must be
+       bit-identical to the local plan_schedule cut, and the agent's
+       trace must hold plan.schedule + wire.request + the grafted
+       service spans under one round-tripped trace id;
+    3. **failover-with-schedule-in-flight** — killing the primary
+       replica under a partially-executed schedule costs nothing until
+       the NEXT cut, which fails over to the secondary (failover metric
+       + flight event fire; zero local fallbacks).
+    """
+    import dataclasses
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from k8s_spot_rescheduler_tpu.bench.quality import (
+        _HintingPlanner,
+        drain_to_exhaustion,
+    )
+    from k8s_spot_rescheduler_tpu.io.synthetic import (
+        QUALITY_CONFIGS,
+        generate_quality_cluster,
+    )
+    from k8s_spot_rescheduler_tpu.loop import flight
+    from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+    from k8s_spot_rescheduler_tpu.service.server import ServiceServer
+    from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+
+    violations: list = []
+    name, spec = next(iter(QUALITY_CONFIGS.items()))
+    horizon = 4
+
+    # --- case 1: local parity + fetch bound -------------------------------
+    base_cfg = ReschedulerConfig(
+        solver="numpy", resources=spec.resources, max_drains_per_tick=64
+    )
+    sched_cfg = dataclasses.replace(
+        base_cfg, plan_schedule_enabled=True, schedule_horizon=horizon
+    )
+    inv0 = metrics.robustness_snapshot()["schedule_invalidated"]
+    drains_base = drain_to_exhaustion(
+        generate_quality_cluster(spec, seed, reschedule_evicted=True),
+        base_cfg,
+    )
+    stats: dict = {}
+    drains_sched = drain_to_exhaustion(
+        generate_quality_cluster(spec, seed, reschedule_evicted=True),
+        sched_cfg,
+        planner_stats=stats,
+    )
+    fetches = stats.get("fetches_total", -1)
+    lens = stats.get("schedule_lens", [])
+    bound = math.ceil(max(drains_sched, 1) / horizon) + 2
+    if drains_sched != drains_base:
+        violations.append(
+            f"schedule mode drained {drains_sched} != per-tick "
+            f"{drains_base}"
+        )
+    if fetches > bound:
+        violations.append(
+            f"fetches {fetches} > ceil({drains_sched}/{horizon})+2 = "
+            f"{bound} — the O(1)-fetch claim failed"
+        )
+    inv_quiescent = (
+        metrics.robustness_snapshot()["schedule_invalidated"] - inv0
+    )
+    if inv_quiescent:
+        violations.append(
+            f"{inv_quiescent} invalidation(s) on a quiescent run"
+        )
+
+    # --- case 1b: churn invalidates, flight == metric ---------------------
+    client = generate_quality_cluster(spec, seed, reschedule_evicted=True)
+    churn_cfg = dataclasses.replace(
+        sched_cfg, max_drains_per_tick=1, schedule_horizon=8,
+        node_drain_delay=0.0,
+    )
+    inner = SolverPlanner(churn_cfg)
+    r = Rescheduler(
+        client, _HintingPlanner(inner, client), churn_cfg,
+        clock=client.clock, recorder=client,
+    )
+    m0 = metrics.robustness_snapshot()["schedule_invalidated"]
+    f0 = flight.RECORDER.counts().get("schedule-invalidated", 0)
+    client.clock.advance(1)
+    first = r.tick()
+    if not first.drained or first.report.schedule_len < 2:
+        violations.append("churn case: first tick did not start a schedule")
+    # churn under the pending schedule: a spot node vanishes
+    spot = next(
+        n for n in client.nodes.values()
+        if "spot" in "".join(f"{k}={v}" for k, v in n.labels.items())
+    )
+    client.remove_node(spot.name)
+    client.clock.advance(1)
+    second = r.tick()
+    m_delta = metrics.robustness_snapshot()["schedule_invalidated"] - m0
+    f_delta = flight.RECORDER.counts().get("schedule-invalidated", 0) - f0
+    if m_delta < 1:
+        violations.append("churn did not invalidate the schedule")
+    if m_delta != f_delta:
+        violations.append(
+            f"flight delta {f_delta} != metric delta {m_delta} for "
+            "schedule-invalidated"
+        )
+    if not second.drained:
+        violations.append("post-invalidation tick failed to re-plan+drain")
+
+    # --- case 2: service bit-identity + span tree -------------------------
+    svc_cfg = dataclasses.replace(
+        ReschedulerConfig(
+            solver="numpy", resources=spec.resources,
+            plan_schedule_enabled=True, schedule_horizon=6,
+        ),
+    )
+    client2 = generate_quality_cluster(spec, seed, reschedule_evicted=True)
+    store = client2.columnar_store(
+        svc_cfg.resources,
+        on_demand_label=svc_cfg.on_demand_node_label,
+        spot_label=svc_cfg.spot_node_label,
+    )
+    pdbs = client2.list_pdbs()
+    srv = ServiceServer(svc_cfg, "127.0.0.1:0", batch_window_s=0.0)
+    srv.start_background(scheduler=False)
+    try:
+        agent = RemotePlanner(
+            svc_cfg, f"http://{srv.address}", tenant="sched-smoke",
+            clock=FakeClock(),
+        )
+        handle_remote = agent.plan_schedule(store, pdbs)
+        handle_local = SolverPlanner(svc_cfg).plan_schedule(store, pdbs)
+        if handle_remote is None or handle_local is None:
+            violations.append("service case: schedule cut failed")
+        else:
+            if len(handle_remote.steps) != len(handle_local.steps) or any(
+                a.index != b.index
+                or a.n_feasible != b.n_feasible
+                or not np.array_equal(a.row, b.row)
+                for a, b in zip(handle_remote.steps, handle_local.steps)
+            ):
+                violations.append(
+                    "wire schedule differs from the local device cut"
+                )
+            trace = agent.last_trace
+            want = {"plan.schedule", "wire.request", "service.solve"}
+            have = {
+                n for n in want if trace is not None and trace.find(n)
+            }
+            if want - have:
+                violations.append(
+                    f"service case: trace missing spans {sorted(want - have)}"
+                )
+    finally:
+        srv.close()
+
+    # --- case 3: failover with a schedule in flight -----------------------
+    clock = FakeClock()
+    srv_a = ServiceServer(svc_cfg, "127.0.0.1:0", batch_window_s=0.0,
+                          clock=clock)
+    srv_b = ServiceServer(svc_cfg, "127.0.0.1:0", batch_window_s=0.0,
+                          clock=clock)
+    srv_a.start_background(scheduler=False)
+    srv_b.start_background(scheduler=False)
+    svc_before = metrics.service_snapshot()
+    fl_failover0 = flight.RECORDER.counts().get("failover", 0)
+    try:
+        agent = RemotePlanner(
+            svc_cfg,
+            f"http://{srv_a.address},http://{srv_b.address}",
+            tenant="sched-failover",
+            clock=clock,
+        )
+        handle = agent.plan_schedule(store, pdbs)
+        if handle is None or agent.last_endpoint != f"http://{srv_a.address}":
+            violations.append("failover case: primary did not serve the cut")
+
+        def execute(report):
+            # apply one step's drain to the fake cluster the way the
+            # real actuator + scheduler would: evict the plan's pods
+            # and let them land on their proven placements
+            client2.placement_hints.clear()
+            client2.placement_hints.update(report.plan.assignments)
+            for pod in report.plan.pods:
+                client2.evict_pod(pod, 0)
+            client2.clock.advance(1)
+
+        step = handle.next_plan(store, pdbs) if handle else None
+        if step is None:
+            violations.append("failover case: step 0 did not execute")
+        else:
+            execute(step)
+        srv_a.close()
+        # the in-flight schedule keeps executing with ZERO wire traffic
+        if handle is not None and not handle.exhausted:
+            nxt = handle.next_plan(store, pdbs)
+            if nxt is None:
+                violations.append(
+                    "failover case: in-flight step failed after the "
+                    "replica death (%s)" % handle.invalid_reason
+                )
+            else:
+                execute(nxt)
+        handle2 = agent.plan_schedule(store, pdbs)
+        if handle2 is None:
+            violations.append("failover case: secondary did not serve")
+        elif agent.last_endpoint != f"http://{srv_b.address}":
+            violations.append("failover case: cut not served by secondary")
+        svc_after = metrics.service_snapshot()
+        failovers = (
+            svc_after["remote_planner_failover"]
+            - svc_before["remote_planner_failover"]
+        )
+        fl_failover = (
+            flight.RECORDER.counts().get("failover", 0) - fl_failover0
+        )
+        if failovers < 1:
+            violations.append("failover metric did not fire")
+        if failovers != fl_failover:
+            violations.append(
+                f"flight failover delta {fl_failover} != metric "
+                f"delta {failovers}"
+            )
+        if (
+            svc_after["remote_planner_fallback"]
+            != svc_before["remote_planner_fallback"]
+        ):
+            violations.append(
+                "failover case: an agent fell back to the local oracle"
+            )
+    finally:
+        srv_b.close()
+
+    stats_out = {
+        "drains": int(drains_sched),
+        "drains_per_tick_baseline": int(drains_base),
+        "fetches_total": int(fetches),
+        "fetch_bound": int(bound),
+        "schedule_lens": lens,
+        "invalidations": int(m_delta),
+    }
+    return stats_out, violations
+
+
+def run_sched_smoke(args, metric: str, unit: str) -> int:
+    """CI smoke of the drain-schedule path (``make sched-smoke``):
+    local parity + fetch bound, churn invalidation with flight/metric
+    parity, wire bit-identity, and failover with a schedule in
+    flight."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    t0 = time.perf_counter()
+    stats, violations = sched_smoke(args.seed)
+    wall = time.perf_counter() - t0
+    ok = not violations
+    print(
+        f"sched-smoke: {stats['drains']} drains in "
+        f"{stats['fetches_total']} fetches (bound {stats['fetch_bound']}; "
+        f"per-tick baseline {stats['drains_per_tick_baseline']} drains)  "
+        f"schedule lens {stats['schedule_lens']}  "
+        f"invalidations {stats['invalidations']}  wall={wall:.1f}s  "
+        f"-> {'OK' if ok else 'FAIL: ' + '; '.join(violations)}",
+        file=sys.stderr,
+    )
+    emit(
+        {
+            "metric": metric,
+            "value": int(stats["fetches_total"]),
+            "unit": unit,
+            "vs_baseline": round(
+                stats["drains"] / max(stats["fetches_total"], 1), 2
+            ),
+            "wall_s": round(wall, 2),
+            "ok": ok,
+            **stats,
+            **({"violations": violations} if violations else {}),
+        }
+    )
+    return 0 if ok else 1
 
 
 def fleet_chaos_smoke(n_agents: int = 4, seed: int = 0) -> dict:
@@ -2096,6 +2441,8 @@ def _metric_for(args) -> tuple:
         return "bench_smoke_delta_upload_bytes", "bytes"
     if args.serve_smoke:
         return "serve_smoke_agent_plan_ms", "ms"
+    if args.sched_smoke:
+        return "sched_smoke_fetches_total", "count"
     if args.fleet_chaos:
         return "fleet_chaos_failover_ms", "ms"
     if args.quality:
@@ -2214,6 +2561,18 @@ def main() -> int:
     ap.add_argument("--tenants", type=int, default=4,
                     help="tenant count for --serve-smoke (>=4 for the "
                          "acceptance run)")
+    ap.add_argument("--sched-smoke", action="store_true",
+                    help="CI smoke (make sched-smoke): the drain-"
+                         "schedule path on the numpy oracle parity "
+                         "path — local drains + fetch bound, churn "
+                         "invalidation with flight==metric parity, "
+                         "wire bit-identity through a real service, "
+                         "and failover with a schedule in flight")
+    ap.add_argument("--schedule-horizon", type=int, default=32,
+                    help="drain-schedule horizon for --quality-scale "
+                         "(steps per planner fetch; 0 disables the "
+                         "schedule path and re-measures the per-drain-"
+                         "fetch baseline)")
     ap.add_argument("--fleet-chaos", action="store_true",
                     help="CI smoke (make fleet-chaos-smoke): 4 agents x "
                          "2 service replicas on a virtual clock under "
@@ -2256,6 +2615,8 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         return run_smoke(args, metric, unit)
     if args.serve_smoke:
         return run_serve_smoke(args, metric, unit)
+    if args.sched_smoke:
+        return run_sched_smoke(args, metric, unit)
     if args.fleet_chaos:
         return run_fleet_chaos(args, metric, unit)
     if args.quality:

@@ -92,9 +92,17 @@ def drain_node(
     pod_eviction_timeout: float,
     eviction_retry_time: float,
     identity: str = "",
+    schedule_step: int = -1,
 ) -> None:
     """Drain ``node`` of ``pods``; raises DrainError on failure
     (reference scaler.go:68-146 ``DrainNode``).
+
+    ``schedule_step`` >= 0 marks a drain executed from a device-cut
+    drain schedule (planner/schedule.py): the step index rides the
+    node's Normal event and the eviction trace spans, so a postmortem
+    can tell schedule-executed drains from per-tick plans. The cadence
+    is unchanged either way — the schedule changes how drains are
+    DECIDED (one fetch per horizon), never how they are verified.
 
     The taint is stamped with an ownership value (``identity`` — the
     replica's stable holder id — plus a wall timestamp): the cluster
@@ -121,7 +129,12 @@ def drain_node(
         raise DrainError(str(err)) from err
     recorder.event(
         "Node", node.name, "Normal", "Rescheduler",
-        "marked the node as draining/unschedulable",
+        "marked the node as draining/unschedulable"
+        + (
+            f" (drain schedule step {schedule_step})"
+            if schedule_step >= 0
+            else ""
+        ),
     )
 
     drain_successful = False
@@ -141,7 +154,11 @@ def drain_node(
         # retry period until the deadline (scaler.go:47-62).
         remaining: List[PodSpec] = list(pods)
         while remaining:
-            with tracing.span("drain.evict", pods=len(remaining)):
+            with tracing.span(
+                "drain.evict", pods=len(remaining),
+                **({"schedule_step": schedule_step}
+                   if schedule_step >= 0 else {}),
+            ):
                 remaining, err = _evict_round(
                     client, remaining, max_graceful_termination
                 )

@@ -35,18 +35,13 @@ import numpy as np
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 
-
-def _slice_lane(packed: PackedCluster, c: int) -> PackedCluster:
-    """A single-lane view (C=1) — lanes are independent fork copies, so
-    slicing is exact (same argument as the MULTICHIP oracle slices)."""
-    sl = slice(c, c + 1)
-    return packed._replace(
-        slot_req=packed.slot_req[sl],
-        slot_valid=packed.slot_valid[sl],
-        slot_tol=packed.slot_tol[sl],
-        slot_aff=packed.slot_aff[sl],
-        cand_valid=packed.cand_valid[sl],
-    )
+# the single-lane view (C=1) is exact because lanes are independent
+# fork copies — same argument as the MULTICHIP oracle slices; one
+# shared slicer (solver/schedule.py) serves this analyzer and the
+# schedule execution handle's per-step validation
+from k8s_spot_rescheduler_tpu.solver.schedule import (  # noqa: F401
+    slice_lane as _slice_lane,
+)
 
 
 def classify_packed(

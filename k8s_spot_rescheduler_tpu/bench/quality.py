@@ -322,15 +322,34 @@ class _HintingPlanner:
         finish = self.inner.plan_async(node_map, pdbs)
         return lambda: self._record(finish())
 
+    def plan_schedule(self, node_map, pdbs):
+        # same lesson as plan_async: __getattr__ would hand the loop
+        # the inner planner's plan_schedule, whose served steps would
+        # skip hint recording — the handle's on_step hook exists for
+        # exactly this (each executed step's proven placements become
+        # the fake scheduler's routing hints before its drain runs)
+        plan_schedule = getattr(self.inner, "plan_schedule", None)
+        if plan_schedule is None:
+            return None
+        handle = plan_schedule(node_map, pdbs)
+        if handle is not None:
+            handle.on_step = self._record
+        return handle
+
 
 def drain_to_exhaustion(
-    client, config, *, max_ticks: int = 10_000, on_packed=None
+    client, config, *, max_ticks: int = 10_000, on_packed=None,
+    planner_stats=None,
 ) -> int:
     """Run the real control loop (zero cooldown) until no drain happens;
     returns the number of nodes drained — the framework's quality
     number. ``on_packed`` (optional) receives each tick's packed problem
     after planning — the chain-depth analyzer's tap
-    (bench/chain_depth.py; it id-deduplicates skipped ticks)."""
+    (bench/chain_depth.py; it id-deduplicates skipped ticks).
+    ``planner_stats`` (optional dict) is filled with the planner's
+    fetch accounting — ``fetches_total`` and per-cut ``schedule_lens``
+    — the measured artifact behind the O(1)-fetch claim when
+    ``plan_schedule_enabled`` is on."""
     import dataclasses
 
     from k8s_spot_rescheduler_tpu.loop.controller import Rescheduler
@@ -362,4 +381,7 @@ def drain_to_exhaustion(
         if not result.drained and not result.drain_failed:
             break
         freed += len(result.drained)
+    if planner_stats is not None:
+        planner_stats["fetches_total"] = inner.fetches_total
+        planner_stats["schedule_lens"] = list(inner.schedule_lens)
     return freed

@@ -116,6 +116,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop solving at the first chunk containing a "
                         "feasible lane (selection is identical; the "
                         "feasible count then covers the solved prefix)")
+    p.add_argument("--plan-schedule-enabled", type=_bool,
+                   default=d.plan_schedule_enabled,
+                   help="cut whole drain-to-exhaustion SCHEDULES on "
+                        "device (one planner fetch per schedule-horizon "
+                        "drains) and execute them across ticks, each "
+                        "step re-packed and re-proven from scratch "
+                        "against the live mirror before any eviction; "
+                        "churn invalidates the schedule tail and "
+                        "re-plans (false = per-tick single plans)")
+    p.add_argument("--schedule-horizon", type=int,
+                   default=d.schedule_horizon,
+                   help="max drain steps per cut schedule (the device "
+                        "while-loop bound and its jit compile key)")
     p.add_argument("--kube-retry-max", type=int, default=d.kube_retry_max,
                    help="max transient-retry attempts per kube API read "
                         "(429/5xx/connection errors, jittered exponential "
@@ -350,6 +363,8 @@ def config_from_args(args) -> ReschedulerConfig:
         incremental_device_cache=args.incremental_device_cache,
         staged_chunk_lanes=args.staged_chunk_lanes,
         staged_early_exit=args.staged_early_exit,
+        plan_schedule_enabled=args.plan_schedule_enabled,
+        schedule_horizon=args.schedule_horizon,
         jax_cache_dir=args.jax_cache_dir,
         planner_url=args.planner_url,
         planner_urls=args.planner_urls,

@@ -111,6 +111,7 @@ MANIFEST_MODULES = (
     "k8s_spot_rescheduler_tpu.solver.select",
     "k8s_spot_rescheduler_tpu.solver.prefilter",
     "k8s_spot_rescheduler_tpu.solver.fallback",
+    "k8s_spot_rescheduler_tpu.solver.schedule",
     "k8s_spot_rescheduler_tpu.ops.pallas_ffd",
     "k8s_spot_rescheduler_tpu.parallel.sharded_ffd",
     "k8s_spot_rescheduler_tpu.parallel.tenant_batch",

@@ -123,6 +123,10 @@ class Rescheduler:
         # must never untaint a drain in progress (single-threaded today,
         # so empty at every sweep — load-bearing if actuation ever forks)
         self._active_drains: set = set()
+        # pending drain schedule (planner/schedule.py): cut by
+        # plan_schedule in one device fetch, executed across ticks with
+        # per-step live validation; dropped on invalidation/exhaustion
+        self._schedule = None
         # --- freshness gate state (docs/ROBUSTNESS.md) ---
         # the client this tick's READS go to: the configured client, or
         # its direct (cache-bypassing) twin while the watch mirror is
@@ -358,6 +362,90 @@ class Rescheduler:
         except Exception as err:  # noqa: BLE001, exception-discipline — both planners dead: the None return becomes skipped="error", counted by the breaker/health path (the primary's crash already fired planner_fallback + the flight event)
             log.error("Fallback planner failed too: %s", err)
             return None, True
+
+    # --- drain-schedule execution (planner/schedule.py) ---
+
+    def _next_plan(self, observation, pdbs, *, run_metrics: bool = True):
+        """(report | None, used_fallback): the tick's drain decision —
+        from the pending drain schedule when ``plan_schedule_enabled``
+        and the planner supports it (one device fetch per
+        ``schedule_horizon`` drains), else the per-tick plan path.
+        Every schedule-served step was re-packed, precondition-checked
+        and from-scratch validated against the live mirror inside
+        ``DrainSchedule.next_plan``; any schedule-machinery failure
+        degrades to the ordinary guarded per-tick plan."""
+        plan_schedule = (
+            getattr(self.planner, "plan_schedule", None)
+            if self.config.plan_schedule_enabled
+            else None
+        )
+        if plan_schedule is None:
+            return self._plan_guarded(
+                observation, pdbs, run_metrics=run_metrics
+            )
+        try:
+            report = self._schedule_step(observation, pdbs, plan_schedule)
+        except Exception as err:  # noqa: BLE001, exception-discipline — schedule machinery crash: the tick falls through to _plan_guarded below, whose own containment counts planner failures; nothing is lost but the fetch amortization
+            log.error(
+                "Drain-schedule path failed (%s); planning per tick", err
+            )
+            self._schedule = None
+            report = None
+        if report is None:
+            return self._plan_guarded(
+                observation, pdbs, run_metrics=run_metrics
+            )
+        if run_metrics:
+            with tracing.phase("observe-metrics"):
+                self._tick_metrics(observation, pdbs)
+        # dashboard continuity: schedule-served ticks still record a
+        # plan phase (the validation + any schedule-cut fetch)
+        metrics.observe_tick_phase("plan", report.solve_seconds)
+        return report, False
+
+    def _note_schedule_invalidated(self, sched) -> None:
+        """One edge, three surfaces: the counter, the flight event and
+        the log line fire together so they can never diverge."""
+        metrics.update_schedule_invalidated()
+        flight.note_event(
+            "schedule-invalidated",
+            cause=sched.invalid_reason or "live mirror diverged from the "
+                  "schedule's predicted state",
+            trace_id=tracing.current_trace_id(),
+            step=sched.cursor,
+            schedule_len=len(sched.steps),
+        )
+        log.error(
+            "Drain schedule invalidated at step %d/%d (%s); re-planning",
+            sched.cursor, len(sched.steps), sched.invalid_reason,
+        )
+
+    def _schedule_step(self, observation, pdbs, plan_schedule):
+        """Serve the next validated schedule step, cutting a fresh
+        schedule when none is pending; None degrades to per-tick
+        planning."""
+        sched = self._schedule
+        if sched is not None and not sched.invalidated and not sched.exhausted:
+            report = sched.next_plan(observation, pdbs)
+            if report is not None:
+                return report
+            if sched.invalidated:
+                self._note_schedule_invalidated(sched)
+        self._schedule = None
+        sched = plan_schedule(observation, pdbs)
+        if sched is None:
+            return None  # planner cannot schedule this problem
+        report = sched.next_plan(observation, pdbs)
+        if report is None:
+            if sched.invalidated:
+                # structurally impossible (the schedule was cut from
+                # this very observation) but counted, not assumed
+                self._note_schedule_invalidated(sched)
+                return None
+            # zero-step schedule: nothing drainable this tick
+            return sched.empty_report()
+        self._schedule = sched
+        return report
 
     # --- crash-safe drain recovery ---
 
@@ -768,7 +856,7 @@ class Rescheduler:
             )
             return TickResult(skipped="error")
 
-        report, used_fallback = self._plan_guarded(observation, pdbs)
+        report, used_fallback = self._next_plan(observation, pdbs)
         if report is None:
             return TickResult(skipped="error", planner_fallback=True)
         metrics.observe_plan_duration(
@@ -807,7 +895,7 @@ class Rescheduler:
                 except Exception as err:  # noqa: BLE001, exception-discipline — the multi-drain loop stops at the drains already proven; this tick still completes and reports them
                     log.error("Failed to list PDBs: %s", err)
                     break
-                report, used_fallback = self._plan_guarded(
+                report, used_fallback = self._next_plan(
                     observation, pdbs, run_metrics=False
                 )
                 if report is None:
@@ -833,6 +921,7 @@ class Rescheduler:
                     pod_eviction_timeout=self.config.pod_eviction_timeout,
                     eviction_retry_time=self.config.eviction_retry_time,
                     identity=self.identity,
+                    schedule_step=report.schedule_step,
                 )
                 metrics.update_node_drain_count("Success", plan.node.node.name)
                 result.drained.append(plan.node.node.name)

@@ -53,6 +53,7 @@ DEGRADATION_KINDS = frozenset({
     "service-shed",            # planner service 503 (inflight/queue/drain)
     "device-sick",             # watchdog flipped the service host-side
     "failover",                # served by a non-primary planner endpoint
+    "schedule-invalidated",    # churn broke a drain-schedule prediction
 })
 CONTEXT_KINDS = frozenset({
     "orphan-taint-recovered",

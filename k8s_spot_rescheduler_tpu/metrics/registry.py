@@ -433,6 +433,34 @@ service_device_sick = Gauge(
 )
 
 
+# Drain-schedule observability (solver/schedule.py + planner/schedule.py
+# + loop/controller.py): one device fetch returns a whole drain schedule;
+# the controller executes it across ticks with per-step from-scratch
+# validation. The invalidation counter is the degradation edge — churn
+# broke a prediction and cost a re-plan fetch (never a wrong eviction).
+
+plan_schedule_len = Gauge(
+    "plan_schedule_len",
+    "Drain steps in the last cut drain-to-exhaustion schedule (one "
+    "device fetch covers this many drains; 0 = the last cut found no "
+    "drainable candidate).",
+    namespace=NAMESPACE,
+)
+
+schedule_invalidated = Counter(
+    "schedule_invalidated",
+    "Drain-schedule tails invalidated before execution: the live "
+    "mirror no longer matched the schedule's predicted state (cluster "
+    "churn since the cut) or a step failed its from-scratch placement "
+    "re-proof, so the remaining steps were discarded and the tick "
+    "re-planned fresh. Each increment costs one extra planner fetch "
+    "and loses no correctness; a sustained rate means the cluster "
+    "churns faster than schedule_horizon drains and the horizon "
+    "should shrink (flight recorder kind: schedule-invalidated).",
+    namespace=NAMESPACE,
+)
+
+
 def update_nodes_map(on_demand_label: str, spot_label: str, n_on_demand: int, n_spot: int) -> None:
     """reference metrics/metrics.go:73-80 (labels carry the configured
     node-class label strings, as in the reference)."""
@@ -509,6 +537,14 @@ def update_kube_request_failure() -> None:
 
 def update_planner_fallback() -> None:
     planner_fallback.inc()
+
+
+def update_plan_schedule_len(n: int) -> None:
+    plan_schedule_len.set(n)
+
+
+def update_schedule_invalidated() -> None:
+    schedule_invalidated.inc()
 
 
 def update_taint_recovered() -> None:
@@ -648,6 +684,7 @@ def robustness_snapshot() -> dict:
         "kube_request_failures": _counter_value(kube_request_failures),
         "planner_fallback": _counter_value(planner_fallback),
         "orphaned_taints_recovered": _counter_value(orphaned_taints_recovered),
+        "schedule_invalidated": _counter_value(schedule_invalidated),
         "degraded": degraded,
     }
 

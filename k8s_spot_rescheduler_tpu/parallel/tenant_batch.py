@@ -95,6 +95,61 @@ def plan_tenants_batched(
     return fn(stacked)
 
 
+def plan_tenants_scheduled(
+    stacked: PackedCluster,
+    *,
+    horizon: int,
+    rounds: int = 0,
+    best_fit_fallback: bool = True,
+):
+    """Solve T stacked tenant problems to whole DRAIN SCHEDULES;
+    returns int32 [T, horizon, 3 + K].
+
+    The drain-to-exhaustion while-loop (solver/schedule.py) vmaps over
+    the tenant axis exactly like the single-plan program: tenants never
+    interact, so under vmap the loop runs until the LAST tenant
+    exhausts with the finished tenants' lanes masked no-ops. Schedule
+    batches are rare by construction (one per ``horizon`` drains per
+    tenant), so this first version stays single-device vmap — the
+    tenant-mesh sharding the single-plan batch uses is future work."""
+    from k8s_spot_rescheduler_tpu.solver.fallback import (
+        with_best_fit_fallback,
+        with_repair,
+    )
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+    from k8s_spot_rescheduler_tpu.solver.schedule import schedule_matrix
+
+    if best_fit_fallback and rounds > 0:
+        solve = with_repair(plan_ffd, rounds)
+    elif best_fit_fallback:
+        solve = with_best_fit_fallback(plan_ffd)
+    else:
+        solve = plan_ffd
+
+    def tenant_sched(p):
+        return schedule_matrix(solve, p, horizon)
+
+    return jax.vmap(tenant_sched)(stacked)
+
+
+def make_tenant_schedule_planner(
+    *,
+    horizon: int,
+    rounds: int = 0,
+    best_fit_fallback: bool = True,
+):
+    """The service's jitted batched-schedule program (one per horizon —
+    the horizon is the compile key, stable per fleet config)."""
+    return jax.jit(
+        functools.partial(
+            plan_tenants_scheduled,
+            horizon=horizon,
+            rounds=rounds,
+            best_fit_fallback=best_fit_fallback,
+        )
+    )
+
+
 def make_tenant_batch_planner(
     mesh: Mesh | None = None,
     *,
@@ -146,6 +201,20 @@ def _tenant_batch_build(s):
     )
 
 
+def _tenant_schedule_build(s):
+    base = packed_struct(s)
+    stacked = PackedCluster(
+        *(
+            jax.ShapeDtypeStruct((TENANT_PROBE_COUNT,) + f.shape, f.dtype)
+            for f in base
+        )
+    )
+    return (
+        functools.partial(plan_tenants_scheduled, horizon=8, rounds=8),
+        (stacked,),
+    )
+
+
 HOT_PROGRAMS = {
     "service.tenant_batch": HotProgram(
         build=_tenant_batch_build,
@@ -153,5 +222,9 @@ HOT_PROGRAMS = {
             "parallel.tenant_batch:plan_tenants_batched",
             "parallel.tenant_batch:plan_tenants_batched.local",
         ),
+    ),
+    "service.tenant_schedule": HotProgram(
+        build=_tenant_schedule_build,
+        covers=("parallel.tenant_batch:plan_tenants_scheduled",),
     ),
 }

@@ -61,7 +61,51 @@ class PlanReport:
     # elect-then-commit spot-chunked search engaged (per-lane repair
     # state exceeded one device), 0 = repair off/unavailable this solve
     repair_chunks: int = 1
+    # --- drain-schedule telemetry (planner/schedule.py) ---
+    # steps in the schedule this plan was served from; 0 = per-tick plan
+    schedule_len: int = 0
+    # which schedule step this report executed; -1 = not a schedule step
+    schedule_step: int = -1
 
 
 class Planner(Protocol):
     def plan(self, node_map: NodeMap, pdbs: Sequence[PDBSpec]) -> PlanReport: ...
+
+
+def pack_observation(planner, observation, pdbs: Sequence[PDBSpec]):
+    """Observation -> (packed, meta) through the production pack path
+    with ``planner``'s high-water pads — THE one implementation behind
+    ``SolverPlanner._pack_observation`` and
+    ``RemotePlanner._pack_observation`` (and therefore behind every
+    drain-schedule step's live re-pack), so the local and wire pack
+    paths cannot drift. ``planner`` carries ``config``, the
+    ``_pad_c/_pad_k/_pad_s`` high-water marks (grown in place: shapes
+    only ever grow, so neither jit compiles nor service-side buckets
+    churn), and ``last_packed`` (the offline analyzers' tap)."""
+    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+
+    cfg = planner.config
+    if hasattr(observation, "pack"):  # ColumnarStore / ColumnarObservation
+        packed, meta = observation.pack(
+            pdbs,
+            priority_threshold=cfg.priority_threshold,
+            delete_non_replicated=cfg.delete_non_replicated_pods,
+            pad_candidates=planner._pad_c,
+            pad_spot=planner._pad_s,
+            pad_slots=planner._pad_k,
+        )
+    else:
+        packed, meta = pack_cluster(
+            observation,
+            pdbs,
+            resources=cfg.resources,
+            delete_non_replicated=cfg.delete_non_replicated_pods,
+            pad_candidates=planner._pad_c,
+            pad_spot=planner._pad_s,
+            pad_slots=planner._pad_k,
+        )
+    planner._pad_c = max(planner._pad_c, packed.slot_req.shape[0])
+    planner._pad_k = max(planner._pad_k, packed.slot_req.shape[1])
+    planner._pad_s = max(planner._pad_s, packed.spot_free.shape[0])
+    planner.last_packed = packed
+    return packed, meta

@@ -35,8 +35,7 @@ from typing import Sequence
 import numpy as np
 
 from k8s_spot_rescheduler_tpu.models.cluster import PDBSpec
-from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
-from k8s_spot_rescheduler_tpu.planner.base import PlanReport
+from k8s_spot_rescheduler_tpu.planner.base import PlanReport, pack_observation
 from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
@@ -87,6 +86,12 @@ class SolverPlanner:
         self._host_prev = None
         self._apply_delta_jit = None
         self.last_solver = config.solver  # what the last plan actually ran
+        # drain-schedule machinery (solver/schedule.py): one jitted
+        # while-loop program per horizon, plus the fetch accounting the
+        # consolidation benches assert O(1) on
+        self._sched_planners = {}
+        self.fetches_total = 0  # blocking planner fetches (plan + schedule)
+        self.schedule_lens = []  # steps per cut schedule, this planner's life
         if config.solver == "numpy":
             self._solve_host = plan_oracle
         else:
@@ -467,6 +472,13 @@ class SolverPlanner:
     # handing it one instead of a NodeMap.
     accepts_columnar = True
 
+    def _pack_observation(self, observation, pdbs):
+        """The shared pack path (planner/base.pack_observation): used
+        by plan_async, plan_schedule, and the drain-schedule execution
+        handle, whose per-step live re-pack must be exactly what a
+        fresh plan would solve."""
+        return pack_observation(self, observation, pdbs)
+
     def plan(self, observation, pdbs: Sequence[PDBSpec]) -> PlanReport:
         """``observation`` is either a classified ``NodeMap`` (object
         path, reference-faithful) or a ``models/columnar.ColumnarStore``
@@ -485,34 +497,9 @@ class SolverPlanner:
         # spans land on the controller's ambient tick trace (no-ops
         # when tracing is off or no trace is active)
         with tracing.span("plan.pack") as pack_sp:
-            if hasattr(observation, "pack"):  # ColumnarStore
-                packed, meta = observation.pack(
-                    pdbs,
-                    priority_threshold=cfg.priority_threshold,
-                    delete_non_replicated=cfg.delete_non_replicated_pods,
-                    pad_candidates=self._pad_c,
-                    pad_spot=self._pad_s,
-                    pad_slots=self._pad_k,
-                )
-            else:
-                packed, meta = pack_cluster(
-                    observation,
-                    pdbs,
-                    resources=cfg.resources,
-                    delete_non_replicated=cfg.delete_non_replicated_pods,
-                    pad_candidates=self._pad_c,
-                    pad_spot=self._pad_s,
-                    pad_slots=self._pad_k,
-                )
+            packed, meta = self._pack_observation(observation, pdbs)
             if pack_sp is not None:
                 pack_sp.attrs["lanes"] = int(packed.slot_req.shape[0])
-        # high-water-mark padding: shapes only ever grow → no recompile churn
-        self._pad_c = max(self._pad_c, packed.slot_req.shape[0])
-        self._pad_k = max(self._pad_k, packed.slot_req.shape[1])
-        self._pad_s = max(self._pad_s, packed.spot_free.shape[0])
-        # the tick's packed problem, for offline analyzers
-        # (bench/chain_depth.py) — a tuple of numpy refs, no copy
-        self.last_packed = packed
 
         for blocked in meta.blocking_pods():
             log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)
@@ -587,6 +574,10 @@ class SolverPlanner:
 
         def finish() -> PlanReport:
             staged_stats = None
+            # one blocking planner fetch per completed plan (device
+            # selection fetch or host solve) — the denominator of the
+            # consolidation benches' O(1)-fetch assertion
+            self.fetches_total += 1
             with tracing.span("plan.solve"):
                 if fetch is not None:
                     sel, staged_stats = fetch()
@@ -661,6 +652,106 @@ class SolverPlanner:
             return report
 
         return finish
+
+    # ------------------------------------------------------------------
+    # drain-to-exhaustion schedules (solver/schedule.py)
+
+    def _schedule_planner_for(self, horizon: int):
+        """The jitted while-loop schedule program over the SAME union
+        program ``_fused`` wraps, one compile per horizon value."""
+        if horizon not in self._sched_planners:
+            from k8s_spot_rescheduler_tpu.solver.schedule import (
+                make_schedule_planner,
+            )
+
+            self._sched_planners[horizon] = make_schedule_planner(
+                self._union_fn, horizon
+            )
+        return self._sched_planners[horizon]
+
+    def plan_schedule(self, observation, pdbs: Sequence[PDBSpec]):
+        """Cut a whole drain schedule in ONE fetch: pack, run the
+        device drain→commit→re-solve loop (solver/schedule.py), and
+        return a ``planner/schedule.DrainSchedule`` the control loop
+        executes across ticks with per-step live validation. Returns
+        None when this problem's shapes dispatch to a mesh reroute
+        (the schedule program is single-chip; the caller then plans
+        per-tick, losing only the fetch amortization)."""
+        from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+        from k8s_spot_rescheduler_tpu.planner.schedule import DrainSchedule
+        from k8s_spot_rescheduler_tpu.solver import schedule as sched_mod
+
+        cfg = self.config
+        horizon = max(1, cfg.schedule_horizon)
+        with tracing.span("plan.schedule") as sp:
+            packed, meta = self._pack_observation(observation, pdbs)
+            for blocked in meta.blocking_pods():
+                log.info(
+                    "BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason
+                )
+            if self._fused is None:
+                mat = sched_mod.plan_schedule_oracle(
+                    packed,
+                    horizon,
+                    best_fit_fallback=cfg.fallback_best_fit,
+                    repair_rounds=cfg.repair_rounds,
+                )
+                label = cfg.solver
+            elif cfg.solver not in ("jax", "pallas"):
+                # the configured mesh solver composes its own sharded
+                # placement; the schedule while-loop is single-chip
+                log.vlog(
+                    2,
+                    "solver %r has no drain-schedule program; planning "
+                    "per tick", cfg.solver,
+                )
+                return None
+            else:
+                fused, label, _, _ = self._maybe_shard(packed)
+                if fused is not self._fused:
+                    # the problem outgrew one chip: the mesh tiers
+                    # manage their own placement and the schedule
+                    # program is single-chip — per-tick planning takes
+                    # over (correctness unchanged, fetches O(drains))
+                    log.vlog(
+                        2,
+                        "mesh reroute engaged; drain schedules "
+                        "unavailable at this scale — planning per tick",
+                    )
+                    return None
+                device_packed = packed
+                if cfg.incremental_device_cache and cfg.solver in (
+                    "jax",
+                    "pallas",
+                ):
+                    # ship through the resident delta cache: the
+                    # schedule program reads the cached tensors without
+                    # donating them, so the next tick's diff still holds
+                    device_packed = self._upload_incremental(packed)[0]
+                else:
+                    import jax
+
+                    device_packed = jax.device_put(packed)
+                mat = np.asarray(
+                    self._schedule_planner_for(horizon)(device_packed)
+                )  # the ONE fetch for up to `horizon` drains
+            steps = sched_mod.decode_schedule(mat)
+            self.fetches_total += 1
+            self.schedule_lens.append(len(steps))
+            metrics.update_plan_schedule_len(len(steps))
+            if sp is not None:
+                sp.attrs["steps"] = len(steps)
+                sp.attrs["horizon"] = horizon
+        self.last_solver = label
+        return DrainSchedule(
+            steps,
+            packed,
+            meta,
+            pack_fn=self._pack_observation,
+            solver_label=f"{label}+schedule",
+            horizon=horizon,
+            base_observation=observation,
+        )
 
     def _report_conservatism(self, packed, meta, n_feasible: int) -> None:
         """Why-no-drain observability (metrics/registry.py conservatism

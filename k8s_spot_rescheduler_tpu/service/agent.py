@@ -57,8 +57,7 @@ import numpy as np
 from k8s_spot_rescheduler_tpu.loop import flight
 from k8s_spot_rescheduler_tpu.metrics import registry as metrics
 from k8s_spot_rescheduler_tpu.models.cluster import PDBSpec
-from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
-from k8s_spot_rescheduler_tpu.planner.base import PlanReport
+from k8s_spot_rescheduler_tpu.planner.base import PlanReport, pack_observation
 from k8s_spot_rescheduler_tpu.service import wire
 from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
@@ -282,6 +281,119 @@ class RemotePlanner:
                 retry_after,
             ) from err
 
+    def _pack_observation(self, observation, pdbs):
+        """The shared pack path (planner/base.pack_observation) with
+        the agent's high-water pads — stable shapes keep the whole
+        fleet in few service-side buckets; shared by plan_async,
+        plan_schedule, and the drain-schedule execution handle."""
+        return pack_observation(self, observation, pdbs)
+
+    def _ladder_call(self, path: str, body: bytes, headers: dict,
+                     decode, box: dict) -> None:
+        """Walk the ordered endpoint list under ONE deadline budget:
+        the tick's documented planner_timeout bounds the whole call,
+        not each endpoint — three blackholed replicas must not stall
+        the loop 3x the deadline. Fills ``box`` with the decoded reply
+        + serving endpoint (or just the attempts on total failure)."""
+        box["t_send"] = time.perf_counter()
+        deadline = box["t_send"] + self.timeout
+        skipped = 0
+        for ep in self._endpoints:
+            if self.clock.now() < ep.skip_until:
+                # counts toward failover only if it precedes the
+                # endpoint that eventually serves
+                skipped += 1
+                continue
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                box["attempts"].append((
+                    ep.url,
+                    "plan deadline exhausted before this "
+                    "endpoint was tried",
+                    0.0,
+                ))
+                # not an endpoint failure: its breaker is
+                # untouched — we simply ran out of budget
+                continue
+            t_ep = time.perf_counter()
+            try:
+                raw = self.transport(
+                    f"{ep.url}{path}", body, headers,
+                    max(0.05, remaining),
+                )
+                reply = decode(raw)
+            except RemoteCallError as err:
+                self._note_failure(ep, str(err), err.retry_after)
+                box["attempts"].append((
+                    ep.url, str(err),
+                    (time.perf_counter() - t_ep) * 1e3,
+                ))
+                continue
+            except Exception as err:  # noqa: BLE001, exception-discipline — transport/protocol failure of ONE endpoint: recorded as a failover attempt and the ladder continues; the terminal all-dead case is counted+evented by the caller
+                self._note_failure(ep, str(err), 0.0)
+                box["attempts"].append((
+                    ep.url, str(err),
+                    (time.perf_counter() - t_ep) * 1e3,
+                ))
+                continue
+            self._note_success(ep)
+            box["reply"] = reply
+            box["endpoint"] = ep.url
+            box["skipped_before"] = skipped
+            break
+        box["t_recv"] = time.perf_counter()
+
+    def _note_wire_outcome(self, trace, box, spans, attrs=None) -> None:
+        """The shared post-ladder accounting: graft each FAILED
+        endpoint attempt, fire the failover metric + flight event when
+        the serving endpoint was not first choice (same site, so the
+        two surfaces always agree), and graft the server's span block
+        under the measured round trip."""
+        attempts = box["attempts"]
+        if trace is not None:
+            for ep_url, why, dur_ms in attempts:
+                trace.graft(
+                    tracing.make_span("wire.failover", 0.0, dur_ms),
+                    attrs={"endpoint": ep_url, "error": True},
+                )
+        if box.get("reply") is None:
+            return
+        skipped_before = box.get("skipped_before", 0)
+        if attempts or skipped_before:
+            # served, but only after at least one EARLIER endpoint
+            # failed or was breaker-open: a failover tick. (A
+            # breaker-open endpoint LATER in the list is irrelevant —
+            # the primary serving is healthy.)
+            metrics.update_remote_planner_failover()
+            flight.note_event(
+                "failover",
+                cause=(
+                    f"{len(attempts)} endpoint(s) failed, "
+                    f"{skipped_before} breaker-open; served by "
+                    f"{box.get('endpoint', '?')}"
+                ),
+                trace_id=(
+                    trace.trace_id if trace is not None else ""
+                ),
+                endpoints_tried=len(attempts) + skipped_before + 1,
+            )
+        if trace is not None:
+            # graft the server's span block under the measured round
+            # trip; the residual (rtt minus server-side work) is the
+            # wire itself — tunnel, TLS, serialization on the path
+            rtt_ms = max(0.0, (box["t_recv"] - box["t_send"]) * 1e3)
+            server_ms = sum(d for _, _, d in spans)
+            trace.graft(
+                tracing.make_span("wire.request", 0.0, rtt_ms),
+                children=spans,
+                attrs=attrs,
+            )
+            trace.graft(
+                tracing.make_span(
+                    "wire.transfer", 0.0, max(0.0, rtt_ms - server_ms)
+                )
+            )
+
     # ------------------------------------------------------------------
     # Planner surface
 
@@ -318,31 +430,7 @@ class RemotePlanner:
             )
 
         with _sp("plan.pack"):
-            if hasattr(observation, "pack"):  # ColumnarStore
-                packed, meta = observation.pack(
-                    pdbs,
-                    priority_threshold=cfg.priority_threshold,
-                    delete_non_replicated=cfg.delete_non_replicated_pods,
-                    pad_candidates=self._pad_c,
-                    pad_spot=self._pad_s,
-                    pad_slots=self._pad_k,
-                )
-            else:
-                packed, meta = pack_cluster(
-                    observation,
-                    pdbs,
-                    resources=cfg.resources,
-                    delete_non_replicated=cfg.delete_non_replicated_pods,
-                    pad_candidates=self._pad_c,
-                    pad_spot=self._pad_s,
-                    pad_slots=self._pad_k,
-                )
-        # high-water pads: stable shapes keep the whole fleet in few
-        # service-side buckets (and the service in few compiles)
-        self._pad_c = max(self._pad_c, packed.slot_req.shape[0])
-        self._pad_k = max(self._pad_k, packed.slot_req.shape[1])
-        self._pad_s = max(self._pad_s, packed.spot_free.shape[0])
-        self.last_packed = packed
+            packed, meta = self._pack_observation(observation, pdbs)
 
         for blocked in meta.blocking_pods():
             log.info("BlockingPod: %s (%s)", blocked.pod.uid, blocked.reason)
@@ -370,57 +458,9 @@ class RemotePlanner:
                 headers["X-Trace-Id"] = trace_id
 
             def call():
-                box["t_send"] = time.perf_counter()
-                # ONE deadline budget for the whole ladder: the tick's
-                # documented planner_timeout bounds the plan call, not
-                # each endpoint — three blackholed replicas must not
-                # stall the loop 3x the deadline
-                deadline = box["t_send"] + self.timeout
-                skipped = 0
-                for ep in self._endpoints:
-                    if self.clock.now() < ep.skip_until:
-                        # counts toward failover only if it precedes the
-                        # endpoint that eventually serves
-                        skipped += 1
-                        continue
-                    remaining = deadline - time.perf_counter()
-                    if remaining <= 0:
-                        box["attempts"].append((
-                            ep.url,
-                            "plan deadline exhausted before this "
-                            "endpoint was tried",
-                            0.0,
-                        ))
-                        # not an endpoint failure: its breaker is
-                        # untouched — we simply ran out of budget
-                        continue
-                    t_ep = time.perf_counter()
-                    try:
-                        raw = self.transport(
-                            f"{ep.url}/v2/plan", body, headers,
-                            max(0.05, remaining),
-                        )
-                        reply = wire.decode_plan_reply(raw)
-                    except RemoteCallError as err:
-                        self._note_failure(ep, str(err), err.retry_after)
-                        box["attempts"].append((
-                            ep.url, str(err),
-                            (time.perf_counter() - t_ep) * 1e3,
-                        ))
-                        continue
-                    except Exception as err:  # noqa: BLE001, exception-discipline — transport/protocol failure of ONE endpoint: recorded as a failover attempt and the ladder continues; the terminal all-dead case is counted+evented in _plan_fallback
-                        self._note_failure(ep, str(err), 0.0)
-                        box["attempts"].append((
-                            ep.url, str(err),
-                            (time.perf_counter() - t_ep) * 1e3,
-                        ))
-                        continue
-                    self._note_success(ep)
-                    box["reply"] = reply
-                    box["endpoint"] = ep.url
-                    box["skipped_before"] = skipped
-                    break
-                box["t_recv"] = time.perf_counter()
+                self._ladder_call(
+                    "/v2/plan", body, headers, wire.decode_plan_reply, box
+                )
 
             worker = threading.Thread(target=call, daemon=True)
             worker.start()
@@ -429,63 +469,22 @@ class RemotePlanner:
             if worker is not None:
                 worker.join()
             reply = box.get("reply")
-            attempts = box["attempts"]
-            if trace is not None:
-                for ep_url, why, dur_ms in attempts:
-                    trace.graft(
-                        tracing.make_span("wire.failover", 0.0, dur_ms),
-                        attrs={"endpoint": ep_url, "error": True},
-                    )
             if reply is None:
-                causes = "; ".join(why for _, why, _ in attempts)
+                self._note_wire_outcome(trace, box, ())
+                causes = "; ".join(why for _, why, _ in box["attempts"])
                 return self._plan_fallback(
                     observation, pdbs,
                     cause=causes or "breaker open on every endpoint",
                 )
             self.last_solver = "remote"
             self.last_endpoint = box.get("endpoint", "")
-            skipped_before = box["skipped_before"]
-            if attempts or skipped_before:
-                # served, but only after at least one EARLIER endpoint
-                # failed or was breaker-open: a failover tick. Metric
-                # and flight event fire together so the two surfaces
-                # always agree. (A breaker-open endpoint LATER in the
-                # list is irrelevant — the primary serving is healthy.)
-                metrics.update_remote_planner_failover()
-                flight.note_event(
-                    "failover",
-                    cause=(
-                        f"{len(attempts)} endpoint(s) failed, "
-                        f"{skipped_before} breaker-open; served by "
-                        f"{box.get('endpoint', '?')}"
-                    ),
-                    trace_id=(
-                        trace.trace_id if trace is not None else ""
-                    ),
-                    endpoints_tried=len(attempts) + skipped_before + 1,
-                )
-            if trace is not None:
-                # graft the server's span block under the measured round
-                # trip; the residual (rtt minus server-side work) is the
-                # wire itself — tunnel, TLS, serialization on the path
-                rtt_ms = max(
-                    0.0, (box["t_recv"] - box["t_send"]) * 1e3
-                )
-                server_ms = sum(d for _, _, d in reply.spans)
-                trace.graft(
-                    tracing.make_span("wire.request", 0.0, rtt_ms),
-                    children=reply.spans,
-                    attrs={
-                        "batch_lanes": reply.batch_lanes,
-                        "batch_tenants": reply.batch_tenants,
-                    },
-                )
-                trace.graft(
-                    tracing.make_span(
-                        "wire.transfer", 0.0,
-                        max(0.0, rtt_ms - server_ms),
-                    )
-                )
+            self._note_wire_outcome(
+                trace, box, reply.spans,
+                attrs={
+                    "batch_lanes": reply.batch_lanes,
+                    "batch_tenants": reply.batch_tenants,
+                },
+            )
             plan = None
             if reply.found and reply.index < meta.n_candidates:
                 plan = meta.build_plan(
@@ -501,6 +500,100 @@ class RemotePlanner:
             )
 
         return finish
+
+    def plan_schedule(self, observation, pdbs: Sequence[PDBSpec]):
+        """Fetch a whole drain schedule over the wire (wire v3
+        ``schedule_horizon`` frame -> KIND_PLAN_SCHEDULE reply): pack
+        locally, walk the SAME endpoint failover ladder synchronously
+        (a schedule fetch happens once per ``schedule_horizon`` drains
+        — there is no metrics pass to overlap), and return a
+        ``planner/schedule.DrainSchedule`` whose per-step validation
+        runs entirely locally — executing an in-flight schedule needs
+        no wire at all, so a replica dying mid-schedule costs nothing
+        until the NEXT cut, which fails over. Returns None when every
+        endpoint is unusable; the controller then plans per tick
+        (plan_async's own ladder + local-fallback accounting owns the
+        degradation)."""
+        from k8s_spot_rescheduler_tpu.planner.schedule import DrainSchedule
+        from k8s_spot_rescheduler_tpu.solver.schedule import decode_schedule
+
+        cfg = self.config
+        horizon = max(1, cfg.schedule_horizon)
+        trace = tracing.current_trace()
+        if trace is None and cfg.trace_enabled:
+            trace = tracing.Trace()
+        self.last_trace = trace
+        span_cm = (
+            trace.span("plan.schedule")
+            if trace is not None
+            else contextlib.nullcontext()
+        )
+        with span_cm as sp:
+            with (
+                trace.span("plan.pack")
+                if trace is not None
+                else contextlib.nullcontext()
+            ):
+                packed, meta = self._pack_observation(observation, pdbs)
+            live = [
+                ep for ep in self._endpoints
+                if self.clock.now() >= ep.skip_until
+            ]
+            if not live:
+                return None
+            trace_id = trace.trace_id if trace is not None else ""
+            body = wire.encode_plan_request(
+                self.tenant, packed, trace_id=trace_id,
+                schedule_horizon=horizon,
+            )
+            headers = {
+                "Content-Type": "application/octet-stream",
+                "X-Planner-Deadline": f"{self.timeout:.3f}",
+            }
+            if trace_id:
+                headers["X-Trace-Id"] = trace_id
+            box: dict = {"attempts": [], "skipped_before": 0}
+            self._ladder_call(
+                "/v2/plan", body, headers,
+                wire.decode_plan_schedule_reply, box,
+            )
+            reply = box.get("reply")
+            self._note_wire_outcome(
+                trace, box,
+                reply.spans if reply is not None else (),
+                attrs=(
+                    {
+                        "batch_lanes": reply.batch_lanes,
+                        "batch_tenants": reply.batch_tenants,
+                    }
+                    if reply is not None
+                    else None
+                ),
+            )
+            if reply is None:
+                log.warning(
+                    "drain-schedule fetch failed on every endpoint "
+                    "(%s); the tick plans per-plan instead",
+                    "; ".join(why for _, why, _ in box["attempts"])
+                    or "breaker open on every endpoint",
+                )
+                return None
+            steps = decode_schedule(reply.steps)
+            if sp is not None:
+                sp.attrs["steps"] = len(steps)
+                sp.attrs["horizon"] = horizon
+        metrics.update_plan_schedule_len(len(steps))
+        self.last_solver = "remote"
+        self.last_endpoint = box.get("endpoint", "")
+        return DrainSchedule(
+            steps,
+            packed,
+            meta,
+            pack_fn=self._pack_observation,
+            solver_label="remote+schedule",
+            horizon=horizon,
+            base_observation=observation,
+        )
 
     def _plan_fallback(self, observation, pdbs, cause: str = "") -> PlanReport:
         """This tick plans locally (numpy oracle) — every endpoint is

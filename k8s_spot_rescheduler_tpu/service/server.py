@@ -107,14 +107,18 @@ SEEN_BUCKETS_MAX = 64
 class _Request:
     __slots__ = (
         "tenant", "packed", "bucket", "lanes", "enqueued", "event",
-        "reply", "error", "trace_id",
+        "reply", "error", "trace_id", "horizon",
     )
 
     def __init__(self, tenant: str, packed: PackedCluster, bucket: Bucket,
-                 enqueued: float, trace_id: str = ""):
+                 enqueued: float, trace_id: str = "", horizon: int = 0):
         self.tenant = tenant
         self.packed = packed
         self.bucket = bucket
+        # drain-schedule horizon (wire v3): 0 = ordinary single plan;
+        # > 0 = answer with a whole [horizon, 3+K] schedule. Requests
+        # only batch with same-horizon peers (one program per batch).
+        self.horizon = int(horizon)
         # DRR cost: the lanes this problem actually solves (valid lanes,
         # not pad) — a tenant shipping big problems drains its deficit
         # faster than one shipping small ones
@@ -168,6 +172,7 @@ class PlannerService:
         self._cadence_s: Optional[float] = None  # EMA of batch intervals
         self._last_batch_mono: Optional[float] = None
         self._batched = None  # lazy jitted tenant-batch program
+        self._sched_programs: Dict[int, object] = {}  # horizon -> jit
         self._mesh = None
         self._stop = False
         self._draining = False
@@ -210,13 +215,17 @@ class PlannerService:
     # queue
 
     def submit_nowait(
-        self, tenant: str, packed: PackedCluster, trace_id: str = ""
+        self,
+        tenant: str,
+        packed: PackedCluster,
+        trace_id: str = "",
+        schedule_horizon: int = 0,
     ) -> _Request:
         """Enqueue one problem; returns the pending request (its
         ``event`` fires when a batch delivered ``reply`` or ``error``)."""
         req = _Request(
             tenant, packed, bucketing.bucket_for(packed), self.clock.now(),
-            trace_id=trace_id,
+            trace_id=trace_id, horizon=schedule_horizon,
         )
         with self._work:
             if self._draining:
@@ -244,18 +253,24 @@ class PlannerService:
         packed: PackedCluster,
         timeout_s: Optional[float] = None,
         trace_id: str = "",
-    ) -> wire.PlanReply:
+        schedule_horizon: int = 0,
+    ):
         """Enqueue and wait for the batch that carries this request.
         Raises :class:`ServiceBusy` when the bounded wait expires — the
         request is evicted from the queue so an abandoned caller cannot
         occupy a batch slot. ``timeout_s`` is the CLIENT's declared
         deadline (agents send it as ``X-Planner-Deadline``): waiting any
         longer than the caller will would solve — and hold an inflight
-        slot for — a request nobody is listening to anymore."""
+        slot for — a request nobody is listening to anymore. Returns a
+        :class:`wire.PlanReply`, or a :class:`wire.PlanScheduleReply`
+        when ``schedule_horizon`` > 0 asked for a drain schedule."""
         wait_s = self.queue_timeout_s
         if timeout_s is not None and timeout_s > 0:
             wait_s = max(0.05, min(wait_s, float(timeout_s)))
-        req = self.submit_nowait(tenant, packed, trace_id=trace_id)
+        req = self.submit_nowait(
+            tenant, packed, trace_id=trace_id,
+            schedule_horizon=schedule_horizon,
+        )
         if self._thread is None:
             # no scheduler thread (an in-process caller — e.g.
             # PlannerSidecar.plan without start_background): drain the
@@ -362,6 +377,9 @@ class PlannerService:
         if oldest is None:
             return []
         bucket = oldest.bucket
+        # schedule requests (horizon > 0) solve a different program per
+        # horizon: a batch only ever mixes same-(bucket, horizon) peers
+        horizon = oldest.horizon
         cap = self.max_batch_tenants or self._batch_cap.get(bucket, 0)
         if not cap:
             # memoized per bucket: the estimate is constant in (bucket,
@@ -406,7 +424,7 @@ class PlannerService:
                     self._deficit.pop(tenant, None)
                     self._queues.pop(tenant, None)
                     continue
-                if q[0].bucket == bucket:
+                if q[0].bucket == bucket and q[0].horizon == horizon:
                     if tenant not in refilled:
                         refilled.add(tenant)
                         # clamp: credit saved while batches were full
@@ -506,36 +524,48 @@ class PlannerService:
         for i, req in enumerate(batch):
             K = req.packed.slot_req.shape[1]
             vec = out[i]
-            req.reply = wire.PlanReply(
-                found=bool(vec[1]),
-                index=int(vec[0]),
-                n_feasible=int(vec[2]),
-                # trim the bucket's K pad back to the tenant's K: slot
-                # indices beyond the tenant's own slots are pad rows
-                row=np.asarray(vec[3 : 3 + K], np.int32),
-                solve_ms=float(solve_ms / max(len(batch), 1)),
-                queue_wait_ms=float(waits_ms[i]),
-                batch_lanes=lanes,
-                batch_tenants=tenants,
-                # server-side spans, offset from THIS request's enqueue:
-                # how its wall time split between the tenant queue, the
-                # bucket pad/stack, and the shared solve. The HTTP layer
-                # prepends admit/decode and appends encode; the agent
-                # grafts the whole block under its wire.request span.
-                spans=(
-                    tracing.make_span(
-                        "service.queue-wait", 0.0, waits_ms[i]
-                    ),
-                    tracing.make_span(
-                        "service.batch", waits_ms[i], batch_ms
-                    ),
-                    tracing.make_span(
-                        "service.solve",
-                        waits_ms[i] + batch_ms,
-                        solve_wall_ms,
-                    ),
+            # server-side spans, offset from THIS request's enqueue:
+            # how its wall time split between the tenant queue, the
+            # bucket pad/stack, and the shared solve. The HTTP layer
+            # prepends admit/decode and appends encode; the agent
+            # grafts the whole block under its wire.request span.
+            spans = (
+                tracing.make_span("service.queue-wait", 0.0, waits_ms[i]),
+                tracing.make_span("service.batch", waits_ms[i], batch_ms),
+                tracing.make_span(
+                    "service.solve", waits_ms[i] + batch_ms, solve_wall_ms
                 ),
             )
+            if req.horizon > 0:
+                # a whole drain schedule (wire v3): trim the bucket's K
+                # pad per step — the slot columns beyond the tenant's
+                # own K are pad rows, exactly as for a single plan
+                req.reply = wire.PlanScheduleReply(
+                    steps=np.ascontiguousarray(
+                        np.concatenate(
+                            [vec[:, :3], vec[:, 3 : 3 + K]], axis=1
+                        ).astype(np.int32)
+                    ),
+                    solve_ms=float(solve_ms / max(len(batch), 1)),
+                    queue_wait_ms=float(waits_ms[i]),
+                    batch_lanes=lanes,
+                    batch_tenants=tenants,
+                    spans=spans,
+                )
+            else:
+                req.reply = wire.PlanReply(
+                    found=bool(vec[1]),
+                    index=int(vec[0]),
+                    n_feasible=int(vec[2]),
+                    # trim the bucket's K pad back to the tenant's K:
+                    # slot indices beyond the tenant's own slots are pad
+                    row=np.asarray(vec[3 : 3 + K], np.int32),
+                    solve_ms=float(solve_ms / max(len(batch), 1)),
+                    queue_wait_ms=float(waits_ms[i]),
+                    batch_lanes=lanes,
+                    batch_tenants=tenants,
+                    spans=spans,
+                )
             metrics.update_service_request("ok")
             req.event.set()
         if self._state_path() and (
@@ -632,6 +662,8 @@ class PlannerService:
         A device exception flips the watchdog and is contained to the
         host path for the batch; host-path exceptions propagate to
         drain_once's per-batch containment."""
+        if batch and batch[0].horizon > 0:
+            return self._solve_schedule_batch(stacked, batch[0].horizon)
         wd = self._watchdog()
         if wd is None:
             out, _dur, err = self._device_solve_timed(stacked, batch)
@@ -664,6 +696,63 @@ class PlannerService:
                 self._note_device_edge(wd.note_probe(dur, ok=True))
             return out
         return self._solve_host(stacked)
+
+    def _solve_schedule_batch(self, stacked: PackedCluster, horizon: int):
+        """One batched drain-SCHEDULE solve (wire v3): int32
+        [T, horizon, 3+K]. Routed like the single-plan solve — host
+        oracle for solver=numpy and while the watchdog holds the device
+        sick — but deliberately NOT fed into the watchdog's latency
+        baseline: a schedule is ~horizon single solves by construction,
+        and sampling it would poison the EMA a single-plan batch is
+        judged against (a device ERROR still flips the watchdog)."""
+        wd = self._watchdog()
+        if self.config.solver == "numpy" or (wd is not None and wd.sick):
+            return self._solve_schedule_host(stacked, horizon)
+        if horizon not in self._sched_programs:
+            from k8s_spot_rescheduler_tpu.parallel.tenant_batch import (
+                make_tenant_schedule_planner,
+            )
+
+            cfg = self.config
+            self._sched_programs[horizon] = make_tenant_schedule_planner(
+                horizon=horizon,
+                rounds=(cfg.repair_rounds if cfg.fallback_best_fit else 0),
+                best_fit_fallback=cfg.fallback_best_fit,
+            )
+        try:
+            if self.chaos is not None:
+                self.chaos.on_batch()
+            return np.asarray(self._sched_programs[horizon](stacked))
+        except Exception as err:  # noqa: BLE001, exception-discipline — a device failure on the schedule program flips the SAME watchdog edge (gauge + flight) as a single-plan batch, then drain_once's per-batch containment answers the tenants typed
+            if wd is not None:
+                self._note_device_edge(wd.note_error(err))
+            raise
+
+    def _solve_schedule_host(
+        self, stacked: PackedCluster, horizon: int
+    ) -> np.ndarray:
+        """Per-tenant host drain schedules via the SAME oracle loop
+        SolverPlanner's numpy branch runs (solver/schedule.
+        plan_schedule_oracle) — one host implementation, no drift."""
+        from k8s_spot_rescheduler_tpu.solver.schedule import (
+            plan_schedule_oracle,
+        )
+
+        cfg = self.config
+        T = stacked.slot_req.shape[0]
+        K = stacked.slot_req.shape[2]
+        out = np.full((T, horizon, 3 + K), -1, np.int32)
+        for t in range(T):
+            packed = PackedCluster(
+                *(np.asarray(getattr(stacked, f)[t]) for f in stacked._fields)
+            )
+            out[t] = plan_schedule_oracle(
+                packed,
+                horizon,
+                best_fit_fallback=cfg.fallback_best_fit,
+                repair_rounds=cfg.repair_rounds,
+            )
+        return out
 
     def run_canary(self) -> None:
         """Idle liveness canary (called from the scheduler loop): a tiny
@@ -1257,6 +1346,7 @@ class ServiceServer:
                             req.tenant, req.packed,
                             timeout_s=deadline or None,
                             trace_id=trace_id,
+                            schedule_horizon=req.schedule_horizon,
                         )
                     except ServiceBusy as err:
                         return self._send_bytes(
@@ -1277,8 +1367,15 @@ class ServiceServer:
                             "service.decode", admit_ms, decode_ms
                         ),
                     ) + reply.spans
+                    # schedule requests (wire v3) answer in the
+                    # schedule kind; the encode dance is identical
+                    encode = (
+                        wire.encode_plan_schedule_reply
+                        if isinstance(reply, wire.PlanScheduleReply)
+                        else wire.encode_plan_reply
+                    )
                     t_enc = time.perf_counter()
-                    wire.encode_plan_reply(
+                    encode(
                         reply._replace(spans=spans), version=req.version
                     )
                     encode_ms = (time.perf_counter() - t_enc) * 1e3
@@ -1287,7 +1384,7 @@ class ServiceServer:
                     )
                     server.note_request_trace(trace_id, req.tenant, spans)
                     return self._send_bytes(
-                        wire.encode_plan_reply(
+                        encode(
                             reply._replace(spans=spans),
                             version=req.version,
                         ),

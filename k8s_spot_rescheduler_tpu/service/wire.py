@@ -52,6 +52,18 @@ Version history
   their absence is a valid version-2 message. The bump (rather than
   frame addition alone) marks the reply-mirroring contract: a v2-aware
   peer may rely on span frames surviving the round trip.
+- **3** — drain schedules (solver/schedule.py): PLAN_REQUEST may carry
+  an optional ``schedule_horizon`` frame asking the service to answer
+  with a whole drain-to-exhaustion schedule, and a NEW reply kind
+  ``KIND_PLAN_SCHEDULE`` carries it (one ``steps`` int32
+  ``[horizon, 3+K]`` matrix — the same layout the in-process device
+  fetch returns — plus the PLAN_REPLY batch telemetry and optional v2
+  span frames). Per the policy above, the new kind and frame alone
+  would not bump the version; the bump marks the REPLY-KIND contract:
+  only a version-3 request may be answered with KIND_PLAN_SCHEDULE
+  (the reply mirrors the request's version, so v1/v2 agents can never
+  receive a kind they do not decode), and a v3-aware peer may rely on
+  the service honoring ``schedule_horizon``.
 """
 
 from __future__ import annotations
@@ -62,14 +74,15 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 MAGIC = b"KSRW"
-WIRE_VERSION = 2
-SUPPORTED_VERSIONS = (1, 2)
+WIRE_VERSION = 3
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 # message kinds (u8). New kinds append; renumbering is a version bump.
 KIND_PLAN_REQUEST = 1  # agent -> service: tenant + PackedCluster
 KIND_PLAN_REPLY = 2  # service -> agent: selection + batch telemetry
 KIND_PACKED_DELTA = 3  # agent -> service: tenant + PackedDelta
 KIND_ERROR = 4  # service -> agent: typed error text
+KIND_PLAN_SCHEDULE = 5  # service -> agent: whole drain schedule (v3)
 
 # dtype table (u8 code <-> numpy dtype). Append-only; reordering is a
 # version bump. bool travels as its own code (1 byte/element) so the
@@ -183,7 +196,8 @@ def decode_frames_v(data: bytes) -> Tuple[int, int, Dict[str, np.ndarray]]:
             "in service/wire.py)"
         )
     if kind not in (
-        KIND_PLAN_REQUEST, KIND_PLAN_REPLY, KIND_PACKED_DELTA, KIND_ERROR
+        KIND_PLAN_REQUEST, KIND_PLAN_REPLY, KIND_PACKED_DELTA, KIND_ERROR,
+        KIND_PLAN_SCHEDULE,
     ):
         raise WireError(f"unknown message kind {kind}")
     if n_frames > MAX_FRAMES:
@@ -287,15 +301,22 @@ def encode_plan_request(
     packed,
     trace_id: str = "",
     version: Optional[int] = None,
+    schedule_horizon: int = 0,
 ) -> bytes:
     """Agent -> service: one tenant's full packed problem, optionally
     stamped with the agent's tick trace ID (wire v2; omitted when empty
-    or when encoding a version-1 message for an old server)."""
+    or when encoding a version-1 message for an old server) and an
+    optional ``schedule_horizon`` (wire v3: ask for a whole drain
+    schedule back — KIND_PLAN_SCHEDULE — instead of a single plan)."""
     version = WIRE_VERSION if version is None else int(version)
     frames: List[Tuple[str, np.ndarray]] = [("tenant", _str_frame(tenant))]
     frames.extend((f, getattr(packed, f)) for f in type(packed)._fields)
     if trace_id and version >= 2:
         frames.append(("trace_id", _str_frame(trace_id)))
+    if schedule_horizon > 0 and version >= 3:
+        frames.append(
+            ("schedule_horizon", np.array([schedule_horizon], "<i4"))
+        )
     return encode_frames(KIND_PLAN_REQUEST, frames, version=version)
 
 
@@ -320,12 +341,15 @@ def _check_tensor_fields(frames, dtypes, ranks, what):
 
 class PlanRequest(NamedTuple):
     """A fully-decoded plan request: its protocol version (the reply
-    mirrors it), tenant, problem tensors, and the optional trace ID."""
+    mirrors it), tenant, problem tensors, the optional trace ID, and
+    the optional drain-schedule horizon (0 = an ordinary single-plan
+    request; > 0 = answer with KIND_PLAN_SCHEDULE, wire v3)."""
 
     version: int
     tenant: str
     packed: object  # PackedCluster
     trace_id: str
+    schedule_horizon: int = 0
 
 
 def decode_plan_request(data: bytes):
@@ -352,6 +376,26 @@ def decode_plan_request_ex(data: bytes) -> PlanRequest:
     trace_id = ""
     if "trace_id" in frames:
         trace_id = _frame_str(frames["trace_id"], "trace id")
+    schedule_horizon = 0
+    if "schedule_horizon" in frames:
+        if version < 3:
+            # reject at DECODE (clean 400), not after a batch solve:
+            # only a v3 request may be answered with KIND_PLAN_SCHEDULE
+            # (the version-bump contract above), so a pre-v3 request
+            # carrying the frame is out of contract, and honoring it
+            # would burn a whole schedule solve only to fail at encode
+            raise WireError(
+                f"schedule_horizon frame requires wire version >= 3 "
+                f"(request is version {version})"
+            )
+        schedule_horizon = int(
+            _scalar(frames, "schedule_horizon", "<i4", "plan request")
+        )
+        if schedule_horizon < 1:
+            raise WireError(
+                f"plan request schedule_horizon {schedule_horizon} "
+                "must be >= 1 when present"
+            )
     t = _check_tensor_fields(frames, _PACKED_DTYPES, _PACKED_RANKS, "plan request")
     C, K, R = t["slot_req"].shape
     S = t["spot_free"].shape[0]
@@ -370,7 +414,9 @@ def decode_plan_request_ex(data: bytes) -> PlanRequest:
                 f"inconsistent with (C={C}, K={K}, S={S}, R={R}, W={W}, "
                 f"A={A}) — expected {shape}"
             )
-    return PlanRequest(version, tenant, PackedCluster(**t), trace_id)
+    return PlanRequest(
+        version, tenant, PackedCluster(**t), trace_id, schedule_horizon
+    )
 
 
 def encode_packed_delta(tenant: str, delta, version: Optional[int] = None) -> bytes:
@@ -513,6 +559,96 @@ def decode_plan_reply(data: bytes) -> PlanReply:
         batch_lanes=int(_scalar(frames, "batch_lanes", "<i4", "plan reply")),
         batch_tenants=int(
             _scalar(frames, "batch_tenants", "<i4", "plan reply")
+        ),
+        spans=_decode_reply_spans(frames),
+    )
+
+
+# ---------------------------------------------------------------------------
+# drain-schedule reply (wire v3)
+
+class PlanScheduleReply(NamedTuple):
+    """A whole drain schedule for one tenant (KIND_PLAN_SCHEDULE):
+    ``steps`` is the int32 ``[horizon, 3 + K]`` matrix the in-process
+    device fetch returns (per step ``idx | found | n_feasible | row``;
+    decode with ``solver/schedule.decode_schedule``), plus the same
+    batch telemetry and optional server-span block a PLAN_REPLY
+    carries. Only ever sent in answer to a version-3 request that
+    asked via ``schedule_horizon`` (the version-bump contract)."""
+
+    steps: np.ndarray  # int32 [H, 3 + K]
+    solve_ms: float
+    queue_wait_ms: float
+    batch_lanes: int
+    batch_tenants: int
+    spans: Tuple[Tuple[str, float, float], ...] = ()
+
+
+def encode_plan_schedule_reply(
+    reply: PlanScheduleReply, version: Optional[int] = None
+) -> bytes:
+    version = WIRE_VERSION if version is None else int(version)
+    if version < 3:
+        raise WireError(
+            f"KIND_PLAN_SCHEDULE requires wire version >= 3, got {version} "
+            "(a pre-v3 peer never asked for a schedule)"
+        )
+    steps = np.ascontiguousarray(np.asarray(reply.steps, "<i4"))
+    if steps.ndim != 2 or steps.shape[1] < 3:
+        raise WireError(
+            f"schedule steps matrix must be [H, 3+K], got {steps.shape}"
+        )
+    frames = [
+        ("steps", steps),
+        ("solve_ms", np.array([reply.solve_ms], "<f4")),
+        ("queue_wait_ms", np.array([reply.queue_wait_ms], "<f4")),
+        ("batch_lanes", np.array([reply.batch_lanes], "<i4")),
+        ("batch_tenants", np.array([reply.batch_tenants], "<i4")),
+    ]
+    if reply.spans:
+        names = [s[0] for s in reply.spans]
+        if any("\n" in n for n in names):
+            raise WireError("span names must not contain newlines")
+        frames.append(("span_names", _str_frame("\n".join(names))))
+        frames.append(
+            ("span_t0_ms", np.asarray([s[1] for s in reply.spans], "<f4"))
+        )
+        frames.append(
+            ("span_dur_ms", np.asarray([s[2] for s in reply.spans], "<f4"))
+        )
+    return encode_frames(KIND_PLAN_SCHEDULE, frames, version=version)
+
+
+def decode_plan_schedule_reply(data: bytes) -> PlanScheduleReply:
+    kind, frames = decode_frames(data)
+    if kind == KIND_ERROR:
+        raise WireError(
+            "service error: "
+            + _frame_str(frames.get("message", np.zeros(0, np.uint8)), "error")
+        )
+    if kind != KIND_PLAN_SCHEDULE:
+        raise WireError(f"expected PLAN_SCHEDULE, got kind {kind}")
+    steps = frames.get("steps")
+    if (
+        steps is None
+        or steps.dtype != np.dtype("<i4")
+        or steps.ndim != 2
+        or steps.shape[1] < 3
+    ):
+        raise WireError(
+            "plan schedule frame 'steps' missing or malformed"
+        )
+    return PlanScheduleReply(
+        steps=steps,
+        solve_ms=float(_scalar(frames, "solve_ms", "<f4", "plan schedule")),
+        queue_wait_ms=float(
+            _scalar(frames, "queue_wait_ms", "<f4", "plan schedule")
+        ),
+        batch_lanes=int(
+            _scalar(frames, "batch_lanes", "<i4", "plan schedule")
+        ),
+        batch_tenants=int(
+            _scalar(frames, "batch_tenants", "<i4", "plan schedule")
         ),
         spans=_decode_reply_spans(frames),
     )

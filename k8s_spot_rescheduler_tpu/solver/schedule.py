@@ -1,0 +1,284 @@
+"""Device-resident drain-to-exhaustion: one fetch, a whole schedule.
+
+The full-scale consolidation sweep was the biggest wall-clock number in
+the repo: 877 s to drain 856 nodes at config 3 (docs/RESULTS.md),
+because every drain decision round-tripped the host↔device tunnel
+(~65 ms RTT) while the device solve itself costs ~1.07 ms. The
+chain-depth protocol (bench/protocol.py) already proved 50
+data-dependent solves compose into one device program; this module is
+the production version of that proof: a ``lax.while_loop`` that runs
+the drain → commit → re-solve loop ON DEVICE —
+
+- solve the current pack with the same union program the fused planner
+  runs (first-fit ∪ best-fit ∪ repair, solver/fallback.py);
+- elect the first feasible candidate in drain-priority order (the
+  reference's loop policy, exactly ``solver/select.selection_vector``'s
+  argmax);
+- commit its evictees into the spot carry state (capacity depleted,
+  pod counts bumped, resident anti-affinity words OR-ed — the same
+  delta the scatter path applies between real ticks) and retire the
+  drained lane from the candidate set;
+- re-solve, until no candidate remains drainable or ``horizon`` steps
+  are recorded —
+
+and returns the whole drain *schedule* as ONE int32 matrix
+``[horizon, 3 + K]`` (per step: ``idx | found | n_feasible | row``,
+each row decoding exactly like ``solver/select.decode_selection``). The
+host pays ONE fetch per ``horizon`` drains instead of one per drain.
+
+Safety split (the proven-placement invariant is untouched): the device
+schedule is a *prediction* under the quiescent-cluster assumption. The
+execution layer (planner/schedule.py ``DrainSchedule``) re-packs the
+live mirror before EVERY executed step, re-proves the step's placement
+from scratch (solver/validate.py) against that live pack, and
+invalidates the schedule tail on any churn — a schedule can save
+fetches, never correctness.
+
+``plan_schedule_oracle`` is the host-side twin (the same loop over
+``solver/numpy_oracle.plan_union_oracle`` + ``commit_step_host``):
+``solver="numpy"`` runs it, the planner service's host batch path runs
+it per tenant, and tests pin the device matrix bit-identical to it.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import numpy as np
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+
+
+class ScheduleStep(NamedTuple):
+    """One decoded drain step: candidate lane + proven placement row in
+    the schedule's OWN (base-pack) index space."""
+
+    index: int
+    n_feasible: int
+    row: np.ndarray  # int32 [K]
+
+
+def schedule_matrix(solve_fn, packed: PackedCluster, horizon: int):
+    """Traced drain-to-exhaustion loop; returns int32 [horizon, 3+K].
+
+    ``solve_fn`` is a PackedCluster -> SolveResult union program (the
+    same one the fused planner wraps). The carry holds exactly the state
+    a committed drain changes — spot capacity/count/affinity words and
+    the candidate-validity mask — so each iteration re-solves the
+    cluster the PREVIOUS drain left behind, all on device. The terminal
+    probe (no candidate drainable) writes its ``found=0`` row too, so
+    the matrix is self-delimiting."""
+    import jax
+    import jax.numpy as jnp
+
+    C, K, _ = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    out0 = jnp.full((horizon, 3 + K), -1, jnp.int32)
+
+    def cond(carry):
+        step, done, _, _, _, _, _ = carry
+        return (step < horizon) & ~done
+
+    def body(carry):
+        step, _, cand_valid, free, count, aff, out = carry
+        cur = packed._replace(
+            cand_valid=cand_valid,
+            spot_free=free,
+            spot_count=count,
+            spot_aff=aff,
+        )
+        res = solve_fn(cur)
+        feasible = res.feasible & cand_valid
+        found = jnp.any(feasible)
+        # candidates are pre-sorted least-requested-first: argmax of the
+        # mask IS the reference's drain choice (select.selection_vector)
+        idx = jnp.argmax(feasible).astype(jnp.int32)
+        row = res.assignment[idx].astype(jnp.int32)  # [K]
+        # commit (masked no-op when nothing was found): evictees deplete
+        # spot capacity, bump pod counts, and land their anti-affinity
+        # words on their nodes; the drained lane leaves the candidate
+        # set (a drained-empty node packs cand_valid=False next tick)
+        placed = (row >= 0) & packed.slot_valid[idx] & found  # [K]
+        onehot = (jnp.arange(S, dtype=jnp.int32)[None, :] == row[:, None]) & (
+            placed[:, None]
+        )  # [K, S]
+        free = free - jnp.einsum(
+            "ks,kr->sr", onehot.astype(free.dtype), packed.slot_req[idx]
+        )
+        count = count + onehot.sum(axis=0).astype(count.dtype)
+        contrib = jnp.where(
+            onehot[:, :, None], packed.slot_aff[idx][:, None, :], jnp.uint32(0)
+        )  # [K, S, A]
+        aff = aff | jax.lax.reduce(
+            contrib, jnp.uint32(0), jax.lax.bitwise_or, (0,)
+        )
+        cand_valid = cand_valid & ~(
+            found & (jnp.arange(C, dtype=jnp.int32) == idx)
+        )
+        step_vec = jnp.concatenate(
+            [
+                jnp.where(found, idx, jnp.int32(-1))[None],
+                found.astype(jnp.int32)[None],
+                feasible.sum().astype(jnp.int32)[None],
+                jnp.where(found, row, jnp.int32(-1)),
+            ]
+        )
+        out = out.at[step].set(step_vec)
+        return (step + jnp.int32(1), ~found, cand_valid, free, count, aff, out)
+
+    init = (
+        jnp.int32(0),
+        jnp.asarray(False),
+        jnp.asarray(packed.cand_valid),
+        jnp.asarray(packed.spot_free),
+        jnp.asarray(packed.spot_count).astype(jnp.int32),
+        jnp.asarray(packed.spot_aff),
+        out0,
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    return final[6]
+
+
+def make_schedule_planner(solve_fn, horizon: int):
+    """Jit-wrap ``schedule_matrix`` at a fixed ``horizon`` (the horizon
+    is a compile-time shape decision — one compile per configured
+    value, stable across ticks). The input tensors are NOT donated: the
+    planner hands this program its device-resident cache, which must
+    survive for the next tick's delta diff."""
+    import jax
+
+    @jax.jit
+    def sched(packed):
+        return schedule_matrix(solve_fn, packed, horizon)
+
+    return sched
+
+
+def decode_schedule(mat) -> List[ScheduleStep]:
+    """The drain steps of one fetched schedule matrix, in execution
+    order — the prefix of rows with ``found=1`` (the device loop stops
+    at, and records, the first infeasible probe)."""
+    mat = np.asarray(mat)
+    steps: List[ScheduleStep] = []
+    for r in range(mat.shape[0]):
+        if mat[r, 1] != 1:
+            break
+        steps.append(
+            ScheduleStep(
+                index=int(mat[r, 0]),
+                n_feasible=int(mat[r, 2]),
+                row=np.asarray(mat[r, 3:], np.int32),
+            )
+        )
+    return steps
+
+
+def slice_lane(packed: PackedCluster, c: int) -> PackedCluster:
+    """A single-lane view (C=1) of ``packed`` — lanes are independent
+    fork copies, so slicing is exact. Shared by the schedule execution
+    handle's per-step validation (planner/schedule.py) and the
+    chain-depth analyzer (bench/chain_depth.py): one slicer, so a new
+    lane-indexed PackedCluster field cannot be missed in one copy."""
+    sl = slice(c, c + 1)
+    return packed._replace(
+        slot_req=packed.slot_req[sl],
+        slot_valid=packed.slot_valid[sl],
+        slot_tol=packed.slot_tol[sl],
+        slot_aff=packed.slot_aff[sl],
+        cand_valid=packed.cand_valid[sl],
+    )
+
+
+def commit_step_host(
+    packed: PackedCluster, idx: int, row: np.ndarray
+) -> PackedCluster:
+    """Host twin of the device commit: apply one drain step's placements
+    to the spot carry state and retire the drained lane. Exact in
+    float32 (requests are scaled integers < 2**24), so a committed pack
+    equals what a fresh pack of the post-drain cluster computes for the
+    same fields."""
+    free = np.array(packed.spot_free)
+    count = np.array(packed.spot_count)
+    aff = np.array(packed.spot_aff)
+    cand = np.array(packed.cand_valid)
+    row = np.asarray(row)
+    for k in range(min(len(row), packed.slot_req.shape[1])):
+        s = int(row[k])
+        if s < 0 or not packed.slot_valid[idx, k]:
+            continue
+        free[s] -= packed.slot_req[idx, k]
+        count[s] += 1
+        aff[s] |= packed.slot_aff[idx, k]
+    cand[idx] = False
+    return packed._replace(
+        spot_free=free, spot_count=count, spot_aff=aff, cand_valid=cand
+    )
+
+
+def plan_schedule_oracle(
+    packed: PackedCluster,
+    horizon: int,
+    *,
+    best_fit_fallback: bool = True,
+    repair_rounds: int = 8,
+) -> np.ndarray:
+    """Host-side drain-to-exhaustion schedule: the same loop over the
+    shared host union (solver/numpy_oracle.plan_union_oracle), emitting
+    the identical int32 [horizon, 3+K] matrix. The device program is
+    pinned bit-identical to this in tests/test_schedule.py."""
+    from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_union_oracle
+
+    C, K, _ = packed.slot_req.shape
+    out = np.full((horizon, 3 + K), -1, np.int32)
+    cur = packed
+    for step in range(horizon):
+        res = plan_union_oracle(
+            cur,
+            best_fit_fallback=best_fit_fallback,
+            repair_rounds=repair_rounds,
+        )
+        feasible = np.asarray(res.feasible) & np.asarray(cur.cand_valid)
+        out[step, 1] = 0
+        out[step, 2] = int(feasible.sum())
+        if not feasible.any():
+            break
+        idx = int(np.argmax(feasible))
+        row = np.asarray(res.assignment[idx], np.int32)
+        out[step, 0] = idx
+        out[step, 1] = 1
+        out[step, 3:] = row
+        cur = commit_step_host(cur, idx, row)
+    return out
+
+
+# Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
+# tools/analysis/jaxpr): the drain-to-exhaustion while-loop, traced at
+# MAX_SHAPES with the full repair union in the body — the index-width
+# pass vets the step/selection arithmetic and the dtype pass the carried
+# spot state at the 20x target shapes like every other hot program.
+from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
+    HotProgram,
+    packed_struct,
+)
+
+SCHEDULE_PROBE_HORIZON = 32
+
+
+def _schedule_build(s):
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_repair
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    return (
+        make_schedule_planner(
+            with_repair(plan_ffd, 8), SCHEDULE_PROBE_HORIZON
+        ),
+        (packed_struct(s),),
+    )
+
+
+HOT_PROGRAMS = {
+    "schedule.drain_to_exhaustion": HotProgram(
+        build=_schedule_build,
+        covers=("solver.schedule:make_schedule_planner.sched",),
+    ),
+}

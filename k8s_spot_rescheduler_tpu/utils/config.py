@@ -126,6 +126,21 @@ class ReschedulerConfig:
     incremental_device_cache: bool = True
     staged_chunk_lanes: int = 256
     staged_early_exit: bool = True
+    # Device-resident drain-to-exhaustion schedules (solver/schedule.py,
+    # planner/schedule.py): one device fetch returns a whole drain
+    # SCHEDULE (up to ``schedule_horizon`` steps) that the controller
+    # executes across ticks, each step re-packed, precondition-checked,
+    # and re-proven from scratch against the live mirror before any
+    # eviction — churn invalidates the schedule tail (counted +
+    # flight-evented) and forces a re-plan, never a wrong eviction.
+    # Planner fetches for a consolidation sweep drop from O(drains) to
+    # O(drains / horizon). Off by default: the per-tick single-plan
+    # path stays the shipped behavior; the consolidation benches and
+    # sched-smoke run with it on.
+    plan_schedule_enabled: bool = False
+    # Max drain steps per cut schedule (the device while-loop bound and
+    # the jit compile key; one compile per configured value).
+    schedule_horizon: int = 32
     # Persistent XLA compilation cache directory (``--jax-cache-dir``):
     # the solver programs cost seconds of cold compile per process
     # (~3.7 s at config-3 shapes, BENCH_r05); pointing this at a
@@ -262,6 +277,8 @@ class ReschedulerConfig:
             raise ValueError("max_drains_per_tick must be >= 1")
         if self.staged_chunk_lanes < 0:
             raise ValueError("staged_chunk_lanes must be >= 0 (0 = unstaged)")
+        if self.schedule_horizon < 1:
+            raise ValueError("schedule_horizon must be >= 1")
         if not self.resources:
             raise ValueError("resources must be non-empty")
         if self.kube_retry_max < 0:

@@ -69,6 +69,7 @@ SPAN_NAMES: Dict[str, str] = {
     "plan.pack": "host pack of the observation into problem tensors",
     "plan.delta-upload": "device-resident cache update (delta or repack)",
     "plan.solve": "the solve the tick actually waited on (fetch/oracle)",
+    "plan.schedule": "drain-to-exhaustion schedule cut: one fetch, H steps",
     # agent <-> service wire (service/agent.py)
     "wire.request": "full service round trip; server spans graft under it",
     "wire.transfer": "wire residual: round trip minus server-side spans",

@@ -276,7 +276,10 @@ GOLDEN_V1_REQUEST_HEAD_HEX = "4b53525701010c00060074656e616e74"
 # --- version-2 goldens (trace frames, ISSUE 9) ---
 # Same layouts, version byte 2, plus the OPTIONAL trace frames: a
 # trace_id frame on requests, span_names/span_t0_ms/span_dur_ms on
-# replies. Both with-and-without variants are pinned.
+# replies. Both with-and-without variants are pinned. Since the v3
+# bump these encode via an explicit version=2 — and must stay
+# BIT-IDENTICAL to what v2 builds shipped (the additive-bump proof,
+# same as the v1 goldens before them).
 GOLDEN_V2_REQUEST_SHA256 = (
     "3aa861318f26e7ff990d7ce07c5b8a62ce02d859dd77778656b987f1257e1b79"
 )
@@ -293,6 +296,31 @@ GOLDEN_V2_DELTA_SHA256 = (
     "b01e6863b442e508d38993e5969ae1b78b8b778df0c1a2d72afe9d208cf8c713"
 )
 GOLDEN_V2_REQUEST_HEAD_HEX = "4b53525702010c00060074656e616e74"
+
+# --- version-3 goldens (drain schedules, ISSUE 11) ---
+# Version byte 3, plus the OPTIONAL schedule_horizon request frame and
+# the NEW KIND_PLAN_SCHEDULE reply (steps matrix + batch telemetry +
+# the v2 span block). Present-and-absent variants of every optional
+# frame are pinned.
+GOLDEN_V3_REQUEST_SHA256 = (
+    "b712ab3b1d2cdd1298e5ea07113e1cce2de6032e1e94c8d5bc8683b46e7d30dc"
+)
+GOLDEN_V3_REQUEST_FULL_SHA256 = (  # trace_id AND schedule_horizon frames
+    "ddcafab75c9a084665b2bc208ae769efda438a1247e2dcac8560e00cd309768b"
+)
+GOLDEN_V3_SCHEDULE_SHA256 = (
+    "35bfc6df71550a4bec5c431e1357a9b4dcfd7fec6a375ae1a4a547c01af1e7ed"
+)
+GOLDEN_V3_SCHEDULE_SPANS_SHA256 = (
+    "a72e6ac3e63e88b6e480e021e60297250cdd5141371845a4da4abf01746d7588"
+)
+GOLDEN_V3_REPLY_SHA256 = (
+    "9b57cbabad125584d2b520c50666fd24fa9f71dee412e6a2136b808e73975509"
+)
+GOLDEN_V3_DELTA_SHA256 = (
+    "c129254a3d290488f6ddbc257bcc2d1a55461792cc2eb91134ad8abd65b59e30"
+)
+GOLDEN_V3_REQUEST_HEAD_HEX = "4b53525703010c00060074656e616e74"
 
 GOLDEN_TRACE_ID = "00f1e2d3c4b5a697"
 GOLDEN_SPANS = (
@@ -362,6 +390,27 @@ def _golden_reply():
     )
 
 
+def _golden_schedule_reply(spans=()):
+    import numpy as np
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    # 3 steps of a K=3 problem: two drains then the terminal found=0
+    # probe (the self-delimiting matrix solver/schedule.py emits)
+    steps = np.array(
+        [
+            [1, 1, 2, 0, 1, -1],
+            [0, 1, 1, 1, -1, -1],
+            [-1, 0, 0, -1, -1, -1],
+        ],
+        "<i4",
+    )
+    return wire.PlanScheduleReply(
+        steps=steps, solve_ms=2.5, queue_wait_ms=3.5,
+        batch_lanes=24, batch_tenants=3, spans=spans,
+    )
+
+
 def test_wire_protocol_byte_golden_v1():
     """Version-1 encodings are pinned to the digests version-1-only
     builds shipped — the v2 bump changed NOTHING about what an old
@@ -390,34 +439,86 @@ def test_wire_protocol_byte_golden_v1():
 
 
 def test_wire_protocol_byte_golden_v2():
-    """The current-version encodings, pinned with the trace frames both
-    absent and present: any layout change breaks this test and must
-    ship with a WIRE_VERSION decision (bump on meaning change, golden
-    refresh always)."""
+    """Version-2 encodings stay pinned to the digests v2 builds
+    shipped — like the v1 goldens, the strongest proof the v3 bump is
+    purely additive on the wire for an un-upgraded peer."""
     import hashlib
 
     from k8s_spot_rescheduler_tpu.service import wire
 
-    assert wire.WIRE_VERSION == 2  # bumping? update every digest below
-    req = wire.encode_plan_request("golden-tenant", _golden_packed())
+    assert 2 in wire.SUPPORTED_VERSIONS
+    req = wire.encode_plan_request(
+        "golden-tenant", _golden_packed(), version=2
+    )
     assert hashlib.sha256(req).hexdigest() == GOLDEN_V2_REQUEST_SHA256
     assert req[:16].hex() == GOLDEN_V2_REQUEST_HEAD_HEX
     req_t = wire.encode_plan_request(
-        "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID
+        "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID,
+        version=2,
     )
     assert (
         hashlib.sha256(req_t).hexdigest() == GOLDEN_V2_REQUEST_TRACE_SHA256
     )
-    delta = wire.encode_packed_delta("golden-tenant", _golden_delta())
+    # a schedule horizon handed to a v2 encode is DROPPED, not
+    # smuggled: the bytes stay exactly the shipped v2 protocol
+    req_h = wire.encode_plan_request(
+        "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID,
+        version=2, schedule_horizon=3,
+    )
+    assert (
+        hashlib.sha256(req_h).hexdigest() == GOLDEN_V2_REQUEST_TRACE_SHA256
+    )
+    delta = wire.encode_packed_delta(
+        "golden-tenant", _golden_delta(), version=2
+    )
     assert hashlib.sha256(delta).hexdigest() == GOLDEN_V2_DELTA_SHA256
-    reply = wire.encode_plan_reply(_golden_reply())
+    reply = wire.encode_plan_reply(_golden_reply(), version=2)
     assert hashlib.sha256(reply).hexdigest() == GOLDEN_V2_REPLY_SHA256
     reply_s = wire.encode_plan_reply(
-        _golden_reply()._replace(spans=GOLDEN_SPANS)
+        _golden_reply()._replace(spans=GOLDEN_SPANS), version=2
     )
     assert (
         hashlib.sha256(reply_s).hexdigest() == GOLDEN_V2_REPLY_SPANS_SHA256
     )
+
+
+def test_wire_protocol_byte_golden_v3():
+    """The current-version encodings, pinned with every optional frame
+    both absent and present — the schedule_horizon request frame and
+    the KIND_PLAN_SCHEDULE reply included: any layout change breaks
+    this test and must ship with a WIRE_VERSION decision (bump on
+    meaning change, golden refresh always)."""
+    import hashlib
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    assert wire.WIRE_VERSION == 3  # bumping? update every digest below
+    req = wire.encode_plan_request("golden-tenant", _golden_packed())
+    assert hashlib.sha256(req).hexdigest() == GOLDEN_V3_REQUEST_SHA256
+    assert req[:16].hex() == GOLDEN_V3_REQUEST_HEAD_HEX
+    req_full = wire.encode_plan_request(
+        "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID,
+        schedule_horizon=3,
+    )
+    assert (
+        hashlib.sha256(req_full).hexdigest() == GOLDEN_V3_REQUEST_FULL_SHA256
+    )
+    delta = wire.encode_packed_delta("golden-tenant", _golden_delta())
+    assert hashlib.sha256(delta).hexdigest() == GOLDEN_V3_DELTA_SHA256
+    reply = wire.encode_plan_reply(_golden_reply())
+    assert hashlib.sha256(reply).hexdigest() == GOLDEN_V3_REPLY_SHA256
+    sched = wire.encode_plan_schedule_reply(_golden_schedule_reply())
+    assert hashlib.sha256(sched).hexdigest() == GOLDEN_V3_SCHEDULE_SHA256
+    sched_s = wire.encode_plan_schedule_reply(
+        _golden_schedule_reply(GOLDEN_SPANS)
+    )
+    assert (
+        hashlib.sha256(sched_s).hexdigest() == GOLDEN_V3_SCHEDULE_SPANS_SHA256
+    )
+    # a schedule reply cannot be downgraded below v3: a pre-v3 peer
+    # never asked for one, so encoding one for it is a caller bug
+    with pytest.raises(wire.WireError):
+        wire.encode_plan_schedule_reply(_golden_schedule_reply(), version=2)
 
 
 def test_wire_protocol_roundtrip():
@@ -472,6 +573,30 @@ def test_wire_protocol_roundtrip():
     for got, want in zip(sdec.spans, GOLDEN_SPANS):
         assert got[1] == pytest.approx(want[1], abs=1e-4)
         assert got[2] == pytest.approx(want[2], abs=1e-4)
+
+    # the v3 schedule request + reply round-trip: horizon frame decoded,
+    # steps matrix bit-identical, span block intact
+    req_h = wire.decode_plan_request_ex(
+        wire.encode_plan_request(
+            "golden-tenant", packed, trace_id=GOLDEN_TRACE_ID,
+            schedule_horizon=5,
+        )
+    )
+    assert req_h.schedule_horizon == 5
+    assert req_h.trace_id == GOLDEN_TRACE_ID
+    sched = _golden_schedule_reply(GOLDEN_SPANS)
+    sched_dec = wire.decode_plan_schedule_reply(
+        wire.encode_plan_schedule_reply(sched)
+    )
+    np.testing.assert_array_equal(sched_dec.steps, sched.steps)
+    assert sched_dec.batch_lanes == sched.batch_lanes
+    assert sched_dec.batch_tenants == sched.batch_tenants
+    assert [s[0] for s in sched_dec.spans] == [s[0] for s in GOLDEN_SPANS]
+    # the decoded steps feed the same decoder the in-process fetch uses
+    from k8s_spot_rescheduler_tpu.solver.schedule import decode_schedule
+
+    steps = decode_schedule(sched_dec.steps)
+    assert [s.index for s in steps] == [1, 0]
 
 
 def test_wire_unknown_version_is_typed_error():
@@ -558,6 +683,20 @@ def test_wire_malformed_inputs_are_typed_errors():
     # a reply is not a request
     with pytest.raises(wire.WireError):
         wire.decode_plan_request(wire.encode_plan_reply(_golden_reply()))
+    # a pre-v3 request smuggling a schedule_horizon frame is refused at
+    # DECODE (clean 400) — only a v3 request may be answered with
+    # KIND_PLAN_SCHEDULE, and honoring the frame would burn a whole
+    # schedule batch solve only to fail at encode
+    frames = [("tenant", np.frombuffer(b"t", np.uint8))]
+    packed = _golden_packed()
+    frames.extend((f, getattr(packed, f)) for f in packed._fields)
+    frames.append(("schedule_horizon", np.array([4], "<i4")))
+    smuggled = wire.encode_frames(wire.KIND_PLAN_REQUEST, frames, version=2)
+    with pytest.raises(wire.WireError):
+        wire.decode_plan_request_ex(smuggled)
+    # the same frame on a v3 request decodes fine
+    ok = wire.encode_frames(wire.KIND_PLAN_REQUEST, frames, version=3)
+    assert wire.decode_plan_request_ex(ok).schedule_horizon == 4
 
 
 def test_wire_fuzz_corpus_typed_errors_only():
@@ -586,6 +725,10 @@ def test_wire_fuzz_corpus_typed_errors_only():
          wire.encode_plan_reply(_golden_reply()._replace(
              spans=GOLDEN_SPANS
          ))),
+        ("schedule", wire.decode_plan_schedule_reply,
+         wire.encode_plan_schedule_reply(
+             _golden_schedule_reply(GOLDEN_SPANS)
+         )),
         ("error", wire.decode_plan_reply, wire.encode_error("boom")),
     ]
     rng = random.Random(0xF1EE7)
