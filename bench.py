@@ -1049,7 +1049,82 @@ def serve_smoke(n_tenants: int = 4, seed: int = 0) -> dict:
         t.start()
     for t in threads:
         t.join()
+
+    # --- delta-wire steady state (wire v4): the O(churn) acceptance ---
+    # tick 1 above was first contact (full packs). Tick 2 ships ZERO
+    # churn — every agent's upload must be a fixed-size empty delta,
+    # not a pack. Tick 3 ships small churn (one pod removed per
+    # tenant) — bytes proportional to it. Tick 4 is a FORCED resync
+    # (tenant cache invalidated server-side): exactly one resync per
+    # agent, full-pack bytes again, and still the right selections.
+    def ingest_bytes():
+        return metrics.service_snapshot()["wire_ingest_bytes"]
+
+    def delta_counts():
+        d = metrics.service_snapshot()["delta_requests"]
+        return d.get("applied", 0), d.get("resync", 0)
+
+    def fleet_tick():
+        ticked = [None] * n_tenants
+        gate = threading.Barrier(n_tenants)
+
+        def run(i):
+            store, pdbs = tenants[i]
+            gate.wait()
+            ticked[i] = agents[i].plan(store, pdbs)
+
+        ts = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(n_tenants)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return ticked
+
+    def check_tick(note, reports, bad):
+        for i, report in enumerate(reports):
+            store, pdbs = tenants[i]
+            want = selection(solo.plan(store, pdbs))
+            got = selection(report)
+            if got != want or report.solver != "remote":
+                bad.append(
+                    {"tick": note, "tenant": i, "solo": want,
+                     "served": got, "solver": report.solver}
+                )
+
+    delta_bad: list = []
+    full_tick_bytes = ingest_bytes() - before.get("wire_ingest_bytes", 0)
+    b0 = ingest_bytes()
+    check_tick("quiet", fleet_tick(), delta_bad)
+    quiet_tick_bytes = ingest_bytes() - b0
+    quiet_cobatch = metrics.service_snapshot()["batch_tenants"]
+    for i in range(n_tenants):  # small churn: one pod per tenant
+        store = tenants[i][0]
+        store.remove_pod(next(iter(store._pod_row)))
+    b1 = ingest_bytes()
+    check_tick("churn", fleet_tick(), delta_bad)
+    churn_tick_bytes = ingest_bytes() - b1
+    applied_before_resync, resyncs_before = delta_counts()
+    server.service.invalidate_tenant_cache()
+    b2 = ingest_bytes()
+    check_tick("forced-resync", fleet_tick(), delta_bad)
+    resync_tick_bytes = ingest_bytes() - b2
+    applied_total, resyncs_total = delta_counts()
+    forced_resyncs = resyncs_total - resyncs_before
     server.close()
+
+    # the wire claim, measured: a zero-churn tick ships fixed-size
+    # headers (not packs), churn ticks ship O(churn), and only first
+    # contact / forced resyncs pay full-pack bytes
+    wire_ok = (
+        quiet_tick_bytes < n_tenants * 2048
+        and 0 < churn_tick_bytes < 0.5 * full_tick_bytes
+        and resync_tick_bytes > 0.9 * full_tick_bytes
+        and forced_resyncs == n_tenants
+        and quiet_cobatch >= 2  # delta ticks still co-batch
+    )
 
     after = metrics.service_snapshot()
     mismatches = []
@@ -1088,12 +1163,33 @@ def serve_smoke(n_tenants: int = 4, seed: int = 0) -> dict:
             trace_bad.append({"tenant": i, "missing": sorted(missing)})
     ok = (
         not mismatches and fallbacks == 0 and cobatched and lanes_prove
-        and not trace_bad
+        and not trace_bad and wire_ok and not delta_bad
     )
+    applied = after["delta_requests"].get("applied", 0) - before.get(
+        "delta_requests", {}
+    ).get("applied", 0)
+    resyncs = after["delta_requests"].get("resync", 0) - before.get(
+        "delta_requests", {}
+    ).get("resync", 0)
     return {
         "ok": ok,
         "n_tenants": n_tenants,
         "serve_ms": round(float(np.median(times)), 2),
+        # the wire-anti-entropy accounting (delta phases, wire v4)
+        "full_tick_bytes": int(full_tick_bytes),
+        "quiet_tick_bytes": int(quiet_tick_bytes),
+        "churn_tick_bytes": int(churn_tick_bytes),
+        "resync_tick_bytes": int(resync_tick_bytes),
+        "wire_bytes_per_tick": int(
+            np.median([quiet_tick_bytes, churn_tick_bytes])
+        ),
+        "delta_applied": int(applied),
+        "delta_resyncs": int(resyncs),
+        "cache_hit_rate": round(
+            applied / max(1.0, applied + resyncs), 3
+        ),
+        "delta_mismatches": delta_bad,
+        "wire_ok": wire_ok,
         "batch_tenants_max": int(after["batch_tenants_max"]),
         "batch_lanes_max": int(after["batch_lanes_max"]),
         "batch_occupancy": round(
@@ -1124,7 +1220,16 @@ def run_serve_smoke(args, metric: str, unit: str) -> int:
 
     jax.config.update("jax_platforms", "cpu")
     result = serve_smoke(n_tenants=max(4, args.tenants), seed=args.seed)
-    fail_detail = result["mismatches"] or result["trace_violations"]
+    fail_detail = (
+        result["mismatches"] or result["trace_violations"]
+        or result["delta_mismatches"]
+        or {
+            k: result[k]
+            for k in ("full_tick_bytes", "quiet_tick_bytes",
+                      "churn_tick_bytes", "resync_tick_bytes",
+                      "delta_resyncs")
+        }
+    )
     print(
         f"serve-smoke: {result['n_tenants']} tenants  "
         f"serve_ms={result['serve_ms']}  "
@@ -1132,6 +1237,11 @@ def run_serve_smoke(args, metric: str, unit: str) -> int:
         f"batch_lanes_max={result['batch_lanes_max']} "
         f"(solo max {result['solo_lanes_max']})  "
         f"fallbacks={result['remote_fallbacks']}  "
+        f"wire bytes full={result['full_tick_bytes']} "
+        f"quiet={result['quiet_tick_bytes']} "
+        f"churn={result['churn_tick_bytes']} "
+        f"resync={result['resync_tick_bytes']}  "
+        f"cache_hit={result['cache_hit_rate']}  "
         f"spans queue={result['span_queue_ms']} "
         f"solve={result['span_solve_ms']} wire={result['span_wire_ms']} ms  "
         f"-> {'OK' if result['ok'] else 'FAIL: %s' % fail_detail}",
@@ -1148,6 +1258,14 @@ def run_serve_smoke(args, metric: str, unit: str) -> int:
             "batch_tenants_max": result["batch_tenants_max"],
             "batch_lanes_max": result["batch_lanes_max"],
             "remote_fallbacks": result["remote_fallbacks"],
+            # the delta-wire accounting (wire v4): steady-state bytes
+            # per tick are O(churn); full packs only on first contact
+            # and forced resyncs
+            "wire_bytes_per_tick": result["wire_bytes_per_tick"],
+            "full_tick_bytes": result["full_tick_bytes"],
+            "quiet_tick_bytes": result["quiet_tick_bytes"],
+            "delta_resyncs": result["delta_resyncs"],
+            "cache_hit_rate": result["cache_hit_rate"],
             # the cross-process span breakdown (grafted traces): where
             # the tunnel-RTT-bound milliseconds actually go
             "span_queue_ms": result["span_queue_ms"],
@@ -1620,9 +1738,30 @@ def fleet_chaos_smoke(n_agents: int = 4, seed: int = 0) -> dict:
         clock.advance(3.0)  # the virtual housekeeping interval
         return walls
 
+    def delta_resyncs():
+        return metrics.service_snapshot()["delta_requests"].get("resync", 0)
+
     # --- phase 1: healthy warmup (calibrates the watchdog baseline) ---
     for _ in range(6):
         fleet_tick("healthy")
+
+    # --- phase 1.5: corrupted delta — replica A bit-flips every
+    # request body ahead of the decode. The agents ship deltas by now
+    # (tick 2 on); a corrupted delta must fail its integrity digest
+    # and come back as a typed RESYNC DEMAND (flight delta == metric
+    # delta, asserted at the end), the same-tick full-pack retry is
+    # ALSO corrupted (rate 1.0) so the agent fails over to B — and
+    # every selection stays bit-identical to the solo plan. Never a
+    # wrong plan from corrupt bytes.
+    svc_a = replica_a.service
+    svc_a.chaos = ServiceChaos(
+        ServiceFaultPlan(seed=seed, request_corrupt_rate=1.0),
+        clock=clock,
+    )
+    resyncs_before_corrupt = delta_resyncs()
+    fleet_tick("corrupt-delta")
+    svc_a.chaos = None
+    corrupt_resyncs = delta_resyncs() - resyncs_before_corrupt
 
     # --- phase 2: wire/HTTP chaos on every agent transport ---
     for chaos in chaos_transports:
@@ -1692,15 +1831,20 @@ def fleet_chaos_smoke(n_agents: int = 4, seed: int = 0) -> dict:
     failover_metric = (
         m1["remote_planner_failover"] - m0["remote_planner_failover"]
     )
+    resync_metric = m1["delta_requests"].get("resync", 0) - m0.get(
+        "delta_requests", {}
+    ).get("resync", 0)
     flight_eq_metrics = (
         fdelta("remote-planner-fallback") == fallback_metric
         and fdelta("failover") == failover_metric
         and fdelta("device-sick") == 1
         and fdelta("device-recovered") == 1
+        and fdelta("delta-resync") == resync_metric
     )
     ok = (
         not crashes
         and not mismatches
+        and corrupt_resyncs >= 1
         and sick_detect_ticks is not None
         and sick_snapshot.get("device") == "sick"
         and sick_gauge_during == 1.0
@@ -1731,8 +1875,11 @@ def fleet_chaos_smoke(n_agents: int = 4, seed: int = 0) -> dict:
         "flight_deltas": {
             k: fdelta(k)
             for k in ("remote-planner-fallback", "failover",
-                      "device-sick", "device-recovered", "service-shed")
+                      "device-sick", "device-recovered", "service-shed",
+                      "delta-resync")
         },
+        "corrupt_resyncs": int(corrupt_resyncs),
+        "delta_resyncs": int(resync_metric),
         "warmed_buckets": warmed,
         "primary_back": primary_back,
         "device_end_state": end_snapshot.get("device"),
@@ -1758,6 +1905,8 @@ def run_fleet_chaos(args, metric: str, unit: str) -> int:
         f"failovers={result['failovers']} "
         f"(median {result['failover_ms']} ms)  "
         f"fallbacks={result['fallbacks']}  "
+        f"resyncs={result['delta_resyncs']} "
+        f"(corrupt phase {result['corrupt_resyncs']})  "
         f"warmed={result['warmed_buckets']}  "
         f"flight==metrics: {result['flight_eq_metrics']}  "
         f"-> {'OK' if result['ok'] else 'FAIL: %s' % detail}",
@@ -1776,6 +1925,8 @@ def run_fleet_chaos(args, metric: str, unit: str) -> int:
             "recovered_after_ticks": result["recovered_after_ticks"],
             "failovers": result["failovers"],
             "fallbacks": result["fallbacks"],
+            "delta_resyncs": result["delta_resyncs"],
+            "corrupt_resyncs": result["corrupt_resyncs"],
             "flight_eq_metrics": result["flight_eq_metrics"],
             "warmed_buckets": len(result["warmed_buckets"]),
             "ok": result["ok"],

@@ -199,6 +199,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-plan HTTP deadline of the agent's planner-"
                         "service call; past it the tick plans locally "
                         "(Go duration)")
+    p.add_argument("--delta-wire-enabled", type=_bool,
+                   default=d.delta_wire_enabled,
+                   help="ship each tick's churn-proportional delta to "
+                        "the planner service instead of the full pack "
+                        "(wire v4, fingerprinted per endpoint); the "
+                        "service resyncs with one full pack on restart/"
+                        "eviction/mismatch/corruption — resync-on-"
+                        "anything, never a wrong plan (false = full "
+                        "packs every tick)")
     p.add_argument("--device-sick-threshold", type=int,
                    default=d.device_sick_threshold,
                    help="--serve mode: consecutive slower-than-baseline "
@@ -369,6 +378,7 @@ def config_from_args(args) -> ReschedulerConfig:
         planner_url=args.planner_url,
         planner_urls=args.planner_urls,
         planner_timeout=parse_duration(args.planner_timeout),
+        delta_wire_enabled=args.delta_wire_enabled,
         service_batch_window=parse_duration(args.service_batch_window),
         service_queue_timeout=parse_duration(args.service_queue_timeout),
         device_sick_threshold=args.device_sick_threshold,

@@ -54,6 +54,7 @@ DEGRADATION_KINDS = frozenset({
     "device-sick",             # watchdog flipped the service host-side
     "failover",                # served by a non-primary planner endpoint
     "schedule-invalidated",    # churn broke a drain-schedule prediction
+    "delta-resync",            # delta base unusable -> full-pack resync
 })
 CONTEXT_KINDS = frozenset({
     "orphan-taint-recovered",

@@ -420,6 +420,37 @@ remote_planner_failover = Counter(
     namespace=NAMESPACE,
 )
 
+service_delta_requests = Counter(
+    "service_delta_requests",
+    "Delta-shipping plan requests (wire v4 KIND_PACKED_DELTA) by "
+    "outcome: applied (the base fingerprint matched the cached tenant "
+    "state and the churn scattered into it before the batch solve), "
+    "resync (the service demanded one full-pack resync — restart, "
+    "cache eviction, fingerprint mismatch, or any decode/apply "
+    "anomaly; the agent's next upload is a full pack, never a wrong "
+    "plan). Flight recorder kind: delta-resync, same sites.",
+    ["outcome"],
+    namespace=NAMESPACE,
+)
+
+service_wire_ingest_bytes = Counter(
+    "service_wire_ingest_bytes",
+    "Request-body bytes the planner service ingested on /v2/plan "
+    "(full packs and deltas alike) — the fleet-scale ceiling the delta "
+    "wire exists to lower: steady state this grows O(churn) per tick "
+    "per tenant, with full-pack-sized jumps only on first contact and "
+    "forced resyncs (serve-smoke asserts it).",
+    namespace=NAMESPACE,
+)
+
+service_tenant_cache = Gauge(
+    "service_tenant_cache_entries",
+    "Tenants with device/host-resident packed state cached for the "
+    "delta wire (pruned with the tenant-state TTL and hard-capped; an "
+    "evicted tenant's next delta is answered with a resync demand).",
+    namespace=NAMESPACE,
+)
+
 service_device_sick = Gauge(
     "service_device_sick",
     "1 while the planner service's device-health watchdog "
@@ -637,6 +668,21 @@ def update_service_device_sick(sick: bool) -> None:
     service_device_sick.set(1 if sick else 0)
 
 
+def update_service_delta(outcome: str) -> None:
+    """One delta request resolved: ``applied`` or ``resync`` (the
+    resync site also fires the flight ``delta-resync`` event — keep
+    the two surfaces firing from the same call site)."""
+    service_delta_requests.labels(outcome).inc()
+
+
+def update_service_wire_ingest(nbytes: int) -> None:
+    service_wire_ingest_bytes.inc(max(0, int(nbytes)))
+
+
+def update_service_tenant_cache(entries: int) -> None:
+    service_tenant_cache.set(int(entries))
+
+
 def service_snapshot() -> dict:
     """Service/agent counters via the public collect() API (tests and
     the serve-smoke harness diff before/after), plus the run's batch
@@ -653,6 +699,13 @@ def service_snapshot() -> dict:
     device_sick = 0.0
     for sample in service_device_sick.collect()[0].samples:
         device_sick = sample.value
+    delta_by_outcome = {}
+    for sample in service_delta_requests.collect()[0].samples:
+        if sample.name.endswith("_total"):
+            delta_by_outcome[sample.labels.get("outcome", "")] = sample.value
+    cache_entries = 0.0
+    for sample in service_tenant_cache.collect()[0].samples:
+        cache_entries = sample.value
     return {
         "requests": by_outcome,
         "batch_lanes": lanes,
@@ -663,6 +716,9 @@ def service_snapshot() -> dict:
         "remote_planner_fallback": _counter_value(remote_planner_fallback),
         "remote_planner_failover": _counter_value(remote_planner_failover),
         "device_sick": device_sick,
+        "delta_requests": delta_by_outcome,
+        "wire_ingest_bytes": _counter_value(service_wire_ingest_bytes),
+        "tenant_cache_entries": cache_entries,
     }
 
 

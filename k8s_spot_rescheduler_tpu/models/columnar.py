@@ -2011,7 +2011,11 @@ def emit_packed_delta(prev: PackedCluster, new: PackedCluster):
 def apply_packed_delta(packed: PackedCluster, delta: PackedDelta) -> PackedCluster:
     """Host-side reference application of a delta (the device path in
     ``planner/solver_planner.py`` mirrors this with a donated-buffer
-    scatter program; both must agree bit-for-bit with the full pack)."""
+    scatter program; both must agree bit-for-bit with the full pack).
+    The planner service's tenant cache applies deltas with its own
+    in-place variant (``PlannerService._apply_delta_host`` — the cached
+    state is bucket-padded, so the lane slabs scatter at the delta's
+    own K into the wider arrays)."""
 
     def upd(arr, idx, vals):
         out = arr.copy()
@@ -2032,4 +2036,133 @@ def apply_packed_delta(packed: PackedCluster, delta: PackedDelta) -> PackedClust
         spot_taints=upd(packed.spot_taints, delta.spot_rows, delta.spot_taints),
         spot_ok=upd(packed.spot_ok, delta.spot_rows, delta.spot_ok),
         spot_aff=upd(packed.spot_aff, delta.spot_rows, delta.spot_aff),
+    )
+
+
+def update_tensor_digest(h, name: str, arr) -> None:
+    """Feed one named tensor into a running sha256: field name, shape,
+    and little-endian contiguous bytes. THE canonical tensor-hash step
+    of the delta wire's anti-entropy protocol — shared by
+    :func:`pack_fingerprint` and the wire integrity digest
+    (service/wire.delta_digest). Both sides of the protocol must hash
+    bit-identically forever; change this in one place only."""
+    arr = np.asarray(arr)
+    if arr.dtype.byteorder == ">":
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    h.update(name.encode())
+    h.update(str(arr.shape).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+
+
+def pack_fingerprint(packed) -> str:
+    """Content fingerprint of a packed tensor set: sha256 over every
+    field's shape, dtype and little-endian bytes. The anti-entropy key
+    of the delta wire (service/wire.py v4): an agent's delta names the
+    fingerprint of the pack it diffs FROM, the service applies it only
+    when its cached tenant state carries that exact fingerprint, and
+    any disagreement — restart, eviction, a missed tick — degrades to
+    one full-pack resync, never a wrong plan. Content-addressed, so
+    the check is correct regardless of how either side got there."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for f in type(packed)._fields:
+        update_tensor_digest(h, f, getattr(packed, f))
+    return h.hexdigest()
+
+
+def pad_pow2(n: int) -> int:
+    """Pad delta sections to power-of-two lengths so the donated
+    scatter programs compile O(log(max churn)) times, not per tick —
+    shared by the in-process planner's device cache and the planner
+    service's batched tenant scatter."""
+    return 8 if n <= 8 else 1 << (n - 1).bit_length()
+
+
+def pad_packed_delta(
+    delta: PackedDelta,
+    C: int,
+    S: int,
+    *,
+    lane_rows: int = 0,
+    cand_rows: int = 0,
+    spot_rows: int = 0,
+    K: int = 0,
+) -> PackedDelta:
+    """Pad each delta section to a power-of-two length (or the given
+    explicit row counts — the service pads a whole batch's deltas to
+    one shared shape); index pads point one past the axis end and are
+    dropped by the ``mode="drop"`` scatters. ``K`` > the slab width
+    additionally zero-pads the lane slabs' slot axis — a delta shipped
+    at the agent's K scatters into a bucket-padded cached state whose
+    pad slot columns are zeros, and zero-padding the slab writes the
+    exact same zeros there."""
+
+    def idx(a, oob, rows):
+        out = np.full(rows or pad_pow2(len(a)), oob, np.int32)
+        out[: len(a)] = a
+        return out
+
+    def data(a, rows):
+        out = np.zeros(
+            (rows or pad_pow2(a.shape[0]),) + a.shape[1:], a.dtype
+        )
+        out[: a.shape[0]] = a
+        return out
+
+    def slab(a, rows):
+        out = np.zeros(
+            (rows or pad_pow2(a.shape[0]), max(K, a.shape[1]))
+            + a.shape[2:],
+            a.dtype,
+        )
+        out[: a.shape[0], : a.shape[1]] = a
+        return out
+
+    return PackedDelta(
+        lanes=idx(delta.lanes, C, lane_rows),
+        lane_slot_req=slab(delta.lane_slot_req, lane_rows),
+        lane_slot_valid=slab(delta.lane_slot_valid, lane_rows),
+        lane_slot_tol=slab(delta.lane_slot_tol, lane_rows),
+        lane_slot_aff=slab(delta.lane_slot_aff, lane_rows),
+        cand_rows=idx(delta.cand_rows, C, cand_rows),
+        cand_valid=data(delta.cand_valid, cand_rows),
+        spot_rows=idx(delta.spot_rows, S, spot_rows),
+        spot_free=data(delta.spot_free, spot_rows),
+        spot_count=data(delta.spot_count, spot_rows),
+        spot_max_pods=data(delta.spot_max_pods, spot_rows),
+        spot_taints=data(delta.spot_taints, spot_rows),
+        spot_ok=data(delta.spot_ok, spot_rows),
+        spot_aff=data(delta.spot_aff, spot_rows),
+    )
+
+
+def empty_packed_delta(packed_or_delta) -> PackedDelta:
+    """An all-empty delta at another pack/delta's trailing dims — the
+    inert scatter a full-pack tenant rides in a mixed batch (every
+    index section pads to out-of-bounds no-ops)."""
+    src = packed_or_delta
+    if isinstance(src, PackedDelta):
+        K, R = src.lane_slot_req.shape[1:3]
+        W = src.lane_slot_tol.shape[2]
+        A = src.lane_slot_aff.shape[2]
+    else:
+        _, K, R = src.slot_req.shape
+        W = src.spot_taints.shape[1]
+        A = src.spot_aff.shape[1]
+    return PackedDelta(
+        lanes=np.zeros(0, np.int32),
+        lane_slot_req=np.zeros((0, K, R), np.float32),
+        lane_slot_valid=np.zeros((0, K), bool),
+        lane_slot_tol=np.zeros((0, K, W), np.uint32),
+        lane_slot_aff=np.zeros((0, K, A), np.uint32),
+        cand_rows=np.zeros(0, np.int32),
+        cand_valid=np.zeros(0, bool),
+        spot_rows=np.zeros(0, np.int32),
+        spot_free=np.zeros((0, R), np.float32),
+        spot_count=np.zeros(0, np.int32),
+        spot_max_pods=np.zeros(0, np.int32),
+        spot_taints=np.zeros((0, W), np.uint32),
+        spot_ok=np.zeros(0, bool),
+        spot_aff=np.zeros((0, A), np.uint32),
     )

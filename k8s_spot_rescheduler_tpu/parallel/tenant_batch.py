@@ -96,6 +96,7 @@ def plan_tenants_batched(
 
 
 def plan_tenants_scheduled(
+    mesh: Mesh | None,
     stacked: PackedCluster,
     *,
     horizon: int,
@@ -108,10 +109,13 @@ def plan_tenants_scheduled(
     The drain-to-exhaustion while-loop (solver/schedule.py) vmaps over
     the tenant axis exactly like the single-plan program: tenants never
     interact, so under vmap the loop runs until the LAST tenant
-    exhausts with the finished tenants' lanes masked no-ops. Schedule
-    batches are rare by construction (one per ``horizon`` drains per
-    tenant), so this first version stays single-device vmap — the
-    tenant-mesh sharding the single-plan batch uses is future work."""
+    exhausts with the finished tenants' lanes masked no-ops. On a
+    multi-device mesh the tenant axis shards over the devices exactly
+    like the single-plan batch (zero collectives — each device runs
+    its block's while-loop independently, so the wall clock is the
+    slowest BLOCK, not the slowest tenant times T); the service pads
+    the tenant axis to a device multiple with all-invalid problems,
+    the same inert padding the single-plan batch uses."""
     from k8s_spot_rescheduler_tpu.solver.fallback import (
         with_best_fit_fallback,
         with_repair,
@@ -129,10 +133,30 @@ def plan_tenants_scheduled(
     def tenant_sched(p):
         return schedule_matrix(solve, p, horizon)
 
-    return jax.vmap(tenant_sched)(stacked)
+    T = stacked.slot_req.shape[0]
+    n = mesh.devices.size if mesh is not None else 1
+    if n <= 1 or T % n != 0:
+        # single device, or a tenant count the mesh does not divide:
+        # same contract as plan_tenants_batched — the service pads to
+        # a multiple, so with a mesh in play this is the 1-chip path
+        return jax.vmap(tenant_sched)(stacked)
+    specs = PackedCluster(*(P(TENANT_AXIS) for _ in PackedCluster._fields))
+
+    def local(block):
+        return jax.vmap(tenant_sched)(block)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=P(TENANT_AXIS),
+        check_vma=False,
+    )
+    return fn(stacked)
 
 
 def make_tenant_schedule_planner(
+    mesh: Mesh | None = None,
     *,
     horizon: int,
     rounds: int = 0,
@@ -143,10 +167,77 @@ def make_tenant_schedule_planner(
     return jax.jit(
         functools.partial(
             plan_tenants_scheduled,
+            mesh,
             horizon=horizon,
             rounds=rounds,
             best_fit_fallback=best_fit_fallback,
         )
+    )
+
+
+def apply_tenant_deltas(
+    slot_req, slot_valid, slot_tol, slot_aff, cand_valid,
+    spot_free, spot_count, spot_max_pods, spot_taints, spot_ok, spot_aff,
+    deltas,
+):
+    """Scatter T tenants' wire deltas into their stacked cached states
+    in ONE device program — the batched twin of the in-process donated
+    scatter (planner/solver_planner._delta_apply_fn): every argument
+    carries a leading tenant axis ([T, C, ...] states, [T, rows, ...]
+    padded delta sections from models/columnar.pad_packed_delta), the
+    scatter vmaps over it, and index pads point one past the axis end
+    so ``mode="drop"`` makes them no-ops (a full-pack tenant rides a
+    mixed batch with an all-pad empty delta). The 11 state tensors are
+    donated by the jit wrapper (the scatter aliases them instead of
+    allocating a second batch-state), so steady-state HOST→DEVICE
+    upload traffic is the deltas alone — batch assembly still restacks
+    the cached per-tenant twins along the tenant axis, a device-side
+    copy of the same order the batch solve already pays reading its
+    inputs."""
+
+    def one(
+        s_req, s_valid, s_tol, s_aff, c_valid,
+        p_free, p_count, p_max, p_taints, p_ok, p_aff, d,
+    ):
+        return PackedCluster(
+            slot_req=s_req.at[d.lanes].set(d.lane_slot_req, mode="drop"),
+            slot_valid=s_valid.at[d.lanes].set(
+                d.lane_slot_valid, mode="drop"
+            ),
+            slot_tol=s_tol.at[d.lanes].set(d.lane_slot_tol, mode="drop"),
+            slot_aff=s_aff.at[d.lanes].set(d.lane_slot_aff, mode="drop"),
+            cand_valid=c_valid.at[d.cand_rows].set(
+                d.cand_valid, mode="drop"
+            ),
+            spot_free=p_free.at[d.spot_rows].set(d.spot_free, mode="drop"),
+            spot_count=p_count.at[d.spot_rows].set(
+                d.spot_count, mode="drop"
+            ),
+            spot_max_pods=p_max.at[d.spot_rows].set(
+                d.spot_max_pods, mode="drop"
+            ),
+            spot_taints=p_taints.at[d.spot_rows].set(
+                d.spot_taints, mode="drop"
+            ),
+            spot_ok=p_ok.at[d.spot_rows].set(d.spot_ok, mode="drop"),
+            spot_aff=p_aff.at[d.spot_rows].set(d.spot_aff, mode="drop"),
+        )
+
+    return jax.vmap(one)(
+        slot_req, slot_valid, slot_tol, slot_aff, cand_valid,
+        spot_free, spot_count, spot_max_pods, spot_taints, spot_ok,
+        spot_aff, deltas,
+    )
+
+
+def make_tenant_delta_applier():
+    """The service's jitted batched delta scatter: the 11 stacked state
+    tensors are donated (the update aliases them in place in device
+    memory — audited by the transfer pass like the in-process scatter's
+    11 donations), re-specialized per (T, rows) shape with both axes on
+    power-of-two ladders so compiles stay O(log T · log churn)."""
+    return jax.jit(
+        apply_tenant_deltas, donate_argnums=tuple(range(11))
     )
 
 
@@ -202,6 +293,8 @@ def _tenant_batch_build(s):
 
 
 def _tenant_schedule_build(s):
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_tenant_mesh
+
     base = packed_struct(s)
     stacked = PackedCluster(
         *(
@@ -210,9 +303,29 @@ def _tenant_schedule_build(s):
         )
     )
     return (
-        functools.partial(plan_tenants_scheduled, horizon=8, rounds=8),
+        functools.partial(
+            plan_tenants_scheduled, make_tenant_mesh(), horizon=8, rounds=8
+        ),
         (stacked,),
     )
+
+
+def _tenant_delta_build(s):
+    from k8s_spot_rescheduler_tpu.hot_programs import delta_struct
+
+    base = packed_struct(s)
+    stacked = tuple(
+        jax.ShapeDtypeStruct((TENANT_PROBE_COUNT,) + f.shape, f.dtype)
+        for f in base
+    )
+    d = delta_struct(s)
+    deltas = type(d)(
+        *(
+            jax.ShapeDtypeStruct((TENANT_PROBE_COUNT,) + f.shape, f.dtype)
+            for f in d
+        )
+    )
+    return (apply_tenant_deltas, stacked + (deltas,))
 
 
 HOT_PROGRAMS = {
@@ -225,6 +338,14 @@ HOT_PROGRAMS = {
     ),
     "service.tenant_schedule": HotProgram(
         build=_tenant_schedule_build,
-        covers=("parallel.tenant_batch:plan_tenants_scheduled",),
+        covers=(
+            "parallel.tenant_batch:plan_tenants_scheduled",
+            "parallel.tenant_batch:plan_tenants_scheduled.local",
+        ),
+    ),
+    "service.tenant_delta_scatter": HotProgram(
+        build=_tenant_delta_build,
+        covers=("parallel.tenant_batch:apply_tenant_deltas",),
+        donate_argnums=tuple(range(11)),
     ),
 }

@@ -135,8 +135,12 @@ class SolverPlanner:
     @staticmethod
     def _pad_pow2(n: int) -> int:
         """Pad delta sections to power-of-two lengths so the donated
-        scatter program compiles O(log(max churn)) times, not per tick."""
-        return 8 if n <= 8 else 1 << (n - 1).bit_length()
+        scatter program compiles O(log(max churn)) times, not per tick
+        (models/columnar.pad_pow2 — one ladder, shared with the planner
+        service's batched tenant scatter)."""
+        from k8s_spot_rescheduler_tpu.models.columnar import pad_pow2
+
+        return pad_pow2(n)
 
     def _delta_apply_fn(self):
         if self._apply_delta_jit is None:
@@ -195,37 +199,14 @@ class SolverPlanner:
 
     def _pad_delta(self, delta, C: int, S: int):
         """Pad each delta section to a power-of-two length; index pads
-        point one past the axis end (dropped by the scatter)."""
-        from k8s_spot_rescheduler_tpu.models.columnar import PackedDelta
-
-        def idx(a, oob):
-            out = np.full(self._pad_pow2(len(a)), oob, np.int32)
-            out[: len(a)] = a
-            return out
-
-        def data(a):
-            out = np.zeros(
-                (self._pad_pow2(a.shape[0]),) + a.shape[1:], a.dtype
-            )
-            out[: a.shape[0]] = a
-            return out
-
-        return PackedDelta(
-            lanes=idx(delta.lanes, C),
-            lane_slot_req=data(delta.lane_slot_req),
-            lane_slot_valid=data(delta.lane_slot_valid),
-            lane_slot_tol=data(delta.lane_slot_tol),
-            lane_slot_aff=data(delta.lane_slot_aff),
-            cand_rows=idx(delta.cand_rows, C),
-            cand_valid=data(delta.cand_valid),
-            spot_rows=idx(delta.spot_rows, S),
-            spot_free=data(delta.spot_free),
-            spot_count=data(delta.spot_count),
-            spot_max_pods=data(delta.spot_max_pods),
-            spot_taints=data(delta.spot_taints),
-            spot_ok=data(delta.spot_ok),
-            spot_aff=data(delta.spot_aff),
+        point one past the axis end (dropped by the scatter). The
+        shared models/columnar.pad_packed_delta — the planner service's
+        wire-delta path pads with the same helper."""
+        from k8s_spot_rescheduler_tpu.models.columnar import (
+            pad_packed_delta,
         )
+
+        return pad_packed_delta(delta, C, S)
 
     def _upload_incremental(self, packed):
         """Move this tick's problem to the device through the resident

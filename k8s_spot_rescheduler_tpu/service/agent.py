@@ -80,14 +80,20 @@ _RemoteError = RemoteCallError
 
 class _Endpoint:
     """Per-endpoint breaker state: failures at replica A must not make
-    the agent skip replica B."""
+    the agent skip replica B. ``acked_fp`` is the fingerprint of the
+    last pack THIS endpoint acknowledged (full upload or applied
+    delta) — the delta wire ships churn only to an endpoint whose
+    acknowledged state IS the delta's base, so a failover target (or a
+    repointed url) gets a full pack by construction, without waiting
+    for the server's resync demand."""
 
-    __slots__ = ("url", "consecutive_failures", "skip_until")
+    __slots__ = ("url", "consecutive_failures", "skip_until", "acked_fp")
 
     def __init__(self, url: str):
         self.url = url.rstrip("/")
         self.consecutive_failures = 0
         self.skip_until = 0.0  # on the agent's clock (monotonic)
+        self.acked_fp = ""  # last pack fingerprint this replica holds
 
 
 class RemotePlanner:
@@ -155,6 +161,11 @@ class RemotePlanner:
         self._pad_s = 0
         self._pad_k = config.max_pods_per_node_hint
         self._fallback = None  # lazy local numpy-oracle planner
+        # delta wire (v4): the previous tick's pack + its fingerprint —
+        # what this tick's churn delta is diffed against (the agent's
+        # half of the anti-entropy pair; the service holds the other)
+        self._prev_packed = None
+        self._prev_fp = ""
         self.last_solver = "remote"
         self.last_endpoint = ""
         # the trace the last plan recorded into: the controller's tick
@@ -289,12 +300,21 @@ class RemotePlanner:
         return pack_observation(self, observation, pdbs)
 
     def _ladder_call(self, path: str, body: bytes, headers: dict,
-                     decode, box: dict) -> None:
+                     decode, box: dict, delta_body: bytes = None,
+                     base_fp: str = "", new_fp: str = "") -> None:
         """Walk the ordered endpoint list under ONE deadline budget:
         the tick's documented planner_timeout bounds the whole call,
         not each endpoint — three blackholed replicas must not stall
         the loop 3x the deadline. Fills ``box`` with the decoded reply
-        + serving endpoint (or just the attempts on total failure)."""
+        + serving endpoint (or just the attempts on total failure).
+
+        Delta wire: with ``delta_body`` given, an endpoint whose
+        acknowledged fingerprint equals ``base_fp`` is sent the churn
+        delta instead of the full pack; a KIND_RESYNC answer retries
+        the full pack on the SAME endpoint within the same budget (a
+        resync is protocol, not a failure — no breaker, no failover).
+        A serving endpoint's ``acked_fp`` advances to ``new_fp``, so
+        failover targets get a full pack by construction."""
         box["t_send"] = time.perf_counter()
         deadline = box["t_send"] + self.timeout
         skipped = 0
@@ -315,13 +335,35 @@ class RemotePlanner:
                 # not an endpoint failure: its breaker is
                 # untouched — we simply ran out of budget
                 continue
+            use_delta = delta_body is not None and ep.acked_fp == base_fp
             t_ep = time.perf_counter()
             try:
                 raw = self.transport(
-                    f"{ep.url}{path}", body, headers,
+                    f"{ep.url}{path}",
+                    delta_body if use_delta else body,
+                    headers,
                     max(0.05, remaining),
                 )
-                reply = decode(raw)
+                reply = (
+                    wire.decode_plan_or_resync(raw)
+                    if use_delta
+                    else decode(raw)
+                )
+                if isinstance(reply, wire.ResyncDemand):
+                    # the service cannot honor the delta's base
+                    # (restart, eviction, mismatch, corruption): one
+                    # full pack to the SAME endpoint, same budget
+                    box["resyncs"] = box.get("resyncs", 0) + 1
+                    log.info(
+                        "planner endpoint %s demanded a full-pack "
+                        "resync: %s", ep.url, reply.cause,
+                    )
+                    remaining = deadline - time.perf_counter()
+                    raw = self.transport(
+                        f"{ep.url}{path}", body, headers,
+                        max(0.05, remaining),
+                    )
+                    reply = decode(raw)
             except RemoteCallError as err:
                 self._note_failure(ep, str(err), err.retry_after)
                 box["attempts"].append((
@@ -337,6 +379,10 @@ class RemotePlanner:
                 ))
                 continue
             self._note_success(ep)
+            if new_fp:
+                # this replica now holds exactly the new pack (full
+                # upload, or delta applied over an acknowledged base)
+                ep.acked_fp = new_fp
             box["reply"] = reply
             box["endpoint"] = ep.url
             box["skipped_before"] = skipped
@@ -378,6 +424,10 @@ class RemotePlanner:
                 endpoints_tried=len(attempts) + skipped_before + 1,
             )
         if trace is not None:
+            if box.get("resyncs"):
+                # surface a served-after-resync tick on the trace tree
+                attrs = dict(attrs or {})
+                attrs["delta_resyncs"] = box["resyncs"]
             # graft the server's span block under the measured round
             # trip; the residual (rtt minus server-side work) is the
             # wire itself — tunnel, TLS, serialization on the path
@@ -441,11 +491,44 @@ class RemotePlanner:
         ]
         box: dict = {"attempts": [], "skipped_before": 0}
         worker: Optional[threading.Thread] = None
+        # delta wire (v4): fingerprint this pack, diff it against the
+        # previous tick's, and remember it as the next tick's base —
+        # regardless of how THIS tick ends (fallback included), since
+        # the per-endpoint acked fingerprints are what gate shipping
+        fp = ""
+        delta = None
+        base_fp = ""
+        if cfg.delta_wire_enabled:
+            from k8s_spot_rescheduler_tpu.models.columnar import (
+                emit_packed_delta,
+                pack_fingerprint,
+            )
+
+            with _sp("plan.fingerprint"):
+                fp = pack_fingerprint(packed)
+            if self._prev_packed is not None:
+                with _sp("plan.delta-emit"):
+                    # None on shape growth past the high-water pads:
+                    # this tick ships the full pack (and re-seeds)
+                    delta = emit_packed_delta(self._prev_packed, packed)
+                base_fp = self._prev_fp
+            self._prev_packed = packed
+            self._prev_fp = fp
         if live:
             trace_id = trace.trace_id if trace is not None else ""
             body = wire.encode_plan_request(
-                self.tenant, packed, trace_id=trace_id
+                self.tenant, packed, trace_id=trace_id,
+                pack_fingerprint=fp,
             )
+            delta_body = None
+            if delta is not None and any(
+                ep.acked_fp == base_fp for ep in live
+            ):
+                delta_body = wire.encode_packed_delta(
+                    self.tenant, delta,
+                    base_fingerprint=base_fp, new_fingerprint=fp,
+                    trace_id=trace_id,
+                )
             headers = {
                 "Content-Type": "application/octet-stream",
                 # declare our own deadline so the service evicts (and
@@ -459,7 +542,9 @@ class RemotePlanner:
 
             def call():
                 self._ladder_call(
-                    "/v2/plan", body, headers, wire.decode_plan_reply, box
+                    "/v2/plan", body, headers, wire.decode_plan_reply,
+                    box, delta_body=delta_body, base_fp=base_fp,
+                    new_fp=fp,
                 )
 
             worker = threading.Thread(target=call, daemon=True)

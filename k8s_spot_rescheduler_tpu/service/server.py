@@ -89,6 +89,15 @@ class ServiceBusy(Exception):
         self.retry_after = int(retry_after)
 
 
+class ResyncRequired(Exception):
+    """A delta request's base state is unusable (restart, eviction,
+    fingerprint mismatch, decode/apply anomaly): the agent must answer
+    with exactly one full pack. Typed so the HTTP layer encodes it as
+    wire ``KIND_RESYNC`` (HTTP 200 — a resync is protocol, not an
+    endpoint failure; a 4xx/5xx would trip the agent's breaker and
+    read as a dead replica)."""
+
+
 # per-tenant bookkeeping bounds: tenant ids are CLIENT-supplied (wire
 # frame / X-Tenant header), so every keyed structure must be pruned or a
 # churning fleet (fresh hostname per agent restart) grows the long-lived
@@ -103,15 +112,45 @@ STATE_SAVE_INTERVAL_S = 60.0
 WARM_MAX_BUCKETS = 8
 SEEN_BUCKETS_MAX = 64
 
+# delta-wire tenant cache (wire v4): per-tenant packed state is a whole
+# bucket-padded tensor set — far heavier than the bookkeeping maps — so
+# it carries its own, tighter hard cap (eviction is cheap for the
+# evictee: one full-pack resync on its next delta)
+TENANT_CACHE_MAX = 512
+
+
+class _TenantEntry:
+    """One tenant's cached packed state for the delta wire: the host
+    mirror (bucket-padded, owned writable arrays — deltas scatter into
+    it in place), the device-resident twin on the accelerator path
+    (populated after the tenant's first batched scatter; None on the
+    numpy path and after a device error), and the content fingerprint
+    the next delta's base must name."""
+
+    __slots__ = ("fp", "host", "device", "bucket", "K", "lanes",
+                 "last_used")
+
+    def __init__(self, fp, host, bucket, K, lanes, last_used):
+        self.fp = fp
+        self.host = host  # PackedCluster of writable numpy arrays
+        self.device = None  # PackedCluster of device arrays, or None
+        self.bucket = bucket
+        self.K = int(K)  # the agent's own K (reply row trim)
+        self.lanes = int(lanes)  # valid lanes (DRR cost of a delta req)
+        self.last_used = float(last_used)
+
 
 class _Request:
     __slots__ = (
         "tenant", "packed", "bucket", "lanes", "enqueued", "event",
-        "reply", "error", "trace_id", "horizon",
+        "reply", "error", "trace_id", "horizon", "fingerprint", "K",
+        "delta", "base_fp", "new_fp", "resync",
     )
 
-    def __init__(self, tenant: str, packed: PackedCluster, bucket: Bucket,
-                 enqueued: float, trace_id: str = "", horizon: int = 0):
+    def __init__(self, tenant: str, packed: Optional[PackedCluster],
+                 bucket: Bucket, enqueued: float, trace_id: str = "",
+                 horizon: int = 0, fingerprint: str = "", lanes: int = 0,
+                 K: int = 0):
         self.tenant = tenant
         self.packed = packed
         self.bucket = bucket
@@ -121,8 +160,14 @@ class _Request:
         self.horizon = int(horizon)
         # DRR cost: the lanes this problem actually solves (valid lanes,
         # not pad) — a tenant shipping big problems drains its deficit
-        # faster than one shipping small ones
-        self.lanes = int(np.asarray(packed.cand_valid).sum())
+        # faster than one shipping small ones. Delta requests (packed
+        # None) have the caller compute it from the cached state.
+        if packed is not None:
+            self.lanes = int(np.asarray(packed.cand_valid).sum())
+            self.K = packed.slot_req.shape[1]
+        else:
+            self.lanes = int(lanes)
+            self.K = int(K)
         self.enqueued = enqueued
         self.event = threading.Event()
         self.reply: Optional[wire.PlanReply] = None
@@ -131,6 +176,16 @@ class _Request:
         # spans are keyed by it so the reply's span block grafts into
         # the right tick tree on the far side
         self.trace_id = trace_id
+        # delta wire (v4): the pack fingerprint a full-pack request
+        # carries (seeds the tenant cache), or the churn payload +
+        # base/new fingerprints of a delta-backed request; ``resync``
+        # carries the demand's cause when the batch path refused the
+        # delta after it was queued
+        self.fingerprint = fingerprint
+        self.delta = None
+        self.base_fp = ""
+        self.new_fp = ""
+        self.resync: Optional[str] = None
 
 
 class PlannerService:
@@ -174,6 +229,14 @@ class PlannerService:
         self._batched = None  # lazy jitted tenant-batch program
         self._sched_programs: Dict[int, object] = {}  # horizon -> jit
         self._mesh = None
+        self._mesh_ready = False
+        # delta wire (v4): per-tenant fingerprinted packed state +
+        # the lazily-jitted batched tenant scatter; _warm_fps holds the
+        # RESTART-persisted fingerprints (content is gone — they only
+        # name the resync cause precisely)
+        self._tenant_cache: Dict[str, _TenantEntry] = {}
+        self._warm_fps: Dict[str, str] = {}
+        self._delta_applier = None
         self._stop = False
         self._draining = False
         self._thread: Optional[threading.Thread] = None
@@ -220,13 +283,19 @@ class PlannerService:
         packed: PackedCluster,
         trace_id: str = "",
         schedule_horizon: int = 0,
+        pack_fingerprint: str = "",
     ) -> _Request:
         """Enqueue one problem; returns the pending request (its
         ``event`` fires when a batch delivered ``reply`` or ``error``)."""
         req = _Request(
             tenant, packed, bucketing.bucket_for(packed), self.clock.now(),
             trace_id=trace_id, horizon=schedule_horizon,
+            fingerprint=pack_fingerprint,
         )
+        self._enqueue(req)
+        return req
+
+    def _enqueue(self, req: _Request) -> None:
         with self._work:
             if self._draining:
                 # graceful drain: stop admitting; the Retry-After horizon
@@ -237,15 +306,14 @@ class PlannerService:
                     "replica",
                     self.drain_retry_after(),
                 )
-            q = self._queues.get(tenant)
+            q = self._queues.get(req.tenant)
             if q is None:
-                q = self._queues[tenant] = deque()
-            if tenant not in self._deficit:
-                self._ring.append(tenant)
-                self._deficit[tenant] = 0
+                q = self._queues[req.tenant] = deque()
+            if req.tenant not in self._deficit:
+                self._ring.append(req.tenant)
+                self._deficit[req.tenant] = 0
             q.append(req)
             self._work.notify_all()
-        return req
 
     def submit(
         self,
@@ -254,6 +322,7 @@ class PlannerService:
         timeout_s: Optional[float] = None,
         trace_id: str = "",
         schedule_horizon: int = 0,
+        pack_fingerprint: str = "",
     ):
         """Enqueue and wait for the batch that carries this request.
         Raises :class:`ServiceBusy` when the bounded wait expires — the
@@ -270,7 +339,14 @@ class PlannerService:
         req = self.submit_nowait(
             tenant, packed, trace_id=trace_id,
             schedule_horizon=schedule_horizon,
+            pack_fingerprint=pack_fingerprint,
         )
+        return self._finish_wait(req, wait_s)
+
+    def _finish_wait(self, req: _Request, wait_s: float):
+        """The shared bounded wait behind :meth:`submit` and
+        :meth:`submit_delta`: inline drain for scheduler-less callers,
+        eviction past the deadline, and the typed outcomes."""
         if self._thread is None:
             # no scheduler thread (an in-process caller — e.g.
             # PlannerSidecar.plan without start_background): drain the
@@ -298,6 +374,8 @@ class PlannerService:
             # interruptible (an XLA dispatch cannot be cancelled), so
             # ride it out — same contract as the old sidecar lock
             req.event.wait()
+        if req.resync is not None:
+            raise ResyncRequired(req.resync)
         if req.error is not None:
             raise req.error
         if req.reply is None:
@@ -311,6 +389,128 @@ class PlannerService:
                 q.remove(req)
                 return True
         return False
+
+    # ------------------------------------------------------------------
+    # delta wire (v4): fingerprinted tenant cache + resync demands
+
+    def note_resync(self, tenant: str, cause: str, trace_id: str = "") -> None:
+        """ONE resync demanded: fire the metric and the flight event
+        from this single site so ``service_delta_requests_total``
+        {outcome=resync} and the flight ``delta-resync`` count can
+        never disagree (fleet-chaos-smoke asserts equality)."""
+        metrics.update_service_delta("resync")
+        flight.note_event(
+            "delta-resync", cause=cause, trace_id=trace_id, tenant=tenant,
+        )
+        log.warning(
+            "delta resync demanded for tenant %s: %s",
+            flight.redact_text(tenant) if tenant else "<undecoded>", cause,
+        )
+
+    def _cache_mismatch_locked(
+        self, tenant: str, entry: Optional[_TenantEntry], base_fp: str
+    ) -> Optional[str]:
+        """Why this delta cannot apply (None = it can). Caller holds
+        the lock."""
+        if entry is None:
+            if self._warm_fps.get(tenant) == base_fp:
+                return (
+                    "server restart lost the cached tenant state (the "
+                    "persisted warm fingerprint matches the delta base)"
+                )
+            return "no cached state for tenant (first contact or evicted)"
+        if entry.fp != base_fp:
+            return (
+                f"fingerprint mismatch (cache holds {entry.fp[:12]}..., "
+                f"delta base names {base_fp[:12]}...)"
+            )
+        return None
+
+    @staticmethod
+    def _validate_delta(delta, bucket: Bucket) -> Optional[str]:
+        """Range-check a decoded delta against the cached bucket shape
+        (the wire digest already proves the bytes are as sent; this
+        guards a buggy agent — numpy would silently WRAP a negative
+        index where the device scatter drops it, so refuse both)."""
+        if delta.lane_slot_req.shape[1] > bucket.K:
+            return (
+                f"delta lane slabs carry K={delta.lane_slot_req.shape[1]} "
+                f"> cached bucket K={bucket.K}"
+            )
+        for name, idx, n in (
+            ("lanes", delta.lanes, bucket.C),
+            ("cand_rows", delta.cand_rows, bucket.C),
+            ("spot_rows", delta.spot_rows, bucket.S),
+        ):
+            if len(idx) and (
+                int(idx.min()) < 0 or int(idx.max()) >= n
+            ):
+                return f"delta {name} index out of range [0, {n})"
+        return None
+
+    def submit_delta(
+        self,
+        tenant: str,
+        delta,
+        base_fp: str,
+        new_fp: str,
+        timeout_s: Optional[float] = None,
+        trace_id: str = "",
+    ):
+        """Enqueue one delta-backed plan request and wait for the batch
+        that carries it. Raises :class:`ResyncRequired` when the cached
+        base state cannot honor the delta (fast-path check here; the
+        authoritative re-check happens at batch assembly, since an
+        earlier queued delta may advance the cache first), or
+        :class:`ServiceBusy` exactly like :meth:`submit`. Returns a
+        :class:`wire.PlanReply` — the selection is computed from the
+        cached state with this delta scattered in, bit-identical to the
+        same tenant shipping its full pack."""
+        with self._work:
+            entry = self._tenant_cache.get(tenant)
+            cause = self._cache_mismatch_locked(tenant, entry, base_fp)
+            if cause is None:
+                cause = self._validate_delta(delta, entry.bucket)
+            if cause is None:
+                # DRR lane cost of the resulting state, computed from
+                # the delta alone: cached lanes minus the flips the
+                # cand_valid section reverts, plus the ones it sets
+                old = np.asarray(
+                    entry.host.cand_valid[np.asarray(delta.cand_rows)]
+                )
+                lanes = (
+                    entry.lanes
+                    - int(old.sum())
+                    + int(np.asarray(delta.cand_valid).sum())
+                )
+                req = _Request(
+                    tenant, None, entry.bucket, self.clock.now(),
+                    trace_id=trace_id, lanes=lanes, K=entry.K,
+                )
+                req.delta = delta
+                req.base_fp = base_fp
+                req.new_fp = new_fp
+        if cause is not None:
+            self.note_resync(tenant, cause, trace_id)
+            raise ResyncRequired(cause)
+        self._enqueue(req)
+        wait_s = self.queue_timeout_s
+        if timeout_s is not None and timeout_s > 0:
+            wait_s = max(0.05, min(wait_s, float(timeout_s)))
+        return self._finish_wait(req, wait_s)
+
+    def invalidate_tenant_cache(self, tenant: Optional[str] = None) -> int:
+        """Drop one tenant's (or every) cached packed state; their next
+        delta is answered with a resync demand. The forced-resync seam
+        serve-smoke drives; eviction/TTL pruning reuses it."""
+        with self._work:
+            if tenant is not None:
+                n = 1 if self._tenant_cache.pop(tenant, None) else 0
+            else:
+                n = len(self._tenant_cache)
+                self._tenant_cache.clear()
+            metrics.update_service_tenant_cache(len(self._tenant_cache))
+        return n
 
     def retry_after(self) -> int:
         """Seconds until a batch slot plausibly frees: the measured
@@ -345,6 +545,7 @@ class PlannerService:
             }
             cadence = self._cadence_s
             draining = self._draining
+            cache_entries = len(self._tenant_cache)
         out = {
             "queue_depth": depth,
             "bucket_occupancy": by_bucket,
@@ -354,6 +555,7 @@ class PlannerService:
             ),
             "batch_window_s": self.batch_window_s,
             "draining": draining,
+            "tenant_cache_entries": cache_entries,
         }
         if wd is not None:
             out.update(wd.snapshot())
@@ -452,14 +654,14 @@ class PlannerService:
         if not batch:
             return False
         bucket = batch[0].bucket
-        now = self.clock.now()
-        waits_ms = [max(0.0, now - r.enqueued) * 1e3 for r in batch]
         t0 = self.clock.now()
         try:
-            padded = [
-                bucketing.pad_to_bucket(r.packed, bucket) for r in batch
-            ]
-            stacked = bucketing.stack_bucket(padded, bucket)
+            batch, stacked = self._assemble_batch(batch, bucket)
+            if not batch:
+                # every member resynced away (already answered typed)
+                return True
+            now = self.clock.now()
+            waits_ms = [max(0.0, now - r.enqueued) * 1e3 for r in batch]
             t_solve = self.clock.now()
             out = self._solve_batch(stacked, batch)
         except Exception as err:  # noqa: BLE001 — contain: fail the batch,
@@ -467,6 +669,8 @@ class PlannerService:
             # counted via update_service_request("error") below
             log.error("batched solve failed: %s", err)
             for req in batch:
+                if req.event.is_set():
+                    continue  # already answered (a typed resync)
                 req.error = ServiceBusy(f"solve failed: {err}", 0)
                 metrics.update_service_request("error")
                 req.event.set()
@@ -513,6 +717,27 @@ class PlannerService:
                     for t, b in self._tenant_bucket.items()
                     if t in self._last_plan_wall
                 }
+            # the delta-wire tenant cache rides the same lifecycle —
+            # TTL'd tenants lose their cached packed state, and a
+            # tighter hard cap evicts the least-recently-used entries
+            # (packed state is far heavier than the bookkeeping maps);
+            # an evicted tenant's next delta costs one full-pack resync
+            if self._tenant_cache:
+                for t in [
+                    t for t in self._tenant_cache
+                    if t not in self._last_plan_wall
+                ]:
+                    del self._tenant_cache[t]
+                if len(self._tenant_cache) > TENANT_CACHE_MAX:
+                    newest = sorted(
+                        self._tenant_cache.items(),
+                        key=lambda kv: kv[1].last_used,
+                        reverse=True,
+                    )[:TENANT_CACHE_MAX]
+                    self._tenant_cache = dict(newest)
+                metrics.update_service_tenant_cache(
+                    len(self._tenant_cache)
+                )
             if self._last_batch_mono is not None:
                 interval = max(1e-9, end - self._last_batch_mono)
                 self._cadence_s = (
@@ -522,7 +747,7 @@ class PlannerService:
                 )
             self._last_batch_mono = end
         for i, req in enumerate(batch):
-            K = req.packed.slot_req.shape[1]
+            K = req.K
             vec = out[i]
             # server-side spans, offset from THIS request's enqueue:
             # how its wall time split between the tenant queue, the
@@ -567,6 +792,10 @@ class PlannerService:
                     spans=spans,
                 )
             metrics.update_service_request("ok")
+            if req.delta is not None:
+                # the applied half of the delta accounting (the resync
+                # half fires in note_resync — one site each)
+                metrics.update_service_delta("applied")
             req.event.set()
         if self._state_path() and (
             self._last_state_save is None
@@ -577,6 +806,213 @@ class PlannerService:
             self._last_state_save = wall
             self.save_state()
         return True
+
+    # ------------------------------------------------------------------
+    # batch assembly (full packs + delta scatter)
+
+    @staticmethod
+    def _apply_delta_host(host: PackedCluster, delta) -> None:
+        """Scatter one wire delta into a cached host mirror IN PLACE —
+        the same update models/columnar.apply_packed_delta defines,
+        sliced to the delta's own slab width (the cached state is
+        bucket-padded; columns past the agent's K are zeros on both
+        sides by the pad invariant, so the narrower write is exact)."""
+        k = delta.lane_slot_req.shape[1]
+        host.slot_req[delta.lanes, :k] = delta.lane_slot_req
+        host.slot_valid[delta.lanes, :k] = delta.lane_slot_valid
+        host.slot_tol[delta.lanes, :k] = delta.lane_slot_tol
+        host.slot_aff[delta.lanes, :k] = delta.lane_slot_aff
+        host.cand_valid[delta.cand_rows] = delta.cand_valid
+        host.spot_free[delta.spot_rows] = delta.spot_free
+        host.spot_count[delta.spot_rows] = delta.spot_count
+        host.spot_max_pods[delta.spot_rows] = delta.spot_max_pods
+        host.spot_taints[delta.spot_rows] = delta.spot_taints
+        host.spot_ok[delta.spot_rows] = delta.spot_ok
+        host.spot_aff[delta.spot_rows] = delta.spot_aff
+
+    def _assemble_batch(self, batch, bucket: Bucket):
+        """Resolve a popped batch to its solve-input state: full packs
+        pad into the bucket (and seed the tenant cache when they carry
+        a v4 fingerprint); delta requests re-verify against the cache —
+        the authoritative check, an earlier queued delta may have
+        advanced it since submit — update the host mirror in place,
+        and on the accelerator path ride ONE batched donated scatter
+        (parallel/tenant_batch.apply_tenant_deltas) applying every
+        tenant's churn on device before the batch solve, whose output
+        slices become the per-tenant device-resident state. A delta
+        the cache cannot honor (or whose apply raises) is answered
+        with a typed resync demand and dropped — never a wrong plan.
+        Returns (live_batch, stacked_states)."""
+        from k8s_spot_rescheduler_tpu.models.columnar import (
+            empty_packed_delta,
+            pad_packed_delta,
+            pad_pow2,
+        )
+
+        wd = self._devhealth
+        any_delta = any(r.delta is not None for r in batch)
+        use_device = (
+            any_delta
+            and self.config.solver != "numpy"
+            and batch[0].horizon == 0
+            and (wd is None or not wd.sick)
+        )
+        live: List[_Request] = []
+        states: List[PackedCluster] = []
+        deltas: List[Optional[object]] = []
+        resynced: List[_Request] = []
+        wall = self.clock.wall()
+        with self._work:
+            for req in batch:
+                if req.delta is None:
+                    padded = bucketing.pad_to_bucket(req.packed, bucket)
+                    if req.fingerprint:
+                        # owned writable copies: decoded wire tensors
+                        # are read-only views into the request body,
+                        # and future deltas scatter into these in place
+                        host = PackedCluster(
+                            *(np.array(f) for f in padded)
+                        )
+                        self._tenant_cache[req.tenant] = _TenantEntry(
+                            req.fingerprint, host, bucket, req.K,
+                            req.lanes, wall,
+                        )
+                        states.append(host)
+                    else:
+                        states.append(padded)
+                    deltas.append(None)
+                    live.append(req)
+                    continue
+                entry = self._tenant_cache.get(req.tenant)
+                cause = self._cache_mismatch_locked(
+                    req.tenant, entry, req.base_fp
+                )
+                if cause is None and entry.bucket != bucket:
+                    # a stale queued delta racing a full repack into
+                    # another shape family — resync, never mis-scatter
+                    cause = "cached state moved to another shape bucket"
+                if cause is None:
+                    cause = self._validate_delta(req.delta, bucket)
+                if cause is None:
+                    # base for the device scatter, captured before the
+                    # host mirror mutates. When it IS the host mirror
+                    # (no device twin yet) the stack below may read the
+                    # post-apply arrays — harmless: the scatter is a
+                    # pure SET, so re-applying the same delta is
+                    # idempotent bit-for-bit.
+                    base = (
+                        entry.device
+                        if entry.device is not None
+                        else entry.host
+                    )
+                    try:
+                        self._apply_delta_host(entry.host, req.delta)
+                    except Exception as err:  # noqa: BLE001, exception-discipline — ANY apply anomaly demands a typed resync (counted + flight-evented below); the entry is dropped so a partial scatter can never serve a later delta
+                        self._tenant_cache.pop(req.tenant, None)
+                        cause = f"delta apply failed: {err}"
+                if cause is not None:
+                    req.resync = cause
+                    resynced.append(req)
+                    continue
+                entry.fp = req.new_fp
+                entry.lanes = req.lanes
+                entry.last_used = wall
+                if not use_device:
+                    # the twin was NOT part of this apply (host-only
+                    # path: sick watchdog, or a schedule/numpy batch):
+                    # drop it, or a post-recovery device scatter would
+                    # build on a base missing this batch's churn
+                    entry.device = None
+                states.append(base if use_device else entry.host)
+                deltas.append(req.delta)
+                live.append(req)
+            metrics.update_service_tenant_cache(len(self._tenant_cache))
+            stacked = None
+            if live and not use_device:
+                # host path: the mirrors already hold the post-delta
+                # state; stack INSIDE the lock so no concurrent batch's
+                # apply can slip between mirror and copy
+                stacked = bucketing.stack_bucket(states, bucket)
+        for req in resynced:
+            self.note_resync(req.tenant, req.resync, req.trace_id)
+            req.event.set()
+        if not live:
+            return [], None
+        if not use_device:
+            return live, stacked
+        try:
+            import jax.numpy as jnp
+
+            stacked_base = PackedCluster(
+                *(
+                    jnp.stack([getattr(s, f) for s in states])
+                    for f in PackedCluster._fields
+                )
+            )
+            rows = {
+                sec: pad_pow2(max(
+                    (
+                        len(getattr(d, sec))
+                        for d in deltas
+                        if d is not None
+                    ),
+                    default=0,
+                ))
+                for sec in ("lanes", "cand_rows", "spot_rows")
+            }
+            padded_deltas = [
+                pad_packed_delta(
+                    d if d is not None else empty_packed_delta(states[i]),
+                    bucket.C,
+                    bucket.S,
+                    lane_rows=rows["lanes"],
+                    cand_rows=rows["cand_rows"],
+                    spot_rows=rows["spot_rows"],
+                    K=bucket.K,
+                )
+                for i, d in enumerate(deltas)
+            ]
+            delta_t = type(padded_deltas[0])
+            stacked_delta = delta_t(
+                *(
+                    np.stack([getattr(d, f) for d in padded_deltas])
+                    for f in delta_t._fields
+                )
+            )
+            if self._delta_applier is None:
+                from k8s_spot_rescheduler_tpu.parallel.tenant_batch import (
+                    make_tenant_delta_applier,
+                )
+
+                self._delta_applier = make_tenant_delta_applier()
+            out_state = self._delta_applier(*stacked_base, stacked_delta)
+            with self._work:
+                for i, req in enumerate(live):
+                    entry = self._tenant_cache.get(req.tenant)
+                    if entry is not None and entry.bucket == bucket:
+                        # the device-resident per-tenant state: next
+                        # tick's scatter stacks these device-to-device
+                        entry.device = PackedCluster(
+                            *(f[i] for f in out_state)
+                        )
+            return live, out_state
+        except Exception as err:  # noqa: BLE001, exception-discipline — a device-side scatter failure is contained to the HOST path (the post-apply host mirrors are authoritative and bit-identical); the device twins are dropped and rebuilt by the next batch
+            log.error(
+                "batched delta scatter failed on device (%s); serving "
+                "this batch from the host mirrors", err,
+            )
+            with self._work:
+                host_states = []
+                for i, req in enumerate(live):
+                    entry = self._tenant_cache.get(req.tenant)
+                    if entry is not None:
+                        entry.device = None
+                    if req.delta is not None and entry is not None:
+                        host_states.append(entry.host)
+                    else:
+                        host_states.append(states[i])
+                stacked = bucketing.stack_bucket(host_states, bucket)
+            return live, stacked
 
     # ------------------------------------------------------------------
     # device health + solve routing
@@ -715,6 +1151,7 @@ class PlannerService:
 
             cfg = self.config
             self._sched_programs[horizon] = make_tenant_schedule_planner(
+                self._ensure_mesh(),
                 horizon=horizon,
                 rounds=(cfg.repair_rounds if cfg.fallback_best_fit else 0),
                 best_fit_fallback=cfg.fallback_best_fit,
@@ -722,7 +1159,15 @@ class PlannerService:
         try:
             if self.chaos is not None:
                 self.chaos.on_batch()
-            return np.asarray(self._sched_programs[horizon](stacked))
+            # the schedule batch shards over the tenant mesh exactly
+            # like the single-plan batch: pad the tenant axis to a
+            # device multiple with all-invalid problems, trim after
+            T = stacked.slot_req.shape[0]
+            return np.asarray(
+                self._sched_programs[horizon](
+                    self._pad_tenant_axis(stacked)
+                )
+            )[:T]
         except Exception as err:  # noqa: BLE001, exception-discipline — a device failure on the schedule program flips the SAME watchdog edge (gauge + flight) as a single-plan batch, then drain_once's per-batch containment answers the tenants typed
             if wd is not None:
                 self._note_device_edge(wd.note_error(err))
@@ -883,9 +1328,17 @@ class PlannerService:
                 reverse=True,
             )
             payload = {
-                "version": 1,
+                "version": 2,
                 "tenants": dict(self._tenant_bucket),
                 "buckets": [list(dims) for dims in buckets],
+                # delta-wire pack fingerprints: the cached CONTENT does
+                # not survive a restart, but the fingerprints do — a
+                # reconnecting agent's first delta then gets a resync
+                # demand that NAMES the restart as its cause, and the
+                # anti-entropy accounting stays exact
+                "fingerprints": {
+                    t: e.fp for t, e in self._tenant_cache.items()
+                },
             }
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -912,6 +1365,7 @@ class PlannerService:
                 payload = json.load(f)
             bucket_dims = list(payload.get("buckets", ()))
             tenants = payload.get("tenants", {})
+            fingerprints = payload.get("fingerprints", {})
         except (OSError, ValueError, TypeError, AttributeError) as err:
             # valid JSON of the wrong SHAPE (a list, "buckets": 5) must
             # cost a cold start, never the boot — same contract as an
@@ -938,6 +1392,11 @@ class PlannerService:
                 self._tenant_bucket.update(
                     {str(t): str(k) for t, k in tenants.items()}
                 )
+        if isinstance(fingerprints, dict):
+            with self._work:
+                self._warm_fps.update(
+                    {str(t): str(fp) for t, fp in fingerprints.items()}
+                )
         if warmed:
             log.info(
                 "warm restart: pre-warmed %d bucket compile(s): %s",
@@ -958,6 +1417,49 @@ class PlannerService:
             else "tenant-batch(jax union)"
         )
 
+    def _ensure_mesh(self):
+        """The tenant mesh, probed once: None on a single-device (or
+        backend-less) host, shared by the batch, schedule and delta-
+        scatter programs."""
+        if self._mesh_ready:
+            return self._mesh
+        try:
+            import jax
+
+            if len(jax.devices()) > 1:
+                from k8s_spot_rescheduler_tpu.parallel.mesh import (
+                    make_tenant_mesh,
+                )
+
+                self._mesh = make_tenant_mesh()
+        except Exception:  # noqa: BLE001, exception-discipline — no backend info: stay 1-chip, the single-device vmap program is the documented degradation and /healthz batch_program names it
+            self._mesh = None
+        self._mesh_ready = True
+        return self._mesh
+
+    def _pad_tenant_axis(self, stacked: PackedCluster) -> PackedCluster:
+        """Pad the tenant axis to a device multiple so the batch
+        SHARDS instead of falling to one-device vmap; pad tenants are
+        all-invalid problems (found=False rows, trimmed by callers)."""
+        if self._mesh is None:
+            return stacked
+        T = stacked.slot_req.shape[0]
+        n = int(self._mesh.devices.size)
+        pad = (-T) % n
+        if not pad:
+            return stacked
+        return PackedCluster(
+            *(
+                np.concatenate(
+                    [
+                        np.asarray(f),
+                        np.zeros((pad,) + f.shape[1:], f.dtype),
+                    ]
+                )
+                for f in stacked
+            )
+        )
+
     def _solve(self, stacked: PackedCluster) -> np.ndarray:
         if self.config.solver == "numpy":
             return self._solve_host(stacked)
@@ -966,17 +1468,7 @@ class PlannerService:
                 make_tenant_batch_planner,
             )
 
-            try:
-                import jax
-
-                if len(jax.devices()) > 1:
-                    from k8s_spot_rescheduler_tpu.parallel.mesh import (
-                        make_tenant_mesh,
-                    )
-
-                    self._mesh = make_tenant_mesh()
-            except Exception:  # noqa: BLE001, exception-discipline — no backend info: stay 1-chip, the single-device vmap program is the documented degradation and /healthz batch_program names it
-                self._mesh = None
+            self._ensure_mesh()
             cfg = self.config
             if cfg.solver not in ("jax",):
                 # pallas/sharded are per-tenant SINGLE-problem kernel
@@ -997,25 +1489,7 @@ class PlannerService:
                 best_fit_fallback=cfg.fallback_best_fit,
             )
         T = stacked.slot_req.shape[0]
-        if self._mesh is not None:
-            # pad the tenant axis to a device multiple so the batch
-            # SHARDS instead of falling to one-device vmap; pad tenants
-            # are all-invalid problems (found=False rows, discarded)
-            n = int(self._mesh.devices.size)
-            pad = (-T) % n
-            if pad:
-                stacked = PackedCluster(
-                    *(
-                        np.concatenate(
-                            [
-                                np.asarray(f),
-                                np.zeros((pad,) + f.shape[1:], f.dtype),
-                            ]
-                        )
-                        for f in stacked
-                    )
-                )
-        return np.asarray(self._batched(stacked))[:T]
+        return np.asarray(self._batched(self._pad_tenant_axis(stacked)))[:T]
 
     def _solve_host(self, stacked: PackedCluster) -> np.ndarray:
         """The numpy-oracle batch path (CI / --solver numpy): the SAME
@@ -1296,6 +1770,9 @@ class ServiceServer:
                 body = self._read_body()
                 if body is None:
                     return
+                # ingest-bandwidth accounting (the ceiling the delta
+                # wire lowers): every /v2/plan body, pack or delta
+                metrics.update_service_wire_ingest(len(body))
                 chaos = server.service.chaos
                 if chaos is not None:
                     # the decode chaos hook: a corrupted request must
@@ -1313,6 +1790,14 @@ class ServiceServer:
                     if raw_version in wire.SUPPORTED_VERSIONS
                     else 1
                 )
+                if (
+                    len(body) > 5
+                    and body[5] == wire.KIND_PACKED_DELTA
+                    and reply_version >= 4
+                ):
+                    # the delta wire (v4): same endpoint, its own
+                    # decode/answer contract (resync-on-anything)
+                    return self._post_wire_delta(body, t_req)
                 try:
                     admit_ms = (time.perf_counter() - t_req) * 1e3
                     try:
@@ -1347,6 +1832,7 @@ class ServiceServer:
                             timeout_s=deadline or None,
                             trace_id=trace_id,
                             schedule_horizon=req.schedule_horizon,
+                            pack_fingerprint=req.pack_fingerprint,
                         )
                     except ServiceBusy as err:
                         return self._send_bytes(
@@ -1395,6 +1881,97 @@ class ServiceServer:
                     metrics.update_service_request("error")
                     return self._send_bytes(
                         wire.encode_error(str(err), version=reply_version),
+                        "application/octet-stream", 500,
+                    )
+                finally:
+                    server._release()
+
+            def _post_wire_delta(self, body: bytes, t_req: float):
+                """One delta-backed plan request (wire v4). The answer
+                ladder is resync-on-anything: a decode anomaly, an
+                unknown/mismatched base, or an apply failure all come
+                back as HTTP 200 + KIND_RESYNC (a 4xx would read as an
+                endpoint failure and trip the agent's breaker — a
+                resync is protocol, not an outage); only queue
+                pressure (503) and handler bugs (500) answer as for
+                full packs. The caller already released no state: the
+                inflight slot is freed in the finally as usual."""
+                try:
+                    admit_ms = (time.perf_counter() - t_req) * 1e3
+                    header_trace = self.headers.get("X-Trace-Id", "") or ""
+                    try:
+                        t_dec = time.perf_counter()
+                        dreq = wire.decode_packed_delta_ex(body)
+                        decode_ms = (time.perf_counter() - t_dec) * 1e3
+                    except wire.WireError as err:
+                        # ANY decode anomaly (truncation, bit flip —
+                        # the digest catches payload corruption) is a
+                        # typed resync demand; the agent answers with
+                        # one full pack, never a wrong plan
+                        cause = f"delta decode failed: {err}"
+                        server.service.note_resync(
+                            "", cause, header_trace
+                        )
+                        return self._send_bytes(
+                            wire.encode_resync(cause, version=4),
+                            "application/octet-stream",
+                        )
+                    trace_id = dreq.trace_id or header_trace
+                    try:
+                        deadline = float(
+                            self.headers.get("X-Planner-Deadline", 0)
+                            or 0
+                        )
+                    except (TypeError, ValueError):
+                        deadline = 0.0
+                    try:
+                        reply = server.service.submit_delta(
+                            dreq.tenant,
+                            dreq.delta,
+                            dreq.base_fingerprint,
+                            dreq.new_fingerprint,
+                            timeout_s=deadline or None,
+                            trace_id=trace_id,
+                        )
+                    except ResyncRequired as err:
+                        # counted + flight-evented at the demand site
+                        return self._send_bytes(
+                            wire.encode_resync(str(err), version=4),
+                            "application/octet-stream",
+                        )
+                    except ServiceBusy as err:
+                        return self._send_bytes(
+                            wire.encode_error(str(err), version=4),
+                            "application/octet-stream", 503,
+                            headers=[("Retry-After", str(err.retry_after))],
+                        )
+                    spans = (
+                        tracing.make_span("service.admit", 0.0, admit_ms),
+                        tracing.make_span(
+                            "service.decode", admit_ms, decode_ms
+                        ),
+                    ) + reply.spans
+                    t_enc = time.perf_counter()
+                    wire.encode_plan_reply(
+                        reply._replace(spans=spans), version=dreq.version
+                    )
+                    encode_ms = (time.perf_counter() - t_enc) * 1e3
+                    spans = spans + (
+                        tracing.make_span("service.encode", 0.0, encode_ms),
+                    )
+                    server.note_request_trace(trace_id, dreq.tenant, spans)
+                    return self._send_bytes(
+                        wire.encode_plan_reply(
+                            reply._replace(spans=spans),
+                            version=dreq.version,
+                        ),
+                        "application/octet-stream",
+                    )
+                except Exception as err:  # noqa: BLE001 — handler survives
+                    log.error("service /v2/plan (delta) failed: %s", err)
+                    metrics.update_service_request("error")
+                    return self._send_bytes(
+                        wire.encode_error(str(err), version=4),
                         "application/octet-stream", 500,
                     )
                 finally:

@@ -64,25 +64,44 @@ Version history
   (the reply mirrors the request's version, so v1/v2 agents can never
   receive a kind they do not decode), and a v3-aware peer may rely on
   the service honoring ``schedule_horizon``.
+- **4** — the delta wire (docs/ROBUSTNESS.md "Wire anti-entropy"):
+  ``KIND_PACKED_DELTA`` — shipped by nothing before this version —
+  becomes a REAL plan request: it must carry ``base_fingerprint`` (the
+  pack the delta diffs from), ``new_fingerprint`` (the pack it
+  produces) and ``delta_digest`` (sha256 over both fingerprints and
+  every delta tensor — verified at decode, so a corrupted-in-flight
+  delta is a typed error, never wrong tensors), and may carry the v2
+  ``trace_id``. PLAN_REQUEST may carry an optional
+  ``pack_fingerprint`` frame seeding the service's tenant cache. A NEW
+  reply kind ``KIND_RESYNC`` answers a delta whose base the service
+  cannot honor (restart, eviction, fingerprint mismatch, any
+  decode/apply anomaly): a ``cause`` string demanding one full-pack
+  resync. The bump marks the reply-kind contract once more: only a
+  version-4 delta request may be answered with KIND_RESYNC, and a
+  pre-v4 KIND_PACKED_DELTA (which nothing ever sent) is refused at
+  decode — it carries no fingerprints, so it can neither be verified
+  nor answered with a resync the sender would decode.
 """
 
 from __future__ import annotations
 
+import hashlib
 import struct
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 MAGIC = b"KSRW"
-WIRE_VERSION = 3
-SUPPORTED_VERSIONS = (1, 2, 3)
+WIRE_VERSION = 4
+SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 # message kinds (u8). New kinds append; renumbering is a version bump.
 KIND_PLAN_REQUEST = 1  # agent -> service: tenant + PackedCluster
 KIND_PLAN_REPLY = 2  # service -> agent: selection + batch telemetry
-KIND_PACKED_DELTA = 3  # agent -> service: tenant + PackedDelta
+KIND_PACKED_DELTA = 3  # agent -> service: tenant + PackedDelta (v4)
 KIND_ERROR = 4  # service -> agent: typed error text
 KIND_PLAN_SCHEDULE = 5  # service -> agent: whole drain schedule (v3)
+KIND_RESYNC = 6  # service -> agent: delta base unusable; full pack (v4)
 
 # dtype table (u8 code <-> numpy dtype). Append-only; reordering is a
 # version bump. bool travels as its own code (1 byte/element) so the
@@ -197,7 +216,7 @@ def decode_frames_v(data: bytes) -> Tuple[int, int, Dict[str, np.ndarray]]:
         )
     if kind not in (
         KIND_PLAN_REQUEST, KIND_PLAN_REPLY, KIND_PACKED_DELTA, KIND_ERROR,
-        KIND_PLAN_SCHEDULE,
+        KIND_PLAN_SCHEDULE, KIND_RESYNC,
     ):
         raise WireError(f"unknown message kind {kind}")
     if n_frames > MAX_FRAMES:
@@ -302,12 +321,15 @@ def encode_plan_request(
     trace_id: str = "",
     version: Optional[int] = None,
     schedule_horizon: int = 0,
+    pack_fingerprint: str = "",
 ) -> bytes:
     """Agent -> service: one tenant's full packed problem, optionally
     stamped with the agent's tick trace ID (wire v2; omitted when empty
-    or when encoding a version-1 message for an old server) and an
+    or when encoding a version-1 message for an old server), an
     optional ``schedule_horizon`` (wire v3: ask for a whole drain
-    schedule back — KIND_PLAN_SCHEDULE — instead of a single plan)."""
+    schedule back — KIND_PLAN_SCHEDULE — instead of a single plan),
+    and an optional ``pack_fingerprint`` (wire v4: seed the service's
+    tenant cache so the NEXT tick may ship a delta)."""
     version = WIRE_VERSION if version is None else int(version)
     frames: List[Tuple[str, np.ndarray]] = [("tenant", _str_frame(tenant))]
     frames.extend((f, getattr(packed, f)) for f in type(packed)._fields)
@@ -317,6 +339,8 @@ def encode_plan_request(
         frames.append(
             ("schedule_horizon", np.array([schedule_horizon], "<i4"))
         )
+    if pack_fingerprint and version >= 4:
+        frames.append(("pack_fingerprint", _str_frame(pack_fingerprint)))
     return encode_frames(KIND_PLAN_REQUEST, frames, version=version)
 
 
@@ -341,15 +365,18 @@ def _check_tensor_fields(frames, dtypes, ranks, what):
 
 class PlanRequest(NamedTuple):
     """A fully-decoded plan request: its protocol version (the reply
-    mirrors it), tenant, problem tensors, the optional trace ID, and
-    the optional drain-schedule horizon (0 = an ordinary single-plan
-    request; > 0 = answer with KIND_PLAN_SCHEDULE, wire v3)."""
+    mirrors it), tenant, problem tensors, the optional trace ID, the
+    optional drain-schedule horizon (0 = an ordinary single-plan
+    request; > 0 = answer with KIND_PLAN_SCHEDULE, wire v3), and the
+    optional pack fingerprint (wire v4: seed the tenant cache; empty =
+    the agent does not speak the delta wire)."""
 
     version: int
     tenant: str
     packed: object  # PackedCluster
     trace_id: str
     schedule_horizon: int = 0
+    pack_fingerprint: str = ""
 
 
 def decode_plan_request(data: bytes):
@@ -396,6 +423,20 @@ def decode_plan_request_ex(data: bytes) -> PlanRequest:
                 f"plan request schedule_horizon {schedule_horizon} "
                 "must be >= 1 when present"
             )
+    pack_fingerprint = ""
+    if "pack_fingerprint" in frames:
+        if version < 4:
+            # same contract as schedule_horizon above: the frame's
+            # meaning (cache seeding + the KIND_RESYNC answer path) is
+            # a v4 contract; a pre-v4 request carrying it is out of
+            # contract and refused at decode (clean 400)
+            raise WireError(
+                f"pack_fingerprint frame requires wire version >= 4 "
+                f"(request is version {version})"
+            )
+        pack_fingerprint = _frame_str(
+            frames["pack_fingerprint"], "pack fingerprint"
+        )
     t = _check_tensor_fields(frames, _PACKED_DTYPES, _PACKED_RANKS, "plan request")
     C, K, R = t["slot_req"].shape
     S = t["spot_free"].shape[0]
@@ -415,29 +456,129 @@ def decode_plan_request_ex(data: bytes) -> PlanRequest:
                 f"A={A}) — expected {shape}"
             )
     return PlanRequest(
-        version, tenant, PackedCluster(**t), trace_id, schedule_horizon
+        version, tenant, PackedCluster(**t), trace_id, schedule_horizon,
+        pack_fingerprint,
     )
 
 
-def encode_packed_delta(tenant: str, delta, version: Optional[int] = None) -> bytes:
-    """Agent -> service: a churn-proportional PackedDelta (the wire
-    twin of the device-resident scatter path; a future delta-shipping
-    agent sends this instead of the full pack when shapes are stable)."""
+def delta_digest(base_fingerprint: str, new_fingerprint: str, delta) -> str:
+    """Integrity digest of one delta message: sha256 over both
+    fingerprints and every delta tensor's shape + little-endian bytes.
+    Computed by the encoder and REVERIFIED at decode — a bit flipped
+    anywhere in the fingerprints or the churn payload is a typed
+    :class:`WireError` (the service answers with a resync demand),
+    never silently-wrong tensors scattered into a tenant's cached
+    state. O(churn) to compute, like the delta itself. The per-tensor
+    hash step is models/columnar.update_tensor_digest — the SAME
+    routine behind pack_fingerprint, so the two sides of the
+    anti-entropy protocol can never drift apart."""
+    from k8s_spot_rescheduler_tpu.models.columnar import (
+        update_tensor_digest,
+    )
+
+    h = hashlib.sha256()
+    h.update(base_fingerprint.encode("utf-8"))
+    h.update(new_fingerprint.encode("utf-8"))
+    for f in type(delta)._fields:
+        update_tensor_digest(h, f, getattr(delta, f))
+    return h.hexdigest()
+
+
+def encode_packed_delta(
+    tenant: str,
+    delta,
+    version: Optional[int] = None,
+    *,
+    base_fingerprint: str = "",
+    new_fingerprint: str = "",
+    trace_id: str = "",
+) -> bytes:
+    """Agent -> service: a churn-proportional PackedDelta — since wire
+    v4 a real plan request carrying the base/new pack fingerprints and
+    an integrity digest (see :func:`delta_digest`). Encoding for a
+    pre-v4 version drops the fingerprint/digest/trace frames (the
+    additive-bump proof: pre-v4 bytes stay exactly what those builds
+    shipped); encoding v4 REQUIRES both fingerprints — a v4 delta
+    without them could be neither verified nor safely applied."""
+    version = WIRE_VERSION if version is None else int(version)
     frames: List[Tuple[str, np.ndarray]] = [("tenant", _str_frame(tenant))]
     frames.extend((f, getattr(delta, f)) for f in type(delta)._fields)
+    if version >= 4:
+        if not base_fingerprint or not new_fingerprint:
+            raise WireError(
+                "a version-4 packed delta requires base_fingerprint "
+                "and new_fingerprint"
+            )
+        frames.append(("base_fingerprint", _str_frame(base_fingerprint)))
+        frames.append(("new_fingerprint", _str_frame(new_fingerprint)))
+        frames.append((
+            "delta_digest",
+            _str_frame(
+                delta_digest(base_fingerprint, new_fingerprint, delta)
+            ),
+        ))
+        if trace_id:
+            frames.append(("trace_id", _str_frame(trace_id)))
     return encode_frames(KIND_PACKED_DELTA, frames, version=version)
 
 
+class DeltaRequest(NamedTuple):
+    """A fully-decoded (and digest-verified) delta plan request."""
+
+    version: int
+    tenant: str
+    delta: object  # PackedDelta
+    base_fingerprint: str
+    new_fingerprint: str
+    trace_id: str = ""
+
+
 def decode_packed_delta(data: bytes):
-    """(tenant, PackedDelta) from KIND_PACKED_DELTA bytes."""
+    """(tenant, PackedDelta) from KIND_PACKED_DELTA bytes; see
+    :func:`decode_packed_delta_ex` for the fingerprints."""
+    req = decode_packed_delta_ex(data)
+    return req.tenant, req.delta
+
+
+def decode_packed_delta_ex(data: bytes) -> DeltaRequest:
+    """Full decode of KIND_PACKED_DELTA bytes. Requires wire version
+    >= 4 (nothing ever sent the kind before v4, and a pre-v4 delta
+    carries no fingerprints — unverifiable, and its sender could not
+    decode the KIND_RESYNC answer); verifies the delta digest, so a
+    message that decodes is bit-exact as sent."""
     from k8s_spot_rescheduler_tpu.models.columnar import PackedDelta
 
-    kind, frames = decode_frames(data)
+    version, kind, frames = decode_frames_v(data)
     if kind != KIND_PACKED_DELTA:
         raise WireError(f"expected PACKED_DELTA, got kind {kind}")
+    if version < 4:
+        raise WireError(
+            f"packed delta over the wire requires version >= 4 "
+            f"(request is version {version}; pre-v4 builds never sent "
+            "this kind)"
+        )
     tenant = _frame_str(frames.get("tenant", np.zeros(0, np.uint8)), "tenant id")
     if not tenant:
         raise WireError("packed delta carries no tenant id")
+    base_fp = _frame_str(
+        frames.get("base_fingerprint", np.zeros(0, np.uint8)),
+        "base fingerprint",
+    )
+    new_fp = _frame_str(
+        frames.get("new_fingerprint", np.zeros(0, np.uint8)),
+        "new fingerprint",
+    )
+    digest = _frame_str(
+        frames.get("delta_digest", np.zeros(0, np.uint8)), "delta digest"
+    )
+    if not base_fp or not new_fp or not digest:
+        raise WireError(
+            "packed delta missing base_fingerprint / new_fingerprint / "
+            "delta_digest frame(s)"
+        )
+    trace_id = ""
+    if "trace_id" in frames:
+        trace_id = _frame_str(frames["trace_id"], "trace id")
     t = _check_tensor_fields(frames, _DELTA_DTYPES, {}, "packed delta")
     for sec in (
         ("lanes", "lane_slot_req", "lane_slot_valid", "lane_slot_tol",
@@ -453,7 +594,59 @@ def decode_packed_delta(data: bytes):
                     f"packed delta frame {name!r}: leading dim "
                     f"{t[name].shape[0]} != section length {n}"
                 )
-    return tenant, PackedDelta(**t)
+    delta = PackedDelta(**t)
+    want = delta_digest(base_fp, new_fp, delta)
+    if digest != want:
+        raise WireError(
+            "packed delta digest mismatch (message corrupted in "
+            "flight); a full-pack resync is required"
+        )
+    return DeltaRequest(version, tenant, delta, base_fp, new_fp, trace_id)
+
+
+class ResyncDemand(NamedTuple):
+    """Service -> agent (KIND_RESYNC, v4): the delta's base state is
+    unusable server-side — restart, cache eviction, fingerprint
+    mismatch, or a decode/apply anomaly. ``cause`` says which; the
+    agent answers with exactly one full-pack request."""
+
+    cause: str
+
+
+def encode_resync(cause: str, version: Optional[int] = None) -> bytes:
+    version = WIRE_VERSION if version is None else int(version)
+    if version < 4:
+        raise WireError(
+            f"KIND_RESYNC requires wire version >= 4, got {version} "
+            "(a pre-v4 peer never sent a delta)"
+        )
+    return encode_frames(
+        KIND_RESYNC, [("cause", _str_frame(cause))], version=version
+    )
+
+
+def decode_resync(data: bytes) -> ResyncDemand:
+    kind, frames = decode_frames(data)
+    if kind != KIND_RESYNC:
+        raise WireError(f"expected RESYNC, got kind {kind}")
+    return ResyncDemand(
+        _frame_str(frames.get("cause", np.zeros(0, np.uint8)), "resync cause")
+    )
+
+
+def decode_plan_or_resync(data: bytes):
+    """The decoder a delta-shipping agent applies to a delta request's
+    answer: a :class:`PlanReply` (the delta applied and rode a batch)
+    or a :class:`ResyncDemand` (send one full pack). Anything else is
+    a typed WireError like every other out-of-contract reply."""
+    kind, frames = decode_frames(data)
+    if kind == KIND_RESYNC:
+        return ResyncDemand(
+            _frame_str(
+                frames.get("cause", np.zeros(0, np.uint8)), "resync cause"
+            )
+        )
+    return decode_plan_reply(data)
 
 
 # ---------------------------------------------------------------------------

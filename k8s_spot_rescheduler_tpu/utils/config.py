@@ -208,6 +208,17 @@ class ReschedulerConfig:
     # Per-plan HTTP deadline of the agent's service call; past it the
     # tick falls back locally rather than stall the control loop.
     planner_timeout: float = 10.0
+    # Delta wire (docs/ROBUSTNESS.md "Wire anti-entropy", wire v4): a
+    # RemotePlanner agent ships each tick's churn-proportional
+    # PackedDelta instead of the full pack whenever the endpoint it is
+    # about to try acknowledged the exact previous pack (fingerprint-
+    # tracked per endpoint — failover forces a full pack by itself).
+    # The service applies deltas to its fingerprinted per-tenant
+    # device-resident cache; ANY disagreement — restart, eviction,
+    # mismatch, corruption — is answered with a typed resync demand
+    # and costs one full pack, never a wrong plan. Off = every tick
+    # ships the full pack (the pre-v4 behavior).
+    delta_wire_enabled: bool = True
     # Device-health watchdog (service/devhealth.py): consecutive
     # slower-than-baseline batched solves before the planner service
     # declares its accelerator sick and flips to the numpy-oracle host

@@ -1,0 +1,484 @@
+"""Delta wire (wire v4) tests: the fingerprinted tenant cache and the
+batched device scatter on the service (service/server.py), the
+delta-shipping agent with per-endpoint fingerprint tracking
+(service/agent.py), and the tenant-mesh sharding of the batched
+schedule program (parallel/tenant_batch.py).
+
+The byte-level protocol is pinned in tests/test_wire_fixtures.py; the
+O(churn)-bytes-per-tick acceptance runs as ``make serve-smoke`` and the
+corrupted-delta/failover resync accounting as ``make
+fleet-chaos-smoke`` (bench.serve_smoke / bench.fleet_chaos_smoke)."""
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.models.columnar import (
+    apply_packed_delta,
+    emit_packed_delta,
+    pack_fingerprint,
+)
+from k8s_spot_rescheduler_tpu.service import buckets as bucketing
+from k8s_spot_rescheduler_tpu.service import wire
+from k8s_spot_rescheduler_tpu.service.server import (
+    PlannerService,
+    ResyncRequired,
+    ServiceServer,
+)
+from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.test_service import _observation, tiny_packed
+
+
+def _service(clock=None, **kwargs) -> PlannerService:
+    return PlannerService(
+        ReschedulerConfig(solver="numpy"),
+        clock=clock or FakeClock(),
+        batch_window_s=0,
+        **kwargs,
+    )
+
+
+def _resync_count():
+    return metrics.service_snapshot()["delta_requests"].get("resync", 0)
+
+
+# ---------------------------------------------------------------------------
+# service: cache + apply + resync semantics
+
+
+def test_delta_applies_bit_identical_to_full_pack():
+    """A full pack seeds the cache; subsequent deltas produce replies
+    bit-identical to shipping the full new pack — across several ticks
+    of churn, host path."""
+    svc = _service()
+    p = tiny_packed(seed=3)
+    fp = pack_fingerprint(p)
+    svc.submit("t", p, pack_fingerprint=fp)
+    rng = np.random.default_rng(7)
+    for tick in range(4):
+        new = p._replace(
+            spot_free=(rng.random(p.spot_free.shape) * 100).astype(
+                np.float32
+            ),
+            cand_valid=rng.random(2) < 0.8,
+        )
+        new_fp = pack_fingerprint(new)
+        delta = emit_packed_delta(p, new)
+        got = svc.submit_delta("t", delta, fp, new_fp)
+        want = svc.submit(f"oracle-{tick}", new, pack_fingerprint=new_fp)
+        assert (got.found, got.index, got.n_feasible) == (
+            want.found, want.index, want.n_feasible,
+        ), tick
+        np.testing.assert_array_equal(got.row, want.row)
+        p, fp = new, new_fp
+    # the host mirror converged on exactly the last pack
+    entry = svc._tenant_cache["t"]
+    padded = bucketing.pad_to_bucket(p, entry.bucket)
+    for f in padded._fields:
+        np.testing.assert_array_equal(
+            getattr(entry.host, f), getattr(padded, f), err_msg=f
+        )
+
+
+def test_delta_mismatch_eviction_and_restart_cause():
+    """Every anti-entropy edge answers with a typed resync whose cause
+    names the real reason — and the resync metric fires once per
+    demand."""
+    import dataclasses
+    import tempfile
+
+    state_dir = tempfile.mkdtemp(prefix="delta-wire-state-")
+    cfg = dataclasses.replace(
+        ReschedulerConfig(solver="numpy"), service_state_dir=state_dir
+    )
+    svc = PlannerService(cfg, clock=FakeClock(), batch_window_s=0)
+    p = tiny_packed(seed=5)
+    fp = pack_fingerprint(p)
+    new = p._replace(spot_count=p.spot_count + 1)
+    new_fp = pack_fingerprint(new)
+    delta = emit_packed_delta(p, new)
+
+    # unknown tenant (first contact)
+    before = _resync_count()
+    with pytest.raises(ResyncRequired, match="no cached state"):
+        svc.submit_delta("t", delta, fp, new_fp)
+    svc.submit("t", p, pack_fingerprint=fp)
+    # fingerprint mismatch
+    with pytest.raises(ResyncRequired, match="fingerprint mismatch"):
+        svc.submit_delta("t", delta, "0" * 64, new_fp)
+    # eviction
+    assert svc.invalidate_tenant_cache("t") == 1
+    with pytest.raises(ResyncRequired, match="no cached state"):
+        svc.submit_delta("t", delta, fp, new_fp)
+    assert _resync_count() == before + 3
+    # warm restart: fingerprints persist, content does not — the new
+    # replica's resync names the restart as the cause
+    svc.submit("t", p, pack_fingerprint=fp)
+    assert svc.save_state()
+    svc2 = PlannerService(cfg, clock=FakeClock(), batch_window_s=0)
+    svc2.warm_start()
+    with pytest.raises(ResyncRequired, match="restart"):
+        svc2.submit_delta("t", delta, fp, new_fp)
+    # after the full-pack resync the delta path works again
+    svc2.submit("t", p, pack_fingerprint=fp)
+    reply = svc2.submit_delta("t", delta, fp, new_fp)
+    assert reply.n_feasible >= 0
+
+
+def test_delta_cache_pruned_with_tenant_ttl():
+    """The tenant cache rides the tenant-state TTL: a tenant whose
+    last plan aged out loses its cached packed state too."""
+    from k8s_spot_rescheduler_tpu.service import server as srv
+
+    clock = FakeClock()
+    svc = _service(clock)
+    p = tiny_packed()
+    svc.submit("old", p, pack_fingerprint=pack_fingerprint(p))
+    assert "old" in svc._tenant_cache
+    clock.advance(srv.TENANT_STATE_TTL_S + 10)
+    svc.submit("fresh", p, pack_fingerprint=pack_fingerprint(p))
+    assert "old" not in svc._tenant_cache
+    assert "fresh" in svc._tenant_cache
+    assert metrics.service_snapshot()["tenant_cache_entries"] == 1
+
+
+def test_delta_request_without_fingerprint_not_cached():
+    """A full pack WITHOUT a fingerprint (delta wire off, or an old
+    agent) seeds nothing — the cache only ever holds states whose
+    content is named."""
+    svc = _service()
+    svc.submit("plain", tiny_packed())
+    assert "plain" not in svc._tenant_cache
+
+
+def test_delta_malformed_apply_is_resync_not_crash():
+    """A delta whose indices are out of the cached bucket's range is
+    refused with a resync demand (numpy would WRAP a negative index
+    where the device scatter drops it — neither may happen)."""
+    svc = _service()
+    p = tiny_packed(seed=9)
+    fp = pack_fingerprint(p)
+    svc.submit("t", p, pack_fingerprint=fp)
+    new = p._replace(spot_count=p.spot_count + 1)
+    delta = emit_packed_delta(p, new)
+    bad = delta._replace(spot_rows=np.array([-1], np.int32))
+    with pytest.raises(ResyncRequired, match="out of range"):
+        svc.submit_delta("t", bad, fp, pack_fingerprint(new))
+    # the cached state is untouched and still serves the honest delta
+    reply = svc.submit_delta("t", delta, fp, pack_fingerprint(new))
+    assert reply.n_feasible >= 0
+
+
+def test_host_path_delta_drops_stale_device_twin():
+    """A delta applied on the HOST path (sick watchdog) must drop the
+    tenant's device-resident twin: a post-recovery scatter would
+    otherwise build on a base missing the sick-window churn — wrong
+    state under a MATCHING fingerprint, the one corruption the
+    resync-on-anything ladder could not catch."""
+    svc = PlannerService(
+        ReschedulerConfig(solver="jax"), clock=FakeClock(),
+        batch_window_s=0,
+    )
+    p = tiny_packed(seed=50)
+    fp = pack_fingerprint(p)
+    svc.submit("t", p, pack_fingerprint=fp)
+
+    def churn(prev, row):
+        sf = prev.spot_free.copy()
+        sf[row] += 1.0 + row
+        new = prev._replace(spot_free=sf)
+        return new, emit_packed_delta(prev, new), pack_fingerprint(new)
+
+    # healthy delta -> the batched scatter populates the device twin
+    new1, d1, fp1 = churn(p, 0)
+    svc.submit_delta("t", d1, fp, fp1)
+    entry = svc._tenant_cache["t"]
+    assert entry.device is not None
+    # sick window: the delta applies host-only; the twin must go
+    wd = svc._watchdog()
+    wd._flip_sick("test", "forced")
+    new2, d2, fp2 = churn(new1, 0)
+    svc.submit_delta("t", d2, fp1, fp2)
+    assert svc._tenant_cache["t"].device is None
+    # recovery: the next delta (touching a DIFFERENT row, so a stale
+    # twin could not be healed by overwrite) rebuilds the twin from
+    # the authoritative host mirror — device == host, field for field,
+    # and the reply matches an oracle tenant shipping the full pack
+    wd.sick = False
+    new3, d3, fp3 = churn(new2, 1)
+    got = svc.submit_delta("t", d3, fp2, fp3)
+    want = svc.submit("oracle", new3, pack_fingerprint=fp3)
+    assert (got.found, got.index, got.n_feasible) == (
+        want.found, want.index, want.n_feasible,
+    )
+    np.testing.assert_array_equal(got.row, want.row)
+    entry = svc._tenant_cache["t"]
+    assert entry.device is not None
+    for f in entry.host._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(entry.device, f)), getattr(entry.host, f),
+            err_msg=f,
+        )
+
+
+# ---------------------------------------------------------------------------
+# batched device scatter parity
+
+
+def test_batched_tenant_scatter_matches_host_apply():
+    """The jitted batched scatter (parallel/tenant_batch.
+    apply_tenant_deltas) applies T tenants' padded deltas exactly as
+    the host reference (models/columnar.apply_packed_delta), pad rows
+    dropped."""
+    from k8s_spot_rescheduler_tpu.models.columnar import (
+        empty_packed_delta,
+        pad_packed_delta,
+    )
+    from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+    from k8s_spot_rescheduler_tpu.parallel.tenant_batch import (
+        make_tenant_delta_applier,
+    )
+
+    rng = np.random.default_rng(11)
+    packs, deltas, wants = [], [], []
+    for i in range(3):
+        p = tiny_packed(seed=20 + i)
+        new = p._replace(
+            spot_free=(rng.random(p.spot_free.shape) * 50).astype(
+                np.float32
+            )
+        )
+        d = emit_packed_delta(p, new)
+        if i == 2:
+            d = empty_packed_delta(p)  # a zero-churn tenant in the mix
+            new = p
+        packs.append(p)
+        deltas.append(d)
+        wants.append(apply_packed_delta(p, d))
+    b = bucketing.bucket_for(packs[0])
+    stacked = bucketing.stack_bucket(
+        [bucketing.pad_to_bucket(p, b) for p in packs], b
+    )
+    padded = [
+        pad_packed_delta(
+            d, b.C, b.S, lane_rows=8, cand_rows=8, spot_rows=8, K=b.K
+        )
+        for d in deltas
+    ]
+    stacked_delta = type(padded[0])(
+        *(
+            np.stack([getattr(d, f) for d in padded])
+            for f in type(padded[0])._fields
+        )
+    )
+    out = make_tenant_delta_applier()(*stacked, stacked_delta)
+    for i, want in enumerate(wants):
+        want_padded = bucketing.pad_to_bucket(want, b)
+        got = PackedCluster(*(np.asarray(f[i]) for f in out))
+        for f in want_padded._fields:
+            np.testing.assert_array_equal(
+                getattr(got, f), getattr(want_padded, f),
+                err_msg=f"tenant {i} field {f}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# agent: delta emission, resync retry, failover forces a full pack
+
+
+def _recording_agent(cfg, urls, tenant="c1"):
+    from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+
+    agent = RemotePlanner(cfg, urls, tenant=tenant)
+    kinds = []
+    inner = agent.transport
+
+    def rec(url, body, headers, timeout):
+        kinds.append((url, body[5]))
+        return inner(url, body, headers, timeout)
+
+    agent.transport = rec
+    return agent, kinds
+
+
+def test_agent_ships_delta_then_resyncs_then_recovers():
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=5.0)
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server.start_background()
+    try:
+        node_map, pdbs = _observation()
+        agent, kinds = _recording_agent(cfg, f"http://{server.address}")
+        want = agent.plan(node_map, pdbs)
+        r2 = agent.plan(node_map, pdbs)
+        assert [k for _, k in kinds] == [
+            wire.KIND_PLAN_REQUEST, wire.KIND_PACKED_DELTA,
+        ]
+        assert r2.solver == "remote"
+        assert dict(r2.plan.assignments) == dict(want.plan.assignments)
+        # forced resync (cache dropped server-side): ONE delta attempt,
+        # ONE full-pack retry on the same endpoint, a correct plan, and
+        # the next tick ships deltas again
+        server.service.invalidate_tenant_cache()
+        before = _resync_count()
+        r3 = agent.plan(node_map, pdbs)
+        assert _resync_count() == before + 1
+        assert r3.solver == "remote"
+        assert dict(r3.plan.assignments) == dict(want.plan.assignments)
+        assert [k for _, k in kinds[2:]] == [
+            wire.KIND_PACKED_DELTA, wire.KIND_PLAN_REQUEST,
+        ]
+        agent.plan(node_map, pdbs)
+        assert kinds[-1][1] == wire.KIND_PACKED_DELTA
+    finally:
+        server.close()
+
+
+def test_agent_failover_forces_full_pack():
+    """Per-endpoint fingerprint tracking: replica B never acknowledged
+    the agent's pack, so the failover tick ships it a FULL pack by
+    construction — no resync round trip, no wrong base."""
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=5.0)
+    a = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    b = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    a.start_background()
+    b.start_background()
+    try:
+        node_map, pdbs = _observation()
+        agent, kinds = _recording_agent(
+            cfg, f"http://{a.address},http://{b.address}"
+        )
+        want = agent.plan(node_map, pdbs)  # full to A
+        agent.plan(node_map, pdbs)  # delta to A
+        a.close()
+        r = agent.plan(node_map, pdbs)  # A dead -> B serves
+        assert r.solver == "remote"
+        assert dict(r.plan.assignments) == dict(want.plan.assignments)
+        b_url = f"http://{b.address}"
+        b_kinds = [k for url, k in kinds if url.startswith(b_url)]
+        assert b_kinds == [wire.KIND_PLAN_REQUEST]
+        # and B, having acknowledged, now gets deltas
+        r2 = agent.plan(node_map, pdbs)
+        assert r2.solver == "remote"
+        assert [k for url, k in kinds if url.startswith(b_url)] == [
+            wire.KIND_PLAN_REQUEST, wire.KIND_PACKED_DELTA,
+        ]
+    finally:
+        for srv in (a, b):
+            try:
+                srv.close()
+            except Exception:  # noqa: BLE001 — a may already be closed
+                pass
+
+
+def test_agent_delta_wire_disabled_ships_full_packs():
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        ReschedulerConfig(solver="numpy", planner_timeout=5.0),
+        delta_wire_enabled=False,
+    )
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server.start_background()
+    try:
+        node_map, pdbs = _observation()
+        agent, kinds = _recording_agent(cfg, f"http://{server.address}")
+        agent.plan(node_map, pdbs)
+        agent.plan(node_map, pdbs)
+        assert [k for _, k in kinds] == [
+            wire.KIND_PLAN_REQUEST, wire.KIND_PLAN_REQUEST,
+        ]
+        assert len(server.service._tenant_cache) == 0
+    finally:
+        server.close()
+
+
+def test_corrupted_delta_over_http_forces_resync_not_wrong_plan():
+    """A delta corrupted in flight (one bit flipped ahead of the
+    decode — the ServiceChaos hook's fault) fails the digest, the
+    service demands a resync, and the agent's SAME-tick full-pack
+    retry still produces the correct plan."""
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=5.0)
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server.start_background()
+    try:
+        node_map, pdbs = _observation()
+        agent, kinds = _recording_agent(cfg, f"http://{server.address}")
+        want = agent.plan(node_map, pdbs)
+
+        inner = agent.transport
+
+        def corrupt_deltas_once(url, body, headers, timeout):
+            if body[5] == wire.KIND_PACKED_DELTA:
+                mutated = bytearray(body)
+                mutated[len(mutated) // 2] ^= 0x10
+                body = bytes(mutated)
+            return inner(url, body, headers, timeout)
+
+        agent.transport = corrupt_deltas_once
+        before = _resync_count()
+        r = agent.plan(node_map, pdbs)
+        assert _resync_count() == before + 1
+        assert r.solver == "remote"
+        assert dict(r.plan.assignments) == dict(want.plan.assignments)
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# tenant-mesh sharding of the batched schedule program (ROADMAP 1 tail)
+
+
+def test_schedule_batch_shards_over_tenant_mesh_and_matches_vmap():
+    """The batched drain-schedule program sharded over the tenant mesh
+    (8 virtual CPU devices via conftest) is identical to the plain
+    single-device vmap program, tenant for tenant, step for step."""
+    import jax
+
+    if len(jax.devices()) <= 1:
+        pytest.skip("needs >1 device")
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_tenant_mesh
+    from k8s_spot_rescheduler_tpu.parallel.tenant_batch import (
+        make_tenant_schedule_planner,
+    )
+
+    mesh = make_tenant_mesh()
+    n = int(mesh.devices.size)
+    packs = [tiny_packed(seed=30 + i) for i in range(n)]
+    b = bucketing.bucket_for(packs[0])
+    stacked = bucketing.stack_bucket(
+        [bucketing.pad_to_bucket(p, b) for p in packs], b
+    )
+    sharded = np.asarray(
+        make_tenant_schedule_planner(mesh, horizon=4, rounds=8)(stacked)
+    )
+    ref = np.asarray(
+        make_tenant_schedule_planner(None, horizon=4, rounds=8)(stacked)
+    )
+    assert sharded.shape == ref.shape == (n, 4, 3 + b.K)
+    np.testing.assert_array_equal(sharded, ref)
+
+
+def test_service_schedule_batch_pads_tenants_to_mesh_multiple():
+    """The service-side schedule solve pads the tenant axis to a
+    device multiple (all-invalid problems) and trims the pad back off
+    — same contract as the single-plan batch."""
+    import jax
+
+    if len(jax.devices()) <= 1:
+        pytest.skip("needs >1 device")
+    svc = PlannerService(
+        ReschedulerConfig(solver="jax"), clock=FakeClock(),
+        batch_window_s=0,
+    )
+    packs = [tiny_packed(seed=40 + i) for i in range(3)]  # 3 % 8 != 0
+    b = bucketing.bucket_for(packs[0])
+    stacked = bucketing.stack_bucket(
+        [bucketing.pad_to_bucket(p, b) for p in packs], b
+    )
+    svc._ensure_mesh()
+    assert svc._mesh is not None
+    out = svc._solve_schedule_batch(stacked, horizon=3)
+    assert out.shape == (3, 3, 3 + b.K)
+    host = svc._solve_schedule_host(stacked, 3)
+    np.testing.assert_array_equal(out, host)

@@ -301,7 +301,9 @@ GOLDEN_V2_REQUEST_HEAD_HEX = "4b53525702010c00060074656e616e74"
 # Version byte 3, plus the OPTIONAL schedule_horizon request frame and
 # the NEW KIND_PLAN_SCHEDULE reply (steps matrix + batch telemetry +
 # the v2 span block). Present-and-absent variants of every optional
-# frame are pinned.
+# frame are pinned. Since the v4 bump these encode via an explicit
+# version=3 — and must stay BIT-IDENTICAL to what v3 builds shipped
+# (the additive-bump proof, same as the v1/v2 goldens before them).
 GOLDEN_V3_REQUEST_SHA256 = (
     "b712ab3b1d2cdd1298e5ea07113e1cce2de6032e1e94c8d5bc8683b46e7d30dc"
 )
@@ -321,6 +323,43 @@ GOLDEN_V3_DELTA_SHA256 = (
     "c129254a3d290488f6ddbc257bcc2d1a55461792cc2eb91134ad8abd65b59e30"
 )
 GOLDEN_V3_REQUEST_HEAD_HEX = "4b53525703010c00060074656e616e74"
+
+# --- version-4 goldens (the delta wire, ISSUE 12) ---
+# Version byte 4: KIND_PACKED_DELTA becomes a real plan request
+# (REQUIRED base/new fingerprints + integrity digest, optional
+# trace_id), PLAN_REQUEST gains the optional pack_fingerprint frame,
+# and the NEW KIND_RESYNC reply demands a full-pack resync. Pinned
+# with the delta's churn frames both present (the golden delta) and
+# absent (the all-empty zero-churn delta — the fixed-size message a
+# quiet tick ships), and every optional request frame both ways.
+GOLDEN_V4_REQUEST_SHA256 = (
+    "16225da38838ef5ab48394885c043e8abee4e25857223748f0b57b2e6f1ee260"
+)
+GOLDEN_V4_REQUEST_FULL_SHA256 = (  # trace + schedule_horizon + pack fp
+    "e3c8c7de9644c53042553872acd12897ca1c3c2a3e49b44fb804a008a835aac0"
+)
+GOLDEN_V4_DELTA_SHA256 = (
+    "145bdbdc50af0f06e7b5a8e001b03da228a97277e2838242aaf1f7b5b40e074e"
+)
+GOLDEN_V4_DELTA_TRACE_SHA256 = (
+    "d0e4cd4302333906460e5ab60ff96785da9dd0db3b04118f5faa9f1b802493ba"
+)
+GOLDEN_V4_DELTA_EMPTY_SHA256 = (  # zero churn: every section length 0
+    "a837091e65ee7c22bbcad1694f3027c89b080704c92ff38cadfe957da06e3085"
+)
+GOLDEN_V4_RESYNC_SHA256 = (
+    "3f629a2be75c6f8509d11530e4aa3e72bbfd5157870ae79ff2aa50112e03adc7"
+)
+GOLDEN_V4_REPLY_SHA256 = (
+    "3769605cf81595336e0a2df98f0b7eb348d2f90ff92b84917dc6f09bacde60f2"
+)
+GOLDEN_V4_SCHEDULE_SHA256 = (
+    "a5b4f95ecee528e3de9a42df525395a97d9a5a361b32984566e93d3bc41b8dfa"
+)
+GOLDEN_V4_REQUEST_HEAD_HEX = "4b53525704010c00060074656e616e74"
+GOLDEN_BASE_FP = "f0" * 32
+GOLDEN_NEW_FP = "0f" * 32
+GOLDEN_RESYNC_CAUSE = "cached state lost; send a full pack"
 
 GOLDEN_TRACE_ID = "00f1e2d3c4b5a697"
 GOLDEN_SPANS = (
@@ -483,34 +522,53 @@ def test_wire_protocol_byte_golden_v2():
 
 
 def test_wire_protocol_byte_golden_v3():
-    """The current-version encodings, pinned with every optional frame
-    both absent and present — the schedule_horizon request frame and
-    the KIND_PLAN_SCHEDULE reply included: any layout change breaks
-    this test and must ship with a WIRE_VERSION decision (bump on
-    meaning change, golden refresh always)."""
+    """Version-3 encodings stay pinned to the digests v3 builds
+    shipped — like the v1/v2 goldens, the strongest proof the v4 bump
+    is purely additive on the wire for an un-upgraded peer."""
     import hashlib
 
     from k8s_spot_rescheduler_tpu.service import wire
 
-    assert wire.WIRE_VERSION == 3  # bumping? update every digest below
-    req = wire.encode_plan_request("golden-tenant", _golden_packed())
+    assert 3 in wire.SUPPORTED_VERSIONS
+    req = wire.encode_plan_request(
+        "golden-tenant", _golden_packed(), version=3
+    )
     assert hashlib.sha256(req).hexdigest() == GOLDEN_V3_REQUEST_SHA256
     assert req[:16].hex() == GOLDEN_V3_REQUEST_HEAD_HEX
     req_full = wire.encode_plan_request(
         "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID,
-        schedule_horizon=3,
+        schedule_horizon=3, version=3,
     )
     assert (
         hashlib.sha256(req_full).hexdigest() == GOLDEN_V3_REQUEST_FULL_SHA256
     )
-    delta = wire.encode_packed_delta("golden-tenant", _golden_delta())
+    # a pack fingerprint handed to a v3 encode is DROPPED, not
+    # smuggled: the bytes stay exactly the shipped v3 protocol
+    req_fp = wire.encode_plan_request(
+        "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID,
+        schedule_horizon=3, version=3, pack_fingerprint=GOLDEN_NEW_FP,
+    )
+    assert (
+        hashlib.sha256(req_fp).hexdigest() == GOLDEN_V3_REQUEST_FULL_SHA256
+    )
+    # the v3-encode-drops-delta proof: fingerprints/digest/trace are
+    # v4 frames — a v3 delta encode drops them all and stays the exact
+    # shipped bytes (nothing ever SENT a v3 delta; the encoder still
+    # must not let v4 state leak into v3 messages)
+    delta = wire.encode_packed_delta(
+        "golden-tenant", _golden_delta(), version=3,
+        base_fingerprint=GOLDEN_BASE_FP, new_fingerprint=GOLDEN_NEW_FP,
+        trace_id=GOLDEN_TRACE_ID,
+    )
     assert hashlib.sha256(delta).hexdigest() == GOLDEN_V3_DELTA_SHA256
-    reply = wire.encode_plan_reply(_golden_reply())
+    reply = wire.encode_plan_reply(_golden_reply(), version=3)
     assert hashlib.sha256(reply).hexdigest() == GOLDEN_V3_REPLY_SHA256
-    sched = wire.encode_plan_schedule_reply(_golden_schedule_reply())
+    sched = wire.encode_plan_schedule_reply(
+        _golden_schedule_reply(), version=3
+    )
     assert hashlib.sha256(sched).hexdigest() == GOLDEN_V3_SCHEDULE_SHA256
     sched_s = wire.encode_plan_schedule_reply(
-        _golden_schedule_reply(GOLDEN_SPANS)
+        _golden_schedule_reply(GOLDEN_SPANS), version=3
     )
     assert (
         hashlib.sha256(sched_s).hexdigest() == GOLDEN_V3_SCHEDULE_SPANS_SHA256
@@ -519,6 +577,75 @@ def test_wire_protocol_byte_golden_v3():
     # never asked for one, so encoding one for it is a caller bug
     with pytest.raises(wire.WireError):
         wire.encode_plan_schedule_reply(_golden_schedule_reply(), version=2)
+
+
+def _golden_empty_delta():
+    from k8s_spot_rescheduler_tpu.models.columnar import (
+        empty_packed_delta,
+    )
+
+    return empty_packed_delta(_golden_packed())
+
+
+def test_wire_protocol_byte_golden_v4():
+    """The current-version encodings, pinned with the delta's churn
+    frames both present and absent (the all-empty delta is the
+    fixed-size message a zero-churn tick ships — the O(churn) wire
+    claim at churn = 0) and every optional request frame both ways:
+    any layout change breaks this test and must ship with a
+    WIRE_VERSION decision (bump on meaning change, golden refresh
+    always)."""
+    import hashlib
+
+    from k8s_spot_rescheduler_tpu.service import wire
+
+    assert wire.WIRE_VERSION == 4  # bumping? update every digest below
+    req = wire.encode_plan_request("golden-tenant", _golden_packed())
+    assert hashlib.sha256(req).hexdigest() == GOLDEN_V4_REQUEST_SHA256
+    assert req[:16].hex() == GOLDEN_V4_REQUEST_HEAD_HEX
+    req_full = wire.encode_plan_request(
+        "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID,
+        schedule_horizon=3, pack_fingerprint=GOLDEN_NEW_FP,
+    )
+    assert (
+        hashlib.sha256(req_full).hexdigest() == GOLDEN_V4_REQUEST_FULL_SHA256
+    )
+    delta = wire.encode_packed_delta(
+        "golden-tenant", _golden_delta(),
+        base_fingerprint=GOLDEN_BASE_FP, new_fingerprint=GOLDEN_NEW_FP,
+    )
+    assert hashlib.sha256(delta).hexdigest() == GOLDEN_V4_DELTA_SHA256
+    delta_t = wire.encode_packed_delta(
+        "golden-tenant", _golden_delta(),
+        base_fingerprint=GOLDEN_BASE_FP, new_fingerprint=GOLDEN_NEW_FP,
+        trace_id=GOLDEN_TRACE_ID,
+    )
+    assert (
+        hashlib.sha256(delta_t).hexdigest() == GOLDEN_V4_DELTA_TRACE_SHA256
+    )
+    empty = wire.encode_packed_delta(
+        "golden-tenant", _golden_empty_delta(),
+        base_fingerprint=GOLDEN_BASE_FP, new_fingerprint=GOLDEN_NEW_FP,
+    )
+    assert (
+        hashlib.sha256(empty).hexdigest() == GOLDEN_V4_DELTA_EMPTY_SHA256
+    )
+    # the zero-churn message is small and FIXED-size: header + empty
+    # sections + fingerprints, no pack-shaped payload anywhere
+    assert len(empty) < 1024
+    resync = wire.encode_resync(GOLDEN_RESYNC_CAUSE)
+    assert hashlib.sha256(resync).hexdigest() == GOLDEN_V4_RESYNC_SHA256
+    reply = wire.encode_plan_reply(_golden_reply())
+    assert hashlib.sha256(reply).hexdigest() == GOLDEN_V4_REPLY_SHA256
+    sched = wire.encode_plan_schedule_reply(_golden_schedule_reply())
+    assert hashlib.sha256(sched).hexdigest() == GOLDEN_V4_SCHEDULE_SHA256
+    # a v4 delta encode REQUIRES its fingerprints (unverifiable
+    # otherwise), and a resync cannot be downgraded below v4 (a
+    # pre-v4 peer never sent a delta)
+    with pytest.raises(wire.WireError):
+        wire.encode_packed_delta("golden-tenant", _golden_delta())
+    with pytest.raises(wire.WireError):
+        wire.encode_resync(GOLDEN_RESYNC_CAUSE, version=3)
 
 
 def test_wire_protocol_roundtrip():
@@ -537,14 +664,34 @@ def test_wire_protocol_roundtrip():
         np.testing.assert_array_equal(got, want, err_msg=f)
 
     delta = _golden_delta()
-    tenant, ddec = wire.decode_packed_delta(
-        wire.encode_packed_delta("golden-tenant", delta)
-    )
-    assert tenant == "golden-tenant"
-    for f in ddec._fields:
-        np.testing.assert_array_equal(
-            getattr(ddec, f), getattr(delta, f), err_msg=f
+    dreq = wire.decode_packed_delta_ex(
+        wire.encode_packed_delta(
+            "golden-tenant", delta,
+            base_fingerprint=GOLDEN_BASE_FP,
+            new_fingerprint=GOLDEN_NEW_FP,
+            trace_id=GOLDEN_TRACE_ID,
         )
+    )
+    assert dreq.tenant == "golden-tenant"
+    assert dreq.base_fingerprint == GOLDEN_BASE_FP
+    assert dreq.new_fingerprint == GOLDEN_NEW_FP
+    assert dreq.trace_id == GOLDEN_TRACE_ID
+    for f in dreq.delta._fields:
+        np.testing.assert_array_equal(
+            getattr(dreq.delta, f), getattr(delta, f), err_msg=f
+        )
+
+    # the resync demand round-trips, and the delta-answer decoder
+    # returns whichever of the two reply shapes actually came back
+    demand = wire.decode_resync(wire.encode_resync("restart lost state"))
+    assert demand.cause == "restart lost state"
+    assert wire.decode_plan_or_resync(
+        wire.encode_resync("evicted")
+    ) == wire.ResyncDemand("evicted")
+    assert isinstance(
+        wire.decode_plan_or_resync(wire.encode_plan_reply(_golden_reply())),
+        wire.PlanReply,
+    )
 
     reply = _golden_reply()
     rdec = wire.decode_plan_reply(wire.encode_plan_reply(reply))
@@ -698,6 +845,43 @@ def test_wire_malformed_inputs_are_typed_errors():
     ok = wire.encode_frames(wire.KIND_PLAN_REQUEST, frames, version=3)
     assert wire.decode_plan_request_ex(ok).schedule_horizon == 4
 
+    # delta-wire contract violations are typed errors, never crashes:
+    # a pre-v4 request smuggling a pack_fingerprint frame
+    fp_frames = [("tenant", np.frombuffer(b"t", np.uint8))]
+    fp_frames.extend((f, getattr(packed, f)) for f in packed._fields)
+    fp_frames.append(
+        ("pack_fingerprint", np.frombuffer(b"ab" * 16, np.uint8))
+    )
+    smuggled_fp = wire.encode_frames(
+        wire.KIND_PLAN_REQUEST, fp_frames, version=3
+    )
+    with pytest.raises(wire.WireError):
+        wire.decode_plan_request_ex(smuggled_fp)
+    # a pre-v4 packed delta (nothing ever sent one; unverifiable)
+    d_frames = [("tenant", np.frombuffer(b"t", np.uint8))]
+    delta = _golden_delta()
+    d_frames.extend((f, getattr(delta, f)) for f in delta._fields)
+    with pytest.raises(wire.WireError):
+        wire.decode_packed_delta_ex(
+            wire.encode_frames(wire.KIND_PACKED_DELTA, d_frames, version=3)
+        )
+    # a v4 delta without its fingerprint/digest frames
+    with pytest.raises(wire.WireError):
+        wire.decode_packed_delta_ex(
+            wire.encode_frames(wire.KIND_PACKED_DELTA, d_frames, version=4)
+        )
+    # a v4 delta whose digest names different content (one payload
+    # byte flipped after the digest was computed)
+    good = wire.encode_packed_delta(
+        "t", delta,
+        base_fingerprint=GOLDEN_BASE_FP, new_fingerprint=GOLDEN_NEW_FP,
+    )
+    tampered = bytearray(good)
+    # flip a bit inside the lane_slot_req payload (well past the header)
+    tampered[200] ^= 0x40
+    with pytest.raises(wire.WireError):
+        wire.decode_packed_delta_ex(bytes(tampered))
+
 
 def test_wire_fuzz_corpus_typed_errors_only():
     """Seeded fuzz corpus over the byte-golden messages: every
@@ -717,14 +901,30 @@ def test_wire_fuzz_corpus_typed_errors_only():
     corpus = [
         ("request", wire.decode_plan_request_ex,
          wire.encode_plan_request(
-             "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID
+             "golden-tenant", _golden_packed(), trace_id=GOLDEN_TRACE_ID,
+             pack_fingerprint=GOLDEN_NEW_FP,
          )),
-        ("delta", wire.decode_packed_delta,
-         wire.encode_packed_delta("golden-tenant", _golden_delta())),
+        ("delta", wire.decode_packed_delta_ex,
+         wire.encode_packed_delta(
+             "golden-tenant", _golden_delta(),
+             base_fingerprint=GOLDEN_BASE_FP,
+             new_fingerprint=GOLDEN_NEW_FP,
+             trace_id=GOLDEN_TRACE_ID,
+         )),
+        ("empty-delta", wire.decode_packed_delta_ex,
+         wire.encode_packed_delta(
+             "golden-tenant", _golden_empty_delta(),
+             base_fingerprint=GOLDEN_BASE_FP,
+             new_fingerprint=GOLDEN_NEW_FP,
+         )),
         ("reply", wire.decode_plan_reply,
          wire.encode_plan_reply(_golden_reply()._replace(
              spans=GOLDEN_SPANS
          ))),
+        ("plan-or-resync", wire.decode_plan_or_resync,
+         wire.encode_plan_reply(_golden_reply())),
+        ("resync", wire.decode_plan_or_resync,
+         wire.encode_resync(GOLDEN_RESYNC_CAUSE)),
         ("schedule", wire.decode_plan_schedule_reply,
          wire.encode_plan_schedule_reply(
              _golden_schedule_reply(GOLDEN_SPANS)
