@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke serve-smoke sched-smoke chaos-smoke watch-soak fleet-chaos-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke scale-smoke serve-smoke sched-smoke chaos-smoke watch-soak fleet-chaos-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -19,7 +19,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
 # stand-in and tools/analysis is the go-vet analog, two tiers deep
 # (this image ships no Python linter and installs are forbidden).
-check: lint analyze audit-jaxpr test bench-smoke serve-smoke sched-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke
+check: lint analyze audit-jaxpr test bench-smoke scale-smoke serve-smoke sched-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke
 
 lint:
 	python tools/lint.py
@@ -62,6 +62,14 @@ bench:
 # fewer bytes than the first full-pack tick.
 bench-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --smoke --watchdog 600
+
+# Shape-only 20x proof (CPU, ~1 s): the dispatch ladder at the
+# 1M-pod/100k-node shapes must keep repair LIVE on the carry-streamed
+# narrow tier under the v5e per-device budget (honest estimator
+# breakdown asserted), and the streamed union must trace at the
+# per-device lane-block shapes — no device solve.
+scale-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --scale-smoke --watchdog 300
 
 # Multi-tenant planner-service smoke (CPU-only): >=4 synthetic tenant
 # agents plan concurrently through one in-process service over real

@@ -860,6 +860,153 @@ def _span_ms_median(traces, name: str) -> float:
     return float(np.median(totals)) if totals else 0.0
 
 
+def run_scale_smoke(args, metric: str, unit: str) -> int:
+    """Shape-only 20x proof on CPU (make scale-smoke): the dispatch
+    decision, the honest estimator breakdown, and a jaxpr trace at the
+    1M-pod / 100k-node shapes (hot_programs.MAX_SHAPES) — NO device
+    solve, no allocation beyond the trace.
+
+    Fails unless, at the v5e default budget over an 8-device fleet:
+    1. the dispatch ladder (solver/memory.pick_tier — the same decision
+       the production planner runs) lands on a tier with repair LIVE
+       (``repair_unavailable`` 0, ``repair_chunks`` > 0) for BOTH the
+       fully-narrow carry layout and the conservative config-3 guarded
+       layout (f32 ``used`` — MiB memory sums overflow narrow ints —
+       int8 count, uint8 aff);
+    2. the per-device estimate fits the budget and the carries
+       component dominates it the way the layout promises;
+    3. the carry-streamed union TRACES at the per-device lane-block
+       shapes (jax.make_jaxpr over ShapeDtypeStructs — the program XLA
+       would compile; shape-only, cost independent of problem size).
+    """
+    t0 = time.perf_counter()
+    from k8s_spot_rescheduler_tpu.hot_programs import (
+        MAX_SHAPES,
+        ProbeShapes,
+        packed_struct,
+    )
+    from k8s_spot_rescheduler_tpu.solver import memory as solver_memory
+    from k8s_spot_rescheduler_tpu.solver.carry import (
+        CarryLayout,
+        NARROW_LAYOUT,
+        plane_bytes,
+    )
+
+    s = MAX_SHAPES
+    budget = int(
+        solver_memory.DEFAULT_HBM_BYTES * solver_memory.BUDGET_FRACTION
+    )
+    n_devices = 8  # the v5e-8 fleet the 20x deployment targets
+    guarded = CarryLayout(used="float32", count="int8", aff="uint8")
+    tiers = {}
+    for name, layout in (("narrow", NARROW_LAYOUT), ("guarded", guarded)):
+        tier = solver_memory.pick_tier(
+            s.C, s.K, s.S, s.R, s.W, s.A,
+            n_devices=n_devices,
+            budget_bytes=budget,
+            wants_repair=True,
+            carry_plane_bytes=plane_bytes(layout, s.R, s.A),
+        )
+        tiers[name] = tier
+        print(
+            f"scale-smoke dispatch [{name}]: {tier.kind} "
+            f"repair_chunks={tier.repair_chunks} "
+            f"carry_chunks={tier.carry_chunks} "
+            f"est {tier.est_bytes / 1e9:.2f} GB/device "
+            f"(carries {tier.carry_bytes / 1e9:.2f} GB) vs budget "
+            f"{budget / 1e9:.2f} GB",
+            file=sys.stderr,
+        )
+        if tier.repair_unavailable or tier.repair_chunks <= 0:
+            emit_error(
+                metric, unit,
+                f"20x dispatch [{name}] lost the repair phase: {tier}",
+            )
+            return 1
+        if tier.est_bytes > budget:
+            emit_error(
+                metric, unit,
+                f"20x dispatch [{name}] exceeds the per-device budget: "
+                f"{tier.est_bytes} > {budget}",
+            )
+            return 1
+    tier = tiers["guarded"]  # what --scale 20 on config 3 dispatches
+    bd = solver_memory.estimate_union_hbm_breakdown(
+        tier.lane_block, s.K, s.S, s.R, s.W, s.A,
+        repair_spot_chunks=tier.repair_chunks,
+        carry_chunks=tier.carry_chunks,
+        carry_plane_bytes=plane_bytes(guarded, s.R, s.A),
+    )
+    if sum(bd.values()) != tier.est_bytes or bd["carries"] != tier.carry_bytes:
+        emit_error(
+            metric, unit,
+            f"estimator breakdown disagrees with the tier decision: "
+            f"{bd} vs {tier}",
+        )
+        return 1
+    if bd["carries"] <= max(v for k, v in bd.items() if k != "carries"):
+        emit_error(
+            metric, unit,
+            f"carries no longer dominate the 20x estimate — the layout "
+            f"model drifted: {bd}",
+        )
+        return 1
+
+    # 3. shape-only traces of the per-device lane-block programs — each
+    # dispatched (layout, chunk-count) pair traces AS DISPATCHED, so a
+    # regression specific to either layout (e.g. an f32-`used` dtype
+    # bug the narrow layout would mask) reddens the gate
+    import jax
+
+    from k8s_spot_rescheduler_tpu.solver.fallback import with_repair_streamed
+
+    trace_ms = 0.0
+    trace_eqns = {}
+    for name, lay in (("narrow", NARROW_LAYOUT), ("guarded", guarded)):
+        t = tiers[name]
+        lane_shapes = ProbeShapes(
+            C=t.lane_block, K=s.K, S=s.S, R=s.R, W=s.W, A=s.A
+        )
+        t_trace = time.perf_counter()
+        union = with_repair_streamed(8, t.carry_chunks, lay)
+        closed = jax.make_jaxpr(union)(packed_struct(lane_shapes))
+        one_ms = (time.perf_counter() - t_trace) * 1e3
+        trace_ms += one_ms
+        n_eqns = trace_eqns[name] = len(closed.jaxpr.eqns)
+        if n_eqns <= 0:
+            emit_error(
+                metric, unit,
+                f"20x lane-block trace [{name}] produced no jaxpr",
+            )
+            return 1
+        print(
+            f"scale-smoke trace [{name}]: lane block C={t.lane_block} "
+            f"S={s.S} carry_chunks={t.carry_chunks} layout "
+            f"{lay.used}/{lay.count}/{lay.aff} -> {n_eqns} top-level "
+            f"eqns in {one_ms:.0f} ms",
+            file=sys.stderr,
+        )
+    emit({
+        "metric": metric,
+        "value": round(time.perf_counter() - t0, 3),
+        "unit": unit,
+        "carry_chunks": int(tier.carry_chunks),
+        "carry_bytes": int(tier.carry_bytes),
+        "repair_chunks": int(tier.repair_chunks),
+        "repair_unavailable": 0,
+        "narrow_carry_chunks": int(tiers["narrow"].carry_chunks),
+        "lane_block": int(tier.lane_block),
+        "est_device_gb": round(tier.est_bytes / 1e9, 3),
+        "budget_gb": round(budget / 1e9, 3),
+        "breakdown_mb": {
+            k: round(v / 1e6, 1) for k, v in sorted(bd.items())
+        },
+        "trace_ms": round(trace_ms, 1),
+        "trace_eqns": trace_eqns,  # per dispatched layout
+    })
+    return 0
+
+
 def run_smoke(args, metric: str, unit: str) -> int:
     """CI smoke of the incremental device pipeline (``make bench-smoke``):
     a tiny CPU-only cluster (C≈64, S≈64) runs 5 full ticks through the
@@ -2018,6 +2165,12 @@ def run_chaos(args, metric: str, unit: str) -> int:
         pod_eviction_timeout=60.0,
         eviction_retry_time=5.0,
         flight_dump_dir=dump_dir,
+        # per-tick path pinned (the documented opt-out): this soak's
+        # invariants assert PLAN-path crash containment — flight ==
+        # metric deltas for planner-fallback — and a schedule-path
+        # crash deliberately degrades WITHOUT a fallback event
+        # (PR 11: nothing lost but the fetch amortization)
+        schedule_horizon=0,
     )
 
     class _ScriptedCrashPlanner:
@@ -2047,6 +2200,13 @@ def run_chaos(args, metric: str, unit: str) -> int:
         def plan_async(self, observation, pdbs):
             self._maybe_crash()
             return self._inner.plan_async(observation, pdbs)
+
+        def plan_schedule(self, observation, pdbs):
+            # schedules are the default path now: the scripted crash
+            # must land on whichever plan entry point the tick uses
+            # (same lesson as plan_async above)
+            self._maybe_crash()
+            return self._inner.plan_schedule(observation, pdbs)
 
         def __getattr__(self, name):
             return getattr(self._inner, name)
@@ -2590,6 +2750,8 @@ def _metric_for(args) -> tuple:
         return "watch_soak_completed_ticks", "count"
     if args.smoke:
         return "bench_smoke_delta_upload_bytes", "bytes"
+    if args.scale_smoke:
+        return "scale_smoke_20x_shape_proof_s", "s"
     if args.serve_smoke:
         return "serve_smoke_agent_plan_ms", "ms"
     if args.sched_smoke:
@@ -2739,6 +2901,13 @@ def main() -> int:
                          "cluster, 5 ticks through the production "
                          "incremental pipeline; asserts the delta tick "
                          "ships fewer bytes than the first full pack")
+    ap.add_argument("--scale-smoke", action="store_true",
+                    help="shape-only 20x proof (make scale-smoke): the "
+                         "dispatch ladder decision, estimator breakdown "
+                         "and a jaxpr trace at the 1M-pod/100k-node "
+                         "shapes — repair must stay live on the carry-"
+                         "streamed tier under the v5e budget; no device "
+                         "solve")
     ap.add_argument("--no-cpu-fallback", action="store_true",
                     help="fail (with a JSON error line) instead of running "
                          "on CPU when the TPU backend never comes up")
@@ -2764,6 +2933,8 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         return run_watch_soak(args, metric, unit)
     if args.smoke:
         return run_smoke(args, metric, unit)
+    if args.scale_smoke:
+        return run_scale_smoke(args, metric, unit)
     if args.serve_smoke:
         return run_serve_smoke(args, metric, unit)
     if args.sched_smoke:
@@ -2882,29 +3053,101 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
         args.config, args.seed, spec=spec, pack_repeats=5
     )
 
-    # single-chip HBM guard — the same dispatch the production planner
-    # runs (solver/memory.py): past the budget with a mesh available, the
-    # solve reroutes to the sharded backend; with ONE chip it proceeds to
-    # the backend's honest OOM, annotated with the designed answer.
+    # single-chip HBM guard — the SAME dispatch ladder the production
+    # planner runs (solver/memory.pick_tier): past the budget with a mesh
+    # available, the solve reroutes down the tiers (cand-sharded →
+    # chunked repair → carry-streamed narrow → 2-D); with ONE chip it
+    # proceeds to the backend's honest OOM, annotated with the designed
+    # answer.
+    from k8s_spot_rescheduler_tpu.solver import carry as solver_carry
     from k8s_spot_rescheduler_tpu.solver import memory as solver_memory
 
-    hbm_est = solver_memory.estimate_union_hbm_bytes(
-        *solver_memory.packed_shapes(packed)
-    )
+    shapes = solver_memory.packed_shapes(packed)
+    hbm_est = solver_memory.estimate_union_hbm_bytes(*shapes)
     hbm_budget = solver_memory.device_hbm_budget()
     n_devices = len(jax.devices())
-    past_chip = hbm_est > hbm_budget
+    layout = solver_carry.carry_layout(packed)
+    tier = solver_memory.pick_tier(
+        *shapes,
+        n_devices=n_devices,
+        budget_bytes=None,
+        wants_repair=True,
+        carry_plane_bytes=solver_carry.plane_bytes(
+            layout, shapes[3], shapes[5]
+        ),
+    )
+    past_chip = tier.kind != "single" or hbm_est > hbm_budget
     scale_note = None
+    # the union program the bench EXECUTES when a cand tier won the
+    # ladder (repair live — possibly carry-streamed); None = the plain
+    # solver path below (single-chip, explicit --solver sharded, or the
+    # 2-D verdict)
+    union_override = None
+    # the tier the emitted carry_chunks/carry_bytes/repair_unavailable
+    # keys describe — always the EXECUTED program, never a hypothetical
+    executed_tier = tier
     if past_chip:
         scale_note = (
             f"problem est {hbm_est / 1e9:.1f} GB exceeds single-chip budget "
             f"{hbm_budget / 1e9:.1f} GB"
         )
-        if n_devices > 1 and args.solver != "sharded":
+        if (
+            n_devices > 1
+            and args.solver != "sharded"
+            and tier.kind in ("cand", "cand-chunked", "cand-carry")
+        ):
+            # execute the ladder's own verdict — the program the
+            # production planner would dispatch (repair intact)
+            import functools as _ft
+
+            from k8s_spot_rescheduler_tpu.parallel.mesh import make_cand_mesh
+            from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
+                plan_union_cand_sharded,
+            )
+            from k8s_spot_rescheduler_tpu.solver.repair import DEFAULT_ROUNDS
+
+            union_override = _ft.partial(
+                plan_union_cand_sharded,
+                make_cand_mesh(),
+                rounds=DEFAULT_ROUNDS,  # the planner's repair depth
+                repair_spot_chunks=(
+                    tier.repair_chunks if tier.carry_chunks == 0 else 1
+                ),
+                carry_chunks=tier.carry_chunks,
+                carry_layout=layout,
+            )
+            if args.solver not in ("jax", "pallas"):
+                args.solver = "jax"
+            scale_note += (
+                f"; executing the dispatch ladder's verdict: {tier.kind} "
+                f"(repair_chunks {tier.repair_chunks}, carry_chunks "
+                f"{tier.carry_chunks}, est {tier.est_bytes / 1e9:.1f} "
+                f"GB/device over {n_devices} devices; repair intact)"
+            )
+        elif n_devices > 1 and args.solver != "sharded":
             args.solver = "sharded"
             scale_note += (
-                f"; auto-dispatched to mesh-sharded solver over "
-                f"{n_devices} devices (repair phase unavailable at this scale)"
+                f"; dispatch ladder verdict: 2-D mesh-sharded over "
+                f"{n_devices} devices (repair unavailable at this scale)"
+            )
+        if union_override is None:
+            # what actually runs has NO repair phase: the 2-D layout
+            # (the ladder's 2-D verdict, or an explicit --solver
+            # sharded), or the one-chip honest path whose union is
+            # first-fit ∪ best-fit only — the emitted keys must say so
+            # even when the ladder would have kept a cand tier
+            lane = tier.lane_block if args.solver == "sharded" else shapes[0]
+            executed_tier = solver_memory.TierDecision(
+                "2d" if args.solver == "sharded" else "single",
+                0, 0,
+                solver_memory.estimate_union_hbm_bytes(
+                    lane, *shapes[1:], repair_spot_chunks=0
+                ),
+                solver_memory.estimate_union_hbm_breakdown(
+                    lane, *shapes[1:], repair_spot_chunks=0
+                )["carries"],
+                lane,
+                True,
             )
         print(f"HBM guard: {scale_note}", file=sys.stderr)
 
@@ -2934,10 +3177,13 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
     from k8s_spot_rescheduler_tpu.solver.repair import DEFAULT_ROUNDS
 
     # the production planner path: first-fit ∪ best-fit ∪ local-search
-    # repair, one fused device program (what SolverPlanner ships). Past
-    # single-chip HBM the repair phase is dropped, mirroring the
-    # planner's auto-shard reroute (its search state is single-chip).
-    if past_chip:
+    # repair, one fused device program (what SolverPlanner ships).
+    # ``union_override`` is the cand-tier verdict's own program (repair
+    # live); only the 2-D regime drops the repair phase, exactly as the
+    # planner's auto-shard reroute does.
+    if union_override is not None:
+        union_fn = union_override
+    elif past_chip:
         from k8s_spot_rescheduler_tpu.solver.fallback import (
             with_best_fit_fallback,
         )
@@ -3057,6 +3303,13 @@ def _run_latency(args, metric: str, unit: str, backend_note) -> int:
         # spot-chunked repair engaged (per-lane repair state exceeded
         # one device at these shapes)
         out["repair_chunks"] = int(tick_report.repair_chunks)
+    # the EXECUTED program's tier (solver/memory.pick_tier's verdict —
+    # or the 2-D layout when that is what actually ran): carry-stream
+    # chunk count, estimated resident carry bytes, and whether the
+    # repair phase was live in the measured run
+    out["carry_chunks"] = int(executed_tier.carry_chunks)
+    out["carry_bytes"] = int(executed_tier.carry_bytes)
+    out["repair_unavailable"] = int(executed_tier.repair_unavailable)
     if scale_note is not None:
         out["scale_note"] = scale_note
         out["solver"] = args.solver

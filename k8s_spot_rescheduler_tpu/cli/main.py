@@ -101,6 +101,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.solver_hbm_budget,
                    help="per-device byte budget for the auto-shard "
                         "decision (0 = auto-detect from the backend)")
+    p.add_argument("--carry-chunks", type=int, default=d.carry_chunks,
+                   help="spot-chunk count of the carry-streamed narrow "
+                        "union tier (the auto-shard rung past the wide "
+                        "chunked-repair ceiling; repair stays live, "
+                        "results bit-identical); 0 = auto via "
+                        "solver/memory.pick_carry_chunks")
     p.add_argument("--incremental-device-cache", type=_bool,
                    default=d.incremental_device_cache,
                    help="keep the packed problem resident on device and "
@@ -124,11 +130,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "step re-packed and re-proven from scratch "
                         "against the live mirror before any eviction; "
                         "churn invalidates the schedule tail and "
-                        "re-plans (false = per-tick single plans)")
+                        "re-plans; ON by default (false, or "
+                        "--schedule-horizon 0, = per-tick single plans)")
     p.add_argument("--schedule-horizon", type=int,
                    default=d.schedule_horizon,
                    help="max drain steps per cut schedule (the device "
-                        "while-loop bound and its jit compile key)")
+                        "while-loop bound and its jit compile key); "
+                        "0 = schedules off (the documented opt-out)")
     p.add_argument("--kube-retry-max", type=int, default=d.kube_retry_max,
                    help="max transient-retry attempts per kube API read "
                         "(429/5xx/connection errors, jittered exponential "
@@ -369,6 +377,7 @@ def config_from_args(args) -> ReschedulerConfig:
         repair_rounds=args.repair_rounds,
         auto_shard=args.auto_shard,
         solver_hbm_budget=args.solver_hbm_budget,
+        carry_chunks=args.carry_chunks,
         incremental_device_cache=args.incremental_device_cache,
         staged_chunk_lanes=args.staged_chunk_lanes,
         staged_early_exit=args.staged_early_exit,

@@ -127,6 +127,17 @@ class Rescheduler:
         # plan_schedule in one device fetch, executed across ticks with
         # per-step live validation; dropped on invalidation/exhaustion
         self._schedule = None
+        # churn hysteresis for the default-on schedule path: a schedule
+        # churn kills before it served 2 steps wasted a horizon-deep
+        # sweep for at most one drain, and under CONSTANT churn (replay-
+        # grade event streams) that waste would recur every tick. Each
+        # such early invalidation doubles a per-tick-planning backoff
+        # window (capped); a schedule that serves >= 2 steps — or runs
+        # to exhaustion — resets it. Amortized schedule overhead under
+        # constant churn is therefore bounded at ~horizon/cap extra
+        # solves per tick instead of horizon per tick.
+        self._sched_backoff = 0  # ticks left planning per-tick
+        self._sched_backoff_next = 1  # next window on early invalidation
         # --- freshness gate state (docs/ROBUSTNESS.md) ---
         # the client this tick's READS go to: the configured client, or
         # its direct (cache-bypassing) twin while the watch mirror is
@@ -377,9 +388,17 @@ class Rescheduler:
         plan_schedule = (
             getattr(self.planner, "plan_schedule", None)
             if self.config.plan_schedule_enabled
+            and self.config.schedule_horizon >= 1  # 0 = documented opt-out
             else None
         )
         if plan_schedule is None:
+            return self._plan_guarded(
+                observation, pdbs, run_metrics=run_metrics
+            )
+        if self._schedule is None and self._sched_backoff > 0:
+            # churn hysteresis window: recent schedules died before
+            # paying for themselves — plan per-tick until it expires
+            self._sched_backoff -= 1
             return self._plan_guarded(
                 observation, pdbs, run_metrics=run_metrics
             )
@@ -403,6 +422,24 @@ class Rescheduler:
         metrics.observe_tick_phase("plan", report.solve_seconds)
         return report, False
 
+    def _note_schedule_outcome(self, sched) -> None:
+        """Feed the churn hysteresis from an invalidated schedule's
+        accounting. A schedule that served >= 2 steps amortized its cut
+        (one fetch bought several drains): clear any backoff. One that
+        churn killed with >= 2 UNSERVED steps wasted a horizon-deep
+        sweep: open (and double, capped) the per-tick window. Schedules
+        that exhaust never enter here — ``_schedule_step`` resets the
+        ladder at their drop site (the device while-loop stops at
+        exhaustion, so a short schedule only ever cost its own length
+        in solves). Zero-step cuts cost one solve (== a per-tick plan)
+        and never back off either."""
+        if sched.cursor >= 2:
+            self._sched_backoff = 0
+            self._sched_backoff_next = 1
+        elif len(sched.steps) - sched.cursor >= 2:
+            self._sched_backoff = self._sched_backoff_next
+            self._sched_backoff_next = min(64, self._sched_backoff_next * 2)
+
     def _note_schedule_invalidated(self, sched) -> None:
         """One edge, three surfaces: the counter, the flight event and
         the log line fire together so they can never diverge."""
@@ -425,13 +462,25 @@ class Rescheduler:
         schedule when none is pending; None degrades to per-tick
         planning."""
         sched = self._schedule
-        if sched is not None and not sched.invalidated and not sched.exhausted:
+        if sched is not None and sched.exhausted and not sched.invalidated:
+            # ran to exhaustion: the cut paid for itself in full —
+            # clear the churn-hysteresis ladder before replacing it
+            self._sched_backoff = 0
+            self._sched_backoff_next = 1
+        elif sched is not None and not sched.invalidated:
             report = sched.next_plan(observation, pdbs)
             if report is not None:
                 return report
             if sched.invalidated:
                 self._note_schedule_invalidated(sched)
+                self._note_schedule_outcome(sched)
         self._schedule = None
+        if self._sched_backoff > 0:
+            # the early invalidation above just opened (or re-opened) a
+            # hysteresis window: degrade this tick to per-tick planning
+            # instead of paying another doomed horizon-deep cut
+            self._sched_backoff -= 1
+            return None
         sched = plan_schedule(observation, pdbs)
         if sched is None:
             return None  # planner cannot schedule this problem
@@ -441,6 +490,7 @@ class Rescheduler:
                 # structurally impossible (the schedule was cut from
                 # this very observation) but counted, not assumed
                 self._note_schedule_invalidated(sched)
+                self._note_schedule_outcome(sched)
                 return None
             # zero-step schedule: nothing drainable this tick
             return sched.empty_report()

@@ -40,6 +40,13 @@ class HealthState:
         self.breaker_interval: Optional[float] = None
         self.taints_recovered_total = 0
         self.mirror_staleness_s: Optional[float] = None
+        # last dispatched solver program (planner/solver_planner):
+        # running label + the carry-streamed tier's chunk count and
+        # estimated resident carry bytes — mirrored beside the
+        # solver_mode / solver_carry_* gauges from the SAME call site
+        self.solver_mode: Optional[str] = None
+        self.carry_chunks = 0
+        self.solver_carry_bytes: Optional[int] = None
 
     def reset(self) -> None:
         """Back to process-start state (test isolation)."""
@@ -56,6 +63,9 @@ class HealthState:
             self.breaker_interval = None
             self.taints_recovered_total = 0
             self.mirror_staleness_s = None
+            self.solver_mode = None
+            self.carry_chunks = 0
+            self.solver_carry_bytes = None
         self._mirror_gauge(False)
 
     def set_clock(self, now_fn: Callable[[], float]) -> None:
@@ -150,6 +160,19 @@ class HealthState:
             degraded = self._degraded_locked()
         self._mirror_gauge(degraded)
 
+    def note_solver_mode(
+        self, running: str, carry_chunks: int, carry_bytes: int
+    ) -> None:
+        """What the last solve actually ran (the dispatch ladder's
+        verdict), called beside ``metrics.update_solver_mode`` so
+        /healthz and the gauges agree. Negative ``carry_bytes`` =
+        estimate unavailable (non-auto-shard paths) — left as-is."""
+        with self._lock:
+            self.solver_mode = running
+            self.carry_chunks = int(carry_chunks)
+            if carry_bytes >= 0:
+                self.solver_carry_bytes = int(carry_bytes)
+
     def note_taint_recovered(self) -> None:
         with self._lock:
             self.taints_recovered_total += 1
@@ -172,6 +195,9 @@ class HealthState:
                 "breaker_interval_s": self.breaker_interval,
                 "taints_recovered_total": self.taints_recovered_total,
                 "mirror_staleness_s": self.mirror_staleness_s,
+                "solver_mode": self.solver_mode,
+                "carry_chunks": self.carry_chunks,
+                "solver_carry_bytes": self.solver_carry_bytes,
             }
 
 

@@ -127,6 +127,26 @@ solver_repair_chunks = Gauge(
     namespace=NAMESPACE,
 )
 
+solver_carry_chunks = Gauge(
+    "solver_carry_chunks",
+    "Carry chunks of the last solve's carry-streamed tier (solver/"
+    "fallback.with_repair_streamed): the spot axis streams through the "
+    "greedy scans in this many ordered chunks with narrow delta "
+    "carries. 0 = a wide-carry tier ran (single-chip, cand-sharded, "
+    "cand-chunked or 2-D).",
+    namespace=NAMESPACE,
+)
+
+solver_carry_bytes = Gauge(
+    "solver_carry_bytes",
+    "Estimated per-device resident scan-carry bytes of the last "
+    "dispatched solver program (the 'carries' component of solver/"
+    "memory.estimate_union_hbm_breakdown at the dispatched tier's "
+    "layout — narrow delta planes on the carry-streamed tier). The "
+    "per-spot resident carry the 20x scaling ceiling is set by.",
+    namespace=NAMESPACE,
+)
+
 tick_phase_duration = Histogram(
     "tick_phase_duration_seconds",
     "Wall time of each housekeeping-tick phase (observe / plan-dispatch "
@@ -528,12 +548,18 @@ def update_solver_mode(
     running: str,
     repair_dropped: bool,
     repair_chunks: int | None = None,
+    carry_chunks: int | None = None,
+    carry_bytes: int | None = None,
 ) -> None:
     """Expose what the last solve actually ran. The previous label pair
     is zeroed (not removed) so dashboards see a clean 1-of-N encoding
     and the flip to/from the reroute is a visible edge.
     ``repair_chunks`` mirrors the dispatch decision's spot-chunk count
-    into ``solver_repair_chunks`` (None leaves the gauge untouched)."""
+    into ``solver_repair_chunks`` (None leaves the gauge untouched);
+    ``carry_chunks``/``carry_bytes`` mirror the carry-streamed tier's
+    chunk count and estimated resident carry bytes into
+    ``solver_carry_chunks``/``solver_carry_bytes`` (None / negative
+    carry_bytes leave the gauges untouched)."""
     prev = _last_solver_mode[0]
     if prev is not None and prev != (configured, running):
         solver_mode.labels(*prev).set(0)
@@ -542,6 +568,10 @@ def update_solver_mode(
     repair_unavailable.set(1 if repair_dropped else 0)
     if repair_chunks is not None:
         solver_repair_chunks.set(repair_chunks)
+    if carry_chunks is not None:
+        solver_carry_chunks.set(carry_chunks)
+    if carry_bytes is not None and carry_bytes >= 0:
+        solver_carry_bytes.set(carry_bytes)
 
 
 def update_incremental_tick(report) -> None:

@@ -46,24 +46,33 @@ except ImportError:  # older jax: experimental module, kwarg check_rep
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.parallel.mesh import CAND_AXIS, SPOT_AXIS, make_mesh
 from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask_t
+from k8s_spot_rescheduler_tpu.solver.carry import WIDE_LAYOUT
+from k8s_spot_rescheduler_tpu.solver.ffd import (
+    _spot_statics as _ffd_spot_statics,
+    _widen as _ffd_widen,
+)
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 
 _BIG = jnp.int32(2**30)
 
 
 def _local_step(static, best_fit, carry, slot):
-    """One pod-slot placement on this device's (cand, spot) block."""
-    spot_max_pods, spot_taints, spot_ok, s_local, s_offset = static
-    free, count, aff_acc, feasible = carry
+    """One pod-slot placement on this device's (cand, spot) block.
+    The carry is the DELTA-form narrow state (solver/carry.CarryLayout)
+    widened on read against the replicated block statics — the same
+    one-site discipline as solver/ffd."""
+    spot_static, s_local, s_offset = static
+    used, dcount, daff, feasible = carry
     req, valid, tol, aff = slot  # local [Cl,R], [Cl], [Cl,W], [Cl,A]
+    free, count, aff_acc = _ffd_widen(spot_static, used, dcount, daff)
 
     fits = fit_mask_t(
         jnp,
         free_t=free,  # [Cl, R, Sl] — spot axis minor (see fit_mask_t)
         count=count,
-        max_pods=spot_max_pods,
-        node_taints_t=spot_taints,  # [W, Sl]
-        node_ok=spot_ok,
+        max_pods=spot_static.max_pods,
+        node_taints_t=spot_static.taints_t,  # [W, Sl]
+        node_ok=spot_static.ok,
         node_aff_t=aff_acc,  # [Cl, A, Sl]
         req=req,
         tol=tol,
@@ -96,43 +105,40 @@ def _local_step(static, best_fit, carry, slot):
         in_shard[:, None]
     )
 
-    free = free - onehot[:, None, :] * req[:, :, None]
-    count = count + onehot.astype(count.dtype)
-    aff_acc = aff_acc | jnp.where(onehot[:, None, :], aff[:, :, None], 0)
+    used = used + (onehot[:, None, :] * req[:, :, None]).astype(used.dtype)
+    dcount = dcount + onehot.astype(dcount.dtype)
+    daff = daff | jnp.where(
+        onehot[:, None, :], aff[:, :, None], 0
+    ).astype(daff.dtype)
     feasible = feasible & (any_fit | ~valid)
 
     chosen = jnp.where(place, winner, jnp.int32(-1))
-    return (free, count, aff_acc, feasible), chosen
+    return (used, dcount, daff, feasible), chosen
 
 
-def _sharded_plan_local(best_fit, packed: PackedCluster):
+def _sharded_plan_local(best_fit, layout, packed: PackedCluster):
     """Runs on every device over its local block (inside shard_map)."""
     Cl = packed.slot_req.shape[0]
     Sl = packed.spot_free.shape[0]
+    R = packed.slot_req.shape[2]
+    A = packed.spot_aff.shape[1]
     s_offset = jax.lax.axis_index(SPOT_AXIS).astype(jnp.int32) * Sl
 
-    free_t = jnp.asarray(packed.spot_free).T  # [R, Sl]
-    aff_t = jnp.asarray(packed.spot_aff).T  # [A, Sl]
+    spot_static = _ffd_spot_statics(packed)
     carry = (
-        jnp.broadcast_to(free_t, (Cl, *free_t.shape)),
-        jnp.broadcast_to(packed.spot_count, (Cl, Sl)).astype(jnp.int32),
-        jnp.broadcast_to(aff_t, (Cl, *aff_t.shape)),
+        jnp.zeros((Cl, R, Sl), layout.used),
+        jnp.zeros((Cl, Sl), layout.count),
+        jnp.zeros((Cl, A, Sl), layout.aff),
         jnp.asarray(packed.cand_valid),
     )
-    static = (
-        packed.spot_max_pods,
-        jnp.asarray(packed.spot_taints).T,  # [W, Sl]
-        packed.spot_ok,
-        jnp.int32(Sl),
-        s_offset,
-    )
+    static = (spot_static, jnp.int32(Sl), s_offset)
     slots = (
         jnp.moveaxis(packed.slot_req, 1, 0),
         jnp.moveaxis(packed.slot_valid, 1, 0),
         jnp.moveaxis(packed.slot_tol, 1, 0),
         jnp.moveaxis(packed.slot_aff, 1, 0),
     )
-    (f, c, a, feasible), chosen = jax.lax.scan(
+    (u, dc, da, feasible), chosen = jax.lax.scan(
         functools.partial(_local_step, static, best_fit), carry, slots
     )
     feasible = feasible & jnp.asarray(packed.cand_valid)
@@ -186,10 +192,15 @@ def _pad_to_mesh(packed: PackedCluster, mesh: Mesh) -> PackedCluster:
 
 
 def plan_ffd_sharded(
-    mesh: Mesh, packed: PackedCluster, best_fit: bool = False
+    mesh: Mesh,
+    packed: PackedCluster,
+    best_fit: bool = False,
+    layout=WIDE_LAYOUT,
 ) -> SolveResult:
     """Shard the PackedCluster over the mesh and solve. Axes that don't
-    divide the mesh are padded with inert entries and sliced back out."""
+    divide the mesh are padded with inert entries and sliced back out.
+    ``layout`` narrows each device's delta carries (solver/carry.py) —
+    the caller passes only what ``carry_layout(packed)`` proves."""
     C = packed.slot_req.shape[0]
     packed = _pad_to_mesh(packed, mesh)
     cand_sharded = PackedCluster(
@@ -206,7 +217,7 @@ def plan_ffd_sharded(
         spot_aff=P(SPOT_AXIS),
     )
     fn = shard_map(
-        functools.partial(_sharded_plan_local, best_fit),
+        functools.partial(_sharded_plan_local, best_fit, layout),
         mesh=mesh,
         in_specs=(cand_sharded,),
         out_specs=(P(CAND_AXIS), P(CAND_AXIS, None)),
@@ -223,6 +234,8 @@ def plan_union_cand_sharded(
     rounds: int = 0,
     best_fit_fallback: bool = True,
     repair_spot_chunks: int = 1,
+    carry_chunks: int = 0,
+    carry_layout=None,
 ) -> SolveResult:
     """Candidate-ONLY sharding: each device holds a block of candidate
     lanes with the FULL spot axis replicated, and runs the complete
@@ -239,20 +252,23 @@ def plan_union_cand_sharded(
     bit-identical), shrinking the per-round working set to
     O(S / chunks) and carrying repair further still — only when even
     the fully-chunked block exceeds the budget does the dispatch fall
-    to the repair-less 2-D layout. ``mesh`` is the 1-D all-device mesh
+    to the repair-less 2-D layout. ``carry_chunks`` >= 1 swaps the block
+    program for the CARRY-STREAMED union
+    (solver/fallback.with_repair_streamed, ROADMAP 5): narrow delta
+    carries under ``carry_layout`` (solver/carry.carry_layout of the
+    pack; NARROW_LAYOUT when None) with the spot axis streamed — repair
+    stays live past even the fully-chunked wide ceiling, bit-identical
+    results throughout. ``mesh`` is the 1-D all-device mesh
     of ``parallel/mesh.make_cand_mesh``."""
-    from k8s_spot_rescheduler_tpu.solver.fallback import (
-        with_best_fit_fallback,
-        with_repair,
-    )
-    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+    from k8s_spot_rescheduler_tpu.solver.fallback import union_program
 
-    if best_fit_fallback and rounds > 0:
-        solve = with_repair(plan_ffd, rounds, spot_chunks=repair_spot_chunks)
-    elif best_fit_fallback:
-        solve = with_best_fit_fallback(plan_ffd)
-    else:
-        solve = plan_ffd
+    solve = union_program(
+        rounds,
+        best_fit_fallback,
+        repair_spot_chunks=repair_spot_chunks,
+        carry_chunks=carry_chunks,
+        carry_layout=carry_layout,
+    )
     C = packed.slot_req.shape[0]
     packed = _pad_axes(
         packed,
@@ -328,6 +344,22 @@ def _cand_sharded_build(s):
     )
 
 
+def _cand_carry_build(s):
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_cand_mesh
+    from k8s_spot_rescheduler_tpu.solver.carry import NARROW_LAYOUT
+
+    return (
+        functools.partial(
+            plan_union_cand_sharded,
+            make_cand_mesh(),
+            rounds=8,
+            carry_chunks=4,
+            carry_layout=NARROW_LAYOUT,
+        ),
+        (packed_struct(s),),
+    )
+
+
 HOT_PROGRAMS = {
     "sharded.ffd_2d": HotProgram(
         build=_sharded_2d_build,
@@ -338,6 +370,10 @@ HOT_PROGRAMS = {
     ),
     "sharded.union_cand": HotProgram(
         build=_cand_sharded_build,
+        covers=("parallel.sharded_ffd:plan_union_cand_sharded.local",),
+    ),
+    "sharded.union_cand_carry": HotProgram(
+        build=_cand_carry_build,
         covers=("parallel.sharded_ffd:plan_union_cand_sharded.local",),
     ),
 }

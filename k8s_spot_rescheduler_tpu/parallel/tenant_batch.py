@@ -41,12 +41,30 @@ from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import shard_map
 from k8s_spot_rescheduler_tpu.solver.select import selection_vector
 
 
+def _tenant_union(rounds, best_fit_fallback, carry_chunks, carry_layout):
+    """The per-tenant union program the batch vmaps — the ONE
+    composition ladder of solver/fallback.union_program, so the
+    service's program can never drift from the cand-sharded planner's
+    (``carry_chunks`` >= 1 gives huge-bucket tenants the ROADMAP-5
+    narrow delta-carry streamed union under vmap too)."""
+    from k8s_spot_rescheduler_tpu.solver.fallback import union_program
+
+    return union_program(
+        rounds,
+        best_fit_fallback,
+        carry_chunks=carry_chunks,
+        carry_layout=carry_layout,
+    )
+
+
 def plan_tenants_batched(
     mesh: Mesh | None,
     stacked: PackedCluster,
     *,
     rounds: int = 0,
     best_fit_fallback: bool = True,
+    carry_chunks: int = 0,
+    carry_layout=None,
 ):
     """Solve T stacked tenant problems; returns int32 [T, 3 + K].
 
@@ -54,18 +72,7 @@ def plan_tenants_batched(
     tenant axis (service/buckets.stack_bucket). Row t decodes with
     ``solver/select.decode_selection`` exactly as a solo solve would.
     """
-    from k8s_spot_rescheduler_tpu.solver.fallback import (
-        with_best_fit_fallback,
-        with_repair,
-    )
-    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
-
-    if best_fit_fallback and rounds > 0:
-        solve = with_repair(plan_ffd, rounds)
-    elif best_fit_fallback:
-        solve = with_best_fit_fallback(plan_ffd)
-    else:
-        solve = plan_ffd
+    solve = _tenant_union(rounds, best_fit_fallback, carry_chunks, carry_layout)
 
     def tenant_select(p):
         return selection_vector(solve, p)
@@ -116,19 +123,10 @@ def plan_tenants_scheduled(
     slowest BLOCK, not the slowest tenant times T); the service pads
     the tenant axis to a device multiple with all-invalid problems,
     the same inert padding the single-plan batch uses."""
-    from k8s_spot_rescheduler_tpu.solver.fallback import (
-        with_best_fit_fallback,
-        with_repair,
-    )
-    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+    from k8s_spot_rescheduler_tpu.solver.fallback import union_program
     from k8s_spot_rescheduler_tpu.solver.schedule import schedule_matrix
 
-    if best_fit_fallback and rounds > 0:
-        solve = with_repair(plan_ffd, rounds)
-    elif best_fit_fallback:
-        solve = with_best_fit_fallback(plan_ffd)
-    else:
-        solve = plan_ffd
+    solve = union_program(rounds, best_fit_fallback)
 
     def tenant_sched(p):
         return schedule_matrix(solve, p, horizon)
@@ -246,17 +244,24 @@ def make_tenant_batch_planner(
     *,
     rounds: int = 0,
     best_fit_fallback: bool = True,
+    carry_chunks: int = 0,
+    carry_layout=None,
 ):
     """The service's jitted batch program. One returned callable serves
     every bucket: jit re-specializes per stacked shape, and the bucket
     discipline (powers of two per axis) bounds the distinct shapes to
-    O(log C · log K · log S) for the fleet's lifetime."""
+    O(log C · log K · log S) for the fleet's lifetime. ``carry_chunks``
+    >= 1 runs every tenant on the carry-streamed narrow union (same
+    selections, narrower resident carries — for buckets whose stacked
+    wide state would not fit the device)."""
     return jax.jit(
         functools.partial(
             plan_tenants_batched,
             mesh,
             rounds=rounds,
             best_fit_fallback=best_fit_fallback,
+            carry_chunks=carry_chunks,
+            carry_layout=carry_layout,
         )
     )
 
@@ -287,6 +292,29 @@ def _tenant_batch_build(s):
     return (
         functools.partial(
             plan_tenants_batched, make_tenant_mesh(), rounds=8
+        ),
+        (stacked,),
+    )
+
+
+def _tenant_batch_carry_build(s):
+    from k8s_spot_rescheduler_tpu.parallel.mesh import make_tenant_mesh
+    from k8s_spot_rescheduler_tpu.solver.carry import NARROW_LAYOUT
+
+    base = packed_struct(s)
+    stacked = PackedCluster(
+        *(
+            jax.ShapeDtypeStruct((TENANT_PROBE_COUNT,) + f.shape, f.dtype)
+            for f in base
+        )
+    )
+    return (
+        functools.partial(
+            plan_tenants_batched,
+            make_tenant_mesh(),
+            rounds=8,
+            carry_chunks=4,
+            carry_layout=NARROW_LAYOUT,
         ),
         (stacked,),
     )
@@ -331,6 +359,13 @@ def _tenant_delta_build(s):
 HOT_PROGRAMS = {
     "service.tenant_batch": HotProgram(
         build=_tenant_batch_build,
+        covers=(
+            "parallel.tenant_batch:plan_tenants_batched",
+            "parallel.tenant_batch:plan_tenants_batched.local",
+        ),
+    ),
+    "service.tenant_batch_carry": HotProgram(
+        build=_tenant_batch_carry_build,
         covers=(
             "parallel.tenant_batch:plan_tenants_batched",
             "parallel.tenant_batch:plan_tenants_batched.local",

@@ -61,6 +61,9 @@ class PlanReport:
     # elect-then-commit spot-chunked search engaged (per-lane repair
     # state exceeded one device), 0 = repair off/unavailable this solve
     repair_chunks: int = 1
+    # carry chunks of the carry-streamed narrow tier (solver/carry.py +
+    # solver/fallback.with_repair_streamed): 0 = a wide-carry tier ran
+    carry_chunks: int = 0
     # --- drain-schedule telemetry (planner/schedule.py) ---
     # steps in the schedule this plan was served from; 0 = per-tick plan
     schedule_len: int = 0

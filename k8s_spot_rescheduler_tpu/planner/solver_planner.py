@@ -80,6 +80,7 @@ class SolverPlanner:
         self._staged = None  # lazy chunked early-exit planner
         self._fused_sharded = None  # lazy 2-D auto-shard reroute
         self._fused_cand_sharded = None  # lazy cand-only reroute (repair on)
+        self._fused_carry = None  # lazy carry-streamed narrow reroute
         # incremental device cache: last tick's problem, resident in HBM,
         # plus the host copy the next tick's delta is diffed against
         self._device_packed = None
@@ -349,6 +350,44 @@ class SolverPlanner:
             )
         return self._fused_cand_sharded[repair_chunks]
 
+    def _carry_streamed_fused_planner(self, carry_chunks: int, layout):
+        """The carry-streamed cand tier (ROADMAP 5): lanes shard over
+        all devices and each device runs the NARROW delta-carry
+        streamed union (solver/fallback.with_repair_streamed) on its
+        block — first-fit spot-streamed with leftovers flowing forward,
+        best-fit and the repair rounds on the stacked narrow state —
+        bit-identical to the single-chip union, resident carries ~2x
+        smaller and per-round temporaries O(S / carry_chunks). One
+        fused planner per (chunk count, layout) — both are compile-time
+        decisions, stable across ticks at the high-water pads."""
+        if self._fused_carry is None:
+            self._fused_carry = {}
+        key = (carry_chunks, layout)
+        if key not in self._fused_carry:
+            import functools
+
+            from k8s_spot_rescheduler_tpu.parallel.mesh import make_cand_mesh
+            from k8s_spot_rescheduler_tpu.parallel.sharded_ffd import (
+                plan_union_cand_sharded,
+            )
+            from k8s_spot_rescheduler_tpu.solver.select import make_fused_planner
+
+            cfg = self.config
+            mesh = make_cand_mesh()
+            self._fused_carry[key] = make_fused_planner(
+                functools.partial(
+                    plan_union_cand_sharded,
+                    mesh,
+                    rounds=(
+                        cfg.repair_rounds if cfg.fallback_best_fit else 0
+                    ),
+                    best_fit_fallback=cfg.fallback_best_fit,
+                    carry_chunks=carry_chunks,
+                    carry_layout=layout,
+                )
+            )
+        return self._fused_carry[key]
+
     def _maybe_shard(self, packed):
         """Pick the device program for this problem's shapes: the
         configured solver; past the single-chip HBM estimate, the
@@ -357,12 +396,19 @@ class SolverPlanner:
         device; past THAT, the same tier with elect-then-commit
         spot-CHUNKED repair (solver/repair.plan_repair_chunked,
         bit-identical) at the chunk count solver/memory.
-        pick_repair_chunks sizes to the budget; only when even the
-        fully-chunked block exceeds it does the 2-D cand×spot layout
-        (repair off) engage — the one regime ``repair_unavailable``
-        fires in. The scale story of SURVEY.md §5.7: the mesh engages
-        BY ITSELF where the single-chip kernel gives out. Returns
-        (fused, label, repair_dropped, repair_chunks)."""
+        pick_repair_chunks sizes to the budget; past the wide chunked
+        ceiling, the CARRY-STREAMED tier (ROADMAP 5): narrow delta
+        carries sized by the pack's exact layout guard
+        (solver/carry.carry_layout) with the spot axis streamed at
+        ``solver/memory.pick_carry_chunks``'s count — repair still
+        LIVE, results still bit-identical; only when even the narrow
+        streamed block exceeds the budget does the 2-D cand×spot
+        layout (repair off) engage — the one regime
+        ``repair_unavailable`` fires in. The ladder decision itself is
+        ``solver/memory.pick_tier`` (shared with bench.py and
+        ``make scale-smoke``, so the surfaces can't drift). Returns
+        (fused, label, repair_dropped, repair_chunks, carry_chunks,
+        carry_bytes)."""
         cfg = self.config
         wants_repair = cfg.fallback_best_fit and cfg.repair_rounds > 0
         own_chunks = 1 if wants_repair else 0
@@ -371,7 +417,8 @@ class SolverPlanner:
             or self._fused is None
             or cfg.solver == "sharded"  # already the mesh path
         ):
-            return self._fused, cfg.solver, False, own_chunks
+            return self._fused, cfg.solver, False, own_chunks, 0, -1
+        from k8s_spot_rescheduler_tpu.solver import carry as carry_mod
         from k8s_spot_rescheduler_tpu.solver import memory
 
         try:
@@ -379,74 +426,100 @@ class SolverPlanner:
 
             n_devices = len(jax.devices())
         except Exception:  # noqa: BLE001 — no backend: keep configured path
-            return self._fused, cfg.solver, False, own_chunks
+            return self._fused, cfg.solver, False, own_chunks, 0, -1
         budget = cfg.solver_hbm_budget or None
-        # own_chunks doubles as the estimate mode: 0 = no repair phase
-        # configured, so its working set must not count against the chip
-        if not memory.should_shard(
-            packed, n_devices, budget_bytes=budget,
-            repair_spot_chunks=own_chunks,
-        ):
-            return self._fused, cfg.solver, False, own_chunks
         C, K, S, R, W, A = memory.packed_shapes(packed)
-        est = memory.estimate_union_hbm_bytes(
-            C, K, S, R, W, A, repair_spot_chunks=own_chunks
+        # deferred + memoized: the exact layout guard is an O(C·K·R)
+        # host pass only the carry rung pays, and it pays it ONCE (the
+        # dispatch branch below reuses the same verdict)
+        layout_memo = []
+
+        def _layout():
+            if not layout_memo:
+                layout_memo.append(carry_mod.carry_layout(packed))
+            return layout_memo[0]
+
+        tier = memory.pick_tier(
+            C, K, S, R, W, A,
+            n_devices=n_devices,
+            budget_bytes=budget,
+            wants_repair=wants_repair,
+            carry_plane_bytes=lambda: carry_mod.plane_bytes(
+                _layout(), R, A
+            ),
+            forced_carry_chunks=cfg.carry_chunks,
         )
-        lane_block = -(-C // n_devices)
-        lane_est = memory.estimate_union_hbm_bytes(
-            lane_block, K, S, R, W, A, repair_spot_chunks=own_chunks
-        )
-        lane_budget = budget or memory.device_hbm_budget()
-        if lane_est <= lane_budget:
+        if tier.kind == "single":
+            return self._fused, cfg.solver, False, own_chunks, 0, tier.carry_bytes
+        if tier.kind == "cand":
             fused = self._cand_sharded_fused_planner()
-            label = f"{cfg.solver}+cand-sharded"
             log.info(
-                "Problem exceeds single-chip HBM (est %.1f GB > budget); "
-                "dispatching to cand-sharded union over %d devices "
-                "(%d-lane blocks, est %.1f GB/device; repair intact)",
-                est / 1e9,
+                "Problem exceeds single-chip HBM; dispatching to "
+                "cand-sharded union over %d devices (%d-lane blocks, "
+                "est %.1f GB/device; repair intact)",
                 n_devices,
-                lane_block,
-                lane_est / 1e9,
+                tier.lane_block,
+                tier.est_bytes / 1e9,
             )
-            return fused, label, False, own_chunks
-        # chunking only shrinks the repair working set: without a repair
-        # phase there is nothing to chunk — straight to the 2-D tier
-        chunks = (
-            memory.pick_repair_chunks(lane_block, K, S, R, W, A, lane_budget)
-            if wants_repair
-            else 0
-        )
-        if chunks > 1:
-            fused = self._cand_sharded_fused_planner(chunks)
-            label = f"{cfg.solver}+cand-sharded"
-            chunk_est = memory.estimate_union_hbm_bytes(
-                lane_block, K, S, R, W, A, repair_spot_chunks=chunks
+            return (
+                fused, f"{cfg.solver}+cand-sharded", False, own_chunks, 0,
+                tier.carry_bytes,
+            )
+        if tier.kind == "cand-chunked":
+            fused = self._cand_sharded_fused_planner(tier.repair_chunks)
+            log.info(
+                "Problem exceeds single-chip HBM; dispatching to "
+                "cand-sharded union with repair chunked over %d spot "
+                "chunks (est %.1f GB/device; repair intact)",
+                tier.repair_chunks,
+                tier.est_bytes / 1e9,
+            )
+            return (
+                fused,
+                f"{cfg.solver}+cand-sharded",
+                False,
+                tier.repair_chunks,
+                0,
+                tier.carry_bytes,
+            )
+        if tier.kind == "cand-carry":
+            layout = _layout()  # memoized: computed once per dispatch
+            fused = self._carry_streamed_fused_planner(
+                tier.carry_chunks, layout
             )
             log.info(
-                "Problem exceeds single-chip HBM (est %.1f GB > budget; "
-                "an unchunked 1/%d lane block needs %.1f GB); "
-                "dispatching to cand-sharded union with repair chunked "
-                "over %d spot chunks (est %.1f GB/device; repair intact)",
-                est / 1e9,
+                "Problem exceeds the wide chunked ceiling; dispatching "
+                "to cand-sharded CARRY-STREAMED union over %d devices "
+                "(%d-lane blocks, %d carry chunks, layout %s/%s/%s, "
+                "est %.1f GB/device of which carries %.1f GB; repair "
+                "intact)",
                 n_devices,
-                lane_est / 1e9,
-                chunks,
-                chunk_est / 1e9,
+                tier.lane_block,
+                tier.carry_chunks,
+                layout.used,
+                layout.count,
+                layout.aff,
+                tier.est_bytes / 1e9,
+                tier.carry_bytes / 1e9,
             )
-            return fused, label, False, chunks
+            return (
+                fused,
+                f"{cfg.solver}+cand-carry",
+                False,
+                tier.repair_chunks,
+                tier.carry_chunks,
+                tier.carry_bytes,
+            )
         fused = self._sharded_fused_planner()
-        label = f"{cfg.solver}+sharded"
         log.info(
-            "Problem exceeds single-chip HBM (est %.1f GB > budget; "
-            "even a fully-chunked 1/%d lane block exceeds it); "
-            "dispatching to 2-D mesh-sharded solver (%s mesh); repair "
-            "phase unavailable at this scale",
-            est / 1e9,
+            "Problem exceeds single-chip HBM (even the narrow "
+            "carry-streamed 1/%d lane block exceeds it); dispatching to "
+            "2-D mesh-sharded solver (%s mesh); repair phase "
+            "unavailable at this scale",
             n_devices,
             "x".join(map(str, getattr(self, "_mesh_shape", ()))),
         )
-        return fused, label, wants_repair, 0
+        return fused, f"{cfg.solver}+sharded", wants_repair, 0, 0, tier.carry_bytes
 
     # SolverPlanner can plan straight from a ColumnarStore snapshot (the
     # vectorized observe path); the control loop checks this before
@@ -490,6 +563,8 @@ class SolverPlanner:
         repair_chunks = (
             1 if cfg.fallback_best_fit and cfg.repair_rounds > 0 else 0
         )
+        carry_chunks = 0
+        carry_bytes = -1
         fetch = None
         delta_lanes, full_repack, upload_bytes = -1, False, -1
         if self._fused is not None:
@@ -500,6 +575,8 @@ class SolverPlanner:
                 solver_label,
                 repair_dropped,
                 repair_chunks,
+                carry_chunks,
+                carry_bytes,
             ) = self._maybe_shard(packed)
             # the incremental cache and the staged solve apply only to the
             # plain single-chip program: the mesh reroutes manage their own
@@ -606,6 +683,15 @@ class SolverPlanner:
             metrics.update_solver_mode(
                 cfg.solver, solver_label, repair_dropped,
                 repair_chunks=repair_chunks,
+                carry_chunks=carry_chunks,
+                carry_bytes=carry_bytes,
+            )
+            # /healthz mirrors the same verdict beside solver_mode
+            # (loop/health.py) — one site, surfaces agree
+            from k8s_spot_rescheduler_tpu.loop import health
+
+            health.STATE.note_solver_mode(
+                solver_label, carry_chunks, carry_bytes
             )
 
             self.last_solver = solver_label
@@ -629,6 +715,7 @@ class SolverPlanner:
                     staged_stats.count_truncated if staged_stats else False
                 ),
                 repair_chunks=repair_chunks,
+                carry_chunks=carry_chunks,
             )
             return report
 
@@ -688,7 +775,7 @@ class SolverPlanner:
                 )
                 return None
             else:
-                fused, label, _, _ = self._maybe_shard(packed)
+                fused, label, _, _, _, _ = self._maybe_shard(packed)
                 if fused is not self._fused:
                     # the problem outgrew one chip: the mesh tiers
                     # manage their own placement and the schedule
@@ -720,6 +807,13 @@ class SolverPlanner:
             self.fetches_total += 1
             self.schedule_lens.append(len(steps))
             metrics.update_plan_schedule_len(len(steps))
+            # why-no-drain observability per CUT (schedules are the
+            # default path now): step 0's feasible count IS the fresh
+            # solve's — a zero-step cut classifies every blocked
+            # candidate exactly like a per-tick no-drain plan would
+            self._report_conservatism(
+                packed, meta, steps[0].n_feasible if steps else 0
+            )
             if sp is not None:
                 sp.attrs["steps"] = len(steps)
                 sp.attrs["horizon"] = horizon

@@ -1,0 +1,129 @@
+"""Narrow-int carry layouts: the per-spot scan state, sized honestly.
+
+The greedy passes' mutable per-(lane, spot) state — capacity consumed,
+pods placed, dynamic affinity bits accumulated — was historically
+carried WIDE (f32 free, i32 count, u32×A affinity words, the static
+spot rows broadcast into every lane's copy). Those carries, not the
+repair temporaries, set the fully-chunked scaling ceiling (docs/
+RESULTS.md "scaling"): every greedy pass holds them, double-buffered
+through the ``lax.scan``, and no spot chunking shrinks them.
+
+This module is the host half of the ROADMAP-5 answer:
+
+- the carries become DELTAS against the static spot rows (consumed, not
+  free; placements added, not absolute count; pod-contributed affinity
+  bits, not static|dynamic) — the statics are read-only scan inputs, so
+  each delta starts at zero and stays bounded by what ONE lane can do
+  to one node;
+- those bounds are computable EXACTLY on the host from the pack:
+  consumed ≤ the lane's total valid request, placements ≤ K, dynamic
+  affinity bits ⊆ the OR of every pod's interned words. ``carry_layout``
+  derives the narrowest int dtypes those bounds provably fit —
+  int16/int8/uint16 at production shapes — and the kernels widen ON
+  READ at one site, so the selection arithmetic (f32 integers < 2**24,
+  exact) is bit-identical to the wide layout;
+- when a pack's bounds exceed a narrow dtype (adversarial requests,
+  K > 127, affinity bits interned past bit 15) the layout falls back
+  per-field to the wide dtype — the guard is exact, never heuristic,
+  so narrowing can never change a single placement.
+
+Kept free of jax imports on purpose: ``solver/memory.py`` (the HBM
+dispatch estimator) and the kernels both consume it, and the estimator
+must stay importable host-side without touching a backend.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class CarryLayout(NamedTuple):
+    """Dtypes of the three mutable carry planes (delta form).
+
+    ``used``  — capacity consumed per (lane, resource, spot);
+    ``count`` — placements added per (lane, spot);
+    ``aff``   — OR of placed pods' affinity bits per (lane, word, spot).
+
+    The default is the WIDE layout: delta-form but full-width dtypes,
+    arithmetically identical to the historical absolute-value carries
+    (all quantities are exact integers in f32 below 2**24).
+    """
+
+    used: str = "float32"
+    count: str = "int32"
+    aff: str = "uint32"
+
+
+WIDE_LAYOUT = CarryLayout()
+
+# The layout the 20x dispatch ladder targets (and the jaxpr auditor
+# traces at MAX_SHAPES): int16 consumed quanta, int8 placement deltas,
+# uint16 dynamic-affinity words. carry_layout() only ever RETURNS this
+# when the pack's exact bounds fit it.
+NARROW_LAYOUT = CarryLayout(used="int16", count="int8", aff="uint16")
+
+
+def carry_layout(packed) -> CarryLayout:
+    """The narrowest layout ``packed``'s exact host-side bounds fit.
+
+    Works on the host copy of a PackedCluster (numpy arrays; device
+    arrays are converted). Exactness argument per field:
+
+    - ``used[c, r, s]`` is always the sum of ``slot_req[c, k, r]`` over
+      the pods of lane ``c`` currently assigned to ``s`` (the partial
+      pass adds; repair moves, keeping the invariant), so it is bounded
+      by the lane's total valid request per resource;
+    - ``count[c, s]`` delta is the number of lane-``c`` pods on ``s``,
+      bounded by K;
+    - ``aff[c, a, s]`` delta is an OR of ``slot_aff`` words, so every
+      set bit appears in the OR over all slots.
+    """
+    req = np.asarray(packed.slot_req)
+    valid = np.asarray(packed.slot_valid)
+    consumed_max = 0.0
+    if req.size:
+        consumed_max = float(
+            (req * valid[:, :, None].astype(req.dtype)).sum(axis=1).max()
+        )
+    if consumed_max <= np.iinfo(np.int16).max:
+        used = "int16"
+    elif consumed_max <= np.iinfo(np.uint16).max:
+        # consumed is invariantly >= 0 (the sum of currently-assigned
+        # requests), so the unsigned range is safe — it covers e.g. a
+        # fully-packed 64 GiB node's MiB-unit memory sums that int16
+        # cannot (updates widen->compute->narrow, never cast a negative
+        # intermediate)
+        used = "uint16"
+    else:
+        used = "float32"  # exact up to 2**24, the pack contract
+    K = req.shape[1] if req.ndim == 3 else 0
+    count = "int8" if K <= np.iinfo(np.int8).max else "int16"
+    slot_aff = np.asarray(packed.slot_aff)
+    aff_bits = (
+        int(np.bitwise_or.reduce(slot_aff, axis=None)) if slot_aff.size else 0
+    )
+    if aff_bits <= 0xFF:
+        aff = "uint8"
+    elif aff_bits <= 0xFFFF:
+        aff = "uint16"
+    else:
+        aff = "uint32"
+    return CarryLayout(used=used, count=count, aff=aff)
+
+
+def plane_bytes(layout: CarryLayout, R: int, A: int) -> int:
+    """Carry bytes per (lane, spot) under ``layout``: R used planes +
+    one count plane + A affinity planes. The wide layout reproduces the
+    historical 4*(R + A + 1); the full narrow layout is 2R + 2A + 1."""
+    return (
+        R * np.dtype(layout.used).itemsize
+        + np.dtype(layout.count).itemsize
+        + A * np.dtype(layout.aff).itemsize
+    )
+
+
+def is_narrow(layout: CarryLayout) -> bool:
+    """True when any carry plane is narrower than the wide layout."""
+    return layout != WIDE_LAYOUT

@@ -111,13 +111,120 @@ def with_repair(solve_fn, rounds: int, spot_chunks: int = 1):
     return solve
 
 
+def with_repair_streamed(
+    rounds: int,
+    carry_chunks: int,
+    layout,
+    chain: bool = True,
+    best_fit_fallback: bool = True,
+):
+    """The carry-streamed union (ROADMAP 5): first-fit with the spot
+    axis STREAMED in ``carry_chunks`` ordered chunks (leftovers flow
+    forward — resident first-fit carry O(S / carry_chunks)), best-fit
+    as per-slot elect-then-commit over the stacked narrow chunk state,
+    and the spot-chunked repair rounds — every pass on the DELTA-form
+    narrow carry ``layout`` (solver/carry.py) widened on read, so the
+    whole union is bit-identical to ``with_repair(plan_ffd, rounds)``
+    while the resident per-(lane, spot) carry bytes shrink ~2x and the
+    per-round temporaries shrink by the chunk count. This is the tier
+    ``planner/solver_planner._maybe_shard`` dispatches above the 2-D
+    fallback: repair stays LIVE past the wide layouts' carry bound.
+
+    Same cond discipline as ``with_repair``: best-fit and repair only
+    execute when the pass before them left a valid lane unproven."""
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_streamed
+    from k8s_spot_rescheduler_tpu.solver.repair import plan_repair_chunked
+
+    def solve(packed) -> SolveResult:
+        cand_valid = jnp.asarray(packed.cand_valid)
+        ff = plan_ffd_streamed(
+            packed, carry_chunks=carry_chunks, layout=layout
+        )
+        if not best_fit_fallback:
+            return ff
+        need_bf = jnp.any(cand_valid & ~ff.feasible)
+        bf = _cond_solve(
+            need_bf,
+            lambda: plan_ffd_streamed(
+                packed,
+                carry_chunks=carry_chunks,
+                layout=layout,
+                best_fit=True,
+            ),
+            ff,
+        )
+        greedy_feasible = ff.feasible | bf.feasible
+        if rounds <= 0:
+            assignment = jnp.where(
+                ff.feasible[:, None], ff.assignment, bf.assignment
+            )
+            return SolveResult(
+                feasible=greedy_feasible, assignment=assignment
+            )
+        need_repair = jnp.any(cand_valid & ~greedy_feasible)
+        rp = _cond_solve(
+            need_repair,
+            lambda: plan_repair_chunked(
+                packed,
+                rounds=rounds,
+                chain=chain,
+                spot_chunks=carry_chunks,
+                layout=layout,
+            ),
+            ff,
+        )
+        feasible = greedy_feasible | rp.feasible
+        assignment = jnp.where(
+            ff.feasible[:, None],
+            ff.assignment,
+            jnp.where(bf.feasible[:, None], bf.assignment, rp.assignment),
+        )
+        return SolveResult(feasible=feasible, assignment=assignment)
+
+    return solve
+
+
+def union_program(
+    rounds: int,
+    best_fit_fallback: bool = True,
+    *,
+    repair_spot_chunks: int = 1,
+    carry_chunks: int = 0,
+    carry_layout=None,
+):
+    """THE union-composition ladder every dispatch site builds from —
+    the cand-sharded block program (parallel/sharded_ffd) and the
+    batched tenant program (parallel/tenant_batch) call this one
+    helper, so their compositions can never drift. ``carry_chunks`` >=
+    1 selects the carry-streamed narrow union (``carry_layout``
+    defaults to NARROW_LAYOUT); otherwise first-fit ∪ best-fit ∪
+    (spot-chunked) repair per the flags."""
+    from k8s_spot_rescheduler_tpu.solver.carry import NARROW_LAYOUT
+    from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
+
+    if carry_chunks and carry_chunks >= 1:
+        return with_repair_streamed(
+            rounds,
+            carry_chunks,
+            carry_layout if carry_layout is not None else NARROW_LAYOUT,
+            best_fit_fallback=best_fit_fallback,
+        )
+    if best_fit_fallback and rounds > 0:
+        return with_repair(plan_ffd, rounds, spot_chunks=repair_spot_chunks)
+    if best_fit_fallback:
+        return with_best_fit_fallback(plan_ffd)
+    return plan_ffd
+
+
 # Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
 # tools/analysis/jaxpr): the fused union compositions the planner
 # actually runs. The ``reconcile`` specs tie each composition to
 # solver/memory.estimate_union_hbm_breakdown at the matching
 # repair_spot_chunks mode — the memory-reconcile pass diffs the traced
 # program's live-buffer model against the estimate so the HBM dispatch
-# (pick_repair_chunks / should_shard) can't rot as kernels change.
+# (pick_repair_chunks / should_shard) can't rot as kernels change. The
+# streamed entry reconciles against the NARROW-layout carry estimate
+# (carry_chunks mode) — the ROADMAP-5 regression gate.
 from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
     HotProgram,
     packed_struct,
@@ -155,4 +262,21 @@ HOT_PROGRAMS = {
         covers=("solver.repair:plan_repair_chunked",),
         reconcile={"repair_spot_chunks": 4},
     ),
+    "union.repair_streamed": HotProgram(
+        build=lambda s: (
+            with_repair_streamed(8, 4, _narrow_layout()),
+            (packed_struct(s),),
+        ),
+        covers=(
+            "solver.ffd:plan_ffd_streamed",
+            "solver.repair:plan_repair_chunked",
+        ),
+        reconcile={"repair_spot_chunks": 4, "carry_chunks": 4},
+    ),
 }
+
+
+def _narrow_layout():
+    from k8s_spot_rescheduler_tpu.solver.carry import NARROW_LAYOUT
+
+    return NARROW_LAYOUT

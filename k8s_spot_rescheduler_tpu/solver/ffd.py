@@ -23,6 +23,24 @@ Layout: the mutable carries keep the wide spot axis MINOR — [C, R, S] and
 [C, A, S] — because TPU tiles the minor dim to 128 lanes; a minor axis of
 R=2 would pad 64x in HBM (predicates/masks.fit_mask_t). Capacities are
 float32 integers < 2**24 (exact); masks are uint32; shapes are static.
+
+Carry discipline (ROADMAP 5, the 20x reshape): the scan state is a
+DELTA against the static spot rows — capacity *consumed*, placements
+*added*, pod-contributed affinity bits — not the absolute free/count/aff
+the carries historically held. The statics are read-only scan inputs and
+``_widen`` reconstructs the absolute values at ONE site per read, so the
+selection arithmetic is bit-identical (exact f32 integers) while the
+carried planes can be narrow ints: ``solver/carry.CarryLayout`` sizes
+them int16/int8/uint16 from exact host-side pack bounds
+(``carry_layout``), cutting the resident per-(lane, spot) carry bytes
+~2x and moving the fully-chunked scaling ceiling past 20x.
+``plan_ffd_streamed`` additionally streams the spot axis through the
+scan in ordered chunks — first-fit decomposes exactly with leftovers
+flowing forward (the ops/pallas_ffd ``_plan_ffd_chunked`` property,
+lifted to the carry itself), so the first-fit pass's resident carry is
+O(S / carry_chunks); best-fit keeps a stacked narrow state with the
+per-slot elect-then-commit election proven for the chunked repair's
+partial pass.
 """
 
 from __future__ import annotations
@@ -35,29 +53,106 @@ import jax.numpy as jnp
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask_t
+from k8s_spot_rescheduler_tpu.solver.carry import (
+    CarryLayout,
+    NARROW_LAYOUT,
+    WIDE_LAYOUT,
+)
+# re-exported: the kernel-facing layout surface (tests and the planner
+# import carry_layout from here beside plan_ffd)
+from k8s_spot_rescheduler_tpu.solver.carry import carry_layout  # noqa: F401
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
+
+__all__ = [
+    "CarryLayout",
+    "NARROW_LAYOUT",
+    "WIDE_LAYOUT",
+    "carry_layout",
+    "plan_ffd",
+    "plan_ffd_jit",
+    "plan_ffd_streamed",
+    "plan_ffd_streamed_jit",
+]
+
+
+class _SpotStatics(NamedTuple):
+    """The read-only spot rows the delta carries widen against (full
+    axis in ``plan_ffd``; one chunk's slice in the streamed kernels)."""
+
+    free_t: jax.Array  # f32 [R, S]
+    count: jax.Array  # i32 [S]
+    aff_t: jax.Array  # u32 [A, S]
+    max_pods: jax.Array  # i32 [S]
+    taints_t: jax.Array  # u32 [W, S]
+    ok: jax.Array  # bool [S]
 
 
 class _Carry(NamedTuple):
-    free: jax.Array  # f32 [C, R, S]
-    count: jax.Array  # i32 [C, S]
-    aff: jax.Array  # u32 [C, A, S]
+    """Delta-form mutable state (dtypes from a CarryLayout)."""
+
+    used: jax.Array  # layout.used [C, R, S] — capacity consumed
+    dcount: jax.Array  # layout.count [C, S] — placements added
+    daff: jax.Array  # layout.aff [C, A, S] — placed pods' aff bits
     feasible: jax.Array  # bool [C]
 
 
-def _scan_step(static, best_fit, carry: _Carry, slot):
+def _widen(static: _SpotStatics, used, dcount, daff):
+    """THE widen-on-read site: absolute (free_t, count, aff_t) views of
+    a delta carry. Exact — consumed/placed values are integers within
+    the layout guard's bounds, so the casts lose nothing and the
+    arithmetic downstream is bit-identical to the wide layout."""
+    free_t = static.free_t - used.astype(static.free_t.dtype)
+    count = static.count + dcount.astype(static.count.dtype)
+    aff_t = static.aff_t | daff.astype(static.aff_t.dtype)
+    return free_t, count, aff_t
+
+
+def _zero_carry(
+    layout: CarryLayout, C: int, R: int, A: int, S: int, feasible
+) -> _Carry:
+    return _Carry(
+        used=jnp.zeros((C, R, S), layout.used),
+        dcount=jnp.zeros((C, S), layout.count),
+        daff=jnp.zeros((C, A, S), layout.aff),
+        feasible=feasible,
+    )
+
+
+def _spot_statics(packed: PackedCluster) -> _SpotStatics:
+    return _SpotStatics(
+        free_t=jnp.asarray(packed.spot_free).T,  # [R, S]
+        count=jnp.asarray(packed.spot_count).astype(jnp.int32),
+        aff_t=jnp.asarray(packed.spot_aff).T,  # [A, S]
+        max_pods=jnp.asarray(packed.spot_max_pods),
+        taints_t=jnp.asarray(packed.spot_taints).T,  # [W, S]
+        ok=jnp.asarray(packed.spot_ok),
+    )
+
+
+def _slot_stream(packed: PackedCluster):
+    return (
+        jnp.moveaxis(jnp.asarray(packed.slot_req), 1, 0),  # [K, C, R]
+        jnp.moveaxis(jnp.asarray(packed.slot_valid), 1, 0),  # [K, C]
+        jnp.moveaxis(jnp.asarray(packed.slot_tol), 1, 0),  # [K, C, W]
+        jnp.moveaxis(jnp.asarray(packed.slot_aff), 1, 0),  # [K, C, A]
+    )
+
+
+def _scan_step(static: _SpotStatics, best_fit, carry: _Carry, slot):
     """Place pod-slot k for every candidate lane at once."""
-    spot_max_pods, spot_taints_t, spot_ok = static
     req, valid, tol, aff = slot  # [C,R], [C], [C,W], [C,A]
+    free_t, count, aff_t = _widen(
+        static, carry.used, carry.dcount, carry.daff
+    )
 
     fits = fit_mask_t(
         jnp,
-        free_t=carry.free,
-        count=carry.count,
-        max_pods=spot_max_pods,
-        node_taints_t=spot_taints_t,
-        node_ok=spot_ok,
-        node_aff_t=carry.aff,
+        free_t=free_t,
+        count=count,
+        max_pods=static.max_pods,
+        node_taints_t=static.taints_t,
+        node_ok=static.ok,
+        node_aff_t=aff_t,
         req=req,
         tol=tol,
         aff=aff,
@@ -67,7 +162,7 @@ def _scan_step(static, best_fit, carry: _Carry, slot):
     if best_fit:
         # fallback packing: tightest primary-resource fit, ties → probe
         # order (argmin returns the first minimum)
-        slack = jnp.where(fits, carry.free[:, 0, :] - req[:, None, 0], jnp.inf)
+        slack = jnp.where(fits, free_t[:, 0, :] - req[:, None, 0], jnp.inf)
         first = jnp.argmin(slack, axis=-1)
     else:
         first = jnp.argmax(fits, axis=-1)  # first fitting spot per lane
@@ -76,44 +171,41 @@ def _scan_step(static, best_fit, carry: _Carry, slot):
     S = fits.shape[-1]
     onehot = (jnp.arange(S)[None, :] == first[:, None]) & place[:, None]  # [C,S]
 
-    free = carry.free - onehot[:, None, :] * req[:, :, None]
-    count = carry.count + onehot.astype(carry.count.dtype)
-    aff_acc = carry.aff | jnp.where(onehot[:, None, :], aff[:, :, None], 0)
+    used = carry.used + (
+        onehot[:, None, :] * req[:, :, None]
+    ).astype(carry.used.dtype)
+    dcount = carry.dcount + onehot.astype(carry.dcount.dtype)
+    daff = carry.daff | jnp.where(
+        onehot[:, None, :], aff[:, :, None], 0
+    ).astype(carry.daff.dtype)
     feasible = carry.feasible & (any_fit | ~valid)
 
     chosen = jnp.where(place, first.astype(jnp.int32), jnp.int32(-1))
-    return _Carry(free, count, aff_acc, feasible), chosen
+    return _Carry(used, dcount, daff, feasible), chosen
 
 
-def plan_ffd(packed: PackedCluster, best_fit: bool = False) -> SolveResult:
+def plan_ffd(
+    packed: PackedCluster,
+    best_fit: bool = False,
+    layout: CarryLayout = WIDE_LAYOUT,
+) -> SolveResult:
     """Jittable batched first-fit (or, with ``best_fit``, best-fit
-    fallback-mode) solve over a PackedCluster (device arrays)."""
-    C = packed.slot_req.shape[0]
+    fallback-mode) solve over a PackedCluster (device arrays).
+    ``layout`` narrows the delta carries (solver/carry.py); the caller
+    must only pass a narrow layout ``carry_layout(packed)`` proves —
+    the default wide layout is always exact."""
+    C, K, R = packed.slot_req.shape
     S = packed.spot_free.shape[0]
+    A = packed.spot_aff.shape[1]
 
-    free_t = jnp.asarray(packed.spot_free).T  # [R, S]
-    aff_t = jnp.asarray(packed.spot_aff).T  # [A, S]
-    carry = _Carry(
-        free=jnp.broadcast_to(free_t, (C, *free_t.shape)),
-        count=jnp.broadcast_to(packed.spot_count, (C, S)).astype(jnp.int32),
-        aff=jnp.broadcast_to(aff_t, (C, *aff_t.shape)),
-        feasible=jnp.asarray(packed.cand_valid),
+    static = _spot_statics(packed)
+    carry = _zero_carry(
+        layout, C, R, A, S, jnp.asarray(packed.cand_valid)
     )
-    static = (
-        jnp.asarray(packed.spot_max_pods),
-        jnp.asarray(packed.spot_taints).T,  # [W, S]
-        jnp.asarray(packed.spot_ok),
-    )
-
-    slots = (
-        jnp.moveaxis(packed.slot_req, 1, 0),  # [K, C, R]
-        jnp.moveaxis(packed.slot_valid, 1, 0),  # [K, C]
-        jnp.moveaxis(packed.slot_tol, 1, 0),  # [K, C, W]
-        jnp.moveaxis(packed.slot_aff, 1, 0),  # [K, C, A]
-    )
-
     carry, chosen = jax.lax.scan(
-        functools.partial(_scan_step, static, best_fit), carry, slots
+        functools.partial(_scan_step, static, best_fit),
+        carry,
+        _slot_stream(packed),
     )  # chosen: [K, C]
 
     feasible = carry.feasible & jnp.asarray(packed.cand_valid)
@@ -122,12 +214,266 @@ def plan_ffd(packed: PackedCluster, best_fit: bool = False) -> SolveResult:
     return SolveResult(feasible=feasible, assignment=assignment)
 
 
-plan_ffd_jit = jax.jit(plan_ffd, static_argnames=("best_fit",))
+plan_ffd_jit = jax.jit(plan_ffd, static_argnames=("best_fit", "layout"))
+
+
+# --- spot-streamed kernels (ROADMAP 5) -------------------------------------
+
+def chunk_minor(arr, n: int, Sc: int):
+    """[..., n*Sc] -> [n, ..., Sc]: split the minor spot axis into n
+    ordered chunk-major blocks (block j holds global spots
+    [j*Sc, (j+1)*Sc))."""
+    parts = jnp.reshape(arr, (*arr.shape[:-1], n, Sc))
+    return jnp.moveaxis(parts, -2, 0)
+
+
+def pad_spot_axis(arr, pad: int):
+    """Pad the leading spot axis with ``pad`` inert rows (the padded
+    nodes carry spot_ok=False and sit at the END of the probe order, so
+    placements and global indices are unchanged)."""
+    arr = jnp.asarray(arr)
+    if pad == 0:
+        return arr
+    widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    return jnp.pad(arr, widths)
+
+
+def chunked_spot_statics(packed: PackedCluster, n: int, Sc: int):
+    """The spot statics split into n ordered chunks:
+    (free0 [n,R,Sc], count0 [n,Sc], aff0 [n,A,Sc], taints [n,W,Sc],
+    ok [n,Sc], max_pods [n,Sc], offs [n])."""
+    S = packed.spot_free.shape[0]
+    pad = n * Sc - S
+    return (
+        chunk_minor(pad_spot_axis(packed.spot_free, pad).T, n, Sc),
+        chunk_minor(
+            pad_spot_axis(packed.spot_count, pad).astype(jnp.int32), n, Sc
+        ),
+        chunk_minor(pad_spot_axis(packed.spot_aff, pad).T, n, Sc),
+        chunk_minor(pad_spot_axis(packed.spot_taints, pad).T, n, Sc),
+        chunk_minor(pad_spot_axis(packed.spot_ok, pad), n, Sc),
+        chunk_minor(pad_spot_axis(packed.spot_max_pods, pad), n, Sc),
+        jnp.arange(n, dtype=jnp.int32) * Sc,
+    )
+
+
+def _zero_chunk_state(layout: CarryLayout, n, C, R, A, Sc):
+    """Stacked delta state over n chunks (best-fit / repair rounds)."""
+    return (
+        jnp.zeros((n, C, R, Sc), layout.used),
+        jnp.zeros((n, C, Sc), layout.count),
+        jnp.zeros((n, C, A, Sc), layout.aff),
+    )
+
+
+def _widen_chunk(free0, count0, aff0, used, dcount, daff):
+    """Per-chunk twin of ``_widen`` (chunk statics vs chunk deltas)."""
+    return (
+        free0 - used.astype(free0.dtype),
+        count0 + dcount.astype(count0.dtype),
+        aff0 | daff.astype(aff0.dtype),
+    )
+
+
+def _stream_bf_step(chunk_xs, Sc, state, slot):
+    """One best-fit placement across ordered spot chunks, delta-form:
+    each chunk elects its local tightest fit; a lexicographic
+    (slack, chunk-order) election picks the global winner — identical
+    to the unchunked argmin (ties resolve to the earlier probe index) —
+    and only the winning chunk's state commits. Returns
+    (state, (chosen global index or -1, any_fit))."""
+    free0_c, count0_c, aff0_c, taints_c, ok_c, maxp_c, offs = chunk_xs
+    used_c, dcount_c, daff_c = state
+    req, valid, tol, aff = slot  # [C,R], [C], [C,W], [C,A]
+    C = req.shape[0]
+
+    def elect(best, xs):
+        best_slack, best_g = best
+        (used_j, dcount_j, daff_j, free0_j, count0_j, aff0_j,
+         taints_j, ok_j, maxp_j, off) = xs
+        free_j, count_j, aff_j = _widen_chunk(
+            free0_j, count0_j, aff0_j, used_j, dcount_j, daff_j
+        )
+        fits = fit_mask_t(
+            jnp,
+            free_t=free_j,
+            count=count_j,
+            max_pods=maxp_j,
+            node_taints_t=taints_j,
+            node_ok=ok_j,
+            node_aff_t=aff_j,
+            req=req,
+            tol=tol,
+            aff=aff,
+        )  # [C, Sc]
+        slack = jnp.where(fits, free_j[:, 0, :] - req[:, None, 0], jnp.inf)
+        m = jnp.min(slack, axis=-1)
+        i = jnp.argmin(slack, axis=-1).astype(jnp.int32)
+        better = m < best_slack  # strict: ties keep the earlier chunk
+        return (
+            jnp.where(better, m, best_slack),
+            jnp.where(better, off + i, best_g),
+        ), None
+
+    (best_slack, best_g), _ = jax.lax.scan(
+        elect,
+        (
+            jnp.full((C,), jnp.inf, free0_c.dtype),
+            jnp.zeros((C,), jnp.int32),
+        ),
+        (used_c, dcount_c, daff_c, *chunk_xs),
+    )
+    any_fit = jnp.isfinite(best_slack)
+    place = valid & any_fit
+
+    def commit(xs):
+        used_j, dcount_j, daff_j, off = xs
+        loc = best_g - off
+        onehot = (
+            jnp.arange(Sc)[None, :] == loc[:, None]
+        ) & place[:, None]  # [C, Sc]
+        return (
+            used_j + (
+                onehot[:, None, :] * req[:, :, None]
+            ).astype(used_j.dtype),
+            dcount_j + onehot.astype(dcount_j.dtype),
+            daff_j | jnp.where(
+                onehot[:, None, :], aff[:, :, None], 0
+            ).astype(daff_j.dtype),
+        )
+
+    used_c, dcount_c, daff_c = jax.lax.map(
+        commit, (used_c, dcount_c, daff_c, offs)
+    )
+    chosen = jnp.where(place, best_g, jnp.int32(-1))
+    return (used_c, dcount_c, daff_c), (chosen, any_fit)
+
+
+def plan_ffd_streamed(
+    packed: PackedCluster,
+    *,
+    carry_chunks: int = 2,
+    layout: CarryLayout = WIDE_LAYOUT,
+    best_fit: bool = False,
+) -> SolveResult:
+    """``plan_ffd`` with the spot axis streamed through the scan in
+    ``carry_chunks`` ordered chunks.
+
+    First-fit decomposes EXACTLY over an ordered spot partition with
+    leftover pods flowing forward (per-spot state is chunk-independent
+    and first-fit prefers earlier spots — the ops/pallas_ffd
+    ``_plan_ffd_chunked`` property): each chunk runs the full K-slot
+    scan against its own chunk-local delta carry (zeros-initialized —
+    the statics are scan inputs), placing every still-unplaced pod that
+    fits, so the RESIDENT first-fit carry is O(S / carry_chunks) and
+    the cross-chunk carry is just the O(C·K) remaining/chosen bookkeep.
+
+    Best-fit's global tightest-slack election does not stream; with
+    ``best_fit`` the kernel runs the per-slot elect-then-commit over a
+    STACKED narrow chunk state (``_stream_bf_step``) — same results as
+    ``plan_ffd(best_fit=True)``, resident carry narrow but O(S).
+
+    Bit-identical to ``plan_ffd`` in both modes (pinned by
+    tests/test_carry_stream.py at multiple chunk counts); the spot axis
+    is padded to a chunk multiple with inert nodes at the end of the
+    probe order, so placements and assignment indices are unchanged."""
+    if carry_chunks <= 1:
+        return plan_ffd(packed, best_fit=best_fit, layout=layout)
+    C, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    A = packed.spot_aff.shape[1]
+    n = int(carry_chunks)
+    Sc = -(-S // n)
+    chunk_xs = chunked_spot_statics(packed, n, Sc)
+    slots = _slot_stream(packed)
+
+    if best_fit:
+        def bf_step(carry, slot):
+            state, feasible = carry
+            _, valid, _, _ = slot
+            state, (chosen, any_fit) = _stream_bf_step(
+                chunk_xs, Sc, state, slot
+            )
+            return (state, feasible & (any_fit | ~valid)), chosen
+
+        (_, feasible), chosen = jax.lax.scan(
+            bf_step,
+            (
+                _zero_chunk_state(layout, n, C, R, A, Sc),
+                jnp.asarray(packed.cand_valid),
+            ),
+            slots,
+        )
+        feasible = feasible & jnp.asarray(packed.cand_valid)
+        assignment = jnp.where(feasible[None, :], chosen, -1).T
+        return SolveResult(feasible=feasible, assignment=assignment)
+
+    slot_req_k, _, slot_tol_k, slot_aff_k = slots
+
+    def chunk_step(carry, xs):
+        remaining, chosen = carry  # [C, K] bool, [C, K] i32
+        free0_j, count0_j, aff0_j, taints_j, ok_j, maxp_j, off = xs
+        static_j = _SpotStatics(
+            free_t=free0_j,
+            count=count0_j,
+            aff_t=aff0_j,
+            max_pods=maxp_j,
+            taints_t=taints_j,
+            ok=ok_j,
+        )
+        inner = _zero_carry(
+            layout, C, R, A, Sc, jnp.ones((C,), bool)
+        )
+
+        def slot_step(c, slot_k):
+            # feasibility is the outer loop's job (a leftover pod may
+            # still place in a later chunk); keep the inner flag inert
+            new_c, chosen_local = _scan_step(static_j, False, c, slot_k)
+            return new_c._replace(feasible=c.feasible), chosen_local
+
+        _, chosen_local = jax.lax.scan(
+            slot_step,
+            inner,
+            (
+                slot_req_k,
+                jnp.moveaxis(remaining, 1, 0),  # [K, C]
+                slot_tol_k,
+                slot_aff_k,
+            ),
+        )  # chosen_local: [K, C], -1 = no fit in this chunk
+        placed = (chosen_local >= 0).T  # [C, K]
+        chosen = jnp.where(placed, chosen_local.T + off, chosen)
+        remaining = remaining & ~placed
+        return (remaining, chosen), None
+
+    (remaining, chosen), _ = jax.lax.scan(
+        chunk_step,
+        (
+            jnp.asarray(packed.slot_valid),
+            jnp.full((C, K), -1, jnp.int32),
+        ),
+        chunk_xs,
+    )
+    # a lane is feasible iff nothing valid remains unplaced — identical
+    # to plan_ffd's per-turn verdict (a pod with no fit anywhere at its
+    # turn can never place later: chunk states at its turn are exactly
+    # the global first-fit states)
+    feasible = jnp.asarray(packed.cand_valid) & ~jnp.any(remaining, axis=1)
+    assignment = jnp.where(feasible[:, None], chosen, -1)
+    return SolveResult(feasible=feasible, assignment=assignment)
+
+
+plan_ffd_streamed_jit = jax.jit(
+    plan_ffd_streamed,
+    static_argnames=("carry_chunks", "layout", "best_fit"),
+)
 
 
 # Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
-# tools/analysis/jaxpr): the traced shapes of this module's jit root.
-# manifest-contract (make analyze) fails if the root loses coverage.
+# tools/analysis/jaxpr): the traced shapes of this module's jit roots.
+# manifest-contract (make analyze) fails if a root loses coverage. The
+# streamed variants trace at the NARROW layout — the dtype pass then
+# sees the exact int16/int8/uint16 carry program the 20x tier runs.
 from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
     HotProgram,
     packed_struct,
@@ -144,5 +490,26 @@ HOT_PROGRAMS = {
             (packed_struct(s),),
         ),
         covers=("solver.ffd:plan_ffd",),
+    ),
+    "ffd.streamed": HotProgram(
+        build=lambda s: (
+            functools.partial(
+                plan_ffd_streamed, carry_chunks=4, layout=NARROW_LAYOUT
+            ),
+            (packed_struct(s),),
+        ),
+        covers=("solver.ffd:plan_ffd_streamed",),
+    ),
+    "ffd.streamed_best_fit": HotProgram(
+        build=lambda s: (
+            functools.partial(
+                plan_ffd_streamed,
+                carry_chunks=4,
+                layout=NARROW_LAYOUT,
+                best_fit=True,
+            ),
+            (packed_struct(s),),
+        ),
+        covers=("solver.ffd:plan_ffd_streamed",),
     ),
 }

@@ -26,7 +26,12 @@ tests/test_sharding.py)."""
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional
+
+from k8s_spot_rescheduler_tpu.solver.carry import (
+    NARROW_LAYOUT,
+    plane_bytes as carry_plane_bytes_of,
+)
 
 # Default assumed HBM when the backend won't say (v5e = 16 GB);
 # fraction left to the solver after runtime/program overheads.
@@ -36,11 +41,14 @@ BUDGET_FRACTION = 0.85
 # A repair spot chunk narrower than the TPU lane width stops paying:
 # every [C, Sc] temporary pads back up to 128 lanes in VMEM/HBM tiles.
 MIN_REPAIR_CHUNK = 128
+MIN_CARRY_CHUNK = MIN_REPAIR_CHUNK  # same tiling argument, carry tier
 
 
 def estimate_union_hbm_breakdown(
     C: int, K: int, S: int, R: int, W: int, A: int,
     repair_spot_chunks: int = 1,
+    carry_chunks: int = 0,
+    carry_plane_bytes: Optional[int] = None,
 ) -> dict:
     """Per-component HBM estimate of the fused union solver: named
     buffer family -> bytes. ``estimate_union_hbm_bytes`` is the sum.
@@ -64,13 +72,53 @@ def estimate_union_hbm_breakdown(
     repair (solver/repair.plan_repair_chunked): only one spot chunk's
     round temporaries are live at a time, so that term divides by the
     chunk count — the carries (which every greedy pass needs too) do
-    not, which is what sets the NEW, fully-chunked ceiling.
+    not, which is what set the OLD fully-chunked ceiling.
     ``repair_spot_chunks=0`` models a program with NO repair phase at
     all (``fallback_best_fit`` off or ``repair_rounds=0``): the repair
     working set is never allocated, so charging it would reroute such
     configs off one chip for memory they never use.
+
+    ``carry_chunks`` >= 1 models the CARRY-STREAMED union
+    (solver/fallback.with_repair_streamed, ROADMAP 5): the greedy scan
+    state is the narrow DELTA carry (``carry_plane_bytes`` per
+    (lane, spot) — solver/carry.plane_bytes of the pack's guarded
+    layout; the NARROW_LAYOUT default when unspecified), double-buffered
+    like every scan carry, and the first-fit pass's resident chunk,
+    per-step temporaries and repair working set all live one spot chunk
+    at a time, so those terms divide by the carry-chunk count. The
+    carries term does NOT divide — best-fit's global election and the
+    repair rounds keep the stacked state — which is why the new ceiling
+    sits at the NARROW carry bound rather than the wide one.
     """
     plane = C * S * 4  # one f32/i32/u32 [C, S] plane
+    if carry_chunks and carry_chunks >= 1:
+        npb = (
+            carry_plane_bytes
+            if carry_plane_bytes
+            else carry_plane_bytes_of(NARROW_LAYOUT, R, A)
+        )
+        Sc = -(-S // carry_chunks)
+        cplane = C * Sc * 4  # one chunk-resident f32 [C, Sc] plane
+        return {
+            # stacked narrow delta state (best-fit + repair rounds),
+            # double-buffered by the scan — the new, smaller sharp term
+            "carries": 2 * npb * C * S,
+            # per-chunk step temporaries only: the elect-then-commit
+            # map's restacked copy is a liveness-model artifact (XLA
+            # ping-pongs the scan carry's two buffers; the measured
+            # hardware envelope has always tracked the estimator, not
+            # the liveness peak — memory-reconcile's TOTAL_BAND lower
+            # edge is calibrated to 0.20 for exactly this shape)
+            "temporaries": 3 * cplane,
+            "repair": (
+                0
+                if repair_spot_chunks == 0
+                else (R + 2 * A + 7) * cplane
+            ),
+            "slots": K * C * (R * 4 + 1 + W * 4 + A * 4),
+            "outputs": 2 * C * K * 4,
+            "spot_static": S * (R * 4 + 4 + 4 + W * 4 + 1 + A * 4),
+        }
     return {
         "carries": 2 * (R + A + 1) * plane,  # double-buffered scan state
         "temporaries": 3 * plane,
@@ -88,13 +136,18 @@ def estimate_union_hbm_breakdown(
 def estimate_union_hbm_bytes(
     C: int, K: int, S: int, R: int, W: int, A: int,
     repair_spot_chunks: int = 1,
+    carry_chunks: int = 0,
+    carry_plane_bytes: Optional[int] = None,
 ) -> int:
     """Estimated peak HBM of the fused union solver at these shapes
     (sum of ``estimate_union_hbm_breakdown`` — see there for the
     component model)."""
     return sum(
         estimate_union_hbm_breakdown(
-            C, K, S, R, W, A, repair_spot_chunks=repair_spot_chunks
+            C, K, S, R, W, A,
+            repair_spot_chunks=repair_spot_chunks,
+            carry_chunks=carry_chunks,
+            carry_plane_bytes=carry_plane_bytes,
         ).values()
     )
 
@@ -127,6 +180,139 @@ def pick_repair_chunks(
         n *= 2
         if -(-S // n) < MIN_REPAIR_CHUNK:
             return 0
+
+
+def pick_carry_chunks(
+    C: int, K: int, S: int, R: int, W: int, A: int, budget_bytes: int,
+    carry_plane_bytes: Optional[int] = None,
+) -> int:
+    """Carry-chunk count for the carry-streamed union at these shapes.
+
+    1 = the narrow-carry program fits ``budget_bytes`` without spot
+    streaming; >1 = the smallest power-of-two chunking (each chunk kept
+    at least MIN_CARRY_CHUNK spots wide) whose estimate fits; 0 = even
+    fully streamed the narrow stacked carries exceed the budget — the
+    regime of the 2-D cand×spot tier, where the repair phase is
+    genuinely unavailable and ``repair_unavailable`` must fire.
+
+    ``carry_plane_bytes`` is the pack's guarded layout width
+    (solver/carry.plane_bytes of carry_layout(packed)); the chunk-count
+    discipline mirrors ``pick_repair_chunks`` (powers of two, one
+    compiled program per count)."""
+    n = 1
+    while True:
+        est = estimate_union_hbm_bytes(
+            C, K, S, R, W, A,
+            repair_spot_chunks=n,
+            carry_chunks=n,
+            carry_plane_bytes=carry_plane_bytes,
+        )
+        if est <= budget_bytes:
+            return n
+        n *= 2
+        if -(-S // n) < MIN_CARRY_CHUNK:
+            return 0
+
+
+class TierDecision(NamedTuple):
+    """The dispatch ladder's verdict at one problem's shapes — the ONE
+    decision ``planner/solver_planner._maybe_shard``, ``bench.py`` and
+    ``make scale-smoke`` all read, so they can never drift.
+
+    ``kind``: "single" (configured single-chip program), "cand"
+    (cand-sharded union, repair unchunked), "cand-chunked" (cand tier,
+    spot-chunked repair), "cand-carry" (cand tier, narrow delta carries
+    + spot streaming — the ROADMAP-5 rung), "2d" (cand×spot, repair
+    unavailable). ``repair_chunks`` is the spot-chunk count the repair
+    phase runs with (0 = no repair on this tier); ``carry_chunks`` > 0
+    only on the carry tier. ``est_bytes`` is the per-device estimate of
+    the dispatched program; ``carry_bytes`` its resident scan-carry
+    component (the "carries" term); ``lane_block`` the per-device lane
+    count on the sharded tiers."""
+
+    kind: str
+    repair_chunks: int
+    carry_chunks: int
+    est_bytes: int
+    carry_bytes: int
+    lane_block: int
+    repair_unavailable: bool
+
+
+def pick_tier(
+    C: int, K: int, S: int, R: int, W: int, A: int,
+    *,
+    n_devices: int,
+    budget_bytes: Optional[int] = None,
+    wants_repair: bool = True,
+    carry_plane_bytes: Optional[int] = None,
+    forced_carry_chunks: int = 0,
+) -> TierDecision:
+    """Walk the dispatch ladder at these shapes: single-chip →
+    cand-sharded (repair intact) → cand-sharded + spot-chunked repair →
+    cand-sharded + carry-streamed narrow union → 2-D (repair
+    unavailable). ``forced_carry_chunks`` (the ``carry_chunks`` config
+    knob) pins the carry tier's chunk count instead of
+    ``pick_carry_chunks``; 0 = auto. ``carry_plane_bytes`` may be a
+    zero-arg callable (the pack's exact layout guard is an O(C·K·R)
+    host pass — deferring it keeps the common under-budget tick from
+    paying it)."""
+    budget = budget_bytes if budget_bytes else device_hbm_budget()
+    own_chunks = 1 if wants_repair else 0
+
+    def est(c, **kw):
+        return estimate_union_hbm_bytes(c, K, S, R, W, A, **kw)
+
+    def bd(c, **kw):
+        return estimate_union_hbm_breakdown(c, K, S, R, W, A, **kw)
+
+    full = est(C, repair_spot_chunks=own_chunks)
+    if n_devices <= 1 or full <= budget:
+        return TierDecision(
+            "single", own_chunks, 0, full,
+            bd(C, repair_spot_chunks=own_chunks)["carries"], C, False,
+        )
+    lane = -(-C // n_devices)
+    lane_est = est(lane, repair_spot_chunks=own_chunks)
+    if lane_est <= budget:
+        return TierDecision(
+            "cand", own_chunks, 0, lane_est,
+            bd(lane, repair_spot_chunks=own_chunks)["carries"], lane, False,
+        )
+    chunks = (
+        pick_repair_chunks(lane, K, S, R, W, A, budget)
+        if wants_repair
+        else 0
+    )
+    if chunks > 1:
+        return TierDecision(
+            "cand-chunked", chunks, 0,
+            est(lane, repair_spot_chunks=chunks),
+            bd(lane, repair_spot_chunks=chunks)["carries"], lane, False,
+        )
+    if wants_repair:
+        cpb = (
+            carry_plane_bytes()
+            if callable(carry_plane_bytes)
+            else carry_plane_bytes
+        )
+        cchunks = forced_carry_chunks or pick_carry_chunks(
+            lane, K, S, R, W, A, budget, carry_plane_bytes=cpb,
+        )
+        if cchunks >= 1:
+            kw = dict(
+                repair_spot_chunks=cchunks,
+                carry_chunks=cchunks,
+                carry_plane_bytes=cpb,
+            )
+            return TierDecision(
+                "cand-carry", cchunks, cchunks, est(lane, **kw),
+                bd(lane, **kw)["carries"], lane, False,
+            )
+    return TierDecision(
+        "2d", 0, 0, est(lane, repair_spot_chunks=0),
+        bd(lane, repair_spot_chunks=0)["carries"], lane, wants_repair,
+    )
 
 
 def packed_shapes(packed) -> tuple:

@@ -29,6 +29,12 @@ those lanes:
 
 TPU shape discipline matches solver/ffd.py: carries keep the spot axis
 minor ([C, R, S] / [C, A, S]), shapes are static, rounds are a scan.
+Since the ROADMAP-5 reshape the carried state is DELTA-form against the
+static spot rows (capacity consumed / placements added / pod-contributed
+affinity bits — solver/carry.CarryLayout sizes the dtypes, int16/int8/
+uint16 when the pack's exact host-side bounds fit), widened on read at
+the shared ``solver/ffd._widen`` site so every election and gate below
+computes on bit-identical absolute values.
 
 Affinity ejection is EXACT (round 4; was monotone-conservative before):
 the per-node affinity state starts exact after the partial pass (static
@@ -58,17 +64,37 @@ import numpy as np
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
 from k8s_spot_rescheduler_tpu.predicates.masks import fit_mask, fit_mask_t
-from k8s_spot_rescheduler_tpu.solver.ffd import _Carry, _scan_step
+from k8s_spot_rescheduler_tpu.solver.carry import CarryLayout, WIDE_LAYOUT
+from k8s_spot_rescheduler_tpu.solver.ffd import (
+    _Carry,
+    _scan_step,
+    _spot_statics,
+    _slot_stream,
+    _stream_bf_step,
+    _widen,
+    _widen_chunk,
+    _zero_carry,
+    _zero_chunk_state,
+    chunk_minor,
+    chunked_spot_statics,
+    pad_spot_axis,
+)
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 from k8s_spot_rescheduler_tpu.solver.validate import validate_assignment
 
 DEFAULT_ROUNDS = 8
 
+# kept as the chunk-splitting helper's historical name for callers
+_chunk_minor = chunk_minor
+
 
 class _RepairCarry(NamedTuple):
-    free: jax.Array  # f32 [C, R, S]
-    count: jax.Array  # i32 [C, S]
-    aff: jax.Array  # u32 [C, A, S] (exact — see module docstring)
+    """Delta-form repair state (dtypes from a CarryLayout); the absolute
+    free/count/aff views are rebuilt per round at the one widen site."""
+
+    used: jax.Array  # layout.used [C, R, S]
+    dcount: jax.Array  # layout.count [C, S]
+    daff: jax.Array  # layout.aff [C, A, S]
     assign: jax.Array  # i32 [C, K]
 
 
@@ -85,10 +111,18 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
     chain-depth-demand analyzer (bench/chain_depth.py) compiles a
     depth-1-only variant to classify which lanes genuinely NEED the
     chain; production always passes True."""
-    (spot_max_pods, spot_taints_t, spot_ok, spot_aff_static,
+    (spot_static, spot_aff_static,
      slot_req, slot_valid, slot_tol, slot_aff) = static
+    spot_max_pods = spot_static.max_pods
+    spot_taints_t = spot_static.taints_t
+    spot_ok = spot_static.ok
     C, K, R = slot_req.shape
-    S = state.free.shape[-1]
+    S = state.used.shape[-1]
+    # the one widen-on-read: every election and gate below sees the
+    # absolute values the wide layout carried
+    free, count, aff = _widen(
+        spot_static, state.used, state.dcount, state.daff
+    )
 
     unplaced = slot_valid & (state.assign < 0)  # [C, K]
     has_gap = jnp.any(unplaced, axis=-1)  # [C]
@@ -112,7 +146,7 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
     # unlocker below — a per-candidate exact recompute here would cost
     # O(K^2·A) for nothing, since rotation retries next round anyway)
     free_at_q = jnp.take_along_axis(
-        state.free, s_q[:, None, :], axis=2
+        free, s_q[:, None, :], axis=2
     )  # [C, R, K]
     req_t = jnp.swapaxes(slot_req, 1, 2)  # [C, R, K]
     res_ok = jnp.all(
@@ -140,12 +174,12 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
 
     fits_q = fit_mask_t(
         jnp,
-        free_t=state.free,
-        count=state.count,
+        free_t=free,
+        count=count,
         max_pods=spot_max_pods,
         node_taints_t=spot_taints_t,
         node_ok=spot_ok,
-        node_aff_t=state.aff,
+        node_aff_t=aff,
         req=req_q,
         tol=tol_q,
         aff=aff_q,
@@ -156,15 +190,18 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
 
     # exact affinity of q's node AFTER q leaves: static resident bits OR
     # the bits of pods still assigned there — ejection genuinely clears
-    # q's contribution (a group member vacating for its group-mate)
+    # q's contribution (a group member vacating for its group-mate).
+    # ``aff_ejd`` is the pod-contributed half alone: the WRITE value of
+    # the delta carry (the read site ORs the static bits back in).
     ks = jnp.arange(K)[None, :]
     others = placed & (state.assign == sq_star[:, None]) & (ks != q[:, None])
     contrib = jnp.where(
         others[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
     )  # [C, A, K]
-    aff_ej = jax.lax.reduce(
+    aff_ejd = jax.lax.reduce(
         contrib, np.uint32(0), jax.lax.bitwise_or, (2,)
-    ) | spot_aff_static[sq_star]  # [C, A]
+    )  # [C, A] — pods-only
+    aff_ej = aff_ejd | spot_aff_static[sq_star]  # [C, A] — exact gate value
     aff_ok_p = jnp.all((aff_p & aff_ej) == 0, axis=1)  # [C]
 
     do_direct = has_gap & any_q & can_move & aff_ok_p  # [C]
@@ -177,7 +214,7 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
         s3 = s2
         req_r = req_q
         aff_r = aff_q
-        aff_ej_r = aff_ej
+        aff_ejd_r = aff_ejd
         r = q
 
     # ---- depth-2 chain (round 4): when q cannot re-place DIRECTLY,
@@ -220,12 +257,12 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
 
         fits_r = fit_mask_t(
             jnp,
-            free_t=state.free,
-            count=state.count,
+            free_t=free,
+            count=count,
             max_pods=spot_max_pods,
             node_taints_t=spot_taints_t,
             node_ok=spot_ok,
-            node_aff_t=state.aff,
+            node_aff_t=aff,
             req=req_r,
             tol=tol_r,
             aff=aff_r,
@@ -243,9 +280,10 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
         contrib_r = jnp.where(
             others_r[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
         )
-        aff_ej_r = jax.lax.reduce(
+        aff_ejd_r = jax.lax.reduce(
             contrib_r, np.uint32(0), jax.lax.bitwise_or, (2,)
-        ) | spot_aff_static[sr_star]  # [C, A]
+        )  # [C, A] — pods-only
+        aff_ej_r = aff_ejd_r | spot_aff_static[sr_star]  # [C, A]
         aff_ok_q = jnp.all((aff_q & aff_ej_r) == 0, axis=1)  # [C]
 
         do_chain = (
@@ -268,30 +306,48 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
         + onehot_qd[:, None, :] * do_chain[:, None, None] * req_r[:, :, None]
         - onehot_s3[:, None, :] * req_r[:, :, None]
     )
-    free = jnp.where(do[:, None, None], state.free + delta, state.free)
-    count = jnp.where(
-        do[:, None], state.count + onehot_inc.astype(state.count.dtype),
-        state.count,
+    # free += delta  ⇔  used -= delta (delta-form). Widen -> compute ->
+    # narrow: the result is invariantly in the layout guard's bounds,
+    # but the intermediate ``-delta`` may be negative, which an unsigned
+    # narrow dtype must never see.
+    used = jnp.where(
+        do[:, None, None],
+        (state.used.astype(delta.dtype) - delta).astype(state.used.dtype),
+        state.used,
+    )
+    dcount = jnp.where(
+        do[:, None],
+        state.dcount + onehot_inc.astype(state.dcount.dtype),
+        state.dcount,
     )
     # s_q's column is REPLACED by the exact recompute (plus p's
-    # arrival); q's destination is replaced on a chain (aff_ej_r | q's
-    # bits) or OR'd on a direct move; s3 accumulates r's bits
+    # arrival); q's destination is replaced on a chain (aff_ejd_r | q's
+    # bits) or OR'd on a direct move; s3 accumulates r's bits. All
+    # written values are pod-contributed bits only — the widen site ORs
+    # the static resident bits back, reproducing the wide layout's
+    # absolute columns bit for bit.
+    dt = state.daff.dtype
+    zero = jnp.zeros((), dt)
     qd_col = jnp.where(
-        do_chain[:, None], aff_ej_r | aff_q, jnp.uint32(0)
-    )  # chain: exact replacement value for s_r
-    aff_after = jnp.where(
-        onehot_sq[:, None, :], (aff_ej | aff_p)[:, :, None], state.aff
+        do_chain[:, None], aff_ejd_r | aff_q, jnp.uint32(0)
+    ).astype(dt)  # chain: exact replacement value for s_r
+    daff_after = jnp.where(
+        onehot_sq[:, None, :],
+        (aff_ejd | aff_p).astype(dt)[:, :, None],
+        state.daff,
     )
-    aff_after = jnp.where(
+    daff_after = jnp.where(
         (onehot_qd & do_chain[:, None])[:, None, :],
         qd_col[:, :, None],
-        aff_after,
+        daff_after,
     ) | jnp.where(
         (onehot_qd & do_direct[:, None])[:, None, :],
-        aff_q[:, :, None],
-        jnp.uint32(0),
-    ) | jnp.where(onehot_s3[:, None, :], aff_r[:, :, None], jnp.uint32(0))
-    aff = jnp.where(do[:, None, None], aff_after, state.aff)
+        aff_q.astype(dt)[:, :, None],
+        zero,
+    ) | jnp.where(
+        onehot_s3[:, None, :], aff_r.astype(dt)[:, :, None], zero
+    )
+    daff = jnp.where(do[:, None, None], daff_after, state.daff)
     assign = jnp.where(
         do[:, None],
         jnp.where(
@@ -308,47 +364,41 @@ def _repair_round(static, chain, state: _RepairCarry, round_idx):
         ),
         state.assign,
     )
-    return _RepairCarry(free, count, aff, assign), ()
+    return _RepairCarry(used, dcount, daff, assign), ()
 
 
 def plan_repair(
-    packed: PackedCluster, rounds: int = DEFAULT_ROUNDS, chain: bool = True
+    packed: PackedCluster,
+    rounds: int = DEFAULT_ROUNDS,
+    chain: bool = True,
+    layout: CarryLayout = WIDE_LAYOUT,
 ) -> SolveResult:
     """Jittable partial-pack + bounded repair + from-scratch validation.
     ``chain=False`` compiles the depth-1-only search — used solely by
-    the chain-depth-demand analyzer (bench/chain_depth.py)."""
+    the chain-depth-demand analyzer (bench/chain_depth.py). ``layout``
+    narrows the delta carries (callers pass only what
+    ``solver/carry.carry_layout`` proves the pack fits)."""
     C, K, R = packed.slot_req.shape
     S = packed.spot_free.shape[0]
+    A = packed.spot_aff.shape[1]
 
-    free_t = jnp.asarray(packed.spot_free).T
-    aff_t = jnp.asarray(packed.spot_aff).T
-    carry = _Carry(
-        free=jnp.broadcast_to(free_t, (C, *free_t.shape)),
-        count=jnp.broadcast_to(packed.spot_count, (C, S)).astype(jnp.int32),
-        aff=jnp.broadcast_to(aff_t, (C, *aff_t.shape)),
-        feasible=jnp.asarray(packed.cand_valid),
-    )
-    scan_static = (
-        jnp.asarray(packed.spot_max_pods),
-        jnp.asarray(packed.spot_taints).T,
-        jnp.asarray(packed.spot_ok),
-    )
-    slots = (
-        jnp.moveaxis(packed.slot_req, 1, 0),
-        jnp.moveaxis(packed.slot_valid, 1, 0),
-        jnp.moveaxis(packed.slot_tol, 1, 0),
-        jnp.moveaxis(packed.slot_aff, 1, 0),
+    static = _spot_statics(packed)
+    carry = _zero_carry(
+        layout, C, R, A, S, jnp.asarray(packed.cand_valid)
     )
     carry, chosen = jax.lax.scan(
-        functools.partial(_partial_scan_step, scan_static), carry, slots
+        functools.partial(_partial_scan_step, static),
+        carry,
+        _slot_stream(packed),
     )
     assign0 = jnp.swapaxes(chosen, 0, 1).astype(jnp.int32)  # [C, K]
 
     state = _RepairCarry(
-        free=carry.free, count=carry.count, aff=carry.aff, assign=assign0
+        used=carry.used, dcount=carry.dcount, daff=carry.daff,
+        assign=assign0,
     )
     repair_static = (
-        *scan_static,
+        static,
         jnp.asarray(packed.spot_aff),  # static resident bits, [S, A]
         jnp.asarray(packed.slot_req),
         jnp.asarray(packed.slot_valid),
@@ -366,7 +416,9 @@ def plan_repair(
     return SolveResult(feasible=feasible, assignment=assignment)
 
 
-plan_repair_jit = jax.jit(plan_repair, static_argnames=("rounds", "chain"))
+plan_repair_jit = jax.jit(
+    plan_repair, static_argnames=("rounds", "chain", "layout")
+)
 
 
 # --- spot-chunked repair (elect-then-commit) -------------------------------
@@ -392,86 +444,25 @@ plan_repair_jit = jax.jit(plan_repair, static_argnames=("rounds", "chain"))
 #    vets the elected move, and only the chunks holding the (at most
 #    three) touched nodes change state.
 #
-# Per-round temporaries are therefore O(C × S/chunks), never O(C × S);
-# the carried state is the same free/count/aff set every greedy pass
-# already holds. The final from-scratch validation
-# (solver/validate.py) is unchanged, so chunked repair can still never
-# approve an invalid drain. Bit parity with ``plan_repair_oracle`` is
-# pinned by tests/test_repair_chunked.py and the dryrun harness.
+# Per-round temporaries are therefore O(C × S/chunks), never O(C × S),
+# and the carried state is the DELTA-form free/count/aff set every
+# greedy pass already holds — narrow ints under a CarryLayout, which is
+# what moves the fully-chunked ceiling past the old greedy carry bound.
+# The final from-scratch validation (solver/validate.py) is unchanged,
+# so chunked repair can still never approve an invalid drain. Bit parity
+# with ``plan_repair_oracle`` is pinned by tests/test_repair_chunked.py,
+# tests/test_carry_stream.py and the dryrun harness.
 
 _BIG_IDX = 2**30  # > any global spot index; int so jnp weak-types it
 
 
-def _chunk_minor(arr, n: int, Sc: int):
-    """[..., n*Sc] -> [n, ..., Sc]: split the minor spot axis into n
-    ordered chunk-major blocks (block j holds global spots
-    [j*Sc, (j+1)*Sc))."""
-    parts = jnp.reshape(arr, (*arr.shape[:-1], n, Sc))
-    return jnp.moveaxis(parts, -2, 0)
-
-
 def _chunked_partial_step(chunk_xs, Sc, carry, slot):
-    """Best-fit-with-gaps placement of one pod slot over spot chunks.
-    Each chunk elects its local tightest fit; a lexicographic
-    (slack, chunk-order) election picks the global winner — identical
-    to the unchunked argmin (ties resolve to the earlier probe index) —
-    and only the winning chunk's state is committed."""
-    taints_c, ok_c, maxp_c, offs = chunk_xs
-    free_c, count_c, aff_c = carry
-    req, valid, tol, aff = slot  # [C,R], [C], [C,W], [C,A]
-    C = req.shape[0]
-
-    def elect(best, xs):
-        best_slack, best_g = best
-        free_j, count_j, aff_j, taints_j, ok_j, maxp_j, off = xs
-        fits = fit_mask_t(
-            jnp,
-            free_t=free_j,
-            count=count_j,
-            max_pods=maxp_j,
-            node_taints_t=taints_j,
-            node_ok=ok_j,
-            node_aff_t=aff_j,
-            req=req,
-            tol=tol,
-            aff=aff,
-        )  # [C, Sc]
-        slack = jnp.where(fits, free_j[:, 0, :] - req[:, None, 0], jnp.inf)
-        m = jnp.min(slack, axis=-1)
-        i = jnp.argmin(slack, axis=-1).astype(jnp.int32)
-        better = m < best_slack  # strict: ties keep the earlier chunk
-        return (
-            jnp.where(better, m, best_slack),
-            jnp.where(better, off + i, best_g),
-        ), None
-
-    (best_slack, best_g), _ = jax.lax.scan(
-        elect,
-        (
-            jnp.full((C,), jnp.inf, free_c.dtype),
-            jnp.zeros((C,), jnp.int32),
-        ),
-        (free_c, count_c, aff_c, taints_c, ok_c, maxp_c, offs),
-    )
-    place = valid & jnp.isfinite(best_slack)
-
-    def commit(xs):
-        free_j, count_j, aff_j, off = xs
-        loc = best_g - off
-        onehot = (
-            jnp.arange(Sc)[None, :] == loc[:, None]
-        ) & place[:, None]  # [C, Sc]
-        return (
-            free_j - onehot[:, None, :] * req[:, :, None],
-            count_j + onehot.astype(count_j.dtype),
-            aff_j | jnp.where(onehot[:, None, :], aff[:, :, None], 0),
-        )
-
-    free_c, count_c, aff_c = jax.lax.map(
-        commit, (free_c, count_c, aff_c, offs)
-    )
-    chosen = jnp.where(place, best_g, jnp.int32(-1))
-    return (free_c, count_c, aff_c), chosen
+    """Best-fit-with-gaps placement of one pod slot over spot chunks:
+    the shared delta-form elect-then-commit step
+    (solver/ffd._stream_bf_step); feasibility tracking is repair's job,
+    so the any-fit flag is dropped."""
+    state, (chosen, _) = _stream_bf_step(chunk_xs, Sc, carry, slot)
+    return state, chosen
 
 
 def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
@@ -479,12 +470,12 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
     ``_repair_round``): chunk-local sweeps build the unlocker set and
     re-placement targets, elections pick the move in global index
     order, the exact affinity gate vets it, and only the winning
-    chunks' state commits."""
+    chunks' state commits. State is the stacked delta carry."""
     spot_aff_static, slot_req, slot_valid, slot_tol, slot_aff = small
-    taints_c, ok_c, maxp_c, offs = chunk_xs
-    free_c, count_c, aff_c, assign = state
+    free0_c, count0_c, aff0_c, taints_c, ok_c, maxp_c, offs = chunk_xs
+    used_c, dcount_c, daff_c, assign = state
     C, K, R = slot_req.shape
-    Sp = free_c.shape[0] * Sc
+    Sp = used_c.shape[0] * Sc
     ks = jnp.arange(K)[None, :]
     gsc = jnp.arange(Sc)[None, :]
 
@@ -504,7 +495,8 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
     # pod lives in exactly one chunk, so the union over chunks is the
     # unchunked unlock mask exactly.
     def sweep_unlock(unlock, xs):
-        free_j, taints_j, ok_j, off = xs
+        used_j, free0_j, taints_j, ok_j, off = xs
+        free_j = free0_j - used_j.astype(free0_j.dtype)
         word_ok = jnp.all(
             (taints_j & ~tol_p[:, :, None]) == 0, axis=1
         )  # [C, Sc]
@@ -521,7 +513,7 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
     unlock, _ = jax.lax.scan(
         sweep_unlock,
         jnp.zeros((C, K), bool),
-        (free_c, taints_c, ok_c, offs),
+        (used_c, free0_c, taints_c, ok_c, offs),
     )
 
     # q election: deterministic rotation in global slot order, unchanged
@@ -544,7 +536,11 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
     # first fit — plus (chain) the chunk-local r candidates.
     def sweep_q(carry_b, xs):
         s2g, eligible_r = carry_b
-        free_j, count_j, aff_j, taints_j, ok_j, maxp_j, off = xs
+        (used_j, dcount_j, daff_j, free0_j, count0_j, aff0_j,
+         taints_j, ok_j, maxp_j, off) = xs
+        free_j, count_j, aff_j = _widen_chunk(
+            free0_j, count0_j, aff0_j, used_j, dcount_j, daff_j
+        )
         fits_q = fit_mask_t(
             jnp,
             free_t=free_j,
@@ -589,7 +585,7 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
             jnp.full((C,), _BIG_IDX, jnp.int32),
             jnp.zeros((C, K), bool),
         ),
-        (free_c, count_c, aff_c, taints_c, ok_c, maxp_c, offs),
+        (used_c, dcount_c, daff_c, *chunk_xs),
     )
     can_move = s2g < _BIG_IDX
 
@@ -612,7 +608,11 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
 
         # ---- sweep C (elect): r's re-placement target
         def sweep_r(s3g, xs):
-            free_j, count_j, aff_j, taints_j, ok_j, maxp_j, off = xs
+            (used_j, dcount_j, daff_j, free0_j, count0_j, aff0_j,
+             taints_j, ok_j, maxp_j, off) = xs
+            free_j, count_j, aff_j = _widen_chunk(
+                free0_j, count0_j, aff0_j, used_j, dcount_j, daff_j
+            )
             fits_r = fit_mask_t(
                 jnp,
                 free_t=free_j,
@@ -636,18 +636,21 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
         s3g, _ = jax.lax.scan(
             sweep_r,
             jnp.full((C,), _BIG_IDX, jnp.int32),
-            (free_c, count_c, aff_c, taints_c, ok_c, maxp_c, offs),
+            (used_c, dcount_c, daff_c, *chunk_xs),
         )
         r_can_move = s3g < _BIG_IDX
 
-    # ---- exact affinity gates: O(K·A), no spot-wide work
+    # ---- exact affinity gates: O(K·A), no spot-wide work. aff_ejd /
+    # aff_ejd_r are the pod-contributed halves (the delta write values);
+    # the gates OR the static bits back in, exactly as _repair_round.
     others = placed & (assign == sq_star[:, None]) & (ks != q[:, None])
     contrib = jnp.where(
         others[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
     )
-    aff_ej = jax.lax.reduce(
+    aff_ejd = jax.lax.reduce(
         contrib, np.uint32(0), jax.lax.bitwise_or, (2,)
-    ) | spot_aff_static[sq_star]
+    )
+    aff_ej = aff_ejd | spot_aff_static[sq_star]
     aff_ok_p = jnp.all((aff_p & aff_ej) == 0, axis=1)
     do_direct = has_gap & any_q & can_move & aff_ok_p
 
@@ -657,16 +660,17 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
         s3g = s2g
         req_r = req_q
         aff_r = aff_q
-        aff_ej_r = aff_ej
+        aff_ejd_r = aff_ejd
         r = q
     else:
         others_r = placed & (assign == sr_star[:, None]) & (ks != r[:, None])
         contrib_r = jnp.where(
             others_r[:, None, :], jnp.swapaxes(slot_aff, 1, 2), jnp.uint32(0)
         )
-        aff_ej_r = jax.lax.reduce(
+        aff_ejd_r = jax.lax.reduce(
             contrib_r, np.uint32(0), jax.lax.bitwise_or, (2,)
-        ) | spot_aff_static[sr_star]
+        )
+        aff_ej_r = aff_ejd_r | spot_aff_static[sr_star]
         aff_ok_q = jnp.all((aff_q & aff_ej_r) == 0, axis=1)
         do_chain = (
             has_gap & any_q & ~can_move & aff_ok_p
@@ -676,11 +680,15 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
 
     q_dest = jnp.where(do_chain, sr_star, s2g)
     inc_node = jnp.where(do_chain, s3g, s2g)
-    qd_col = jnp.where(do_chain[:, None], aff_ej_r | aff_q, jnp.uint32(0))
+    dt = daff_c.dtype
+    zero = jnp.zeros((), dt)
+    qd_col = jnp.where(
+        do_chain[:, None], aff_ejd_r | aff_q, jnp.uint32(0)
+    ).astype(dt)
 
     # ---- COMMIT: only chunks holding a touched node change state
     def commit(xs):
-        free_j, count_j, aff_j, off = xs
+        used_j, dcount_j, daff_j, off = xs
         gid = off + gsc
         onehot_sq = gid == sq_star[:, None]  # [C, Sc]
         onehot_qd = gid == q_dest[:, None]
@@ -694,31 +702,37 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
             * req_r[:, :, None]
             - onehot_s3[:, None, :] * req_r[:, :, None]
         )
-        free_j = jnp.where(do[:, None, None], free_j + delta, free_j)
-        count_j = jnp.where(
+        used_j = jnp.where(
+            do[:, None, None],
+            (used_j.astype(delta.dtype) - delta).astype(used_j.dtype),
+            used_j,
+        )
+        dcount_j = jnp.where(
             do[:, None],
-            count_j + onehot_inc.astype(count_j.dtype),
-            count_j,
+            dcount_j + onehot_inc.astype(dcount_j.dtype),
+            dcount_j,
         )
-        aff_after = jnp.where(
-            onehot_sq[:, None, :], (aff_ej | aff_p)[:, :, None], aff_j
+        daff_after = jnp.where(
+            onehot_sq[:, None, :],
+            (aff_ejd | aff_p).astype(dt)[:, :, None],
+            daff_j,
         )
-        aff_after = jnp.where(
+        daff_after = jnp.where(
             (onehot_qd & do_chain[:, None])[:, None, :],
             qd_col[:, :, None],
-            aff_after,
+            daff_after,
         ) | jnp.where(
             (onehot_qd & do_direct[:, None])[:, None, :],
-            aff_q[:, :, None],
-            jnp.uint32(0),
+            aff_q.astype(dt)[:, :, None],
+            zero,
         ) | jnp.where(
-            onehot_s3[:, None, :], aff_r[:, :, None], jnp.uint32(0)
+            onehot_s3[:, None, :], aff_r.astype(dt)[:, :, None], zero
         )
-        aff_j = jnp.where(do[:, None, None], aff_after, aff_j)
-        return free_j, count_j, aff_j
+        daff_j = jnp.where(do[:, None, None], daff_after, daff_j)
+        return used_j, dcount_j, daff_j
 
-    free_c, count_c, aff_c = jax.lax.map(
-        commit, (free_c, count_c, aff_c, offs)
+    used_c, dcount_c, daff_c = jax.lax.map(
+        commit, (used_c, dcount_c, daff_c, offs)
     )
     assign = jnp.where(
         do[:, None],
@@ -737,7 +751,7 @@ def _chunked_repair_round(small, chunk_xs, chain, Sc, state, round_idx):
         ),
         assign,
     )
-    return (free_c, count_c, aff_c, assign), ()
+    return (used_c, dcount_c, daff_c, assign), ()
 
 
 def plan_repair_chunked(
@@ -745,72 +759,44 @@ def plan_repair_chunked(
     rounds: int = DEFAULT_ROUNDS,
     chain: bool = True,
     spot_chunks: int = 2,
+    layout: CarryLayout = WIDE_LAYOUT,
 ) -> SolveResult:
     """``plan_repair`` restructured over ``spot_chunks`` ordered spot
     chunks (elect-then-commit; see the module section above) —
-    bit-identical results, per-round temporaries O(S / spot_chunks).
+    bit-identical results, per-round temporaries O(S / spot_chunks) and
+    the carried state narrow under ``layout`` (solver/carry.py).
     The spot axis is padded to a chunk multiple with inert nodes
     (``spot_ok``=False, at the end of the probe order), so placements
     and assignment indices are unchanged; validation runs against the
     ORIGINAL packed problem."""
     if spot_chunks <= 1:
-        return plan_repair(packed, rounds=rounds, chain=chain)
+        return plan_repair(packed, rounds=rounds, chain=chain, layout=layout)
     C, K, R = packed.slot_req.shape
     S = packed.spot_free.shape[0]
+    A = packed.spot_aff.shape[1]
     n = int(spot_chunks)
     Sc = -(-S // n)
     pad = n * Sc - S
 
-    def pad_s(arr):
-        arr = jnp.asarray(arr)
-        if pad == 0:
-            return arr
-        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
-        return jnp.pad(arr, widths)
+    chunk_xs = chunked_spot_statics(packed, n, Sc)
+    state0 = _zero_chunk_state(layout, n, C, R, A, Sc)
 
-    spot_free = pad_s(packed.spot_free)  # [Sp, R]
-    spot_aff = pad_s(packed.spot_aff)  # [Sp, A]
-    free_t = spot_free.T
-    aff_t = spot_aff.T
-    free_c = _chunk_minor(
-        jnp.broadcast_to(free_t, (C, *free_t.shape)), n, Sc
-    )  # [n, C, R, Sc]
-    count_c = _chunk_minor(
-        jnp.broadcast_to(pad_s(packed.spot_count), (C, n * Sc)).astype(
-            jnp.int32
-        ),
-        n,
-        Sc,
-    )
-    aff_c = _chunk_minor(jnp.broadcast_to(aff_t, (C, *aff_t.shape)), n, Sc)
-    chunk_xs = (
-        _chunk_minor(pad_s(packed.spot_taints).T, n, Sc),  # [n, W, Sc]
-        _chunk_minor(pad_s(packed.spot_ok), n, Sc),  # [n, Sc]
-        _chunk_minor(pad_s(packed.spot_max_pods), n, Sc),  # [n, Sc]
-        jnp.arange(n, dtype=jnp.int32) * Sc,  # chunk offsets
-    )
-
-    slots = (
-        jnp.moveaxis(jnp.asarray(packed.slot_req), 1, 0),
-        jnp.moveaxis(jnp.asarray(packed.slot_valid), 1, 0),
-        jnp.moveaxis(jnp.asarray(packed.slot_tol), 1, 0),
-        jnp.moveaxis(jnp.asarray(packed.slot_aff), 1, 0),
-    )
-    (free_c, count_c, aff_c), chosen = jax.lax.scan(
+    slots = _slot_stream(packed)
+    (used_c, dcount_c, daff_c), chosen = jax.lax.scan(
         functools.partial(_chunked_partial_step, chunk_xs, Sc),
-        (free_c, count_c, aff_c),
+        state0,
         slots,
     )
     assign0 = jnp.swapaxes(chosen, 0, 1).astype(jnp.int32)  # [C, K]
 
     small = (
-        spot_aff,  # static resident bits, [Sp, A]
+        pad_spot_axis(packed.spot_aff, pad),  # static resident bits, [Sp, A]
         jnp.asarray(packed.slot_req),
         jnp.asarray(packed.slot_valid),
         jnp.asarray(packed.slot_tol),
         jnp.asarray(packed.slot_aff),
     )
-    state = (free_c, count_c, aff_c, assign0)
+    state = (used_c, dcount_c, daff_c, assign0)
     state, _ = jax.lax.scan(
         functools.partial(_chunked_repair_round, small, chunk_xs, chain, Sc),
         state,
@@ -824,7 +810,8 @@ def plan_repair_chunked(
 
 
 plan_repair_chunked_jit = jax.jit(
-    plan_repair_chunked, static_argnames=("rounds", "chain", "spot_chunks")
+    plan_repair_chunked,
+    static_argnames=("rounds", "chain", "spot_chunks", "layout"),
 )
 
 
@@ -1003,11 +990,13 @@ def plan_repair_oracle(
 # Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
 # tools/analysis/jaxpr): both repair variants traced at audit shapes —
 # the chunked carry restructure is exactly where ROADMAP-5's narrow-int
-# packing will land, so its dtype/width properties are gated here.
+# packing landed, so its dtype/width properties are gated here (the
+# chunked probe runs the NARROW layout the 20x tier dispatches).
 from k8s_spot_rescheduler_tpu.hot_programs import (  # noqa: E402
     HotProgram,
     packed_struct,
 )
+from k8s_spot_rescheduler_tpu.solver.carry import NARROW_LAYOUT  # noqa: E402
 
 HOT_PROGRAMS = {
     "repair.rounds": HotProgram(
@@ -1019,7 +1008,10 @@ HOT_PROGRAMS = {
     ),
     "repair.chunked": HotProgram(
         build=lambda s: (
-            functools.partial(plan_repair_chunked, rounds=4, spot_chunks=4),
+            functools.partial(
+                plan_repair_chunked, rounds=4, spot_chunks=4,
+                layout=NARROW_LAYOUT,
+            ),
             (packed_struct(s),),
         ),
         covers=("solver.repair:plan_repair_chunked",),

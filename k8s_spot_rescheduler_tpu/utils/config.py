@@ -105,6 +105,13 @@ class ReschedulerConfig:
     repair_rounds: int = 8
     auto_shard: bool = True
     solver_hbm_budget: int = 0
+    # Carry-streamed tier chunk count (solver/fallback.
+    # with_repair_streamed): how many ordered spot chunks the narrow
+    # delta-carry union streams through when the auto-shard ladder
+    # reaches the carry tier (past even the spot-chunked wide repair
+    # ceiling — repair stays live, results bit-identical). 0 = auto via
+    # solver/memory.pick_carry_chunks (sized to the device budget).
+    carry_chunks: int = 0
     # Observe via the incrementally-maintained columnar mirror
     # (models/columnar.py) when the cluster client provides one — the
     # vectorized replacement for the per-tick object-model rebuild. Off →
@@ -134,12 +141,16 @@ class ReschedulerConfig:
     # eviction — churn invalidates the schedule tail (counted +
     # flight-evented) and forces a re-plan, never a wrong eviction.
     # Planner fetches for a consolidation sweep drop from O(drains) to
-    # O(drains / horizon). Off by default: the per-tick single-plan
-    # path stays the shipped behavior; the consolidation benches and
-    # sched-smoke run with it on.
-    plan_schedule_enabled: bool = False
+    # O(drains / horizon). ON by default since the PR-11 follow-up: the
+    # quality-scale bench asserts the fetch bound with schedules live
+    # and every step is still re-proven from scratch before any
+    # eviction; ``--schedule-horizon 0`` is the documented opt-out
+    # (per-tick single plans, the pre-schedule behavior).
+    plan_schedule_enabled: bool = True
     # Max drain steps per cut schedule (the device while-loop bound and
-    # the jit compile key; one compile per configured value).
+    # the jit compile key; one compile per configured value). 0 turns
+    # schedules OFF (the documented opt-out) even with
+    # plan_schedule_enabled.
     schedule_horizon: int = 32
     # Persistent XLA compilation cache directory (``--jax-cache-dir``):
     # the solver programs cost seconds of cold compile per process
@@ -288,8 +299,12 @@ class ReschedulerConfig:
             raise ValueError("max_drains_per_tick must be >= 1")
         if self.staged_chunk_lanes < 0:
             raise ValueError("staged_chunk_lanes must be >= 0 (0 = unstaged)")
-        if self.schedule_horizon < 1:
-            raise ValueError("schedule_horizon must be >= 1")
+        if self.carry_chunks < 0:
+            raise ValueError("carry_chunks must be >= 0 (0 = auto)")
+        if self.schedule_horizon < 0:
+            raise ValueError(
+                "schedule_horizon must be >= 0 (0 = schedules off)"
+            )
         if not self.resources:
             raise ValueError("resources must be non-empty")
         if self.kube_retry_max < 0:

@@ -877,6 +877,38 @@ def test_jaxpr_seeded_carry_mismatch(tmp_path):
     assert "carry" in r.stdout
 
 
+def test_jaxpr_seeded_narrow_carry_promotion_mismatch(tmp_path):
+    """The LANDED narrow-carry layout's one-keystroke regression: an
+    int16 delta carry whose update forgets the ``.astype`` narrow-back
+    silently promotes (int16 + weak int32 delta -> int32) and the scan
+    carry types no longer match. The dtype pass must CLASSIFY it as a
+    carry mismatch finding — never surface the raw trace TypeError."""
+    _, r = seed_jaxpr_manifest(tmp_path, _MANIFEST_PRELUDE + """\
+
+    def _solve(packed):
+        used0 = jnp.zeros(packed.spot_free.shape, jnp.int16)
+
+        def step(used, _):
+            # BUG: delta computed in i32, narrow-back astype forgotten
+            delta = jnp.ones(used.shape, jnp.int32)
+            return used + delta, None
+
+        out, _ = jax.lax.scan(step, used0, None, length=3)
+        return out
+
+
+    HOT_PROGRAMS = {
+        "fix.narrow_carry": HotProgram(
+            build=lambda s: (_solve, (packed_struct(s),)),
+        ),
+    }
+    """)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "dtype-promotion" in r.stdout
+    assert "carry" in r.stdout
+    assert "Traceback" not in r.stdout  # classified, not a raw TypeError
+
+
 # --- jaxpr tier: index-width ----------------------------------------------
 
 

@@ -1,0 +1,400 @@
+"""Narrow-int delta carries + spot-streamed greedy kernels (ROADMAP 5).
+
+The carry-streamed tier's whole claim is BIT-identity: the delta-form
+narrow carry (solver/carry.CarryLayout) widened on read must reproduce
+the wide kernels' every placement, and the spot-streamed first-fit's
+leftover flow must reproduce global probe order across any chunk
+boundary. These tests pin that claim against the numpy oracles and the
+existing fused planner at multiple chunk counts, drive the dtype
+saturation edges the layout guard promises (residual exactly at the
+int8/int16/uint16 edge — and one past it, where the guard must widen),
+and prove the dispatch ladder lands on the carry tier with repair LIVE.
+"""
+
+import numpy as np
+import pytest
+
+from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.solver.carry import (
+    CarryLayout,
+    NARROW_LAYOUT,
+    WIDE_LAYOUT,
+    carry_layout,
+    is_narrow,
+    plane_bytes,
+)
+from k8s_spot_rescheduler_tpu.solver.fallback import (
+    with_repair,
+    with_repair_streamed,
+)
+from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd, plan_ffd_streamed
+from k8s_spot_rescheduler_tpu.solver.numpy_oracle import plan_oracle
+from k8s_spot_rescheduler_tpu.solver.repair import (
+    plan_repair_chunked,
+    plan_repair_oracle,
+)
+from tests.test_solver import _random_packed
+
+CHUNK_COUNTS = (2, 3, 5)  # >= 3 distinct counts, incl. a non-divisor
+
+
+def _assert_same(got, want, note=""):
+    np.testing.assert_array_equal(
+        np.asarray(got.feasible), np.asarray(want.feasible), err_msg=note
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.assignment), np.asarray(want.assignment), err_msg=note
+    )
+
+
+# --- randomized bit parity --------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(8))
+def test_streamed_first_fit_parity(seed):
+    packed = _random_packed(np.random.default_rng(seed))
+    want = plan_oracle(packed)
+    layout = carry_layout(packed)
+    for n in CHUNK_COUNTS:
+        for lay in (WIDE_LAYOUT, layout):
+            got = plan_ffd_streamed(packed, carry_chunks=n, layout=lay)
+            _assert_same(got, want, f"seed={seed} chunks={n} layout={lay}")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_streamed_best_fit_parity(seed):
+    packed = _random_packed(np.random.default_rng(100 + seed))
+    want = plan_oracle(packed, best_fit=True)
+    layout = carry_layout(packed)
+    for n in CHUNK_COUNTS:
+        got = plan_ffd_streamed(
+            packed, carry_chunks=n, layout=layout, best_fit=True
+        )
+        _assert_same(got, want, f"seed={seed} chunks={n}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_streamed_union_parity_vs_fused_and_oracle(seed):
+    """The whole carry-streamed union (ff ∪ bf ∪ chunked repair on the
+    narrow delta carry) against BOTH the existing fused planner's union
+    and the host oracle stack — the acceptance bit-identity."""
+    packed = _random_packed(np.random.default_rng(200 + seed))
+    layout = carry_layout(packed)
+    fused = with_repair(plan_ffd, 8)(packed)
+    ff = plan_oracle(packed)
+    bf = plan_oracle(packed, best_fit=True)
+    rp = plan_repair_oracle(packed, rounds=8)
+    feasible = ff.feasible | bf.feasible | rp.feasible
+    assignment = np.where(
+        ff.feasible[:, None],
+        ff.assignment,
+        np.where(bf.feasible[:, None], bf.assignment, rp.assignment),
+    )
+    np.testing.assert_array_equal(np.asarray(fused.feasible), feasible)
+    for n in CHUNK_COUNTS:
+        got = with_repair_streamed(8, n, layout)(packed)
+        _assert_same(got, fused, f"seed={seed} chunks={n} (vs fused)")
+        np.testing.assert_array_equal(np.asarray(got.feasible), feasible)
+        np.testing.assert_array_equal(np.asarray(got.assignment), assignment)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_streamed_chunked_repair_parity(seed):
+    packed = _random_packed(np.random.default_rng(300 + seed))
+    layout = carry_layout(packed)
+    want = plan_repair_oracle(packed, rounds=6)
+    for n in CHUNK_COUNTS:
+        got = plan_repair_chunked(
+            packed, rounds=6, spot_chunks=n, layout=layout
+        )
+        _assert_same(got, want, f"seed={seed} chunks={n}")
+
+
+# --- chunk-boundary leftover flow -------------------------------------------
+
+def _leftover_case() -> PackedCluster:
+    """Pod 0 fits nothing in chunk 0 and places in chunk 1 while pod 1
+    places in chunk 0 AFTER pod 0 already failed it — the leftover
+    interleave a wrong streaming order would scramble. 4 spot nodes so
+    every CHUNK_COUNTS split puts a boundary inside the probe order:
+    node0 tiny, node1 tiny, node2 big, node3 big."""
+    C, K, S, R, W, A = 1, 3, 4, 1, 1, 1
+    return PackedCluster(
+        slot_req=np.array([[[500.0], [100.0], [400.0]]], np.float32),
+        slot_valid=np.ones((C, K), bool),
+        slot_tol=np.zeros((C, K, W), np.uint32),
+        slot_aff=np.zeros((C, K, A), np.uint32),
+        cand_valid=np.ones((C,), bool),
+        spot_free=np.array(
+            [[150.0], [120.0], [900.0], [450.0]], np.float32
+        ),
+        spot_count=np.zeros((S,), np.int32),
+        spot_max_pods=np.full((S,), 8, np.int32),
+        spot_taints=np.zeros((S, W), np.uint32),
+        spot_ok=np.ones((S,), bool),
+        spot_aff=np.zeros((S, A), np.uint32),
+    )
+
+
+def test_leftover_flows_across_chunk_boundary():
+    """Global first-fit: pod0(500)→node2, pod1(100)→node0, pod2(400)→
+    node2 again (depleted to exactly 400 by pod0 — the saturating fit).
+    Every chunking must agree — pod0 and pod2 are chunk-0 leftovers
+    that must see chunk 1 in POD order (pod2 after pod0's depletion),
+    while pod1 back-fills chunk 0 in between."""
+    packed = _leftover_case()
+    want = plan_oracle(packed)
+    assert bool(want.feasible[0])
+    assert list(want.assignment[0]) == [2, 0, 2]
+    for n in (2, 3, 4):
+        for lay in (WIDE_LAYOUT, NARROW_LAYOUT):
+            got = plan_ffd_streamed(packed, carry_chunks=n, layout=lay)
+            _assert_same(got, want, f"chunks={n} layout={lay}")
+
+
+# --- dtype saturation edges --------------------------------------------------
+
+def _edge_pack(req_each: float, k: int, free: float) -> PackedCluster:
+    """One lane, ``k`` identical pods of ``req_each`` against one open
+    node of ``free`` capacity (plus a decoy the taints forbid)."""
+    C, K, S, R, W, A = 1, k, 2, 1, 1, 1
+    return PackedCluster(
+        slot_req=np.full((C, K, R), req_each, np.float32),
+        slot_valid=np.ones((C, K), bool),
+        slot_tol=np.zeros((C, K, W), np.uint32),
+        slot_aff=np.zeros((C, K, A), np.uint32),
+        cand_valid=np.ones((C,), bool),
+        spot_free=np.array([[free], [free]], np.float32),
+        spot_count=np.zeros((S,), np.int32),
+        spot_max_pods=np.full((S,), k + 1, np.int32),
+        spot_taints=np.array([[0], [1]], np.uint32),  # decoy: untolerated
+        spot_ok=np.ones((S,), bool),
+        spot_aff=np.zeros((S, A), np.uint32),
+    )
+
+
+def test_layout_guard_used_edges():
+    """Consumed-sum bounds exactly AT each dtype edge narrow; one past
+    widens. The edge packs then solve bit-identically on the narrow
+    layout — the saturating residual is representable by construction."""
+    at_i16 = _edge_pack(32767.0 / 7, 7, 40000.0)
+    at_i16 = at_i16._replace(
+        slot_req=np.full((1, 7, 1), 4681.0, np.float32)  # 7*4681 = 32767
+    )
+    assert carry_layout(at_i16).used == "int16"
+    past_i16 = at_i16._replace(
+        slot_req=np.full((1, 7, 1), 4682.0, np.float32)  # 32774 > int16
+    )
+    assert carry_layout(past_i16).used == "uint16"
+    at_u16 = _edge_pack(13107.0, 5, 70000.0)  # 5*13107 = 65535 == edge
+    assert carry_layout(at_u16).used == "uint16"
+    past_u16 = _edge_pack(13108.0, 5, 70000.0)  # 65540 > uint16
+    assert carry_layout(past_u16).used == "float32"
+    for packed in (at_i16, past_i16, at_u16, past_u16):
+        lay = carry_layout(packed)
+        want = plan_oracle(packed)
+        assert bool(want.feasible[0])  # the full residual is consumed
+        for n in (1, 2):
+            got = plan_ffd_streamed(packed, carry_chunks=n, layout=lay)
+            _assert_same(got, want, f"layout={lay} chunks={n}")
+        got = with_repair_streamed(4, 2, lay)(packed)
+        _assert_same(got, want, f"union layout={lay}")
+
+
+def test_layout_guard_count_and_aff_edges():
+    small = _random_packed(np.random.default_rng(0))
+    # count: K <= 127 -> int8; past -> int16
+    k127 = small._replace(
+        slot_req=np.zeros((1, 127, 1), np.float32),
+        slot_valid=np.ones((1, 127), bool),
+        slot_tol=np.zeros((1, 127, 1), np.uint32),
+        slot_aff=np.zeros((1, 127, 1), np.uint32),
+        cand_valid=np.ones((1,), bool),
+    )
+    assert carry_layout(k127).count == "int8"
+    k128 = k127._replace(
+        slot_req=np.zeros((1, 128, 1), np.float32),
+        slot_valid=np.ones((1, 128), bool),
+        slot_tol=np.zeros((1, 128, 1), np.uint32),
+        slot_aff=np.zeros((1, 128, 1), np.uint32),
+    )
+    assert carry_layout(k128).count == "int16"
+    # aff: highest interned dynamic bit decides the word width
+    def with_bit(bit):
+        aff = np.zeros((1, 2, 1), np.uint32)
+        aff[0, 0, 0] = np.uint32(1) << bit
+        return k127._replace(
+            slot_req=np.zeros((1, 2, 1), np.float32),
+            slot_valid=np.ones((1, 2), bool),
+            slot_tol=np.zeros((1, 2, 1), np.uint32),
+            slot_aff=aff,
+        )
+    assert carry_layout(with_bit(7)).aff == "uint8"
+    assert carry_layout(with_bit(15)).aff == "uint16"  # exactly the edge
+    assert carry_layout(with_bit(16)).aff == "uint32"  # one past widens
+
+
+def test_affinity_edge_bit_parity():
+    """A pod whose interned affinity bit sits exactly at the uint16
+    edge (bit 15) must conflict identically through the narrow carry —
+    the second group member is rejected on the node the first took."""
+    C, K, S, R, W, A = 1, 2, 2, 1, 1, 1
+    bit15 = np.uint32(1) << 15
+    packed = PackedCluster(
+        slot_req=np.full((C, K, R), 10.0, np.float32),
+        slot_valid=np.ones((C, K), bool),
+        slot_tol=np.zeros((C, K, W), np.uint32),
+        slot_aff=np.full((C, K, A), bit15, np.uint32),  # anti-affine pair
+        cand_valid=np.ones((C,), bool),
+        spot_free=np.full((S, R), 100.0, np.float32),
+        spot_count=np.zeros((S,), np.int32),
+        spot_max_pods=np.full((S,), 8, np.int32),
+        spot_taints=np.zeros((S, W), np.uint32),
+        spot_ok=np.ones((S,), bool),
+        spot_aff=np.zeros((S, A), np.uint32),
+    )
+    lay = carry_layout(packed)
+    assert lay.aff == "uint16"
+    want = plan_oracle(packed)
+    assert list(want.assignment[0]) == [0, 1]  # split across the nodes
+    for n in (1, 2):
+        got = plan_ffd_streamed(packed, carry_chunks=n, layout=lay)
+        _assert_same(got, want, f"chunks={n}")
+
+
+# --- layout plumbing ---------------------------------------------------------
+
+def test_plane_bytes_and_narrow_flag():
+    assert plane_bytes(WIDE_LAYOUT, 4, 2) == 4 * (4 + 2 + 1)  # 28: history
+    assert plane_bytes(NARROW_LAYOUT, 4, 2) == 2 * 4 + 1 + 2 * 2
+    assert not is_narrow(WIDE_LAYOUT)
+    assert is_narrow(NARROW_LAYOUT)
+    assert is_narrow(CarryLayout(used="float32", count="int8", aff="uint8"))
+
+
+# --- memory sizing + dispatch ladder -----------------------------------------
+
+def test_pick_carry_chunks_ladder():
+    from k8s_spot_rescheduler_tpu.solver import memory
+
+    npb = plane_bytes(NARROW_LAYOUT, 4, 2)
+    shapes = (6400, 32, 51200, 4, 2, 2)
+    fits_plain = memory.estimate_union_hbm_bytes(
+        *shapes, repair_spot_chunks=1, carry_chunks=1, carry_plane_bytes=npb
+    )
+    # generous budget: no streaming needed
+    assert memory.pick_carry_chunks(
+        *shapes, fits_plain + 1, carry_plane_bytes=npb
+    ) == 1
+    # the v5e default: streaming must engage with a power-of-two count
+    budget = int(memory.DEFAULT_HBM_BYTES * memory.BUDGET_FRACTION)
+    n = memory.pick_carry_chunks(*shapes, budget, carry_plane_bytes=npb)
+    assert n > 1 and (n & (n - 1)) == 0
+    est = memory.estimate_union_hbm_bytes(
+        *shapes, repair_spot_chunks=n, carry_chunks=n, carry_plane_bytes=npb
+    )
+    assert est <= budget
+    # below even the stacked narrow carries: the 2-D regime
+    carries = memory.estimate_union_hbm_breakdown(
+        *shapes, carry_chunks=1, carry_plane_bytes=npb
+    )["carries"]
+    assert memory.pick_carry_chunks(
+        *shapes, carries - 1, carry_plane_bytes=npb
+    ) == 0
+
+
+def test_pick_tier_20x_keeps_repair_live():
+    """THE acceptance pin: at the 20x shapes (1M pods / 100k nodes,
+    hot_programs.MAX_SHAPES) over an 8-device v5e fleet, the ladder
+    must land on the carry-streamed tier with repair live — for the
+    fully narrow layout AND the conservative guarded layout of the
+    4-resource synthetic config (f32 used, int8 count, uint8 aff) —
+    while 16x still fits the WIDE chunked tier (the documented old
+    ceiling stays history, not current behavior)."""
+    from k8s_spot_rescheduler_tpu.hot_programs import MAX_SHAPES
+    from k8s_spot_rescheduler_tpu.solver import memory
+
+    budget = int(memory.DEFAULT_HBM_BYTES * memory.BUDGET_FRACTION)
+    s = MAX_SHAPES
+    guarded = CarryLayout(used="float32", count="int8", aff="uint8")
+    for layout in (NARROW_LAYOUT, guarded):
+        tier = memory.pick_tier(
+            s.C, s.K, s.S, s.R, s.W, s.A,
+            n_devices=8, budget_bytes=budget, wants_repair=True,
+            carry_plane_bytes=plane_bytes(layout, s.R, s.A),
+        )
+        assert tier.kind == "cand-carry", (layout, tier)
+        assert not tier.repair_unavailable
+        assert tier.repair_chunks > 0 and tier.carry_chunks > 1
+        assert tier.est_bytes <= budget
+    # 16x: the wide chunked tier still carries it (the old ceiling)
+    n16 = 2560 * 16
+    tier16 = memory.pick_tier(
+        n16, 32, n16, 4, 2, 2,
+        n_devices=8, budget_bytes=budget, wants_repair=True,
+        carry_plane_bytes=plane_bytes(NARROW_LAYOUT, 4, 2),
+    )
+    assert tier16.kind == "cand-chunked" and tier16.repair_chunks > 1
+
+
+def test_planner_dispatches_carry_tier_with_repair_live():
+    """End to end on the 8-virtual-device platform: a budget below the
+    wide tiers but above the carry tier must land on
+    ``jax+cand-carry`` with the SAME drain the host oracle stack
+    proves, repair_unavailable 0, and the report/gauges/healthz naming
+    the tier."""
+    from k8s_spot_rescheduler_tpu.loop import health
+    from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+    from k8s_spot_rescheduler_tpu.models.tensors import pack_cluster
+    from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
+    from k8s_spot_rescheduler_tpu.solver import memory
+    from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+    from tests.test_sharding import _repair_demanding_fake
+
+    node_map = _repair_demanding_fake()
+    want = SolverPlanner(ReschedulerConfig(solver="numpy")).plan(node_map, [])
+    assert want.plan is not None  # only repair proves this drain
+
+    packed, _ = pack_cluster(node_map, [], resources=("cpu", "memory"))
+    C, K, S, R, W, A = memory.packed_shapes(packed)
+    pb = plane_bytes(carry_layout(packed), R, A)
+    carry_est = memory.estimate_union_hbm_bytes(
+        -(-C // 8), K, S, R, W, A,
+        repair_spot_chunks=1, carry_chunks=1, carry_plane_bytes=pb,
+    )
+    cfg = ReschedulerConfig(
+        solver="jax", solver_hbm_budget=int(carry_est) + 1, carry_chunks=2
+    )
+    planner = SolverPlanner(cfg)
+    report = planner.plan(node_map, [])
+    assert report.solver == "jax+cand-carry"
+    assert report.carry_chunks == 2
+    assert report.repair_chunks == 2  # repair LIVE, spot-chunked
+    assert report.plan is not None
+    assert report.plan.node.node.name == want.plan.node.node.name
+    assert report.plan.assignments == want.plan.assignments
+    assert (
+        metrics.repair_unavailable.collect()[0].samples[0].value == 0.0
+    )
+    assert (
+        metrics.solver_carry_chunks.collect()[0].samples[0].value == 2.0
+    )
+    assert metrics.solver_carry_bytes.collect()[0].samples[0].value > 0
+    snap = health.snapshot()
+    assert snap["solver_mode"] == "jax+cand-carry"
+    assert snap["carry_chunks"] == 2
+    assert snap["solver_carry_bytes"] > 0
+
+
+def test_streamed_union_repairs_greedy_failure():
+    """A drain only repair can prove survives the carry-streamed union
+    bit-identically (the repair phase genuinely runs on the narrow
+    carry, not just the greedy passes)."""
+    from tests.test_repair_chunked import _swap_case
+
+    packed = _swap_case()
+    assert not plan_oracle(packed).feasible[0]
+    want = plan_repair_oracle(packed, rounds=8)
+    assert bool(want.feasible[0])
+    for n in (2, 3):
+        got = with_repair_streamed(8, n, carry_layout(packed))(packed)
+        _assert_same(got, want, f"chunks={n}")

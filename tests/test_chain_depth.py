@@ -12,12 +12,21 @@ configs must not.
 
 from __future__ import annotations
 
+import pytest
+
 from k8s_spot_rescheduler_tpu.bench.chain_depth import (
     analyze_quality_runs,
     classify_packed,
 )
 from k8s_spot_rescheduler_tpu.io.synthetic import AffinitySpec
-from tests.test_repair import _rotation_coverage_case, _swap_case
+
+# tests.test_repair's import chain needs hypothesis; collection must
+# stay clean on images without it (skip here, run where it exists)
+pytest.importorskip("hypothesis")
+from tests.test_repair import (  # noqa: E402
+    _rotation_coverage_case,
+    _swap_case,
+)
 
 
 def test_classify_depth1_fixture():

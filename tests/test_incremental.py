@@ -355,7 +355,11 @@ def test_pipelined_tick_records_split_phases():
     spec = dataclasses.replace(CONFIGS[1], n_pods=12)
     fc = generate_cluster(spec, seed=3)
     cfg = ReschedulerConfig(
-        solver="jax", resources=spec.resources, node_drain_delay=0.0
+        solver="jax", resources=spec.resources, node_drain_delay=0.0,
+        # the per-tick pipelined path is what this test times; schedules
+        # (the default) serve steps without plan-dispatch/plan-fetch —
+        # pin the documented opt-out
+        schedule_horizon=0,
     )
     r = Rescheduler(fc, SolverPlanner(cfg), cfg, clock=FakeClock())
 

@@ -262,11 +262,13 @@ def test_zero_step_schedule_reports_no_drain():
     assert result.report.schedule_len == 0
 
 
-def test_schedule_disabled_by_default():
-    """The config default keeps the shipped per-tick path: no schedule
-    is ever cut unless plan_schedule_enabled is set."""
-    assert ReschedulerConfig().plan_schedule_enabled is False
-    cfg = _quality_cfg(max_drains_per_tick=1)
+def test_schedule_enabled_by_default_with_horizon_zero_opt_out():
+    """Schedules are ON by default (the PR-11 follow-up: quality-scale
+    asserts the fetch bound with them live); ``--schedule-horizon 0``
+    is the documented opt-out — no schedule is ever cut under it."""
+    assert ReschedulerConfig().plan_schedule_enabled is True
+    assert ReschedulerConfig().schedule_horizon == 32
+    cfg = _quality_cfg(max_drains_per_tick=1, schedule_horizon=0)
     client = generate_quality_cluster(SPEC, 0, reschedule_evicted=True)
     inner = SolverPlanner(cfg)
     r = Rescheduler(
@@ -372,5 +374,34 @@ def test_schedule_flags_flow_into_config():
     cfg = config_from_args(args)
     assert cfg.plan_schedule_enabled is True
     assert cfg.schedule_horizon == 16
+    # 0 = the documented opt-out (schedules off); negatives stay invalid
+    assert ReschedulerConfig(schedule_horizon=0).schedule_horizon == 0
     with pytest.raises(ValueError):
-        ReschedulerConfig(schedule_horizon=0)
+        ReschedulerConfig(schedule_horizon=-1)
+
+
+def test_schedule_churn_hysteresis_accounting():
+    """Default-on follow-up: a schedule churn kills before it served 2
+    steps (with a meaningful unserved tail) opens a doubling per-tick
+    backoff window, capped; one that served >= 2 steps resets it; a
+    short schedule (< 2 unserved steps wasted) never backs off."""
+
+    class _S:
+        def __init__(self, cursor, n):
+            self.cursor = cursor
+            self.steps = [None] * n
+
+    r = Rescheduler.__new__(Rescheduler)  # accounting only, no loop
+    r._sched_backoff = 0
+    r._sched_backoff_next = 1
+    r._note_schedule_outcome(_S(1, 32))
+    assert (r._sched_backoff, r._sched_backoff_next) == (1, 2)
+    r._note_schedule_outcome(_S(0, 32))
+    assert (r._sched_backoff, r._sched_backoff_next) == (2, 4)
+    for _ in range(10):
+        r._note_schedule_outcome(_S(1, 32))
+    assert r._sched_backoff_next == 64  # capped
+    r._note_schedule_outcome(_S(2, 32))  # paid for its cut
+    assert (r._sched_backoff, r._sched_backoff_next) == (0, 1)
+    r._note_schedule_outcome(_S(1, 2))  # tiny waste: stay schedule-happy
+    assert (r._sched_backoff, r._sched_backoff_next) == (0, 1)

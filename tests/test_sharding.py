@@ -107,6 +107,60 @@ def test_hbm_breakdown_components_sum_to_total():
     assert norepair["repair"] == 0
 
 
+def test_hbm_breakdown_carry_mode_boundary_pins():
+    """The carry-streamed estimate (ROADMAP 5): same component names,
+    sum == total, the carries term is the narrow layout's
+    2·plane_bytes·C·S exactly (the jaxpr memory-reconcile carries band
+    0.7-1.4 gates this term against the traced program — measured 1.00
+    at introduction), streaming shrinks ONLY the chunk-resident terms,
+    and the narrow carries sit strictly under the wide ones."""
+    from k8s_spot_rescheduler_tpu.solver.carry import (
+        NARROW_LAYOUT,
+        plane_bytes,
+    )
+    from k8s_spot_rescheduler_tpu.solver.memory import (
+        estimate_union_hbm_breakdown,
+        estimate_union_hbm_bytes,
+    )
+
+    npb = plane_bytes(NARROW_LAYOUT, 4, 2)
+    wide = estimate_union_hbm_breakdown(2560, 32, 2560, 4, 2, 2)
+    for chunks in (1, 4, 16):
+        bd = estimate_union_hbm_breakdown(
+            2560, 32, 2560, 4, 2, 2,
+            repair_spot_chunks=chunks, carry_chunks=chunks,
+            carry_plane_bytes=npb,
+        )
+        assert set(bd) == set(wide)
+        assert sum(bd.values()) == estimate_union_hbm_bytes(
+            2560, 32, 2560, 4, 2, 2,
+            repair_spot_chunks=chunks, carry_chunks=chunks,
+            carry_plane_bytes=npb,
+        )
+        # the sharp term: narrow stacked delta planes, double-buffered
+        assert bd["carries"] == 2 * npb * 2560 * 2560
+        assert bd["carries"] < wide["carries"]
+        # inputs/outputs are layout-independent
+        for k in ("slots", "outputs", "spot_static"):
+            assert bd[k] == wide[k], k
+    one = estimate_union_hbm_breakdown(
+        2560, 32, 2560, 4, 2, 2, carry_chunks=1, carry_plane_bytes=npb
+    )
+    four = estimate_union_hbm_breakdown(
+        2560, 32, 2560, 4, 2, 2,
+        repair_spot_chunks=4, carry_chunks=4, carry_plane_bytes=npb,
+    )
+    # streaming shrinks the chunk-resident terms, never the carries
+    assert four["temporaries"] < one["temporaries"]
+    assert four["repair"] < one["repair"]
+    assert four["carries"] == one["carries"]
+    # unspecified plane bytes default to the NARROW layout's
+    dflt = estimate_union_hbm_breakdown(
+        2560, 32, 2560, 4, 2, 2, carry_chunks=1
+    )
+    assert dflt["carries"] == one["carries"]
+
+
 def test_should_shard_requires_mesh_and_pressure():
     from k8s_spot_rescheduler_tpu.solver.memory import should_shard
 

@@ -46,6 +46,13 @@ from tools.analysis.jaxpr.jaxpr_utils import live_model
 CARRY_BAND = (0.7, 1.4)
 INPUT_BAND = (0.7, 1.4)
 TOTAL_BAND = (0.25, 0.9)
+# Carry-streamed entries (reconcile spec carry_chunks >= 1) get a
+# lower total floor: their elect-then-commit lax.map inside the slot
+# scan makes the liveness model charge a third stacked-state copy
+# XLA's ping-ponged loop buffers never materialize (measured ratio
+# 0.23 at both reconcile scales; wide-layout programs keep the 0.25
+# floor and still measure 0.31-0.55 — docs/ANALYSIS.md table).
+CARRY_TOTAL_FLOOR = 0.20
 SCALE_DRIFT_MAX = 0.15
 
 
@@ -57,9 +64,13 @@ def _breakdown(hp, shapes) -> dict:
         estimate_union_hbm_breakdown,
     )
 
+    # carry_chunks >= 1 reconciles against the carry-streamed NARROW
+    # layout (solver/carry.NARROW_LAYOUT plane bytes — the layout the
+    # streamed hot programs trace with), the ROADMAP-5 regression gate
     return estimate_union_hbm_breakdown(
         shapes.C, shapes.K, shapes.S, shapes.R, shapes.W, shapes.A,
         repair_spot_chunks=spec.get("repair_spot_chunks", 1),
+        carry_chunks=spec.get("carry_chunks", 0),
     )
 
 
@@ -137,12 +148,17 @@ def reconcile(traced_by_shape, name, hp, path, line) -> List[Finding]:
         if model["peak"]:
             r = est_total / model["peak"]
             ratios.append((shapes, r))
-            if not (TOTAL_BAND[0] <= r <= TOTAL_BAND[1]):
+            total_band = (
+                (CARRY_TOTAL_FLOOR, TOTAL_BAND[1])
+                if (hp.reconcile or {}).get("carry_chunks")
+                else TOTAL_BAND
+            )
+            if not (total_band[0] <= r <= total_band[1]):
                 fail(
                     "total",
                     f"total drifted: estimator {est_total / 1e6:.1f}MB vs "
                     f"modeled peak {model['peak'] / 1e6:.1f}MB (ratio "
-                    f"{r:.2f}, band {TOTAL_BAND}) at C={shapes.C},"
+                    f"{r:.2f}, band {total_band}) at C={shapes.C},"
                     f"S={shapes.S}; {table}",
                 )
     if len(ratios) >= 2:
