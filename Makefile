@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke scale-smoke serve-smoke sched-smoke chaos-smoke watch-soak fleet-chaos-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke scale-smoke serve-smoke sched-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -19,7 +19,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
 # stand-in and tools/analysis is the go-vet analog, two tiers deep
 # (this image ships no Python linter and installs are forbidden).
-check: lint analyze audit-jaxpr test bench-smoke scale-smoke serve-smoke sched-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke
+check: lint analyze audit-jaxpr test bench-smoke scale-smoke serve-smoke sched-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke
 
 lint:
 	python tools/lint.py
@@ -127,6 +127,19 @@ watch-soak:
 # its persisted state. Budget: <60 s wall.
 fleet-chaos-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --fleet-chaos --watchdog 60
+
+# Fleet-twin smoke (CPU-only, seconds of wall on a virtual clock): 64
+# heterogeneous tenant twins x 2 real-HTTP planner-service replicas
+# through ~20 simulated minutes — 4 occupancy phases with correlated
+# spot-interruption storms, one replica kill + warm restart per phase,
+# and tenant join/leave churn — plus the deterministic induction that
+# drives every labeled service_admission_shed_total reason through a
+# live replica. Fails unless zero twin crashes, every spot-checked
+# selection is bit-identical to the solo in-process plan, the capacity
+# curve is monotone and non-degenerate, and flight-recorder deltas
+# equal metric deltas for failover and every shed reason. Budget: <60 s.
+fleet-twin-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --fleet-twin-smoke --watchdog 60
 
 quality:
 	python bench.py --quality

@@ -2082,6 +2082,108 @@ def run_fleet_chaos(args, metric: str, unit: str) -> int:
     return 0 if result["ok"] else 1
 
 
+def _fleet_twin_report(result: dict, label: str) -> None:
+    curve = result.get("capacity_curve", [])
+    occ = "/".join("%.2f" % r["occupancy"] for r in curve)
+    p99 = "/".join("%.0f" % r["queue_wait_p99_ms"] for r in curve)
+    print(
+        f"{label}: {result['ever_active']} twins x "
+        f"{result['replicas']} replicas, {result['sim_s']:.0f}s sim in "
+        f"{result['wall_s']:.1f}s wall  occ={occ}  p99={p99}ms  "
+        f"capacity@{result['slo_ms']:.0f}ms="
+        f"{result['capacity_tenants_per_device_at_slo']} tenants/device  "
+        f"jain={result['jain_fleet']}  "
+        f"verified={result['verified_selections']}  "
+        f"failovers={result['failovers_metric']}=="
+        f"{result['failovers_flight']}  "
+        f"sheds={result['shed_total_metric']}=="
+        f"{result['shed_total_flight']}  "
+        f"-> {'OK' if result['ok'] else 'FAIL: %s' % result['failures']}",
+        file=sys.stderr,
+    )
+
+
+def run_fleet_twin_smoke(args, metric: str, unit: str) -> int:
+    """CI smoke of the fleet twin (``make fleet-twin-smoke``): 64
+    heterogeneous tenant twins x 2 real-HTTP service replicas through
+    ~20 simulated minutes (4 occupancy phases, one spot storm and one
+    replica kill/restart per phase), plus the deterministic shed-edge
+    induction that drives every labeled ``service_admission_shed_total``
+    reason through a live replica. Fails unless zero twin crashes, every
+    spot-checked selection is bit-identical to the solo in-process plan,
+    the capacity curve is monotone and non-degenerate, and flight-
+    recorder deltas equal metric deltas for both the failover and the
+    per-reason shed edges."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from k8s_spot_rescheduler_tpu.bench.fleet_twin import (
+        fleet_twin, induce_shed_edges,
+    )
+    result = fleet_twin(
+        n_twins=max(16, min(64, args.tenants if args.tenants > 4 else 64)),
+        n_replicas=2, sim_s=1200.0, seed=args.seed, slo_ms=3000.0,
+        cost_base_s=0.3, cost_per_lane_s=0.4, max_wall_s=45.0,
+    )
+    edges = induce_shed_edges(seed=args.seed)
+    ok = bool(result["ok"] and edges["ok"])
+    _fleet_twin_report(result, "fleet-twin-smoke")
+    print(
+        f"fleet-twin-smoke shed edges: metric={edges['metric_delta']} "
+        f"flight={edges['flight_delta']} "
+        f"-> {'OK' if edges['ok'] else 'FAIL: %s' % edges['failures']}",
+        file=sys.stderr,
+    )
+    emit(
+        {
+            "metric": metric,
+            "value": result["capacity_tenants_per_device_at_slo"],
+            "unit": unit,
+            "n_twins": result["n_twins"],
+            "ever_active": result["ever_active"],
+            "replicas": result["replicas"],
+            "sim_s": result["sim_s"],
+            "wall_s": result["wall_s"],
+            "slo_ms": result["slo_ms"],
+            "capacity_curve": result["capacity_curve"],
+            "failover_convexity": result["failover_convexity"],
+            "jain_fleet": result["jain_fleet"],
+            "compile": result["compile"],
+            "sheds_by_reason": result["sheds_by_reason"],
+            "shed_edge_metric_delta": edges["metric_delta"],
+            "shed_edge_flight_delta": edges["flight_delta"],
+            "failovers": result["failovers_flight"],
+            "verified_selections": result["verified_selections"],
+            "mismatches": result["mismatches"],
+            "crashes": result["crashes"],
+            "ok": ok,
+            "failures": result["failures"] + edges["failures"],
+        }
+    )
+    return 0 if ok else 1
+
+
+def run_fleet_twin(args, metric: str, unit: str) -> int:
+    """Full fleet twin (``python bench.py --fleet-twin``): 512
+    heterogeneous tenant twins x 2 real-HTTP replicas through one
+    simulated hour on the shared virtual clock — the capacity-planning
+    artifact (tenants/device at the queue-wait SLO across 4 occupancy
+    points, failover convexity, Jain fairness) in a few minutes of CPU
+    wall. Same invariants as the smoke, at fleet scale."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from k8s_spot_rescheduler_tpu.bench.fleet_twin import fleet_twin
+    result = fleet_twin(
+        n_twins=max(512, args.tenants if args.tenants > 4 else 512),
+        n_replicas=2, sim_s=3600.0, seed=args.seed, slo_ms=1000.0,
+        cost_base_s=0.05, cost_per_lane_s=0.05, max_wall_s=280.0,
+    )
+    _fleet_twin_report(result, "fleet-twin")
+    out = dict(result)
+    out.update({"metric": metric, "value":
+                result["capacity_tenants_per_device_at_slo"],
+                "unit": unit})
+    emit(out)
+    return 0 if result["ok"] else 1
+
+
 def run_chaos(args, metric: str, unit: str) -> int:
     """Chaos soak (``make chaos-smoke``): N control-loop ticks over a
     fixture-scale fake cluster behind the seeded fault-injection client
@@ -2758,6 +2860,10 @@ def _metric_for(args) -> tuple:
         return "sched_smoke_fetches_total", "count"
     if args.fleet_chaos:
         return "fleet_chaos_failover_ms", "ms"
+    if args.fleet_twin_smoke:
+        return "fleet_twin_smoke_capacity_tenants_per_device", "tenants"
+    if args.fleet_twin:
+        return "fleet_twin_capacity_tenants_per_device", "tenants"
     if args.quality:
         return "nodes_freed_vs_ilp_oracle_ratio", "ratio"
     if args.quality_boundary:
@@ -2896,6 +3002,21 @@ def main() -> int:
                          "solo in-process plan, detection/recovery "
                          "edges fire, and flight deltas == metric "
                          "deltas")
+    ap.add_argument("--fleet-twin-smoke", action="store_true",
+                    help="CI smoke (make fleet-twin-smoke): 64 tenant "
+                         "twins x 2 real-HTTP replicas through ~20 "
+                         "simulated minutes (storms, replica kills, "
+                         "join/leave churn) plus deterministic shed-"
+                         "edge induction; fails unless zero crashes, "
+                         "bit-identical spot checks, a monotone non-"
+                         "degenerate capacity curve, and flight==metric "
+                         "for failover and every shed reason")
+    ap.add_argument("--fleet-twin", action="store_true",
+                    help="full fleet twin: 512 tenant twins x 2 real-"
+                         "HTTP replicas through 1 simulated hour on the "
+                         "virtual clock; emits the capacity-planning "
+                         "curve (tenants/device at the queue-wait SLO), "
+                         "failover convexity and Jain fairness")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke (make bench-smoke): tiny CPU-only "
                          "cluster, 5 ticks through the production "
@@ -2941,6 +3062,10 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         return run_sched_smoke(args, metric, unit)
     if args.fleet_chaos:
         return run_fleet_chaos(args, metric, unit)
+    if args.fleet_twin_smoke:
+        return run_fleet_twin_smoke(args, metric, unit)
+    if args.fleet_twin:
+        return run_fleet_twin(args, metric, unit)
     if args.quality:
         return run_quality(
             args.seed, sweep=args.sweep, solver=args.solver or "numpy"

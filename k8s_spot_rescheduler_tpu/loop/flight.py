@@ -266,6 +266,22 @@ class FlightRecorder:
             out = [e for e in out if e["kind"] == kind]
         return out
 
+    def attr_counts(self, kind: str, key: str) -> Dict[str, int]:
+        """Events of ``kind`` grouped by a SAFE attr (e.g. service-shed
+        by ``reason``): the per-label readback the fleet-twin smoke
+        diffs against the labeled metric so flight-delta == metric-delta
+        holds per reason, not just in total. Bounded by the event log
+        (the per-kind totals in counts() see every event; this sees the
+        retained window — diff over a window shorter than the log)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for event in self._events:
+                if event["kind"] != kind:
+                    continue
+                value = str(event.get("attrs", {}).get(key, ""))
+                out[value] = out.get(value, 0) + 1
+        return out
+
     def last_tick(self) -> Optional[dict]:
         """The most recent ring entry, redacted (/debug/trace)."""
         with self._lock:
@@ -347,6 +363,18 @@ def note_event(kind: str, cause: str = "", trace_id: str = "", **attrs) -> dict:
 
 def record_tick(trace: dict, **attrs) -> None:
     RECORDER.record_tick(trace, **attrs)
+
+
+def counts() -> Dict[str, int]:
+    return RECORDER.counts()
+
+
+def attr_counts(kind: str, key: str) -> Dict[str, int]:
+    return RECORDER.attr_counts(kind, key)
+
+
+def events(kind: Optional[str] = None) -> List[dict]:
+    return RECORDER.events(kind)
 
 
 def snapshot() -> dict:

@@ -16,6 +16,9 @@ Reference update points this module mirrors:
 
 from __future__ import annotations
 
+import math
+from collections import OrderedDict, deque
+
 from prometheus_client import Counter, Gauge, Histogram, start_http_server
 
 NAMESPACE = "spot_rescheduler"
@@ -471,6 +474,69 @@ service_tenant_cache = Gauge(
     namespace=NAMESPACE,
 )
 
+service_admission_shed = Counter(
+    "service_admission_shed",
+    "Plan requests the planner service shed, labeled by the admission "
+    "edge that refused them: max-inflight (the handler depth cap "
+    "answered 503 before the body was read), queue-timeout (evicted "
+    "after waiting a full service_queue_timeout in the tenant queue), "
+    "deadline (evicted after waiting out the CLIENT's declared "
+    "X-Planner-Deadline, shorter than the queue timeout), drain-refuse "
+    "(a draining replica refused pre-body), drain-evict (queued work "
+    "evicted when the drain grace expired). Each reason fires from "
+    "exactly ONE site, paired with a flight 'service-shed' event "
+    "carrying the same reason attr — the capacity curve's shed axis.",
+    ["reason"],
+    namespace=NAMESPACE,
+)
+
+service_bucket_compile_hits = Counter(
+    "service_bucket_compile_hits",
+    "Batched solves whose stacked shape family (bucket dims x tenant "
+    "axis x schedule horizon) had already been solved by this process "
+    "— the jit program was reused, no compile was paid. The "
+    "compile-sharing win of power-of-two shape buckets: hits/(hits+"
+    "misses) is the fleet's compile hit rate as tenant shapes drift.",
+    namespace=NAMESPACE,
+)
+
+service_bucket_compile_misses = Counter(
+    "service_bucket_compile_misses",
+    "Batched solves that were the FIRST of their stacked shape family "
+    "in this process — each paid (or would pay, on a device backend) "
+    "one jit compile. Climbing misses under a stable fleet means "
+    "tenant shape drift is walking out of the bucketed shape space "
+    "(docs/DESIGN.md service era: buckets exist to bound this).",
+    namespace=NAMESPACE,
+)
+
+service_batch_occupancy = Gauge(
+    "service_batch_occupancy",
+    "Tenant lane-blocks in the last batched solve as a fraction of the "
+    "HBM-derived batch cap for its bucket (1.0 = the batch dispatched "
+    "full; the saturation gauge the capacity curve sweeps — queue "
+    "waits stay flat until this pins near 1, then the knee).",
+    namespace=NAMESPACE,
+)
+
+service_queue_wait_p50 = Gauge(
+    "service_queue_wait_p50_ms",
+    "Median queue wait over the recent window (the bounded ring behind "
+    "service_tenant_wait_snapshot, all tenants pooled) — unlike the "
+    "cumulative service_queue_wait_ms histogram this answers 'how is "
+    "the fleet RIGHT NOW', and resets with the window.",
+    namespace=NAMESPACE,
+)
+
+service_queue_wait_p99 = Gauge(
+    "service_queue_wait_p99_ms",
+    "p99 queue wait over the recent window (same ring as the p50 "
+    "gauge) — the tail the capacity-planning SLO is declared against: "
+    "tenants/device at a given occupancy is read off where this "
+    "crosses the SLO.",
+    namespace=NAMESPACE,
+)
+
 service_device_sick = Gauge(
     "service_device_sick",
     "1 while the planner service's device-health watchdog "
@@ -664,22 +730,151 @@ def update_observe_delta_events(n: int) -> None:
 # batch; the serve-smoke acceptance needs the run's high-water marks)
 _service_batch_max = {"lanes": 0, "tenants": 0}
 
+# windowed queue-wait accounting: a bounded ring of recent waits per
+# tenant (plus one pooled ring for the aggregate gauges). Tenant ids
+# are client-supplied, so the map is bounded exactly like the server's
+# tenant bookkeeping: per-ring length capped, LRU-evicted past the
+# tenant cap — a churning fleet must not grow this (or /healthz, which
+# serves it) without bound.
+WAIT_WINDOW = 128  # recent waits kept per tenant
+WAIT_TENANTS_MAX = 4096  # mirror of the server's TENANT_STATE_MAX
+_tenant_waits: "OrderedDict[str, deque]" = OrderedDict()
+_window_waits: deque = deque(maxlen=4096)
+# requests served per tenant since the window was last reset — the
+# service-share vector jain_fairness() is computed over
+_tenant_served: "OrderedDict[str, int]" = OrderedDict()
+
+
+def _percentile(values, q: float) -> float:
+    """Nearest-rank percentile of an unsorted sequence (0 when empty) —
+    the one implementation the gauges, snapshots and /healthz share."""
+    if not values:
+        return 0.0
+    ranked = sorted(values)
+    idx = min(len(ranked) - 1, max(0, int(math.ceil(q * len(ranked))) - 1))
+    return float(ranked[idx])
+
+
+def jain_fairness(shares) -> float:
+    """Jain's fairness index (sum x)^2 / (n * sum x^2) over a vector of
+    per-tenant service shares: 1.0 = perfectly even, 1/n = one tenant
+    holds everything. The twin computes it over served/offered ratios
+    (demand-normalized); ``service_snapshot()`` reports it over the
+    windowed per-tenant served counts (meaningful under symmetric
+    demand). Empty or all-zero vectors read as 1.0 — no tenants means
+    nobody is being starved."""
+    vals = [float(v) for v in shares]
+    total = sum(vals)
+    if not vals or total <= 0:
+        return 1.0
+    return (total * total) / (len(vals) * sum(v * v for v in vals))
+
+
+def _note_tenant_wait(tenant: str, wait_ms: float) -> None:
+    ring = _tenant_waits.get(tenant)
+    if ring is None:
+        ring = _tenant_waits[tenant] = deque(maxlen=WAIT_WINDOW)
+    ring.append(wait_ms)
+    _tenant_waits.move_to_end(tenant)
+    _tenant_served[tenant] = _tenant_served.get(tenant, 0) + 1
+    _tenant_served.move_to_end(tenant)
+    while len(_tenant_waits) > WAIT_TENANTS_MAX:
+        _tenant_waits.popitem(last=False)
+    while len(_tenant_served) > WAIT_TENANTS_MAX:
+        _tenant_served.popitem(last=False)
+    _window_waits.append(wait_ms)
+
 
 def update_service_request(outcome: str) -> None:
     service_requests.labels(outcome).inc()
 
 
-def update_service_batch(lanes: int, tenants: int, waits_ms) -> None:
-    """One batched solve dispatched: refresh the occupancy gauges and
-    observe every member request's queue wait."""
+def update_service_admission_shed(reason: str) -> None:
+    """One plan request shed at an admission edge; the caller fires the
+    flight 'service-shed' event with the same reason from the same site
+    so the two surfaces always agree per reason."""
+    service_admission_shed.labels(reason).inc()
+
+
+def update_service_bucket_compile(first: bool) -> None:
+    """One batched solve routed: ``first`` means its stacked shape
+    family had never been solved by this process (a compile was paid);
+    otherwise the jit program was shared."""
+    if first:
+        service_bucket_compile_misses.inc()
+    else:
+        service_bucket_compile_hits.inc()
+
+
+def update_service_batch(
+    lanes: int, tenants: int, waits, occupancy=None
+) -> None:
+    """One batched solve dispatched: refresh the occupancy gauges,
+    observe every member request's queue wait, and feed the windowed
+    per-tenant percentile rings. ``waits`` carries ``(tenant,
+    wait_ms)`` pairs; ``occupancy`` is the batch's fill fraction of its
+    bucket's HBM-derived cap (None when the cap is unknown)."""
     service_batch_lanes.set(int(lanes))
     service_batch_tenants.set(int(tenants))
     _service_batch_max["lanes"] = max(_service_batch_max["lanes"], int(lanes))
     _service_batch_max["tenants"] = max(
         _service_batch_max["tenants"], int(tenants)
     )
-    for w in waits_ms:
+    if occupancy is not None:
+        service_batch_occupancy.set(float(occupancy))
+    for tenant, w in waits:
         service_queue_wait_ms.observe(float(w))
+        _note_tenant_wait(str(tenant), float(w))
+    service_queue_wait_p50.set(_percentile(_window_waits, 0.50))
+    service_queue_wait_p99.set(_percentile(_window_waits, 0.99))
+
+
+def service_tenant_wait_snapshot(top: int = 0) -> dict:
+    """Windowed per-tenant queue-wait percentiles: ``{tenant: {p50_ms,
+    p99_ms, n}}`` over each tenant's bounded ring of recent waits — the
+    starving-tenant probe surface (/healthz), unlike the run-maxima in
+    ``service_snapshot()``. ``top`` > 0 keeps only the worst ``top``
+    tenants by p99 (the /healthz response stays bounded even before
+    LRU eviction kicks in)."""
+    out = {}
+    for tenant, ring in list(_tenant_waits.items()):
+        vals = list(ring)
+        out[tenant] = {
+            "p50_ms": round(_percentile(vals, 0.50), 3),
+            "p99_ms": round(_percentile(vals, 0.99), 3),
+            "n": len(vals),
+        }
+    if top and len(out) > top:
+        worst = sorted(
+            out.items(), key=lambda kv: kv[1]["p99_ms"], reverse=True
+        )[:top]
+        out = dict(worst)
+    return out
+
+
+def service_queue_wait_summary(top: int = 16) -> dict:
+    """The pooled windowed percentiles plus the worst tenants' — the
+    block /healthz embeds so a probe sees the fleet's CURRENT tail and
+    who is in it."""
+    vals = list(_window_waits)
+    return {
+        "p50_ms": round(_percentile(vals, 0.50), 3),
+        "p99_ms": round(_percentile(vals, 0.99), 3),
+        "n": len(vals),
+        "tenants": service_tenant_wait_snapshot(top=top),
+    }
+
+
+def reset_service_window() -> None:
+    """Clear the windowed wait rings and served-count shares (the fleet
+    twin resets at phase boundaries so each occupancy point's
+    percentiles are its own; tests reset for isolation). Cumulative
+    counters and run maxima are untouched."""
+    _tenant_waits.clear()
+    _window_waits.clear()
+    _tenant_served.clear()
+    service_queue_wait_p50.set(0.0)
+    service_queue_wait_p99.set(0.0)
 
 
 def update_service_tenant_eviction(tenant: str) -> None:
@@ -736,12 +931,20 @@ def service_snapshot() -> dict:
     cache_entries = 0.0
     for sample in service_tenant_cache.collect()[0].samples:
         cache_entries = sample.value
+    shed_by_reason = {}
+    for sample in service_admission_shed.collect()[0].samples:
+        if sample.name.endswith("_total"):
+            shed_by_reason[sample.labels.get("reason", "")] = sample.value
+    occupancy = 0.0
+    for sample in service_batch_occupancy.collect()[0].samples:
+        occupancy = sample.value
     return {
         "requests": by_outcome,
         "batch_lanes": lanes,
         "batch_tenants": tenants,
         "batch_lanes_max": _service_batch_max["lanes"],
         "batch_tenants_max": _service_batch_max["tenants"],
+        "batch_occupancy": occupancy,
         "tenant_evictions": _labeled_counter_total(service_tenant_evictions),
         "remote_planner_fallback": _counter_value(remote_planner_fallback),
         "remote_planner_failover": _counter_value(remote_planner_failover),
@@ -749,6 +952,13 @@ def service_snapshot() -> dict:
         "delta_requests": delta_by_outcome,
         "wire_ingest_bytes": _counter_value(service_wire_ingest_bytes),
         "tenant_cache_entries": cache_entries,
+        "admission_shed": shed_by_reason,
+        "compile_hits": _counter_value(service_bucket_compile_hits),
+        "compile_misses": _counter_value(service_bucket_compile_misses),
+        "queue_wait_p50_ms": round(_percentile(_window_waits, 0.50), 3),
+        "queue_wait_p99_ms": round(_percentile(_window_waits, 0.99), 3),
+        "tenant_queue_wait": service_tenant_wait_snapshot(),
+        "jain_served": round(jain_fairness(_tenant_served.values()), 4),
     }
 
 

@@ -96,6 +96,11 @@ class _Endpoint:
         self.acked_fp = ""  # last pack fingerprint this replica holds
 
 
+# public name: the fleet twin (service/twin.py) reuses the per-endpoint
+# breaker state object rather than growing a parallel one
+Endpoint = _Endpoint
+
+
 class RemotePlanner:
     """Planner over a remote multi-tenant planner service (or an
     ordered failover list of its replicas)."""

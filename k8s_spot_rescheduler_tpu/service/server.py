@@ -273,6 +273,11 @@ class PlannerService:
         # judged (or baselined) as device latency by the watchdog — a
         # fleet ramp-up's compiles are not a sick accelerator
         self._timed_shapes: set = set()
+        # compile-sharing accounting, independent of the watchdog's
+        # _timed_shapes (which deliberately does NOT advance on the
+        # sick/host path): every batch counts a hit or a miss against
+        # the shapes THIS process has solved, whatever path served it
+        self._compile_seen: set = set()
 
     # ------------------------------------------------------------------
     # queue
@@ -333,20 +338,47 @@ class PlannerService:
         slot for — a request nobody is listening to anymore. Returns a
         :class:`wire.PlanReply`, or a :class:`wire.PlanScheduleReply`
         when ``schedule_horizon`` > 0 asked for a drain schedule."""
-        wait_s = self.queue_timeout_s
-        if timeout_s is not None and timeout_s > 0:
-            wait_s = max(0.05, min(wait_s, float(timeout_s)))
+        wait_s, capped = self._bounded_wait(timeout_s)
         req = self.submit_nowait(
             tenant, packed, trace_id=trace_id,
             schedule_horizon=schedule_horizon,
             pack_fingerprint=pack_fingerprint,
         )
-        return self._finish_wait(req, wait_s)
+        return self._finish_wait(req, wait_s, deadline_capped=capped)
 
-    def _finish_wait(self, req: _Request, wait_s: float):
+    def _bounded_wait(self, timeout_s: Optional[float]):
+        """(wait_s, deadline_capped): the queue timeout, shortened to
+        the client's declared deadline when that is tighter — the flag
+        names which bound an eventual eviction was shed under."""
+        wait_s = self.queue_timeout_s
+        if timeout_s is not None and 0 < float(timeout_s) < wait_s:
+            return max(0.05, float(timeout_s)), True
+        return wait_s, False
+
+    def _note_shed(
+        self, reason: str, cause: str, tenant: str = "", trace_id: str = ""
+    ) -> None:
+        """ONE request shed at an admission edge: fire the labeled
+        ``service_admission_shed_total`` counter and the flight
+        ``service-shed`` event (same reason attr) from this single
+        funnel, one call site per reason, so the two surfaces can be
+        asserted equal per reason (fleet-twin-smoke does)."""
+        metrics.update_service_admission_shed(reason)
+        attrs = {"reason": reason}
+        if tenant:
+            attrs["tenant"] = tenant
+        flight.note_event(
+            "service-shed", cause=cause, trace_id=trace_id, **attrs
+        )
+
+    def _finish_wait(
+        self, req: _Request, wait_s: float, deadline_capped: bool = False
+    ):
         """The shared bounded wait behind :meth:`submit` and
         :meth:`submit_delta`: inline drain for scheduler-less callers,
-        eviction past the deadline, and the typed outcomes."""
+        eviction past the deadline, and the typed outcomes.
+        ``deadline_capped`` names which bound an eviction sheds under —
+        the client's declared deadline vs the service queue timeout."""
         if self._thread is None:
             # no scheduler thread (an in-process caller — e.g.
             # PlannerSidecar.plan without start_background): drain the
@@ -358,13 +390,20 @@ class PlannerService:
             if self._evict(req):
                 metrics.update_service_request("expired")
                 metrics.update_service_tenant_eviction(req.tenant)
-                flight.note_event(
-                    "service-shed",
-                    cause="plan request waited past the %.1fs queue "
-                          "timeout" % wait_s,
-                    trace_id=req.trace_id,
-                    tenant=req.tenant,
-                )
+                if deadline_capped:
+                    self._note_shed(
+                        "deadline",
+                        "plan request outlived the client's %.1fs "
+                        "declared deadline" % wait_s,
+                        tenant=req.tenant, trace_id=req.trace_id,
+                    )
+                else:
+                    self._note_shed(
+                        "queue-timeout",
+                        "plan request waited past the %.1fs queue "
+                        "timeout" % wait_s,
+                        tenant=req.tenant, trace_id=req.trace_id,
+                    )
                 raise ServiceBusy(
                     "plan request waited past the %.1fs queue timeout"
                     % wait_s,
@@ -494,10 +533,8 @@ class PlannerService:
             self.note_resync(tenant, cause, trace_id)
             raise ResyncRequired(cause)
         self._enqueue(req)
-        wait_s = self.queue_timeout_s
-        if timeout_s is not None and timeout_s > 0:
-            wait_s = max(0.05, min(wait_s, float(timeout_s)))
-        return self._finish_wait(req, wait_s)
+        wait_s, capped = self._bounded_wait(timeout_s)
+        return self._finish_wait(req, wait_s, deadline_capped=capped)
 
     def invalidate_tenant_cache(self, tenant: Optional[str] = None) -> int:
         """Drop one tenant's (or every) cached packed state; their next
@@ -556,6 +593,10 @@ class PlannerService:
             "batch_window_s": self.batch_window_s,
             "draining": draining,
             "tenant_cache_entries": cache_entries,
+            # windowed queue-wait percentiles (pooled + the worst
+            # tenants' tails): a probe sees a starving tenant NOW, not
+            # its worst-ever (metrics/registry.py bounded rings)
+            "queue_wait_ms": metrics.service_queue_wait_summary(),
         }
         if wd is not None:
             out.update(wd.snapshot())
@@ -680,7 +721,12 @@ class PlannerService:
         solve_ms = (self.clock.now() - t0) * 1e3
         lanes = sum(r.lanes for r in batch)
         tenants = len({r.tenant for r in batch})
-        metrics.update_service_batch(lanes, tenants, waits_ms)
+        cap = self.max_batch_tenants or self._batch_cap.get(bucket, 0)
+        metrics.update_service_batch(
+            lanes, tenants,
+            [(r.tenant, w) for r, w in zip(batch, waits_ms)],
+            occupancy=(len(batch) / cap if cap else None),
+        )
         wall = self.clock.wall()
         end = self.clock.now()
         with self._work:
@@ -1045,6 +1091,29 @@ class PlannerService:
         self._timed_shapes.add(key)
         return True
 
+    def _note_bucket_compile(
+        self, stacked: PackedCluster, horizon: int, count: bool = True
+    ) -> bool:
+        """Compile-sharing accounting: True exactly once per stacked
+        shape family + schedule horizon (that solve pays the jit
+        compile on a device backend); with ``count`` the hit/miss
+        counters fire (warm_start marks its pre-warmed shapes seen
+        WITHOUT counting — a boot-time pre-warm is the compile the
+        first reconnecting agent then gets a hit against)."""
+        key = (
+            stacked.slot_req.shape, stacked.spot_free.shape,
+            stacked.spot_taints.shape, stacked.spot_aff.shape,
+            int(horizon),
+        )
+        first = key not in self._compile_seen
+        if first:
+            if len(self._compile_seen) > 4096:
+                self._compile_seen.clear()
+            self._compile_seen.add(key)
+        if count:
+            metrics.update_service_bucket_compile(first)
+        return first
+
     def _device_solve_timed(self, stacked: PackedCluster, batch):
         """One device-path solve (the solve_hook seam included), timed
         on the service clock, with the server-side chaos hook inside the
@@ -1098,6 +1167,9 @@ class PlannerService:
         A device exception flips the watchdog and is contained to the
         host path for the batch; host-path exceptions propagate to
         drain_once's per-batch containment."""
+        self._note_bucket_compile(
+            stacked, batch[0].horizon if batch else 0
+        )
         if batch and batch[0].horizon > 0:
             return self._solve_schedule_batch(stacked, batch[0].horizon)
         wd = self._watchdog()
@@ -1302,11 +1374,10 @@ class PlannerService:
             )
             metrics.update_service_request("expired")
             metrics.update_service_tenant_eviction(req.tenant)
-            flight.note_event(
-                "service-shed",
-                cause="queued plan request evicted by graceful drain",
-                trace_id=req.trace_id,
-                tenant=req.tenant,
+            self._note_shed(
+                "drain-evict",
+                "queued plan request evicted by graceful drain",
+                tenant=req.tenant, trace_id=req.trace_id,
             )
             req.event.set()
 
@@ -1380,7 +1451,9 @@ class PlannerService:
             except (TypeError, ValueError):
                 continue
             try:
-                self._solve(self._all_invalid_stack(b))
+                stacked = self._all_invalid_stack(b)
+                self._solve(stacked)
+                self._note_bucket_compile(stacked, 0, count=False)
             except Exception as err:  # noqa: BLE001, exception-discipline — a failed pre-warm costs one later cold compile, never availability; boot continues and the failure is logged
                 log.error("bucket %s pre-warm failed: %s", b.key, err)
                 continue
@@ -1714,9 +1787,9 @@ class ServiceServer:
                     # graceful drain: refuse BEFORE the body is read,
                     # naming the horizon a failover replica answers by
                     metrics.update_service_request("rejected")
-                    flight.note_event(
-                        "service-shed",
-                        cause="replica draining (graceful shutdown)",
+                    server.service._note_shed(
+                        "drain-refuse",
+                        "replica draining (graceful shutdown)",
                         trace_id=self.headers.get("X-Trace-Id", "") or "",
                     )
                     self._reject_unread(
@@ -1730,9 +1803,9 @@ class ServiceServer:
                     return None
                 if not server._admit():
                     metrics.update_service_request("rejected")
-                    flight.note_event(
-                        "service-shed",
-                        cause="planner overloaded (%d requests in flight)"
+                    server.service._note_shed(
+                        "max-inflight",
+                        "planner overloaded (%d requests in flight)"
                         % server.max_inflight,
                         trace_id=self.headers.get("X-Trace-Id", "") or "",
                     )

@@ -104,3 +104,96 @@ def test_tick_phase_histogram():
             )
             >= 1
         )
+
+
+# ---------------------------------------------------------------------------
+# fleet observability plane: windowed waits, fairness, shed/compile series
+
+
+def test_jain_fairness_index():
+    assert metrics.jain_fairness([]) == 1.0
+    assert metrics.jain_fairness([0, 0, 0]) == 1.0  # nobody served, nobody starved
+    assert metrics.jain_fairness([5, 5, 5, 5]) == 1.0
+    n = 8
+    one_hot = [1.0] + [0.0] * (n - 1)
+    assert abs(metrics.jain_fairness(one_hot) - 1.0 / n) < 1e-9
+    mild = metrics.jain_fairness([1, 2, 1, 2])
+    assert 1.0 / 4 < mild < 1.0
+
+
+def test_windowed_wait_percentiles_and_reset():
+    metrics.reset_service_window()
+    # 200 waits for one tenant: the per-tenant ring keeps the last
+    # WAIT_WINDOW only, so the p50 reflects the recent half
+    waits = [("t-ring", float(i)) for i in range(200)]
+    metrics.update_service_batch(4, 1, waits, occupancy=0.5)
+    snap = metrics.service_tenant_wait_snapshot()
+    assert snap["t-ring"]["n"] == metrics.WAIT_WINDOW
+    # nearest-rank p99 of the 128-deep ring [72..199] is rank 127 -> 198
+    assert snap["t-ring"]["p99_ms"] == 198.0
+    assert snap["t-ring"]["p50_ms"] >= 100.0  # old half evicted
+    summary = metrics.service_queue_wait_summary(top=4)
+    assert summary["n"] == 200  # pooled window is wider than one ring
+    assert summary["p99_ms"] >= snap["t-ring"]["p50_ms"]
+    assert _value("spot_rescheduler_service_queue_wait_p99_ms") > 0
+    assert _value("spot_rescheduler_service_batch_occupancy") == 0.5
+    metrics.reset_service_window()
+    assert metrics.service_tenant_wait_snapshot() == {}
+    assert metrics.service_queue_wait_summary()["n"] == 0
+    assert _value("spot_rescheduler_service_queue_wait_p99_ms") == 0.0
+
+
+def test_tenant_wait_snapshot_keeps_worst_tenants():
+    metrics.reset_service_window()
+    pairs = [(f"t-{i}", float(i * 100)) for i in range(8)]
+    metrics.update_service_batch(8, 8, pairs)
+    snap = metrics.service_tenant_wait_snapshot(top=3)
+    assert set(snap) == {"t-7", "t-6", "t-5"}  # worst p99 win
+    metrics.reset_service_window()
+
+
+def test_tenant_wait_rings_are_lru_bounded():
+    metrics.reset_service_window()
+    n_over = metrics.WAIT_TENANTS_MAX + 5
+    for i in range(n_over):
+        metrics.update_service_batch(1, 1, [(f"lru-{i}", 1.0)])
+    snap = metrics.service_tenant_wait_snapshot()
+    assert len(snap) == metrics.WAIT_TENANTS_MAX
+    assert "lru-0" not in snap  # oldest evicted
+    assert f"lru-{n_over - 1}" in snap
+    metrics.reset_service_window()
+
+
+def test_admission_shed_reason_labels():
+    name = "spot_rescheduler_service_admission_shed_total"
+    before = _value(name, {"reason": "queue-timeout"}) or 0
+    metrics.update_service_admission_shed("queue-timeout")
+    assert _value(name, {"reason": "queue-timeout"}) == before + 1
+    other = _value(name, {"reason": "max-inflight"}) or 0
+    metrics.update_service_admission_shed("max-inflight")
+    assert _value(name, {"reason": "max-inflight"}) == other + 1
+
+
+def test_bucket_compile_hit_miss_counters():
+    hits = "spot_rescheduler_service_bucket_compile_hits_total"
+    misses = "spot_rescheduler_service_bucket_compile_misses_total"
+    h0, m0 = _value(hits) or 0, _value(misses) or 0
+    metrics.update_service_bucket_compile(first=True)
+    metrics.update_service_bucket_compile(first=False)
+    metrics.update_service_bucket_compile(first=False)
+    assert _value(hits) == h0 + 2
+    assert _value(misses) == m0 + 1
+
+
+def test_service_snapshot_carries_fleet_plane():
+    metrics.reset_service_window()
+    metrics.update_service_batch(
+        4, 2, [("snap-a", 10.0), ("snap-b", 30.0)], occupancy=0.25
+    )
+    snap = metrics.service_snapshot()
+    assert snap["batch_occupancy"] == 0.25
+    assert snap["queue_wait_p99_ms"] == 30.0
+    assert snap["tenant_queue_wait"]["snap-b"]["p99_ms"] == 30.0
+    assert 0 < snap["jain_served"] <= 1.0
+    assert "admission_shed" in snap and "compile_hits" in snap
+    metrics.reset_service_window()

@@ -514,3 +514,73 @@ def test_serve_smoke_core():
 
     result = bench.serve_smoke(n_tenants=4, seed=0)
     assert result["ok"], result
+
+
+# ---------------------------------------------------------------------------
+# fleet observability plane: windowed waits on /healthz, compile sharing,
+# labeled admission shed (the per-reason edges are driven end-to-end over
+# HTTP by bench.fleet_twin.induce_shed_edges / tests/test_twin.py)
+
+
+def test_healthz_embeds_windowed_queue_waits():
+    metrics.reset_service_window()
+    clock = FakeClock()
+    svc = _service(clock)
+    svc.solve_hook = _stub_solve()
+    svc.submit_nowait("probe-a", tiny_packed())
+    clock.advance(0.25)
+    svc.submit_nowait("probe-b", tiny_packed(seed=1))
+    assert svc.drain_once()
+    snap = svc.healthz_snapshot()
+    qw = snap["queue_wait_ms"]
+    assert qw["n"] == 2
+    # probe-a waited ~250ms, probe-b ~0: the windowed percentiles see it
+    assert qw["p99_ms"] >= 200.0
+    assert qw["tenants"]["probe-a"]["p99_ms"] >= 200.0
+    assert qw["tenants"]["probe-b"]["p99_ms"] < 200.0
+    metrics.reset_service_window()
+
+
+def test_bucket_compile_miss_then_hit_per_shape_family():
+    from prometheus_client import REGISTRY as _REG
+
+    def _v(name):
+        return _REG.get_sample_value(name) or 0
+
+    hits = "spot_rescheduler_service_bucket_compile_hits_total"
+    misses = "spot_rescheduler_service_bucket_compile_misses_total"
+    svc = _service()
+    svc.solve_hook = _stub_solve()
+    h0, m0 = _v(hits), _v(misses)
+    svc.submit_nowait("t", tiny_packed(seed=0))
+    assert svc.drain_once()  # first solve of this stacked family: miss
+    assert (_v(misses), _v(hits)) == (m0 + 1, h0)
+    svc.submit_nowait("t", tiny_packed(seed=1))
+    assert svc.drain_once()  # same family again: shared program, hit
+    assert (_v(misses), _v(hits)) == (m0 + 1, h0 + 1)
+
+
+def test_queue_timeout_eviction_fires_labeled_shed():
+    from prometheus_client import REGISTRY as _REG
+
+    from k8s_spot_rescheduler_tpu.loop import flight
+
+    name = "spot_rescheduler_service_admission_shed_total"
+    before = _REG.get_sample_value(name, {"reason": "queue-timeout"}) or 0
+    seq0 = max(
+        (e["seq"] for e in flight.events("service-shed")), default=-1
+    )
+    clock = FakeClock()
+    svc = _service(clock)
+    svc.queue_timeout_s = 0.05
+    svc._thread = object()  # scheduler "exists" but never drains: rot
+    with pytest.raises(ServiceBusy):
+        svc.submit("too-late", tiny_packed())
+    after = _REG.get_sample_value(name, {"reason": "queue-timeout"}) or 0
+    assert after == before + 1
+    fresh = [
+        e for e in flight.events("service-shed")
+        if e["seq"] > seq0
+        and e["attrs"].get("reason") == "queue-timeout"
+    ]
+    assert len(fresh) == 1  # one fire site, metric and ledger agree
