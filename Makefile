@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke scale-smoke serve-smoke sched-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -19,7 +19,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
 # stand-in and tools/analysis is the go-vet analog, two tiers deep
 # (this image ships no Python linter and installs are forbidden).
-check: lint analyze audit-jaxpr test bench-smoke scale-smoke serve-smoke sched-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke
+check: lint analyze audit-jaxpr test bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke
 
 lint:
 	python tools/lint.py
@@ -89,6 +89,13 @@ serve-smoke:
 # schedule in flight must cost nothing until the next cut fails over.
 sched-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --sched-smoke --watchdog 300
+
+# Pallas stream-kernel parity smoke (CPU interpret mode, <30 s): the
+# fused elect-then-commit best-fit kernel vs the XLA carry-streamed
+# step vs the host oracle, bit-identical selections across >=3 chunk
+# counts on 3 permuted packs.
+pallas-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --pallas-smoke --watchdog 30
 
 # 8-virtual-device spot-chunked repair smoke: a drain only repair can
 # prove, at a budget that previously forced the repair-less 2-D tier —

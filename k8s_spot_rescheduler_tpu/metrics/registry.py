@@ -443,6 +443,28 @@ remote_planner_failover = Counter(
     namespace=NAMESPACE,
 )
 
+remote_wire_connection_reuse = Counter(
+    "remote_wire_connection_reuse",
+    "Agent plan requests served over an ALREADY-ESTABLISHED pooled "
+    "keep-alive connection (service/agent.py PooledWireTransport) — "
+    "the per-tick TCP+HTTP setup tax the persistent wire amortizes "
+    "away. Steady state this grows by 1 per tick per endpoint; "
+    "serve-smoke asserts >= ticks-1 over a live ServiceServer.",
+    namespace=NAMESPACE,
+)
+
+remote_wire_reconnects = Counter(
+    "remote_wire_reconnects",
+    "Pooled keep-alive sockets found stale/half-closed (server "
+    "restart, idle timeout, LB reset) and transparently replaced by "
+    "ONE retry on a fresh connection before the request counted "
+    "against the endpoint's breaker (service/agent.py stale-retry "
+    "contract, docs/ROBUSTNESS.md). A steadily climbing rate means "
+    "something on the path kills idle connections faster than the "
+    "tick cadence.",
+    namespace=NAMESPACE,
+)
+
 service_delta_requests = Counter(
     "service_delta_requests",
     "Delta-shipping plan requests (wire v4 KIND_PACKED_DELTA) by "
@@ -889,6 +911,14 @@ def update_remote_planner_failover() -> None:
     remote_planner_failover.inc()
 
 
+def update_remote_wire_reuse() -> None:
+    remote_wire_connection_reuse.inc()
+
+
+def update_remote_wire_reconnect() -> None:
+    remote_wire_reconnects.inc()
+
+
 def update_service_device_sick(sick: bool) -> None:
     service_device_sick.set(1 if sick else 0)
 
@@ -948,6 +978,8 @@ def service_snapshot() -> dict:
         "tenant_evictions": _labeled_counter_total(service_tenant_evictions),
         "remote_planner_fallback": _counter_value(remote_planner_fallback),
         "remote_planner_failover": _counter_value(remote_planner_failover),
+        "wire_connection_reuse": _counter_value(remote_wire_connection_reuse),
+        "wire_reconnects": _counter_value(remote_wire_reconnects),
         "device_sick": device_sick,
         "delta_requests": delta_by_outcome,
         "wire_ingest_bytes": _counter_value(service_wire_ingest_bytes),

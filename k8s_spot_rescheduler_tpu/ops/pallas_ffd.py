@@ -32,6 +32,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from k8s_spot_rescheduler_tpu.models.tensors import PackedCluster
+from k8s_spot_rescheduler_tpu.solver.carry import (
+    CarryLayout,
+    NARROW_LAYOUT,
+    plane_bytes,
+)
 from k8s_spot_rescheduler_tpu.solver.result import SolveResult
 
 _BIG = 2**30  # python int: jnp constants would be captured by the kernel
@@ -54,6 +59,27 @@ def needs_scan_fallback(C: int, S: int, R: int, A: int) -> bool:
     the caller then chunks the spot axis (first-fit) or uses the HBM
     scan solver (best-fit; same semantics)."""
     return _footprint_per_spot(C, R, A) * S > _VMEM_BUDGET
+
+
+def _stream_footprint_per_spot(
+    C: int, R: int, A: int, layout: CarryLayout
+) -> int:
+    """Per-spot-column VMEM bytes of one lane block of the FUSED
+    best-fit stream kernel: the narrow delta carry planes
+    (solver/carry.plane_bytes — layout.used x R + layout.count +
+    layout.aff x A per lane, delta-form so no static copies) plus ~6
+    live 32-bit temporaries (fit / widened free / widened count / slack
+    / masked iota / onehot)."""
+    return min(LANE_BLOCK, C) * (plane_bytes(layout, R, A) + 4 * 6)
+
+
+def needs_stream_fallback(
+    C: int, S: int, R: int, A: int, layout: CarryLayout
+) -> bool:
+    """True when the fused stream kernel's narrow resident carry would
+    not fit VMEM; the caller then runs the XLA carry-streamed scan
+    (solver/ffd.plan_ffd_streamed, best_fit) — same semantics."""
+    return _stream_footprint_per_spot(C, R, A, layout) * S > _VMEM_BUDGET
 
 
 def _kernel(
@@ -162,6 +188,135 @@ def _kernel(
     feasible_ref[...] = feas[...]
 
 
+def _stream_kernel(
+    # inputs — identical layout to _kernel (K leading/untiled on slots)
+    slot_req_ref,  # f32 [K, R, Cb]
+    slot_valid_ref,  # i32 [K, 1, Cb]
+    slot_tol_ref,  # u32 [K, W, Cb]
+    slot_aff_ref,  # u32 [K, A, Cb]
+    cand_valid_ref,  # i32 [Cb, 1]
+    spot_free_ref,  # f32 [R, S]
+    spot_count_ref,  # i32 [1, S]
+    spot_maxp_ref,  # i32 [1, S]
+    spot_taints_ref,  # u32 [W, S]
+    spot_ok_ref,  # i32 [1, S]
+    spot_aff_ref,  # u32 [A, S]
+    # outputs
+    feasible_ref,  # i32 [Cb, 1]
+    chosen_ref,  # i32 [K, 1, Cb]
+    # scratch — the NARROW delta carry, resident across all K steps
+    used,  # layout.used [R, Cb, S] — capacity consumed
+    dcount,  # layout.count [Cb, S] — placements added
+    daff,  # layout.aff [A, Cb, S] — placed pods' aff bits
+    feas,  # i32 [Cb, 1]
+    *,
+    K: int,
+    R: int,
+    W: int,
+    A: int,
+):
+    """Fused elect-then-commit best-fit stream step (solver/ffd
+    ``_stream_bf_step``), one kernel for all K placements.
+
+    The XLA streamed best-fit path holds THREE stacked copies of the
+    chunk state per step (the scanned delta carry, the widened
+    absolutes, and the [Cb, S]-broadcast statics the wide ``_kernel``
+    materializes in scratch). Here the resident state is ONLY the
+    delta carry in the narrow ``CarryLayout`` dtypes: the statics stay
+    in their input refs and are widened against the deltas in
+    registers at each step (widen-on-read, exactly solver/ffd._widen),
+    then the elected placement narrows back on store.
+
+    Bit-identity argument: ``_stream_bf_step``'s per-chunk min/argmin
+    plus strict-< lexicographic (slack, chunk-order) election IS the
+    global first-minimum argmin over the full spot axis — so one fused
+    election over full S (min slack, then first index attaining it,
+    the ``_kernel`` best-fit idiom) reproduces the streamed scan's
+    placements for EVERY carry_chunks value, and plan_ffd(best_fit)'s,
+    and the host oracle's. Pinned by tests/test_pallas.py across
+    multiple chunk counts."""
+    Cb, S = dcount.shape
+
+    used[...] = jnp.zeros(used.shape, used.dtype)
+    dcount[...] = jnp.zeros(dcount.shape, dcount.dtype)
+    daff[...] = jnp.zeros(daff.shape, daff.dtype)
+    feas[...] = cand_valid_ref[...]
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (Cb, S), 1)
+
+    # dynamic trip count, exactly _kernel: slots past the last valid
+    # one are no-ops (place=0, feas factor 1), so stopping at kmax is
+    # bit-exact
+    valid_k = slot_valid_ref[...]  # i32 [K, 1, Cb]
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, valid_k.shape, 0)
+    kmax = jnp.max(jnp.where(valid_k != 0, iota_k + 1, 0))
+    chosen_ref[...] = jnp.full_like(chosen_ref[...], -1)
+
+    def body(k, _):
+        # widen-on-read: absolute views = statics (input refs, never
+        # copied to scratch) combined with the narrow deltas
+        fit = jnp.broadcast_to(spot_ok_ref[0][None, :], (Cb, S)) != 0
+        free_0 = None
+        req_0 = None
+        for r in range(R):
+            req_r = slot_req_ref[k, r][:, None]  # [Cb, 1]
+            free_r = jnp.broadcast_to(
+                spot_free_ref[r][None, :], (Cb, S)
+            ) - used[r].astype(jnp.float32)
+            fit &= free_r >= req_r
+            if r == 0:
+                free_0, req_0 = free_r, req_r
+        count_w = jnp.broadcast_to(
+            spot_count_ref[0][None, :], (Cb, S)
+        ) + dcount[...].astype(jnp.int32)
+        fit &= count_w < jnp.broadcast_to(spot_maxp_ref[0][None, :], (Cb, S))
+        for w in range(W):
+            tol_w = slot_tol_ref[k, w][:, None].astype(jnp.uint32)
+            taints_w = jnp.broadcast_to(
+                spot_taints_ref[w][None, :], (Cb, S)
+            ).astype(jnp.uint32)
+            fit &= (taints_w & ~tol_w) == 0
+        for a in range(A):
+            aff_a = slot_aff_ref[k, a][:, None].astype(jnp.uint32)
+            aff_w = jnp.broadcast_to(
+                spot_aff_ref[a][None, :], (Cb, S)
+            ) | daff[a].astype(jnp.uint32)
+            fit &= (aff_w & aff_a) == 0
+
+        # elect: tightest primary-resource fit; slack values are
+        # integral in f32, so the equality re-scan is exact and the
+        # first index attaining the min == the global argmin (ties ->
+        # probe order, the _stream_bf_step strict-< election)
+        slack = jnp.where(fit, free_0 - req_0, jnp.float32(3e38))
+        min_slack = jnp.min(slack, axis=1, keepdims=True)
+        masked = jnp.where(fit & (slack == min_slack), iota, _BIG)
+        first = jnp.min(masked, axis=1, keepdims=True)  # i32 [Cb, 1]
+        anyfit_i = jnp.where(first < _BIG, 1, 0)  # i32 [Cb, 1]
+        valid_i = slot_valid_ref[k, 0][:, None]  # i32 [Cb, 1]
+        place_i = valid_i * anyfit_i  # i32 [Cb, 1]
+        place_s = jnp.broadcast_to(place_i, (Cb, S)) != 0
+
+        # commit: narrow-on-store, exactly the solver/ffd._scan_step
+        # delta updates (casts are exact within the layout guard)
+        onehot = (iota == first) & place_s  # [Cb, S]
+        for r in range(R):
+            req_r = slot_req_ref[k, r][:, None]
+            used[r] = used[r] + (onehot * req_r).astype(used.dtype)
+        dcount[...] = dcount[...] + onehot.astype(dcount.dtype)
+        for a in range(A):
+            aff_a = slot_aff_ref[k, a][:, None].astype(jnp.uint32)
+            daff[a] = daff[a] | jnp.where(
+                onehot, aff_a, jnp.uint32(0)
+            ).astype(daff.dtype)
+
+        feas[...] = feas[...] * jnp.maximum(anyfit_i, 1 - valid_i)
+        chosen_ref[k] = jnp.where(place_i != 0, first, -1).reshape(1, Cb)
+        return 0
+
+    jax.lax.fori_loop(0, kmax, body, 0)
+    feasible_ref[...] = feas[...]
+
+
 def plan_ffd_pallas(
     packed: PackedCluster,
     interpret: bool | None = None,
@@ -235,10 +390,16 @@ def _plan_ffd_chunked(packed: PackedCluster, interpret: bool) -> SolveResult:
 
 
 def _invoke_kernel(
-    packed: PackedCluster, interpret: bool, best_fit: bool
+    packed: PackedCluster,
+    interpret: bool,
+    best_fit: bool,
+    stream_layout: CarryLayout | None = None,
 ):
     """One kernel invocation; returns (feasible [C0] bool, chosen [C0, K]
-    i32 with -1 for unplaced slots, UNmasked by lane feasibility)."""
+    i32 with -1 for unplaced slots, UNmasked by lane feasibility).
+    ``stream_layout`` selects the fused best-fit stream kernel
+    (``_stream_kernel``) with its scratch carry in the layout's narrow
+    dtypes; the input/output plumbing is shared."""
     slot_req = jnp.asarray(packed.slot_req, jnp.float32)
     C0, K, R = slot_req.shape
     S = packed.spot_free.shape[0]
@@ -262,7 +423,16 @@ def _invoke_kernel(
         return jnp.pad(arr, widths)
 
     grid = (C // Cb,)
-    kernel = functools.partial(_kernel, K=K, R=R, W=W, A=A, best_fit=best_fit)
+    if stream_layout is None:
+        kernel = functools.partial(
+            _kernel, K=K, R=R, W=W, A=A, best_fit=best_fit
+        )
+        dt_used, dt_count, dt_aff = jnp.float32, jnp.int32, jnp.uint32
+    else:
+        kernel = functools.partial(_stream_kernel, K=K, R=R, W=W, A=A)
+        dt_used = jnp.dtype(stream_layout.used)
+        dt_count = jnp.dtype(stream_layout.count)
+        dt_aff = jnp.dtype(stream_layout.aff)
 
     out_shape = (
         jax.ShapeDtypeStruct((C, 1), jnp.int32),  # feasible
@@ -286,9 +456,9 @@ def _invoke_kernel(
         pl.BlockSpec((K, 1, Cb), lambda i: (0, 0, i)),
     )
     scratch_shapes = [
-        pltpu.VMEM((R, Cb, S), jnp.float32),
-        pltpu.VMEM((Cb, S), jnp.int32),
-        pltpu.VMEM((A, Cb, S), jnp.uint32),
+        pltpu.VMEM((R, Cb, S), dt_used),
+        pltpu.VMEM((Cb, S), dt_count),
+        pltpu.VMEM((A, Cb, S), dt_aff),
         pltpu.VMEM((Cb, 1), jnp.int32),
     ]
 
@@ -323,6 +493,53 @@ plan_ffd_pallas_jit = jax.jit(
 )
 
 
+def plan_stream_bf_pallas(
+    packed: PackedCluster,
+    *,
+    carry_chunks: int = 2,
+    layout: CarryLayout = NARROW_LAYOUT,
+    interpret: bool | None = None,
+) -> SolveResult:
+    """Fused best-fit stream solve: the Pallas twin of
+    ``solver/ffd.plan_ffd_streamed(best_fit=True)`` (same contract,
+    bit-identical results at every ``carry_chunks``).
+
+    The XLA streamed path elects per chunk and commits via a second
+    ``lax.map`` over the stacked state — three copies of the chunk
+    state live per step. The kernel fuses elect-then-commit with ONLY
+    the narrow delta carry resident in VMEM (statics widened from
+    their input refs in registers), so HBM sees the spot pool once in
+    and the selections once out. ``carry_chunks`` does not change the
+    result (the chunked election is provably the global argmin); it
+    sizes the XLA fallback taken when the carry exceeds the VMEM
+    budget (``needs_stream_fallback``)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    C0, K, R = packed.slot_req.shape
+    S = packed.spot_free.shape[0]
+    A = packed.spot_aff.shape[1]
+
+    if needs_stream_fallback(C0, S, R, A, layout):
+        from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_streamed
+
+        return plan_ffd_streamed(
+            packed, carry_chunks=carry_chunks, layout=layout, best_fit=True
+        )
+
+    feasible, chosen = _invoke_kernel(
+        packed, interpret, best_fit=True, stream_layout=layout
+    )
+    assignment = jnp.where(feasible[:, None], chosen, -1)
+    return SolveResult(feasible=feasible, assignment=assignment)
+
+
+plan_stream_bf_pallas_jit = jax.jit(
+    plan_stream_bf_pallas,
+    static_argnames=("carry_chunks", "layout", "interpret"),
+)
+
+
 # Jaxpr-tier audit manifest (k8s_spot_rescheduler_tpu/hot_programs.py,
 # tools/analysis/jaxpr). pallas_call traces abstractly on CPU — the
 # kernel body's dtype/width properties are proven without a TPU.
@@ -338,5 +555,16 @@ HOT_PROGRAMS = {
             (packed_struct(s),),
         ),
         covers=("ops.pallas_ffd:plan_ffd_pallas",),
+    ),
+    # the fused best-fit stream kernel behind the pallas carry-streamed
+    # union; at MAX_SHAPES the VMEM guard routes the trace through the
+    # XLA streamed fallback — the jaxpr auditor then proves the exact
+    # program the dispatch would run at that scale
+    "pallas.stream_best_fit": HotProgram(
+        build=lambda s: (
+            functools.partial(plan_stream_bf_pallas, interpret=True),
+            (packed_struct(s),),
+        ),
+        covers=("ops.pallas_ffd:plan_stream_bf_pallas",),
     ),
 }

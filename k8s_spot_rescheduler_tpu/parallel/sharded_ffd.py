@@ -236,6 +236,7 @@ def plan_union_cand_sharded(
     repair_spot_chunks: int = 1,
     carry_chunks: int = 0,
     carry_layout=None,
+    use_pallas: bool = False,
 ) -> SolveResult:
     """Candidate-ONLY sharding: each device holds a block of candidate
     lanes with the FULL spot axis replicated, and runs the complete
@@ -258,8 +259,10 @@ def plan_union_cand_sharded(
     carries under ``carry_layout`` (solver/carry.carry_layout of the
     pack; NARROW_LAYOUT when None) with the spot axis streamed — repair
     stays live past even the fully-chunked wide ceiling, bit-identical
-    results throughout. ``mesh`` is the 1-D all-device mesh
-    of ``parallel/mesh.make_cand_mesh``."""
+    results throughout. ``use_pallas`` swaps the streamed union's
+    best-fit pass for the fused Pallas stream kernel (bit-identical;
+    ops/pallas_ffd.plan_stream_bf_pallas). ``mesh`` is the 1-D
+    all-device mesh of ``parallel/mesh.make_cand_mesh``."""
     from k8s_spot_rescheduler_tpu.solver.fallback import union_program
 
     solve = union_program(
@@ -268,6 +271,7 @@ def plan_union_cand_sharded(
         repair_spot_chunks=repair_spot_chunks,
         carry_chunks=carry_chunks,
         carry_layout=carry_layout,
+        use_pallas=use_pallas,
     )
     C = packed.slot_req.shape[0]
     packed = _pad_axes(

@@ -357,9 +357,13 @@ class SolverPlanner:
         block — first-fit spot-streamed with leftovers flowing forward,
         best-fit and the repair rounds on the stacked narrow state —
         bit-identical to the single-chip union, resident carries ~2x
-        smaller and per-round temporaries O(S / carry_chunks). One
-        fused planner per (chunk count, layout) — both are compile-time
-        decisions, stable across ticks at the high-water pads."""
+        smaller and per-round temporaries O(S / carry_chunks). With the
+        ``pallas`` solver the best-fit pass runs the fused
+        elect-then-commit stream kernel instead of the XLA scan
+        (ops/pallas_ffd.plan_stream_bf_pallas, bit-identical — the
+        narrow carry stays resident in VMEM). One fused planner per
+        (chunk count, layout) — both are compile-time decisions, stable
+        across ticks at the high-water pads."""
         if self._fused_carry is None:
             self._fused_carry = {}
         key = (carry_chunks, layout)
@@ -384,6 +388,7 @@ class SolverPlanner:
                     best_fit_fallback=cfg.fallback_best_fit,
                     carry_chunks=carry_chunks,
                     carry_layout=layout,
+                    use_pallas=(cfg.solver == "pallas"),
                 )
             )
         return self._fused_carry[key]

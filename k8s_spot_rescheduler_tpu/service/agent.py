@@ -46,11 +46,14 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import http.client
+import socket
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -101,6 +104,344 @@ class _Endpoint:
 Endpoint = _Endpoint
 
 
+# longest HTTP status/header line the pooled reader accepts (matches
+# http.client's own _MAXLINE discipline)
+_MAX_LINE = 65536
+
+
+class _WireSocket:
+    """One persistent keep-alive connection to a planner endpoint, with
+    HTTP/1.1 request pipelining.
+
+    Writes are serialized under a send lock and each request takes a
+    FIFO *ticket*; replies are read strictly in ticket order (the
+    HTTP/1.1 pipelining contract), so a second request — the overlapped
+    metrics-pass upload, a concurrent direct caller — can go on the
+    wire while the first reply is still in flight instead of opening a
+    second socket. One buffered reader lives for the connection's whole
+    life: response parsing can never strand the next reply's bytes in
+    a discarded per-response buffer.
+
+    Any send/parse failure marks the connection ``broken``; the pool
+    discards it and the transport's stale-retry contract decides
+    whether the failure counts (see :class:`PooledWireTransport`)."""
+
+    def __init__(self, host: str, port: int, timeout: float,
+                 tls: bool = False):
+        t0 = time.perf_counter()
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        if tls:
+            import ssl
+
+            self.sock = ssl.create_default_context().wrap_socket(
+                self.sock, server_hostname=host
+            )
+        self.connect_ms = (time.perf_counter() - t0) * 1e3
+        with contextlib.suppress(OSError):
+            self.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+        self.rfile = self.sock.makefile("rb")
+        self.requests = 0  # requests ever sent on this connection
+        self.broken = False
+        self._send_lock = threading.Lock()
+        self._read_cond = threading.Condition()
+        self._next_ticket = 0
+        self._next_read = 0
+
+    @property
+    def idle(self) -> bool:
+        """No reply in flight (every sent request has been read)."""
+        return self._next_ticket == self._next_read
+
+    def send(self, data: bytes, timeout: float) -> Tuple[int, bool]:
+        """Write one request; returns ``(ticket, reused)`` where
+        ``reused`` is True when this connection had already served
+        traffic (the reuse-vs-fresh distinction the stale-retry
+        contract and the reuse counter both key on)."""
+        with self._send_lock:
+            if self.broken:
+                raise ConnectionError(
+                    "pooled connection already marked broken"
+                )
+            reused = self.requests > 0
+            self.requests += 1
+            self.sock.settimeout(max(0.05, timeout))
+            try:
+                self.sock.sendall(data)
+            except BaseException:
+                self.broken = True
+                raise
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            return ticket, reused
+
+    def read(self, ticket: int, deadline: float):
+        """Read the reply for ``ticket`` (FIFO pipeline order); returns
+        ``(status, headers, body, keep_alive)``."""
+        with self._read_cond:
+            while self._next_read != ticket:
+                if self.broken:
+                    raise ConnectionError(
+                        "pooled connection broke ahead in the pipeline"
+                    )
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    self.broken = True
+                    self._read_cond.notify_all()
+                    raise TimeoutError(
+                        "pipelined reply timed out behind earlier "
+                        "requests"
+                    )
+                self._read_cond.wait(min(remaining, 0.05))
+            if self.broken:
+                raise ConnectionError(
+                    "pooled connection broke ahead in the pipeline"
+                )
+            try:
+                return self._read_response(deadline)
+            except BaseException:
+                self.broken = True
+                raise
+            finally:
+                self._next_read += 1
+                self._read_cond.notify_all()
+
+    def _read_response(self, deadline: float):
+        self.sock.settimeout(max(0.05, deadline - time.perf_counter()))
+        status_line = self.rfile.readline(_MAX_LINE + 1)
+        if not status_line:
+            # EOF before any reply byte: the server closed this
+            # keep-alive connection while it sat idle — THE stale
+            # half-closed case the retry-once contract exists for
+            raise ConnectionError(
+                "server closed the keep-alive connection"
+            )
+        try:
+            version, code_raw = status_line.split(None, 2)[:2]
+            code = int(code_raw)
+        except (ValueError, IndexError) as err:
+            raise ConnectionError(
+                f"malformed HTTP status line {status_line[:64]!r}"
+            ) from err
+        headers = http.client.parse_headers(self.rfile)
+        try:
+            length = int(headers.get("Content-Length", 0) or 0)
+        except (TypeError, ValueError):
+            length = 0
+        body = self.rfile.read(length) if length > 0 else b""
+        if length > 0 and len(body) < length:
+            raise ConnectionError(
+                "keep-alive reply truncated mid-body"
+            )
+        conn_hdr = (headers.get("Connection") or "").lower()
+        keep = version.startswith(b"HTTP/1.1") and "close" not in conn_hdr
+        return code, headers, body, keep
+
+    def close(self) -> None:
+        with self._read_cond:
+            self.broken = True
+            self._read_cond.notify_all()
+        with contextlib.suppress(Exception):
+            self.rfile.close()
+        with contextlib.suppress(Exception):
+            self.sock.close()
+
+
+class PooledWireTransport:
+    """The default agent transport: a persistent keep-alive connection
+    pool behind the ``RemotePlanner.transport`` seam (same callable
+    shape ``(url, body, headers, timeout) -> bytes``).
+
+    - **One connection per endpoint**, reused across ticks AND across
+      the failover ladder: a breaker-expiry failback to the primary
+      rides the primary's still-pooled socket, and
+      ``MAX_CONNS_PER_ENDPOINT`` bounds the pool by construction —
+      concurrent requests share the endpoint's connection via HTTP/1.1
+      pipelining (:class:`_WireSocket`) instead of fanning out sockets.
+    - **Stale-retry contract** (docs/ROBUSTNESS.md): a send/parse
+      failure on a connection that had already served traffic —
+      server restart, idle-timeout close, LB reset between ticks — is
+      retried exactly ONCE on a fresh connection
+      (``remote_wire_reconnects_total``) before it propagates as an
+      endpoint failure. Failures on a *fresh* connection, and genuine
+      deadline timeouts, propagate immediately (retrying a timeout
+      would double the stall).
+    - **Accounting**: reuses feed ``remote_wire_connection_reuse_total``;
+      a fresh connect's handshake time is handed to the caller's
+      thread via :meth:`take_last_call` and grafted as the
+      ``wire.connect`` span under ``wire.request`` — socket economics
+      are visible per tick, not just in aggregate.
+
+    Thread-safe; trace mutation stays on the caller (RemotePlanner
+    reads ``take_last_call`` on the worker thread into the box and
+    grafts on the finish thread, the same single-threaded-trace
+    discipline as the rest of the wire accounting)."""
+
+    # hard per-endpoint connection bound: requests PIPELINE rather than
+    # fan out, so one socket per endpoint is the steady state and the
+    # ceiling (tests/test_wire_pool.py hammers this)
+    MAX_CONNS_PER_ENDPOINT = 1
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns: Dict[Tuple[str, int, bool], _WireSocket] = {}
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _endpoint(url: str) -> Tuple[Tuple[str, int, bool], str, str]:
+        parsed = urllib.parse.urlsplit(url)
+        tls = parsed.scheme == "https"
+        host = parsed.hostname or "localhost"
+        port = parsed.port or (443 if tls else 80)
+        path = parsed.path or "/"
+        if parsed.query:
+            path = f"{path}?{parsed.query}"
+        return (host, port, tls), host, path
+
+    @staticmethod
+    def _request_bytes(
+        host: str, port: int, path: str, body: bytes, headers: dict
+    ) -> bytes:
+        lines = [
+            f"POST {path} HTTP/1.1",
+            f"Host: {host}:{port}",
+            f"Content-Length: {len(body)}",
+            "Connection: keep-alive",
+        ]
+        lines.extend(f"{k}: {v}" for k, v in headers.items())
+        return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + body
+
+    def _checkout(self, key, timeout: float) -> _WireSocket:
+        """The endpoint's pooled connection, or a fresh one when none
+        is live. The pool holds at most MAX_CONNS_PER_ENDPOINT (=1)
+        connection per endpoint — ever."""
+        with self._lock:
+            conn = self._conns.get(key)
+            if conn is not None and not conn.broken:
+                return conn
+            if conn is not None:
+                conn.close()
+            conn = _WireSocket(key[0], key[1], timeout, tls=key[2])
+            self._conns[key] = conn
+            return conn
+
+    def _discard(self, key, conn: _WireSocket) -> None:
+        with self._lock:
+            if self._conns.get(key) is conn:
+                del self._conns[key]
+        conn.close()
+
+    # ------------------------------------------------------------------
+
+    def __call__(
+        self, url: str, body: bytes, headers: dict, timeout: float
+    ) -> bytes:
+        key, host, path = self._endpoint(url)
+        data = self._request_bytes(host, key[1], path, body, headers)
+        deadline = time.perf_counter() + timeout
+        info = {"connect_ms": 0.0, "reused": False, "reconnected": False}
+        self._tls.last_call = info
+        for attempt in (0, 1):
+            budget = max(0.05, deadline - time.perf_counter())
+            conn = self._checkout(key, budget)
+            try:
+                ticket, reused = conn.send(data, budget)
+                code, hdrs, payload, keep = conn.read(ticket, deadline)
+            except TimeoutError:
+                # a genuine deadline timeout is not staleness: retrying
+                # would stall the tick twice. The ladder owns it.
+                self._discard(key, conn)
+                raise
+            except (ConnectionError, OSError):
+                self._discard(key, conn)
+                if conn.requests > 1 and attempt == 0:
+                    # the stale-socket contract: a connection that had
+                    # already served traffic may have been half-closed
+                    # between ticks — ONE transparent retry on a fresh
+                    # socket before this counts as an endpoint failure
+                    metrics.update_remote_wire_reconnect()
+                    info["reconnected"] = True
+                    continue
+                raise
+            if not reused:
+                info["connect_ms"] = conn.connect_ms
+            info["reused"] = reused
+            if reused:
+                metrics.update_remote_wire_reuse()
+            if not keep:
+                # the server said close (drain-refuse, pre-body reject,
+                # HTTP/1.0 peer): honor it — never pool a socket whose
+                # next reply would desync
+                self._discard(key, conn)
+            if code != 200:
+                retry_after = 0.0
+                if code == 503:
+                    try:
+                        retry_after = float(hdrs.get("Retry-After", 0))
+                    except (TypeError, ValueError):
+                        retry_after = 0.0
+                detail = ""
+                try:
+                    wire.decode_plan_reply(payload)
+                except wire.WireError as werr:
+                    detail = str(werr)
+                raise RemoteCallError(
+                    f"HTTP {code}{': ' + detail if detail else ''}",
+                    retry_after,
+                )
+            return payload
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    # ------------------------------------------------------------------
+    # caller-facing accounting + lifecycle
+
+    def take_last_call(self) -> Optional[dict]:
+        """Pop this thread's last call's connection accounting
+        (``connect_ms``/``reused``/``reconnected``), or None when no
+        pooled call happened on this thread since the last take."""
+        info = getattr(self._tls, "last_call", None)
+        self._tls.last_call = None
+        return info
+
+    def connection_count(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+    def connection_for(self, url: str) -> Optional[_WireSocket]:
+        """The live pooled connection for ``url``'s endpoint (tests:
+        socket-identity assertions across failover return)."""
+        key, _, _ = self._endpoint(url)
+        with self._lock:
+            return self._conns.get(key)
+
+    def break_idle(self) -> int:
+        """OS-level half-close of every pooled connection with no reply
+        in flight, LEAVING it in the pool — exactly what a server-side
+        idle-timeout close between ticks looks like to the agent. The
+        chaos half-closed-socket fault (service/chaos.py) calls this;
+        the next request must discover the stale socket and retry once
+        on a fresh one. Returns the number of connections broken."""
+        with self._lock:
+            conns = list(self._conns.values())
+        broken = 0
+        for conn in conns:
+            if conn.idle and not conn.broken:
+                with contextlib.suppress(OSError):
+                    conn.sock.shutdown(socket.SHUT_RDWR)
+                broken += 1
+        return broken
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            conn.close()
+
+
 class RemotePlanner:
     """Planner over a remote multi-tenant planner service (or an
     ordered failover list of its replicas)."""
@@ -142,7 +483,11 @@ class RemotePlanner:
         self.clock = clock or RealClock()
         # seam: (url, body, headers, timeout) -> reply bytes; raises
         # RemoteCallError for HTTP errors. service/chaos.py wraps it.
-        self.transport = self._transport_urllib
+        # Default = the persistent keep-alive pool; _transport_urllib
+        # stays as the fresh-connection-per-request baseline (bench.py
+        # serve_smoke measures the pool's win against it in-run).
+        self._wire_pool = PooledWireTransport()
+        self.transport = self._wire_pool
         if config.service_chaos_profile not in ("", "off", "none"):
             from k8s_spot_rescheduler_tpu.service.chaos import (
                 ChaosAgentTransport,
@@ -161,6 +506,7 @@ class RemotePlanner:
                     config.service_chaos_seed,
                 ),
                 clock=self.clock,
+                pool=self._wire_pool,
             )
         self._pad_c = 0
         self._pad_s = 0
@@ -342,11 +688,25 @@ class RemotePlanner:
                 continue
             use_delta = delta_body is not None and ep.acked_fp == base_fp
             t_ep = time.perf_counter()
-            try:
+
+            def _call(payload: bytes, budget: float) -> bytes:
+                # one transport invocation + the pool's per-call socket
+                # accounting (connect time, reuse, stale reconnects)
+                # copied into the box on THIS worker thread; the finish
+                # thread grafts it (traces are single-threaded)
                 raw = self.transport(
-                    f"{ep.url}{path}",
+                    f"{ep.url}{path}", payload, headers, budget
+                )
+                pool = self._wire_pool
+                if pool is not None:
+                    conn_info = pool.take_last_call()
+                    if conn_info is not None:
+                        box["wire_conn"] = conn_info
+                return raw
+
+            try:
+                raw = _call(
                     delta_body if use_delta else body,
-                    headers,
                     max(0.05, remaining),
                 )
                 reply = (
@@ -364,10 +724,7 @@ class RemotePlanner:
                         "resync: %s", ep.url, reply.cause,
                     )
                     remaining = deadline - time.perf_counter()
-                    raw = self.transport(
-                        f"{ep.url}{path}", body, headers,
-                        max(0.05, remaining),
-                    )
+                    raw = _call(body, max(0.05, remaining))
                     reply = decode(raw)
             except RemoteCallError as err:
                 self._note_failure(ep, str(err), err.retry_after)
@@ -438,9 +795,27 @@ class RemotePlanner:
             # wire itself — tunnel, TLS, serialization on the path
             rtt_ms = max(0.0, (box["t_recv"] - box["t_send"]) * 1e3)
             server_ms = sum(d for _, _, d in spans)
+            children = list(spans)
+            conn_info = box.get("wire_conn")
+            if conn_info is not None:
+                attrs = dict(attrs or {})
+                attrs["wire_reused"] = bool(conn_info.get("reused"))
+                if conn_info.get("reconnected"):
+                    attrs["wire_reconnected"] = True
+                if conn_info.get("connect_ms"):
+                    # a fresh TCP connect happened inside this round
+                    # trip (first tick, failback, stale replacement);
+                    # on a reused socket the span is absent — its
+                    # absence IS the sub-RTT win
+                    children.append(
+                        tracing.make_span(
+                            "wire.connect", 0.0,
+                            float(conn_info["connect_ms"]),
+                        )
+                    )
             trace.graft(
                 tracing.make_span("wire.request", 0.0, rtt_ms),
-                children=spans,
+                children=children,
                 attrs=attrs,
             )
             trace.graft(

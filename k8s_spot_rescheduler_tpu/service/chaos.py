@@ -62,6 +62,13 @@ class ServiceFaultPlan:
     - ``http_503_script`` — 1-based request indices answered with a 503
       + ``http_503_retry_after`` (a scripted shed storm).
     - ``http_5xx_rate`` — probability of a plain 500.
+    - ``half_close_script`` — 1-based request indices BEFORE which every
+      idle pooled keep-alive connection is half-closed at the OS level
+      (``PooledWireTransport.break_idle``) — the server-restarts/
+      idle-timeout-between-ticks case. The agent must retry ONCE on a
+      fresh socket (``remote_wire_reconnects_total``) with ZERO
+      fallback/failover counted; needs the transport pool handed to
+      :class:`ChaosAgentTransport` (no-op otherwise).
 
     Server-side (PlannerService hook) knobs:
 
@@ -85,6 +92,7 @@ class ServiceFaultPlan:
     http_503_script: Tuple[int, ...] = ()
     http_503_retry_after: float = 2.0
     http_5xx_rate: float = 0.0
+    half_close_script: Tuple[int, ...] = ()
     # server side
     solve_error_script: Tuple[int, ...] = ()
     sick_phase: Tuple[float, ...] = ()
@@ -126,10 +134,18 @@ class ChaosAgentTransport:
     per the plan before/after the wrapped transport runs. ``enabled``
     quiesces every fault at once (scripted counters keep their state)."""
 
-    def __init__(self, inner, plan: ServiceFaultPlan, *, clock=None):
+    def __init__(self, inner, plan: ServiceFaultPlan, *, clock=None,
+                 pool=None):
         self.inner = inner
         self.plan = plan
         self.clock = clock
+        # the agent's PooledWireTransport (or anything with a
+        # ``break_idle()``): the half-closed-keep-alive-socket fault
+        # needs to reach UNDER the transport callable and kill the
+        # pooled sockets at the OS level — a fault raised above the
+        # pool would exercise the failover ladder, not the stale-retry
+        # contract this fault exists to prove
+        self.pool = pool
         self.enabled = True
         self.rng = random.Random(plan.seed)
         self.stats: collections.Counter = collections.Counter()
@@ -144,6 +160,13 @@ class ChaosAgentTransport:
         n = self._requests
         plan = self.plan
         if self.enabled:
+            if n in plan.half_close_script and self.pool is not None:
+                # the server "restarted"/idle-timed-out between ticks:
+                # every idle pooled socket is half-closed under the
+                # transport's feet. NOT an injected exception — the
+                # request proceeds and the pool itself must discover
+                # the stale socket and retry once on a fresh one.
+                self.stats["half_close"] += self.pool.break_idle()
             if plan.slow_loris_rate and self.rng.random() < plan.slow_loris_rate:
                 # the upload crawls: the caller's whole deadline elapses
                 # (instant on a virtual clock), then the socket timeout

@@ -56,9 +56,11 @@ Fleet failure containment (docs/ROBUSTNESS.md "Fleet failure domains"):
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import os
+import socket
 import threading
 import time
 from collections import deque
@@ -1692,10 +1694,46 @@ class ServiceServer:
         # has no tick of its own)
         self._recent_lock = threading.Lock()
         self._recent: deque = deque(maxlen=32)
+        # live accepted sockets: with keep-alive a handler thread stays
+        # parked in readline() between requests, so closing the listener
+        # alone would leave pooled agent connections happily served by a
+        # "stopped" replica — close() must hard-close these too
+        self._conn_lock = threading.Lock()
+        self._open_conns: set = set()
         host, _, port = address.rpartition(":")
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive for the persistent agent wire (service/agent.py
+            # PooledWireTransport): HTTP/1.1 + the Content-Length
+            # discipline _send_bytes already enforces lets one socket
+            # carry every tick. The default HTTP/1.0 answered one
+            # request per connection — the per-tick TCP+HTTP setup tax
+            # the pool exists to amortize. Pre-body rejects still close
+            # (_reject_unread), and an idle connection is reaped after
+            # ``timeout`` so drained agents don't pin handler threads.
+            protocol_version = "HTTP/1.1"
+            timeout = 120.0
+            # on a keep-alive connection the reply goes out as two
+            # writes (buffered headers, then body): with Nagle on, the
+            # body segment sits behind the client's delayed ACK —
+            # a ~40ms stall per tick that dwarfs the round trip the
+            # pool exists to shrink. (A closing connection never showed
+            # it: the FIN flushed the tail.)
+            disable_nagle_algorithm = True
+
+            def setup(self):
+                super().setup()
+                with server._conn_lock:
+                    server._open_conns.add(self.connection)
+
+            def finish(self):
+                try:
+                    super().finish()
+                finally:
+                    with server._conn_lock:
+                        server._open_conns.discard(self.connection)
+
             def log_message(self, *a):
                 pass
 
@@ -2229,6 +2267,15 @@ class ServiceServer:
         if getattr(self, "_serving", False):
             self.server.shutdown()
         self.server.server_close()
+        # hard-close live keep-alive connections: their handler threads
+        # are parked in readline() waiting for the agent's next request
+        # and would keep answering a "closed" replica otherwise
+        with self._conn_lock:
+            conns = list(self._open_conns)
+            self._open_conns.clear()
+        for conn in conns:
+            with contextlib.suppress(OSError):
+                conn.shutdown(socket.SHUT_RDWR)
         self.service.stop_scheduler()
         self.service.save_state()
 

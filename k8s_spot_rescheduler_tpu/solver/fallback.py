@@ -117,6 +117,7 @@ def with_repair_streamed(
     layout,
     chain: bool = True,
     best_fit_fallback: bool = True,
+    use_pallas: bool = False,
 ):
     """The carry-streamed union (ROADMAP 5): first-fit with the spot
     axis STREAMED in ``carry_chunks`` ordered chunks (leftovers flow
@@ -130,10 +131,34 @@ def with_repair_streamed(
     ``planner/solver_planner._maybe_shard`` dispatches above the 2-D
     fallback: repair stays LIVE past the wide layouts' carry bound.
 
+    ``use_pallas`` swaps the best-fit pass's XLA elect-then-commit scan
+    for the fused Pallas stream kernel
+    (``ops/pallas_ffd.plan_stream_bf_pallas`` — bit-identical by the
+    chunk-election-is-global-argmin property, narrow carry resident in
+    VMEM); first-fit and repair are unchanged.
+
     Same cond discipline as ``with_repair``: best-fit and repair only
     execute when the pass before them left a valid lane unproven."""
     from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd_streamed
     from k8s_spot_rescheduler_tpu.solver.repair import plan_repair_chunked
+
+    if use_pallas:
+        from k8s_spot_rescheduler_tpu.ops.pallas_ffd import (
+            plan_stream_bf_pallas,
+        )
+
+        def bf_thunk(packed):
+            return plan_stream_bf_pallas(
+                packed, carry_chunks=carry_chunks, layout=layout
+            )
+    else:
+        def bf_thunk(packed):
+            return plan_ffd_streamed(
+                packed,
+                carry_chunks=carry_chunks,
+                layout=layout,
+                best_fit=True,
+            )
 
     def solve(packed) -> SolveResult:
         cand_valid = jnp.asarray(packed.cand_valid)
@@ -143,16 +168,7 @@ def with_repair_streamed(
         if not best_fit_fallback:
             return ff
         need_bf = jnp.any(cand_valid & ~ff.feasible)
-        bf = _cond_solve(
-            need_bf,
-            lambda: plan_ffd_streamed(
-                packed,
-                carry_chunks=carry_chunks,
-                layout=layout,
-                best_fit=True,
-            ),
-            ff,
-        )
+        bf = _cond_solve(need_bf, lambda: bf_thunk(packed), ff)
         greedy_feasible = ff.feasible | bf.feasible
         if rounds <= 0:
             assignment = jnp.where(
@@ -191,14 +207,16 @@ def union_program(
     repair_spot_chunks: int = 1,
     carry_chunks: int = 0,
     carry_layout=None,
+    use_pallas: bool = False,
 ):
     """THE union-composition ladder every dispatch site builds from —
     the cand-sharded block program (parallel/sharded_ffd) and the
     batched tenant program (parallel/tenant_batch) call this one
     helper, so their compositions can never drift. ``carry_chunks`` >=
     1 selects the carry-streamed narrow union (``carry_layout``
-    defaults to NARROW_LAYOUT); otherwise first-fit ∪ best-fit ∪
-    (spot-chunked) repair per the flags."""
+    defaults to NARROW_LAYOUT; ``use_pallas`` swaps its best-fit pass
+    for the fused Pallas stream kernel); otherwise first-fit ∪
+    best-fit ∪ (spot-chunked) repair per the flags."""
     from k8s_spot_rescheduler_tpu.solver.carry import NARROW_LAYOUT
     from k8s_spot_rescheduler_tpu.solver.ffd import plan_ffd
 
@@ -208,6 +226,7 @@ def union_program(
             carry_chunks,
             carry_layout if carry_layout is not None else NARROW_LAYOUT,
             best_fit_fallback=best_fit_fallback,
+            use_pallas=use_pallas,
         )
     if best_fit_fallback and rounds > 0:
         return with_repair(plan_ffd, rounds, spot_chunks=repair_spot_chunks)

@@ -73,6 +73,7 @@ SPAN_NAMES: Dict[str, str] = {
     # agent <-> service wire (service/agent.py)
     "wire.request": "full service round trip; server spans graft under it",
     "wire.transfer": "wire residual: round trip minus server-side spans",
+    "wire.connect": "TCP connect for a fresh pooled socket (absent on reuse)",
     "wire.failover": "one FAILED endpoint attempt before failing over",
     # service-side spans, returned compactly in the PlanReply and
     # grafted by the agent (service/server.py)

@@ -89,6 +89,135 @@ def test_chunked_first_fit_matches_oracle(monkeypatch):
         )
 
 
+def test_stream_bf_matches_every_chunk_count():
+    """The fused elect-then-commit stream kernel is bit-identical to
+    the XLA carry-streamed best-fit scan at EVERY chunk count (the
+    strict-< lexicographic chunk election IS the global first-min
+    argmin), to the unstreamed plan_ffd(best_fit=True), and to the
+    host oracle."""
+    from k8s_spot_rescheduler_tpu.ops.pallas_ffd import (
+        plan_stream_bf_pallas,
+    )
+    from k8s_spot_rescheduler_tpu.solver.ffd import (
+        carry_layout,
+        plan_ffd,
+        plan_ffd_streamed,
+    )
+    from tests.test_carry_stream import CHUNK_COUNTS
+
+    for seed in range(8):
+        packed = _random_packed(np.random.default_rng(seed))
+        lay = carry_layout(packed)
+        got = plan_stream_bf_pallas(packed, layout=lay, interpret=True)
+        for n in CHUNK_COUNTS:
+            want = plan_ffd_streamed(
+                packed, carry_chunks=n, layout=lay, best_fit=True
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.feasible), np.asarray(want.feasible),
+                err_msg=f"seed {seed} chunks {n}",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.assignment), np.asarray(want.assignment),
+                err_msg=f"seed {seed} chunks {n}",
+            )
+        flat = plan_ffd(packed, best_fit=True)
+        oracle = plan_oracle(packed, best_fit=True)
+        np.testing.assert_array_equal(
+            np.asarray(got.feasible), np.asarray(flat.feasible)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), np.asarray(flat.assignment)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.feasible), oracle.feasible
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), oracle.assignment
+        )
+
+
+def test_stream_bf_edge_cases():
+    """Handcrafted chunk-boundary packs (tests/test_carry_stream): the
+    kernel must reproduce the oracle where leftovers straddle chunk
+    splits and where ties must resolve to the earlier probe index."""
+    from k8s_spot_rescheduler_tpu.ops.pallas_ffd import (
+        plan_stream_bf_pallas,
+    )
+    from k8s_spot_rescheduler_tpu.solver.ffd import carry_layout
+    from tests.test_carry_stream import _edge_pack, _leftover_case
+
+    cases = [
+        _leftover_case(),
+        _edge_pack(100.0, 3, 100.0),
+        _edge_pack(1.0, 1, 3.0),
+    ]
+    for pods in ([500, 300, 100, 100, 100], [500, 400, 100, 100, 100]):
+        packed, _ = _pack_drain_case(_test_spot_pool(), pods)
+        cases.append(packed)
+    for i, packed in enumerate(cases):
+        got = plan_stream_bf_pallas(
+            packed, layout=carry_layout(packed), interpret=True
+        )
+        want = plan_oracle(packed, best_fit=True)
+        np.testing.assert_array_equal(
+            np.asarray(got.feasible), want.feasible, err_msg=f"case {i}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), want.assignment, err_msg=f"case {i}"
+        )
+
+
+def test_stream_bf_vmem_guard_falls_back(monkeypatch):
+    """Past the VMEM budget the stream solve must route to the XLA
+    carry-streamed scan (bit-identical), not the kernel."""
+    import k8s_spot_rescheduler_tpu.ops.pallas_ffd as pf
+    from k8s_spot_rescheduler_tpu.solver.ffd import carry_layout
+
+    packed = _random_packed(np.random.default_rng(11))
+    lay = carry_layout(packed)
+    want = plan_oracle(packed, best_fit=True)
+
+    monkeypatch.setattr(pf, "_VMEM_BUDGET", 1)
+    calls = []
+    real_invoke = pf._invoke_kernel
+    monkeypatch.setattr(
+        pf, "_invoke_kernel",
+        lambda *a, **kw: calls.append("kernel") or real_invoke(*a, **kw),
+    )
+    got = pf.plan_stream_bf_pallas(packed, layout=lay, interpret=True)
+    assert calls == []  # guard took the scan fallback, never the kernel
+    np.testing.assert_array_equal(np.asarray(got.feasible), want.feasible)
+    np.testing.assert_array_equal(
+        np.asarray(got.assignment), want.assignment
+    )
+
+
+def test_streamed_union_use_pallas_parity():
+    """The full streamed union (first-fit ∪ best-fit ∪ repair) with
+    ``use_pallas`` must match the XLA composition lane for lane — the
+    dispatch swap the ``pallas`` solver takes in
+    planner/solver_planner._carry_streamed_fused_planner."""
+    from k8s_spot_rescheduler_tpu.solver.fallback import (
+        with_repair_streamed,
+    )
+    from k8s_spot_rescheduler_tpu.solver.ffd import carry_layout
+
+    for seed in (0, 5, 9):
+        packed = _random_packed(np.random.default_rng(seed))
+        lay = carry_layout(packed)
+        xla = with_repair_streamed(2, 3, lay, use_pallas=False)(packed)
+        pls = with_repair_streamed(2, 3, lay, use_pallas=True)(packed)
+        np.testing.assert_array_equal(
+            np.asarray(pls.feasible), np.asarray(xla.feasible),
+            err_msg=f"seed {seed}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pls.assignment), np.asarray(xla.assignment),
+            err_msg=f"seed {seed}",
+        )
+
+
 def test_oversize_first_fit_routes_to_chunked(monkeypatch):
     """On TPU-sized problems past the VMEM budget, first-fit must take
     the chunked kernel path and best-fit the scan fallback."""

@@ -1,0 +1,356 @@
+"""Persistent pipelined wire transport tests (service/agent.py
+PooledWireTransport / _WireSocket): keep-alive semantics, pipelining,
+the stale-socket retry-once contract, pool bounds, and the chaos
+half-closed-socket fault. The strict reuse/latency acceptance runs as
+``make serve-smoke`` (bench.serve_smoke)."""
+
+import contextlib
+import http.client
+import socket
+import threading
+import time
+
+import pytest
+
+from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.service.agent import (
+    PooledWireTransport,
+    RemoteCallError,
+    RemotePlanner,
+)
+from k8s_spot_rescheduler_tpu.service.chaos import (
+    ChaosAgentTransport,
+    ServiceFaultPlan,
+)
+from k8s_spot_rescheduler_tpu.service.server import ServiceServer
+from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
+from tests.test_service import _observation
+
+
+class EchoServer:
+    """Minimal HTTP/1.1 keep-alive echo server: every accepted
+    connection is served on its own thread, replies strictly in request
+    order (the pipelining contract the pool relies on).
+    ``first_reply_delay_s`` stalls each connection's FIRST reply so a
+    pipelined second request can demonstrably queue behind it."""
+
+    def __init__(self, first_reply_delay_s: float = 0.0):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(16)
+        self.port = self.sock.getsockname()[1]
+        self.url = f"http://127.0.0.1:{self.port}/echo"
+        self.first_reply_delay_s = first_reply_delay_s
+        self.connections = 0
+        self.requests = 0
+        self._lock = threading.Lock()
+        self._closing = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with self._lock:
+                self.connections += 1
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        rfile = conn.makefile("rb")
+        served = 0
+        try:
+            while True:
+                line = rfile.readline(65536)
+                if not line or b"HTTP" not in line:
+                    return
+                headers = http.client.parse_headers(rfile)
+                body = rfile.read(int(headers.get("Content-Length", 0)))
+                with self._lock:
+                    self.requests += 1
+                if served == 0 and self.first_reply_delay_s:
+                    time.sleep(self.first_reply_delay_s)
+                served += 1
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: "
+                    + str(len(body)).encode()
+                    + b"\r\n\r\n"
+                    + body
+                )
+        except (OSError, ValueError):
+            return
+        finally:
+            with contextlib.suppress(Exception):
+                rfile.close()
+            with contextlib.suppress(Exception):
+                conn.close()
+
+    def close(self):
+        self.sock.close()
+
+
+def test_keep_alive_reuse_one_socket():
+    """N sequential requests to one endpoint ride ONE socket: N-1
+    reuses counted, one server-side accept, payloads intact."""
+    srv = EchoServer()
+    pool = PooledWireTransport()
+    before = metrics.service_snapshot()["wire_connection_reuse"]
+    try:
+        for i in range(10):
+            out = pool(srv.url, b"tick-%d" % i, {}, 5.0)
+            assert out == b"tick-%d" % i
+        assert pool.connection_count() == 1
+        assert srv.connections == 1
+        assert srv.requests == 10
+        after = metrics.service_snapshot()["wire_connection_reuse"]
+        assert after - before == 9
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_pipelined_second_request_queues_behind_first():
+    """A second request issued while the first reply is still in
+    flight goes onto the SAME socket (ticketed pipelining), not a
+    second connection — and both replies come back to their callers."""
+    srv = EchoServer(first_reply_delay_s=0.8)
+    pool = PooledWireTransport()
+    results = {}
+
+    def call(name):
+        results[name] = pool(srv.url, name.encode(), {}, 5.0)
+
+    try:
+        t1 = threading.Thread(target=call, args=("one",))
+        t1.start()
+        # wait until the first request is ON the wire (server saw it;
+        # its reply is now stalled by first_reply_delay_s)
+        deadline = time.monotonic() + 2.0
+        while srv.requests < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.requests == 1
+        conn = pool.connection_for(srv.url)
+        assert conn is not None
+        t2 = threading.Thread(target=call, args=("two",))
+        t2.start()
+        # the second request must go out on the SAME pooled socket
+        # while reply #1 is still stalled server-side — watch the
+        # connection's send counter, not the server's (the server
+        # reads a connection's requests sequentially)
+        deadline = time.monotonic() + 2.0
+        while conn.requests < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert conn.requests == 2, "second request did not pipeline"
+        assert srv.connections == 1  # no second socket fanned out
+        assert not conn.idle  # both replies still in flight
+        t1.join(5.0)
+        t2.join(5.0)
+        assert results == {"one": b"one", "two": b"two"}
+        assert srv.connections == 1
+        assert srv.requests == 2
+        assert pool.connection_count() == 1
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_pool_bounded_under_concurrent_hammering():
+    """MAX_CONNS_PER_ENDPOINT (=1) holds under concurrency: 6 threads
+    x 5 requests share one socket; every payload returns intact."""
+    srv = EchoServer()
+    pool = PooledWireTransport()
+    errors = []
+
+    def hammer(t):
+        for i in range(5):
+            payload = b"t%d-%d" % (t, i)
+            try:
+                if pool(srv.url, payload, {}, 5.0) != payload:
+                    errors.append((t, i, "payload mismatch"))
+            except Exception as err:  # noqa: BLE001 — collected
+                errors.append((t, i, repr(err)))
+
+    try:
+        threads = [
+            threading.Thread(target=hammer, args=(t,)) for t in range(6)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(10.0)
+        assert errors == []
+        assert pool.connection_count() == 1
+        assert srv.connections == 1
+        assert srv.requests == 30
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_stale_socket_retries_once_on_fresh_connection():
+    """The stale-retry contract: a pooled socket half-closed while idle
+    (server restart / idle timeout between ticks) is discovered on the
+    next request and retried exactly ONCE on a fresh socket —
+    transparently (the caller sees a normal reply), counted in
+    remote_wire_reconnects_total."""
+    srv = EchoServer()
+    pool = PooledWireTransport()
+    before = metrics.service_snapshot()["wire_reconnects"]
+    try:
+        assert pool(srv.url, b"warm", {}, 5.0) == b"warm"
+        assert pool.break_idle() == 1  # OS half-close, left pooled
+        out = pool(srv.url, b"after-break", {}, 5.0)
+        assert out == b"after-break"
+        after = metrics.service_snapshot()["wire_reconnects"]
+        assert after - before == 1
+        # the retry ran on a FRESH socket (second server-side accept)
+        assert srv.connections == 2
+        conn = pool.connection_for(srv.url)
+        assert conn is not None and conn.requests == 1
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_fresh_connection_failure_propagates_immediately():
+    """Failures on a connection that never served traffic are NOT
+    retried (nothing was stale — the endpoint is down): they propagate
+    to the ladder as an endpoint failure at once."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    pool = PooledWireTransport()
+    before = metrics.service_snapshot()["wire_reconnects"]
+    with pytest.raises(OSError):
+        pool(f"http://127.0.0.1:{port}/echo", b"x", {}, 1.0)
+    assert metrics.service_snapshot()["wire_reconnects"] == before
+    pool.close()
+
+
+def test_connection_close_honored_on_drain_refuse():
+    """A drain-refuse 503 rides ``Connection: close`` (the server's
+    pre-body reject discipline): the pool must NOT keep that socket —
+    the next request opens fresh."""
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=5.0)
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server.start_background()
+    pool = PooledWireTransport()
+    url = f"http://{server.address}/v2/plan"
+    try:
+        server.service.begin_drain()
+        with pytest.raises(RemoteCallError) as exc:
+            pool(url, b"irrelevant", {}, 5.0)
+        assert "503" in str(exc.value)
+        assert exc.value.retry_after > 0  # Retry-After parsed
+        # the socket was discarded per the server's Connection: close
+        assert pool.connection_for(url) is None
+        assert pool.connection_count() == 0
+    finally:
+        pool.close()
+        server.close()
+
+
+def test_failback_reuses_primary_pooled_socket():
+    """Reuse across failover return: after a failover tick served by
+    the secondary, the primary's pooled socket is still warm — the
+    failback tick rides THAT socket, not a fresh connect."""
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=5.0)
+    server_a = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server_b = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server_a.start_background()
+    server_b.start_background()
+    try:
+        agent = RemotePlanner(
+            cfg,
+            f"http://{server_a.address},http://{server_b.address}",
+            tenant="c1",
+        )
+        node_map, pdbs = _observation()
+        r1 = agent.plan(node_map, pdbs)
+        assert r1.solver == "remote"
+        s_primary = agent._wire_pool.connection_for(
+            f"http://{server_a.address}"
+        )
+        assert s_primary is not None
+
+        # scripted 503 for the chaos wrapper's FIRST call (it is
+        # installed after tick 1, so its call counter starts here):
+        # raised ABOVE the pool, so the primary's pooled socket stays
+        # warm while the ladder fails over to the secondary
+        chaos = ChaosAgentTransport(
+            agent.transport,
+            ServiceFaultPlan(http_503_script=(1,), http_503_retry_after=0.5),
+            pool=agent._wire_pool,
+        )
+        agent.transport = chaos
+        before = metrics.service_snapshot()["remote_planner_failover"]
+        r2 = agent.plan(node_map, pdbs)
+        assert r2.solver == "remote"
+        assert (
+            metrics.service_snapshot()["remote_planner_failover"]
+            == before + 1
+        )
+        assert agent._wire_pool.connection_count() == 2
+
+        # failback: the primary's breaker window passes; the next tick
+        # walks the ladder back to the primary and reuses ITS socket
+        agent._endpoints[0].skip_until = 0.0
+        reuse_before = metrics.service_snapshot()["wire_connection_reuse"]
+        r3 = agent.plan(node_map, pdbs)
+        assert r3.solver == "remote"
+        assert (
+            agent._wire_pool.connection_for(f"http://{server_a.address}")
+            is s_primary
+        )
+        assert (
+            metrics.service_snapshot()["wire_connection_reuse"]
+            == reuse_before + 1
+        )
+        # selections identical throughout
+        assert dict(r3.plan.assignments) == dict(r1.plan.assignments)
+    finally:
+        server_a.close()
+        server_b.close()
+
+
+def test_chaos_half_close_fault_zero_fallback_bit_identical():
+    """The chaos half-closed-keep-alive-socket fault: the agent must
+    absorb it with ONE transparent reconnect per strike — zero
+    fallback, zero failover, selections bit-identical to the unfaulted
+    ticks."""
+    cfg = ReschedulerConfig(solver="numpy", planner_timeout=5.0)
+    server = ServiceServer(cfg, "127.0.0.1:0", batch_window_s=0.01)
+    server.start_background()
+    try:
+        agent = RemotePlanner(cfg, f"http://{server.address}", tenant="c1")
+        chaos = ChaosAgentTransport(
+            agent.transport,
+            ServiceFaultPlan(half_close_script=(2, 4)),
+            pool=agent._wire_pool,
+        )
+        agent.transport = chaos
+        node_map, pdbs = _observation()
+        before = metrics.service_snapshot()
+        results = [agent.plan(node_map, pdbs) for _ in range(4)]
+        after = metrics.service_snapshot()
+        assert [r.solver for r in results] == ["remote"] * 4
+        assert chaos.stats["half_close"] == 2
+        assert after["wire_reconnects"] - before["wire_reconnects"] == 2
+        assert (
+            after["remote_planner_fallback"]
+            == before["remote_planner_fallback"]
+        )
+        assert (
+            after["remote_planner_failover"]
+            == before["remote_planner_failover"]
+        )
+        want = dict(results[0].plan.assignments)
+        for r in results[1:]:
+            assert dict(r.plan.assignments) == want
+    finally:
+        server.close()
