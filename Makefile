@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke storm-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -19,7 +19,7 @@ all:
 # (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
 # stand-in and tools/analysis is the go-vet analog, two tiers deep
 # (this image ships no Python linter and installs are forbidden).
-check: lint analyze audit-jaxpr test bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke
+check: lint analyze audit-jaxpr test bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke storm-smoke
 
 lint:
 	python tools/lint.py
@@ -147,6 +147,17 @@ fleet-chaos-smoke:
 # equal metric deltas for failover and every shed reason. Budget: <60 s.
 fleet-twin-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --fleet-twin-smoke --watchdog 60
+
+# Resync-storm survival (FakeClock, >=32 twins x 2 replicas): one
+# replica killed + warm-restarted under full load, wiping its tenant
+# cache — the full-pack resync herd must be SHED by the bounded ingest
+# admission class, never collapse the delta traffic. Fails unless
+# concurrent ingests stay under the cap, no tenant resyncs twice,
+# unaffected tenants hold the queue-wait SLO, the fleet converges in
+# O(affected) full packs, and every shed/resync ledger (labeled metric
+# vs flight events vs twin counters) agrees exactly. Budget: <60 s.
+storm-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --storm-smoke --watchdog 60
 
 quality:
 	python bench.py --quality

@@ -160,6 +160,38 @@ def emit_error(metric: str, unit: str, error: str) -> None:
     )
 
 
+def load_twin_calibration(path: str) -> dict:
+    """Collect per-bucket measured solve costs from a bench JSON-lines
+    file (``--carry-wall`` rows carry a ``twin_calibration`` table:
+    bucket key -> {"solve_s": measured seconds}). Later lines win on
+    key collisions; a missing or unparsable file is an error — a
+    calibrated fleet run must not silently fall back to the synthetic
+    cost line."""
+    table: dict = {}
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # bench files interleave logs with JSON rows
+            cal = row.get("twin_calibration")
+            if isinstance(cal, dict):
+                for key, cost in cal.items():
+                    if isinstance(cost, dict) and "solve_s" in cost:
+                        table[str(key)] = {
+                            "solve_s": float(cost["solve_s"])
+                        }
+    if not table:
+        raise ValueError(
+            f"no twin_calibration tables found in {path!r} "
+            f"(expected --carry-wall JSON rows)"
+        )
+    return table
+
+
 def start_watchdog(seconds: float, metric: str, unit: str) -> threading.Timer:
     """Hard-exit with a diagnostic JSON line if the bench overruns —
     a hung device fetch cannot be interrupted any other way."""
@@ -1193,6 +1225,14 @@ def run_carry_wall(args, metric: str, unit: str) -> int:
         f"({feas}/{lanes} valid lanes feasible)",
         file=sys.stderr,
     )
+    # the fleet twin's calibration hook: this measured union wall,
+    # keyed by the service bucket this problem lands in, feeds
+    # ``bench.py --twin-calibration <this file>`` so the modeled
+    # device charges MEASURED per-batch solve seconds instead of the
+    # synthetic base+per-lane line
+    from k8s_spot_rescheduler_tpu.service import buckets as bucketing
+
+    bucket = bucketing.bucket_for(packed)
     emit({
         "metric": metric,
         "value": round(wall_ms, 2),
@@ -1207,6 +1247,9 @@ def run_carry_wall(args, metric: str, unit: str) -> int:
         "repeats": len(walls),
         "feasible_lanes": feas,
         "valid_lanes": lanes,
+        "twin_calibration": {
+            bucket.key: {"solve_s": round(wall_ms / 1e3, 6)}
+        },
     })
     return 0
 
@@ -2408,6 +2451,20 @@ def _fleet_twin_report(result: dict, label: str) -> None:
     curve = result.get("capacity_curve", [])
     occ = "/".join("%.2f" % r["occupancy"] for r in curve)
     p99 = "/".join("%.0f" % r["queue_wait_p99_ms"] for r in curve)
+    storm = result.get("resync_storm") or {}
+    storm_note = ""
+    if storm:
+        storm_note = (
+            f"restart-storm[affected={storm.get('affected')} "
+            f"resyncs={storm.get('resyncs_server')}=="
+            f"{storm.get('resyncs_twins')} "
+            f"sheds={storm.get('resync_sheds')} "
+            f"ingest_max={storm.get('ingest_inflight_max')}/"
+            f"{storm.get('ingest_cap')} "
+            f"converged={storm.get('converge_ticks')}t/"
+            f"{storm.get('converge_s')}s "
+            f"p99={storm.get('p99_unaffected_ms')}ms]  "
+        )
     print(
         f"{label}: {result['ever_active']} twins x "
         f"{result['replicas']} replicas, {result['sim_s']:.0f}s sim in "
@@ -2420,9 +2477,15 @@ def _fleet_twin_report(result: dict, label: str) -> None:
         f"{result['failovers_flight']}  "
         f"sheds={result['shed_total_metric']}=="
         f"{result['shed_total_flight']}  "
+        f"{storm_note}"
         f"-> {'OK' if result['ok'] else 'FAIL: %s' % result['failures']}",
         file=sys.stderr,
     )
+
+
+def _twin_calibration_arg(args) -> dict | None:
+    path = getattr(args, "twin_calibration", "")
+    return load_twin_calibration(path) if path else None
 
 
 def run_fleet_twin_smoke(args, metric: str, unit: str) -> int:
@@ -2444,6 +2507,7 @@ def run_fleet_twin_smoke(args, metric: str, unit: str) -> int:
         n_twins=max(16, min(64, args.tenants if args.tenants > 4 else 64)),
         n_replicas=2, sim_s=1200.0, seed=args.seed, slo_ms=3000.0,
         cost_base_s=0.3, cost_per_lane_s=0.4, max_wall_s=45.0,
+        calibration=_twin_calibration_arg(args),
     )
     edges = induce_shed_edges(seed=args.seed)
     ok = bool(result["ok"] and edges["ok"])
@@ -2476,6 +2540,14 @@ def run_fleet_twin_smoke(args, metric: str, unit: str) -> int:
             "verified_selections": result["verified_selections"],
             "mismatches": result["mismatches"],
             "crashes": result["crashes"],
+            "resyncs_server": result["resyncs_server"],
+            "resyncs_twins": result["resyncs_twins"],
+            "resync_storm": result["resync_storm"],
+            "resync_storm_converge_ticks": result[
+                "resync_storm_converge_ticks"
+            ],
+            "resync_sheds": result["resync_sheds"],
+            "storm_p99_wait_ms": result["storm_p99_wait_ms"],
             "ok": ok,
             "failures": result["failures"] + edges["failures"],
         }
@@ -2496,6 +2568,7 @@ def run_fleet_twin(args, metric: str, unit: str) -> int:
         n_twins=max(512, args.tenants if args.tenants > 4 else 512),
         n_replicas=2, sim_s=3600.0, seed=args.seed, slo_ms=1000.0,
         cost_base_s=0.05, cost_per_lane_s=0.05, max_wall_s=280.0,
+        calibration=_twin_calibration_arg(args),
     )
     _fleet_twin_report(result, "fleet-twin")
     out = dict(result)
@@ -2504,6 +2577,79 @@ def run_fleet_twin(args, metric: str, unit: str) -> int:
                 "unit": unit})
     emit(out)
     return 0 if result["ok"] else 1
+
+
+def run_storm_smoke(args, metric: str, unit: str) -> int:
+    """Resync-storm CI smoke (``make storm-smoke``): >= 32 tenant
+    twins x 2 real-HTTP replicas on the virtual clock, ramped briefly
+    and then hit with the dedicated restart storm — one replica killed
+    and warm-restarted under full load, wiping its tenant cache.
+    Fails unless the fleet SHEDS instead of collapsing: concurrent
+    full-pack ingests stay under the admission cap, unaffected tenants
+    hold their queue-wait SLO, no tenant resyncs twice, the fleet
+    converges in O(affected) full packs, every selection stays
+    bit-identical, and ALL shed/resync ledgers (labeled metrics vs
+    flight events, server vs twins) agree — plus the deterministic
+    per-reason shed-edge induction, which guarantees the resync-storm
+    edge fires at least once regardless of storm timing."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from k8s_spot_rescheduler_tpu.bench.fleet_twin import (
+        fleet_twin, induce_shed_edges,
+    )
+    result = fleet_twin(
+        n_twins=max(32, min(64, args.tenants if args.tenants > 4 else 32)),
+        n_replicas=2, sim_s=600.0, seed=args.seed, phases=2,
+        slo_ms=3000.0, cost_base_s=0.3, cost_per_lane_s=0.4,
+        max_wall_s=45.0, resync_storm_s=300.0,
+        calibration=_twin_calibration_arg(args),
+    )
+    edges = induce_shed_edges(seed=args.seed)
+    ok = bool(
+        result["ok"] and edges["ok"] and result.get("resync_storm")
+    )
+    _fleet_twin_report(result, "storm-smoke")
+    print(
+        f"storm-smoke shed edges: metric={edges['metric_delta']} "
+        f"flight={edges['flight_delta']} "
+        f"-> {'OK' if edges['ok'] else 'FAIL: %s' % edges['failures']}",
+        file=sys.stderr,
+    )
+    storm = result.get("resync_storm") or {}
+    emit(
+        {
+            "metric": metric,
+            "value": result["resync_storm_converge_ticks"],
+            "unit": unit,
+            "n_twins": result["n_twins"],
+            "replicas": result["replicas"],
+            "sim_s": result["sim_s"],
+            "wall_s": result["wall_s"],
+            "slo_ms": result["slo_ms"],
+            "resync_storm": storm,
+            "resync_storm_converge_ticks": result[
+                "resync_storm_converge_ticks"
+            ],
+            "resync_sheds": result["resync_sheds"],
+            "storm_p99_wait_ms": result["storm_p99_wait_ms"],
+            "resyncs_server": result["resyncs_server"],
+            "resyncs_twins": result["resyncs_twins"],
+            "wire_bytes_sent": result["wire_bytes_sent"],
+            "full_posts": result["full_posts"],
+            "delta_posts": result["delta_posts"],
+            "sheds_by_reason": result["sheds_by_reason"],
+            "shed_edge_metric_delta": edges["metric_delta"],
+            "shed_edge_flight_delta": edges["flight_delta"],
+            "verified_selections": result["verified_selections"],
+            "mismatches": result["mismatches"],
+            "crashes": result["crashes"],
+            "ok": ok,
+            "failures": result["failures"] + edges["failures"] + (
+                [] if result.get("resync_storm")
+                else ["restart-storm phase did not run"]
+            ),
+        }
+    )
+    return 0 if ok else 1
 
 
 def run_chaos(args, metric: str, unit: str) -> int:
@@ -3186,6 +3332,8 @@ def _metric_for(args) -> tuple:
         return "fleet_twin_smoke_capacity_tenants_per_device", "tenants"
     if args.fleet_twin:
         return "fleet_twin_capacity_tenants_per_device", "tenants"
+    if args.storm_smoke:
+        return "storm_smoke_resync_converge_ticks", "ticks"
     if args.pallas_smoke:
         return "pallas_parity_wall_s", "s"
     if args.carry_wall:
@@ -3346,6 +3494,23 @@ def main() -> int:
                          "virtual clock; emits the capacity-planning "
                          "curve (tenants/device at the queue-wait SLO), "
                          "failover convexity and Jain fairness")
+    ap.add_argument("--storm-smoke", action="store_true",
+                    help="CI smoke (make storm-smoke): >=32 tenant "
+                         "twins x 2 real-HTTP replicas; one replica is "
+                         "killed and warm-restarted under full load "
+                         "(tenant cache wiped) — fails unless the "
+                         "resync admission class sheds instead of "
+                         "collapsing: bounded concurrent ingests, no "
+                         "tenant resyncing twice, unaffected tenants "
+                         "holding the SLO, O(affected) full-pack "
+                         "convergence, and all ledgers in parity")
+    ap.add_argument("--twin-calibration", default="",
+                    help="bench JSON-lines file whose --carry-wall rows "
+                         "carry twin_calibration tables (bucket key -> "
+                         "measured solve_s); fleet twin runs then charge "
+                         "the modeled device MEASURED per-batch seconds "
+                         "for those buckets instead of the synthetic "
+                         "base+per-lane cost line")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke (make bench-smoke): tiny CPU-only "
                          "cluster, 5 ticks through the production "
@@ -3410,6 +3575,8 @@ def _dispatch(ap, args, metric: str, unit: str) -> int:
         return run_fleet_twin_smoke(args, metric, unit)
     if args.fleet_twin:
         return run_fleet_twin(args, metric, unit)
+    if args.storm_smoke:
+        return run_storm_smoke(args, metric, unit)
     if args.pallas_smoke:
         return run_pallas_smoke(args, metric, unit)
     if args.carry_wall:

@@ -29,10 +29,16 @@ Outputs (one JSON artifact line via ``bench.py --fleet-twin``):
 - **compile sharing**: bucket-level first-compile hits/misses as twin
   shapes drift (storms change packed shapes mid-run);
 - **admission-shed ledger**: every shed edge double-booked — the
-  labeled metric vs the flight ``service-shed`` events — asserted
-  equal, plus a deterministic per-reason edge-induction pass
-  (:func:`induce_shed_edges`) that fires each of the five reasons at
-  least once and diffs both surfaces per label.
+  labeled metric vs the flight ``service-shed``/``resync-shed`` events
+  — asserted equal, plus a deterministic per-reason edge-induction
+  pass (:func:`induce_shed_edges`) that fires every reason in the
+  REGISTRY's label set at least once and diffs both surfaces per
+  label;
+- **restart-storm survival**: after the ramped phases, one replica is
+  killed and warm-restarted under the full fleet (tenant cache wiped);
+  the run asserts bounded concurrent full-pack ingests, no tenant
+  resyncing twice, server-vs-twin resync ledger parity, unaffected
+  tenants holding the SLO, and convergence in O(affected) full packs.
 
 ``bench.py --fleet-twin-smoke`` runs the same loop at <= 64 twins
 inside ``make check``; the full run (512 twins, one simulated hour)
@@ -51,6 +57,7 @@ import numpy as np
 from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS
 from k8s_spot_rescheduler_tpu.loop import flight
 from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.models.columnar import pack_fingerprint
 from k8s_spot_rescheduler_tpu.service import wire
 from k8s_spot_rescheduler_tpu.service.server import ServiceServer
 from k8s_spot_rescheduler_tpu.service.twin import (
@@ -62,10 +69,22 @@ from k8s_spot_rescheduler_tpu.utils.clock import FakeClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
 
-SHED_REASONS = (
-    "max-inflight", "queue-timeout", "drain-refuse", "deadline",
-    "drain-evict",
-)
+# the shed-reason label set comes from the REGISTRY, not a local
+# literal: a new admission edge added to the service shows up here
+# automatically, and ``induce_shed_edges`` then FAILS until it also has
+# a deterministic recipe for firing it — the completeness contract
+SHED_REASONS = metrics.SHED_REASONS
+
+# the two flight kinds an admission shed can fire as (the resync-storm
+# edge has its own kind so storm ingest refusals are separable from
+# ordinary queue sheds in the flight log); every ledger diff in this
+# module must sum BOTH to stay equal to the labeled metric
+SHED_FLIGHT_KINDS = ("service-shed", "resync-shed")
+
+
+def _shed_flight_total() -> int:
+    counts = flight.counts()
+    return sum(int(counts.get(k, 0)) for k in SHED_FLIGHT_KINDS)
 
 
 def _pctl(values: List[float], q: float) -> float:
@@ -104,12 +123,18 @@ class _Fleet:
 
     def __init__(self, cfg: ReschedulerConfig, clock: FakeClock,
                  n_replicas: int, max_inflight: int,
-                 cost_base_s: float, cost_per_lane_s: float):
+                 cost_base_s: float, cost_per_lane_s: float,
+                 calibration: Optional[Dict[str, dict]] = None):
         self.cfg = cfg
         self.clock = clock
         self.max_inflight = max_inflight
         self.cost_base_s = cost_base_s
         self.cost_per_lane_s = cost_per_lane_s
+        # measured per-bucket solve costs (bucket key -> {"solve_s"}),
+        # from a real --carry-wall run's ``twin_calibration`` table:
+        # when a batch's bucket has a measured cost, the modeled device
+        # charges THAT instead of the synthetic base+per-lane line
+        self.calibration: Dict[str, dict] = dict(calibration or {})
         self.busy_s = [0.0] * n_replicas  # modeled device time, per slot
         # per-replica device frontier: the virtual time through which
         # that replica's modeled TPU is committed. Parallel replicas
@@ -145,9 +170,29 @@ class _Fleet:
             # last member enqueued — that lower bound (not clock.now(),
             # which a concurrent replica may already have advanced)
             # keeps parallel devices overlapped in virtual time.
-            cost = self.cost_base_s + self.cost_per_lane_s * sum(
-                r.lanes for r in batch
+            measured = (
+                self.calibration.get(batch[0].bucket.key)
+                if batch else None
             )
+            if measured is not None:
+                cost = float(measured.get("solve_s", 0.0)) or (
+                    self.cost_base_s
+                )
+            else:
+                # the device solves every tenant's FULL lane block no
+                # matter how few lanes a delta request touched: charge
+                # the stacked batch's valid candidate lanes (equal to
+                # the DRR cost for full packs), not r.lanes, which for
+                # delta traffic counts only the CHANGED lanes and would
+                # make deltas read as nearly free device time
+                lanes = (
+                    int(np.asarray(stacked.cand_valid).sum())
+                    if stacked is not None
+                    else sum(r.lanes for r in batch)
+                )
+                cost = (
+                    self.cost_base_s + self.cost_per_lane_s * lanes
+                )
             ready = max((r.enqueued for r in batch), default=0.0)
             with self._adv_lock:
                 start = max(self.frontier[idx], ready)
@@ -199,10 +244,22 @@ def fleet_twin(
     jain_min: float = 0.8,
     max_wall_s: float = 280.0,
     deadline_frac: float = 0.0,
+    resync_storm_s: float = 240.0,
+    calibration: Optional[Dict[str, dict]] = None,
 ) -> dict:
     """Run the fleet twin; returns the capacity/observability artifact
     (``ok`` False plus a ``failures`` list when any fleet invariant
-    broke). See the module docstring for what each phase does."""
+    broke). See the module docstring for what each phase does.
+
+    After the ramped phases, ``resync_storm_s`` > 0 appends a dedicated
+    **restart-storm** phase under the full fleet: one replica is killed
+    and warm-restarted (its tenant cache wiped), and the run asserts
+    the anti-entropy contract — bounded concurrent full-pack ingests
+    (``resync_ingest_inflight_max`` <= the configured cap), no tenant
+    resyncing twice, server resync count == the twins' sum, unaffected
+    tenants holding the queue-wait SLO, and convergence in O(affected)
+    full packs. ``calibration`` maps bucket keys to measured per-batch
+    solve costs (see ``--twin-calibration``)."""
     t_wall = time.perf_counter()
     clock = FakeClock()
     spec0 = CONFIGS[2]
@@ -210,9 +267,14 @@ def fleet_twin(
         resources=spec0.resources, solver="numpy",
         device_sick_threshold=0, service_drain_grace=2.0,
         planner_timeout=5.0,
+        # short drain schedules keep the twins' periodic wire-v3
+        # requests (every SCHEDULE_EVERY-th tick) cheap enough for the
+        # modeled device while still exercising the surface at scale
+        schedule_horizon=6,
     )
     fleet = _Fleet(cfg, clock, n_replicas, max_inflight,
-                   cost_base_s, cost_per_lane_s)
+                   cost_base_s, cost_per_lane_s,
+                   calibration=calibration)
     from k8s_spot_rescheduler_tpu.planner.solver_planner import SolverPlanner
 
     solo = SolverPlanner(cfg)
@@ -251,7 +313,7 @@ def fleet_twin(
         "resync", 0
     )
     shed_metric_0 = sum(_shed_totals().values())
-    shed_flight_0 = flight.counts().get("service-shed", 0)
+    shed_flight_0 = _shed_flight_total()
     fo_metric_0 = metrics.service_snapshot()["remote_planner_failover"]
     fo_flight_0 = flight.counts().get("failover", 0)
 
@@ -395,10 +457,15 @@ def fleet_twin(
                     # phase-lock its cohort (identical next_due would
                     # turn every later round into one synchronized
                     # burst whose queue waits read as saturation at any
-                    # load)
-                    tw.next_due = clock.now() + tw.spec.cadence_s * (
-                        float(tw.rng.uniform(0.7, 1.3))
-                    )
+                    # load). A pending resync retry (retry_due > 0)
+                    # overrides the cadence: the twin owes the server
+                    # exactly one full pack, on ITS jittered schedule
+                    if tw.retry_due > 0:
+                        tw.next_due = tw.retry_due
+                    else:
+                        tw.next_due = clock.now() + tw.spec.cadence_s * (
+                            float(tw.rng.uniform(0.7, 1.3))
+                        )
                     tw.churn()
             if aborted:
                 break
@@ -488,6 +555,245 @@ def fleet_twin(
                 p, len(active), occupancy, row["queue_wait_p99_ms"],
                 row["jain"], row["sheds"],
             )
+
+        # --------------------------------------------------------------
+        # dedicated restart storm: kill + warm-restart ONE replica under
+        # the full fleet, wiping its tenant cache. Every active tenant
+        # whose primary it is owes one full-pack resync, all at once —
+        # the admission class must SHED the excess (bounded concurrent
+        # ingests), never collapse (unaffected tenants hold the SLO),
+        # and the fleet must converge in O(affected) full packs with no
+        # tenant resyncing twice.
+        storm_report: dict = {}
+        if not aborted and resync_storm_s > 0 and active:
+            storm_kill = phases % n_replicas  # rotate past the phase kills
+            metrics.reset_service_window()  # arm the ingest high-water
+            sm_resync_0 = metrics.service_snapshot()[
+                "delta_requests"
+            ].get("resync", 0)
+            sm_shed_0 = _shed_totals().get("resync-storm", 0)
+            sm_shed_flight_0 = flight.counts().get("resync-shed", 0)
+            tw_resync_0 = {i: twins[i].resyncs for i in active}
+            tw_fulls_0 = sum(twins[i].full_posts for i in active)
+            tw_sched_0 = sum(twins[i].schedule_ticks for i in active)
+            tw_bytes_0 = sum(twins[i].wire_bytes_sent for i in active)
+            wait_mark = {i: len(twins[i].wait_samples_ms) for i in active}
+            affected = [
+                i for i in active if i % n_replicas == storm_kill
+            ]
+            storm_t0 = clock.now()
+            fleet.kill(storm_kill)
+            fleet.restart(storm_kill)  # warm restart: cache wiped
+            srv_restarted = fleet.replicas[storm_kill]
+            affected_set = set(affected)
+            # the correlated wave: every AFFECTED twin re-ticks within
+            # seconds of the restart (their cadences all land on the
+            # fresh cache together — the storm this phase exists for);
+            # unaffected twins keep their natural cadence, pulled into
+            # the window only so their SLO has samples to judge
+            for i in active:
+                tw = twins[i]
+                tw.retry_due = 0.0
+                if i in affected_set:
+                    tw.next_due = storm_t0 + float(
+                        rng.uniform(0.0, 10.0)
+                    )
+                else:
+                    tw.next_due = min(
+                        tw.next_due,
+                        storm_t0 + float(rng.uniform(0.3, 1.0)) * min(
+                            tw.spec.cadence_s, resync_storm_s * 0.5
+                        ),
+                    )
+            storm_end = storm_t0 + resync_storm_s
+            # the isolation bound for unaffected tenants: the storm
+            # must not make them materially worse than the load the
+            # ramp ALREADY exhibited (the top phases may sit past the
+            # SLO knee by design — that saturation is the capacity
+            # curve's finding, not the storm's fault). Baseline = the
+            # worst steady-state p99 of any ramp phase. The affected
+            # cohort can be half the fleet, so DRR fair-share alone
+            # puts 2x that load on the unaffected while the herd
+            # re-seeds, and past the knee queue waits grow
+            # superlinearly — 3x the baseline is the survival band
+            # (512-twin measured: 2.3x); COLLAPSE, the thing the
+            # admission class exists to prevent, reads as an order of
+            # magnitude, not a fair-share doubling.
+            pre_storm_p99 = max(
+                (r["queue_wait_p99_ms"] for r in curve), default=0.0
+            )
+            storm_slo = max(slo_ms, 3.0 * pre_storm_p99)
+            converge_ticks = 0
+            converged_s = 0.0
+
+            def _storm_converged() -> bool:
+                # ground truth of anti-entropy: the wiped cache holds
+                # every affected (primary-owner) tenant again, and no
+                # twin still owes a full pack
+                svc = srv_restarted.service
+                return all(
+                    svc.tenant_cached(twins[i].spec.name)
+                    for i in affected
+                ) and not any(twins[i]._need_full for i in active)
+
+            while clock.now() < storm_end:
+                if time.perf_counter() - t_wall > max_wall_s:
+                    aborted = (
+                        "wall budget %.0fs exhausted in restart storm"
+                        % max_wall_s
+                    )
+                    break
+                if converged_s == 0.0 and _storm_converged():
+                    converged_s = clock.now() - storm_t0
+                    break
+                now = clock.now()
+                due = [i for i in active if twins[i].next_due <= now]
+                if not due:
+                    nxt = min(
+                        min(twins[i].next_due for i in active), storm_end
+                    )
+                    clock.advance(max(1e-3, nxt - now))
+                    continue
+                converge_ticks += 1
+                list(pool.map(lambda i: twins[i].tick(), due))
+                for i in due:
+                    tw = twins[i]
+                    if tw.last_reply is not None and (
+                        tw.served == 1 or tw.served % verify_every == 0
+                    ):
+                        bad = tw.verify(solo)
+                        verified += 1
+                        if bad is not None:
+                            mismatches.append(bad)
+                    if tw.retry_due > 0:
+                        tw.next_due = tw.retry_due
+                    else:
+                        # an affected twin still owing anti-entropy
+                        # (primary cache not yet re-seeded) re-ticks
+                        # within a minute so convergence completes in
+                        # the window; everyone else keeps their natural
+                        # cadence. No churn in this phase — the
+                        # full-pack ledger below then counts ONLY
+                        # resync traffic (plus scheduled v3 fulls),
+                        # not shape growth
+                        cad = tw.spec.cadence_s
+                        if i in affected_set and not (
+                            srv_restarted.service.tenant_cached(
+                                tw.spec.name
+                            )
+                        ):
+                            cad = min(cad, 60.0)
+                        tw.next_due = clock.now() + cad * float(
+                            tw.rng.uniform(0.7, 1.3)
+                        )
+            if converged_s == 0.0 and _storm_converged():
+                converged_s = clock.now() - storm_t0
+
+            sm_resync = metrics.service_snapshot()[
+                "delta_requests"
+            ].get("resync", 0) - sm_resync_0
+            sm_shed = _shed_totals().get("resync-storm", 0) - sm_shed_0
+            sm_shed_flight = (
+                flight.counts().get("resync-shed", 0) - sm_shed_flight_0
+            )
+            tw_resync = {
+                i: twins[i].resyncs - tw_resync_0[i] for i in active
+            }
+            storm_fulls = (
+                sum(twins[i].full_posts for i in active) - tw_fulls_0
+                - (sum(twins[i].schedule_ticks for i in active)
+                   - tw_sched_0)
+            )
+            unaffected_waits = [
+                w
+                for i in active if i not in affected_set
+                for w in twins[i].wait_samples_ms[wait_mark.get(i, 0):]
+            ]
+            storm_p99 = _pctl(unaffected_waits, 0.99)
+            ingest_max = metrics.service_snapshot().get(
+                "resync_ingest_inflight_max", 0
+            )
+            cap = int(cfg.service_resync_ingest_cap)
+            if converged_s == 0.0 and not aborted:
+                failures.append(
+                    "restart storm did not converge within %.0fs: "
+                    "%d/%d affected tenants re-cached"
+                    % (
+                        resync_storm_s,
+                        sum(
+                            1 for i in affected
+                            if srv_restarted.service.tenant_cached(
+                                twins[i].spec.name
+                            )
+                        ),
+                        len(affected),
+                    )
+                )
+            if ingest_max > cap:
+                failures.append(
+                    f"concurrent resync ingests peaked at {ingest_max} "
+                    f"> cap {cap}"
+                )
+            twice = {
+                twins[i].spec.name: n
+                for i, n in tw_resync.items() if n > 1
+            }
+            if twice:
+                failures.append(
+                    f"tenants resynced more than once in one storm: "
+                    f"{twice}"
+                )
+            if sm_resync != sum(tw_resync.values()):
+                failures.append(
+                    f"storm resync ledgers disagree: server {sm_resync} "
+                    f"!= twins {sum(tw_resync.values())}"
+                )
+            if sm_shed != sm_shed_flight:
+                failures.append(
+                    f"resync-shed ledgers disagree: metric {sm_shed} "
+                    f"!= flight {sm_shed_flight}"
+                )
+            if storm_fulls > 2 * len(affected) + len(active):
+                failures.append(
+                    f"storm full-pack traffic not O(tenants): "
+                    f"{storm_fulls} fulls for {len(affected)} affected "
+                    f"/ {len(active)} active"
+                )
+            if storm_p99 > storm_slo:
+                failures.append(
+                    f"unaffected tenants broke the SLO during the "
+                    f"storm: p99 {storm_p99:.0f}ms > {storm_slo:.0f}ms "
+                    f"(slo {slo_ms}ms, pre-storm p99 "
+                    f"{pre_storm_p99:.0f}ms)"
+                )
+            storm_report = {
+                "affected": len(affected),
+                "active": len(active),
+                "resyncs_server": sm_resync,
+                "resyncs_twins": sum(tw_resync.values()),
+                "resync_sheds": sm_shed,
+                "resync_sheds_flight": sm_shed_flight,
+                "ingest_inflight_max": int(ingest_max),
+                "ingest_cap": cap,
+                "full_packs": storm_fulls,
+                "wire_bytes": sum(
+                    twins[i].wire_bytes_sent for i in active
+                ) - tw_bytes_0,
+                "converge_ticks": converge_ticks,
+                "converge_s": round(converged_s, 1),
+                "p99_unaffected_ms": round(storm_p99, 3),
+                "storm_slo_ms": round(storm_slo, 1),
+            }
+            log.info(
+                "fleet-twin restart storm: affected=%d resyncs=%d "
+                "sheds=%d ingest_max=%d/%d converged in %d ticks "
+                "(%.0fs sim) p99=%.0fms",
+                len(affected), sm_resync, sm_shed, ingest_max, cap,
+                converge_ticks, converged_s, storm_p99,
+            )
+            if aborted:
+                failures.append(aborted)
+                aborted = ""
     finally:
         pool.shutdown(wait=True)
         fleet.close()
@@ -538,7 +844,7 @@ def fleet_twin(
     # double-booked degradation ledgers: cumulative flight event counts
     # vs the metric counters must agree exactly (shed + failover edges)
     shed_metric = sum(_shed_totals().values()) - shed_metric_0
-    shed_flight = flight.counts().get("service-shed", 0) - shed_flight_0
+    shed_flight = _shed_flight_total() - shed_flight_0
     if shed_metric != shed_flight:
         failures.append(
             f"shed ledgers disagree: metric {shed_metric} != "
@@ -555,13 +861,28 @@ def fleet_twin(
         )
     if fo_metric <= 0:
         failures.append("no failover edges induced by the kill windows")
+    # resync PARITY, not resync zero: phase kills and the restart storm
+    # legitimately stale the delta bases, so resyncs happen — what must
+    # hold is that every server-side resync demand is one twin's
+    # observed demand (no lost or phantom anti-entropy), and that no
+    # twin resyncs more than once per restart event
     resyncs = (
         metrics.service_snapshot()["delta_requests"].get("resync", 0)
         - resync_before
     )
-    if resyncs:
+    twin_resyncs = sum(tw.resyncs for tw in twins.values())
+    if resyncs != twin_resyncs:
         failures.append(
-            f"join/leave churn caused {resyncs} delta resyncs"
+            f"resync ledgers disagree: server {resyncs} != "
+            f"twins {twin_resyncs}"
+        )
+    restarts_total = phases + (1 if resync_storm_s > 0 else 0)
+    worst = max((tw.resyncs for tw in twins.values()), default=0)
+    if worst > restarts_total:
+        failures.append(
+            f"a twin resynced {worst} times across {restarts_total} "
+            f"replica restarts (anti-entropy not converging to one "
+            f"full pack per restart)"
         )
     snap = metrics.service_snapshot()
     artifact = {
@@ -589,6 +910,24 @@ def fleet_twin(
         "verified_selections": verified,
         "mismatches": mismatches[:8],
         "crashes": crashes,
+        "resyncs_server": resyncs,
+        "resyncs_twins": twin_resyncs,
+        "wire_bytes_sent": sum(
+            tw.wire_bytes_sent for tw in twins.values()
+        ),
+        "full_posts": sum(tw.full_posts for tw in twins.values()),
+        "delta_posts": sum(tw.delta_posts for tw in twins.values()),
+        "schedule_ticks": sum(
+            tw.schedule_ticks for tw in twins.values()
+        ),
+        "resync_storm": storm_report,
+        # the three headline storm numbers, flattened for dashboards
+        # (bench.py's attestation covers them under these exact keys)
+        "resync_storm_converge_ticks": storm_report.get(
+            "converge_ticks", 0
+        ),
+        "resync_sheds": storm_report.get("resync_sheds", 0),
+        "storm_p99_wait_ms": storm_report.get("p99_unaffected_ms", 0.0),
         "ok": not failures,
         "failures": failures,
     }
@@ -602,8 +941,11 @@ def fleet_twin(
 def induce_shed_edges(seed: int = 0) -> dict:
     """Fire every admission-shed reason at least once, deterministically,
     against a dedicated single replica — and prove the two ledgers
-    (labeled ``service_admission_shed_total`` vs flight ``service-shed``
-    events grouped by the same reason attr) move in lockstep per label.
+    (labeled ``service_admission_shed_total`` vs the flight shed events
+    grouped by the same reason attr) move in lockstep per label. The
+    reason list is the REGISTRY's (``metrics.SHED_REASONS``), not a
+    local literal: adding an admission edge to the service makes this
+    pass FAIL until a recipe for inducing it exists here.
 
     The recipe leans on the replica being fully controllable here:
     a ``solve_hook`` that sleeps REAL time keeps the scheduler busy so
@@ -645,14 +987,19 @@ def induce_shed_edges(seed: int = 0) -> dict:
     # induction can make a before/after count diff see EVICTIONS of old
     # shed events as negative deltas. Events with seq > the start mark
     # are exactly the induced ones (far fewer than the log bound).
-    seq0 = max(
-        (e["seq"] for e in flight.events("service-shed")), default=0
-    )
+    seq0 = {
+        kind: max(
+            (e["seq"] for e in flight.events(kind)), default=0
+        )
+        for kind in SHED_FLIGHT_KINDS
+    }
     got: Dict[str, str] = {}
 
-    def post_expecting_503(headers: dict, label: str) -> None:
+    def post_expecting_503(
+        headers: dict, label: str, payload: bytes = b""
+    ) -> None:
         try:
-            post_plan(url, body, headers, timeout=15.0)
+            post_plan(url, payload or body, headers, timeout=15.0)
             got[label] = "served (expected 503)"
         except Exception as err:  # noqa: BLE001 — the 503 IS the
             # expected outcome here; anything else is reported in the
@@ -688,6 +1035,21 @@ def induce_shed_edges(seed: int = 0) -> dict:
     srv.max_inflight = 0
     post_expecting_503(dict(octet), "max-inflight")
     srv.max_inflight = 4
+    # resync-storm: a FINGERPRINTED full pack for a tenant this replica
+    # has never cached is a resync-class ingest; with the ingest cap
+    # forced to zero the admission class must refuse it typed (503 +
+    # load-derived Retry-After, the dedicated ``resync-shed`` flight
+    # kind) rather than let it crowd the delta queue
+    old_cap = srv.resync_ingest_cap
+    srv.resync_ingest_cap = 0
+    post_expecting_503(
+        dict(octet), "resync-storm",
+        payload=wire.encode_plan_request(
+            "edge-probe-uncached", packed,
+            pack_fingerprint=pack_fingerprint(packed),
+        ),
+    )
+    srv.resync_ingest_cap = old_cap
     # drain-refuse + drain-evict: park two victims in the queue with no
     # scheduler to serve them, start draining (new posts refused), then
     # drain_pending with ZERO grace must evict both
@@ -708,12 +1070,13 @@ def induce_shed_edges(seed: int = 0) -> dict:
         for r in SHED_REASONS
     }
     flight_delta = {r: 0 for r in SHED_REASONS}
-    for event in flight.events("service-shed"):
-        if event["seq"] <= seq0:
-            continue
-        reason = str(event.get("attrs", {}).get("reason", ""))
-        if reason in flight_delta:
-            flight_delta[reason] += 1
+    for kind in SHED_FLIGHT_KINDS:
+        for event in flight.events(kind):
+            if event["seq"] <= seq0[kind]:
+                continue
+            reason = str(event.get("attrs", {}).get("reason", ""))
+            if reason in flight_delta:
+                flight_delta[reason] += 1
     failures = []
     for r in SHED_REASONS:
         if metric_delta[r] < 1:

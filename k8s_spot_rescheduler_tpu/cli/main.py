@@ -261,6 +261,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "request unbatched past this is evicted with "
                         "503 + Retry-After from the measured batch "
                         "cadence (Go duration)")
+    p.add_argument("--service-resync-ingest-cap", type=int,
+                   default=d.service_resync_ingest_cap,
+                   help="--serve mode: max concurrent full-pack resync "
+                        "ingests (uncached tenants re-seeding after a "
+                        "restart); excess refused with typed 503 + "
+                        "load-derived Retry-After so a correlated "
+                        "resync storm sheds instead of collapsing")
+    p.add_argument("--service-resync-ingest-budget", type=int,
+                   default=d.service_resync_ingest_budget,
+                   help="--serve mode: byte budget for the resync "
+                        "ingest ledger (in-flight resync ingests "
+                        "charge their estimated per-tenant HBM "
+                        "footprint); 0 = derive from the HBM budget")
     p.add_argument("--serve", default="",
                    help="run as the multi-tenant planner SERVICE on "
                         "this address (e.g. 0.0.0.0:8642) instead of a "
@@ -390,6 +403,8 @@ def config_from_args(args) -> ReschedulerConfig:
         delta_wire_enabled=args.delta_wire_enabled,
         service_batch_window=parse_duration(args.service_batch_window),
         service_queue_timeout=parse_duration(args.service_queue_timeout),
+        service_resync_ingest_cap=args.service_resync_ingest_cap,
+        service_resync_ingest_budget=args.service_resync_ingest_budget,
         device_sick_threshold=args.device_sick_threshold,
         service_drain_grace=parse_duration(args.service_drain_grace),
         service_state_dir=args.service_state_dir,

@@ -51,6 +51,7 @@ DEGRADATION_KINDS = frozenset({
     "freshness-bypass",        # stale mirror -> direct-LIST observe
     "watch-stall",             # open-but-silent stream killed
     "service-shed",            # planner service 503 (inflight/queue/drain)
+    "resync-shed",             # full-pack resync ingest refused (storm)
     "device-sick",             # watchdog flipped the service host-side
     "failover",                # served by a non-primary planner endpoint
     "schedule-invalidated",    # churn broke a drain-schedule prediction

@@ -505,10 +505,57 @@ service_admission_shed = Counter(
     "deadline (evicted after waiting out the CLIENT's declared "
     "X-Planner-Deadline, shorter than the queue timeout), drain-refuse "
     "(a draining replica refused pre-body), drain-evict (queued work "
-    "evicted when the drain grace expired). Each reason fires from "
-    "exactly ONE site, paired with a flight 'service-shed' event "
-    "carrying the same reason attr — the capacity curve's shed axis.",
+    "evicted when the drain grace expired), resync-storm (a full-pack "
+    "resync ingest refused by the bounded resync admission class — "
+    "concurrent-ingest cap or byte ledger — with a load-derived "
+    "Retry-After). Each reason fires from exactly ONE site, paired "
+    "with a flight shed event ('service-shed', or 'resync-shed' for "
+    "resync-storm) carrying the same reason attr — the capacity "
+    "curve's shed axis.",
     ["reason"],
+    namespace=NAMESPACE,
+)
+
+# The canonical admission-shed label set — every reason the counter
+# above can ever carry, in one importable place. bench/fleet_twin.py's
+# induce_shed_edges() enumerates THIS tuple (never its own literal), so
+# adding a reason here without an induction recipe turns the fleet
+# smoke red instead of letting the new edge go silently unexercised.
+SHED_REASONS = (
+    "max-inflight",
+    "queue-timeout",
+    "deadline",
+    "drain-refuse",
+    "drain-evict",
+    "resync-storm",
+)
+
+service_resync_ingest_admitted = Counter(
+    "service_resync_ingest_admitted",
+    "Full-pack resync ingests ADMITTED through the bounded resync "
+    "admission class (a fingerprinted full pack for a tenant with no "
+    "cached state — first contact or post-restart re-seed). Refusals "
+    "land in service_admission_shed{reason=resync-storm}; together the "
+    "two count every resync-class arrival.",
+    namespace=NAMESPACE,
+)
+
+service_resync_ingest_inflight = Gauge(
+    "service_resync_ingest_inflight",
+    "Full-pack resync ingests currently holding an admission token "
+    "(decode through batch solve and cache seed). The restart-storm "
+    "bench asserts the run high-water of this gauge never exceeds "
+    "service_resync_ingest_cap — the shed-not-collapse contract.",
+    namespace=NAMESPACE,
+)
+
+service_resync_ingest_ledger = Gauge(
+    "service_resync_ingest_ledger_bytes",
+    "Estimated HBM bytes (per-tenant bucket footprint, the same "
+    "estimate_union_hbm_breakdown model the batch cap uses) committed "
+    "by in-flight resync ingests — the byte-budgeted ledger that "
+    "bounds how much cache-seeding state a correlated storm can "
+    "commit concurrently.",
     namespace=NAMESPACE,
 )
 
@@ -752,6 +799,11 @@ def update_observe_delta_events(n: int) -> None:
 # batch; the serve-smoke acceptance needs the run's high-water marks)
 _service_batch_max = {"lanes": 0, "tenants": 0}
 
+# high-water of concurrent resync ingests since the window reset — the
+# storm bench's "never exceeded the cap" witness (the gauge alone only
+# holds the instantaneous value)
+_resync_ingest_max = {"inflight": 0}
+
 # windowed queue-wait accounting: a bounded ring of recent waits per
 # tenant (plus one pooled ring for the aggregate gauges). Tenant ids
 # are client-supplied, so the map is bounded exactly like the server's
@@ -895,6 +947,7 @@ def reset_service_window() -> None:
     _tenant_waits.clear()
     _window_waits.clear()
     _tenant_served.clear()
+    _resync_ingest_max["inflight"] = 0
     service_queue_wait_p50.set(0.0)
     service_queue_wait_p99.set(0.0)
 
@@ -938,6 +991,23 @@ def update_service_tenant_cache(entries: int) -> None:
     service_tenant_cache.set(int(entries))
 
 
+def update_service_resync_ingest(
+    inflight: int, ledger_bytes: int, admitted: bool = False
+) -> None:
+    """Resync-ingest admission occupancy changed: refresh the
+    concurrent-ingest and ledger gauges and the run high-water (the
+    storm bench asserts the high-water against the configured cap).
+    ``admitted`` marks the transition that admitted one more
+    full-pack resync ingest."""
+    if admitted:
+        service_resync_ingest_admitted.inc()
+    service_resync_ingest_inflight.set(int(inflight))
+    service_resync_ingest_ledger.set(max(0, int(ledger_bytes)))
+    _resync_ingest_max["inflight"] = max(
+        _resync_ingest_max["inflight"], int(inflight)
+    )
+
+
 def service_snapshot() -> dict:
     """Service/agent counters via the public collect() API (tests and
     the serve-smoke harness diff before/after), plus the run's batch
@@ -968,6 +1038,11 @@ def service_snapshot() -> dict:
     occupancy = 0.0
     for sample in service_batch_occupancy.collect()[0].samples:
         occupancy = sample.value
+    resync_inflight = resync_ledger = 0.0
+    for sample in service_resync_ingest_inflight.collect()[0].samples:
+        resync_inflight = sample.value
+    for sample in service_resync_ingest_ledger.collect()[0].samples:
+        resync_ledger = sample.value
     return {
         "requests": by_outcome,
         "batch_lanes": lanes,
@@ -985,6 +1060,12 @@ def service_snapshot() -> dict:
         "wire_ingest_bytes": _counter_value(service_wire_ingest_bytes),
         "tenant_cache_entries": cache_entries,
         "admission_shed": shed_by_reason,
+        "resync_ingest_admitted": _counter_value(
+            service_resync_ingest_admitted
+        ),
+        "resync_ingest_inflight": resync_inflight,
+        "resync_ingest_inflight_max": _resync_ingest_max["inflight"],
+        "resync_ingest_ledger_bytes": resync_ledger,
         "compile_hits": _counter_value(service_bucket_compile_hits),
         "compile_misses": _counter_value(service_bucket_compile_misses),
         "queue_wait_p50_ms": round(_percentile(_window_waits, 0.50), 3),

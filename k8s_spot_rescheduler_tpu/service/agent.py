@@ -36,7 +36,12 @@ skip window; at/above the threshold the skip window is
 ``max(doubling backoff, Retry-After)`` with the server-suggested value
 capped at ``RETRY_AFTER_CAP_S`` — one bad LB header must not park an
 agent on its fallback for hours (the same 30 s cap the kube read path
-applies, docs/ROBUSTNESS.md).
+applies, docs/ROBUSTNESS.md). The capped horizon is then stretched by
+a private urandom-seeded jitter, and a KIND_RESYNC full-pack retry
+sleeps a jittered delay first: a fleet-wide restart hands every agent
+the same horizon in the same tick, and without per-agent jitter they
+would all come back at once — the resync storm docs/ROBUSTNESS.md's
+"Resync storms" section bounds.
 
 The transport is a seam (``self.transport``): ``service/chaos.py``
 wraps it to inject wire faults in ``make fleet-chaos-smoke``.
@@ -47,6 +52,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import http.client
+import random
 import socket
 import threading
 import time
@@ -457,6 +463,18 @@ class RemotePlanner:
     # window (a misconfigured LB header must not stall failback for
     # hours; outages past this belong to the doubling backoff)
     RETRY_AFTER_CAP_S = 30.0
+    # decorrelation jitter: the suggested horizon is stretched by a
+    # per-agent random factor in [1.0, 1 + this) before it opens the
+    # skip window — N agents refused with the SAME Retry-After must
+    # not come back in the same instant (the herd the horizon exists
+    # to spread)
+    RETRY_JITTER_FRAC = 0.5
+    # spread (seconds) of the jittered delay before a KIND_RESYNC
+    # full-pack retry — a fleet-wide restart demands resyncs from
+    # every agent in the same tick; an immediate retry would be a
+    # perfectly synchronized full-pack herd by construction. Bounded
+    # by the remaining tick deadline budget.
+    RESYNC_JITTER_S = 2.0
 
     def __init__(
         self,
@@ -511,6 +529,11 @@ class RemotePlanner:
         self._pad_c = 0
         self._pad_s = 0
         self._pad_k = config.max_pods_per_node_hint
+        # private urandom-seeded instance (the kube read path's PR-4
+        # lesson): retry jitter must decorrelate agents/restarts — a
+        # fixed seed would synchronize the very herd it exists to
+        # spread — without perturbing global random state
+        self._retry_rng = random.Random()
         self._fallback = None  # lazy local numpy-oracle planner
         # delta wire (v4): the previous tick's pack + its fingerprint —
         # what this tick's churn delta is diffed against (the agent's
@@ -570,14 +593,27 @@ class RemotePlanner:
             )
         return self._fallback
 
+    def _jittered_horizon(self, suggested: float) -> float:
+        """Stretch a (already-capped) server-suggested horizon by this
+        agent's private jitter: uniform in [1.0, 1+RETRY_JITTER_FRAC).
+        A storm refuses hundreds of agents with near-identical
+        Retry-After values; without this they would all come back in
+        the same instant and re-form the herd the 503 just shed."""
+        return suggested * (
+            1.0 + self._retry_rng.random() * self.RETRY_JITTER_FRAC
+        )
+
     def _note_failure(
         self, ep: _Endpoint, why: str, retry_after: float = 0.0
     ) -> None:
         ep.consecutive_failures += 1
         # one bad LB header must not stall failback for hours: the
         # server-suggested horizon is capped wherever it feeds the skip
-        # window (regression-tested; docs/ROBUSTNESS.md)
+        # window (regression-tested; docs/ROBUSTNESS.md), then jittered
+        # per agent so equal horizons don't re-synchronize the fleet
         suggested = min(max(retry_after, 0.0), self.RETRY_AFTER_CAP_S)
+        if suggested > 0:
+            suggested = self._jittered_horizon(suggested)
         if ep.consecutive_failures >= self.FAIL_THRESHOLD:
             n = ep.consecutive_failures - self.FAIL_THRESHOLD
             backoff = min(
@@ -650,6 +686,16 @@ class RemotePlanner:
         plan_schedule, and the drain-schedule execution handle."""
         return pack_observation(self, observation, pdbs)
 
+    def _resync_retry_delay(self, remaining: float) -> float:
+        """Jittered decorrelation delay before the KIND_RESYNC
+        full-pack retry: uniform over [0, RESYNC_JITTER_S], clamped to
+        at most half the remaining deadline budget (the retry must
+        still have room to complete). 0 when the budget is exhausted."""
+        spread = min(self.RESYNC_JITTER_S, max(0.0, remaining * 0.5))
+        if spread <= 0:
+            return 0.0
+        return self._retry_rng.uniform(0.0, spread)
+
     def _ladder_call(self, path: str, body: bytes, headers: dict,
                      decode, box: dict, delta_body: bytes = None,
                      base_fp: str = "", new_fp: str = "") -> None:
@@ -717,13 +763,24 @@ class RemotePlanner:
                 if isinstance(reply, wire.ResyncDemand):
                     # the service cannot honor the delta's base
                     # (restart, eviction, mismatch, corruption): one
-                    # full pack to the SAME endpoint, same budget
+                    # full pack to the SAME endpoint, same budget.
+                    # NOT immediately — a replica restart stales every
+                    # agent's fingerprint in the same tick, and a
+                    # zero-jitter retry is a perfectly synchronized
+                    # full-pack herd by construction. Sleep a private
+                    # urandom-jittered delay (bounded so most of the
+                    # budget is left for the retry itself) before the
+                    # one full pack.
                     box["resyncs"] = box.get("resyncs", 0) + 1
                     log.info(
                         "planner endpoint %s demanded a full-pack "
                         "resync: %s", ep.url, reply.cause,
                     )
                     remaining = deadline - time.perf_counter()
+                    delay = self._resync_retry_delay(remaining)
+                    if delay > 0:
+                        self.clock.sleep(delay)
+                        remaining = deadline - time.perf_counter()
                     raw = _call(body, max(0.05, remaining))
                     reply = decode(raw)
             except RemoteCallError as err:

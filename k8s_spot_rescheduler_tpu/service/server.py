@@ -76,6 +76,7 @@ from k8s_spot_rescheduler_tpu.service import buckets as bucketing
 from k8s_spot_rescheduler_tpu.service import wire
 from k8s_spot_rescheduler_tpu.service.buckets import Bucket
 from k8s_spot_rescheduler_tpu.service.devhealth import DeviceHealthWatchdog
+from k8s_spot_rescheduler_tpu.solver import memory
 from k8s_spot_rescheduler_tpu.utils.clock import Clock, RealClock
 from k8s_spot_rescheduler_tpu.utils.config import ReschedulerConfig
 from k8s_spot_rescheduler_tpu.utils import logging as log
@@ -358,20 +359,22 @@ class PlannerService:
         return wait_s, False
 
     def _note_shed(
-        self, reason: str, cause: str, tenant: str = "", trace_id: str = ""
+        self, reason: str, cause: str, tenant: str = "", trace_id: str = "",
+        kind: str = "service-shed",
     ) -> None:
         """ONE request shed at an admission edge: fire the labeled
-        ``service_admission_shed_total`` counter and the flight
-        ``service-shed`` event (same reason attr) from this single
-        funnel, one call site per reason, so the two surfaces can be
-        asserted equal per reason (fleet-twin-smoke does)."""
+        ``service_admission_shed_total`` counter and the flight shed
+        event (same reason attr) from this single funnel, one call site
+        per reason, so the two surfaces can be asserted equal per
+        reason (fleet-twin-smoke does). ``kind`` defaults to
+        ``service-shed``; the resync-storm admission edge fires its
+        dedicated ``resync-shed`` flight kind through the same
+        funnel."""
         metrics.update_service_admission_shed(reason)
         attrs = {"reason": reason}
         if tenant:
             attrs["tenant"] = tenant
-        flight.note_event(
-            "service-shed", cause=cause, trace_id=trace_id, **attrs
-        )
+        flight.note_event(kind, cause=cause, trace_id=trace_id, **attrs)
 
     def _finish_wait(
         self, req: _Request, wait_s: float, deadline_capped: bool = False
@@ -537,6 +540,15 @@ class PlannerService:
         self._enqueue(req)
         wait_s, capped = self._bounded_wait(timeout_s)
         return self._finish_wait(req, wait_s, deadline_capped=capped)
+
+    def tenant_cached(self, tenant: str) -> bool:
+        """Whether this tenant currently has delta-wire state cached —
+        the resync admission class keys on it: a fingerprinted full
+        pack from an UNCACHED tenant is a cache-seeding resync ingest
+        (first contact or post-restart re-seed); cached tenants and
+        delta traffic bypass the resync gate entirely."""
+        with self._work:
+            return tenant in self._tenant_cache
 
     def invalidate_tenant_cache(self, tenant: Optional[str] = None) -> int:
         """Drop one tenant's (or every) cached packed state; their next
@@ -1682,6 +1694,25 @@ class ServiceServer:
         self.max_inflight = int(max_inflight)
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # Resync-storm admission class (docs/ROBUSTNESS.md "Resync
+        # storms"): full-pack resync ingests — a fingerprinted full
+        # pack from a tenant with NO cached state (first contact or
+        # post-restart re-seed) — get their own bounded admission:
+        # a concurrent-ingest token bucket plus a byte ledger charging
+        # each ingest its estimated per-tenant HBM footprint (the same
+        # model the batch cap uses). A replica restart under a large
+        # fleet stales every tenant's fingerprint at once; this class
+        # sheds the excess (503 + load-derived Retry-After, reason
+        # resync-storm) so delta traffic and cached tenants keep their
+        # queue-wait SLO instead of the queue collapsing.
+        self.resync_ingest_cap = int(config.service_resync_ingest_cap)
+        self._resync_lock = threading.Lock()
+        self._resync_inflight = 0
+        self._resync_ledger_bytes = 0
+        # refusals not yet drained by a completed ingest — the load
+        # term that makes Retry-After grow with the storm instead of
+        # answering every refused tenant the same static horizon
+        self._resync_pressure = 0
         # flight recorder knobs ride the same config the control loop
         # uses; in service-only mode this process records request-level
         # degradation events (sheds, solve failures) instead of ticks
@@ -1909,6 +1940,10 @@ class ServiceServer:
                     # the delta wire (v4): same endpoint, its own
                     # decode/answer contract (resync-on-anything)
                     return self._post_wire_delta(body, t_req)
+                # ledger charge held by THIS request when it was
+                # admitted as a resync-class ingest (-1 = not one);
+                # released in the finally below
+                resync_charge = -1
                 try:
                     admit_ms = (time.perf_counter() - t_req) * 1e3
                     try:
@@ -1926,6 +1961,40 @@ class ServiceServer:
                     trace_id = req.trace_id or (
                         self.headers.get("X-Trace-Id", "") or ""
                     )
+                    # Resync-storm admission: a fingerprinted full pack
+                    # for a tenant with no cached state is a
+                    # cache-seeding resync ingest (first contact or the
+                    # post-restart re-upload every tenant fires at
+                    # once). It must clear the bounded resync class
+                    # BEFORE entering the queue — delta traffic and
+                    # cached tenants never touch this gate.
+                    if req.pack_fingerprint and not (
+                        server.service.tenant_cached(req.tenant)
+                    ):
+                        ok, retry, charge = server.admit_resync_ingest(
+                            req.packed
+                        )
+                        if not ok:
+                            metrics.update_service_request("rejected")
+                            server.service._note_shed(
+                                "resync-storm",
+                                "full-pack resync ingest refused: "
+                                "concurrent-ingest cap or byte ledger "
+                                "exhausted",
+                                tenant=req.tenant, trace_id=trace_id,
+                                kind="resync-shed",
+                            )
+                            return self._send_bytes(
+                                wire.encode_error(
+                                    "resync ingest shed (storm "
+                                    "admission); retry after the "
+                                    "suggested horizon",
+                                    version=reply_version,
+                                ),
+                                "application/octet-stream", 503,
+                                headers=[("Retry-After", str(retry))],
+                            )
+                        resync_charge = charge
                     try:
                         # the agent declares its own HTTP deadline:
                         # waiting longer server-side would batch-solve
@@ -1995,6 +2064,8 @@ class ServiceServer:
                         "application/octet-stream", 500,
                     )
                 finally:
+                    if resync_charge >= 0:
+                        server.release_resync_ingest(resync_charge)
                     server._release()
 
             def _post_wire_delta(self, body: bytes, t_req: float):
@@ -2129,6 +2200,66 @@ class ServiceServer:
     def _release(self) -> None:
         with self._inflight_lock:
             self._inflight -= 1
+
+    def _resync_ingest_budget(self) -> int:
+        """Byte budget for the resync-ingest ledger: the configured
+        override, else the solver HBM budget the batch cap sizes
+        against, else the device budget."""
+        configured = int(self.config.service_resync_ingest_budget)
+        if configured > 0:
+            return configured
+        return int(self.config.solver_hbm_budget) or memory.device_hbm_budget()
+
+    def admit_resync_ingest(self, packed):
+        """Gate ONE cache-seeding full-pack resync ingest through the
+        bounded admission class. Returns ``(admitted, retry_after_s,
+        charge_bytes)``; an admitted ingest holds one token and
+        ``charge_bytes`` of ledger until :meth:`release_resync_ingest`.
+        Refusals carry a LOAD-derived Retry-After: the measured batch
+        cadence scaled by how deep the storm currently is (in-flight
+        ingests plus undrained refusals, per cap slot) — the herd is
+        answered with staggered horizons, not one synchronized
+        comeback time. A lone over-budget tenant is still admitted
+        when the class is idle (the batch cap's never-zero floor)."""
+        bucket = bucketing.bucket_for(packed)
+        per = bucketing.per_tenant_hbm_bytes(bucket)
+        budget = self._resync_ingest_budget()
+        with self._resync_lock:
+            over_cap = self._resync_inflight >= self.resync_ingest_cap
+            over_budget = (
+                self._resync_inflight > 0
+                and self._resync_ledger_bytes + per > budget
+            )
+            if over_cap or over_budget:
+                self._resync_pressure += 1
+                cadence = max(1, self.service.retry_after())
+                retry = int(math.ceil(
+                    cadence
+                    * (self._resync_inflight + self._resync_pressure)
+                    / max(1, self.resync_ingest_cap)
+                ))
+                return False, max(1, retry), 0
+            self._resync_inflight += 1
+            self._resync_ledger_bytes += per
+            metrics.update_service_resync_ingest(
+                self._resync_inflight, self._resync_ledger_bytes,
+                admitted=True,
+            )
+            return True, 0, per
+
+    def release_resync_ingest(self, charge_bytes: int) -> None:
+        """Return one resync-ingest token (and its ledger bytes); each
+        completed ingest also drains one unit of refusal pressure so
+        Retry-After horizons relax as the storm is worked off."""
+        with self._resync_lock:
+            self._resync_inflight = max(0, self._resync_inflight - 1)
+            self._resync_ledger_bytes = max(
+                0, self._resync_ledger_bytes - int(charge_bytes)
+            )
+            self._resync_pressure = max(0, self._resync_pressure - 1)
+            metrics.update_service_resync_ingest(
+                self._resync_inflight, self._resync_ledger_bytes
+            )
 
     def note_request_trace(self, trace_id: str, tenant: str, spans) -> None:
         """Remember one request's server-side span block, keyed by the

@@ -28,10 +28,19 @@ What a twin keeps from the real agent, deliberately:
   ``SolverPlanner`` — the serve-smoke correctness contract at fleet
   scale.
 
-What it drops: local-fallback planning, the delta wire, tracing. A twin
-that cannot reach any replica records a shed tick and moves on — the
-fleet bench asserts on the ACCOUNTING of that degradation, not on
-hiding it.
+- since the resync-storm hardening, the twin speaks the agent's FULL
+  protocol ladder: v4 ``KIND_PACKED_DELTA`` with pack fingerprints
+  (delta to an endpoint whose ``acked_fp`` matches the base, full pack
+  otherwise), KIND_RESYNC handling with a jittered decorrelated
+  full-pack retry on the virtual clock (per-twin seeded RNG — distinct
+  seeds decorrelate the herd deterministically), and occasional v3
+  ``schedule_horizon`` requests — so the 512-twin fleet exercises the
+  same anti-entropy contract production agents run, restart storms
+  included.
+
+What it drops: local-fallback planning and tracing. A twin that cannot
+reach any replica records a shed tick and moves on — the fleet bench
+asserts on the ACCOUNTING of that degradation, not on hiding it.
 """
 
 from __future__ import annotations
@@ -46,6 +55,10 @@ import numpy as np
 from k8s_spot_rescheduler_tpu.io.synthetic import CONFIGS, generate_cluster
 from k8s_spot_rescheduler_tpu.loop import flight
 from k8s_spot_rescheduler_tpu.metrics import registry as metrics
+from k8s_spot_rescheduler_tpu.models.columnar import (
+    emit_packed_delta,
+    pack_fingerprint,
+)
 from k8s_spot_rescheduler_tpu.service import wire
 from k8s_spot_rescheduler_tpu.service.agent import (
     Endpoint,
@@ -61,6 +74,12 @@ from k8s_spot_rescheduler_tpu.utils import logging as log
 # under the fleet clock (the handler blocks in real time only for the
 # host solves ahead of it), so this only bounds a hung socket
 HTTP_TIMEOUT_S = 30.0
+
+# every Nth offered tick asks for a whole drain schedule (wire v3)
+# instead of a single plan, when the config has schedules on — the
+# fleet exercises the KIND_PLAN_SCHEDULE surface at scale without
+# paying the horizon-times solve cost on every tick
+SCHEDULE_EVERY = 16
 
 # heterogeneity menu: (n_on_demand, n_spot, n_pods) size tiers chosen
 # to land in DIFFERENT power-of-two service buckets, so a mixed fleet
@@ -215,6 +234,26 @@ class TenantTwin:
         self.last_error = ""
         self._parked_pod = None
         self._storm_nodes: List[object] = []  # NodeSpec parked by a storm
+        # delta wire (v4): the last PLAN tick's pack + fingerprint —
+        # the base the next tick's delta diffs against (per-endpoint
+        # acked_fp gates actually shipping it, exactly as in the agent)
+        self._prev_packed = None
+        self._prev_fp = ""
+        # a KIND_RESYNC demand is pending its one full-pack answer;
+        # while set, delta emission is suppressed (the retry is a full
+        # pack by construction, and acked_fp stays stale until served)
+        self._need_full = False
+        # jittered early re-tick the fleet loop honors instead of the
+        # cadence (the virtual-clock form of the agent's jittered
+        # in-budget resync retry): 0 = no early retry scheduled
+        self.retry_due = 0.0
+        # protocol accounting the storm bench aggregates
+        self.resyncs = 0          # KIND_RESYNC demands observed
+        self.full_posts = 0       # full-pack bodies POSTed
+        self.full_served = 0      # full packs acknowledged by a replica
+        self.delta_posts = 0      # delta bodies POSTed
+        self.schedule_ticks = 0   # v3 schedule requests served
+        self.wire_bytes_sent = 0  # request-body bytes, pack and delta
 
     # ------------------------------------------------------------------
     # wire client
@@ -229,6 +268,14 @@ class TenantTwin:
         suggested = min(
             max(retry_after, 0.0), RemotePlanner.RETRY_AFTER_CAP_S
         )
+        if suggested > 0:
+            # the agent's decorrelation stretch, from the twin's OWN
+            # seeded RNG: distinct per-twin seeds spread equal server
+            # horizons across the fleet deterministically
+            suggested *= (
+                1.0
+                + float(self.rng.random()) * RemotePlanner.RETRY_JITTER_FRAC
+            )
         if ep.consecutive_failures >= RemotePlanner.FAIL_THRESHOLD:
             n = ep.consecutive_failures - RemotePlanner.FAIL_THRESHOLD
             backoff = min(
@@ -240,15 +287,65 @@ class TenantTwin:
             ep.skip_until = self.clock.now() + suggested
 
     def tick(self) -> Optional[wire.PlanReply]:
-        """One planning tick: pack (memoized O(1) on a quiet tick),
-        POST down the breaker-ordered endpoint list, decode. Returns
-        the reply, or None when every endpoint refused/failed — a shed
-        tick, counted, never raised."""
+        """One planning tick on the agent's full protocol ladder: pack
+        (memoized O(1) on a quiet tick), fingerprint + delta against
+        the previous plan tick's pack, then POST down the
+        breaker-ordered endpoint list — the churn delta to an endpoint
+        whose ``acked_fp`` matches the base, the fingerprinted full
+        pack otherwise; every ``SCHEDULE_EVERY``-th tick asks for a v3
+        drain schedule instead. A KIND_RESYNC answer defers ONE full
+        pack to a jittered ``retry_due`` (decorrelation on the virtual
+        clock — the agent sleeps the same jitter in real time).
+        Returns the reply, or None when unserved this tick."""
         self.offered += 1
         self.last_reply = None
+        self.retry_due = 0.0
+        schedule_tick = (
+            self.cfg.schedule_horizon > 0
+            and not self._need_full
+            and self.served > 0
+            and self.offered % SCHEDULE_EVERY == 0
+        )
         try:
             packed, meta = self.store.pack(self.pdbs)
-            body = wire.encode_plan_request(self.spec.name, packed)
+            if schedule_tick:
+                # a schedule request ships the full pack WITHOUT a
+                # fingerprint (the agent's plan_schedule contract): it
+                # neither seeds the tenant cache nor advances the
+                # delta base
+                body = wire.encode_plan_request(
+                    self.spec.name, packed,
+                    schedule_horizon=int(self.cfg.schedule_horizon),
+                )
+                fp = ""
+                delta_body = None
+                base_fp = ""
+            else:
+                fp = pack_fingerprint(packed)
+                delta = None
+                base_fp = ""
+                if self._prev_packed is not None and not self._need_full:
+                    # None on shape growth past the high-water pads:
+                    # this tick ships the full pack (and re-seeds)
+                    delta = emit_packed_delta(self._prev_packed, packed)
+                    base_fp = self._prev_fp
+                body = wire.encode_plan_request(
+                    self.spec.name, packed, pack_fingerprint=fp,
+                )
+                delta_body = (
+                    wire.encode_packed_delta(
+                        self.spec.name, delta,
+                        base_fingerprint=base_fp, new_fingerprint=fp,
+                    )
+                    if delta is not None
+                    and any(ep.acked_fp == base_fp for ep in self.endpoints)
+                    else None
+                )
+                # the next tick diffs against THIS pack regardless of
+                # how the tick ends — the per-endpoint acked
+                # fingerprints gate shipping, exactly as in the agent
+                self._prev_packed = packed
+                self._prev_fp = fp
         except Exception as err:  # noqa: BLE001 — a twin must never
             # take the fleet loop down; counted + flight-recorded and
             # asserted ZERO by the fleet bench
@@ -264,12 +361,26 @@ class TenantTwin:
         now = self.clock.now()
         reply = None
         served_by = -1
+        sent_delta = False
         for slot, ep in enumerate(self.endpoints):
             if ep.skip_until > now:
                 continue
+            use_delta = (
+                delta_body is not None and ep.acked_fp == base_fp
+            )
+            payload = delta_body if use_delta else body
             try:
-                raw = post_plan(f"{ep.url}/v2/plan", body, headers)
-                reply = wire.decode_plan_reply(raw)
+                raw = post_plan(f"{ep.url}/v2/plan", payload, headers)
+                self.wire_bytes_sent += len(payload)
+                if use_delta:
+                    self.delta_posts += 1
+                    decoded = wire.decode_plan_or_resync(raw)
+                elif schedule_tick:
+                    self.full_posts += 1
+                    decoded = wire.decode_plan_schedule_reply(raw)
+                else:
+                    self.full_posts += 1
+                    decoded = wire.decode_plan_reply(raw)
             except RemoteCallError as err:
                 self.last_error = str(err)
                 self._note_endpoint_failure(
@@ -289,12 +400,56 @@ class TenantTwin:
                     "twin-crash", cause=f"tick failed: {err}",
                 )
                 return None
+            if isinstance(decoded, wire.ResyncDemand):
+                # protocol, not failure: no breaker, no failover walk.
+                # The one full-pack answer is DEFERRED a jittered
+                # moment (per-twin seeded RNG) — 256 tenants staled by
+                # one restart must not re-upload in the same instant.
+                # This replica does NOT hold the base it acked (that is
+                # what it just said): drop the stale fingerprint, or a
+                # quiet tenant's unchanged fp would "match" again after
+                # a restart and demand a second resync
+                ep.acked_fp = ""
+                self.resyncs += 1
+                self._need_full = True
+                self.last_error = f"resync: {decoded.cause}"
+                # spread the full-pack answers over up to half a
+                # cadence (capped at the agent's 30s retry ceiling):
+                # a restart stales a whole replica's tenants at once,
+                # and a 2s herd of full packs is the storm the server
+                # would then have to shed
+                spread = max(
+                    RemotePlanner.RESYNC_JITTER_S,
+                    min(self.spec.cadence_s * 0.5,
+                        RemotePlanner.RETRY_AFTER_CAP_S),
+                )
+                self.retry_due = now + float(
+                    self.rng.uniform(0.05, spread)
+                )
+                return None
+            reply = decoded
+            sent_delta = use_delta
             ep.consecutive_failures = 0
             ep.skip_until = 0.0
+            if fp:
+                # this replica now holds exactly this pack (full
+                # upload, or delta applied over an acknowledged base)
+                ep.acked_fp = fp
             served_by = slot
             break
         if reply is None:
             self.shed_ticks += 1
+            if self._need_full:
+                # a storm-refused resync retry: come back when the
+                # soonest breaker window opens, plus jitter — the
+                # load-derived Retry-After horizons (different per
+                # refusal) stagger the fleet's convergence
+                soonest = min(
+                    (ep.skip_until for ep in self.endpoints), default=now
+                )
+                self.retry_due = max(soonest, now) + float(
+                    self.rng.uniform(0.1, RemotePlanner.RESYNC_JITTER_S)
+                )
             return None
         if served_by > 0:
             # ONE fire site for the twin's failover edge: the metric
@@ -307,11 +462,17 @@ class TenantTwin:
                 reason=f"slot-{served_by}",
             )
         self.served += 1
+        if fp and not sent_delta:
+            self.full_served += 1
+            self._need_full = False
+        if schedule_tick:
+            self.schedule_ticks += 1
         self.wait_samples_ms.append(float(reply.queue_wait_ms))
         self.wait_sample_t.append(now)
-        self.last_reply = reply
-        self.last_meta = meta
-        return reply
+        if not schedule_tick:
+            self.last_reply = reply
+            self.last_meta = meta
+        return reply if not schedule_tick else None
 
     # ------------------------------------------------------------------
     # correctness spot check

@@ -260,6 +260,23 @@ class ReschedulerConfig:
     # evicted with 503 + Retry-After derived from the measured batch
     # cadence (service_tenant_evictions_total, per tenant).
     service_queue_timeout: float = 30.0
+    # Resync-storm admission class (docs/ROBUSTNESS.md "Resync
+    # storms"): max full-pack resync ingests (fingerprinted full pack
+    # for a tenant with no cached state — first contact or post-restart
+    # re-seed) allowed in flight at once. A replica restart under a
+    # large fleet stales every tenant's fingerprint simultaneously;
+    # this token bucket keeps the correlated full-pack herd from
+    # starving delta traffic — excess ingests are refused with a typed
+    # 503 + load-derived Retry-After (shed reason resync-storm) instead
+    # of collapsing the queue.
+    service_resync_ingest_cap: int = 4
+    # Byte budget for the resync-ingest ledger: in-flight resync
+    # ingests charge their per-tenant HBM footprint (the same
+    # estimate_union_hbm_breakdown model that sizes the batch cap)
+    # against this. 0 = derive from solver_hbm_budget / the device HBM
+    # budget. One over-budget ingest is still admitted when the class
+    # is idle (mirrors the batch cap's never-zero floor).
+    service_resync_ingest_budget: int = 0
     # Anti-entropy resync audit (io/watch.py): every interval, one
     # LIST per watched resource is diffed field-by-field against the
     # incremental mirror; drift forces a store replace + full repack
@@ -331,6 +348,17 @@ class ReschedulerConfig:
             )
         if self.service_queue_timeout <= 0:
             raise ValueError("service_queue_timeout must be > 0")
+        if self.service_resync_ingest_cap < 1:
+            raise ValueError(
+                "service_resync_ingest_cap must be >= 1 (the class "
+                "must admit at least one ingest or no tenant can ever "
+                "seed its cache)"
+            )
+        if self.service_resync_ingest_budget < 0:
+            raise ValueError(
+                "service_resync_ingest_budget must be >= 0 (0 = derive "
+                "from the HBM budget)"
+            )
         if self.device_sick_threshold < 0:
             raise ValueError(
                 "device_sick_threshold must be >= 0 (0 = watchdog off)"

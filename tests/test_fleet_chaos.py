@@ -429,17 +429,21 @@ def test_retry_after_above_breaker_threshold_capped_regression():
     agent = RemotePlanner(cfg, "http://x:1", tenant="t", clock=clock)
     ep = agent._endpoints[0]
 
+    jit = 1.0 + RemotePlanner.RETRY_JITTER_FRAC
+
     # failure 1 (below threshold, no retry-after): warn only
     agent._note_failure(ep, "HTTP 503", 0.0)
     assert ep.skip_until == 0.0
     # failure 2 (AT threshold): base backoff 5 s, server suggests 20 s
-    # -> the longer server horizon wins
+    # -> the longer server horizon wins (stretched by at most the
+    # per-agent decorrelation jitter)
     agent._note_failure(ep, "HTTP 503", 20.0)
-    assert ep.skip_until == pytest.approx(clock.now() + 20.0)
-    # failure 3: server suggests an hour -> capped at 30 s (the backoff
-    # schedule value 10 s is smaller, so the cap IS the horizon)
+    assert clock.now() + 20.0 <= ep.skip_until < clock.now() + 20.0 * jit
+    # failure 3: server suggests an hour -> the SERVER's word is capped
+    # at 30 s before the jitter stretch (the backoff schedule value
+    # 10 s is smaller, so the capped suggestion is the horizon)
     agent._note_failure(ep, "HTTP 503", 3600.0)
-    assert ep.skip_until == pytest.approx(clock.now() + 30.0)
+    assert clock.now() + 30.0 <= ep.skip_until < clock.now() + 30.0 * jit
     # deep into the schedule the doubling backoff exceeds the cap and
     # rules unchallenged
     for _ in range(4):
@@ -448,8 +452,10 @@ def test_retry_after_above_breaker_threshold_capped_regression():
     # below threshold a fresh endpoint still honors (capped) Retry-After
     agent2 = RemotePlanner(cfg, "http://y:1", tenant="t", clock=clock)
     agent2._note_failure(agent2._endpoints[0], "HTTP 503", 3600.0)
-    assert agent2._endpoints[0].skip_until == pytest.approx(
+    assert (
         clock.now() + 30.0
+        <= agent2._endpoints[0].skip_until
+        < clock.now() + 30.0 * jit
     )
 
 

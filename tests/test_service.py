@@ -584,3 +584,200 @@ def test_queue_timeout_eviction_fires_labeled_shed():
         and e["attrs"].get("reason") == "queue-timeout"
     ]
     assert len(fresh) == 1  # one fire site, metric and ledger agree
+
+
+# ---------------------------------------------------------------------------
+# resync-storm ingest admission (docs/ROBUSTNESS.md "Resync storms")
+
+
+def _ingest_server(**cfg_kwargs) -> ServiceServer:
+    """An unstarted ServiceServer (port 0, FakeClock): the admission
+    gate lives on the server object, no HTTP needed to exercise it."""
+    return ServiceServer(
+        ReschedulerConfig(solver="numpy", **cfg_kwargs),
+        "127.0.0.1:0", batch_window_s=0, clock=FakeClock(),
+    )
+
+
+def test_resync_ingest_cap_refuses_excess():
+    """The concurrent-ingest token bucket: cap admissions hold tokens,
+    the cap+1th is refused (typed, with a horizon), and releases return
+    both the token and the ledger bytes."""
+    srv = _ingest_server()
+    try:
+        packed = tiny_packed()
+        per = bucketing.per_tenant_hbm_bytes(bucketing.bucket_for(packed))
+        charges = []
+        for _ in range(srv.resync_ingest_cap):
+            ok, retry, charge = srv.admit_resync_ingest(packed)
+            assert ok and retry == 0 and charge == per
+            charges.append(charge)
+        assert srv._resync_inflight == srv.resync_ingest_cap
+        assert srv._resync_ledger_bytes == per * srv.resync_ingest_cap
+        ok, retry, charge = srv.admit_resync_ingest(packed)
+        assert not ok and retry >= 1 and charge == 0
+        for c in charges:
+            srv.release_resync_ingest(c)
+        assert srv._resync_inflight == 0
+        assert srv._resync_ledger_bytes == 0
+        ok, _, charge = srv.admit_resync_ingest(packed)  # tokens back
+        assert ok
+        srv.release_resync_ingest(charge)
+    finally:
+        srv.close()
+
+
+def test_resync_ingest_retry_after_grows_with_load():
+    """Refusal horizons are LOAD-derived, not static: each undrained
+    refusal deepens the pressure term, so the k-th refused tenant in a
+    storm is told a strictly later comeback than the (k-1)-th — the
+    herd disperses instead of re-forming on one synchronized instant."""
+    srv = _ingest_server()
+    try:
+        srv.service._cadence_s = 4.0  # measured batch cadence
+        packed = tiny_packed()
+        held = [srv.admit_resync_ingest(packed)[2]
+                for _ in range(srv.resync_ingest_cap)]
+        cap = srv.resync_ingest_cap
+        horizons = [srv.admit_resync_ingest(packed)[1] for _ in range(3)]
+        # ceil(cadence * (inflight + pressure) / cap): 5, 6, 7 at cap 4
+        expect = [
+            int(np.ceil(4.0 * (cap + k) / cap)) for k in (1, 2, 3)
+        ]
+        assert horizons == expect
+        assert horizons == sorted(set(horizons))  # strictly increasing
+        # a completed ingest drains one unit of pressure: the storm
+        # being worked off relaxes the horizon
+        srv.release_resync_ingest(held.pop())
+        relaxed = srv.admit_resync_ingest(packed)[1]
+        assert relaxed <= horizons[-1]
+        for c in held:
+            srv.release_resync_ingest(c)
+    finally:
+        srv.close()
+
+
+def test_resync_ingest_byte_ledger_bounds_admission():
+    """The byte ledger: a second concurrent ingest that would overflow
+    the configured budget is refused even with cap tokens free — but a
+    lone over-budget tenant is still admitted when the class is idle
+    (the batch cap's never-zero floor), so one big tenant can't be
+    locked out forever."""
+    packed = tiny_packed()
+    per = bucketing.per_tenant_hbm_bytes(bucketing.bucket_for(packed))
+    srv = _ingest_server(
+        service_resync_ingest_budget=int(per * 1.5)
+    )
+    try:
+        ok, _, charge = srv.admit_resync_ingest(packed)
+        assert ok  # idle-class floor: admitted though per > budget/2
+        ok2, retry2, _ = srv.admit_resync_ingest(packed)
+        assert not ok2 and retry2 >= 1  # ledger full, tokens free
+        assert srv._resync_inflight < srv.resync_ingest_cap
+        srv.release_resync_ingest(charge)
+        ok3, _, charge3 = srv.admit_resync_ingest(packed)
+        assert ok3  # bytes returned -> admissible again
+        srv.release_resync_ingest(charge3)
+    finally:
+        srv.close()
+
+
+def test_resync_gate_spares_delta_and_cached_traffic(wire_server):
+    """The storm gate only sees cache-seeding resync ingests: cached
+    tenants and unfingerprinted requests plan normally while excess
+    resyncs shed typed 503 + Retry-After, and the labeled metric and
+    the resync-shed flight ledger move in lockstep (one fire site)."""
+    import urllib.error
+    import urllib.request
+
+    from prometheus_client import REGISTRY as _REG
+
+    from k8s_spot_rescheduler_tpu.loop import flight
+    from k8s_spot_rescheduler_tpu.models.columnar import pack_fingerprint
+
+    def post(tenant, *, fp=False, seed=0):
+        packed = tiny_packed(seed=seed)
+        body = wire.encode_plan_request(
+            tenant, packed,
+            pack_fingerprint=pack_fingerprint(packed) if fp else "",
+        )
+        req = urllib.request.Request(
+            f"http://{wire_server.address}/v2/plan", data=body,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as err:
+            err.read()
+            return err.code, dict(err.headers)
+
+    code, _ = post("cached-t", fp=True)  # seeds the tenant cache
+    assert code == 200
+    assert wire_server.service.tenant_cached("cached-t")
+
+    name = "spot_rescheduler_service_admission_shed_total"
+    before = _REG.get_sample_value(name, {"reason": "resync-storm"}) or 0
+    seq0 = max(
+        (e["seq"] for e in flight.events("resync-shed")), default=-1
+    )
+    old_cap = wire_server.resync_ingest_cap
+    wire_server.resync_ingest_cap = 0  # every resync ingest refuses
+    try:
+        code, _ = post("cached-t", fp=True, seed=1)
+        assert code == 200  # cached tenant: bypasses the gate
+        code, _ = post("plain-t")
+        assert code == 200  # no fingerprint: not a resync ingest
+        code, headers = post("storm-t", fp=True)
+        assert code == 503  # uncached full-pack resync: shed
+        assert int(headers.get("Retry-After", "0")) >= 1
+        assert not wire_server.service.tenant_cached("storm-t")
+    finally:
+        wire_server.resync_ingest_cap = old_cap
+    after = _REG.get_sample_value(name, {"reason": "resync-storm"}) or 0
+    assert after == before + 1
+    fresh = [
+        e for e in flight.events("resync-shed") if e["seq"] > seq0
+    ]
+    assert len(fresh) == 1  # flight delta == metric delta
+    assert fresh[0]["attrs"].get("reason") == "resync-storm"
+    # the shed tenant retries into an idle class and is admitted
+    code, _ = post("storm-t", fp=True)
+    assert code == 200
+
+
+def test_retry_jitter_decorrelates_equal_horizons():
+    """Two agents handed the SAME Retry-After must not come back in the
+    same instant: each agent's private urandom-seeded jitter stretches
+    the horizon independently, so equal 503s from one overloaded
+    replica don't re-form the herd it just shed (PR-10's 30s cap still
+    bounds the stretch)."""
+    from k8s_spot_rescheduler_tpu.service.agent import RemotePlanner
+
+    clock = FakeClock()
+    cfg = ReschedulerConfig(solver="numpy")
+    agents = [
+        RemotePlanner(cfg, "http://127.0.0.1:1", tenant=f"t{i}",
+                      clock=clock)
+        for i in range(2)
+    ]
+    horizon = 9.0
+    for a in agents:
+        a._note_failure(a._endpoints[0], "storm 503",
+                        retry_after=horizon)
+    skips = [a._endpoints[0].skip_until for a in agents]
+    now = clock.now()
+    lo = now + horizon
+    hi = now + horizon * (1.0 + RemotePlanner.RETRY_JITTER_FRAC)
+    for s in skips:
+        assert lo <= s <= hi
+    assert skips[0] != skips[1]  # decorrelated: no shared comeback tick
+    # the cap still rules: an absurd LB header can't park an endpoint
+    a = agents[0]
+    a._endpoints[0].consecutive_failures = 0
+    a._note_failure(a._endpoints[0], "bad LB", retry_after=86400.0)
+    cap = RemotePlanner.RETRY_AFTER_CAP_S
+    assert a._endpoints[0].skip_until <= now + cap * (
+        1.0 + RemotePlanner.RETRY_JITTER_FRAC
+    )
