@@ -4,7 +4,7 @@
 IMAGE ?= k8s-spot-rescheduler-tpu
 VERSION ?= $(shell python -c "import k8s_spot_rescheduler_tpu as m; print(m.VERSION)")
 
-.PHONY: all check lint analyze audit-jaxpr test bench bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke storm-smoke quality replay demo dryrun docker-build clean native
+.PHONY: all check lint analyze audit-jaxpr verify-protocol test bench bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke storm-smoke quality replay demo dryrun docker-build clean native
 
 # `native` is optional (io/native_ingest.py degrades gracefully without
 # the .so) — a missing C++ toolchain must not block tests, so `all`
@@ -14,12 +14,13 @@ all:
 	$(MAKE) check
 
 # The CI entry: lint+format gate, then the project-wide analysis suite
-# (ast tier), then the jaxpr-tier program audit, then tests, then the
-# smokes — mirroring the reference's fmt/golangci-lint/vet/test chain
-# (reference Makefile:36-65). tools/lint.py is the fmt+golangci-lint
-# stand-in and tools/analysis is the go-vet analog, two tiers deep
-# (this image ships no Python linter and installs are forbidden).
-check: lint analyze audit-jaxpr test bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke storm-smoke
+# (ast tier), then the jaxpr-tier program audit, then the proto-tier
+# protocol verification, then tests, then the smokes — mirroring the
+# reference's fmt/golangci-lint/vet/test chain (reference
+# Makefile:36-65). tools/lint.py is the fmt+golangci-lint stand-in and
+# tools/analysis is the go-vet analog, three tiers deep (this image
+# ships no Python linter and installs are forbidden).
+check: lint analyze audit-jaxpr verify-protocol test bench-smoke scale-smoke serve-smoke sched-smoke pallas-smoke repair-smoke chaos-smoke watch-soak fleet-chaos-smoke fleet-twin-smoke storm-smoke
 
 lint:
 	python tools/lint.py
@@ -39,6 +40,19 @@ analyze:
 # Pure abstract eval — no device, no execution; must finish in 30 s.
 audit-jaxpr:
 	env JAX_PLATFORMS=cpu python -m tools.analysis --tier jaxpr --max-seconds 30
+
+# Proto-tier protocol verification (docs/ANALYSIS.md "Protocol tier"):
+# exhaustively explores the wire/resync/breaker/admission protocol
+# model (service/protocol_model.py) — 2 agents x 2 replicas under
+# message loss, reordering, duplication and a replica restart — proving
+# the safety invariants (single full-pack per restart epoch, no delta
+# over a mismatched fingerprint, admission inflight <= cap, version-mix
+# frame legality) and storm-drain liveness on every reachable state,
+# then binds the model's tables to the live wire/agent/server constants
+# in both directions (protocol-contract) so neither side can drift
+# silently. Pure Python BFS — no device, no network; must finish in 60 s.
+verify-protocol:
+	python -m tools.analysis --tier proto --max-seconds 60
 
 # best-effort native build first: the native differential suite fails
 # (not skips) when a toolchain exists but the library won't load

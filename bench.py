@@ -1304,6 +1304,27 @@ def run_smoke(args, metric: str, unit: str) -> int:
             file=sys.stderr,
         )
     ok = ok and audit_ok
+    # proto-tier protocol verification cost (make verify-protocol):
+    # same deal — fresh subprocess, full model exploration + contract
+    # binding; the trajectory watches state-space growth here. A red
+    # verification fails the smoke.
+    t_proto = time.perf_counter()
+    proto = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--tier", "proto"],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    verify_protocol_ms = (time.perf_counter() - t_proto) * 1e3
+    proto_ok = proto.returncode == 0
+    if not proto_ok:
+        print(
+            f"bench-smoke: protocol verification RED "
+            f"(rc={proto.returncode}):\n"
+            f"{proto.stdout[-2000:]}\n{proto.stderr[-2000:]}",
+            file=sys.stderr,
+        )
+    ok = ok and proto_ok
     print(
         f"bench-smoke: uploads per tick {uploads} B  "
         f"tick ms {[round(t, 1) for t in tick_ms]}  "
@@ -1341,6 +1362,9 @@ def run_smoke(args, metric: str, unit: str) -> int:
             # full jaxpr-tier audit wall (subprocess incl. jax import):
             # the tracing-cost trajectory for `make audit-jaxpr`
             "audit_jaxpr_ms": round(audit_jaxpr_ms, 1),
+            # proto-tier model exploration + contract wall: the
+            # state-space-growth trajectory for `make verify-protocol`
+            "verify_protocol_ms": round(verify_protocol_ms, 1),
             "ok": ok,
         }
     )

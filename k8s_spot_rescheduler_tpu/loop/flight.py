@@ -61,6 +61,7 @@ CONTEXT_KINDS = frozenset({
     "orphan-taint-recovered",
     "stale-mirror-plan-refused",
     "device-recovered",        # hysteresis probes passed; device resumes
+    "twin-crash",              # contained fleet-twin pack/encode crash
 })
 EVENT_KINDS = DEGRADATION_KINDS | CONTEXT_KINDS
 

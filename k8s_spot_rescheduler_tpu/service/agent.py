@@ -174,7 +174,11 @@ class _WireSocket:
             self.requests += 1
             self.sock.settimeout(max(0.05, timeout))
             try:
-                self.sock.sendall(data)
+                # the send lock is HELD across the socket write on
+                # purpose: it serializes whole frames onto the shared
+                # pipelined connection — two ticks interleaving bytes
+                # mid-frame would corrupt the wire
+                self.sock.sendall(data)  # noqa: lock-graph
             except BaseException:
                 self.broken = True
                 raise

@@ -55,15 +55,21 @@ def lint_file(tmp_path, source: str, name="seeded.py"):
 
 
 def analyze_tree(tmp_path, *extra, tier="ast"):
-    """Analyze a fixture tree: no baseline, the fixture's parity file
-    (created empty when the fixture ships none), ast tier unless the
-    test says otherwise (fixture trees exercise one tier at a time;
-    the real-tree gate runs both)."""
+    """Analyze a fixture tree: no baseline, the fixture's parity and
+    observability files (created empty when the fixture ships none —
+    flight-contract fixtures must document their own kinds, and the
+    real repo's docs must never leak into a fixture), ast tier unless
+    the test says otherwise (fixture trees exercise one tier at a
+    time; the real-tree gate runs all three)."""
     parity = tmp_path / "PARITY.md"
     if not parity.exists():
         parity.write_text("")
+    obs = tmp_path / "OBSERVABILITY.md"
+    if not obs.exists():
+        obs.write_text("")
     return run_analysis(
-        tmp_path, "--no-baseline", "--parity", parity, "--tier", tier,
+        tmp_path, "--no-baseline", "--parity", parity,
+        "--observability", obs, "--tier", tier,
         *extra,
     )
 

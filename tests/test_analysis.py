@@ -44,6 +44,15 @@ def test_jaxpr_tier_clean_within_watchdog():
     assert r.returncode == 0, f"jaxpr gate is red:\n{r.stdout}{r.stderr}"
 
 
+def test_proto_tier_clean_within_watchdog():
+    """`make verify-protocol` acceptance: the full proto tier — both
+    declared product automata exhaustively explored (safety + deadlock
+    + storm-drain liveness) plus the model<->implementation contract —
+    runs CLEAN on an empty baseline and inside the 60 s budget."""
+    r = _run("--tier", "proto", "--max-seconds", "60")
+    assert r.returncode == 0, f"proto gate is red:\n{r.stdout}{r.stderr}"
+
+
 def test_noqa_trailing_prose_still_suppresses(tmp_path):
     """Prose after a code must not merge into the code token."""
     _seed(tmp_path, "solver/prose.py", """\
@@ -135,6 +144,30 @@ def test_single_tier_does_not_stale_other_tiers_baseline(tmp_path):
     assert "stale-baseline" not in r.stdout
 
 
+def test_proto_tier_does_not_stale_other_tiers_baseline(tmp_path):
+    """A proto-only run must not call ast/jaxpr baseline entries stale:
+    their passes never ran (tier-qualified staleness, third tier)."""
+    _seed(tmp_path, "solver/bad.py", """\
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x.item()
+    """)
+    parity = tmp_path / "PARITY.md"
+    parity.write_text("")
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "solver/bad.py::jax-host-sync::42  # ast-tier debt\n"
+        "solver/bad.py::index-width::prog.check  # jaxpr-tier debt\n"
+    )
+    r = _run(
+        tmp_path, "--tier", "proto", "--baseline", baseline,
+        "--parity", parity,
+    )
+    assert "stale-baseline" not in r.stdout
+
+
 def test_unknown_pass_name_errors():
     """A --pass typo must error, not report a vacuously clean tree."""
     r = _run("--pass", "jax-hostsync-typo")
@@ -149,6 +182,21 @@ def test_pass_tier_mismatch_errors():
     assert r.returncode != 0
     assert "jaxpr-tier pass" in r.stderr
     r = _run("--tier", "jaxpr", "--pass", "lock-discipline")
+    assert r.returncode != 0
+    assert "ast-tier pass" in r.stderr
+
+
+def test_pass_tier_mismatch_errors_proto():
+    """The same tier/pass coherence holds for the proto tier: a proto
+    pass under another tier (and an ast pass under --tier proto) is an
+    argparse error, never a vacuously clean run."""
+    r = _run("--tier", "ast", "--pass", "protocol-model")
+    assert r.returncode != 0
+    assert "proto-tier pass" in r.stderr
+    r = _run("--tier", "jaxpr", "--pass", "protocol-contract")
+    assert r.returncode != 0
+    assert "proto-tier pass" in r.stderr
+    r = _run("--tier", "proto", "--pass", "lock-graph")
     assert r.returncode != 0
     assert "ast-tier pass" in r.stderr
 
@@ -1248,3 +1296,482 @@ def test_json_output_schema(tmp_path):
     assert f["code"] == "jax-host-sync"
     assert f["severity"] == "error"
     assert f["tier"] == "ast"
+
+
+def test_json_tier_runtimes(tmp_path):
+    """--json carries a tier_runtimes_ms block: one entry per tier
+    that actually ran (the trajectory the smoke line samples)."""
+    _seed(tmp_path, "solver/ok.py", "x = 1\n")
+    r = _analyze_tree(tmp_path, "--json")
+    out = json.loads(r.stdout)
+    rt = out["tier_runtimes_ms"]
+    assert set(rt) == {"ast"}
+    assert rt["ast"] >= 0
+    r = _analyze_tree(tmp_path, "--json", tier="proto")
+    rt = json.loads(r.stdout)["tier_runtimes_ms"]
+    assert set(rt) == {"proto"}
+
+
+# --- flight-contract ------------------------------------------------------
+
+
+def test_seeded_flight_contract_all_three_directions(tmp_path):
+    """One fixture, all three drift directions red at once: a kind
+    emitted but undeclared, a kind declared but never emitted, and a
+    declared+emitted kind missing from the operator doc — while the
+    fully-wired kind stays clean."""
+    _seed(tmp_path, "pkg/loop/flight.py", """\
+        DEGRADATION_KINDS = frozenset({
+            "good",
+            "dead",
+        })
+        CONTEXT_KINDS = frozenset({
+            "undoc",
+        })
+
+        def note_event(kind, **attrs):
+            pass
+    """)
+    _seed(tmp_path, "pkg/loop/ctrl.py", """\
+        from pkg.loop import flight
+
+        def tick():
+            flight.note_event("good", phase="x")
+            flight.note_event("rogue", phase="y")
+            flight.note_event("undoc")
+    """)
+    (tmp_path / "OBSERVABILITY.md").write_text(
+        "| `good` | yes | ... |\n| `dead` | yes | ... |\n"
+    )
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    hits = [l for l in r.stdout.splitlines() if "flight-contract" in l]
+    assert len(hits) == 3, r.stdout
+    assert any("'rogue'" in h and "absent from" in h for h in hits)
+    assert any("'dead'" in h and "no call site ever emits" in h
+               for h in hits)
+    assert any("'undoc'" in h and "not documented" in h for h in hits)
+
+
+def test_flight_contract_funnel_kinds_count_as_emissions(tmp_path):
+    """A funnel (a ``kind``-parameter function forwarding into
+    note_event) emits its callers' literal ``kind=`` kwargs AND its own
+    literal default — the server's ``_note_shed`` shape stays green."""
+    _seed(tmp_path, "pkg/loop/flight.py", """\
+        DEGRADATION_KINDS = frozenset({
+            "service-shed",
+            "resync-shed",
+        })
+
+        def note_event(kind, **attrs):
+            pass
+    """)
+    _seed(tmp_path, "pkg/service/server.py", """\
+        from pkg.loop import flight
+
+        class Handler:
+            def _note_shed(self, reason, kind="service-shed"):
+                flight.note_event(kind, reason=reason)
+
+            def reject(self):
+                self._note_shed("queue-timeout")
+
+            def storm(self):
+                self._note_shed("resync-storm", kind="resync-shed")
+    """)
+    (tmp_path / "OBSERVABILITY.md").write_text(
+        "`service-shed` and `resync-shed`\n"
+    )
+    r = _analyze_tree(tmp_path)
+    assert "flight-contract" not in r.stdout, r.stdout
+    assert r.returncode == 0
+
+
+def test_flight_contract_inert_without_flight_module(tmp_path):
+    """Fixture trees without a flight vocabulary are not forced to
+    carry one."""
+    _seed(tmp_path, "pkg/loop/ctrl.py", """\
+        from pkg.loop import flight
+
+        def tick():
+            flight.note_event("anything")
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "flight-contract" not in r.stdout
+
+
+# --- lock-graph -----------------------------------------------------------
+
+
+def test_seeded_lock_graph_cycle(tmp_path):
+    """The planted two-lock ordering cycle: one path takes A then B,
+    another takes B then A through a helper call — the finding names
+    the full cycle path."""
+    _seed(tmp_path, "state/cycle.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    self._grab_a()
+
+            def _grab_a(self):
+                with self._a:
+                    pass
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    hits = [l for l in r.stdout.splitlines() if "lock-graph" in l]
+    assert any("lock acquisition cycle" in h for h in hits), r.stdout
+    cycle = next(h for h in hits if "lock acquisition cycle" in h)
+    assert "_a" in cycle and "_b" in cycle and "->" in cycle
+
+
+def test_lock_graph_consistent_order_is_clean(tmp_path):
+    """Negative: the same two locks always taken in the same order —
+    no cycle, no finding."""
+    _seed(tmp_path, "state/ordered.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    self._inner()
+
+            def _inner(self):
+                with self._b:
+                    pass
+    """)
+    r = _analyze_tree(tmp_path)
+    assert "lock-graph" not in r.stdout, r.stdout
+
+
+def test_lock_graph_self_deadlock_and_rlock_exempt(tmp_path):
+    """Re-acquiring a plain Lock down the call graph is a certain
+    self-deadlock (error); the same shape on an RLock is the reentrant
+    contract working as designed (clean)."""
+    _seed(tmp_path, "state/reent.py", """\
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+
+        class Reent:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    hits = [l for l in r.stdout.splitlines() if "lock-graph" in l]
+    assert len(hits) == 1, r.stdout
+    assert "Plain" in hits[0] and "self-deadlock" in hits[0]
+
+
+def test_lock_graph_held_across_blocking_warns(tmp_path):
+    """Holding a lock across a known-blocking call is a warn (latency
+    hazard, not a proven deadlock): rc 0 without --strict."""
+    _seed(tmp_path, "state/slow.py", """\
+        import threading
+        import time
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def nap(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 0, r.stdout
+    hits = [l for l in r.stdout.splitlines() if "lock-graph" in l]
+    assert len(hits) == 1 and "[warn]" in hits[0], r.stdout
+    assert "blocking" in hits[0]
+
+
+def test_lock_graph_condition_wait_holding_other_lock(tmp_path):
+    """cond.wait() releases ONLY the condition's own lock — waiting
+    while holding a second lock starves every path that needs it."""
+    _seed(tmp_path, "state/cond.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+
+            def bad_wait(self):
+                with self._lock:
+                    with self._cond:
+                        self._cond.wait()
+
+            def ok_wait(self):
+                with self._cond:
+                    self._cond.wait()
+    """)
+    r = _analyze_tree(tmp_path)
+    assert r.returncode == 1
+    hits = [l for l in r.stdout.splitlines() if "lock-graph" in l]
+    errors = [h for h in hits if "[warn]" not in h]
+    assert len(errors) == 1, r.stdout
+    assert "bad_wait" in errors[0] and "wait" in errors[0]
+
+
+# --- proto tier: protocol-contract ----------------------------------------
+
+# A minimal contract-clean protocol model + wire module pair. The
+# fixture tree carries no agent.py/server.py, so those contract
+# sections stay inert — the wire/site checks are what these tests
+# exercise. Entries are plain dicts (the pass reads dataclasses and
+# dicts alike); sites of None are unbound by design.
+_PROTO_MODEL_FIXTURE = """\
+    VERSIONS = (1, 2)
+    WIRE_VERSION = 2
+    KINDS = {
+        "KIND_PING": {
+            "value": 1,
+            "min_version": 1,
+            "site": "service/wire.py::encode_ping",
+        },
+    }
+    SHED_REASONS = {}
+    BREAKER_STATES = ("closed", "open")
+    BREAKER_TABLE = (
+        {"src": "closed", "dst": "open", "event": "trip", "site": None},
+        {"src": "open", "dst": "closed", "event": "heal", "site": None},
+    )
+    BREAKER_CONSTANTS = {}
+    ENDPOINT_FIELDS = ("url",)
+    ADMISSION_COUNTERS = ()
+    ADMISSION_LOCK_ATTR = "_lock"
+    ADMISSION_CAP_ATTR = "_cap"
+    ADMISSION_SITES = {}
+    LADDER_TABLE = ()
+"""
+
+_PROTO_WIRE_FIXTURE = """\
+    WIRE_VERSION = 2
+    SUPPORTED_VERSIONS = (1, 2)
+    KIND_PING = 1
+
+    def encode_ping(payload):
+        return payload
+"""
+
+
+def test_proto_contract_clean_fixture(tmp_path):
+    """Negative: a model whose tables mirror the live wire surface and
+    whose sites all resolve is green."""
+    _seed(tmp_path, "service/protocol_model.py", _PROTO_MODEL_FIXTURE)
+    _seed(tmp_path, "service/wire.py", _PROTO_WIRE_FIXTURE)
+    r = _analyze_tree(tmp_path, "--pass", "protocol-contract",
+                      tier="proto")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "protocol-contract" not in r.stdout
+
+
+def test_proto_contract_live_kind_missing_from_model(tmp_path):
+    """Adding a wire frame kind without teaching the model turns the
+    gate red at the live constant: the checker would be blind to it."""
+    _seed(tmp_path, "service/protocol_model.py", _PROTO_MODEL_FIXTURE)
+    _seed(tmp_path, "service/wire.py",
+          _PROTO_WIRE_FIXTURE + "    KIND_ROGUE = 7\n")
+    r = _analyze_tree(tmp_path, "--pass", "protocol-contract",
+                      tier="proto")
+    assert r.returncode == 1
+    assert "KIND_ROGUE" in r.stdout
+    assert "absent from the protocol model" in r.stdout
+    assert "service/wire.py" in r.stdout  # anchored at the LIVE side
+
+
+def test_proto_contract_model_site_must_exist(tmp_path):
+    """A model site string naming a function that does not exist turns
+    the gate red at the model: events must describe live code."""
+    _seed(tmp_path, "service/protocol_model.py",
+          _PROTO_MODEL_FIXTURE.replace("encode_ping", "encode_gone"))
+    _seed(tmp_path, "service/wire.py", _PROTO_WIRE_FIXTURE)
+    r = _analyze_tree(tmp_path, "--pass", "protocol-contract",
+                      tier="proto")
+    assert r.returncode == 1
+    assert "maps to no live function" in r.stdout
+    assert "service/protocol_model.py" in r.stdout
+
+
+def test_proto_contract_value_and_version_drift(tmp_path):
+    """A renumbered frame constant and a bumped WIRE_VERSION each turn
+    the gate red with both values named."""
+    _seed(tmp_path, "service/protocol_model.py", _PROTO_MODEL_FIXTURE)
+    _seed(tmp_path, "service/wire.py",
+          _PROTO_WIRE_FIXTURE.replace("KIND_PING = 1", "KIND_PING = 9")
+          .replace("WIRE_VERSION = 2", "WIRE_VERSION = 3"))
+    r = _analyze_tree(tmp_path, "--pass", "protocol-contract",
+                      tier="proto")
+    assert r.returncode == 1
+    assert "KIND_PING is 9 on the wire but 1" in r.stdout
+    assert "WIRE_VERSION is 3 live but 2" in r.stdout
+
+
+def test_proto_tier_inert_without_model(tmp_path):
+    """A tree that declares no protocol model gets no proto findings —
+    the tier gates trees that opted in (the real package always has
+    service/protocol_model.py in the walk)."""
+    _seed(tmp_path, "service/wire.py", _PROTO_WIRE_FIXTURE)
+    r = _analyze_tree(tmp_path, tier="proto")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "protocol" not in r.stdout
+
+
+# --- proto tier: protocol-model -------------------------------------------
+
+# Toy systems for --proto-model: tiny hand-built automata that exercise
+# the checker's verdicts without the real model's state-space cost.
+_TOY_CLEAN_MODEL = """\
+    class _Toy:
+        name = "toy"
+
+        def initial(self):
+            return 0
+
+        def successors(self, state):
+            if state == 0:
+                yield ("step", None, 1)
+
+        def check(self, state, label, info, nxt):
+            return ()
+
+        def is_goal(self, state):
+            return state == 1
+
+
+    def build_systems():
+        return [_Toy()]
+"""
+
+# state 1 self-loops forever and is_goal only at 0: every path out of
+# the initial state enters a live cycle that can never drain
+_TOY_UNDRAINABLE_MODEL = """\
+    class _Stuck:
+        name = "stuck-storm"
+
+        def initial(self):
+            return 0
+
+        def successors(self, state):
+            if state == 0:
+                yield ("enter-storm", None, 1)
+            else:
+                yield ("spin", None, 1)
+
+        def check(self, state, label, info, nxt):
+            return ()
+
+        def is_goal(self, state):
+            return state == 0
+
+
+    def build_systems():
+        return [_Stuck()]
+"""
+
+
+def test_proto_model_toy_clean(tmp_path):
+    """Negative: a reachable-goal toy automaton passes the checker."""
+    model = _seed(tmp_path, "toy_model.py", _TOY_CLEAN_MODEL)
+    r = _analyze_tree(tmp_path, "--proto-model", model, tier="proto")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_proto_model_planted_unreachable_drain_is_red(tmp_path):
+    """The planted unreachable-storm-drain model turns the run red: a
+    state from which no path reaches the drained goal is a liveness
+    violation carrying the event trail."""
+    model = _seed(tmp_path, "stuck_model.py", _TOY_UNDRAINABLE_MODEL)
+    r = _analyze_tree(tmp_path, "--proto-model", model, tier="proto")
+    assert r.returncode == 1
+    assert "liveness violation" in r.stdout
+    assert "cannot drain" in r.stdout
+    assert "enter-storm" in r.stdout  # the trail names the bad path
+
+
+def test_proto_model_safety_violation_carries_trail(tmp_path):
+    """A transition the invariant rejects is a safety finding whose
+    trail replays the exact event sequence from the initial state."""
+    _seed(tmp_path, "bad_model.py", """\
+        class _Bad:
+            name = "double-pack"
+
+            def initial(self):
+                return 0
+
+            def successors(self, state):
+                if state < 2:
+                    yield ("full-pack", None, state + 1)
+
+            def check(self, state, label, info, nxt):
+                if nxt == 2:
+                    return ("second full pack in one epoch",)
+                return ()
+
+            def is_goal(self, state):
+                return state >= 1
+
+
+        def build_systems():
+            return [_Bad()]
+    """)
+    r = _analyze_tree(tmp_path, "--proto-model",
+                      tmp_path / "bad_model.py", tier="proto")
+    assert r.returncode == 1
+    assert "safety violation" in r.stdout
+    assert "second full pack" in r.stdout
+    assert "full-pack -> full-pack" in r.stdout
+
+
+def test_proto_model_broken_model_is_red_not_silent(tmp_path):
+    """A model that cannot load, and one whose build_systems returns
+    nothing, are each errors — lost verification coverage must never
+    read as a pass."""
+    broken = _seed(tmp_path, "broken.py", "raise RuntimeError('boom')\n")
+    r = _analyze_tree(tmp_path, "--proto-model", broken, tier="proto")
+    assert r.returncode == 1
+    assert "failed to load" in r.stdout
+    empty = _seed(tmp_path, "empty.py", "def build_systems():\n"
+                  "    return []\n")
+    r = _analyze_tree(tmp_path, "--proto-model", empty, tier="proto")
+    assert r.returncode == 1
+    assert "vacuously" in r.stdout

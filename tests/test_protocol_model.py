@@ -1,0 +1,119 @@
+"""The protocol model itself must keep proving what it claims.
+
+tests/test_analysis.py proves the proto TIER gates (seeded fixtures
+turn it red); this file pins the MODEL: the explored state-space sizes
+(so a bounds or transition edit that quietly shrinks coverage is
+loud), the zero-defect verdict on both declared configurations, and
+the structural properties the ISSUE acceptance names — >= 2 agents x
+2 replicas with a restart event, a version-mix configuration, and the
+mirrored wire constants staying equal to the live ones by import (the
+protocol-contract pass re-proves the same equality by AST, so the two
+can only drift together, loudly).
+"""
+
+from k8s_spot_rescheduler_tpu.service import protocol_model, wire
+from tools.analysis.proto.model_check import MAX_STATES, explore
+
+# The exhaustive exploration of both CHECK_BOUNDS configurations, run
+# once per test session (explore() is pure; ~2 s total on CPU).
+_RESULTS = {
+    system.name: explore(system)
+    for system in protocol_model.build_systems()
+}
+
+# Pinned explored sizes. These numbers ARE the coverage: a transition
+# or bounds edit that changes the reachable space must update them
+# consciously (and stay under the checker's MAX_STATES headroom).
+_PINNED = {
+    "storm": dict(n_states=91093, n_edges=243145, n_goal=490),
+    "version-mix": dict(n_states=3251, n_edges=8459, n_goal=52),
+}
+
+
+def test_declared_systems_match_pinned_names():
+    assert set(_RESULTS) == set(_PINNED)
+
+
+def test_bounds_meet_acceptance_floor():
+    """The proof must cover >= 2 agents x 2 replicas with a replica
+    restart, plus a mixed-version fleet."""
+    by_name = {b.name: b for b in protocol_model.CHECK_BOUNDS}
+    storm = by_name["storm"]
+    assert storm.n_agents >= 2 and storm.n_replicas >= 2
+    assert storm.restart_budget >= 1
+    assert storm.loss_budget >= 1
+    mixed = by_name["version-mix"]
+    assert len(set(mixed.versions)) >= 2
+    assert min(mixed.versions) < protocol_model.WIRE_VERSION
+
+
+def test_explorations_are_clean():
+    """Zero safety violations, zero deadlocks, zero undrainable states
+    on every reachable state of both configurations."""
+    for name, result in _RESULTS.items():
+        assert not result.truncated, name
+        assert result.violations == [], (name, result.violations[:3])
+        assert result.deadlocks == [], (name, result.deadlocks[:3])
+        assert result.undrainable == [], (name, result.undrainable[:3])
+        assert result.n_goal > 0, name
+
+
+def test_explored_sizes_are_pinned():
+    for name, pins in _PINNED.items():
+        result = _RESULTS[name]
+        got = dict(
+            n_states=result.n_states,
+            n_edges=result.n_edges,
+            n_goal=result.n_goal,
+        )
+        assert got == pins, (
+            f"{name} state space drifted: {got} != pinned {pins} — a "
+            "model edit changed coverage; re-verify and re-pin "
+            "consciously"
+        )
+
+
+def test_pinned_sizes_fit_the_checker_bound():
+    """Headroom: the pinned spaces must sit well under the checker's
+    MAX_STATES so normal growth doesn't silently approach truncation."""
+    total = sum(p["n_states"] for p in _PINNED.values())
+    assert total < MAX_STATES // 2
+
+
+def test_model_mirrors_live_wire_constants():
+    """The import-level half of the protocol contract: the model's
+    mirrored wire table equals the live module's constants."""
+    assert protocol_model.WIRE_VERSION == wire.WIRE_VERSION
+    assert tuple(protocol_model.VERSIONS) == tuple(
+        wire.SUPPORTED_VERSIONS
+    )
+    for name, kind in protocol_model.KINDS.items():
+        assert getattr(wire, name) == kind.value, name
+
+
+def test_restart_bumps_epoch_and_wipes_cache():
+    """Unit probe of the transition builder: from the initial state, a
+    replica restart must bump the epoch and clear the per-agent cache
+    and full-pack ledger on that replica only."""
+    system = protocol_model.build_systems()[0]
+    init = system.initial()
+    restarts = [
+        (label, nxt) for label, _, nxt in system.successors(init)
+        if label.startswith("restart")
+    ]
+    assert restarts, "no restart event enabled at the initial state"
+    for _, nxt in restarts:
+        _, replicas, budgets = nxt
+        assert budgets[2] == system.bounds.restart_budget - 1
+        assert any(epoch == 1 for epoch, *_ in replicas)
+        for epoch, cached, bits, _proc, _pressure in replicas:
+            if epoch == 1:
+                assert all(fp == cached[0] for fp in cached)
+                assert all(b == 0 for b in bits)
+
+
+def test_goal_requires_synced_closed_endpoint():
+    """The drained goal is not vacuous: the initial state (nothing
+    cached, nothing acked) must NOT be a goal state."""
+    system = protocol_model.build_systems()[0]
+    assert not system.is_goal(system.initial())

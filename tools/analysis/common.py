@@ -66,6 +66,8 @@ ANALYSIS_CODES = {
     "config-contract",
     "kube-write-retry",
     "lock-discipline",
+    "lock-graph",
+    "flight-contract",
     "manifest-contract",
     "exception-discipline",
     "bare-noqa",
@@ -77,6 +79,9 @@ ANALYSIS_CODES = {
     "transfer-audit",
     "memory-reconcile",
     "trace-failure",
+    # proto tier (tools/analysis/proto — protocol model + contract)
+    "protocol-model",
+    "protocol-contract",
 }
 
 # Conventional flake8-family codes used as machine-readable annotations in

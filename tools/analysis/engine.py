@@ -11,7 +11,11 @@ of the package, in two tiers:
   the HOT_PROGRAMS manifest traced shape-only on CPU, audited for
   dtype, index-width, transfer, and memory properties. ``make
   audit-jaxpr`` runs exactly this.
-- ``--tier all`` (default) — both.
+- ``--tier proto`` — the protocol passes (tools/analysis/proto): the
+  declared wire/breaker/admission automata exhaustively explored for
+  safety + liveness, and the model<->code contract. ``make
+  verify-protocol`` runs exactly this.
+- ``--tier all`` (default) — all three.
 
 Either tier's findings flow through the SAME suppression grammar and
 baseline; suppression-hygiene findings (bare-noqa etc.) belong to the
@@ -40,17 +44,30 @@ from tools.analysis.common import (
 )
 from tools.analysis.jaxpr import JAXPR_PASS_NAMES
 from tools.analysis.passes import ALL_PASSES
+from tools.analysis.proto import PROTO_PASS_NAMES
 from tools.analysis.symbols import Project
 
 DEFAULT_BASELINE = Path(__file__).parent / "baseline.txt"
 DEFAULT_PARITY = "docs/PARITY.md"
+DEFAULT_OBSERVABILITY = "docs/OBSERVABILITY.md"
 
 AST_PASS_NAMES = tuple(name for name, _ in ALL_PASSES)
 
 
+def _pass_tier(name) -> str:
+    """Which tier owns a ``--pass`` name (pass names ARE finding
+    codes, and each belongs to exactly one tier)."""
+    if name in JAXPR_PASS_NAMES:
+        return "jaxpr"
+    if name in PROTO_PASS_NAMES:
+        return "proto"
+    return "ast"  # ast passes + the "suppressions" pseudo-pass
+
+
 def _exercised_codes(tier: str, only_pass) -> set:
     """The finding codes this run could have produced — what baseline
-    staleness may be judged against."""
+    staleness may be judged against. Tier-qualified: a --tier proto
+    run never calls ast/jaxpr debt paid, and vice versa."""
     if only_pass == "suppressions":
         return {"bare-noqa", "unknown-suppression"}
     if only_pass is not None:
@@ -62,6 +79,8 @@ def _exercised_codes(tier: str, only_pass) -> set:
     if tier in ("jaxpr", "all"):
         codes.update(JAXPR_PASS_NAMES)
         codes.add("trace-failure")
+    if tier in ("proto", "all"):
+        codes.update(PROTO_PASS_NAMES)
     return codes & ANALYSIS_CODES
 
 
@@ -69,14 +88,17 @@ def analyze(
     roots,
     *,
     parity_path=DEFAULT_PARITY,
+    observability_path=DEFAULT_OBSERVABILITY,
     baseline_path=DEFAULT_BASELINE,
     use_baseline=True,
     only_pass=None,
     tier="all",
     manifest=None,
+    proto_model=None,
 ):
-    """Run the selected tiers' passes; returns (active, baselined) with
-    per-file suppressions folded in. Pure — no printing, no exit."""
+    """Run the selected tiers' passes; returns (active, baselined,
+    tier_runtimes_ms) with per-file suppressions folded in. Pure — no
+    printing, no exit."""
     project = Project(Path.cwd())
     files = {}
     suppressions = {}
@@ -94,9 +116,16 @@ def analyze(
         files["__parity__"] = parity.read_text(
             encoding="utf-8", errors="replace"
         )
+    observability = Path(observability_path)
+    if observability.exists():
+        files["__observability__"] = observability.read_text(
+            encoding="utf-8", errors="replace"
+        )
 
     findings = []
+    tier_runtimes_ms = {}
     if tier in ("ast", "all"):
+        t_tier = time.perf_counter()
         for name, run in ALL_PASSES:
             if only_pass and name != only_pass:
                 continue
@@ -107,14 +136,35 @@ def analyze(
         if only_pass in (None, "suppressions"):
             for path, supp in suppressions.items():
                 findings.extend(supp.findings(relpath(path)))
+        tier_runtimes_ms["ast"] = round(
+            (time.perf_counter() - t_tier) * 1e3, 1
+        )
 
     if tier in ("jaxpr", "all") and (
         only_pass is None or only_pass in JAXPR_PASS_NAMES
     ):
         from tools.analysis.jaxpr import run_tier
 
+        t_tier = time.perf_counter()
         findings.extend(
             run_tier(manifest_path=manifest, only_pass=only_pass)
+        )
+        tier_runtimes_ms["jaxpr"] = round(
+            (time.perf_counter() - t_tier) * 1e3, 1
+        )
+
+    if tier in ("proto", "all") and (
+        only_pass is None or only_pass in PROTO_PASS_NAMES
+    ):
+        from tools.analysis.proto import run_tier as run_proto_tier
+
+        t_tier = time.perf_counter()
+        findings.extend(run_proto_tier(
+            project, files, only_pass=only_pass,
+            model_path=proto_model,
+        ))
+        tier_runtimes_ms["proto"] = round(
+            (time.perf_counter() - t_tier) * 1e3, 1
         )
 
     # apply typed per-line suppressions
@@ -145,22 +195,24 @@ def analyze(
     else:
         active, baselined = kept, []
     active.sort(key=lambda f: (f.path, f.line, f.code))
-    return active, baselined
+    return active, baselined, tier_runtimes_ms
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tools.analysis",
-        description="project-wide static analysis (vet analog), two "
-                    "tiers: ast (source) + jaxpr (traced programs)",
+        description="project-wide static analysis (vet analog), three "
+                    "tiers: ast (source) + jaxpr (traced programs) + "
+                    "proto (protocol model + contract)",
     )
     p.add_argument("roots", nargs="*", default=None,
                    help=f"files/dirs to analyze (default: {DEFAULT_ROOTS})")
-    p.add_argument("--tier", choices=("ast", "jaxpr", "all"),
+    p.add_argument("--tier", choices=("ast", "jaxpr", "proto", "all"),
                    default="all",
                    help="which analysis tier(s) to run (default: all; "
                         "'make analyze' pins ast, 'make audit-jaxpr' "
-                        "pins jaxpr)")
+                        "pins jaxpr, 'make verify-protocol' pins "
+                        "proto)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable findings (schema in "
                         "docs/ANALYSIS.md)")
@@ -174,11 +226,19 @@ def main(argv=None) -> int:
                    help="alternate HOT_PROGRAMS manifest module for the "
                         "jaxpr tier (default: the package's "
                         "hot_programs.collect(); fixture/test hook)")
+    p.add_argument("--observability", default=DEFAULT_OBSERVABILITY,
+                   help="OBSERVABILITY.md path for the flight-contract "
+                        "doc check")
+    p.add_argument("--proto-model", dest="proto_model", default=None,
+                   help="alternate protocol model file for the proto "
+                        "tier (default: the analyzed tree's "
+                        "service/protocol_model.py; fixture/test hook)")
     p.add_argument("--strict", action="store_true",
                    help="warn-tier findings also fail the gate")
     p.add_argument("--pass", dest="only_pass", default=None,
                    choices=list(AST_PASS_NAMES)
                    + list(JAXPR_PASS_NAMES)
+                   + list(PROTO_PASS_NAMES)
                    + ["suppressions"],
                    help="run a single pass by code name (a typo must "
                         "error, not report a vacuously clean tree)")
@@ -187,29 +247,27 @@ def main(argv=None) -> int:
                         "(keeps 'make check' fast)")
     args = p.parse_args(argv)
 
-    if args.only_pass in JAXPR_PASS_NAMES and args.tier == "ast":
-        p.error(
-            f"--pass {args.only_pass} is a jaxpr-tier pass; "
-            "drop --tier ast (or use --tier jaxpr)"
-        )
-    if (
-        args.only_pass in AST_PASS_NAMES
-        or args.only_pass == "suppressions"
-    ) and args.tier == "jaxpr":
-        p.error(
-            f"--pass {args.only_pass} is an ast-tier pass; "
-            "drop --tier jaxpr (or use --tier ast)"
-        )
+    if args.only_pass is not None and args.tier != "all":
+        owner = _pass_tier(args.only_pass)
+        if owner != args.tier:
+            article = "an" if owner == "ast" else "a"
+            p.error(
+                f"--pass {args.only_pass} is {article} {owner}-tier "
+                f"pass; drop --tier {args.tier} (or use --tier "
+                f"{owner})"
+            )
 
     t0 = time.perf_counter()
-    active, baselined = analyze(
+    active, baselined, tier_runtimes_ms = analyze(
         args.roots or DEFAULT_ROOTS,
         parity_path=args.parity,
+        observability_path=args.observability,
         baseline_path=args.baseline,
         use_baseline=not args.no_baseline,
         only_pass=args.only_pass,
         tier=args.tier,
         manifest=args.manifest,
+        proto_model=args.proto_model,
     )
     elapsed = time.perf_counter() - t0
 
@@ -221,6 +279,9 @@ def main(argv=None) -> int:
             "version": 1,
             "tier": args.tier,
             "elapsed_seconds": round(elapsed, 3),
+            # per-tier wall cost: the three tiers dominate `make
+            # check` wall, so their split is part of the schema
+            "tier_runtimes_ms": tier_runtimes_ms,
             "findings": [f.as_dict() for f in active],
             "counts": {
                 "error": len(errors),

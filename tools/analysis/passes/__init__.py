@@ -8,6 +8,7 @@ every analyzed file. Pass registration lives in tools/analysis/engine.py.
 from tools.analysis.passes import (  # noqa: F401
     contracts,
     exceptions,
+    flightkinds,
     hotpath,
     locks,
 )
@@ -21,6 +22,8 @@ ALL_PASSES = (
     ("kube-write-retry", contracts.run_kube_writes),
     ("trace-contract", contracts.run_trace),
     ("manifest-contract", contracts.run_manifest),
+    ("flight-contract", flightkinds.run),
     ("lock-discipline", locks.run),
+    ("lock-graph", locks.run_graph),
     ("exception-discipline", exceptions.run),
 )

@@ -1,0 +1,209 @@
+"""flight-contract: the flight-recorder kind registry vs reality.
+
+``loop/flight.py`` declares the closed vocabularies
+``DEGRADATION_KINDS`` / ``CONTEXT_KINDS``; every degradation path in
+the tree narrates itself through ``flight.note_event(<kind>, ...)``
+(or a funnel like the server's ``_note_shed``, whose ``kind=`` kwarg
+and literal default both count as emissions). The same shape as
+metrics-contract, in BOTH directions plus the doc:
+
+- a kind emitted anywhere but missing from the declared sets is an
+  error at the emission site (the recorder would raise at runtime —
+  this catches it at vet time, on paths no test drives);
+- a declared kind that no call site ever emits is an error at the
+  declaration (dead vocabulary reads as coverage that isn't there);
+- every declared kind must appear in docs/OBSERVABILITY.md (loaded by
+  the engine as ``files["__observability__"]``) as a literal
+  `` `kind` `` mention — the kind table is operator-facing API.
+
+Funnels are found structurally: any function with a ``kind`` parameter
+whose body calls ``note_event`` forwards its callers' literal ``kind=``
+arguments (and its own literal default) into the recorder. Literal
+strings only, as ever: a kind computed at runtime is simply not bound.
+Inert on trees without a flight module or declared kind sets.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.analysis.common import ERROR, Finding, relpath
+from tools.analysis.passes.contracts import _find_module
+from tools.analysis.symbols import Project, dotted
+
+FLIGHT_SUFFIX = "loop/flight.py"
+DECLARED_SETS = ("DEGRADATION_KINDS", "CONTEXT_KINDS")
+
+
+def _frozenset_literal(
+    tree: ast.Module, name: str
+) -> Optional[Tuple[Dict[str, int], int]]:
+    """({kind: lineno}, assign_lineno) of a literal
+    ``name = frozenset({...})`` / ``name = {...}`` declaration."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == name
+            for t in node.targets
+        ):
+            continue
+        value = node.value
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "frozenset"
+            and value.args
+        ):
+            value = value.args[0]
+        if not isinstance(value, (ast.Set, ast.List, ast.Tuple)):
+            continue
+        kinds = {
+            e.value: e.lineno
+            for e in value.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+        return kinds, node.lineno
+    return None
+
+
+def _funnels(project: Project) -> Dict[str, Optional[str]]:
+    """{function_name: literal_kind_default_or_None} for every
+    function that takes a ``kind`` parameter and forwards it into
+    ``note_event`` — callers' literal ``kind=`` kwargs (and the
+    default itself) are emissions."""
+    out: Dict[str, Optional[str]] = {}
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            args = fn.node.args
+            params = list(args.posonlyargs) + list(args.args)
+            names = [p.arg for p in params] + [
+                p.arg for p in args.kwonlyargs
+            ]
+            if "kind" not in names:
+                continue
+            forwards = False
+            for sub in ast.walk(fn.node):
+                if isinstance(sub, ast.Call):
+                    d = dotted(sub.func)
+                    if d and d.split(".")[-1] == "note_event":
+                        forwards = True
+                        break
+            if not forwards:
+                continue
+            default = None
+            defaults = list(args.defaults)
+            for param, dflt in zip(
+                params[len(params) - len(defaults):], defaults
+            ):
+                if param.arg == "kind" and isinstance(
+                    dflt, ast.Constant
+                ) and isinstance(dflt.value, str):
+                    default = dflt.value
+            for param, dflt in zip(args.kwonlyargs, args.kw_defaults):
+                if param.arg == "kind" and isinstance(
+                    dflt, ast.Constant
+                ) and isinstance(dflt.value, str):
+                    default = dflt.value
+            out[fn.name] = default
+    return out
+
+
+def run(project: Project, files) -> List[Finding]:
+    flight_mod = _find_module(project, FLIGHT_SUFFIX)
+    if flight_mod is None:
+        return []
+    flight_path = relpath(flight_mod.path)
+    declared: Dict[str, Tuple[str, int]] = {}  # kind -> (set, lineno)
+    found_any = False
+    for set_name in DECLARED_SETS:
+        parsed = _frozenset_literal(flight_mod.tree, set_name)
+        if parsed is None:
+            continue
+        found_any = True
+        kinds, _ = parsed
+        for kind, lineno in kinds.items():
+            declared.setdefault(kind, (set_name, lineno))
+    if not found_any:
+        return []  # tree has a flight module but no kind vocabulary
+
+    funnels = _funnels(project)
+
+    # every literal emission outside flight.py: kind -> [(path, line)]
+    emitted: Dict[str, List[Tuple[str, int]]] = {}
+    for mod in project.modules.values():
+        if mod is flight_mod:
+            continue
+        path = relpath(mod.path)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.split(".")[-1] if d else None
+            if leaf == "note_event":
+                if (
+                    node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    emitted.setdefault(
+                        node.args[0].value, []
+                    ).append((path, node.lineno))
+            elif leaf in funnels:
+                explicit = False
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "kind"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                    ):
+                        emitted.setdefault(
+                            kw.value.value, []
+                        ).append((path, node.lineno))
+                        explicit = True
+                if not explicit and funnels[leaf] is not None:
+                    emitted.setdefault(
+                        funnels[leaf], []
+                    ).append((path, node.lineno))
+
+    findings: List[Finding] = []
+
+    # direction 1: emitted but undeclared — the recorder would reject
+    # it at runtime on a path no test may drive
+    for kind in sorted(set(emitted) - set(declared)):
+        path, line = emitted[kind][0]
+        findings.append(Finding(
+            path, line, "flight-contract",
+            f"flight kind '{kind}' is emitted here but absent from "
+            f"{flight_path}'s DEGRADATION_KINDS/CONTEXT_KINDS — "
+            "note_event would drop or reject it",
+            severity=ERROR, anchor=f"kind.{kind}",
+        ))
+
+    # direction 2: declared but never emitted — dead vocabulary
+    for kind in sorted(set(declared) - set(emitted)):
+        set_name, lineno = declared[kind]
+        findings.append(Finding(
+            flight_path, lineno, "flight-contract",
+            f"flight kind '{kind}' is declared in {set_name} but no "
+            "call site ever emits it (literal scan over "
+            "note_event and its funnels)",
+            severity=ERROR, anchor=f"kind.{kind}",
+        ))
+
+    # direction 3: declared but undocumented — the kind table in
+    # docs/OBSERVABILITY.md is the operator-facing API
+    doc = files.get("__observability__")
+    if doc is not None:
+        for kind in sorted(declared):
+            if f"`{kind}`" not in doc:
+                set_name, lineno = declared[kind]
+                findings.append(Finding(
+                    flight_path, lineno, "flight-contract",
+                    f"flight kind '{kind}' ({set_name}) is not "
+                    "documented in docs/OBSERVABILITY.md — add it to "
+                    "the kind table",
+                    severity=ERROR, anchor=f"doc.{kind}",
+                ))
+    return findings

@@ -1,6 +1,32 @@
-"""Lock-discipline audit.
+"""Lock-discipline + lock-graph audits.
 
-For every class that owns a ``threading.Lock``/``RLock``/``Condition``
+Two passes live here. ``lock-discipline`` (``run``) is the per-class
+write-protection audit described below. ``lock-graph`` (``run_graph``)
+is the interprocedural deadlock audit: it builds a lock-acquisition-
+order graph over the WHOLE package — which locks can be held when
+every other acquire is reachable, flowing holds through the symbols
+call graph — and reports
+
+- any cycle in the acquisition order as an error naming the full
+  cycle path (two threads walking the cycle from different entry
+  points deadlock),
+- a non-reentrant ``Lock`` acquired while already held (including
+  transitively, through calls) as an error,
+- ``Condition.wait`` while holding a DIFFERENT lock, and any blocking
+  operation (HTTP round-trip, socket send/recv, queue wait, device
+  solve, ``time.sleep``) reached with a lock held, as warnings —
+  latency bombs rather than certain deadlocks.
+
+Lock identity is (module, class, attribute) for ``self.<x>`` locks and
+(module, name) for module-level locks; ``threading.Condition()``'s
+default internal RLock makes nested re-entry on the same condition
+benign, so self-edges on RLock/Condition nodes are dropped. The graph
+is an AST approximation with the usual contract-pass bias: unresolved
+receivers (locks reached through non-self objects, calls the symbol
+table cannot see) cost recall, never false findings.
+
+The ``lock-discipline`` contract:
+for every class that owns a ``threading.Lock``/``RLock``/``Condition``
 (assigned to ``self.<x>`` anywhere in the class), the attributes that
 class protects must only be MUTATED under that protection. "Protected"
 is inferred, not annotated: any attribute written inside a
@@ -26,7 +52,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from tools.analysis.common import ERROR, Finding, relpath
+from tools.analysis.common import ERROR, WARN, Finding, relpath
 from tools.analysis.symbols import Project, dotted
 
 _LOCK_CTORS = {
@@ -206,4 +232,439 @@ def run(project: Project, files) -> List[Finding]:
                             severity=ERROR,
                             anchor=f"{cls.name}.{name}.{attr}",
                         ))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# lock-graph: interprocedural acquisition-order audit (run_graph)
+# ---------------------------------------------------------------------
+
+_QUEUE_CTORS = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue",
+}
+
+# attribute-call names that park the calling thread; the value is the
+# label used in findings. Deliberately tight: a generic name here
+# ("read", "get") would spray warnings over non-blocking code.
+_BLOCKING_ATTRS = {
+    "sleep": "time.sleep",
+    "urlopen": "HTTP round-trip (urlopen)",
+    "getresponse": "HTTP response wait",
+    "sendall": "socket send",
+    "recv": "socket recv",
+    "accept": "socket accept",
+    "block_until_ready": "device solve wait",
+}
+
+# queue methods that can block the caller
+_QUEUE_WAIT_ATTRS = {"get", "put", "join"}
+
+
+class _LockMeta:
+    __slots__ = ("kind", "path", "line", "display")
+
+    def __init__(self, kind, path, line, display):
+        self.kind = kind  # "Lock" | "RLock" | "Condition"
+        self.path = path
+        self.line = line
+        self.display = display
+
+    @property
+    def reentrant(self) -> bool:
+        # Condition() wraps an RLock by default
+        return self.kind in ("RLock", "Condition")
+
+
+def _class_own_assigns(cls: ast.ClassDef):
+    """Assign nodes in ``cls`` excluding nested ClassDef bodies, so a
+    nested handler class's locks are not attributed to the outer."""
+    stack = list(cls.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        if isinstance(node, ast.Assign):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _lock_registry(project: Project):
+    """(locks, queues): identity -> meta for every literal lock/queue
+    construction. Identity is (module_id, class_or_empty, name)."""
+    locks: Dict[tuple, _LockMeta] = {}
+    queues: Dict[tuple, _LockMeta] = {}
+    for mod in project.modules.values():
+        path = relpath(mod.path)
+        stem = path.rsplit("/", 1)[-1].removesuffix(".py")
+        for node in mod.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            ctor = dotted(node.value.func)
+            if ctor not in _LOCK_CTORS:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    locks[(mod.module_id, "", tgt.id)] = _LockMeta(
+                        ctor.split(".")[-1], path, node.lineno,
+                        f"{stem}.{tgt.id}",
+                    )
+        for cls in mod.classes.values():
+            for node in _class_own_assigns(cls):
+                if not isinstance(node.value, ast.Call):
+                    continue
+                ctor = dotted(node.value.func)
+                if ctor is None:
+                    continue
+                reg = (
+                    locks if ctor in _LOCK_CTORS
+                    else queues if ctor in _QUEUE_CTORS
+                    else None
+                )
+                if reg is None:
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr:
+                        reg[(mod.module_id, cls.name, attr)] = _LockMeta(
+                            ctor.split(".")[-1], path, node.lineno,
+                            f"{cls.name}.{attr}",
+                        )
+    return locks, queues
+
+
+class _GraphFacts:
+    """Lock-relevant events of one function, in lexical order."""
+
+    def __init__(self):
+        # (lock_key, line, held_frozenset) — every acquisition point
+        self.acquires: List[tuple] = []
+        # (call_node, line, held_frozenset) — every call expression
+        self.calls: List[tuple] = []
+        # (label, line, held_frozenset) — direct blocking operations
+        self.blocking: List[tuple] = []
+        # (cond_key, line, held_frozenset) — Condition.wait sites
+        self.waits: List[tuple] = []
+
+
+def _resolve_lock(expr: ast.AST, fn, registry) -> Optional[tuple]:
+    """The registry key a lock-ish expression denotes, if resolvable:
+    ``self.<attr>`` against the function's class, a bare name against
+    the module's top-level locks."""
+    attr = _self_attr(expr)
+    if attr is not None and fn.cls:
+        key = (fn.module.module_id, fn.cls, attr)
+        return key if key in registry else None
+    if isinstance(expr, ast.Name):
+        key = (fn.module.module_id, "", expr.id)
+        return key if key in registry else None
+    return None
+
+
+def _graph_facts(fn, locks, queues) -> _GraphFacts:
+    facts = _GraphFacts()
+    sticky: Set[tuple] = set()  # .acquire() without with, until .release()
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                continue  # separate scopes get their own facts
+            child_held = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    expr = item.context_expr
+                    lk = _resolve_lock(expr, fn, locks)
+                    if lk is None and isinstance(expr, ast.Call) and (
+                        isinstance(expr.func, ast.Attribute)
+                    ):
+                        lk = _resolve_lock(expr.func.value, fn, locks)
+                    if lk is not None:
+                        facts.acquires.append(
+                            (lk, child.lineno,
+                             child_held | frozenset(sticky))
+                        )
+                        child_held = child_held | {lk}
+            if isinstance(child, ast.Call):
+                eff = held | frozenset(sticky)
+                handled = False
+                if isinstance(child.func, ast.Attribute):
+                    base_lock = _resolve_lock(
+                        child.func.value, fn, locks
+                    )
+                    if base_lock is not None:
+                        meth = child.func.attr
+                        if meth == "acquire":
+                            facts.acquires.append(
+                                (base_lock, child.lineno, eff)
+                            )
+                            sticky.add(base_lock)
+                            handled = True
+                        elif meth == "release":
+                            sticky.discard(base_lock)
+                            handled = True
+                        elif meth in ("wait", "wait_for"):
+                            facts.waits.append(
+                                (base_lock, child.lineno, eff)
+                            )
+                            handled = True
+                        elif meth in ("notify", "notify_all", "locked"):
+                            handled = True
+                    elif (
+                        _resolve_lock(child.func.value, fn, queues)
+                        is not None
+                        and child.func.attr in _QUEUE_WAIT_ATTRS
+                    ):
+                        facts.blocking.append((
+                            f"queue {child.func.attr}()",
+                            child.lineno, eff,
+                        ))
+                        handled = True
+                    elif child.func.attr in _BLOCKING_ATTRS:
+                        facts.blocking.append((
+                            _BLOCKING_ATTRS[child.func.attr],
+                            child.lineno, eff,
+                        ))
+                        handled = True
+                if not handled:
+                    facts.calls.append((child, child.lineno, eff))
+            visit(child, child_held)
+
+    visit(fn.node, frozenset())
+    return facts
+
+
+def _fq(fn) -> str:
+    """Human-readable function identity: path::qual."""
+    return f"{relpath(fn.path)}::{fn.qual.split(':', 1)[1]}"
+
+
+def _transitive(project, fn_facts, direct_of, combine_key):
+    """Generic transitive may-X summary with witness chains.
+
+    ``direct_of(facts)`` yields (key, line) pairs; the result maps each
+    function to {key: ("qual:line", ...) witness chain}. Call cycles
+    are cut (the on-stack callee contributes nothing on that path)."""
+    memo: Dict[object, dict] = {}
+    on_stack: Set[object] = set()
+
+    def summary(fn):
+        if fn in memo:
+            return memo[fn]
+        if fn in on_stack:
+            return {}
+        on_stack.add(fn)
+        out: dict = {}
+        facts = fn_facts[fn]
+        for key, line in direct_of(facts):
+            out.setdefault(key, (f"{_fq(fn)}:{line}",))
+        for call_node, line, _held in facts.calls:
+            callee = project.resolve_call(
+                fn.module, call_node.func, fn
+            )
+            if callee is None or callee not in fn_facts:
+                continue
+            for key, chain in summary(callee).items():
+                out.setdefault(
+                    combine_key(key),
+                    (f"{_fq(fn)}:{line}",) + chain,
+                )
+        on_stack.discard(fn)
+        memo[fn] = out
+        return out
+
+    for fn in fn_facts:
+        summary(fn)
+    return memo
+
+
+def run_graph(project: Project, files) -> List[Finding]:
+    """The lock-graph pass (see module docstring)."""
+    findings: List[Finding] = []
+    locks, queues = _lock_registry(project)
+    if not locks:
+        return []
+
+    fn_facts = {}
+    for mod in project.modules.values():
+        for fn in mod.functions.values():
+            fn_facts[fn] = _graph_facts(fn, locks, queues)
+
+    may_acquire = _transitive(
+        project, fn_facts,
+        direct_of=lambda f: [(lk, ln) for lk, ln, _ in f.acquires],
+        combine_key=lambda k: k,
+    )
+    may_block = _transitive(
+        project, fn_facts,
+        direct_of=lambda f: (
+            [(label, ln) for label, ln, _ in f.blocking]
+            + [("Condition.wait", ln) for _, ln, _ in f.waits]
+        ),
+        combine_key=lambda k: k,
+    )
+
+    # ---- build the acquisition-order graph ---------------------------
+    # edge (held -> acquired) -> (path, line, witness chain or None)
+    edges: Dict[tuple, tuple] = {}
+
+    def add_edge(src, dst, path, line, chain):
+        edges.setdefault((src, dst), (path, line, chain))
+
+    warn_seen: Set[tuple] = set()
+    for fn, facts in fn_facts.items():
+        path = relpath(fn.path)
+        for lk, line, held in facts.acquires:
+            for h in held:
+                add_edge(h, lk, path, line, None)
+        for call_node, line, held in facts.calls:
+            if not held:
+                continue
+            callee = project.resolve_call(
+                fn.module, call_node.func, fn
+            )
+            if callee is None or callee not in fn_facts:
+                continue
+            for lk, chain in may_acquire[callee].items():
+                for h in held:
+                    add_edge(h, lk, path, line, chain)
+            for label, chain in may_block[callee].items():
+                key = (fn, label)
+                if key in warn_seen:
+                    continue
+                warn_seen.add(key)
+                held_names = ", ".join(
+                    sorted(locks[h].display for h in held)
+                )
+                findings.append(Finding(
+                    path, line, "lock-graph",
+                    f"{fn.name} holds {held_names} across a blocking "
+                    f"operation: {label} via "
+                    f"{' -> '.join(chain)} — lock hold time is bounded "
+                    "by I/O, not compute",
+                    severity=WARN,
+                    anchor=f"block.{fn.qual.split(':', 1)[1]}.{label}",
+                ))
+        for label, line, held in facts.blocking:
+            if not held:
+                continue
+            key = (fn, label, line)
+            if key in warn_seen:
+                continue
+            warn_seen.add(key)
+            held_names = ", ".join(
+                sorted(locks[h].display for h in held)
+            )
+            findings.append(Finding(
+                path, line, "lock-graph",
+                f"{fn.name} holds {held_names} across a blocking "
+                f"operation: {label} — lock hold time is bounded by "
+                "I/O, not compute",
+                severity=WARN,
+                anchor=f"block.{fn.qual.split(':', 1)[1]}.{label}",
+            ))
+        for cond, line, held in facts.waits:
+            others = [h for h in held if h != cond]
+            if not others:
+                continue
+            held_names = ", ".join(
+                sorted(locks[h].display for h in others)
+            )
+            findings.append(Finding(
+                path, line, "lock-graph",
+                f"{fn.name} waits on {locks[cond].display} while "
+                f"holding {held_names}; the wakeup needs another "
+                "thread to get past those locks — a classic "
+                "lost-wakeup deadlock shape",
+                severity=ERROR,
+                anchor=f"wait.{fn.qual.split(':', 1)[1]}",
+            ))
+            # waiting re-acquires the condition on wake: ordering edge
+            for h in others:
+                add_edge(h, cond, relpath(fn.path), line, None)
+
+    # ---- self-acquisition of a non-reentrant lock --------------------
+    for (src, dst), (path, line, chain) in sorted(
+        edges.items(),
+        key=lambda kv: (kv[1][0], kv[1][1]),
+    ):
+        if src == dst and not locks[src].reentrant:
+            via = f" via {' -> '.join(chain)}" if chain else ""
+            findings.append(Finding(
+                path, line, "lock-graph",
+                f"non-reentrant Lock {locks[src].display} is "
+                f"acquired while already held{via} — this "
+                "self-deadlocks",
+                severity=ERROR,
+                anchor=f"self.{locks[src].display}",
+            ))
+
+    # ---- cycles in the acquisition order -----------------------------
+    adj: Dict[tuple, List[tuple]] = {}
+    for (src, dst) in edges:
+        if src != dst:
+            adj.setdefault(src, []).append(dst)
+
+    # iterative DFS cycle detection with path reconstruction; each
+    # cycle is canonicalized (rotated to its smallest node) so one
+    # cycle yields one finding regardless of entry point
+    reported: Set[tuple] = set()
+    for start in sorted(adj, key=lambda k: locks[k].display):
+        stack = [(start, iter(adj.get(start, ())))]
+        on_path = [start]
+        on_path_set = {start}
+        visited_from_start: Set[tuple] = set()
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if nxt in on_path_set:
+                    cycle = on_path[on_path.index(nxt):] + [nxt]
+                    nodes = tuple(cycle[:-1])
+                    pivot = min(
+                        range(len(nodes)),
+                        key=lambda i: locks[nodes[i]].display,
+                    )
+                    canon = nodes[pivot:] + nodes[:pivot]
+                    if canon in reported:
+                        continue
+                    reported.add(canon)
+                    path_names = " -> ".join(
+                        locks[n].display
+                        for n in canon + (canon[0],)
+                    )
+                    first_edge = edges[(canon[0], canon[1 % len(canon)])]
+                    epath, eline, chain = first_edge
+                    via = (
+                        f"; first edge via {' -> '.join(chain)}"
+                        if chain else ""
+                    )
+                    findings.append(Finding(
+                        epath, eline, "lock-graph",
+                        "lock acquisition cycle: "
+                        f"{path_names} — two threads entering the "
+                        "cycle at different locks deadlock"
+                        f"{via}",
+                        severity=ERROR,
+                        anchor="cycle." + "->".join(
+                            locks[n].display for n in canon
+                        ),
+                    ))
+                elif nxt not in visited_from_start:
+                    visited_from_start.add(nxt)
+                    stack.append((nxt, iter(adj.get(nxt, ()))))
+                    on_path.append(nxt)
+                    on_path_set.add(nxt)
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                on_path.pop()
+                on_path_set.discard(node)
     return findings

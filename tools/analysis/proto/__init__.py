@@ -1,0 +1,43 @@
+"""Proto tier: protocol model checking + model<->code contract.
+
+The third analysis tier (``--tier proto``, ``make verify-protocol``),
+beside the ast tier (source passes) and the jaxpr tier (traced-program
+passes). Two passes:
+
+- ``protocol-model`` (tools/analysis/proto/model_check.py): load the
+  tree's ``service/protocol_model.py``, exhaustively explore every
+  bounded product automaton it declares (``build_systems()``), and
+  verify the four safety invariants plus drain/livelock liveness over
+  the FULL reachable state space. Violations come with a concrete
+  counterexample event trail.
+- ``protocol-contract`` (tools/analysis/proto/contract.py): the AST
+  pass that keeps the model honest — every live ``KIND_*`` constant,
+  ``_note_shed`` reason, breaker constant and admission counter must
+  appear in the model with the live value, and every model table entry
+  must map back to an existing code site. Either side drifting turns
+  ``make check`` red.
+
+Like the jaxpr tier, findings flow through the shared suppression
+grammar and baseline; ``_exercised_codes`` in the engine keeps a
+``--tier proto`` run from calling ast/jaxpr debt paid.
+"""
+
+from __future__ import annotations
+
+PROTO_PASS_NAMES = ("protocol-model", "protocol-contract")
+
+
+def run_tier(project, files, only_pass=None, model_path=None):
+    """All proto-tier findings for one engine run. Inert (returns [])
+    on trees that declare no protocol model — same convention as the
+    contract passes — so fixture trees stay green by default."""
+    from tools.analysis.proto import contract, model_check
+
+    findings = []
+    if only_pass in (None, "protocol-contract"):
+        findings.extend(contract.run(project, files))
+    if only_pass in (None, "protocol-model"):
+        findings.extend(
+            model_check.run(project, model_path=model_path)
+        )
+    return findings

@@ -1,0 +1,597 @@
+"""protocol-contract: the model <-> implementation binding pass.
+
+``service/protocol_model.py`` deliberately imports nothing from the
+live wire/agent/server modules — its mirrored constants are CLAIMS.
+This pass makes them falsifiable in both directions:
+
+- every live surface element must appear in the model: ``KIND_*``
+  constants, ``WIRE_VERSION``/``SUPPORTED_VERSIONS`` (service/wire.py);
+  literal ``_note_shed`` reasons with their flight kinds, every
+  ``self._resync_*`` admission attribute and the ingest-cap attribute
+  (service/server.py); every numeric UPPERCASE ``RemotePlanner`` class
+  constant and the exact ``_Endpoint.__slots__`` (service/agent.py);
+- every model element must map back to live code: table entries whose
+  constants vanished are errors anchored at the model line, and every
+  ``site`` string (``"service/agent.py::RemotePlanner._note_failure"``)
+  must resolve to an existing function through the project symbol
+  table, so a model event can never describe code that no longer
+  exists;
+- the breaker table must be structurally sound: edges only between
+  declared ``BREAKER_STATES``, and no declared state unreachable by
+  the table.
+
+Literal-only scanning, like every contract pass here: precision over
+recall — a constant built at runtime simply isn't bound, it never
+produces a false finding. Inert on trees without a protocol model.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from tools.analysis.common import (
+    ERROR,
+    Finding,
+    manifest_dict_literals,
+    relpath,
+)
+from tools.analysis.passes.contracts import _find_module
+
+MODEL_SUFFIX = "service/protocol_model.py"
+WIRE_SUFFIX = "service/wire.py"
+AGENT_SUFFIX = "service/agent.py"
+SERVER_SUFFIX = "service/server.py"
+
+# tables the model must declare for the contract to hold at all
+REQUIRED_TABLES = (
+    "VERSIONS", "WIRE_VERSION", "KINDS", "SHED_REASONS",
+    "BREAKER_STATES", "BREAKER_TABLE", "BREAKER_CONSTANTS",
+    "ENDPOINT_FIELDS", "ADMISSION_COUNTERS", "ADMISSION_LOCK_ATTR",
+    "ADMISSION_CAP_ATTR", "ADMISSION_SITES", "LADDER_TABLE",
+)
+
+
+def _assign_lineno(tree: ast.Module, name: str) -> int:
+    """Line of the top-level assignment binding ``name`` (1 if none)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name
+            for t in node.targets
+        ):
+            return node.lineno
+        if (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == name
+        ):
+            return node.lineno
+    return 1
+
+
+def _site_of(entry):
+    """The ``site`` string of a model table entry (dataclass or dict)."""
+    if isinstance(entry, dict):
+        return entry.get("site")
+    return getattr(entry, "site", None)
+
+
+def _load_model_values(path: Path):
+    """Execute the model file in isolation for its table VALUES (the
+    AST supplies line anchors). Load failures are owned by the
+    protocol-model pass — returning None keeps the two passes from
+    double-reporting one broken import."""
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_protocol_model_under_contract", path
+        )
+        module = importlib.util.module_from_spec(spec)
+        # dataclass field resolution looks the module up by name
+        sys.modules[spec.name] = module
+        spec.loader.exec_module(module)
+        return module
+    except Exception:  # noqa: BLE001 — reported by protocol-model instead
+        sys.modules.pop("_protocol_model_under_contract", None)
+        return None
+
+
+def _wire_constants(tree: ast.Module):
+    """Top-level literal ints: {name: (value, lineno)} for KIND_* /
+    WIRE_VERSION, plus the SUPPORTED_VERSIONS tuple."""
+    kinds = {}
+    wire_version = None
+    supported = None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            v = node.value
+            if (
+                t.id.startswith("KIND_")
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, int)
+                and not isinstance(v.value, bool)
+            ):
+                kinds[t.id] = (v.value, node.lineno)
+            elif t.id == "WIRE_VERSION" and isinstance(v, ast.Constant):
+                wire_version = (v.value, node.lineno)
+            elif t.id == "SUPPORTED_VERSIONS" and isinstance(
+                v, ast.Tuple
+            ):
+                vals = tuple(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                )
+                supported = (vals, node.lineno)
+    return kinds, wire_version, supported
+
+
+def _class_def(tree: ast.Module, name: str) -> Optional[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _numeric_class_constants(cls: ast.ClassDef):
+    """UPPERCASE numeric class attributes: {name: (value, lineno)}."""
+    out = {}
+    for node in cls.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Name)
+                and t.id.isupper()
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, (int, float))
+                and not isinstance(node.value.value, bool)
+            ):
+                out[t.id] = (node.value.value, node.lineno)
+    return out
+
+
+def _slots_tuple(cls: ast.ClassDef):
+    """(fields, lineno) of the class's literal __slots__ tuple."""
+    for node in cls.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "__slots__"
+            for t in node.targets
+        ):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return (
+                    tuple(
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                    ),
+                    node.lineno,
+                )
+    return None
+
+
+def _shed_calls(tree: ast.Module, funnel_default: str):
+    """Literal ``*._note_shed("reason", ..., kind=...)`` call sites:
+    [(reason, kind, lineno)]."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name != "_note_shed":
+            continue
+        if not (
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        kind = funnel_default
+        for kw in node.keywords:
+            if (
+                kw.arg == "kind"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)
+            ):
+                kind = kw.value.value
+        out.append((node.args[0].value, kind, node.lineno))
+    return out
+
+
+def _shed_funnel_default(tree: ast.Module) -> str:
+    """The literal default of ``_note_shed``'s ``kind`` parameter."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "_note_shed"
+        ):
+            args = node.args
+            params = list(args.posonlyargs) + list(args.args)
+            defaults = list(args.defaults)
+            # align defaults to the trailing params
+            for param, default in zip(
+                params[len(params) - len(defaults):], defaults
+            ):
+                if param.arg == "kind" and isinstance(
+                    default, ast.Constant
+                ):
+                    return default.value
+            for param, default in zip(args.kwonlyargs, args.kw_defaults):
+                if (
+                    param.arg == "kind"
+                    and isinstance(default, ast.Constant)
+                ):
+                    return default.value
+    return "service-shed"
+
+
+def _self_attr_stores(tree: ast.Module, prefix: str):
+    """{attr: first_lineno} for every ``self.<prefix>*`` assignment."""
+    out = {}
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and t.attr.startswith(prefix)
+            ):
+                out.setdefault(t.attr, node.lineno)
+    return out
+
+
+def _self_attr_assigned(tree: ast.Module, attr: str) -> bool:
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and t.attr == attr
+            ):
+                return True
+    return False
+
+
+def run(project, files) -> List[Finding]:
+    model_mod = _find_module(project, MODEL_SUFFIX)
+    if model_mod is None:
+        return []  # tree declares no protocol model: inert
+    model_path = relpath(model_mod.path)
+    model = _load_model_values(Path(model_mod.path))
+    if model is None:
+        return []  # protocol-model owns the load failure
+    findings: List[Finding] = []
+
+    def model_finding(line, message, anchor):
+        findings.append(Finding(
+            model_path, line, "protocol-contract", message,
+            severity=ERROR, anchor=anchor, tier="proto",
+        ))
+
+    missing_tables = [
+        t for t in REQUIRED_TABLES if not hasattr(model, t)
+    ]
+    for t in missing_tables:
+        model_finding(
+            1,
+            f"protocol model is missing the required table {t}; the "
+            "contract cannot bind the live surface without it",
+            f"table.{t}",
+        )
+    if missing_tables:
+        return findings
+
+    kind_lines = {
+        k: ln
+        for k, ln, _ in manifest_dict_literals(
+            model_mod.tree, "KINDS"
+        )[0]
+    }
+    shed_lines = {
+        k: ln
+        for k, ln, _ in manifest_dict_literals(
+            model_mod.tree, "SHED_REASONS"
+        )[0]
+    }
+    breaker_const_lines = {
+        k: ln
+        for k, ln, _ in manifest_dict_literals(
+            model_mod.tree, "BREAKER_CONSTANTS"
+        )[0]
+    }
+
+    # ---- service/wire.py: frame kinds + versions ---------------------
+    wire_mod = _find_module(project, WIRE_SUFFIX)
+    if wire_mod is not None:
+        wire_path = relpath(wire_mod.path)
+        kinds, wire_version, supported = _wire_constants(wire_mod.tree)
+        for name, (value, lineno) in sorted(kinds.items()):
+            entry = model.KINDS.get(name)
+            if entry is None:
+                findings.append(Finding(
+                    wire_path, lineno, "protocol-contract",
+                    f"live wire frame kind {name}={value} is absent "
+                    "from the protocol model's KINDS table "
+                    f"({model_path}) — the model checker is blind to "
+                    "it",
+                    severity=ERROR, anchor=name, tier="proto",
+                ))
+            else:
+                declared = (
+                    entry.get("value") if isinstance(entry, dict)
+                    else getattr(entry, "value", None)
+                )
+                if declared != value:
+                    findings.append(Finding(
+                        wire_path, lineno, "protocol-contract",
+                        f"{name} is {value} on the wire but "
+                        f"{declared} in the protocol model",
+                        severity=ERROR, anchor=name, tier="proto",
+                    ))
+        for name in sorted(set(model.KINDS) - set(kinds)):
+            model_finding(
+                kind_lines.get(
+                    name, _assign_lineno(model_mod.tree, "KINDS")
+                ),
+                f"model frame kind {name} has no live KIND_* constant "
+                f"in {wire_path}; the model describes a frame that "
+                "does not exist",
+                name,
+            )
+        if wire_version is not None and (
+            wire_version[0] != model.WIRE_VERSION
+        ):
+            findings.append(Finding(
+                wire_path, wire_version[1], "protocol-contract",
+                f"WIRE_VERSION is {wire_version[0]} live but "
+                f"{model.WIRE_VERSION} in the protocol model",
+                severity=ERROR, anchor="WIRE_VERSION", tier="proto",
+            ))
+        if supported is not None and (
+            supported[0] != tuple(model.VERSIONS)
+        ):
+            findings.append(Finding(
+                wire_path, supported[1], "protocol-contract",
+                f"SUPPORTED_VERSIONS is {supported[0]} live but "
+                f"{tuple(model.VERSIONS)} in the protocol model",
+                severity=ERROR, anchor="SUPPORTED_VERSIONS",
+                tier="proto",
+            ))
+
+    # ---- service/server.py: shed reasons + admission surface ---------
+    server_mod = _find_module(project, SERVER_SUFFIX)
+    if server_mod is not None:
+        server_path = relpath(server_mod.path)
+        funnel_default = _shed_funnel_default(server_mod.tree)
+        live_sheds = _shed_calls(server_mod.tree, funnel_default)
+        live_reasons = {}
+        for reason, kind, lineno in live_sheds:
+            live_reasons.setdefault(reason, (kind, lineno))
+        for reason, (kind, lineno) in sorted(live_reasons.items()):
+            entry = model.SHED_REASONS.get(reason)
+            if entry is None:
+                findings.append(Finding(
+                    server_path, lineno, "protocol-contract",
+                    f"live _note_shed reason '{reason}' is absent "
+                    "from the protocol model's SHED_REASONS table",
+                    severity=ERROR, anchor=f"shed.{reason}",
+                    tier="proto",
+                ))
+                continue
+            declared_kind = (
+                entry.get("flight_kind") if isinstance(entry, dict)
+                else getattr(entry, "flight_kind", None)
+            )
+            if declared_kind != kind:
+                findings.append(Finding(
+                    server_path, lineno, "protocol-contract",
+                    f"shed reason '{reason}' pairs with flight kind "
+                    f"'{kind}' live but '{declared_kind}' in the "
+                    "protocol model",
+                    severity=ERROR, anchor=f"shed.{reason}",
+                    tier="proto",
+                ))
+        for reason in sorted(set(model.SHED_REASONS) - set(live_reasons)):
+            model_finding(
+                shed_lines.get(
+                    reason,
+                    _assign_lineno(model_mod.tree, "SHED_REASONS"),
+                ),
+                f"model shed reason '{reason}' has no live "
+                f"_note_shed site in {server_path}",
+                f"shed.{reason}",
+            )
+
+        live_admission = _self_attr_stores(server_mod.tree, "_resync_")
+        declared_admission = set(model.ADMISSION_COUNTERS) | {
+            model.ADMISSION_LOCK_ATTR
+        }
+        for attr, lineno in sorted(live_admission.items()):
+            if attr not in declared_admission:
+                findings.append(Finding(
+                    server_path, lineno, "protocol-contract",
+                    f"live admission attribute self.{attr} is absent "
+                    "from the protocol model (ADMISSION_COUNTERS / "
+                    "ADMISSION_LOCK_ATTR) — new admission state means "
+                    "a new model dimension",
+                    severity=ERROR, anchor=f"admission.{attr}",
+                    tier="proto",
+                ))
+        for attr in sorted(declared_admission - set(live_admission)):
+            model_finding(
+                _assign_lineno(model_mod.tree, "ADMISSION_COUNTERS"),
+                f"model admission attribute '{attr}' is never "
+                f"assigned in {server_path}",
+                f"admission.{attr}",
+            )
+        if not _self_attr_assigned(
+            server_mod.tree, model.ADMISSION_CAP_ATTR
+        ):
+            model_finding(
+                _assign_lineno(model_mod.tree, "ADMISSION_CAP_ATTR"),
+                f"model admission cap attribute "
+                f"'{model.ADMISSION_CAP_ATTR}' is never assigned in "
+                f"{server_path}",
+                "admission.cap",
+            )
+
+    # ---- service/agent.py: breaker constants + endpoint fields -------
+    agent_mod = _find_module(project, AGENT_SUFFIX)
+    if agent_mod is not None:
+        agent_path = relpath(agent_mod.path)
+        planner_cls = _class_def(agent_mod.tree, "RemotePlanner")
+        if planner_cls is not None:
+            live_consts = _numeric_class_constants(planner_cls)
+            for name, (value, lineno) in sorted(live_consts.items()):
+                if name not in model.BREAKER_CONSTANTS:
+                    findings.append(Finding(
+                        agent_path, lineno, "protocol-contract",
+                        f"live RemotePlanner constant {name}={value} "
+                        "is absent from the protocol model's "
+                        "BREAKER_CONSTANTS",
+                        severity=ERROR, anchor=name, tier="proto",
+                    ))
+                elif model.BREAKER_CONSTANTS[name] != value:
+                    findings.append(Finding(
+                        agent_path, lineno, "protocol-contract",
+                        f"RemotePlanner.{name} is {value} live but "
+                        f"{model.BREAKER_CONSTANTS[name]} in the "
+                        "protocol model",
+                        severity=ERROR, anchor=name, tier="proto",
+                    ))
+            for name in sorted(
+                set(model.BREAKER_CONSTANTS) - set(live_consts)
+            ):
+                model_finding(
+                    breaker_const_lines.get(
+                        name,
+                        _assign_lineno(
+                            model_mod.tree, "BREAKER_CONSTANTS"
+                        ),
+                    ),
+                    f"model breaker constant {name} does not exist "
+                    "on RemotePlanner",
+                    name,
+                )
+        endpoint_cls = _class_def(agent_mod.tree, "_Endpoint")
+        if endpoint_cls is not None:
+            slots = _slots_tuple(endpoint_cls)
+            if slots is not None and (
+                slots[0] != tuple(model.ENDPOINT_FIELDS)
+            ):
+                findings.append(Finding(
+                    agent_path, slots[1], "protocol-contract",
+                    f"_Endpoint.__slots__ is {slots[0]} live but the "
+                    "protocol model's ENDPOINT_FIELDS is "
+                    f"{tuple(model.ENDPOINT_FIELDS)} — per-endpoint "
+                    "state and the model automaton have drifted",
+                    severity=ERROR, anchor="__slots__", tier="proto",
+                ))
+
+    # ---- breaker table structure -------------------------------------
+    table_line = _assign_lineno(model_mod.tree, "BREAKER_TABLE")
+    states = set(model.BREAKER_STATES)
+    touched = set()
+    for edge in model.BREAKER_TABLE:
+        src = (
+            edge.get("src") if isinstance(edge, dict)
+            else getattr(edge, "src", None)
+        )
+        dst = (
+            edge.get("dst") if isinstance(edge, dict)
+            else getattr(edge, "dst", None)
+        )
+        touched.update((src, dst))
+        for s in (src, dst):
+            if s not in states:
+                model_finding(
+                    table_line,
+                    f"BREAKER_TABLE edge touches undeclared state "
+                    f"'{s}' (BREAKER_STATES: "
+                    f"{tuple(model.BREAKER_STATES)})",
+                    f"breaker.{s}",
+                )
+    for s in sorted(states - touched):
+        model_finding(
+            _assign_lineno(model_mod.tree, "BREAKER_STATES"),
+            f"breaker state '{s}' is declared but no BREAKER_TABLE "
+            "edge touches it",
+            f"breaker.{s}",
+        )
+
+    # ---- every model site must be live code --------------------------
+    sites = []
+    for name, entry in model.KINDS.items():
+        sites.append((_site_of(entry), f"site.{name}",
+                      _assign_lineno(model_mod.tree, "KINDS")))
+    for reason, entry in model.SHED_REASONS.items():
+        sites.append((_site_of(entry), f"site.shed.{reason}",
+                      shed_lines.get(reason, 1)))
+    for edge in model.BREAKER_TABLE:
+        event = (
+            edge.get("event") if isinstance(edge, dict)
+            else getattr(edge, "event", "?")
+        )
+        sites.append((_site_of(edge), f"site.breaker.{event}",
+                      table_line))
+    for entry in model.LADDER_TABLE:
+        event = (
+            entry.get("event") if isinstance(entry, dict)
+            else getattr(entry, "event", "?")
+        )
+        sites.append((_site_of(entry), f"site.ladder.{event}",
+                      _assign_lineno(model_mod.tree, "LADDER_TABLE")))
+    for key, site in model.ADMISSION_SITES.items():
+        sites.append((site, f"site.admission.{key}",
+                      _assign_lineno(model_mod.tree,
+                                     "ADMISSION_SITES")))
+    seen_sites = set()
+    for site, anchor, line in sites:
+        if site is None or site in seen_sites:
+            continue
+        seen_sites.add(site)
+        if "::" not in site:
+            model_finding(
+                line,
+                f"model site '{site}' is not of the form "
+                "'<path-suffix>::<qualname>'",
+                anchor,
+            )
+            continue
+        suffix, qual = site.split("::", 1)
+        target_mod = _find_module(project, suffix)
+        if target_mod is None:
+            model_finding(
+                line,
+                f"model site '{site}' names a module not present in "
+                "the analyzed tree",
+                anchor,
+            )
+            continue
+        if qual not in target_mod.functions:
+            model_finding(
+                line,
+                f"model site '{site}' maps to no live function — the "
+                "code the model event describes no longer exists",
+                anchor,
+            )
+    return findings

@@ -1,0 +1,272 @@
+"""protocol-model: exhaustive exploration of the declared automata.
+
+Loads the analyzed tree's ``service/protocol_model.py`` (or the
+``--proto-model`` override) and, for every bounded product automaton
+its ``build_systems()`` declares, BFS-explores the FULL reachable
+state graph, checking:
+
+- **safety** — ``system.check(state, label, info, next)`` on every
+  explored transition (the four wire/breaker/admission invariants for
+  the real model); any violation is an error finding carrying the
+  event trail from the initial state to the violating transition;
+- **deadlock** — a reachable non-goal state with no successors is an
+  error (the product automaton must never wedge);
+- **liveness under weak fairness** — every reachable state must be
+  able to reach a goal state (``system.is_goal``: storm drained, all
+  tenants cached+acked, no breaker open), computed by backward
+  reachability from the goal set over the explored graph. A state
+  from which the drained state is unreachable is an error with the
+  trail to it. This is EF-goal: since some path always drains, weak
+  fairness on the drain-enabling events (admission releases, reply
+  deliveries, breaker-backoff expiry) guarantees the storm drains and
+  no breaker livelocks; only an adversarial scheduler that starves
+  those events forever could avoid it.
+
+Exploration is exact, not sampled: exceeding ``max_states`` is itself
+an error finding (silent truncation would read as "proved"), and
+tests/test_protocol_model.py pins the explored sizes so a model edit
+that quietly shrinks coverage is loud.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import importlib.util
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from tools.analysis.common import ERROR, Finding, relpath
+from tools.analysis.passes.contracts import _find_module
+
+MODEL_SUFFIX = "service/protocol_model.py"
+
+# Generous headroom over the real model's ~95k combined states; a
+# bounds bump that crosses this should raise it CONSCIOUSLY, with the
+# runtime cost measured against the make-check watchdog.
+MAX_STATES = 400_000
+
+# event-trail prefix kept on findings: long enough to replay by hand,
+# short enough to read in a terminal
+_TRAIL_LIMIT = 24
+
+
+@dataclasses.dataclass
+class Exploration:
+    """Everything one ``explore()`` run proved (or found)."""
+
+    name: str
+    n_states: int = 0
+    n_edges: int = 0
+    n_goal: int = 0
+    truncated: bool = False
+    # (message, trail-of-event-labels) per defect, bounded
+    violations: List[tuple] = dataclasses.field(default_factory=list)
+    deadlocks: List[tuple] = dataclasses.field(default_factory=list)
+    undrainable: List[tuple] = dataclasses.field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not (
+            self.truncated
+            or self.violations
+            or self.deadlocks
+            or self.undrainable
+        )
+
+
+def _trail(seen, state) -> List[str]:
+    """Event labels from the initial state to ``state``."""
+    labels = []
+    while seen[state] is not None:
+        state, label = seen[state]
+        labels.append(label)
+    return list(reversed(labels))
+
+
+def _fmt_trail(labels: List[str]) -> str:
+    if len(labels) > _TRAIL_LIMIT:
+        labels = labels[:_TRAIL_LIMIT] + [
+            f"... (+{len(labels) - _TRAIL_LIMIT} more)"
+        ]
+    return " -> ".join(labels) if labels else "<initial>"
+
+
+def explore(system, max_states: int = MAX_STATES,
+            max_defects: int = 3) -> Exploration:
+    """Exhaustively explore one system; never raises on model defects —
+    they land in the returned :class:`Exploration`."""
+    out = Exploration(name=getattr(system, "name", "system"))
+    init = system.initial()
+    seen = {init: None}  # state -> (predecessor, label) | None
+    preds = collections.defaultdict(list)
+    goal = []
+    frontier = collections.deque([init])
+    while frontier:
+        state = frontier.popleft()
+        if system.is_goal(state):
+            goal.append(state)
+        succs = list(system.successors(state))
+        if not succs and not system.is_goal(state):
+            if len(out.deadlocks) < max_defects:
+                out.deadlocks.append((
+                    "terminal non-goal state (protocol wedged)",
+                    _fmt_trail(_trail(seen, state)),
+                ))
+        for label, info, nxt in succs:
+            out.n_edges += 1
+            for msg in system.check(state, label, info, nxt):
+                if len(out.violations) < max_defects:
+                    out.violations.append((
+                        msg,
+                        _fmt_trail(_trail(seen, state) + [label]),
+                    ))
+            if nxt not in seen:
+                if len(seen) >= max_states:
+                    out.truncated = True
+                    out.n_states = len(seen)
+                    out.n_goal = len(goal)
+                    return out
+                seen[nxt] = (state, label)
+                frontier.append(nxt)
+            preds[nxt].append(state)
+    out.n_states = len(seen)
+    out.n_goal = len(goal)
+
+    # liveness: backward reachability from the goal set
+    can_reach = set(goal)
+    bq = collections.deque(goal)
+    while bq:
+        state = bq.popleft()
+        for p in preds[state]:
+            if p not in can_reach:
+                can_reach.add(p)
+                bq.append(p)
+    if len(can_reach) != len(seen):
+        for state in seen:
+            if state in can_reach:
+                continue
+            if len(out.undrainable) >= max_defects:
+                break
+            out.undrainable.append((
+                "state cannot drain: no path to the goal "
+                "(all-tenants-cached, breakers closed) exists",
+                _fmt_trail(_trail(seen, state)),
+            ))
+    return out
+
+
+def _load_model(project, model_path: Optional[str]):
+    """(module, display_path, error) — the model module to check."""
+    if model_path is not None:
+        path = Path(model_path)
+        display = relpath(path)
+    else:
+        mod = _find_module(project, MODEL_SUFFIX)
+        if mod is None:
+            return None, None, None  # inert: tree declares no model
+        path = Path(mod.path)
+        display = relpath(path)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_protocol_model_under_check", path
+        )
+        module = importlib.util.module_from_spec(spec)
+        # dataclass field resolution looks the module up by name
+        sys.modules[spec.name] = module
+        spec.loader.exec_module(module)
+    except Exception as exc:  # noqa: BLE001 — any import failure is the finding
+        sys.modules.pop("_protocol_model_under_check", None)
+        return None, display, f"{type(exc).__name__}: {exc}"
+    return module, display, None
+
+
+def run(project, model_path=None) -> List[Finding]:
+    """The protocol-model pass: explore every declared system."""
+    module, display, err = _load_model(project, model_path)
+    if module is None and display is None:
+        return []
+    findings: List[Finding] = []
+    if module is None:
+        return [Finding(
+            display, 1, "protocol-model",
+            f"protocol model failed to load: {err}",
+            severity=ERROR, anchor="load", tier="proto",
+        )]
+    build = getattr(module, "build_systems", None)
+    if build is None:
+        return [Finding(
+            display, 1, "protocol-model",
+            "protocol model declares no build_systems(); nothing to "
+            "explore — the exhaustive proof the tier promises cannot "
+            "run",
+            severity=ERROR, anchor="build_systems", tier="proto",
+        )]
+    try:
+        systems = list(build())
+    except Exception as exc:  # noqa: BLE001 — surfaced as a finding
+        return [Finding(
+            display, 1, "protocol-model",
+            f"build_systems() raised {type(exc).__name__}: {exc}",
+            severity=ERROR, anchor="build_systems", tier="proto",
+        )]
+    if not systems:
+        return [Finding(
+            display, 1, "protocol-model",
+            "build_systems() returned no systems; the tier would pass "
+            "vacuously",
+            severity=ERROR, anchor="build_systems", tier="proto",
+        )]
+    for system in systems:
+        try:
+            result = explore(system)
+        except Exception as exc:  # noqa: BLE001 — surfaced as a finding
+            findings.append(Finding(
+                display, 1, "protocol-model",
+                f"exploration of '{getattr(system, 'name', '?')}' "
+                f"raised {type(exc).__name__}: {exc}",
+                severity=ERROR,
+                anchor=f"{getattr(system, 'name', '?')}.explore",
+                tier="proto",
+            ))
+            continue
+        name = result.name
+        if result.truncated:
+            findings.append(Finding(
+                display, 1, "protocol-model",
+                f"'{name}' exceeded the {MAX_STATES} explored-state "
+                "bound — the proof is INCOMPLETE; shrink the declared "
+                "bounds or raise MAX_STATES consciously",
+                severity=ERROR, anchor=f"{name}.bound", tier="proto",
+            ))
+            continue
+        if result.n_goal == 0:
+            findings.append(Finding(
+                display, 1, "protocol-model",
+                f"'{name}' has no reachable goal state: the drained "
+                "fleet is not in the state space at all",
+                severity=ERROR, anchor=f"{name}.goal", tier="proto",
+            ))
+        for msg, trail in result.violations:
+            findings.append(Finding(
+                display, 1, "protocol-model",
+                f"'{name}' safety violation: {msg}; trail: {trail}",
+                severity=ERROR,
+                anchor=f"{name}.safety", tier="proto",
+            ))
+        for msg, trail in result.deadlocks:
+            findings.append(Finding(
+                display, 1, "protocol-model",
+                f"'{name}' deadlock: {msg}; trail: {trail}",
+                severity=ERROR,
+                anchor=f"{name}.deadlock", tier="proto",
+            ))
+        for msg, trail in result.undrainable:
+            findings.append(Finding(
+                display, 1, "protocol-model",
+                f"'{name}' liveness violation: {msg}; trail: {trail}",
+                severity=ERROR,
+                anchor=f"{name}.liveness", tier="proto",
+            ))
+    return findings
